@@ -1,0 +1,81 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle (reference: zkh2016/Paddle), built from scratch on
+jax/XLA/pallas.
+
+Execution model (mirrors paddle's dygraph/static split, re-designed for XLA):
+
+* **Eager** — ops dispatch to jnp (executed async on the TPU), an autograd
+  tape gives ``loss.backward()`` / ``Tensor.grad`` semantics.
+* **Compiled** — ``paddle_tpu.jit.to_static`` and the train-step builders in
+  hapi/fleet trace the same python code into one XLA program (grads via
+  jax.grad, optimizer fused in, shardings via jax.sharding) — this is the
+  performance path, equivalent to the reference's static graph + fused
+  executor, with XLA doing what phi+CINN do there.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .framework import (  # noqa: F401
+    CPUPlace, CUDAPlace, TPUPlace, bfloat16, bool_, complex64, complex128,
+    device_count, float16, float32, float64, get_default_dtype, get_device,
+    int8, int16, int32, int64, seed, set_default_dtype, set_device, uint8,
+)
+from .tensor import Parameter, Tensor, to_tensor  # noqa: F401
+from .tensor_ops import *  # noqa: F401,F403
+from .tensor_ops import _bind  # noqa: F401  (attaches Tensor methods)
+from .autograd import enable_grad, grad, no_grad  # noqa: F401
+from .autograd.tape import set_grad_enabled  # noqa: F401
+
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import distributed  # noqa: F401
+from . import distribution  # noqa: F401
+from . import fft  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import metric  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import static  # noqa: F401
+from . import text  # noqa: F401
+from . import utils  # noqa: F401
+from . import vision  # noqa: F401
+
+from .framework.io import load, save  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .hapi.model_summary import summary  # noqa: F401
+from .utils.unique_name import guard as unique_name_guard  # noqa: F401
+
+linalg = None
+from . import tensor_ops as _ops  # noqa: E402
+from .tensor_ops import linalg as _linalg_mod  # noqa: E402
+
+linalg = _linalg_mod
+
+
+def is_grad_enabled():
+    from .autograd.tape import grad_enabled
+    return grad_enabled()
+
+
+def get_flags(*a, **k):
+    return {}
+
+
+def set_flags(*a, **k):
+    return None
+
+
+def in_dynamic_mode():
+    from .jit.api import in_to_static
+    return not in_to_static()
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static(place=None):
+    return None

@@ -1,0 +1,2 @@
+from .auto_cast import amp_guard, amp_state, auto_cast, decorate  # noqa: F401
+from .grad_scaler import GradScaler  # noqa: F401

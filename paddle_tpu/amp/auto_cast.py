@@ -1,0 +1,68 @@
+"""AMP auto_cast (reference: python/paddle/amp/auto_cast.py).
+
+TPU-first policy: the native accumulate-in-fp32 matmul dtype is bfloat16, so
+O1 casts matmul/conv inputs to bf16 (no loss scaling needed, unlike fp16 on
+GPU); O2 additionally keeps parameters in bf16. The cast hook lives in the
+compute-heavy ops (matmul, conv, einsum) — elementwise ops stay in fp32 and
+XLA fuses them, which mirrors the reference's white/black op lists.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+_tls = threading.local()
+
+
+def amp_state():
+    return getattr(_tls, "state", None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = amp_state()
+    _tls.state = {"enable": enable, "level": level,
+                  "dtype": jnp.bfloat16 if dtype in ("bfloat16", "bf16") else jnp.float16} if enable else None
+    try:
+        yield
+    finally:
+        _tls.state = prev
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_compute(*arrays):
+    """Cast matmul/conv inputs per the active amp policy (fp32→bf16)."""
+    st = amp_state()
+    if not st or not st["enable"]:
+        return arrays
+    dt = st["dtype"]
+    out = tuple(a.astype(dt) if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+                for a in arrays)
+    return out
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast Layer parameters to the compute dtype.
+
+    With bf16 on TPU, master weights default to fp32 copies kept by the
+    optimizer (set master_weight=False to train pure-bf16).
+    """
+    from ..nn.layer_base import Layer
+
+    dt = jnp.bfloat16 if dtype in ("bfloat16", "bf16") else jnp.float16
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    for mdl in model_list:
+        if isinstance(mdl, Layer):
+            for p in mdl.parameters():
+                if p._data.dtype == jnp.float32:
+                    p._data = p._data.astype(dt)
+    if optimizers is None:
+        return models
+    return models, optimizers

@@ -1,0 +1,20 @@
+from .tape import backward, enable_grad, functional_mode, no_grad  # noqa: F401
+
+# functional/py_layer import Tensor, which imports this package — load lazily
+_LAZY = {"grad": "functional", "value_and_grad": "functional",
+         "jacobian": "functional", "hessian": "functional", "vjp": "functional",
+         "jvp": "functional", "PyLayer": "py_layer",
+         "PyLayerContext": "py_layer"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module("." + _LAZY[name], __name__)
+        return getattr(mod, name)
+    raise AttributeError(name)
+
+
+def is_grad_enabled():
+    from .tape import grad_enabled
+    return grad_enabled()

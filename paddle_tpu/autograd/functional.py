@@ -1,0 +1,89 @@
+"""Functional autograd (reference: python/paddle/autograd/functional.py).
+
+These are thin adapters over jax transforms: the supplied python function is
+executed in ``functional_mode`` (tape off) so jax traces straight through the
+jnp calls inside our ops.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .tape import functional_mode
+
+
+def _wrap_fn(func):
+    """Lift a Tensor->Tensor python function to a raw-array function."""
+    def raw_fn(*raw_args):
+        args = [Tensor(a, stop_gradient=False) for a in raw_args]
+        with functional_mode():
+            out = func(*args)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+        return out._data if isinstance(out, Tensor) else out
+    return raw_fn
+
+
+def _raw_args(xs):
+    if isinstance(xs, (tuple, list)):
+        return tuple(x._data if isinstance(x, Tensor) else jnp.asarray(x) for x in xs)
+    return (xs._data if isinstance(xs, Tensor) else jnp.asarray(xs),)
+
+
+def grad(func: Callable, argnums=0, has_aux=False):
+    """jax.grad over a paddle-style function of Tensors."""
+    gfn = jax.grad(_wrap_fn(func), argnums=argnums, has_aux=has_aux)
+
+    def wrapper(*args):
+        out = gfn(*(a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args))
+        return jax.tree_util.tree_map(Tensor, out)
+    return wrapper
+
+
+def value_and_grad(func: Callable, argnums=0, has_aux=False):
+    gfn = jax.value_and_grad(_wrap_fn(func), argnums=argnums, has_aux=has_aux)
+
+    def wrapper(*args):
+        out = gfn(*(a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args))
+        return jax.tree_util.tree_map(Tensor, out)
+    return wrapper
+
+
+def vjp(func, xs, v=None):
+    raw = _raw_args(xs)
+    out, vjp_fn = jax.vjp(_wrap_fn(func), *raw)
+    if v is None:
+        v = jnp.ones_like(out)
+    else:
+        v = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+    grads = vjp_fn(v)
+    outs = Tensor(out) if not isinstance(out, tuple) else tuple(Tensor(o) for o in out)
+    gs = tuple(Tensor(g) for g in grads)
+    return outs, gs if len(gs) > 1 else gs[0]
+
+
+def jvp(func, xs, v=None):
+    raw = _raw_args(xs)
+    if v is None:
+        tangents = tuple(jnp.ones_like(r) for r in raw)
+    else:
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        tangents = tuple(t._data if isinstance(t, Tensor) else jnp.asarray(t) for t in vs)
+    out, tangent_out = jax.jvp(_wrap_fn(func), raw, tangents)
+    outs = Tensor(out) if not isinstance(out, tuple) else tuple(Tensor(o) for o in out)
+    return outs, Tensor(tangent_out) if not isinstance(tangent_out, tuple) else tuple(Tensor(t) for t in tangent_out)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    raw = _raw_args(xs)
+    jac = jax.jacrev(_wrap_fn(func), argnums=tuple(range(len(raw))) if len(raw) > 1 else 0)(*raw)
+    return jax.tree_util.tree_map(Tensor, jac)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    raw = _raw_args(xs)
+    h = jax.hessian(_wrap_fn(func), argnums=tuple(range(len(raw))) if len(raw) > 1 else 0)(*raw)
+    return jax.tree_util.tree_map(Tensor, h)

@@ -1,0 +1,79 @@
+"""PyLayer: user-defined forward/backward (reference:
+python/paddle/autograd/py_layer.py).
+
+Usage matches paddle::
+
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x ** 3
+        @staticmethod
+        def backward(ctx, dy):
+            x, = ctx.saved_tensor()
+            return 3 * x ** 2 * dy
+
+Internally the custom backward is spliced into the eager tape as one node.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from . import tape
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(t.detach() if isinstance(t, Tensor) else t for t in tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        taping = tape.grad_enabled()
+        parents = [a for a in args if isinstance(a, Tensor) and not a.stop_gradient]
+        with tape.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+        if not taping or not parents:
+            return out
+
+        outs = tuple(Tensor(o._data, stop_gradient=False) for o in outs)
+
+        def vjp_fn(out_cts):
+            cts = tuple(
+                Tensor(jnp.zeros_like(o._data)) if ct is None else Tensor(ct)
+                for o, ct in zip(outs, out_cts)
+            )
+            with tape.no_grad():
+                grads = cls.backward(ctx, *cts)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            raw = []
+            gi = iter(grads)
+            for a in args:
+                if isinstance(a, Tensor) and not a.stop_gradient:
+                    g = next(gi, None)
+                    raw.append(None if g is None else (g._data if isinstance(g, Tensor) else jnp.asarray(g)))
+            return raw
+
+        tape.record(vjp_fn, parents, outs)
+        return outs if multi else outs[0]

@@ -1,0 +1,202 @@
+"""Eager autograd tape.
+
+Reference: paddle/fluid/eager (C++ GradNode graph) + python/paddle/autograd.
+Paddle's dygraph records a GradNode per op and walks it on
+``loss.backward()``. We do the same in Python: every primitive op (a pure
+jnp function) that touches a grad-requiring Tensor is recorded as a Node
+holding a jax VJP closure. ``backward`` walks nodes in reverse creation
+order accumulating cotangents into leaf ``Tensor.grad``.
+
+The compiled/perf path does NOT use the tape: inside
+``paddle_tpu.jit.to_static`` / train-step builders, ``functional_mode``
+disables recording and gradients come from ``jax.grad`` tracing straight
+through the jnp calls.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "grad_enabled"):
+        _state.grad_enabled = True
+        _state.functional = 0
+    return _state
+
+
+def grad_enabled() -> bool:
+    s = _st()
+    return s.grad_enabled and s.functional == 0
+
+
+@contextlib.contextmanager
+def no_grad():
+    s = _st()
+    prev = s.grad_enabled
+    s.grad_enabled = False
+    try:
+        yield
+    finally:
+        s.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    s = _st()
+    prev = s.grad_enabled
+    s.grad_enabled = True
+    try:
+        yield
+    finally:
+        s.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def functional_mode():
+    """Disable taping entirely (used while tracing jitted/functional code)."""
+    s = _st()
+    s.functional += 1
+    try:
+        yield
+    finally:
+        s.functional -= 1
+
+
+@contextlib.contextmanager
+def set_grad_enabled(mode: bool):
+    """paddle.set_grad_enabled(bool) — context manager form of the API."""
+    s = _st()
+    prev = s.grad_enabled
+    s.grad_enabled = bool(mode)
+    try:
+        yield
+    finally:
+        s.grad_enabled = prev
+
+_node_counter = itertools.count()
+
+
+class Node:
+    """One recorded primitive application."""
+
+    __slots__ = ("id", "vjp_fn", "parents", "n_outputs", "out_ids")
+
+    def __init__(self, vjp_fn, parents, n_outputs):
+        self.id = next(_node_counter)
+        self.vjp_fn = vjp_fn  # cotangents(tuple per output) -> grads per parent
+        self.parents = parents  # list[Tensor] (the diff inputs, in order)
+        self.n_outputs = n_outputs
+        self.out_ids = []  # python id() of output Tensors, parallel to outputs
+
+
+def record(vjp_fn, parents, outputs) -> Node:
+    node = Node(vjp_fn, parents, len(outputs))
+    for o in outputs:
+        o._node = node
+        o._out_index = len(node.out_ids)
+        node.out_ids.append(id(o))
+    return node
+
+
+def backward(tensor, grad_tensor=None, retain_graph=False):
+    """Run reverse accumulation from ``tensor``.
+
+    Populates ``.grad`` on every reachable leaf with stop_gradient=False.
+    Grads accumulate across calls (paddle semantics) until clear_grad.
+    """
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor
+
+    if tensor._node is None and tensor.stop_gradient:
+        raise RuntimeError(
+            "Tensor has no grad graph; it was computed under no_grad or all "
+            "inputs have stop_gradient=True"
+        )
+    if grad_tensor is None:
+        if tensor.size != 1:
+            raise RuntimeError(
+                "backward() on a non-scalar requires grad_tensor")
+        seed_ct = jnp.ones_like(tensor._data)
+    else:
+        seed_ct = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    # cotangent store: (node_id, out_index) -> array, plus leaf tensors
+    cts = {}
+
+    def add_ct(store, key, val):
+        cur = store.get(key)
+        store[key] = val if cur is None else cur + val
+
+    leaf_cts = {}  # id(tensor) -> (tensor, ct)
+
+    if tensor._node is None:
+        # backward on a leaf: its grad is just the seed
+        _accum_leaf(tensor, seed_ct)
+        return
+
+    add_ct(cts, (tensor._node.id, tensor._out_index), seed_ct)
+
+    # Collect reachable nodes, process in reverse creation order (valid topo
+    # order since parents are always created before children).
+    nodes = {}
+    stack = [tensor._node]
+    while stack:
+        n = stack.pop()
+        if n.id in nodes:
+            continue
+        nodes[n.id] = n
+        for p in n.parents:
+            if p._node is not None:
+                stack.append(p._node)
+
+    for nid in sorted(nodes, reverse=True):
+        node = nodes[nid]
+        outs_ct = []
+        has_any = False
+        for i in range(node.n_outputs):
+            ct = cts.pop((nid, i), None)
+            if ct is not None:
+                has_any = True
+            outs_ct.append(ct)
+        if not has_any:
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "trying to backward through the graph a second time; pass "
+                "retain_graph=True to the first backward")
+        grads = node.vjp_fn(outs_ct)
+        for parent, g in zip(node.parents, grads):
+            if g is None:
+                continue
+            if parent._node is not None:
+                add_ct(cts, (parent._node.id, parent._out_index), g)
+            elif not parent.stop_gradient:
+                key = id(parent)
+                if key in leaf_cts:
+                    leaf_cts[key] = (parent, leaf_cts[key][1] + g)
+                else:
+                    leaf_cts[key] = (parent, g)
+        if not retain_graph:
+            node.vjp_fn = None
+
+    for parent, g in leaf_cts.values():
+        _accum_leaf(parent, g)
+
+
+def _accum_leaf(tensor, g):
+    from ..tensor import Tensor
+
+    if tensor.stop_gradient:
+        return
+    if tensor.grad is None:
+        tensor.grad = Tensor(g, stop_gradient=True)
+    else:
+        tensor.grad = Tensor(tensor.grad._data + g, stop_gradient=True)

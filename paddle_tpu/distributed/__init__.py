@@ -1,0 +1,23 @@
+"""paddle_tpu.distributed — mirrors paddle.distributed, built on
+jax.sharding + XLA collectives (see SURVEY.md §2 Distributed)."""
+from . import fleet  # noqa: F401
+from . import mesh  # noqa: F401
+from .auto_parallel import shard_op, shard_tensor  # noqa: F401
+from .checkpoint import load_distributed, save_distributed  # noqa: F401
+from .collective import (  # noqa: F401
+    Group, ReduceOp, all_gather, all_gather_object, all_reduce, alltoall,
+    alltoall_single, barrier, broadcast, destroy_process_group, get_group,
+    get_rank, get_world_size, init_parallel_env, irecv, is_initialized,
+    isend, new_group, recv, reduce, scatter, send, split, wait,
+)
+from .parallel import DataParallel, ParallelEnv  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """Single-controller: run inline (XLA owns all local devices)."""
+    func(*args)
+
+
+def launch():
+    from .launch_main import main
+    main()

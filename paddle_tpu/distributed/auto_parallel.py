@@ -1,0 +1,35 @@
+"""auto_parallel marker API (reference: python/paddle/distributed/
+auto_parallel/interface.py shard_tensor/shard_op).
+
+On TPU these become real placements: shard_tensor device_puts with a
+NamedSharding over the global mesh so downstream jit computations start
+from the annotated layout.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..tensor import Tensor
+from . import mesh as mesh_mod
+
+
+def shard_tensor(x, process_mesh=None, shard_spec=None, dist_attr=None):
+    mesh = process_mesh or mesh_mod.get_mesh()
+    if shard_spec is None:
+        spec = PartitionSpec()
+    else:
+        spec = PartitionSpec(*[s if s in mesh.axis_names else None
+                               for s in shard_spec])
+    data = x._data if isinstance(x, Tensor) else x
+    placed = jax.device_put(data, NamedSharding(mesh, spec))
+    if isinstance(x, Tensor):
+        x._data = placed
+        if hasattr(x, "pspec"):
+            x.pspec = spec
+        return x
+    return Tensor(placed)
+
+
+def shard_op(op, process_mesh=None, in_shard_specs=None, out_shard_specs=None):
+    return op

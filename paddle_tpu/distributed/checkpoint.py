@@ -1,0 +1,53 @@
+"""Distributed (sharded, async) checkpointing.
+
+Reference analog: python/paddle/incubate/checkpoint + fleet utils. Backed by
+orbax when available (async, per-shard files, TPU-friendly); falls back to
+the numpy pickle writer in framework/io.py.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from ..tensor import Tensor
+
+try:
+    import orbax.checkpoint as ocp
+    _HAS_ORBAX = True
+except Exception:
+    _HAS_ORBAX = False
+
+
+def save_distributed(state_dict, path, async_save=False):
+    """state_dict: name → Tensor (possibly sharded jax arrays)."""
+    raw = {k: (v._data if isinstance(v, Tensor) else v)
+           for k, v in state_dict.items()}
+    if _HAS_ORBAX:
+        path = os.path.abspath(path)
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(path, raw, force=True)
+        if not async_save:
+            ckptr.wait_until_finished()
+        return path
+    from ..framework.io import save as _save
+    _save({k: Tensor(np.asarray(v)) for k, v in raw.items()}, path)
+    return path
+
+
+def load_distributed(path, template=None):
+    """Returns name → Tensor. With orbax + template, restores with the
+    template's shardings (resharded load)."""
+    if _HAS_ORBAX and os.path.isdir(path):
+        ckptr = ocp.StandardCheckpointer()
+        if template is not None:
+            tmpl = {k: (v._data if isinstance(v, Tensor) else v)
+                    for k, v in template.items()}
+            restored = ckptr.restore(os.path.abspath(path), tmpl)
+        else:
+            restored = ckptr.restore(os.path.abspath(path))
+        return {k: Tensor(v) for k, v in restored.items()}
+    from ..framework.io import load as _load
+    out = _load(path)
+    return out

@@ -1,0 +1,272 @@
+"""Collective communication API.
+
+Reference: python/paddle/distributed/collective.py (ProcessGroupNCCL-backed
+all_reduce / all_gather / ... with ring ids). TPU-native mapping:
+
+* Compiled path (the perf path): collectives are *implied* by shardings
+  under pjit — user code rarely calls these.
+* Manual-SPMD path: inside ``shard_map`` (ring attention, pipeline,
+  custom kernels) these functions lower to jax.lax collectives
+  (psum/all_gather/ppermute/all_to_all) over the mesh axis named by the
+  Group.
+* Eager, single controller: world_size == process count (1 locally), so the
+  collectives are identity — matching paddle semantics where each rank holds
+  its local tensor.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, apply
+from . import mesh as mesh_mod
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communicator == a (set of) mesh axis name(s)."""
+
+    def __init__(self, rank=0, nranks=1, id=0, ranks=None, axis_names=("dp",)):
+        self.rank = rank
+        self.nranks = nranks
+        self.id = id
+        self.ranks = ranks or list(range(nranks))
+        self.axis_names = tuple(axis_names)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def __repr__(self):
+        return f"Group(axes={self.axis_names}, nranks={self.nranks})"
+
+
+_default_group: Optional[Group] = None
+_initialized = False
+
+
+def init_parallel_env():
+    """Initialize distributed state. Multi-host: jax.distributed via the
+    standard env (PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID or JAX coords)."""
+    global _initialized, _default_group
+    if _initialized:
+        return _default_group
+    n_proc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
+        "MASTER_ADDR_PORT")
+    if n_proc > 1 and coord:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=n_proc, process_id=pid)
+    _default_group = Group(rank=pid, nranks=max(n_proc, 1) if n_proc > 1
+                           else 1, axis_names=("dp", "sharding"))
+    _initialized = True
+    return _default_group
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank(group=None):
+    return (group or _default_group or Group()).rank if _initialized or group \
+        else int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    if _default_group is not None:
+        return _default_group.nranks
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+def new_group(ranks=None, backend=None, axis_names=None):
+    g = Group(rank=0, nranks=len(ranks) if ranks else get_world_size(),
+              ranks=ranks, axis_names=tuple(axis_names or ("dp",)))
+    return g
+
+
+def get_group(id=0):
+    return _default_group
+
+
+def _in_shard_map(axis_names) -> bool:
+    """True when called under a shard_map/pmap trace that binds these axes."""
+    try:
+        jax.lax.axis_index(axis_names[0] if len(axis_names) == 1
+                           else tuple(axis_names))
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+def _axes(group):
+    g = group or _default_group
+    return g.axis_names if g is not None else ("dp",)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=True):
+    axes = _axes(group)
+    if _in_shard_map(axes):
+        ax = axes if len(axes) > 1 else axes[0]
+
+        def _pprod(a, axis_name):
+            # no pprod primitive: product = all_gather then reduce
+            return jnp.prod(jax.lax.all_gather(a, axis_name), axis=0)
+
+        fns = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+               ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean,
+               ReduceOp.PROD: _pprod}
+        out = apply(lambda a: fns[op](a, ax), tensor)
+        tensor._data = out._data
+        tensor._node = out._node
+        tensor._out_index = out._out_index
+        return tensor
+    return tensor  # single-controller eager: already the global value
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=True):
+    axes = _axes(group)
+    if _in_shard_map(axes):
+        ax = axes if len(axes) > 1 else axes[0]
+        gathered = apply(lambda a: jax.lax.all_gather(a, ax), tensor)
+        if isinstance(tensor_list, list):
+            n = gathered.shape[0]
+            for i in range(n):
+                tensor_list.append(gathered[i])
+        return gathered
+    if isinstance(tensor_list, list):
+        tensor_list.append(tensor)
+    return tensor
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True,
+           use_calc_stream=True):
+    return all_reduce(tensor, op, group)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True, use_calc_stream=True):
+    axes = _axes(group)
+    if _in_shard_map(axes):
+        ax = axes[0]
+        # select src's value on every member
+        out = apply(lambda a: jax.lax.all_gather(a, ax)[src], tensor)
+        tensor._data = out._data
+        tensor._node = out._node
+        tensor._out_index = out._out_index
+        return tensor
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True,
+            use_calc_stream=True):
+    axes = _axes(group)
+    if _in_shard_map(axes):
+        ax = axes[0]
+        idx = jax.lax.axis_index(ax)
+        stacked = jnp.stack([t._data for t in tensor_list]) if tensor_list \
+            else tensor._data
+        tensor._data = jax.lax.dynamic_index_in_dim(stacked, idx, keepdims=False)
+        return tensor
+    if tensor_list:
+        tensor._data = tensor_list[src]._data
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True,
+             use_calc_stream=True):
+    axes = _axes(group)
+    if _in_shard_map(axes):
+        ax = axes[0]
+        stacked = apply(lambda *xs: jnp.stack(xs, axis=0), *in_tensor_list)
+        out = apply(lambda s: jax.lax.all_to_all(s, ax, split_axis=0,
+                                                 concat_axis=0, tiled=False),
+                    stacked)
+        for i in range(len(in_tensor_list)):
+            out_tensor_list.append(out[i])
+        return out
+    out_tensor_list.extend(in_tensor_list)
+    return in_tensor_list
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    axes = _axes(group)
+    if _in_shard_map(axes):
+        ax = axes[0]
+        out = apply(lambda a: jax.lax.all_to_all(
+            a, ax, split_axis=0, concat_axis=0, tiled=True), in_tensor)
+        if out_tensor is not None:
+            out_tensor._data = out._data
+        return out
+    if out_tensor is not None:
+        out_tensor._data = in_tensor._data
+    return in_tensor
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=True):
+    # point-to-point maps to ppermute inside shard_map (see ops.pipeline);
+    # eager single-controller: no-op
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=True):
+    return tensor
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+def barrier(group=None):
+    # jax dispatch is ordered per device; block host on a tiny computation
+    jnp.zeros(()).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and hasattr(tensor._data, "block_until_ready"):
+        tensor._data.block_until_ready()
+
+
+def destroy_process_group(group=None):
+    global _initialized, _default_group
+    _initialized = False
+    _default_group = None
+
+
+def split(*args, **kwargs):
+    raise NotImplementedError(
+        "paddle.distributed.split: use fleet.meta_parallel Column/Row "
+        "parallel layers")
+
+
+# -- in-shard_map helpers used by ring attention / pipeline ---------------
+def ppermute(x, axis_name, perm):
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name):
+    return jax.lax.axis_index(axis_name)

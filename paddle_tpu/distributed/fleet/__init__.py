@@ -1,0 +1,88 @@
+"""Fleet — hybrid-parallel training API (reference:
+python/paddle/distributed/fleet/fleet.py)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from .base import DistributedStrategy, HybridCommunicateGroup
+from .train_step import CompiledTrainStep, make_train_step
+from . import meta_parallel  # noqa: F401
+
+_strategy: Optional[DistributedStrategy] = None
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    global _strategy, _hcg
+    _strategy = strategy or DistributedStrategy()
+    _hcg = HybridCommunicateGroup(_strategy)
+    from ..collective import init_parallel_env
+    init_parallel_env()
+    return _hcg
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    global _hcg
+    if _hcg is None:
+        init()
+    return _hcg
+
+
+def get_strategy() -> DistributedStrategy:
+    global _strategy
+    if _strategy is None:
+        init()
+    return _strategy
+
+
+def distributed_model(model):
+    """Annotate parameter shardings per the active strategy (the reference
+    wraps with DataParallel/TensorParallel/PipelineParallel engines; here
+    placement is declarative)."""
+    strategy = get_strategy()
+    stage = strategy.sharding_stage
+    from ..mesh import infer_param_pspec
+    for _, p in model.named_parameters():
+        p.pspec = infer_param_pspec(tuple(p._data.shape), p.pspec, stage)
+    return model
+
+
+class _FleetOptimizer:
+    """Wrapper returned by fleet.distributed_optimizer: same eager surface,
+    plus make_train_step for the compiled hybrid-parallel path."""
+
+    def __init__(self, optimizer, strategy):
+        self._inner = optimizer
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def make_train_step(self, model, loss_fn, **kw) -> CompiledTrainStep:
+        amp_level = "O1" if self._strategy.amp else kw.pop("amp_level", None)
+        return make_train_step(model, self._inner, loss_fn,
+                               strategy=self._strategy, amp_level=amp_level,
+                               **kw)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return _FleetOptimizer(optimizer, strategy or get_strategy())
+
+
+def worker_num():
+    from ..collective import get_world_size
+    return get_world_size()
+
+
+def worker_index():
+    from ..collective import get_rank
+    return get_rank()
+
+
+def is_first_worker():
+    return worker_index() == 0
+
+
+def barrier_worker():
+    from ..collective import barrier
+    barrier()

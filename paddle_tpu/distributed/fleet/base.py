@@ -1,0 +1,111 @@
+"""Fleet strategy & topology.
+
+Reference: python/paddle/distributed/fleet/base/distributed_strategy.py and
+base/topology.py. The reference builds per-dimension NCCL communicator
+groups; here the topology IS the mesh (distributed/mesh.py) and the
+"groups" are views over its named axes.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .. import mesh as mesh_mod
+from ..collective import Group
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.sharding = False
+        self.sharding_configs = {"sharding_stage": 1, "sharding_degree": 1,
+                                 "segment_broadcast_MB": 32}
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0, "use_pure_bf16": False}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.lamb = False
+        self.dgc = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.nccl_comm_num = 1
+
+    @property
+    def sharding_stage(self) -> int:
+        if not self.sharding and self.hybrid_configs.get("sharding_degree", 1) <= 1:
+            return 0
+        return int(self.sharding_configs.get("sharding_stage", 1))
+
+
+class HybridCommunicateGroup:
+    """Axis-name-backed stand-in for fleet's topology object."""
+
+    def __init__(self, strategy: DistributedStrategy,
+                 mesh: Optional[jax.sharding.Mesh] = None):
+        hc = strategy.hybrid_configs
+        self._dp = max(1, hc.get("dp_degree", 1))
+        self._mp = max(1, hc.get("mp_degree", 1))
+        self._pp = max(1, hc.get("pp_degree", 1))
+        self._sharding = max(1, hc.get("sharding_degree", 1))
+        self._sep = max(1, hc.get("sep_degree", 1))
+        if mesh is None:
+            mesh = mesh_mod.build_mesh(dp=self._dp, tp=self._mp, pp=self._pp,
+                                       sharding=self._sharding, sep=self._sep)
+        self.mesh = mesh
+        mesh_mod.set_mesh(mesh)
+
+    # degree accessors (reference names)
+    def get_data_parallel_world_size(self):
+        return self.mesh.shape["dp"]
+
+    def get_model_parallel_world_size(self):
+        return self.mesh.shape["tp"]
+
+    def get_pipe_parallel_world_size(self):
+        return self.mesh.shape["pp"]
+
+    def get_sharding_parallel_world_size(self):
+        return self.mesh.shape["sharding"]
+
+    def get_sep_parallel_world_size(self):
+        return self.mesh.shape["sep"]
+
+    # single-controller: rank views are degenerate (XLA owns placement)
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_model_parallel_group(self):
+        return Group(nranks=self.get_model_parallel_world_size(),
+                     axis_names=("tp",))
+
+    def get_data_parallel_group(self):
+        return Group(nranks=self.get_data_parallel_world_size(),
+                     axis_names=("dp",))
+
+    def get_sharding_parallel_group(self):
+        return Group(nranks=self.get_sharding_parallel_world_size(),
+                     axis_names=("sharding",))
+
+    def get_pipe_parallel_group(self):
+        return Group(nranks=self.get_pipe_parallel_world_size(),
+                     axis_names=("pp",))
+
+    def topology(self):
+        return self.mesh

@@ -1,0 +1,97 @@
+"""Pipeline layer descriptions.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py (LayerDesc / SharedLayerDesc / PipelineLayer). The reference
+materializes only the local stage's layers per rank and p2p-sends
+activations. Here PipelineLayer keeps the whole stack (single controller)
+and records the stage partition; the pipeline schedule itself is the
+shard_map program in paddle_tpu.ops.pipeline, used by the train-step
+builder when pp_degree > 1. Eagerly, forward just runs the stack.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional
+
+from ....nn.layer_base import Layer
+from ....nn.layer.container import LayerList
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight",
+                 *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers: List, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method="uniform",
+                 recompute_interval=0, recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or 1
+        self._shared = {}
+        built = []
+        for desc in layers:
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name in self._shared:
+                    layer = self._shared[desc.layer_name]
+                else:
+                    layer = desc.build_layer()
+                    self._shared[desc.layer_name] = layer
+                built.append((layer, desc.forward_func))
+            elif isinstance(desc, LayerDesc):
+                built.append((desc.build_layer(), None))
+            elif isinstance(desc, Layer):
+                built.append((desc, None))
+            elif callable(desc):
+                built.append((desc, "fn"))
+            else:
+                raise TypeError(f"bad pipeline item {desc!r}")
+        self.run_order = built
+        self.funcs = LayerList([l for l, tag in built if tag != "fn" and isinstance(l, Layer)])
+        # uniform stage segmentation (reference: segment by layer count)
+        n = len(built)
+        per = math.ceil(n / self._num_stages)
+        self._stage_bounds = [(i * per, min((i + 1) * per, n))
+                              for i in range(self._num_stages)]
+
+    def get_stage_of(self, idx: int) -> int:
+        for s, (lo, hi) in enumerate(self._stage_bounds):
+            if lo <= idx < hi:
+                return s
+        return self._num_stages - 1
+
+    def forward(self, x):
+        for layer, tag in self.run_order:
+            if tag == "fn":
+                x = layer(x)
+            elif tag is not None and callable(tag):
+                x = tag(layer, x)
+            else:
+                x = layer(x)
+        return x
+
+    def stage_forward(self, stage: int, x):
+        lo, hi = self._stage_bounds[stage]
+        for layer, tag in self.run_order[lo:hi]:
+            if tag == "fn":
+                x = layer(x)
+            elif tag is not None and callable(tag):
+                x = tag(layer, x)
+            else:
+                x = layer(x)
+        return x

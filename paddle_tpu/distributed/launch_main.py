@@ -1,0 +1,38 @@
+"""python -m paddle_tpu.distributed.launch — multi-host launcher.
+
+Reference: python/paddle/distributed/launch. On TPU pods each host runs the
+same script under the jax multi-controller runtime; this launcher just sets
+the env contract (PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID / PADDLE_MASTER)
+and execs the training script, matching how reference launch scripts are
+invoked so they keep working.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nnodes", type=int,
+                        default=int(os.environ.get("PADDLE_TRAINERS_NUM", 1)))
+    parser.add_argument("--node_rank", type=int,
+                        default=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
+    parser.add_argument("--master", default=os.environ.get("PADDLE_MASTER", ""))
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    os.environ["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
+    os.environ["PADDLE_TRAINER_ID"] = str(args.node_rank)
+    if args.master:
+        os.environ["PADDLE_MASTER"] = args.master
+    sys.argv = [args.script] + args.script_args
+    runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""DataParallel wrapper + env helpers (reference:
+python/paddle/distributed/parallel.py).
+
+Under the single-controller XLA model, DataParallel does not need grad
+hooks: a pjit step with batch sharded over "dp" psums grads automatically.
+This wrapper keeps the reference API for eager scripts and marks the model
+so hapi/fleet builders shard the batch.
+"""
+from __future__ import annotations
+
+from ..nn.layer_base import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+
+class ParallelEnv:
+    def __init__(self):
+        from .collective import get_rank, get_world_size
+        self.rank = get_rank()
+        self.world_size = get_world_size()
+        self.device_id = 0
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def nranks(self):
+        return self.world_size

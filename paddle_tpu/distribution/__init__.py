@@ -1,0 +1,178 @@
+"""Probability distributions. Reference: python/paddle/distribution/*."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.random_seed import next_key
+from ..tensor import Tensor, apply
+from ..tensor_ops._factory import raw
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def probs(self, value):
+        from ..tensor_ops.math import exp
+        return exp(self.log_prob(value))
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = loc if isinstance(loc, Tensor) else Tensor(jnp.asarray(float(loc)))
+        self.scale = scale if isinstance(scale, Tensor) else Tensor(jnp.asarray(float(scale)))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return apply(lambda s: s * s, self.scale)
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + tuple(jnp.broadcast_shapes(
+            raw(self.loc).shape, raw(self.scale).shape))
+        eps = jax.random.normal(next_key(), shp)
+        return Tensor(raw(self.loc) + raw(self.scale) * eps)
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        return apply(lambda v, m, s: -((v - m) ** 2) / (2 * s * s)
+                     - jnp.log(s) - 0.5 * math.log(2 * math.pi),
+                     value, self.loc, self.scale)
+
+    def entropy(self):
+        return apply(lambda s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                     self.scale)
+
+    def kl_divergence(self, other):
+        return apply(lambda m1, s1, m2, s2:
+                     jnp.log(s2 / s1) + (s1 ** 2 + (m1 - m2) ** 2) / (2 * s2 ** 2) - 0.5,
+                     self.loc, self.scale, other.loc, other.scale)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = low if isinstance(low, Tensor) else Tensor(jnp.asarray(float(low)))
+        self.high = high if isinstance(high, Tensor) else Tensor(jnp.asarray(float(high)))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + tuple(jnp.broadcast_shapes(
+            raw(self.low).shape, raw(self.high).shape))
+        u = jax.random.uniform(next_key(), shp)
+        return Tensor(raw(self.low) + (raw(self.high) - raw(self.low)) * u)
+
+    def log_prob(self, value):
+        return apply(lambda v, lo, hi: jnp.where(
+            (v >= lo) & (v < hi), -jnp.log(hi - lo), -jnp.inf),
+            value, self.low, self.high)
+
+    def entropy(self):
+        return apply(lambda lo, hi: jnp.log(hi - lo), self.low, self.high)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = logits if isinstance(logits, Tensor) else Tensor(jnp.asarray(logits))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.categorical(
+            next_key(), raw(self.logits), shape=tuple(shape) + raw(self.logits).shape[:-1] if shape else None))
+
+    def log_prob(self, value):
+        idx = raw(value).astype(jnp.int32)
+        return apply(lambda lg: jnp.take_along_axis(
+            jax.nn.log_softmax(lg, -1), idx[..., None], -1)[..., 0], self.logits)
+
+    def probs(self, value):
+        idx = raw(value).astype(jnp.int32)
+        return apply(lambda lg: jnp.take_along_axis(
+            jax.nn.softmax(lg, -1), idx[..., None], -1)[..., 0], self.logits)
+
+    def entropy(self):
+        def f(lg):
+            p = jax.nn.softmax(lg, -1)
+            return -jnp.sum(p * jax.nn.log_softmax(lg, -1), axis=-1)
+        return apply(f, self.logits)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = probs if isinstance(probs, Tensor) else Tensor(jnp.asarray(float(probs)))
+
+    def sample(self, shape=()):
+        p = raw(self.probs_)
+        return Tensor(jax.random.bernoulli(
+            next_key(), p, tuple(shape) + p.shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        return apply(lambda v, p: v * jnp.log(jnp.clip(p, 1e-12, None)) +
+                     (1 - v) * jnp.log(jnp.clip(1 - p, 1e-12, None)),
+                     value, self.probs_)
+
+    def entropy(self):
+        return apply(lambda p: -(p * jnp.log(jnp.clip(p, 1e-12, None)) +
+                                 (1 - p) * jnp.log(jnp.clip(1 - p, 1e-12, None))),
+                     self.probs_)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, concentration1=None, name=None, beta=None):
+        b = beta if beta is not None else concentration1
+        self.alpha = alpha if isinstance(alpha, Tensor) else Tensor(jnp.asarray(float(alpha)))
+        self.beta = b if isinstance(b, Tensor) else Tensor(jnp.asarray(float(b)))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.beta(next_key(), raw(self.alpha),
+                                      raw(self.beta), tuple(shape) or None))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        return apply(lambda v, a, b: (a - 1) * jnp.log(v) +
+                     (b - 1) * jnp.log1p(-v) - betaln(a, b),
+                     value, self.alpha, self.beta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = concentration if isinstance(concentration, Tensor) \
+            else Tensor(jnp.asarray(concentration))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.dirichlet(next_key(), raw(self.concentration),
+                                           tuple(shape) or ()))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = loc if isinstance(loc, Tensor) else Tensor(jnp.asarray(float(loc)))
+        self.scale = scale if isinstance(scale, Tensor) else Tensor(jnp.asarray(float(scale)))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + raw(self.loc).shape
+        return Tensor(raw(self.loc) + raw(self.scale) *
+                      jax.random.gumbel(next_key(), shp))
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        return p.kl_divergence(q)
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        def f(lp, lq):
+            pp = jax.nn.softmax(lp, -1)
+            return jnp.sum(pp * (jax.nn.log_softmax(lp, -1) -
+                                 jax.nn.log_softmax(lq, -1)), -1)
+        return apply(f, p.logits, q.logits)
+    raise NotImplementedError(f"kl({type(p).__name__}, {type(q).__name__})")

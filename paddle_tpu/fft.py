@@ -1,0 +1,64 @@
+"""FFT module (reference: python/paddle/fft.py) — delegates to jnp.fft."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor import apply
+
+
+def _fftfn(jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply(lambda a: jfn(a, n=n, axis=axis, norm=norm), x)
+    return op
+
+
+def _fftnfn(jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return apply(lambda a: jfn(a, s=s, axes=axes, norm=norm), x)
+    return op
+
+
+fft = _fftfn(jnp.fft.fft)
+ifft = _fftfn(jnp.fft.ifft)
+rfft = _fftfn(jnp.fft.rfft)
+irfft = _fftfn(jnp.fft.irfft)
+hfft = _fftfn(jnp.fft.hfft)
+ihfft = _fftfn(jnp.fft.ihfft)
+fftn = _fftnfn(jnp.fft.fftn)
+ifftn = _fftnfn(jnp.fft.ifftn)
+rfftn = _fftnfn(jnp.fft.rfftn)
+irfftn = _fftnfn(jnp.fft.irfftn)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.fft2(a, s=s, axes=axes, norm=norm), x)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.ifft2(a, s=s, axes=axes, norm=norm), x)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.rfft2(a, s=s, axes=axes, norm=norm), x)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.irfft2(a, s=s, axes=axes, norm=norm), x)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), x)

@@ -1,0 +1,10 @@
+from . import dtype, device, random_seed  # noqa: F401
+from .dtype import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, convert_dtype, float16, float32,
+    float64, get_default_dtype, int8, int16, int32, int64, set_default_dtype,
+    uint8,
+)
+from .device import (  # noqa: F401
+    CPUPlace, CUDAPlace, TPUPlace, device_count, get_device, set_device,
+)
+from .random_seed import seed  # noqa: F401

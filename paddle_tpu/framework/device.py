@@ -1,0 +1,90 @@
+"""Device management.
+
+Reference: python/paddle/device/__init__.py (set_device / get_device /
+is_compiled_with_*). On TPU the device story is simpler: jax owns placement
+and we only track the preferred platform. ``set_device`` accepts paddle-style
+strings ("tpu", "tpu:0", "cpu", "gpu:0") and maps them onto jax devices.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_current_device: str = "tpu"
+
+
+def _platform_of(device: str) -> str:
+    return device.split(":")[0]
+
+
+def set_device(device: str) -> str:
+    """Select the default device. Accepts "cpu", "tpu", "tpu:<n>", "gpu:<n>".
+
+    "gpu" is accepted for script compatibility and mapped to the best
+    available accelerator (tpu if present).
+    """
+    global _current_device
+    plat = _platform_of(device)
+    if plat == "gpu":  # compat: run unmodified cuda scripts on tpu
+        device = device.replace("gpu", "tpu")
+        plat = "tpu"
+    if plat not in ("cpu", "tpu"):
+        raise ValueError(f"Unsupported device {device!r}; expected cpu/tpu")
+    _current_device = device
+    return _current_device
+
+
+def get_device() -> str:
+    return _current_device
+
+
+def get_jax_device(device: str | None = None):
+    """Resolve a paddle-style device string to a concrete jax.Device."""
+    device = device or _current_device
+    plat = _platform_of(device)
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    try:
+        devs = jax.devices(plat if plat != "tpu" else None)
+    except RuntimeError:
+        devs = jax.devices()
+    # jax.devices(None) returns the default backend; filter politely.
+    matching = [d for d in devs if plat == "cpu" and d.platform == "cpu"] or devs
+    return matching[min(idx, len(matching) - 1)]
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+class CPUPlace:
+    def __repr__(self):
+        return "Place(cpu)"
+
+
+class TPUPlace:
+    def __init__(self, idx: int = 0):
+        self.idx = idx
+
+    def __repr__(self):
+        return f"Place(tpu:{self.idx})"
+
+
+# Alias so scripts doing paddle.CUDAPlace(0) keep working.
+CUDAPlace = TPUPlace

@@ -1,0 +1,86 @@
+"""Dtype registry for paddle_tpu.
+
+Mirrors the dtype surface of the reference (python/paddle/framework/dtype.py)
+but is backed directly by numpy/jax dtypes, with bfloat16 first-class since it
+is the native TPU matmul dtype.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects. These are jnp dtype aliases so they interop with
+# every jax/numpy API with zero conversion.
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "float": float32,
+    "double": float64,
+    "half": float16,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "int": int32,
+    "uint8": uint8,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize a user-provided dtype (str | np | jnp dtype) to a np.dtype.
+
+    Returns None when ``dtype`` is None so callers can mean "keep as is".
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return np.dtype(_STR2DTYPE[dtype])
+        except KeyError:
+            raise ValueError(f"Unknown dtype: {dtype!r}")
+    return np.dtype(dtype)
+
+
+def is_floating_point_dtype(dtype) -> bool:
+    d = np.dtype(dtype)
+    return d.kind == "f" or d == np.dtype(jnp.bfloat16)
+
+
+def is_integer_dtype(dtype) -> bool:
+    return np.dtype(dtype).kind in ("i", "u")
+
+
+# Paddle's default dtype is float32 and can be flipped (used by layers when
+# creating parameters).
+_default_dtype = np.dtype(np.float32)
+
+
+def set_default_dtype(dtype):
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if not is_floating_point_dtype(d):
+        raise TypeError(f"default dtype must be floating point, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype

@@ -1,0 +1,68 @@
+"""Global RNG management.
+
+Reference: python/paddle/framework/random.py (paddle.seed, get/set cuda rng
+state). JAX randomness is explicit-key; to present paddle's implicit-RNG API
+we keep a process-global key that is split on every draw. The functional/jit
+path never touches this: layers and dropout accept explicit keys there
+(threaded by the train-step builder), so compiled programs stay pure.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+_key = jax.random.PRNGKey(0)
+_seed_value = 0
+_tls = threading.local()
+
+
+def seed(value: int):
+    """Seed the global generator (paddle.seed)."""
+    global _key, _seed_value
+    _seed_value = int(value)
+    _key = jax.random.PRNGKey(_seed_value)
+    return _key
+
+
+def get_seed() -> int:
+    return _seed_value
+
+
+def next_key():
+    """Return a fresh subkey.
+
+    Inside a ``functional_key`` scope (traced train steps), subkeys are split
+    from the explicit key threaded into the compiled program — keeping it
+    pure. Otherwise the process-global eager key is split.
+    """
+    stack = getattr(_tls, "fkeys", None)
+    if stack:
+        stack[-1], sub = jax.random.split(stack[-1])
+        return sub
+    global _key
+    _key, sub = jax.random.split(_key)
+    return sub
+
+
+@contextlib.contextmanager
+def functional_key(key):
+    """Route next_key() draws to splits of ``key`` (used under jit tracing)."""
+    stack = getattr(_tls, "fkeys", None)
+    if stack is None:
+        stack = _tls.fkeys = []
+    stack.append(key)
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def get_rng_state():
+    return _key
+
+
+def set_rng_state(state):
+    global _key
+    _key = state

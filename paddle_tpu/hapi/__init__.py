@@ -1,0 +1,6 @@
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    VisualDL,
+)
+from .model import Model  # noqa: F401
+from .model_summary import summary  # noqa: F401

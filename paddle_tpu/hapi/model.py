@@ -1,0 +1,224 @@
+"""High-level Model API. Reference: python/paddle/hapi/model.py.
+
+``Model.prepare/fit/evaluate/predict/save/load`` with the same surface; the
+training loop compiles one fused XLA train step via
+fleet.train_step.make_train_step (the reference's prepare() chooses between
+dygraph/static executors — here the compiled path IS the default).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..metric import Metric
+from ..tensor import Tensor
+from .callbacks import CallbackList, ProgBarLogger
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+        self._amp_level = None
+        if amp_configs:
+            if isinstance(amp_configs, str):
+                self._amp_level = amp_configs
+            elif isinstance(amp_configs, dict):
+                self._amp_level = amp_configs.get("level", "O1")
+        return self
+
+    def _build_train_step(self):
+        if self._train_step is not None:
+            return self._train_step
+        from ..distributed.fleet.train_step import make_train_step
+
+        loss_layer = self._loss
+
+        def loss_fn(network, *batch):
+            *xs, y = batch
+            out = network(*xs)
+            return loss_layer(out, y)
+
+        self._train_step = make_train_step(
+            self.network, self._optimizer, loss_fn,
+            amp_level=getattr(self, "_amp_level", None))
+        return self._train_step
+
+    # -- training ------------------------------------------------------------
+    def train_batch(self, inputs, labels=None):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else (
+            [labels] if labels is not None else [])
+        step = self._build_train_step()
+        loss = step(*inputs, *labels)
+        return [float(np.asarray(loss._data))]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else (
+            [labels] if labels is not None else [])
+        out = self.network(*inputs)
+        res = []
+        if self._loss is not None and labels:
+            loss = self._loss(out, labels[0])
+            res.append(float(np.asarray(loss._data)))
+        metric_out = []
+        for m in self._metrics:
+            c = m.compute(out, *labels)
+            metric_out.append(m.update(c))
+        self.network.train()
+        return res, metric_out
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        out = self.network(*inputs)
+        self.network.train()
+        return out
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        cbks = CallbackList(callbacks, model=self, verbose=verbose,
+                            metrics=["loss"] + sum(
+                                [m.name() if isinstance(m.name(), list)
+                                 else [m.name()] for m in self._metrics], []),
+                            log_freq=log_freq)
+        cbks.on_begin("train")
+        steps = None
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            pass
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch, steps)
+            for m in self._metrics:
+                m.reset()
+            it = 0
+            for batch in train_loader:
+                cbks.on_batch_begin("train", it, None)
+                xs, ys = self._split_batch(batch)
+                losses = self.train_batch(xs, ys)
+                logs = {"loss": losses[0], "step": it}
+                cbks.on_batch_end("train", it, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training:
+                break
+        cbks.on_end("train")
+        if save_dir:
+            self.save(os.path.join(save_dir, "final"))
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        from ..io import DataLoader, Dataset
+        loader = DataLoader(eval_data, batch_size=batch_size,
+                            num_workers=num_workers) \
+            if isinstance(eval_data, Dataset) else eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        it = 0
+        for batch in loader:
+            xs, ys = self._split_batch(batch)
+            res, _ = self.eval_batch(xs, ys)
+            if res:
+                losses.append(res[0])
+            it += 1
+            if num_iters is not None and it >= num_iters:
+                break
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            for n, v in zip(names, vals):
+                logs[n] = v
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        from ..io import DataLoader, Dataset
+        loader = DataLoader(test_data, batch_size=batch_size,
+                            num_workers=num_workers) \
+            if isinstance(test_data, Dataset) else test_data
+        outputs = []
+        for batch in loader:
+            xs, _ = self._split_batch(batch, has_labels=False)
+            outputs.append(self.predict_batch(xs))
+        if stack_outputs and outputs:
+            from ..tensor_ops.manipulation import concat
+            return [concat(outputs, axis=0)]
+        return outputs
+
+    def _split_batch(self, batch, has_labels=True):
+        if isinstance(batch, (list, tuple)):
+            if has_labels and len(batch) >= 2:
+                return list(batch[:-1]), [batch[-1]]
+            return list(batch), []
+        return [batch], []
+
+    # -- io -----------------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save as psave
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        psave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            psave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as pload
+        self.network.set_state_dict(pload(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(pload(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+        return summary(self.network, input_size, dtype)

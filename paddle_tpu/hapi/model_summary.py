@@ -1,0 +1,37 @@
+"""paddle.summary. Reference: python/paddle/hapi/model_summary.py."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn.layer_base import Layer
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    rows = []
+    total_params = 0
+    trainable_params = 0
+    for name, layer in net.named_sublayers():
+        n_params = sum(int(np.prod(p._data.shape))
+                       for p in layer._parameters.values() if p is not None)
+        if not layer._sub_layers:  # leaf
+            rows.append((name or type(layer).__name__,
+                         type(layer).__name__, n_params))
+    for p in net.parameters():
+        n = int(np.prod(p._data.shape))
+        total_params += n
+        if p.trainable:
+            trainable_params += n
+
+    width = max([len(r[0]) for r in rows] + [20]) + 2
+    lines = ["-" * (width + 30),
+             f"{'Layer (type)':<{width}}{'Params':>12}",
+             "=" * (width + 30)]
+    for name, tname, n in rows:
+        lines.append(f"{name + ' (' + tname + ')':<{width}}{n:>12,}")
+    lines.append("=" * (width + 30))
+    lines.append(f"Total params: {total_params:,}")
+    lines.append(f"Trainable params: {trainable_params:,}")
+    lines.append(f"Non-trainable params: {total_params - trainable_params:,}")
+    lines.append("-" * (width + 30))
+    print("\n".join(lines))
+    return {"total_params": total_params, "trainable_params": trainable_params}

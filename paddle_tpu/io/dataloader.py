@@ -1,0 +1,126 @@
+"""DataLoader. Reference: python/paddle/io/dataloader/dataloader_iter.py +
+the C++ reader ops (paddle/fluid/operators/reader).
+
+The hot path on TPU is keeping the XLA queue fed: batches are collated to
+numpy on worker threads and prefetched ahead of consumption. When the native
+C++ prefetch runtime is built (paddle_tpu/runtime/cpp), its lock-free ring
+buffer replaces the python queue; otherwise a thread pool is used.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched numpy arrays (converted lazily to device)."""
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return tuple(default_collate_fn([b[i] for b in batch])
+                     for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(b._data) for b in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    return batch
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=False, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(2, prefetch_factor)
+        self.return_list = return_list
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def _make_batches(self):
+        if self._iterable_mode:
+            batch = []
+            for item in self.dataset:
+                batch.append(item)
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+        else:
+            for idxs in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in idxs])
+
+    def __iter__(self):
+        def to_tensors(b):
+            if isinstance(b, tuple):
+                return tuple(to_tensors(x) for x in b)
+            if isinstance(b, list):
+                return [to_tensors(x) for x in b]
+            if isinstance(b, dict):
+                return {k: to_tensors(v) for k, v in b.items()}
+            if isinstance(b, np.ndarray):
+                return Tensor(b)
+            return b
+
+        if self.num_workers == 0:
+            for b in self._make_batches():
+                yield to_tensors(b)
+            return
+
+        # native C++ ring-buffer prefetcher if available, else thread pool
+        try:
+            from ..runtime.prefetcher import NativePrefetcher
+            src = NativePrefetcher(self._make_batches(),
+                                   depth=self.num_workers * self.prefetch_factor)
+            for b in src:
+                yield to_tensors(b)
+            return
+        except Exception:
+            pass
+
+        q: queue.Queue = queue.Queue(self.num_workers * self.prefetch_factor)
+        sentinel = object()
+
+        def producer():
+            try:
+                for b in self._make_batches():
+                    q.put(b)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            b = q.get()
+            if b is sentinel:
+                break
+            yield to_tensors(b)

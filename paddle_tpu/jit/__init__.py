@@ -1,0 +1,2 @@
+from .api import StaticFunction, in_to_static, not_to_static, to_static  # noqa: F401
+from .serialization import load, save  # noqa: F401

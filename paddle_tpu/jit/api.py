@@ -1,0 +1,111 @@
+"""jit.to_static — the Dy2Static analog (reference: python/paddle/jit/api.py,
+dy2static/program_translator.py).
+
+The reference traces python into a static Program executed by the fluid
+executor (optionally CINN-compiled). Here the whole step is compiled by XLA:
+``to_static(fn)`` returns a StaticFunction that runs ``fn`` under
+``jax.jit``. Tensors pass through as pytree leaves; Layer parameters are
+hoisted into jit arguments (NOT baked as constants) so weight updates never
+trigger recompiles and XLA can donate/alias buffers.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+
+from ..autograd.tape import functional_mode
+from ..tensor import Parameter, Tensor
+
+_tls = threading.local()
+
+
+def in_to_static() -> bool:
+    return getattr(_tls, "depth", 0) > 0
+
+
+@contextlib.contextmanager
+def _static_ctx():
+    _tls.depth = getattr(_tls, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _tls.depth -= 1
+
+
+def _collect_params(obj) -> dict:
+    """name → Parameter for a Layer (or empty for plain functions)."""
+    from ..nn.layer_base import Layer
+    if isinstance(obj, Layer):
+        return dict(obj.named_parameters())
+    return {}
+
+
+@contextlib.contextmanager
+def _swap_params(params: dict, raw_tree: dict):
+    olds = {}
+    try:
+        for name, p in params.items():
+            olds[name] = p._data
+            p._data = raw_tree[name]
+        yield
+    finally:
+        for name, p in params.items():
+            p._data = olds[name]
+
+
+class StaticFunction:
+    def __init__(self, fn: Callable, input_spec=None, jit_kwargs=None):
+        self._fn = fn
+        self._layer = getattr(fn, "__self__", None)
+        self._input_spec = input_spec
+        self._jit = jax.jit(self._traced, **(jit_kwargs or {}))
+        functools.update_wrapper(self, fn, updated=())
+
+    def _traced(self, raw_params, args, kwargs):
+        params = _collect_params(self._layer) if self._layer is not None else {}
+        with _static_ctx(), functional_mode(), _swap_params(params, raw_params):
+            return self._fn(*args, **kwargs)
+
+    def __call__(self, *args, **kwargs):
+        params = _collect_params(self._layer) if self._layer is not None else {}
+        raw_params = {k: p._data for k, p in params.items()}
+        return self._jit(raw_params, args, kwargs)
+
+    @property
+    def concrete_program(self):
+        return self._jit
+
+    def lower(self, *args, **kwargs):
+        params = _collect_params(self._layer) if self._layer is not None else {}
+        raw_params = {k: p._data for k, p in params.items()}
+        return self._jit.lower(raw_params, args, kwargs)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator/wrapper converting a dygraph function or Layer to compiled.
+
+    On a Layer instance, returns the layer with its ``forward`` replaced by a
+    StaticFunction (paddle semantics).
+    """
+    from ..nn.layer_base import Layer
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            obj.forward = StaticFunction(obj.forward, input_spec)
+            return obj
+        return StaticFunction(obj, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn=None):
+    if fn is None:
+        return lambda f: f
+    return fn

@@ -1,0 +1,78 @@
+"""jit.save / jit.load (reference: python/paddle/jit/api.py save/load).
+
+The reference serializes a static Program + params. Our compiled artifact is
+an XLA computation: we save (a) the layer state_dict and (b) when
+jax.export is available, the StableHLO of the traced forward, giving an
+inference artifact loadable without the original python class.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+
+
+def save(layer, path, input_spec=None, **configs):
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    payload = {"format": "paddle_tpu.jit", "version": 1}
+    from ..nn.layer_base import Layer
+
+    if isinstance(layer, Layer):
+        payload["state_dict"] = {
+            k: np.asarray(v._data) for k, v in layer.state_dict().items()
+        }
+        payload["class"] = type(layer).__module__ + "." + type(layer).__qualname__
+    hlo = None
+    if input_spec is not None:
+        try:
+            from jax import export as jax_export
+            shapes = [jax.ShapeDtypeStruct(tuple(s.shape), s.dtype)
+                      for s in input_spec]
+            def fwd(*xs):
+                out = layer(*[Tensor(x) for x in xs])
+                return out._data if isinstance(out, Tensor) else out
+            exported = jax_export.export(jax.jit(fwd))(*shapes)
+            hlo = exported.serialize()
+        except Exception:
+            hlo = None
+    payload["stablehlo"] = hlo
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f)
+    return path + ".pdmodel"
+
+
+class TranslatedLayer:
+    """Inference-only callable rebuilt from a serialized artifact."""
+
+    def __init__(self, payload):
+        self._payload = payload
+        self._callable = None
+        if payload.get("stablehlo"):
+            from jax import export as jax_export
+            exported = jax_export.deserialize(payload["stablehlo"])
+            self._callable = exported.call
+
+    def state_dict(self):
+        return {k: Tensor(jnp.asarray(v))
+                for k, v in self._payload.get("state_dict", {}).items()}
+
+    def __call__(self, *args):
+        if self._callable is None:
+            raise RuntimeError(
+                "artifact has no compiled graph; re-save with input_spec or "
+                "rebuild the Layer class and use set_state_dict")
+        raw = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        out = self._callable(*raw)
+        return jax.tree_util.tree_map(Tensor, out)
+
+
+def load(path, **configs):
+    p = path if path.endswith(".pdmodel") else path + ".pdmodel"
+    with open(p, "rb") as f:
+        payload = pickle.load(f)
+    return TranslatedLayer(payload)

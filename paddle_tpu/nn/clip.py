@@ -1,0 +1,89 @@
+"""Gradient clipping. Reference: python/paddle/nn/clip.py (fluid/clip.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        """params_grads: list[(param, grad_raw)] → same with clipped grads."""
+        raise NotImplementedError
+
+    # functional form used by compiled train steps: grads is a pytree of raw
+    # arrays; returns clipped pytree
+    def apply_functional(self, grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        return [(p, jnp.clip(g, self.min, self.max)) for p, g in params_grads]
+
+    def apply_functional(self, grads):
+        import jax
+        return jax.tree_util.tree_map(lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_one(self, g):
+        norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return (g * scale).astype(g.dtype)
+
+    def __call__(self, params_grads):
+        return [(p, self._clip_one(g)) for p, g in params_grads]
+
+    def apply_functional(self, grads):
+        import jax
+        return jax.tree_util.tree_map(self._clip_one, grads)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        sq = sum(jnp.sum(g.astype(jnp.float32) ** 2) for _, g in params_grads)
+        gnorm = jnp.sqrt(sq)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+        return [(p, (g * scale).astype(g.dtype)) for p, g in params_grads]
+
+    def apply_functional(self, grads):
+        import jax
+        leaves = jax.tree_util.tree_leaves(grads)
+        sq = sum(jnp.sum(l.astype(jnp.float32) ** 2) for l in leaves)
+        gnorm = jnp.sqrt(sq)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+        return jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype), grads)
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p.grad._data)) for p in params]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(p.grad._data.astype(jnp.float32)) ** norm_type)
+             for p in params])) ** (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-12), 1.0)
+    for p in params:
+        p.grad = Tensor((p.grad._data * scale).astype(p.grad._data.dtype))
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    for p in parameters:
+        if p.grad is not None:
+            p.grad = Tensor(jnp.clip(p.grad._data, -clip_value, clip_value))

@@ -1,0 +1,135 @@
+"""Activation functionals. Reference: python/paddle/nn/functional/activation.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor import apply
+from ...tensor_ops._factory import unary
+
+relu = unary(jax.nn.relu)
+relu6 = unary(lambda x: jnp.clip(x, 0.0, 6.0))
+sigmoid = unary(jax.nn.sigmoid)
+tanh = unary(jnp.tanh)
+silu = unary(jax.nn.silu)
+swish = silu
+mish = unary(lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+hardswish = unary(lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0)
+hardsigmoid = unary(lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+tanhshrink = unary(lambda x: x - jnp.tanh(x))
+softsign = unary(jax.nn.soft_sign)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.elu(a, alpha=alpha), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.celu(a, alpha=alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope=negative_slope), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            ww = w.reshape(())
+        else:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+            shape[ch_axis] = w.size
+            ww = w.reshape(shape)
+        return jnp.where(a > 0, a, ww * a)
+    return apply(f, x, weight)
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=True, name=None):
+    mid = (lower + upper) / 2.0
+    return apply(lambda a: jnp.where(a >= 0, a, mid * a), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda a: jnp.clip(a, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.sign(a) * jnp.maximum(jnp.abs(a) - threshold, 0.0), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(lambda a: jnp.where(beta * a > threshold, a,
+                                     jnp.log1p(jnp.exp(beta * a)) / beta), x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            from ...framework.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return apply(f, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            from ...framework.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply(f, x)
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, x)
+
+
+def logsigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        c = a.shape[axis]
+        new_shape = list(a.shape)
+        new_shape[axis:axis + 1] = [c // groups, groups]
+        return jnp.max(a.reshape(new_shape), axis=axis + 1)
+    return apply(f, x)
+
+
+def glu(x, axis=-1, name=None):
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return apply(f, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random_seed import next_key
+    key = next_key()
+    def f(a):
+        g = jax.random.gumbel(key, a.shape, dtype=a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            onehot = jax.nn.one_hot(jnp.argmax(y, axis=axis), y.shape[axis],
+                                    axis=axis, dtype=y.dtype)
+            y = onehot + y - jax.lax.stop_gradient(y)  # straight-through
+        return y
+    return apply(f, x)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply(lambda a: jnp.where(a > threshold, a, 0.0), x)

@@ -1,0 +1,108 @@
+"""Attention functionals.
+
+Reference: python/paddle/nn/functional/transformer.py + incubate flash
+attention. ``scaled_dot_product_attention`` routes to the pallas flash
+kernel on TPU (paddle_tpu/ops/pallas/flash_attention.py) and falls back to
+the XLA composite elsewhere.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor import Tensor, apply
+from ...tensor_ops._factory import raw
+
+
+def _xla_sdpa(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None,
+              dropout_key=None):
+    """Reference attention in pure XLA. q/k/v: [B, L, H, D] (paddle layout)."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    qh = jnp.swapaxes(q, 1, 2)  # [B, H, L, D]
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    # GQA: broadcast kv heads
+    if kh.shape[1] != qh.shape[1]:
+        rep = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh * s, kh,
+                        preferred_element_type=jnp.float32)
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((ql, kl), dtype=bool), k=kl - ql)
+        logits = jnp.where(cm, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(logits.dtype)
+    w = jax.nn.softmax(logits, axis=-1).astype(qh.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, w.shape)
+        w = jnp.where(keep, w / (1.0 - dropout_p), 0.0).astype(w.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", w, vh)
+    return jnp.swapaxes(out, 1, 2)  # [B, L, H, D]
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None, name=None):
+    """q/k/v: [batch, seq, heads, head_dim] (paddle flash-attn layout)."""
+    mask = raw(attn_mask) if attn_mask is not None else None
+    use_dropout = dropout_p > 0.0 and training
+    dkey = None
+    if use_dropout:
+        from ...framework.random_seed import next_key
+        dkey = next_key()
+
+    def f(q, k, v):
+        use_flash = (
+            mask is None and not use_dropout
+            and q.dtype in (jnp.bfloat16, jnp.float32)
+            and q.shape[1] >= 128 and q.shape[-1] <= 256
+            and jax.default_backend() == "tpu"
+        )
+        if use_flash:
+            try:
+                from ...ops.pallas.flash_attention import flash_attention
+                return flash_attention(q, k, v, causal=is_causal, scale=scale)
+            except Exception:
+                pass
+        return _xla_sdpa(q, k, v, mask=mask, causal=is_causal, scale=scale,
+                         dropout_p=dropout_p if use_dropout else 0.0,
+                         dropout_key=dkey)
+
+    return apply(f, query, key, value)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention fallback: dense attention with a mask built
+    from the CSR pattern (reference: nn/functional/sparse_attention.py)."""
+    offs = raw(sparse_csr_offset)
+    cols = raw(sparse_csr_columns)
+
+    def f(q, k, v):
+        B, H, L, D = q.shape
+        mask = jnp.zeros((B, H, L, L), dtype=bool)
+        # CSR rows → allowed columns (host loop ok: structure is static)
+        import numpy as np
+        offs_np = np.asarray(offs)
+        cols_np = np.asarray(cols)
+        m = np.zeros((B, H, L, L), dtype=bool)
+        for b in range(B):
+            for h in range(H):
+                for r in range(L):
+                    s, e = offs_np[b, h, r], offs_np[b, h, r + 1]
+                    m[b, h, r, cols_np[b, h, s:e]] = True
+        mask = jnp.asarray(m)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(D)
+        logits = jnp.where(mask, logits, -1e30)
+        w = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+    return apply(f, query, key, value)

@@ -1,0 +1,225 @@
+"""Common functionals: linear, dropout, pad, interpolate, embedding, one_hot.
+
+Reference: python/paddle/nn/functional/common.py, input.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...amp.auto_cast import maybe_cast_compute
+from ...framework.random_seed import next_key
+from ...tensor import Tensor, apply
+from ...tensor_ops._factory import raw
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b, weight shape [in, out] (paddle layout)."""
+    if bias is None:
+        return apply(lambda a, w: jnp.matmul(*maybe_cast_compute(a, w)), x, weight)
+    def f(a, w, b):
+        a, w = maybe_cast_compute(a, w)
+        out = jnp.matmul(a, w)
+        return out + b.astype(out.dtype)
+    return apply(f, x, weight, bias)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return apply(lambda a: a, x) if isinstance(x, Tensor) else x
+    key = next_key()
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(a.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return apply(f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        coef_a = (q + alpha_p ** 2 * q * p) ** -0.5
+        coef_b = -coef_a * alpha_p * p
+        return (coef_a * jnp.where(keep, a, alpha_p) + coef_b).astype(a.dtype)
+    return apply(f, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pd = [int(raw(v)) if isinstance(v, Tensor) else int(v) for v in raw(pad)] \
+        if isinstance(pad, Tensor) else [int(v) for v in pad]
+    def f(a):
+        nd = a.ndim
+        if len(pd) == 2 * nd:
+            # full-form (pairs per dim, paddle order = per-dim low/high)
+            widths = [(pd[2 * i], pd[2 * i + 1]) for i in range(nd)]
+        else:
+            # partial form: pads the spatial dims per data_format, pd is
+            # [left,right,(top,bottom,(front,back))] innermost-last order
+            widths = [(0, 0)] * nd
+            spatial = list(range(2, nd)) if data_format.startswith("NC") else list(range(1, nd - 1))
+            k = len(pd) // 2
+            for j in range(k):
+                dim = spatial[-(j + 1)] if data_format.startswith("NC") else spatial[-(j + 1)]
+                widths[dim] = (pd[2 * j], pd[2 * j + 1])
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode="constant", constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+    return apply(f, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    idx = raw(x)
+    def f(w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply(f, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    idx = raw(x)
+    return Tensor(jax.nn.one_hot(idx, num_classes, dtype=jnp.float32))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            return (1 - epsilon) * l + epsilon * raw(prior_dist)
+        return (1 - epsilon) * l + epsilon / k
+    return apply(f, label)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    mode = mode.lower()
+    def f(a):
+        nchw = data_format.startswith("NC")
+        spatial = a.shape[2:] if nchw else a.shape[1:-1]
+        if size is not None:
+            out_size = tuple(int(raw(s)) if isinstance(s, Tensor) else int(s)
+                             for s in (size if isinstance(size, (list, tuple)) else [size]))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+            out_size = tuple(int(d * s) for d, s in zip(spatial, sf))
+        if nchw:
+            tgt_shape = a.shape[:2] + out_size
+        else:
+            tgt_shape = (a.shape[0],) + out_size + (a.shape[-1],)
+        method = {"nearest": "nearest", "bilinear": "bilinear",
+                  "trilinear": "trilinear", "bicubic": "bicubic",
+                  "linear": "linear", "area": "linear"}[mode]
+        return jax.image.resize(a, tgt_shape, method=method)
+    return apply(f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *bs):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bs:
+            out = out + bs[0]
+        return out
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return apply(f, *args)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+    return apply(f, x1, x2)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+    return apply(f, x, y)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    def f(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])))
+        oh = (a.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (a.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                patches.append(a[:, :, di:di + oh * st[0]:st[0],
+                               dj:dj + ow * st[1]:st[1]])
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+    return apply(f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    def f(a):
+        n, ckk, l = a.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = os_[0] + pd[0] + pd[2], os_[1] + pd[1] + pd[3]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        a = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), dtype=a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                out = out.at[:, :, di:di + oh * st[0]:st[0],
+                             dj:dj + ow * st[1]:st[1]].add(a[:, :, i, j])
+        return out[:, :, pd[0]:ph - pd[2], pd[1]:pw - pd[3]]
+    return apply(f, x)

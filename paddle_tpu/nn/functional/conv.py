@@ -1,0 +1,167 @@
+"""Convolutions. Reference: python/paddle/nn/functional/conv.py.
+
+All convs lower to jax.lax.conv_general_dilated (one XLA HLO), which the TPU
+compiler maps straight onto the MXU. Weight layout matches paddle:
+[out_c, in_c/groups, *kernel]; default data_format NCHW.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...amp.auto_cast import maybe_cast_compute
+from ...tensor import apply
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 2 * n:  # paddle allows per-side pairs flattened
+            return tuple(v)
+        return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding, n, stride, dilation, kernel):
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p == "SAME":
+            return "SAME"
+        if p == "VALID":
+            return "VALID"
+        raise ValueError(padding)
+    if isinstance(padding, (list, tuple)) and len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    if isinstance(padding, (list, tuple)) and padding and isinstance(padding[0], (list, tuple)):
+        # NCHW-style full-form [[0,0],[0,0],[ph,ph],[pw,pw]]
+        return [tuple(p) for p in padding[2:]]
+    pads = _norm_tuple(padding, n)
+    return [(p, p) for p in pads]
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    kernel = None
+    pad = _padding(padding, n, stride, dilation, kernel)
+    dn_in, dn_w, dn_out = _dim_numbers(n, channel_last)
+
+    def f(a, w, *bs):
+        a, w = maybe_cast_compute(a, w)
+        # paddle weight is always OI*; transpose for channel-last spec
+        if channel_last:
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            w = jnp.transpose(w, perm)
+        dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, (dn_in, dn_w, dn_out))
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None)
+        if a.dtype == jnp.bfloat16 and out.dtype == jnp.float32:
+            out = out.astype(jnp.bfloat16)
+        if bs:
+            b = bs[0].astype(out.dtype)
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = (x, weight) + (() if bias is None else (bias,))
+    return apply(f, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, df)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, groups,
+                    dilation, n, data_format, output_size=None):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pads = _padding(padding, n, stride, dilation, None)
+    opad = _norm_tuple(output_padding, n)
+
+    def f(a, w, *bs):
+        a, w = maybe_cast_compute(a, w)
+        if channel_last:  # normalize to NC* and delegate
+            a = jnp.moveaxis(a, -1, 1)
+        # transposed conv == conv with lhs_dilation=stride on a spatially
+        # flipped, in/out-swapped kernel. paddle weight: [in_c, out_c/g, *k]
+        kshape = w.shape[2:]
+        pad_cfg = []
+        for i in range(n):
+            eff_k = dilation[i] * (kshape[i] - 1) + 1
+            lo = eff_k - 1 - pads[i][0]
+            hi = eff_k - 1 - pads[i][1] + opad[i]
+            pad_cfg.append((lo, hi))
+        kern = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups == 1:
+            kern = jnp.swapaxes(kern, 0, 1)  # -> [out, in, *k]
+        else:
+            ic, ocg = w.shape[0], w.shape[1]
+            kern = kern.reshape((groups, ic // groups, ocg) + kshape)
+            kern = jnp.swapaxes(kern, 1, 2)
+            kern = kern.reshape((ocg * groups, ic // groups) + kshape)
+        dn_str = _dim_numbers(n, False)
+        dn = jax.lax.conv_dimension_numbers(a.shape, kern.shape, dn_str)
+        out = jax.lax.conv_general_dilated(
+            a, kern, window_strides=(1,) * n, padding=pad_cfg,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups)
+        if bs:
+            b = bs[0].astype(out.dtype)
+            shape = [1] * out.ndim
+            shape[1] = b.shape[0]
+            out = out + b.reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = (x, weight) + (() if bias is None else (bias,))
+    return apply(f, *args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 1, df, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 3, data_format, output_size)

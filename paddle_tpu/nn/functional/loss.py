@@ -1,0 +1,243 @@
+"""Loss functionals. Reference: python/paddle/nn/functional/loss.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor import Tensor, apply
+from ...tensor_ops._factory import raw
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    lbl = raw(label)
+    w = raw(weight) if weight is not None else None
+
+    def f(logits):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+            jnp.clip(logits, 1e-30, None))
+        if soft_label:
+            tgt = lbl.astype(logp.dtype)
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / k
+            loss = -jnp.sum(tgt * logp, axis=axis)
+        else:
+            li = lbl
+            if li.ndim == logp.ndim:  # [..., 1] int labels
+                li = jnp.squeeze(li, axis=axis)
+            li = li.astype(jnp.int32)
+            valid = li != ignore_index
+            safe = jnp.where(valid, li, 0)
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                onehot = jax.nn.one_hot(safe, k, axis=axis, dtype=logp.dtype)
+                tgt = (1 - label_smoothing) * onehot + label_smoothing / k
+                loss = -jnp.sum(tgt * logp, axis=axis)
+            else:
+                loss = -jnp.take_along_axis(
+                    logp, jnp.expand_dims(safe, axis), axis=axis).squeeze(axis)
+            if w is not None:
+                loss = loss * w[safe]
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                denom = (jnp.sum(w[safe] * valid) if w is not None
+                         else jnp.sum(valid))
+                return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+        return _reduce(loss, reduction)
+
+    return apply(f, input)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = apply(lambda l: jnp.expand_dims(l, axis), loss)
+    if return_softmax:
+        sm = apply(lambda a: jax.nn.softmax(a, axis=axis), logits)
+        return loss, sm
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    lbl = raw(label)
+    w = raw(weight) if weight is not None else None
+
+    def f(logp):
+        li = lbl.astype(jnp.int32)
+        valid = li != ignore_index
+        safe = jnp.where(valid, li, 0)
+        loss = -jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+        if w is not None:
+            loss = loss * w[safe]
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(w[safe] * valid) if w is not None else jnp.sum(valid)
+            return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+        return _reduce(loss, reduction)
+
+    return apply(f, input)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce((a - b) ** 2, reduction), input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return apply(f, input, label)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def f(p, t, *w):
+        eps = 1e-12
+        loss = -(t * jnp.log(jnp.clip(p, eps, None)) +
+                 (1 - t) * jnp.log(jnp.clip(1 - p, eps, None)))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    pw = raw(pos_weight) if pos_weight is not None else None
+
+    def f(z, t, *w):
+        mx = jnp.maximum(z, 0)
+        base = mx - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            logsig = -jax.nn.log_sigmoid(z)
+            lognegsig = -jax.nn.log_sigmoid(-z)
+            base = t * logsig * pw + (1 - t) * lognegsig
+        if w:
+            base = base * w[0]
+        return _reduce(base, reduction)
+    args = (logit, label) + ((weight,) if weight is not None else ())
+    return apply(f, *args)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def f(logp, t):
+        loss = t * (jnp.log(jnp.clip(t, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply(f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def f(a, b, t):
+        return _reduce(jnp.maximum(0.0, -t * (a - b) + margin), reduction)
+    return apply(f, input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(a, t):
+        loss = jnp.where(t == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return apply(f, input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def f(a, b, t):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(t == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply(f, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, -1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, -1) ** (1 / p)
+        if swap:
+            dpn = jnp.sum(jnp.abs(pos - neg) ** p, -1) ** (1 / p)
+            dn = jnp.minimum(dn, dpn)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply(f, input, positive, negative)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def f(p, t):
+        return -(t * jnp.log(p + epsilon) + (1 - t) * jnp.log(1 - p + epsilon))
+    return apply(f, input, label)
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: (a - b) ** 2, input, label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard alpha recursion in log space (lax.scan over time)."""
+    lp = raw(log_probs)  # [T, B, C] paddle layout
+    lab = raw(labels)    # [B, S]
+    il = raw(input_lengths)
+    ll = raw(label_lengths)
+
+    def f(logits):
+        logits = jax.nn.log_softmax(logits, axis=-1)
+        T, B, C = logits.shape
+        S = lab.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        L = 2 * S + 1
+        neg_inf = -1e30
+        alpha0 = jnp.full((B, L), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(logits[0, :, blank])
+        first_lab = jnp.take_along_axis(logits[0], ext[:, 1:2], axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(first_lab)
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), dtype=bool),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, logit_t):
+            a_prev = alpha
+            a_shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], 1)
+            a_shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], 1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+            emit = jnp.take_along_axis(logit_t, ext, axis=1)
+            new_alpha = merged + emit
+            return new_alpha, new_alpha
+
+        _, alphas = jax.lax.scan(step, alpha0, logits[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, L]
+        t_idx = jnp.clip(il - 1, 0, T - 1).astype(jnp.int32)
+        final = alphas[t_idx, jnp.arange(B)]  # [B, L]
+        end1 = jnp.take_along_axis(final, (2 * ll)[:, None].astype(jnp.int32), 1)[:, 0]
+        end2 = jnp.take_along_axis(final, (2 * ll - 1)[:, None].astype(jnp.int32), 1)[:, 0]
+        nll = -jnp.logaddexp(end1, end2)
+        if reduction == "mean":
+            return jnp.mean(nll / jnp.maximum(ll.astype(nll.dtype), 1))
+        return _reduce(nll, reduction)
+
+    return apply(f, log_probs)

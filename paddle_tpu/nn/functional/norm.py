@@ -1,0 +1,171 @@
+"""Normalization functionals. Reference: python/paddle/nn/functional/norm.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor import Tensor, apply
+from ...tensor_ops._factory import raw
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        nrm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+    return apply(f, x)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Functional BN. In training mode, updates running stats in-place on the
+    provided buffer Tensors (tracer-safe: train-step builders capture the
+    mutated values as outputs)."""
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    use_batch = training and not use_global_stats
+
+    def stats_axes(a):
+        if channel_last:
+            return tuple(range(a.ndim - 1))
+        return (0,) + tuple(range(2, a.ndim))
+
+    def ch_shape(a, c):
+        s = [1] * a.ndim
+        s[-1 if channel_last else 1] = c
+        return s
+
+    rm, rv = raw(running_mean), raw(running_var)
+    if use_batch:
+        # update running stats (buffers); gradient-carrying stats are
+        # recomputed inside f so backprop flows through them (XLA CSEs the
+        # duplicate under jit)
+        xa = raw(x)
+        ax = stats_axes(xa)
+        m_ = jnp.mean(xa, axis=ax)
+        v_ = jnp.var(xa, axis=ax)
+        n = xa.size // m_.size
+        unbiased = v_ * n / max(n - 1, 1)
+        running_mean._data = momentum * rm + (1 - momentum) * m_
+        running_var._data = momentum * rv + (1 - momentum) * unbiased
+
+    def f(a, *wb):
+        if use_batch:
+            ax = stats_axes(a)
+            m = jnp.mean(a, axis=ax)
+            v = jnp.var(a, axis=ax)
+        else:
+            m, v = rm, rv
+        c = m.size
+        shp = ch_shape(a, c)
+        out = (a - m.reshape(shp)) * jax.lax.rsqrt(v.reshape(shp) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shp)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shp)
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply(f, *args)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    ns = ((normalized_shape,) if isinstance(normalized_shape, int)
+          else tuple(normalized_shape))
+    naxes = len(ns)
+
+    def f(a, *wb):
+        ax = tuple(range(a.ndim - naxes, a.ndim))
+        mean = jnp.mean(a.astype(jnp.float32), axis=ax, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=ax, keepdims=True)
+        out = ((a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply(f, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (llama-style). fp32 accumulation, bf16 in/out."""
+    def f(a, *w):
+        a32 = a.astype(jnp.float32)
+        ms = jnp.mean(a32 * a32, axis=-1, keepdims=True)
+        out = (a32 * jax.lax.rsqrt(ms + epsilon)).astype(a.dtype)
+        if w:
+            out = out * w[0]
+        return out
+    args = (x,) + (() if weight is None else (weight,))
+    return apply(f, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    def f(a, *wb):
+        ax = tuple(range(2, a.ndim))
+        m = jnp.mean(a, axis=ax, keepdims=True)
+        v = jnp.var(a, axis=ax, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + eps)
+        i = 0
+        if weight is not None:
+            shp = [1, wb[i].shape[0]] + [1] * (a.ndim - 2)
+            out = out * wb[i].reshape(shp)
+            i += 1
+        if bias is not None:
+            shp = [1, wb[i].shape[0]] + [1] * (a.ndim - 2)
+            out = out + wb[i].reshape(shp)
+        return out
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply(f, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def f(a, *wb):
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)
+        n, c = a.shape[0], a.shape[1]
+        g = num_groups
+        grouped = a.reshape((n, g, c // g) + a.shape[2:])
+        ax = tuple(range(2, grouped.ndim))
+        m = jnp.mean(grouped, axis=ax, keepdims=True)
+        v = jnp.var(grouped, axis=ax, keepdims=True)
+        out = ((grouped - m) * jax.lax.rsqrt(v + epsilon)).reshape(a.shape)
+        i = 0
+        shp = [1, c] + [1] * (a.ndim - 2)
+        if weight is not None:
+            out = out * wb[i].reshape(shp)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shp)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply(f, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(a):
+        sq = a * a
+        half = size // 2
+        c = a.shape[1]
+        acc = jnp.zeros_like(a)
+        for off in range(-half, half + 1):
+            lo = max(0, -off)
+            hi = min(c, c - off)
+            acc = acc.at[:, lo:hi].add(sq[:, lo + off:hi + off])
+        return a / (k + alpha * acc / size) ** beta
+    return apply(f, x)

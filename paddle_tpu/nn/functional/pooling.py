@@ -1,0 +1,158 @@
+"""Pooling. Reference: python/paddle/nn/functional/pooling.py.
+
+All pooling lowers to lax.reduce_window (native XLA → TPU vector unit).
+NCHW default like the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import apply
+from .conv import _norm_tuple
+
+
+def _pool_nd(x, n, kernel, stride, padding, kind, ceil_mode=False,
+             exclusive=True, data_format="NCHW", count_include_pad=None):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    ks = _norm_tuple(kernel, n)
+    st = _norm_tuple(stride if stride is not None else kernel, n)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        pd = _norm_tuple(padding, n)
+        pad = [(p, p) for p in pd]
+    if count_include_pad is not None:
+        exclusive = not count_include_pad
+
+    def f(a):
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        if isinstance(pad, str):
+            pads = pad
+        else:
+            pads = [(0, 0), (0, 0)] + pad
+        if kind == "max":
+            init = -jnp.inf if np.dtype(a.dtype).kind == "f" else np.iinfo(np.dtype(a.dtype)).min
+            out = jax.lax.reduce_window(a, init, jax.lax.max, window, strides, pads)
+        else:
+            s = jax.lax.reduce_window(a, 0.0, jax.lax.add,
+                                      window, strides, pads)
+            if exclusive and not isinstance(pads, str):
+                ones = jnp.ones(a.shape, dtype=a.dtype)
+                cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                            strides, pads)
+                out = s / cnt
+            else:
+                out = s / float(np.prod(ks))
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply(f, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _pool_nd(x, 1, kernel_size, stride, padding, "max", ceil_mode,
+                    data_format=df)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool_nd(x, 2, kernel_size, stride, padding, "max", ceil_mode,
+                    data_format=data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool_nd(x, 3, kernel_size, stride, padding, "max", ceil_mode,
+                    data_format=data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _pool_nd(x, 1, kernel_size, stride, padding, "avg",
+                    ceil_mode, exclusive, df)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool_nd(x, 2, kernel_size, stride, padding, "avg",
+                    ceil_mode, exclusive, data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool_nd(x, 3, kernel_size, stride, padding, "avg",
+                    ceil_mode, exclusive, data_format)
+
+
+def _adaptive_pool(x, n, output_size, kind, data_format="NCHW"):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    os_ = _norm_tuple(output_size, n)
+
+    def f(a):
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)
+        spatial = a.shape[2:]
+        out = a
+        # adaptive pooling: split each spatial dim into output_size bins
+        for d in range(n):
+            in_sz, out_sz = spatial[d], os_[d]
+            axis = 2 + d
+            if in_sz % out_sz == 0:
+                k = in_sz // out_sz
+                new_shape = out.shape[:axis] + (out_sz, k) + out.shape[axis + 1:]
+                r = out.reshape(new_shape)
+                out = (jnp.max(r, axis=axis + 1) if kind == "max"
+                       else jnp.mean(r, axis=axis + 1))
+            else:
+                # uneven bins: gather per-bin slices (out_sz is small)
+                starts = [int(np.floor(i * in_sz / out_sz)) for i in range(out_sz)]
+                ends = [int(np.ceil((i + 1) * in_sz / out_sz)) for i in range(out_sz)]
+                pieces = []
+                for s, e in zip(starts, ends):
+                    sl = [slice(None)] * out.ndim
+                    sl[axis] = slice(s, e)
+                    seg = out[tuple(sl)]
+                    red = (jnp.max(seg, axis=axis, keepdims=True) if kind == "max"
+                           else jnp.mean(seg, axis=axis, keepdims=True))
+                    pieces.append(red)
+                out = jnp.concatenate(pieces, axis=axis)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply(f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, 1, output_size, "avg", "NCW")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, 2, output_size, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, 3, output_size, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, 1, output_size, "max", "NCW")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, 2, output_size, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, 3, output_size, "max", "NCDHW")

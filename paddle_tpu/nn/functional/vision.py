@@ -1,0 +1,118 @@
+"""Vision functionals. Reference: python/paddle/nn/functional/vision.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor import apply
+from ...tensor_ops._factory import raw
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = jnp.transpose(a, (0, 1, 3, 2, 4, 5))
+        return a.reshape(n, h * r, w * r, c // (r * r))
+    return apply(f, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = jnp.transpose(a, (0, 1, 3, 5, 2, 4))
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = jnp.transpose(a, (0, 1, 3, 2, 4, 5))
+        return a.reshape(n, h // r, w // r, c * r * r)
+    return apply(f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            a = jnp.swapaxes(a, 1, 2)
+            return a.reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        a = jnp.swapaxes(a, 3, 4)
+        return a.reshape(n, h, w, c)
+    return apply(f, x)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    shp = [int(s) for s in (raw(out_shape) if hasattr(out_shape, "shape") else out_shape)]
+    def f(th):
+        n, c, h, w = shp
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) + 0.5) / h * 2 - 1
+            xs = (jnp.arange(w) + 0.5) / w * 2 - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [h, w, 3]
+        return jnp.einsum("hwk,nik->nhwi", base, th)
+    return apply(f, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(img, yy, xx):
+            yy = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xx = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            return img[:, :, yy, xx] if False else jnp.take(
+                jnp.take(img, yy, axis=2), xx, axis=3)
+
+        if mode == "nearest":
+            yi = jnp.round(fy).astype(jnp.int32)
+            xi = jnp.round(fx).astype(jnp.int32)
+            yi = jnp.clip(yi, 0, h - 1)
+            xi = jnp.clip(xi, 0, w - 1)
+            out = a[jnp.arange(n)[:, None, None], :, yi, xi]
+            return jnp.moveaxis(out, -1, 1)
+        x0 = jnp.floor(fx)
+        y0 = jnp.floor(fy)
+        x1, y1 = x0 + 1, y0 + 1
+        wx1 = fx - x0
+        wy1 = fy - y0
+        wx0, wy0 = 1 - wx1, 1 - wy1
+
+        def gather(yy, xx):
+            yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            v = a[jnp.arange(n)[:, None, None], :, yi, xi]  # [n, gh, gw, c]
+            if padding_mode == "zeros":
+                inb = ((yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1))
+                v = v * inb[..., None]
+            return v
+
+        out = (gather(y0, x0) * (wy0 * wx0)[..., None] +
+               gather(y0, x1) * (wy0 * wx1)[..., None] +
+               gather(y1, x0) * (wy1 * wx0)[..., None] +
+               gather(y1, x1) * (wy1 * wx1)[..., None])
+        return jnp.moveaxis(out, -1, 1)
+    return apply(f, x, grid)

@@ -1,0 +1,297 @@
+"""Recurrent layers. Reference: python/paddle/nn/layer/rnn.py.
+
+Recurrence is expressed with lax.scan so the whole unroll compiles to one
+fused XLA while-loop (no per-step dispatch). Layout matches paddle:
+[batch, time, feat] by default (time_major=False).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor import Tensor, apply
+from ..initializer import Uniform
+from ..layer_base import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        if isinstance(self.state_shape[0], (list, tuple)):
+            return tuple(Tensor(jnp.full((b,) + tuple(s), init_value))
+                         for s in self.state_shape)
+        return Tensor(jnp.full((b,) + tuple(self.state_shape), init_value))
+
+
+def _cell_params(layer, input_size, hidden_size, gates):
+    k = 1.0 / math.sqrt(hidden_size)
+    init = Uniform(-k, k)
+    layer.weight_ih = layer.create_parameter(
+        (gates * hidden_size, input_size), default_initializer=init)
+    layer.weight_hh = layer.create_parameter(
+        (gates * hidden_size, hidden_size), default_initializer=init)
+    layer.bias_ih = layer.create_parameter(
+        (gates * hidden_size,), is_bias=True, default_initializer=init)
+    layer.bias_hh = layer.create_parameter(
+        (gates * hidden_size,), is_bias=True, default_initializer=init)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        _cell_params(self, input_size, hidden_size, 1)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        def f(x, h, wih, whh, bih, bhh):
+            return act(x @ wih.T + bih + h @ whh.T + bhh)
+        h = apply(f, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        _cell_params(self, input_size, hidden_size, 4)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        def f(x, hh, cc, wih, whh, bih, bhh):
+            gates = x @ wih.T + bih + hh @ whh.T + bhh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fg = jax.nn.sigmoid(fg)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            new_c = fg * cc + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+        new_h, new_c = apply(f, inputs, h, c, self.weight_ih, self.weight_hh,
+                             self.bias_ih, self.bias_hh, n_outputs=2)
+        return new_h, (new_h, new_c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        _cell_params(self, input_size, hidden_size, 3)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        def f(x, h, wih, whh, bih, bhh):
+            xg = x @ wih.T + bih
+            hg = h @ whh.T + bhh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1 - z) * n + z * h
+        h = apply(f, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class RNN(Layer):
+    """Runs a cell over time with lax.scan (reference: nn/layer/rnn.py:RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            batch_idx = 1 if self.time_major else 0
+            initial_states = self.cell.get_initial_states(
+                inputs, batch_dim_idx=batch_idx)
+        is_lstm = isinstance(initial_states, (tuple, list))
+
+        params = [self.cell.weight_ih, self.cell.weight_hh,
+                  self.cell.bias_ih, self.cell.bias_hh]
+        cell_type = type(self.cell).__name__
+        act = getattr(self.cell, "activation", "tanh")
+        reverse = self.is_reverse
+        time_major = self.time_major
+
+        def f(x, *state_and_params):
+            if is_lstm:
+                h0, c0 = state_and_params[0], state_and_params[1]
+                wih, whh, bih, bhh = state_and_params[2:]
+                carry0 = (h0, c0)
+            else:
+                h0 = state_and_params[0]
+                wih, whh, bih, bhh = state_and_params[1:]
+                carry0 = h0
+            xs = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, F]
+            if reverse:
+                xs = jnp.flip(xs, axis=0)
+
+            def step(carry, xt):
+                if cell_type == "LSTMCell":
+                    h, c = carry
+                    gates = xt @ wih.T + bih + h @ whh.T + bhh
+                    i, fg, g, o = jnp.split(gates, 4, axis=-1)
+                    new_c = jax.nn.sigmoid(fg) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+                    new_h = jax.nn.sigmoid(o) * jnp.tanh(new_c)
+                    return (new_h, new_c), new_h
+                if cell_type == "GRUCell":
+                    h = carry
+                    xg = xt @ wih.T + bih
+                    hg = h @ whh.T + bhh
+                    xr, xz, xn = jnp.split(xg, 3, axis=-1)
+                    hr, hz, hn = jnp.split(hg, 3, axis=-1)
+                    r = jax.nn.sigmoid(xr + hr)
+                    z = jax.nn.sigmoid(xz + hz)
+                    n = jnp.tanh(xn + r * hn)
+                    new_h = (1 - z) * n + z * h
+                    return new_h, new_h
+                h = carry
+                a = jnp.tanh if act == "tanh" else jax.nn.relu
+                new_h = a(xt @ wih.T + bih + h @ whh.T + bhh)
+                return new_h, new_h
+
+            final, ys = jax.lax.scan(step, carry0, xs)
+            if reverse:
+                ys = jnp.flip(ys, axis=0)
+            if not time_major:
+                ys = jnp.swapaxes(ys, 0, 1)
+            if is_lstm:
+                return ys, final[0], final[1]
+            return ys, final
+
+        if is_lstm:
+            out, fh, fc = apply(f, inputs, initial_states[0], initial_states[1],
+                                *params, n_outputs=3)
+            return out, (fh, fc)
+        out, fh = apply(f, inputs, initial_states, *params, n_outputs=2)
+        return out, fh
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states = initial_states or (None, None)
+        out_f, st_f = self.rnn_fw(inputs, states[0])
+        out_b, st_b = self.rnn_bw(inputs, states[1])
+        from ...tensor_ops.manipulation import concat
+        return concat([out_f, out_b], axis=-1), (st_f, st_b)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh"):
+        super().__init__()
+        self.mode = mode
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.hidden_size = hidden_size
+        bidir = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidir else 1
+
+        def make_cell(in_sz):
+            if mode == "LSTM":
+                return LSTMCell(in_sz, hidden_size)
+            if mode == "GRU":
+                return GRUCell(in_sz, hidden_size)
+            return SimpleRNNCell(in_sz, hidden_size, activation)
+
+        from .container import LayerList
+        self.rnns = LayerList()
+        for layer_i in range(num_layers):
+            in_sz = input_size if layer_i == 0 else hidden_size * self.num_directions
+            if bidir:
+                self.rnns.append(BiRNN(make_cell(in_sz), make_cell(in_sz),
+                                       time_major))
+            else:
+                self.rnns.append(RNN(make_cell(in_sz), False, time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        out = inputs
+        final_states = []
+        for i, rnn in enumerate(self.rnns):
+            st = None if initial_states is None else initial_states
+            out, fs = rnn(out, None)
+            final_states.append(fs)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                from .. import functional as Fn
+                out = Fn.dropout(out, self.dropout, training=self.training)
+        # stack final states: paddle returns [num_layers*dirs, B, H]
+        from ...tensor_ops.manipulation import stack
+        if self.mode == "LSTM":
+            if self.num_directions == 1:
+                hs = stack([fs[0] for fs in final_states], axis=0)
+                cs = stack([fs[1] for fs in final_states], axis=0)
+            else:
+                hs = stack([s[i][0] for s in final_states for i in range(2)], axis=0)
+                cs = stack([s[i][1] for s in final_states for i in range(2)], axis=0)
+            return out, (hs, cs)
+        if self.num_directions == 1:
+            hs = stack(final_states, axis=0)
+        else:
+            hs = stack([s[i] for s in final_states for i in range(2)], axis=0)
+        return out, hs
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)

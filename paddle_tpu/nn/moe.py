@@ -1,0 +1,118 @@
+"""Mixture-of-Experts (ERNIE-MoE capability; reference:
+python/paddle/incubate/distributed/models/moe/).
+
+TPU-native GShard-style design: experts are ONE batched parameter tensor
+[num_experts, ...] and token routing is expressed as dense einsums with a
+capacity-bounded one-hot dispatch mask — static shapes, MXU-friendly, and
+expert parallelism is just sharding the leading expert axis over the mesh's
+"ep" axis (the all-to-all materializes as XLA collectives when the token and
+expert shardings differ). This replaces the reference's explicit
+c_alltoall + per-expert sub-programs.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, apply
+from .initializer import XavierUniform
+from .layer_base import Layer
+
+
+def _topk_gating(logits, k, capacity):
+    """Returns (dispatch [S, E, C] bool-ish, combine [S, E, C], aux_loss)."""
+    S, E = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)  # [S, E]
+    # aux load-balance loss (Switch/GShard): E * sum_e mean_gates_e * mean_frac_e
+    topk_val, topk_idx = jax.lax.top_k(gates, k)  # [S, k]
+    mask_k = jax.nn.one_hot(topk_idx, E, dtype=gates.dtype)  # [S, k, E]
+    frac = jnp.mean(mask_k[:, 0], axis=0)
+    aux = E * jnp.sum(jnp.mean(gates, axis=0) * frac)
+
+    # position of each token within its expert queue, per k-choice
+    disp = jnp.zeros((S, E), dtype=gates.dtype)
+    combine = jnp.zeros((S, E, capacity), dtype=gates.dtype)
+    prev_counts = jnp.zeros((E,), dtype=jnp.int32)
+    for choice in range(k):
+        m = mask_k[:, choice]  # [S, E]
+        pos_in_e = (jnp.cumsum(m, axis=0) - m).astype(jnp.int32) + prev_counts[None, :]
+        keep = (pos_in_e < capacity) * m
+        gate_c = topk_val[:, choice:choice + 1] * keep  # [S, E]
+        oh_pos = jax.nn.one_hot(pos_in_e, capacity, dtype=gates.dtype)  # [S,E,C]
+        combine = combine + gate_c[..., None] * oh_pos * keep[..., None]
+        prev_counts = prev_counts + jnp.sum(m, axis=0).astype(jnp.int32)
+    # renormalize combine weights over chosen experts
+    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+    dispatch = (combine > 0).astype(gates.dtype)
+    return dispatch, combine, aux
+
+
+class TopKGate(Layer):
+    def __init__(self, d_model, num_experts, k=2, capacity_factor=1.25):
+        super().__init__()
+        self.num_experts = num_experts
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.weight = self.create_parameter((d_model, num_experts),
+                                            default_initializer=XavierUniform())
+
+    def forward(self, x_flat):
+        """x_flat: [S, d] → (dispatch, combine, aux_loss)."""
+        S = x_flat.shape[0]
+        capacity = max(4, int(math.ceil(self.k * S * self.capacity_factor /
+                                        self.num_experts)))
+        def f(x, w):
+            logits = (x.astype(jnp.float32) @ w.astype(jnp.float32))
+            return _topk_gating(logits, self.k, capacity)
+        return apply(f, x_flat, self.weight, n_outputs=3)
+
+
+class SwitchGate(TopKGate):
+    def __init__(self, d_model, num_experts, capacity_factor=1.25):
+        super().__init__(d_model, num_experts, k=1,
+                         capacity_factor=capacity_factor)
+
+
+class MoELayer(Layer):
+    """Expert FFN bank + gate. Experts stored batched: weights [E, d, ff].
+
+    Under fleet expert-parallel the leading E axis is sharded on the mesh
+    "ep" axis; XLA turns the dispatch einsum into an all-to-all over ICI.
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, k=2,
+                 capacity_factor=1.25, activation="gelu", gate=None):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.gate = gate or TopKGate(d_model, num_experts, k, capacity_factor)
+        self.w_up = self.create_parameter((num_experts, d_model, d_hidden),
+                                          default_initializer=XavierUniform())
+        self.w_down = self.create_parameter((num_experts, d_hidden, d_model),
+                                            default_initializer=XavierUniform())
+        self.activation = activation
+        self.aux_loss = None
+
+    def forward(self, x):
+        """x: [B, L, d] → [B, L, d]; stores aux_loss for the trainer."""
+        b, l, d = x.shape
+        from ..tensor_ops.manipulation import reshape
+        x_flat = reshape(x, (b * l, d))
+        dispatch, combine, aux = self.gate(x_flat)
+        self.aux_loss = aux
+
+        act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+               "silu": jax.nn.silu}[self.activation]
+
+        def f(xf, disp, comb, wu, wd):
+            # [S,d],[S,E,C] -> [E,C,d]: the all-to-all when sharded
+            expert_in = jnp.einsum("sd,sec->ecd", xf, disp)
+            h = act(jnp.einsum("ecd,edf->ecf", expert_in, wu))
+            expert_out = jnp.einsum("ecf,efd->ecd", h, wd)
+            return jnp.einsum("ecd,sec->sd", expert_out, comb)
+
+        out = apply(f, x_flat, dispatch, combine, self.w_up, self.w_down)
+        return reshape(out, (b, l, d))

@@ -1,0 +1,95 @@
+"""nn.utils. Reference: python/paddle/nn/utils/*."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...tensor import Parameter, Tensor
+from ..layer_base import Layer
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...tensor_ops.manipulation import concat, reshape
+    return concat([reshape(p, (-1,)) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p._data = vec._data[offset:offset + n].reshape(p._data.shape).astype(p._data.dtype)
+        offset += n
+
+
+def weight_norm(layer: Layer, name="weight", dim=0):
+    """Reparametrize weight = g * v / ||v|| (reference: nn/utils/weight_norm_hook.py)."""
+    w = getattr(layer, name)
+    wdata = w._data
+    if dim is None:
+        norm = jnp.sqrt(jnp.sum(wdata ** 2))
+    else:
+        axes = tuple(i for i in range(wdata.ndim) if i != dim)
+        norm = jnp.sqrt(jnp.sum(wdata ** 2, axis=axes, keepdims=True))
+    g = Parameter(norm.reshape(-1) if dim is not None else norm.reshape(1))
+    v = Parameter(wdata)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        vd = v._data
+        if dim is None:
+            nv = jnp.sqrt(jnp.sum(vd ** 2))
+            new_w = g._data.reshape(()) * vd / jnp.maximum(nv, 1e-12)
+        else:
+            axes = tuple(i for i in range(vd.ndim) if i != dim)
+            nv = jnp.sqrt(jnp.sum(vd ** 2, axis=axes, keepdims=True))
+            shape = [1] * vd.ndim
+            shape[dim] = -1
+            new_w = g._data.reshape(shape) * vd / jnp.maximum(nv, 1e-12)
+        object.__setattr__(lyr, "_wn_cache", Tensor(new_w, stop_gradient=False))
+        lyr._parameters[name] = lyr._wn_cache  # visible to forward
+        return None
+
+    layer._wn_hook = layer.register_forward_pre_hook(hook)
+    hook(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name="weight"):
+    if hasattr(layer, "_wn_hook"):
+        layer._wn_hook.remove()
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    layer._parameters[name] = Parameter(layer._parameters.pop(name)._data
+                                        if name in layer._parameters else v._data)
+    return layer
+
+
+def spectral_norm(layer: Layer, name="weight", n_power_iterations=1,
+                  eps=1e-12, dim=0):
+    """Power-iteration spectral normalization (reference: nn/utils/spectral_norm_hook.py)."""
+    import numpy as np
+    w = getattr(layer, name)
+    wmat = np.asarray(w._data)
+    if dim != 0:
+        wmat = np.moveaxis(wmat, dim, 0)
+    h = wmat.shape[0]
+    state = {"u": jnp.asarray(np.random.default_rng(0).normal(size=(h,)),
+                              dtype=jnp.float32)}
+
+    def hook(lyr, inputs):
+        wd = lyr._parameters[name]._data if name in lyr._parameters else w._data
+        mat = jnp.moveaxis(wd, dim, 0).reshape(wd.shape[dim] if dim != 0 else wd.shape[0], -1)
+        u = state["u"]
+        for _ in range(n_power_iterations):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        state["u"] = u
+        sigma = u @ mat @ v
+        object.__setattr__(lyr, "_sn_w", Tensor(wd / sigma, stop_gradient=False))
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    return layer

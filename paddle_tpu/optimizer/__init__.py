@@ -1,0 +1,5 @@
+from . import lr  # noqa: F401
+from .algorithms import (  # noqa: F401
+    Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, RMSProp, SGD,
+)
+from .optimizer import Optimizer  # noqa: F401

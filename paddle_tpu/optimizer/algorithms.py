@@ -1,0 +1,275 @@
+"""Concrete optimizers. Reference: python/paddle/optimizer/{sgd,momentum,
+adam,adamw,adamax,adagrad,adadelta,rmsprop,lamb}.py.
+
+Each algorithm is one pure ``update_param`` — shared verbatim by the eager
+and compiled paths. Moment accumulators are kept in fp32 when the param is
+bf16 (multi_precision, default on — master-weights behavior of the
+reference's FusedAdam).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+def _acc_dtype(p_raw, multi_precision):
+    return jnp.float32 if (multi_precision and p_raw.dtype == jnp.bfloat16) else p_raw.dtype
+
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+def _needs_master(self, p):
+    """Low-precision params keep a persistent fp32 master copy in the state
+    (reference FusedAdam multi_precision): without it, late-training updates
+    smaller than a bf16 ulp round away and training plateaus."""
+    return self._multi_precision and p.dtype in (jnp.bfloat16, jnp.float16)
+
+
+def _master_init(self, p, st):
+    if _needs_master(self, p):
+        st["master"] = p.astype(jnp.float32)
+    return st
+
+
+def _read_master(st, p):
+    return st["master"] if "master" in st else p.astype(jnp.float32)
+
+
+def _write_master(st, new_p32, p):
+    if "master" in st:
+        st["master"] = new_p32
+    return new_p32.astype(p.dtype)
+
+
+class SGD(Optimizer):
+    def init_param_state(self, p):
+        return _master_init(self, p, {})
+
+    def update_param(self, p, g, st, lr, param):
+        st = dict(st)
+        new_p32 = _read_master(st, p) - lr * _f32(g)
+        return _write_master(st, new_p32, p), st
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_param_state(self, p):
+        return _master_init(self, p, {
+            "velocity": jnp.zeros_like(p, dtype=_acc_dtype(p, self._multi_precision))})
+
+    def update_param(self, p, g, st, lr, param):
+        st = dict(st)
+        v = self._momentum * st["velocity"] + _f32(g)
+        if self._nesterov:
+            upd = _f32(g) + self._momentum * v
+        else:
+            upd = v
+        st["velocity"] = v.astype(st["velocity"].dtype)
+        new_p32 = _read_master(st, p) - lr * upd
+        return _write_master(st, new_p32, p), st
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def init_param_state(self, p):
+        dt = _acc_dtype(p, self._multi_precision)
+        return _master_init(self, p, {
+            "moment1": jnp.zeros_like(p, dtype=dt),
+            "moment2": jnp.zeros_like(p, dtype=dt),
+            "beta1_pow": jnp.asarray(1.0, dtype=jnp.float32),
+            "beta2_pow": jnp.asarray(1.0, dtype=jnp.float32)})
+
+    def _adam_update(self, p, g, st, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        g32 = _f32(g)
+        m = b1 * st["moment1"] + (1 - b1) * g32
+        v = b2 * st["moment2"] + (1 - b2) * g32 * g32
+        b1p = st["beta1_pow"] * b1
+        b2p = st["beta2_pow"] * b2
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        step = lr * mhat / (jnp.sqrt(vhat) + eps)
+        new_st = {"moment1": m.astype(st["moment1"].dtype),
+                  "moment2": v.astype(st["moment2"].dtype),
+                  "beta1_pow": b1p, "beta2_pow": b2p}
+        return step, new_st
+
+    def update_param(self, p, g, st, lr, param):
+        step, new_st = self._adam_update(p, g, st, lr)
+        if "master" in st:
+            new_st["master"] = st["master"]
+        new_p32 = _read_master(new_st, p) - step
+        return _write_master(new_st, new_p32, p), new_st
+
+
+class AdamW(Adam):
+    _decoupled = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=True, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._wd_coeff = weight_decay if isinstance(weight_decay, float) else \
+            getattr(weight_decay, "coeff", 0.01)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def update_param(self, p, g, st, lr, param):
+        step, new_st = self._adam_update(p, g, st, lr)
+        if "master" in st:
+            new_st["master"] = st["master"]
+        decay = self._wd_coeff
+        if (self._apply_decay_param_fun is not None and param is not None
+                and not self._apply_decay_param_fun(param.name)):
+            decay = 0.0
+        p32 = _read_master(new_st, p)
+        new_p32 = p32 - lr * decay * p32 - step
+        return _write_master(new_st, new_p32, p), new_st
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def init_param_state(self, p):
+        return {"moment": jnp.zeros_like(p, dtype=jnp.float32),
+                "inf_norm": jnp.zeros_like(p, dtype=jnp.float32),
+                "beta1_pow": jnp.asarray(1.0, dtype=jnp.float32)}
+
+    def update_param(self, p, g, st, lr, param):
+        g32 = _f32(g)
+        m = self._beta1 * st["moment"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * st["inf_norm"], jnp.abs(g32))
+        b1p = st["beta1_pow"] * self._beta1
+        step = lr * m / ((1 - b1p) * (u + self._epsilon))
+        return ((p.astype(jnp.float32) - step).astype(p.dtype),
+                {"moment": m, "inf_norm": u, "beta1_pow": b1p})
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_param_state(self, p):
+        return {"moment": jnp.full_like(p, self._init_acc, dtype=jnp.float32)}
+
+    def update_param(self, p, g, st, lr, param):
+        g32 = _f32(g)
+        acc = st["moment"] + g32 * g32
+        step = lr * g32 / (jnp.sqrt(acc) + self._epsilon)
+        return (p.astype(jnp.float32) - step).astype(p.dtype), {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def init_param_state(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p, dtype=jnp.float32),
+                "avg_squared_update": jnp.zeros_like(p, dtype=jnp.float32)}
+
+    def update_param(self, p, g, st, lr, param):
+        g32 = _f32(g)
+        eg = self._rho * st["avg_squared_grad"] + (1 - self._rho) * g32 * g32
+        upd = (jnp.sqrt(st["avg_squared_update"] + self._epsilon) /
+               jnp.sqrt(eg + self._epsilon)) * g32
+        eu = self._rho * st["avg_squared_update"] + (1 - self._rho) * upd * upd
+        return ((p.astype(jnp.float32) - lr * upd).astype(p.dtype),
+                {"avg_squared_grad": eg, "avg_squared_update": eu})
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def init_param_state(self, p):
+        st = {"mean_square": jnp.zeros_like(p, dtype=jnp.float32),
+              "momentum": jnp.zeros_like(p, dtype=jnp.float32)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(p, dtype=jnp.float32)
+        return st
+
+    def update_param(self, p, g, st, lr, param):
+        g32 = _f32(g)
+        ms = self._rho * st["mean_square"] + (1 - self._rho) * g32 * g32
+        if self._centered:
+            mg = self._rho * st["mean_grad"] + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * st["momentum"] + lr * g32 / denom
+        new_st = {"mean_square": ms, "momentum": mom}
+        if mg is not None:
+            new_st["mean_grad"] = mg
+        return (p.astype(jnp.float32) - mom).astype(p.dtype), new_st
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_param_state(self, p):
+        return {"moment1": jnp.zeros_like(p, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(p, dtype=jnp.float32),
+                "beta1_pow": jnp.asarray(1.0, dtype=jnp.float32),
+                "beta2_pow": jnp.asarray(1.0, dtype=jnp.float32)}
+
+    def update_param(self, p, g, st, lr, param):
+        b1, b2 = self._beta1, self._beta2
+        g32 = _f32(g)
+        p32 = p.astype(jnp.float32)
+        m = b1 * st["moment1"] + (1 - b1) * g32
+        v = b2 * st["moment2"] + (1 - b2) * g32 * g32
+        b1p = st["beta1_pow"] * b1
+        b2p = st["beta2_pow"] * b2
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        wd = self._lamb_wd
+        if self._exclude_fn is not None and param is not None and self._exclude_fn(param):
+            wd = 0.0
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = p32 - lr * trust * r
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v,
+                                       "beta1_pow": b1p, "beta2_pow": b2p}

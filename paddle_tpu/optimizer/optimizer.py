@@ -1,0 +1,164 @@
+"""Optimizer base. Reference: python/paddle/optimizer/optimizer.py.
+
+Dual interface:
+
+* **eager** (paddle-style): ``opt.step()`` consumes ``param.grad`` set by
+  ``loss.backward()`` and updates parameters in place.
+* **functional** (compiled path): ``init_state(params)`` +
+  ``apply_gradients(params, grads, state, lr)`` are pure pytree functions the
+  hapi/fleet train-step builders close over — the whole update fuses into
+  the XLA train step, and sharded params imply sharded optimizer state
+  (sharding stages fall out of the partition specs, no per-param python).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..regularizer import L1Decay, L2Decay
+from ..tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=True):
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._name = name
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, float):
+            weight_decay = L2Decay(weight_decay)
+        self._weight_decay = weight_decay
+        self._accumulators: Dict[int, dict] = {}
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def _lr_scheduler(self):
+        return self._learning_rate if isinstance(self._learning_rate, LRScheduler) else None
+
+    # -- eager path ----------------------------------------------------------
+    def _all_params(self) -> List[Parameter]:
+        if self._parameter_list is None:
+            raise ValueError("optimizer created without a parameter list")
+        return self._parameter_list
+
+    def step(self):
+        lr = self.get_lr()
+        pgs = [(p, p.grad._data) for p in self._all_params()
+               if p.grad is not None and p.trainable]
+        if self._grad_clip is not None:
+            pgs = self._grad_clip(pgs)
+        for p, g in pgs:
+            g = self._apply_decay_to_grad(p, g)
+            st = self._accumulators.get(id(p))
+            if st is None:
+                st = self.init_param_state(p._data)
+                self._accumulators[id(p)] = st
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            new_p, new_st = self.update_param(p._data, g, st, plr, p)
+            p._data = new_p
+            self._accumulators[id(p)] = new_st
+
+    def _apply_decay_to_grad(self, p, g):
+        # L1/L2Decay are coupled (added to grad); AdamW overrides with
+        # decoupled decay in update_param.
+        reg = p.regularizer or self._weight_decay
+        if isinstance(reg, (L1Decay, L2Decay)) and not getattr(self, "_decoupled", False):
+            g = g + reg.grad_term(p._data)
+        return g
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._all_params():
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def backward(self, loss, **kwargs):
+        loss.backward()
+
+    def apply_gradients(self, params_grads):
+        lr = self.get_lr()
+        pgs = [(p, g._data if isinstance(g, Tensor) else g)
+               for p, g in params_grads]
+        if self._grad_clip is not None:
+            pgs = self._grad_clip(pgs)
+        for p, graw in pgs:
+            graw = self._apply_decay_to_grad(p, graw)
+            st = self._accumulators.get(id(p))
+            if st is None:
+                st = self.init_param_state(p._data)
+            new_p, new_st = self.update_param(p._data, graw, st, lr, p)
+            p._data = new_p
+            self._accumulators[id(p)] = new_st
+
+    # -- functional path -----------------------------------------------------
+    def init_state(self, params: dict):
+        """params: dict name → raw array. Returns the state pytree."""
+        return {k: self.init_param_state(v) for k, v in params.items()}
+
+    def apply_gradients_functional(self, params: dict, grads: dict, state: dict,
+                                   lr):
+        if self._grad_clip is not None:
+            grads = self._grad_clip.apply_functional(grads)
+        new_p, new_s = {}, {}
+        for k, p in params.items():
+            g = grads[k]
+            if self._weight_decay is not None and not getattr(self, "_decoupled", False):
+                g = g + self._weight_decay.grad_term(p)
+            new_p[k], new_s[k] = self.update_param(p, g, state[k], lr, None)
+        return new_p, new_s
+
+    # -- per-algorithm hooks (override) --------------------------------------
+    def init_param_state(self, p_raw) -> dict:
+        return {}
+
+    def update_param(self, p_raw, g_raw, state: dict, lr, param):
+        raise NotImplementedError
+
+    # -- serialization -------------------------------------------------------
+    def state_dict(self):
+        out = {"_lr": self._learning_rate if not isinstance(self._learning_rate, LRScheduler) else None}
+        sched = self._lr_scheduler()
+        if sched is not None:
+            out["_lr_scheduler"] = sched.state_dict()
+        if self._parameter_list is not None:
+            for i, p in enumerate(self._all_params()):
+                st = self._accumulators.get(id(p))
+                if st:
+                    out[p.name or f"param_{i}"] = {k: Tensor(v) for k, v in st.items()}
+        return out
+
+    def set_state_dict(self, state):
+        sched = self._lr_scheduler()
+        if sched is not None and "_lr_scheduler" in state:
+            sched.set_state_dict(state["_lr_scheduler"])
+        if self._parameter_list is None:
+            return
+        for i, p in enumerate(self._all_params()):
+            key = p.name or f"param_{i}"
+            if key in state:
+                self._accumulators[id(p)] = {
+                    k: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
+                    for k, v in state[key].items()}
+
+    load_state_dict = set_state_dict

@@ -1,0 +1,22 @@
+"""Regularizers (reference: python/paddle/regularizer.py)."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 penalty; applied by optimizers as sign(w)*coeff added to grads."""
+
+    def grad_term(self, p_raw):
+        import jax.numpy as jnp
+        return self.coeff * jnp.sign(p_raw)
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 penalty; grad term coeff * w."""
+
+    def grad_term(self, p_raw):
+        return self.coeff * p_raw

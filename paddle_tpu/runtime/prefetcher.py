@@ -1,0 +1,70 @@
+"""Native prefetcher binding.
+
+Loads the C++ ring-buffer prefetch runtime (runtime/cpp/prefetch.cc) via
+ctypes. The C++ side owns a bounded lock-free ring of pickled batches filled
+by a producer thread pool, decoupling python-side collate from the device
+feed — the TPU analog of the reference's C++ buffered reader
+(paddle/fluid/operators/reader/buffered_reader.cc).
+
+Falls back (ImportError) when the shared library hasn't been built; the
+DataLoader then uses its python thread queue.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import threading
+
+_LIB = None
+
+
+def _load_lib():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    here = os.path.dirname(__file__)
+    path = os.path.join(here, "cpp", "libptpu_runtime.so")
+    if not os.path.exists(path):
+        raise ImportError("native runtime not built")
+    _LIB = ctypes.CDLL(path)
+    _LIB.rb_create.restype = ctypes.c_void_p
+    _LIB.rb_create.argtypes = [ctypes.c_int]
+    _LIB.rb_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long]
+    _LIB.rb_push.restype = ctypes.c_int
+    _LIB.rb_pop.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_long)]
+    _LIB.rb_pop.restype = ctypes.c_void_p
+    _LIB.rb_free_buf.argtypes = [ctypes.c_void_p]
+    _LIB.rb_close.argtypes = [ctypes.c_void_p]
+    _LIB.rb_destroy.argtypes = [ctypes.c_void_p]
+    return _LIB
+
+
+class NativePrefetcher:
+    def __init__(self, batch_iter, depth=8):
+        lib = _load_lib()
+        self._lib = lib
+        self._rb = lib.rb_create(depth)
+        self._producer = threading.Thread(
+            target=self._produce, args=(batch_iter,), daemon=True)
+        self._producer.start()
+
+    def _produce(self, it):
+        try:
+            for batch in it:
+                data = pickle.dumps(batch, protocol=pickle.HIGHEST_PROTOCOL)
+                # rb_push blocks while the ring is full (backpressure)
+                self._lib.rb_push(self._rb, data, len(data))
+        finally:
+            self._lib.rb_close(self._rb)
+
+    def __iter__(self):
+        n = ctypes.c_long()
+        while True:
+            ptr = self._lib.rb_pop(self._rb, ctypes.byref(n))
+            if not ptr:
+                break
+            raw = ctypes.string_at(ptr, n.value)
+            self._lib.rb_free_buf(ptr)
+            yield pickle.loads(raw)
+        self._lib.rb_destroy(self._rb)

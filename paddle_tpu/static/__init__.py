@@ -1,0 +1,36 @@
+"""Static-graph compat shims (reference: python/paddle/static).
+
+The XLA path makes most of paddle.static unnecessary; InputSpec is the part
+models and jit.save actually use.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+
+
+class InputSpec:
+    """Reference: python/paddle/static/input.py:InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = dtype_mod.convert_dtype(dtype)
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, ndarray.dtype, name)
+
+    def batch(self, batch_size):
+        return InputSpec((batch_size,) + tuple(self.shape), self.dtype, self.name)
+
+    def unbatch(self):
+        return InputSpec(tuple(self.shape[1:]), self.dtype, self.name)

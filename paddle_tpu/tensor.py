@@ -1,0 +1,309 @@
+"""The paddle_tpu Tensor.
+
+Reference surface: python/paddle/fluid/dygraph/varbase_patch_methods.py and
+python/paddle/tensor/tensor.py — a Tensor with ``stop_gradient`` (note:
+paddle's default is True; Parameters default to False), ``.grad``,
+``backward()``, ``numpy()``, and ~200 method aliases of the functional ops.
+
+Implementation: a thin wrapper over a jax array. Every op is a pure jnp
+function routed through :func:`apply` which (a) unwraps inputs, (b) runs the
+jnp computation (eagerly on device, or as a tracer under jit), and (c) when
+the eager tape is live, records a VJP node. Tensor is registered as a jax
+pytree node, so Tensors pass transparently through jax.jit / shard_map /
+grad when used functionally.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .autograd import tape
+from .framework import dtype as dtype_mod
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_index",
+                 "name", "persistable", "__weakref__")
+
+    def __init__(self, data, dtype=None, stop_gradient: bool = True,
+                 name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            dt = dtype_mod.convert_dtype(dtype)
+            arr = np.asarray(data)
+            if dt is None and arr.dtype == np.float64:
+                dt = dtype_mod.get_default_dtype()
+            data = jnp.asarray(arr, dtype=dt)
+        elif dtype is not None:
+            dt = dtype_mod.convert_dtype(dtype)
+            if dt is not None and data.dtype != dt:
+                data = data.astype(dt)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_index = 0
+        self.name = name
+        self.persistable = False
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    ndimension = dim = lambda self: self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def place(self):
+        try:
+            devs = getattr(self._data, "devices", None)
+            return next(iter(devs())) if callable(devs) else "tpu"
+        except Exception:
+            return "traced"
+
+    @property
+    def T(self):
+        from . import tensor_ops as ops
+        return ops.t(self)
+
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        return self._data.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return self._data.dtype.itemsize
+
+    def is_leaf(self):
+        return self._node is None
+
+    def detach(self):
+        return Tensor(self._data, stop_gradient=True)
+
+    def clone(self):
+        from . import tensor_ops as ops
+        return ops.clone(self)
+
+    def astype(self, dtype):
+        from . import tensor_ops as ops
+        return ops.cast(self, dtype)
+
+    cast = astype
+
+    def cpu(self):
+        return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
+
+    def cuda(self, *a, **k):  # compat no-op: data already on accelerator
+        return self
+
+    def to(self, *args, **kwargs):
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, str) and a in ("cpu", "tpu", "gpu") or ":" in str(a):
+                continue
+            dtype = a
+        return self.astype(dtype) if dtype is not None else self
+
+    def pin_memory(self):
+        return self
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        tape.backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def register_hook(self, hook):
+        # Eager-path grad hook: wrap the node vjp. Minimal support.
+        raise NotImplementedError("register_hook is not supported yet")
+
+    # -- display ------------------------------------------------------------
+    def __repr__(self):
+        sg = self.stop_gradient
+        try:
+            body = np.array2string(np.asarray(self._data), precision=8,
+                                   separator=", ", prefix="       ")
+        except Exception:
+            body = f"<traced {self._data}>"
+        return (f"Tensor(shape={self.shape}, dtype={self._data.dtype}, "
+                f"stop_gradient={sg},\n       {body})")
+
+    def __len__(self):
+        if not self._data.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __index__(self):
+        return int(self._data)
+
+    def __format__(self, spec):
+        if self.size == 1:
+            return format(self.item(), spec)
+        return format(str(self), spec)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __hash__(self):
+        return id(self)
+
+    # arithmetic dunders are attached in tensor_ops/_bind.py
+
+
+def _tensor_flatten(t: Tensor):
+    return (t._data,), t.stop_gradient
+
+
+def _tensor_unflatten(aux, children):
+    return Tensor(children[0], stop_gradient=aux)
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: fluid.framework.Parameter/EagerParamBase)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed",
+                 "is_mp", "split_axis", "pspec")
+
+    def __init__(self, data, dtype=None, trainable: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self.is_mp = False
+        self.split_axis = None
+        self.pspec = None  # jax PartitionSpec for the distributed path
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = jnp.asarray(value, dtype=self._data.dtype)
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda p: ((p._data,), (p.stop_gradient,)),
+    lambda aux, ch: Parameter(ch[0], trainable=not aux[0]),
+)
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _wrap_out(val, stop_gradient):
+    return Tensor(val, stop_gradient=stop_gradient)
+
+
+def apply(fn: Callable, *args, n_outputs: Any = 1, **kwargs):
+    """Run primitive ``fn`` (a pure jnp function) on mixed Tensor/array args.
+
+    Differentiates w.r.t. positional Tensor args whose stop_gradient is
+    False; kwargs are always non-differentiable constants. Returns Tensor(s).
+    """
+    taping = tape.grad_enabled()
+    diff_idx = []
+    if taping:
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor) and not a.stop_gradient:
+                diff_idx.append(i)
+    raw = [_unwrap(a) for a in args]
+
+    if not diff_idx:
+        out = fn(*raw, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(_wrap_out(o, True) for o in out)
+        return _wrap_out(out, True)
+
+    parents = [args[i] for i in diff_idx]
+
+    def closed(*diff_vals):
+        vals = list(raw)
+        for i, v in zip(diff_idx, diff_vals):
+            vals[i] = v
+        return fn(*vals, **kwargs)
+
+    out, vjp = jax.vjp(closed, *(raw[i] for i in diff_idx))
+    multi = isinstance(out, (tuple, list))
+    outs = tuple(out) if multi else (out,)
+
+    def vjp_fn(out_cts):
+        cts = tuple(
+            jnp.zeros_like(o) if ct is None else ct
+            for o, ct in zip(outs, out_cts)
+        )
+        return vjp(cts if multi else cts[0])
+
+    wrapped = tuple(_wrap_out(o, False) for o in outs)
+    tape.record(vjp_fn, parents, wrapped)
+    return wrapped if multi else wrapped[0]
+
+
+def nondiff(fn: Callable, *args, **kwargs):
+    """Apply a non-differentiable op (argmax, comparisons, ...)."""
+    raw = [_unwrap(a) for a in args]
+    out = fn(*raw, **kwargs)
+    if isinstance(out, (tuple, list)):
+        return tuple(_wrap_out(o, True) for o in out)
+    return _wrap_out(out, True)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor (reference: python/paddle/tensor/creation.py:to_tensor)."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._data, dtype=dtype, stop_gradient=stop_gradient)
+        return t
+    if isinstance(data, (bool, int)) and dtype is None:
+        # match paddle: python ints -> int64 (jax x64-off folds to int32)
+        data = np.asarray(data)
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)

@@ -1,0 +1,15 @@
+"""Aggregated functional op namespace (mirrors the flat `paddle.*` op API)."""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+
+# names that collide between modules: stat.mean/std/var win over math's
+from .stat import mean, std, var, median, numel  # noqa: F401
+from .math import sum, max, min, prod, abs, pow, round, all, any  # noqa: F401
+from .manipulation import where, cast, reshape, transpose, t  # noqa: F401

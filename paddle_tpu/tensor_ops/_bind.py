@@ -1,0 +1,118 @@
+"""Attach op methods + dunders to Tensor (reference:
+python/paddle/fluid/dygraph/math_op_patch.py & varbase_patch_methods.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor, apply, nondiff
+from . import creation, linalg, logic, manipulation, math as m, search, stat
+
+
+def _swap(fn):
+    return lambda self, other: fn(other if isinstance(other, Tensor) else Tensor(jnp.asarray(other)), self)
+
+
+def bind():
+    T = Tensor
+
+    # arithmetic dunders
+    T.__add__ = lambda s, o: m.add(s, o)
+    T.__radd__ = lambda s, o: m.add(s, o)
+    T.__sub__ = lambda s, o: m.subtract(s, o)
+    T.__rsub__ = _swap(m.subtract)
+    T.__mul__ = lambda s, o: m.multiply(s, o)
+    T.__rmul__ = lambda s, o: m.multiply(s, o)
+    T.__truediv__ = lambda s, o: m.divide(s, o)
+    T.__rtruediv__ = _swap(m.divide)
+    T.__floordiv__ = lambda s, o: m.floor_divide(s, o)
+    T.__rfloordiv__ = _swap(m.floor_divide)
+    T.__mod__ = lambda s, o: m.mod(s, o)
+    T.__rmod__ = _swap(m.mod)
+    T.__pow__ = lambda s, o: m.pow(s, o)
+    T.__rpow__ = _swap(m.pow)
+    T.__matmul__ = lambda s, o: m.matmul(s, o)
+    T.__rmatmul__ = _swap(m.matmul)
+    T.__neg__ = lambda s: m.neg(s)
+    T.__abs__ = lambda s: m.abs(s)
+    T.__invert__ = lambda s: logic.logical_not(s) if s.dtype == jnp.bool_ else logic.bitwise_not(s)
+    T.__and__ = lambda s, o: logic.logical_and(s, o) if s.dtype == jnp.bool_ else logic.bitwise_and(s, o)
+    T.__or__ = lambda s, o: logic.logical_or(s, o) if s.dtype == jnp.bool_ else logic.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: logic.logical_xor(s, o) if s.dtype == jnp.bool_ else logic.bitwise_xor(s, o)
+
+    # comparisons
+    T.__eq__ = lambda s, o: logic.equal(s, o)
+    T.__ne__ = lambda s, o: logic.not_equal(s, o)
+    T.__lt__ = lambda s, o: logic.less_than(s, o)
+    T.__le__ = lambda s, o: logic.less_equal(s, o)
+    T.__gt__ = lambda s, o: logic.greater_than(s, o)
+    T.__ge__ = lambda s, o: logic.greater_equal(s, o)
+
+    # indexing
+    def _getitem(self, idx):
+        if isinstance(idx, Tensor):
+            idx = idx._data
+        elif isinstance(idx, tuple):
+            idx = tuple(i._data if isinstance(i, Tensor) else i for i in idx)
+        return apply(lambda a: a[idx], self)
+
+    def _setitem(self, idx, value):
+        if isinstance(idx, Tensor):
+            idx = idx._data
+        elif isinstance(idx, tuple):
+            idx = tuple(i._data if isinstance(i, Tensor) else i for i in idx)
+        v = value._data if isinstance(value, Tensor) else value
+        self._data = self._data.at[idx].set(v)
+        self._node = None  # in-place write severs the eager grad graph
+
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+
+    # method aliases for every functional op that takes the tensor first
+    modules = (m, manipulation, logic, search, stat, linalg, creation)
+    skip = {"where"}  # paddle's Tensor.where(x, y) keeps cond-first semantics anyway
+    for mod in modules:
+        for name in dir(mod):
+            if name.startswith("_") or name in ("Tensor", "apply", "nondiff", "raw",
+                                                "unary", "binary", "reduction"):
+                continue
+            fn = getattr(mod, name)
+            if callable(fn) and not hasattr(T, name):
+                setattr(T, name, fn)
+
+    from .einsum import einsum  # noqa: F401
+
+    # in-place variants (mutate _data; sever tape like paddle's inplace ops
+    # do when the var is a leaf)
+    def _make_inplace(fn):
+        def inplace(self, *args, **kwargs):
+            out = fn(self, *args, **kwargs)
+            self._data = out._data
+            self._node = out._node
+            self._out_index = out._out_index
+            return self
+        return inplace
+
+    for base in ("add", "subtract", "multiply", "divide", "clip", "scale",
+                 "floor", "ceil", "exp", "sqrt", "rsqrt", "reciprocal",
+                 "round", "tanh", "squeeze", "unsqueeze", "reshape", "flatten",
+                 "cast"):
+        fn = getattr(T, base, None)
+        if fn is not None:
+            setattr(T, base + "_", _make_inplace(fn))
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        self._node = None
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        self._node = None
+        return self
+
+    T.zero_ = zero_
+    T.fill_ = fill_
+    T.copy_ = lambda self, src: (setattr(self, "_data", jnp.asarray(src._data if isinstance(src, Tensor) else src, self._data.dtype)), self)[1]
+
+
+bind()

@@ -1,0 +1,38 @@
+"""Helpers to define paddle-style ops over jnp with minimal boilerplate."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor, apply, nondiff
+
+
+def unary(jfn, differentiable=True):
+    def op(x, name=None):
+        if differentiable:
+            return apply(jfn, x)
+        return nondiff(jfn, x)
+    op.__name__ = getattr(jfn, "__name__", "op")
+    return op
+
+
+def binary(jfn, differentiable=True):
+    def op(x, y, name=None):
+        if differentiable:
+            return apply(jfn, x, y)
+        return nondiff(jfn, x, y)
+    op.__name__ = getattr(jfn, "__name__", "op")
+    return op
+
+
+def reduction(jfn):
+    """paddle reductions: (x, axis=None, keepdim=False)."""
+    def op(x, axis=None, keepdim=False, name=None):
+        if isinstance(axis, (list, tuple)):
+            axis = tuple(axis)
+        return apply(lambda a: jfn(a, axis=axis, keepdims=keepdim), x)
+    op.__name__ = getattr(jfn, "__name__", "reduce")
+    return op
+
+
+def raw(x):
+    return x._data if isinstance(x, Tensor) else x

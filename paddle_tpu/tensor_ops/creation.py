@@ -1,0 +1,177 @@
+"""Creation ops. Reference: python/paddle/tensor/creation.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..tensor import Tensor, apply, nondiff, to_tensor
+from ._factory import raw
+
+
+def _dt(dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    return d if d is not None else dtype_mod.get_default_dtype()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), dtype=_dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), dtype=_dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = raw(fill_value)
+    if dtype is None:
+        out = jnp.full(_shape(shape), fill_value)
+        if out.dtype == jnp.float64:
+            out = out.astype(dtype_mod.get_default_dtype())
+    else:
+        out = jnp.full(_shape(shape), fill_value, dtype=_dt(dtype))
+    return Tensor(out)
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(raw(x), dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(raw(x), dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(raw(x), raw(fill_value),
+                                dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = raw(start), raw(end), raw(step)
+    if end is None:
+        start, end = 0, start
+    dt = dtype_mod.convert_dtype(dtype)
+    if dt is None:
+        dt = (dtype_mod.get_default_dtype()
+              if any(isinstance(v, float) for v in (start, end, step))
+              else np.dtype(np.int64))
+    return Tensor(jnp.arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(raw(start), raw(stop), int(raw(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(raw(start), raw(stop), int(raw(num)),
+                               base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    arrs = [raw(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*arrs, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        out = jnp.diag(a, k=offset)
+        if padding_value != 0 and a.ndim == 1:
+            n = a.shape[0] + builtins_abs(offset)
+            mask = jnp.eye(n, k=offset, dtype=bool)
+            out = jnp.where(mask, out, padding_value)
+        return out
+    return apply(f, x)
+
+
+builtins_abs = abs
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        n = a.shape[-1] + builtins_abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + (-offset if offset < 0 else 0)
+        c = idx + (offset if offset > 0 else 0)
+        return out.at[..., r, c].set(a)
+    return apply(f, x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def assign(x, output=None):
+    data = raw(x)
+    if output is not None:
+        output._data = jnp.asarray(data)
+        return output
+    return Tensor(jnp.asarray(data))
+
+
+def clone(x, name=None):
+    return apply(lambda a: a + 0, x)
+
+
+def complex(real, imag, name=None):
+    return apply(lambda r, i: r + 1j * i, real, imag)
+
+
+def as_complex(x, name=None):
+    return apply(lambda a: a[..., 0] + 1j * a[..., 1], x)
+
+
+def as_real(x, name=None):
+    return apply(lambda a: jnp.stack([a.real, a.imag], axis=-1), x)
+
+
+def real(x, name=None):
+    return apply(jnp.real, x)
+
+
+def imag(x, name=None):
+    return apply(jnp.imag, x)
+
+
+def polar(abs, angle, name=None):
+    return apply(lambda r, t: r * jnp.exp(1j * t), abs, angle)

@@ -1,0 +1,13 @@
+"""Einsum. Reference: python/paddle/tensor/einsum.py — here a direct
+delegate to jnp.einsum which XLA lowers onto the MXU."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import apply
+
+
+def einsum(equation, *operands, name=None):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply(lambda *xs: jnp.einsum(equation, *xs), *operands)

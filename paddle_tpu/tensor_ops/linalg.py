@@ -1,0 +1,180 @@
+"""Linear algebra. Reference: python/paddle/tensor/linalg.py, linalg.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, apply, nondiff
+from ._factory import raw
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def f(a):
+        if axis is None:
+            flat = a.reshape(-1)
+            if p in ("fro", 2, 2.0):
+                return _maybe_keep(jnp.sqrt(jnp.sum(flat * flat)), a, keepdim)
+            if p == 1:
+                return _maybe_keep(jnp.sum(jnp.abs(flat)), a, keepdim)
+            if p in ("inf", jnp.inf, float("inf")):
+                return _maybe_keep(jnp.max(jnp.abs(flat)), a, keepdim)
+            return _maybe_keep(jnp.sum(jnp.abs(flat) ** p) ** (1.0 / p), a, keepdim)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro" or p == 2:
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+        if p in ("inf", jnp.inf, float("inf")):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p in ("-inf", -jnp.inf, float("-inf")):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return apply(f, x)
+
+
+def _maybe_keep(v, a, keepdim):
+    if keepdim:
+        return v.reshape((1,) * a.ndim)
+    return v
+
+
+def dist(x, y, p=2, name=None):
+    def f(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return apply(f, x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return apply(f, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        Lm = jnp.swapaxes(L, -1, -2).conj() if upper else L
+        z = jax.scipy.linalg.solve_triangular(Lm, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(Lm, -1, -2).conj(), z, lower=False)
+    return apply(f, x, y)
+
+
+def inverse(x, name=None):
+    return apply(jnp.linalg.inv, x)
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x)
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return apply(f, x)
+
+
+def svd(x, full_matrices=False, name=None):
+    out = apply(lambda a: jnp.linalg.svd(a, full_matrices=full_matrices), x,
+                n_outputs=3)
+    u, s, vh = out
+    # paddle returns V^H like numpy? paddle.linalg.svd returns U, S, VH
+    return u, s, vh
+
+
+def qr(x, mode="reduced", name=None):
+    return apply(lambda a: jnp.linalg.qr(a, mode=mode), x, n_outputs=2)
+
+
+def eig(x, name=None):
+    import numpy as np
+    w, v = np.linalg.eig(np.asarray(raw(x)))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigh(a, UPLO=UPLO), x, n_outputs=2)
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    w = np.linalg.eigvals(np.asarray(raw(x)))
+    return Tensor(jnp.asarray(w))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x)
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return nondiff(lambda a: jnp.linalg.matrix_rank(a, tol), x)
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply(f, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    out = apply(f, x, y, n_outputs=4)
+    return out
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(raw(x))
+    outs = (Tensor(lu_), Tensor(piv + 1))
+    if get_infos:
+        return outs + (Tensor(jnp.zeros((), dtype=jnp.int32)),)
+    return outs
+
+
+def multi_dot(x, name=None):
+    return apply(lambda *xs: jnp.linalg.multi_dot(xs), *x)
+
+
+def matrix_transpose(x, name=None):
+    return apply(lambda a: jnp.swapaxes(a, -1, -2), x)
+
+
+def cond(x, p=None, name=None):
+    return nondiff(lambda a: jnp.linalg.cond(a, p=p), x)
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(t.shape[-1]):
+            v = jnp.concatenate([jnp.zeros((i,), a.dtype), jnp.ones((1,), a.dtype), a[i + 1:, i]])
+            q = q - t[i] * (q @ jnp.outer(v, v))
+        return q[:, :n]
+    return apply(f, x, tau)

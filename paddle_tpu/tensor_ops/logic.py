@@ -1,0 +1,70 @@
+"""Logic/comparison ops. Reference: python/paddle/tensor/logic.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, nondiff
+from ._factory import binary, unary, raw
+
+equal = binary(jnp.equal, differentiable=False)
+not_equal = binary(jnp.not_equal, differentiable=False)
+greater_than = binary(jnp.greater, differentiable=False)
+greater_equal = binary(jnp.greater_equal, differentiable=False)
+less_than = binary(jnp.less, differentiable=False)
+less_equal = binary(jnp.less_equal, differentiable=False)
+
+logical_and = binary(jnp.logical_and, differentiable=False)
+logical_or = binary(jnp.logical_or, differentiable=False)
+logical_xor = binary(jnp.logical_xor, differentiable=False)
+logical_not = unary(jnp.logical_not, differentiable=False)
+
+bitwise_and = binary(jnp.bitwise_and, differentiable=False)
+bitwise_or = binary(jnp.bitwise_or, differentiable=False)
+bitwise_xor = binary(jnp.bitwise_xor, differentiable=False)
+bitwise_not = unary(jnp.bitwise_not, differentiable=False)
+bitwise_left_shift = binary(jnp.left_shift, differentiable=False)
+bitwise_right_shift = binary(jnp.right_shift, differentiable=False)
+
+
+def equal_all(x, y, name=None):
+    return nondiff(lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return nondiff(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                             equal_nan=equal_nan), x, y)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return nondiff(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                            equal_nan=equal_nan), x, y)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(raw(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def isreal(x, name=None):
+    return nondiff(jnp.isreal, x)
+
+
+def iscomplex(x, name=None):
+    return Tensor(jnp.asarray(np.iscomplexobj(np.dtype(raw(x).dtype).type(0))))
+
+
+def is_complex(x):
+    return np.dtype(raw(x).dtype).kind == "c"
+
+
+def is_floating_point(x):
+    from ..framework.dtype import is_floating_point_dtype
+    return is_floating_point_dtype(raw(x).dtype)
+
+
+def is_integer(x):
+    return np.dtype(raw(x).dtype).kind in ("i", "u")

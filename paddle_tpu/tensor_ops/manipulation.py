@@ -1,0 +1,405 @@
+"""Shape/layout manipulation ops.
+
+Reference: python/paddle/tensor/manipulation.py. Ops with data-dependent
+output shapes (masked_select, unique, nonzero) are eager-only — inside
+``jit.to_static`` they raise, matching XLA's static-shape model.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, apply, nondiff
+from ._factory import raw
+
+builtins_slice = slice  # captured before the paddle-style `slice` op shadows it
+
+
+def reshape(x, shape, name=None):
+    shape = tuple(int(s) if not isinstance(s, Tensor) else int(s.item())
+                  for s in (shape if isinstance(shape, (list, tuple)) else [shape]))
+    return apply(lambda a: jnp.reshape(a, shape), x)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply(f, x)
+
+
+def transpose(x, perm, name=None):
+    return apply(lambda a: jnp.transpose(a, tuple(perm)), x)
+
+
+def t(x, name=None):
+    def f(a):
+        if a.ndim < 2:
+            return a
+        return jnp.swapaxes(a, -1, -2)
+    return apply(f, x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return apply(lambda a: jnp.swapaxes(a, axis1, axis2), x)
+
+
+def concat(x, axis=0, name=None):
+    axis = int(raw(axis)) if isinstance(axis, Tensor) else axis
+    return apply(lambda *xs: jnp.concatenate(xs, axis=axis), *x)
+
+
+def stack(x, axis=0, name=None):
+    return apply(lambda *xs: jnp.stack(xs, axis=axis), *x)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(raw(axis)) if isinstance(axis, Tensor) else axis
+    def f(a):
+        dim = a.shape[axis]
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=axis))
+        secs = list(num_or_sections)
+        total = dim - builtins_sum(s for s in secs if s != -1)
+        secs = [s if s != -1 else total // max(1, builtins_sum(1 for t_ in secs if t_ == -1)) for s in secs]
+        if builtins_sum(secs) != dim:
+            raise ValueError(
+                f"split sections {num_or_sections} do not sum to dim size "
+                f"{dim} along axis {axis}")
+        idx = np.cumsum(secs)[:-1].tolist()
+        return tuple(jnp.split(a, idx, axis=axis))
+    out = apply(f, x)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+builtins_sum = sum
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def unbind(x, axis=0, name=None):
+    def f(a):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(a, a.shape[axis], axis=axis))
+    return list(apply(f, x))
+
+
+unstack = unbind
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return apply(f, x)
+
+
+def unsqueeze(x, axis, name=None):
+    def f(a):
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        out = a
+        for ax in builtins_sorted(int(raw(v)) if isinstance(v, Tensor) else int(v) for v in axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply(f, x)
+
+
+builtins_sorted = sorted
+
+
+def tile(x, repeat_times, name=None):
+    reps = tuple(int(raw(r)) if isinstance(r, Tensor) else int(r)
+                 for r in (repeat_times if isinstance(repeat_times, (list, tuple)) else [repeat_times]))
+    return apply(lambda a: jnp.tile(a, reps), x)
+
+
+def expand(x, shape, name=None):
+    shape = tuple(int(s) for s in shape)
+    def f(a):
+        tgt = list(shape)
+        off = len(tgt) - a.ndim
+        for i in range(a.ndim):
+            if tgt[off + i] == -1:
+                tgt[off + i] = a.shape[i]
+        return jnp.broadcast_to(a, tuple(tgt))
+    return apply(f, x)
+
+
+def expand_as(x, y, name=None):
+    tgt = tuple(raw(y).shape)
+    return apply(lambda a: jnp.broadcast_to(a, tgt), x)
+
+
+def broadcast_to(x, shape, name=None):
+    return apply(lambda a: jnp.broadcast_to(a, tuple(shape)), x)
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(raw(i).shape) for i in inputs]
+    tgt = np.broadcast_shapes(*shapes)
+    return [apply(lambda a: jnp.broadcast_to(a, tgt), i) for i in inputs]
+
+
+def flip(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply(lambda a: jnp.flip(a, axis=ax), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda a: jnp.roll(a, shifts, axis=axis), x)
+
+
+def gather(x, index, axis=0, name=None):
+    axis_v = int(raw(axis)) if isinstance(axis, Tensor) else axis
+    idx = raw(index)
+    if idx.ndim > 1:
+        idx = idx.reshape(-1)
+    return apply(lambda a: jnp.take(a, idx, axis=axis_v), x)
+
+
+def gather_nd(x, index, name=None):
+    idx = raw(index)
+    def f(a):
+        ii = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[ii]
+    return apply(f, x)
+
+
+def take(x, index, mode="raise", name=None):
+    idx = raw(index).reshape(-1)
+    return apply(lambda a: jnp.take(a.reshape(-1), idx, mode="clip" if mode == "clip" else "wrap" if mode == "wrap" else None), x)
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    idx = raw(indices)
+    return apply(lambda a: jnp.take_along_axis(a, idx, axis=axis), arr)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    idx = raw(indices)
+    def f(a, v):
+        v = jnp.broadcast_to(v, idx.shape).astype(a.dtype)
+        ii = list(jnp.indices(idx.shape))
+        ii[axis] = idx
+        ii = tuple(ii)
+        if reduce == "assign":
+            return a.at[ii].set(v)
+        if reduce == "add":
+            return a.at[ii].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[ii].multiply(v)
+        raise ValueError(reduce)
+    if isinstance(values, (int, float)):
+        import jax.numpy as _j
+        values = Tensor(_j.asarray(values))
+    return apply(f, arr, values)
+
+
+def index_select(x, index, axis=0, name=None):
+    idx = raw(index)
+    return apply(lambda a: jnp.take(a, idx, axis=axis), x)
+
+
+def index_sample(x, index, name=None):
+    idx = raw(index)
+    return apply(lambda a: jnp.take_along_axis(a, idx, axis=1), x)
+
+
+def index_add(x, index, axis, value, name=None):
+    idx = raw(index)
+    def f(a, v):
+        sl = [builtins_slice(None)] * a.ndim
+        sl[axis] = idx
+        return a.at[tuple(sl)].add(v)
+    return apply(f, x, value)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = raw(index)
+    def f(a, u):
+        if overwrite:
+            return a.at[idx].set(u)
+        z = a.at[idx].set(0.0)
+        return z.at[idx].add(u)
+    return apply(f, x, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = raw(index)
+    def f(a, u):
+        ii = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[ii].add(u)
+    return apply(f, x, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    idx = raw(index)
+    def f(u):
+        z = jnp.zeros(tuple(shape), dtype=u.dtype)
+        ii = tuple(jnp.moveaxis(idx, -1, 0))
+        return z.at[ii].add(u)
+    return apply(f, updates)
+
+
+def masked_select(x, mask, name=None):
+    m = np.asarray(raw(mask))
+    return nondiff(lambda a: a[m], x)
+
+
+def masked_fill(x, mask, value, name=None):
+    mk = raw(mask)
+    v = raw(value)
+    return apply(lambda a: jnp.where(mk, jnp.asarray(v, a.dtype), a), x)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    c = raw(condition)
+    return apply(lambda a, b: jnp.where(c, a, b), x, y)
+
+
+def nonzero(x, as_tuple=False):
+    a = np.asarray(raw(x))
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(v, dtype=jnp.int64)) for v in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1), dtype=jnp.int64))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    a = np.asarray(raw(x))
+    out = np.unique(a, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(out, tuple):
+        return Tensor(jnp.asarray(out))
+    return tuple(Tensor(jnp.asarray(o)) for o in out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    a = np.asarray(raw(x)).reshape(-1) if axis is None else np.asarray(raw(x))
+    keep = np.ones(a.shape[0], dtype=bool)
+    keep[1:] = a[1:] != a[:-1] if a.ndim == 1 else np.any(a[1:] != a[:-1], axis=tuple(range(1, a.ndim)))
+    vals = a[keep]
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        cnt = np.diff(np.append(idx, a.shape[0]))
+        outs.append(Tensor(jnp.asarray(cnt)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def cast(x, dtype):
+    from ..framework.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+    return apply(lambda a: a.astype(dt), x)
+
+
+def slice(x, axes, starts, ends, name=None):
+    def f(a):
+        sl = [builtins_slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            sl[ax] = builtins_slice(int(raw(s)) if isinstance(s, Tensor) else s,
+                                    int(raw(e)) if isinstance(e, Tensor) else e)
+        return a[tuple(sl)]
+    return apply(f, x)
+
+
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(a):
+        sl = [builtins_slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sl[ax] = builtins_slice(s, e, st)
+        return a[tuple(sl)]
+    return apply(f, x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = raw(repeats) if isinstance(repeats, Tensor) else repeats
+    def f(a):
+        if axis is None:
+            return jnp.repeat(a.reshape(-1), r)
+        return jnp.repeat(a, r, axis=axis)
+    return apply(f, x)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+    def f(a):
+        in_shard = (a // size) == shard_id
+        return jnp.where(in_shard, a % size, ignore_value)
+    return nondiff(f, input)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    def f(a):
+        offs = offsets if offsets is not None else [0] * a.ndim
+        shp = shape if shape is not None else a.shape
+        sl = tuple(builtins_slice(int(o), int(o) + int(s if s != -1 else a.shape[i] - o))
+                   for i, (o, s) in enumerate(zip(offs, shp)))
+        return a[sl]
+    return apply(f, x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(v) if isinstance(v, (list, tuple)) else v for v in ax)
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    a = np.asarray(raw(x))
+    out = np.lib.stride_tricks.as_strided(
+        a.reshape(-1)[offset:], shape=shape,
+        strides=[s * a.dtype.itemsize for s in stride])
+    return Tensor(jnp.asarray(out.copy()))
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply(jnp.atleast_1d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply(jnp.atleast_2d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply(jnp.atleast_3d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs

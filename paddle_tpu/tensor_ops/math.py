@@ -1,0 +1,279 @@
+"""Math ops. Reference: python/paddle/tensor/math.py, ops.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, apply, nondiff
+from ._factory import unary, binary, reduction, raw
+
+# -- elementwise unary ---------------------------------------------------
+abs = unary(jnp.abs)
+acos = unary(jnp.arccos)
+acosh = unary(jnp.arccosh)
+asin = unary(jnp.arcsin)
+asinh = unary(jnp.arcsinh)
+atan = unary(jnp.arctan)
+atanh = unary(jnp.arctanh)
+ceil = unary(jnp.ceil)
+cos = unary(jnp.cos)
+cosh = unary(jnp.cosh)
+digamma = unary(jax.scipy.special.digamma)
+erf = unary(jax.scipy.special.erf)
+erfinv = unary(jax.scipy.special.erfinv)
+exp = unary(jnp.exp)
+expm1 = unary(jnp.expm1)
+floor = unary(jnp.floor)
+lgamma = unary(jax.scipy.special.gammaln)
+log = unary(jnp.log)
+log10 = unary(jnp.log10)
+log1p = unary(jnp.log1p)
+log2 = unary(jnp.log2)
+neg = unary(jnp.negative)
+reciprocal = unary(jnp.reciprocal)
+round = unary(jnp.round)
+rsqrt = unary(lambda x: jax.lax.rsqrt(x))
+sigmoid = unary(jax.nn.sigmoid)
+sign = unary(jnp.sign)
+sin = unary(jnp.sin)
+sinh = unary(jnp.sinh)
+sqrt = unary(jnp.sqrt)
+square = unary(jnp.square)
+tan = unary(jnp.tan)
+tanh = unary(jnp.tanh)
+trunc = unary(jnp.trunc)
+angle = unary(jnp.angle)
+conj = unary(jnp.conj)
+deg2rad = unary(jnp.deg2rad)
+rad2deg = unary(jnp.rad2deg)
+frac = unary(lambda x: x - jnp.trunc(x))
+i0 = unary(jax.scipy.special.i0)
+i1 = unary(jax.scipy.special.i1)
+
+isfinite = unary(jnp.isfinite, differentiable=False)
+isinf = unary(jnp.isinf, differentiable=False)
+isnan = unary(jnp.isnan, differentiable=False)
+
+# -- elementwise binary --------------------------------------------------
+add = binary(jnp.add)
+subtract = binary(jnp.subtract)
+multiply = binary(jnp.multiply)
+divide = binary(jnp.divide)
+true_divide = divide
+floor_divide = binary(jnp.floor_divide, differentiable=False)
+mod = binary(jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = binary(jnp.power)
+maximum = binary(jnp.maximum)
+minimum = binary(jnp.minimum)
+fmax = binary(jnp.fmax)
+fmin = binary(jnp.fmin)
+atan2 = binary(jnp.arctan2)
+heaviside = binary(jnp.heaviside)
+hypot = binary(lambda x, y: jnp.sqrt(x * x + y * y))
+logaddexp = binary(jnp.logaddexp)
+nextafter = binary(jnp.nextafter, differentiable=False)
+gcd = binary(jnp.gcd, differentiable=False)
+lcm = binary(jnp.lcm, differentiable=False)
+copysign = binary(jnp.copysign)
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = scale, bias
+    if bias_after_scale:
+        out = apply(lambda a: a * s + b, x)
+    else:
+        out = apply(lambda a: (a + b) * s, x)
+    return out
+
+
+def divide_no_nan(x, y, name=None):
+    return apply(lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b)), x, y)
+
+
+def multiplex(inputs, index, name=None):
+    stacked = apply(lambda *xs: jnp.stack(xs, axis=0), *inputs)
+    idx = raw(index).reshape(-1)
+    return apply(lambda s: s[idx, jnp.arange(s.shape[1])], stacked)
+
+
+# -- matmul family -------------------------------------------------------
+def _amp_cast(*arrays):
+    from ..amp.auto_cast import maybe_cast_compute
+    return maybe_cast_compute(*arrays)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        a, b = _amp_cast(a, b)
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply(f, x, y)
+
+
+mm = matmul
+
+
+def bmm(x, y, name=None):
+    return apply(lambda a, b: jnp.matmul(*_amp_cast(a, b)), x, y)
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def inner(x, y, name=None):
+    return apply(jnp.inner, x, y)
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y)
+
+
+def kron(x, y, name=None):
+    return apply(jnp.kron, x, y)
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else None
+    def f(a, b):
+        cax = ax
+        if cax is None:
+            for i, d in enumerate(a.shape):
+                if d == 3:
+                    cax = i
+                    break
+        return jnp.cross(a, b, axis=cax)
+    return apply(f, x, y)
+
+
+# -- reductions ----------------------------------------------------------
+sum = reduction(jnp.sum)
+mean = reduction(jnp.mean)
+prod = reduction(jnp.prod)
+max = reduction(jnp.max)
+min = reduction(jnp.min)
+amax = reduction(jnp.max)
+amin = reduction(jnp.min)
+logsumexp = reduction(jax.scipy.special.logsumexp)
+all = reduction(jnp.all)
+any = reduction(jnp.any)
+
+
+def nansum(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nansum(a, axis=axis, keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanmean(a, axis=axis, keepdims=keepdim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return nondiff(lambda a: jnp.count_nonzero(a, axis=axis, keepdims=keepdim), x)
+
+
+# -- cumulative ----------------------------------------------------------
+def cumsum(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=dtype)
+        return jnp.cumsum(a, axis=axis, dtype=dtype)
+    return apply(f, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def f(a):
+        if dim is None:
+            a = a.reshape(-1)
+            return jnp.cumprod(a, dtype=dtype)
+        return jnp.cumprod(a, axis=dim, dtype=dtype)
+    return apply(f, x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        ax = axis if axis is not None else 0
+        aa = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.maximum, aa, axis=ax)
+        return vals
+    return apply(f, x)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        ax = axis if axis is not None else 0
+        aa = a.reshape(-1) if axis is None else a
+        return jax.lax.associative_scan(jnp.minimum, aa, axis=ax)
+    return apply(f, x)
+
+
+# -- clip / misc ---------------------------------------------------------
+def clip(x, min=None, max=None, name=None):
+    return apply(lambda a: jnp.clip(a, min, max), x)
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, (int, float)):
+        return apply(lambda a, b: a + weight * (b - a), x, y)
+    return apply(lambda a, b, w: a + w * (b - a), x, y, weight)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def logit(x, eps=None, name=None):
+    def f(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a / (1.0 - a))
+    return apply(f, x)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    p = raw(prepend) if prepend is not None else None
+    ap = raw(append) if append is not None else None
+    return apply(lambda a: jnp.diff(a, n=n, axis=axis, prepend=p, append=ap), x)
+
+
+def increment(x, value=1.0, name=None):
+    x._data = x._data + value
+    return x
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def softplus_raw(x):
+    return jax.nn.softplus(x)
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as np
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def f(a):
+        dims = [i for i in range(a.ndim) if i != axis]
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+    return apply(f, x)

@@ -1,0 +1,117 @@
+"""Random ops. Reference: python/paddle/tensor/random.py.
+
+Eager path draws from the process-global key (paddle.seed). The functional
+path (inside jit) should use nn.functional variants with explicit keys; these
+ops raise under trace to avoid silently baking a fixed key into a compiled
+program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtype_mod
+from ..framework.random_seed import next_key
+from ..tensor import Tensor
+from ._factory import raw
+
+
+def _dt(dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    return d if d is not None else dtype_mod.get_default_dtype()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(raw(s)) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), dtype=_dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), _shape(shape), dtype=_dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = raw(mean), raw(std)
+        shp = jnp.broadcast_shapes(getattr(m, "shape", ()), getattr(s, "shape", ()))
+        return Tensor(m + s * jax.random.normal(next_key(), shp))
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(mean + std * jax.random.normal(next_key(), shp,
+                                                 dtype=dtype_mod.get_default_dtype()))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=_dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high,
+                                     dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    a = raw(x)
+    if high is None:
+        low, high = 0, low
+    dt = dtype_mod.convert_dtype(dtype) or a.dtype
+    return Tensor(jax.random.randint(next_key(), a.shape, low, high).astype(dt))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), n).astype(
+        dtype_mod.convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    p = raw(x)
+    return Tensor(jax.random.bernoulli(next_key(), p).astype(p.dtype))
+
+
+def poisson(x, name=None):
+    lam = raw(x)
+    return Tensor(jax.random.poisson(next_key(), lam).astype(lam.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    p = raw(x)
+    logits = jnp.log(jnp.clip(p, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(num_samples,) + p.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1) if p.ndim > 1 else out
+    else:
+        g = -jnp.log(-jnp.log(jax.random.uniform(next_key(), p.shape)))
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def rand_like(x, dtype=None, name=None):
+    a = raw(x)
+    dt = dtype_mod.convert_dtype(dtype) or a.dtype
+    return Tensor(jax.random.uniform(next_key(), a.shape, dtype=dt))
+
+
+def randn_like(x, dtype=None, name=None):
+    a = raw(x)
+    dt = dtype_mod.convert_dtype(dtype) or a.dtype
+    return Tensor(jax.random.normal(next_key(), a.shape, dtype=dt))
+
+
+def normal_like(x, mean=0.0, std=1.0, name=None):
+    a = raw(x)
+    return Tensor(mean + std * jax.random.normal(next_key(), a.shape, dtype=a.dtype))

@@ -1,0 +1,120 @@
+"""Search/sort ops. Reference: python/paddle/tensor/search.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor, apply, nondiff
+from ._factory import raw
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        out = jnp.argmax(a.reshape(-1) if axis is None else a,
+                         axis=None if axis is None else axis)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out
+    return nondiff(f, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        out = jnp.argmin(a.reshape(-1) if axis is None else a,
+                         axis=None if axis is None else axis)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out
+    return nondiff(f, x)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis)
+        return jnp.flip(idx, axis=axis) if descending else idx
+    return nondiff(f, x)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        out = jnp.sort(a, axis=axis)
+        return jnp.flip(out, axis=axis) if descending else out
+    return apply(f, x)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    kk = int(raw(k)) if isinstance(k, Tensor) else int(k)
+    def f(a):
+        ax = axis if axis is not None else -1
+        a_m = jnp.moveaxis(a, ax, -1)
+        src = a_m if largest else -a_m
+        vals, idx = jax.lax.top_k(src, kk)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+    import jax
+    vals, idx = apply(f, x, n_outputs=2)
+    idx = Tensor(idx._data, stop_gradient=True)
+    return vals, idx
+
+
+import jax  # noqa: E402  (used inside topk closure)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        i = jnp.argsort(a, axis=axis)
+        vals = jnp.take(s, k - 1, axis=axis)
+        idx = jnp.take(i, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+    vals, idx = apply(f, x, n_outputs=2)
+    return vals, Tensor(idx._data)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    import numpy as np
+    a = np.asarray(raw(x))
+    ax = axis % a.ndim
+    moved = np.moveaxis(a, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals, idxs = [], []
+    for row in flat:
+        uniq, counts = np.unique(row, return_counts=True)
+        v = uniq[np.argmax(counts)]
+        vals.append(v)
+        idxs.append(int(np.where(row == v)[0][-1]))
+    vs = np.asarray(vals).reshape(moved.shape[:-1])
+    ix = np.asarray(idxs).reshape(moved.shape[:-1])
+    if keepdim:
+        vs = np.expand_dims(vs, ax)
+        ix = np.expand_dims(ix, ax)
+    return Tensor(jnp.asarray(vs)), Tensor(jnp.asarray(ix))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    v = raw(values)
+    return nondiff(lambda a: jnp.searchsorted(a, v, side=side), sorted_sequence)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    seq = raw(sorted_sequence)
+    return nondiff(lambda a: jnp.searchsorted(seq, a, side=side), x)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def f(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+        return h
+    return nondiff(f, input)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    w = raw(weights) if weights is not None else None
+    return nondiff(lambda a: jnp.bincount(a, weights=w, minlength=minlength,
+                                          length=None), x)

@@ -1,0 +1,58 @@
+"""Statistics ops. Reference: python/paddle/tensor/stat.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor, apply, nondiff
+from ._factory import raw
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.median(a, axis=axis, keepdims=keepdim), x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    qq = raw(q)
+    return apply(lambda a: jnp.quantile(a, qq, axis=axis, keepdims=keepdim), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    qq = raw(q)
+    return apply(lambda a: jnp.nanquantile(a, qq, axis=axis, keepdims=keepdim), x)
+
+
+def numel(x, name=None):
+    import numpy as np
+    return Tensor(jnp.asarray(int(np.prod(raw(x).shape)) if raw(x).shape else 1))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = raw(fweights) if fweights is not None else None
+    aw = raw(aweights) if aweights is not None else None
+    return apply(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                                   fweights=fw, aweights=aw), x)

@@ -1,0 +1,4 @@
+"""paddle_tpu.text — NLP model zoo + tokenizer (reference pairing:
+python/paddle/text + PaddleNLP model families named in BASELINE.json)."""
+from . import models  # noqa: F401
+from .tokenizer import BpeTokenizer, WhitespaceTokenizer  # noqa: F401

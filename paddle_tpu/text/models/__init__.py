@@ -1,0 +1,12 @@
+from .bert import (  # noqa: F401
+    BERT_BASE, BERT_LARGE, BERT_TINY, BertConfig, BertForPretraining,
+    BertForSequenceClassification, BertModel,
+)
+from .ernie_moe import (  # noqa: F401
+    ERNIE_MOE_TINY, ErnieMoEConfig, ErnieMoEForPretraining, ErnieMoEModel,
+)
+from .gpt import GPT_TINY, GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
+from .llama import (  # noqa: F401
+    LLAMA2_7B, LLAMA2_13B, LLAMA_TINY, LlamaConfig, LlamaForCausalLM,
+    LlamaModel,
+)

@@ -1,0 +1,150 @@
+"""BERT (baseline config 2: pretraining with MLM+NSP under Fleet DP).
+Reference pairing: PaddleNLP bert/modeling.py on paddle.nn primitives."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ...nn import (
+    Dropout, Embedding, GELU, LayerNorm, Linear, Tanh, TransformerEncoder,
+    TransformerEncoderLayer,
+)
+from ...nn import functional as F
+from ...nn.layer_base import Layer
+from ...tensor import Tensor
+from ...tensor_ops.manipulation import reshape
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+
+BERT_BASE = BertConfig()
+BERT_LARGE = BertConfig(hidden_size=1024, num_hidden_layers=24,
+                        num_attention_heads=16, intermediate_size=4096)
+BERT_TINY = BertConfig(vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+                       num_attention_heads=2, intermediate_size=512,
+                       max_position_embeddings=128)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(c.vocab_size, c.hidden_size)
+        self.position_embeddings = Embedding(c.max_position_embeddings,
+                                             c.hidden_size)
+        self.token_type_embeddings = Embedding(c.type_vocab_size, c.hidden_size)
+        self.layer_norm = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.dropout = Dropout(c.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        l = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(l, dtype=jnp.int32)[None, :])
+        if token_type_ids is None:
+            token_type_ids = Tensor(jnp.zeros((1, l), dtype=jnp.int32))
+        emb = (self.word_embeddings(input_ids) +
+               self.position_embeddings(position_ids) +
+               self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(Layer):
+    def __init__(self, hidden_size):
+        super().__init__()
+        self.dense = Linear(hidden_size, hidden_size)
+        self.activation = Tanh()
+
+    def forward(self, hidden):
+        return self.activation(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig = BERT_BASE):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        enc_layer = TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size, dropout=config.hidden_dropout_prob,
+            activation="gelu",
+            attn_dropout=config.attention_probs_dropout_prob)
+        self.encoder = TransformerEncoder(enc_layer, config.num_hidden_layers)
+        self.pooler = BertPooler(config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        emb = self.embeddings(input_ids, token_type_ids, position_ids)
+        if attention_mask is not None and attention_mask.ndim == 2:
+            m = attention_mask._data[:, None, None, :]
+            attention_mask = Tensor((1.0 - m) * -1e30)
+        seq = self.encoder(emb, attention_mask)
+        pooled = self.pooler(seq)
+        return seq, pooled
+
+
+class BertPretrainingHeads(Layer):
+    def __init__(self, c: BertConfig, embedding_weights=None):
+        super().__init__()
+        self.transform = Linear(c.hidden_size, c.hidden_size)
+        self.activation = GELU()
+        self.layer_norm = LayerNorm(c.hidden_size)
+        self.decoder = Linear(c.hidden_size, c.vocab_size)
+        self.seq_relationship = Linear(c.hidden_size, 2)
+
+    def forward(self, sequence_output, pooled_output):
+        x = self.layer_norm(self.activation(self.transform(sequence_output)))
+        prediction_scores = self.decoder(x)
+        seq_relationship_score = self.seq_relationship(pooled_output)
+        return prediction_scores, seq_relationship_score
+
+
+class BertForPretraining(Layer):
+    def __init__(self, config: BertConfig = BERT_BASE):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.cls = BertPretrainingHeads(config)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_label=None):
+        seq, pooled = self.bert(input_ids, token_type_ids,
+                                attention_mask=attention_mask)
+        pred, rel = self.cls(seq, pooled)
+        if masked_lm_labels is not None:
+            mlm = F.cross_entropy(
+                reshape(pred, (-1, self.config.vocab_size)).astype("float32"),
+                reshape(masked_lm_labels, (-1,)), ignore_index=-100)
+            loss = mlm
+            if next_sentence_label is not None:
+                nsp = F.cross_entropy(rel.astype("float32"),
+                                      reshape(next_sentence_label, (-1,)))
+                loss = mlm + nsp
+            return loss
+        return pred, rel
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig = BERT_BASE, num_classes=2,
+                 dropout=None):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(dropout if dropout is not None
+                               else config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        return self.classifier(self.dropout(pooled))

@@ -1,0 +1,123 @@
+"""ERNIE-MoE style expert-parallel transformer (baseline config 5).
+
+Reference pairing: python/paddle/incubate/distributed/models/moe (c_alltoall
+dispatch). Built on paddle_tpu.nn.moe.MoELayer — the expert axis shards on
+the mesh "ep"/"tp" axis and XLA emits the all-to-all.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ...nn import Dropout, Embedding, LayerNorm, Linear, MoELayer
+from ...nn import functional as F
+from ...nn.layer_base import Layer
+from ...nn.layer.container import LayerList
+from ...tensor import Tensor
+from ...tensor_ops.manipulation import reshape, split
+
+
+@dataclass
+class ErnieMoEConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    num_experts: int = 8
+    moe_every: int = 2  # every Nth layer is MoE
+    top_k: int = 2
+    max_position_embeddings: int = 512
+    dropout: float = 0.1
+    aux_loss_weight: float = 0.01
+
+
+ERNIE_MOE_TINY = ErnieMoEConfig(vocab_size=1024, hidden_size=128,
+                                num_hidden_layers=2, num_attention_heads=4,
+                                intermediate_size=256, num_experts=4,
+                                max_position_embeddings=128)
+
+
+class MoEBlock(Layer):
+    def __init__(self, c: ErnieMoEConfig, use_moe: bool):
+        super().__init__()
+        self.ln_1 = LayerNorm(c.hidden_size)
+        self.num_heads = c.num_attention_heads
+        self.head_dim = c.hidden_size // c.num_attention_heads
+        self.qkv = Linear(c.hidden_size, 3 * c.hidden_size)
+        self.proj = Linear(c.hidden_size, c.hidden_size)
+        self.ln_2 = LayerNorm(c.hidden_size)
+        self.use_moe = use_moe
+        if use_moe:
+            self.moe = MoELayer(c.hidden_size, c.intermediate_size,
+                                c.num_experts, k=c.top_k)
+        else:
+            self.fc1 = Linear(c.hidden_size, c.intermediate_size)
+            self.fc2 = Linear(c.intermediate_size, c.hidden_size)
+        self.drop = Dropout(c.dropout)
+
+    def forward(self, x):
+        b, l, h = x.shape
+        q, k, v = split(self.qkv(self.ln_1(x)), 3, axis=-1)
+        q = reshape(q, (b, l, self.num_heads, self.head_dim))
+        k = reshape(k, (b, l, self.num_heads, self.head_dim))
+        v = reshape(v, (b, l, self.num_heads, self.head_dim))
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=False)
+        x = x + self.drop(self.proj(reshape(attn, (b, l, h))))
+        y = self.ln_2(x)
+        if self.use_moe:
+            x = x + self.drop(self.moe(y))
+        else:
+            x = x + self.drop(self.fc2(F.gelu(self.fc1(y))))
+        return x
+
+
+class ErnieMoEModel(Layer):
+    def __init__(self, config: ErnieMoEConfig = ErnieMoEConfig()):
+        super().__init__()
+        self.config = config
+        self.word_emb = Embedding(config.vocab_size, config.hidden_size)
+        self.pos_emb = Embedding(config.max_position_embeddings,
+                                 config.hidden_size)
+        self.blocks = LayerList([
+            MoEBlock(config, use_moe=(i % config.moe_every == config.moe_every - 1))
+            for i in range(config.num_hidden_layers)])
+        self.ln_f = LayerNorm(config.hidden_size)
+
+    def forward(self, input_ids):
+        l = input_ids.shape[1]
+        pos = Tensor(jnp.arange(l, dtype=jnp.int32)[None, :])
+        x = self.word_emb(input_ids) + self.pos_emb(pos)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+    def aux_loss(self):
+        total = None
+        for blk in self.blocks:
+            if blk.use_moe and blk.moe.aux_loss is not None:
+                total = blk.moe.aux_loss if total is None else total + blk.moe.aux_loss
+        return total
+
+
+class ErnieMoEForPretraining(Layer):
+    def __init__(self, config: ErnieMoEConfig = ErnieMoEConfig()):
+        super().__init__()
+        self.config = config
+        self.ernie = ErnieMoEModel(config)
+        self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                              bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.ernie(input_ids)
+        logits = self.lm_head(hidden)
+        if labels is not None:
+            loss = F.cross_entropy(
+                reshape(logits, (-1, self.config.vocab_size)).astype("float32"),
+                reshape(labels, (-1,)), ignore_index=-100)
+            aux = self.ernie.aux_loss()
+            if aux is not None:
+                loss = loss + self.config.aux_loss_weight * aux
+            return loss
+        return logits

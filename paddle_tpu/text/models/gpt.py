@@ -1,0 +1,99 @@
+"""GPT decoder LM (fleet example family in the reference; PaddleNLP gpt)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ...nn import Dropout, Embedding, LayerNorm, Linear
+from ...nn import functional as F
+from ...nn.layer_base import Layer
+from ...nn.layer.container import LayerList
+from ...tensor import Tensor
+from ...tensor_ops.manipulation import reshape
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    dropout: float = 0.1
+
+
+GPT_TINY = GPTConfig(vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=512,
+                     max_position_embeddings=128)
+
+
+class GPTBlock(Layer):
+    def __init__(self, c: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(c.hidden_size)
+        self.num_heads = c.num_attention_heads
+        self.head_dim = c.hidden_size // c.num_attention_heads
+        self.qkv = Linear(c.hidden_size, 3 * c.hidden_size)
+        self.proj = Linear(c.hidden_size, c.hidden_size)
+        self.qkv.weight.pspec = P(None, "tp")
+        self.proj.weight.pspec = P("tp", None)
+        self.ln_2 = LayerNorm(c.hidden_size)
+        self.fc1 = Linear(c.hidden_size, c.intermediate_size)
+        self.fc2 = Linear(c.intermediate_size, c.hidden_size)
+        self.fc1.weight.pspec = P(None, "tp")
+        self.fc2.weight.pspec = P("tp", None)
+        self.drop = Dropout(c.dropout)
+
+    def forward(self, x):
+        b, l, h = x.shape
+        qkv = self.qkv(self.ln_1(x))
+        from ...tensor_ops.manipulation import split
+        q, k, v = split(qkv, 3, axis=-1)
+        q = reshape(q, (b, l, self.num_heads, self.head_dim))
+        k = reshape(k, (b, l, self.num_heads, self.head_dim))
+        v = reshape(v, (b, l, self.num_heads, self.head_dim))
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        x = x + self.drop(self.proj(reshape(attn, (b, l, h))))
+        x = x + self.drop(self.fc2(F.gelu(self.fc1(self.ln_2(x)))))
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig = GPTConfig()):
+        super().__init__()
+        self.config = config
+        self.wte = Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = Embedding(config.max_position_embeddings, config.hidden_size)
+        self.drop = Dropout(config.dropout)
+        self.blocks = LayerList([GPTBlock(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.ln_f = LayerNorm(config.hidden_size)
+
+    def forward(self, input_ids):
+        l = input_ids.shape[1]
+        pos = Tensor(jnp.arange(l, dtype=jnp.int32)[None, :])
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig = GPTConfig()):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                              bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.gpt(input_ids)
+        logits = self.lm_head(hidden)
+        if labels is not None:
+            return F.cross_entropy(
+                reshape(logits, (-1, self.config.vocab_size)).astype("float32"),
+                reshape(labels, (-1,)))
+        return logits

@@ -1,0 +1,238 @@
+"""Llama-2 family (baseline config 4: Fleet sharding-stage3 pretraining).
+
+Reference pairing: PaddleNLP llama (modeling.py) driven by the reference's
+fleet meta_parallel layers. TPU-first choices:
+- bf16 params by default, fp32 RMSNorm accumulation
+- rotary embedding applied in one fused elementwise block (XLA fuses)
+- attention through F.scaled_dot_product_attention → pallas flash kernel
+- TP pspecs annotated Megatron-style on qkv/out/mlp weights
+- optional remat (jax.checkpoint) per decoder layer for long sequences
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ...nn import Embedding, Linear, RMSNorm
+from ...nn import functional as F
+from ...nn.layer_base import Layer
+from ...nn.layer.container import LayerList
+from ...tensor import Tensor, apply
+from ...tensor_ops.manipulation import concat, reshape, transpose
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "bfloat16"
+    remat: bool = False
+
+
+LLAMA2_7B = LlamaConfig()
+LLAMA2_13B = LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                         num_hidden_layers=40, num_attention_heads=40,
+                         num_key_value_heads=40)
+LLAMA_TINY = LlamaConfig(vocab_size=1024, hidden_size=256,
+                         intermediate_size=688, num_hidden_layers=2,
+                         num_attention_heads=8, num_key_value_heads=4,
+                         max_position_embeddings=512)
+
+
+def _rope(q, k, positions, theta, dtype):
+    """Apply rotary embedding to q, k: [B, L, H, D]."""
+    d = q.shape[-1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = positions[:, None].astype(jnp.float32) * inv_freq[None, :]  # [L, D/2]
+    cos = jnp.cos(freqs)[None, :, None, :]
+    sin = jnp.sin(freqs)[None, :, None, :]
+
+    def rot(x):
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                              axis=-1)
+        return out.astype(dtype)
+
+    return rot(q), rot(k)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_attention_heads
+        self.num_kv_heads = c.num_key_value_heads
+        self.head_dim = c.hidden_size // c.num_attention_heads
+        self.theta = c.rope_theta
+        self.dtype = c.dtype
+        h = c.hidden_size
+        kv = self.num_kv_heads * self.head_dim
+        self.q_proj = Linear(h, h, bias_attr=False)
+        self.k_proj = Linear(h, kv, bias_attr=False)
+        self.v_proj = Linear(h, kv, bias_attr=False)
+        self.o_proj = Linear(h, h, bias_attr=False)
+        # Megatron TP: split heads (output dim) on q/k/v, input dim on o
+        self.q_proj.weight.pspec = P(None, "tp")
+        self.k_proj.weight.pspec = P(None, "tp")
+        self.v_proj.weight.pspec = P(None, "tp")
+        self.o_proj.weight.pspec = P("tp", None)
+
+    def forward(self, x, position_ids=None, cache=None):
+        b, l, h = x.shape
+        q = reshape(self.q_proj(x), (b, l, self.num_heads, self.head_dim))
+        k = reshape(self.k_proj(x), (b, l, self.num_kv_heads, self.head_dim))
+        v = reshape(self.v_proj(x), (b, l, self.num_kv_heads, self.head_dim))
+
+        offset = 0 if cache is None else cache[0].shape[1]
+        pos = jnp.arange(offset, offset + l)
+        if position_ids is not None:
+            pos = position_ids._data.reshape(-1)
+        theta, dtype = self.theta, q.dtype
+
+        def rope_fn(qq, kk):
+            return _rope(qq, kk, pos, theta, qq.dtype)
+
+        q, k = apply(rope_fn, q, k, n_outputs=2)
+
+        new_cache = None
+        if cache is not None:
+            k = concat([cache[0], k], axis=1)
+            v = concat([cache[1], v], axis=1)
+            new_cache = (k.detach(), v.detach())
+
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = self.o_proj(reshape(out, (b, l, h)))
+        return (out, new_cache) if cache is not None else out
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, ff = config.hidden_size, config.intermediate_size
+        self.gate_proj = Linear(h, ff, bias_attr=False)
+        self.up_proj = Linear(h, ff, bias_attr=False)
+        self.down_proj = Linear(ff, h, bias_attr=False)
+        self.gate_proj.weight.pspec = P(None, "tp")
+        self.up_proj.weight.pspec = P(None, "tp")
+        self.down_proj.weight.pspec = P("tp", None)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, position_ids=None, cache=None):
+        if cache is not None:
+            attn_out, new_cache = self.self_attn(
+                self.input_layernorm(x), position_ids, cache)
+            x = x + attn_out
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, new_cache
+        x = x + self.self_attn(self.input_layernorm(x), position_ids)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
+        self.embed_tokens.weight.pspec = P("tp", None)
+        self.layers = LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        if config.dtype == "bfloat16":
+            self.to(dtype="bfloat16")
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        x = self.embed_tokens(input_ids)
+        new_caches = [] if caches is not None else None
+        for i, layer in enumerate(self.layers):
+            if caches is not None:
+                x, c = layer(x, position_ids, caches[i])
+                new_caches.append(c)
+            elif self.config.remat:
+                x = _remat_layer(layer, x, position_ids)
+            else:
+                x = layer(x, position_ids)
+        x = self.norm(x)
+        return (x, new_caches) if caches is not None else x
+
+
+def _remat_layer(layer, x, position_ids):
+    """jax.checkpoint over one decoder layer (activation recompute; the
+    reference's recompute_configs analog)."""
+    params = [p for _, p in sorted(layer.named_parameters())]
+    names = [n for n, _ in sorted(layer.named_parameters())]
+
+    def f(xraw, *praw):
+        saved = [p._data for p in params]
+        try:
+            for p, r in zip(params, praw):
+                p._data = r
+            out = layer(Tensor(xraw, stop_gradient=False), position_ids)
+            return out._data if isinstance(out, Tensor) else out
+        finally:
+            for p, s in zip(params, saved):
+                p._data = s
+
+    ck = jax.checkpoint(f)
+    return apply(ck, x, *params)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                              bias_attr=False)
+        self.lm_head.weight.pspec = P(None, "tp")
+        if config.dtype == "bfloat16":
+            self.lm_head.to(dtype="bfloat16")
+        if config.tie_word_embeddings:
+            self.lm_head.weight = self.llama.embed_tokens.weight
+
+    def forward(self, input_ids, position_ids=None, labels=None, caches=None):
+        if caches is not None:
+            hidden, new_caches = self.llama(input_ids, position_ids, caches)
+            logits = self.lm_head(hidden)
+            return logits, new_caches
+        hidden = self.llama(input_ids, position_ids)
+        logits = self.lm_head(hidden)
+        if labels is not None:
+            loss = F.cross_entropy(
+                reshape(logits, (-1, self.config.vocab_size)).astype("float32"),
+                reshape(labels, (-1,)))
+            return loss
+        return logits
+
+    def init_cache(self, batch_size):
+        c = self.config
+        kv = c.num_key_value_heads
+        hd = c.hidden_size // c.num_attention_heads
+        dt = jnp.bfloat16 if c.dtype == "bfloat16" else jnp.float32
+        return [(Tensor(jnp.zeros((batch_size, 0, kv, hd), dtype=dt)),
+                 Tensor(jnp.zeros((batch_size, 0, kv, hd), dtype=dt)))
+                for _ in range(c.num_hidden_layers)]

@@ -1,0 +1,97 @@
+"""Tokenizers (reference pairing: PaddleNLP tokenizers; file-gated vocab).
+
+BpeTokenizer loads a byte-BPE vocab/merges from local files (GPT-2 format).
+WhitespaceTokenizer is the dependency-free fallback used in tests.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+
+class WhitespaceTokenizer:
+    def __init__(self, vocab: Optional[Dict[str, int]] = None, unk_token="<unk>"):
+        self.vocab = vocab or {}
+        self.unk_token = unk_token
+        self.inv = {v: k for k, v in self.vocab.items()}
+
+    def build_vocab(self, texts: List[str], max_size: int = 30000):
+        from collections import Counter
+        counts = Counter()
+        for t in texts:
+            counts.update(t.split())
+        self.vocab = {"<pad>": 0, "<unk>": 1, "<s>": 2, "</s>": 3}
+        for tok, _ in counts.most_common(max_size - len(self.vocab)):
+            self.vocab[tok] = len(self.vocab)
+        self.inv = {v: k for k, v in self.vocab.items()}
+        return self
+
+    def encode(self, text: str) -> List[int]:
+        unk = self.vocab.get(self.unk_token, 1)
+        return [self.vocab.get(t, unk) for t in text.split()]
+
+    def decode(self, ids: List[int]) -> str:
+        return " ".join(self.inv.get(i, self.unk_token) for i in ids)
+
+    @property
+    def vocab_size(self):
+        return len(self.vocab)
+
+
+class BpeTokenizer:
+    """GPT-2-style byte-level BPE from local vocab.json + merges.txt."""
+
+    def __init__(self, vocab_file: str, merges_file: str):
+        if not (os.path.exists(vocab_file) and os.path.exists(merges_file)):
+            raise FileNotFoundError(
+                "BPE vocab files not found; use WhitespaceTokenizer or place "
+                "vocab.json/merges.txt locally")
+        with open(vocab_file) as f:
+            self.encoder = json.load(f)
+        self.decoder = {v: k for k, v in self.encoder.items()}
+        with open(merges_file) as f:
+            merges = [tuple(l.split()) for l in f.read().split("\n")
+                      if l and not l.startswith("#")]
+        self.bpe_ranks = {m: i for i, m in enumerate(merges)}
+        self.cache = {}
+
+    def _bpe(self, token: str) -> str:
+        if token in self.cache:
+            return self.cache[token]
+        word = tuple(token)
+        while len(word) > 1:
+            pairs = {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+            best = min(pairs, key=lambda p: self.bpe_ranks.get(p, 1e18))
+            if best not in self.bpe_ranks:
+                break
+            first, second = best
+            new_word = []
+            i = 0
+            while i < len(word):
+                if (i < len(word) - 1 and word[i] == first
+                        and word[i + 1] == second):
+                    new_word.append(first + second)
+                    i += 2
+                else:
+                    new_word.append(word[i])
+                    i += 1
+            word = tuple(new_word)
+        out = " ".join(word)
+        self.cache[token] = out
+        return out
+
+    def encode(self, text: str) -> List[int]:
+        ids = []
+        for tok in text.split(" "):
+            for piece in self._bpe(tok).split(" "):
+                if piece in self.encoder:
+                    ids.append(self.encoder[piece])
+        return ids
+
+    def decode(self, ids: List[int]) -> str:
+        return "".join(self.decoder.get(i, "") for i in ids)
+
+    @property
+    def vocab_size(self):
+        return len(self.encoder)

@@ -1,0 +1,11 @@
+from . import unique_name  # noqa: F401
+from .watchdog import TrainingWatchdog  # noqa: F401
+from .trace import TraceLogger, get_tracer  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None

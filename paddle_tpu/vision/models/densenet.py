@@ -1,0 +1,96 @@
+"""DenseNet. Reference: python/paddle/vision/models/densenet.py."""
+from __future__ import annotations
+
+from ...nn import (
+    AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D, Linear, MaxPool2D,
+    ReLU, Sequential,
+)
+from ...nn.layer_base import Layer
+from ...tensor_ops.manipulation import concat, flatten
+
+_CFG = {121: (64, 32, [6, 12, 24, 16]), 161: (96, 48, [6, 12, 36, 24]),
+        169: (64, 32, [6, 12, 32, 32]), 201: (64, 32, [6, 12, 48, 32]),
+        264: (64, 32, [6, 12, 64, 48])}
+
+
+class DenseLayer(Layer):
+    def __init__(self, in_c, growth_rate, bn_size):
+        super().__init__()
+        self.bn1 = BatchNorm2D(in_c)
+        self.relu = ReLU()
+        self.conv1 = Conv2D(in_c, bn_size * growth_rate, 1, bias_attr=False)
+        self.bn2 = BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1,
+                            bias_attr=False)
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        return concat([x, out], axis=1)
+
+
+class DenseBlock(Sequential):
+    def __init__(self, n, in_c, growth_rate, bn_size):
+        layers = []
+        for i in range(n):
+            layers.append(DenseLayer(in_c + i * growth_rate, growth_rate,
+                                     bn_size))
+        super().__init__(*layers)
+
+
+class Transition(Sequential):
+    def __init__(self, in_c, out_c):
+        super().__init__(BatchNorm2D(in_c), ReLU(),
+                         Conv2D(in_c, out_c, 1, bias_attr=False),
+                         AvgPool2D(2, 2))
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        num_init, growth, block_cfg = _CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
+            BatchNorm2D(num_init), ReLU(), MaxPool2D(3, 2, padding=1))
+        blocks = []
+        ch = num_init
+        for i, n in enumerate(block_cfg):
+            blocks.append(DenseBlock(n, ch, growth, bn_size))
+            ch += n * growth
+            if i != len(block_cfg) - 1:
+                blocks.append(Transition(ch, ch // 2))
+                ch //= 2
+        self.blocks = Sequential(*blocks)
+        self.bn_last = BatchNorm2D(ch)
+        self.relu_last = ReLU()
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.relu_last(self.bn_last(self.blocks(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(201, **kwargs)

@@ -1,0 +1,68 @@
+"""GoogLeNet (Inception v1). Reference: python/paddle/vision/models/googlenet.py."""
+from __future__ import annotations
+
+from ...nn import (
+    AdaptiveAvgPool2D, AvgPool2D, Conv2D, Dropout, Linear, MaxPool2D, ReLU,
+    Sequential, Softmax,
+)
+from ...nn.layer_base import Layer
+from ...tensor_ops.manipulation import concat, flatten
+
+
+class ConvReLU(Sequential):
+    def __init__(self, in_c, out_c, k, **kw):
+        super().__init__(Conv2D(in_c, out_c, k, **kw), ReLU())
+
+
+class Inception(Layer):
+    def __init__(self, in_c, c1, c2r, c2, c3r, c3, c4):
+        super().__init__()
+        self.b1 = ConvReLU(in_c, c1, 1)
+        self.b2 = Sequential(ConvReLU(in_c, c2r, 1), ConvReLU(c2r, c2, 3, padding=1))
+        self.b3 = Sequential(ConvReLU(in_c, c3r, 1), ConvReLU(c3r, c3, 5, padding=2))
+        self.b4 = Sequential(MaxPool2D(3, 1, padding=1), ConvReLU(in_c, c4, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class GoogLeNet(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            ConvReLU(3, 64, 7, stride=2, padding=3), MaxPool2D(3, 2, padding=1),
+            ConvReLU(64, 64, 1), ConvReLU(64, 192, 3, padding=1),
+            MaxPool2D(3, 2, padding=1))
+        self.i3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, 2, padding=1)
+        self.i4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, 2, padding=1)
+        self.i5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool5 = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = Dropout(0.2)
+            self.fc = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.pool4(self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(x))))))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

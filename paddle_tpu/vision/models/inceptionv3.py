@@ -1,0 +1,125 @@
+"""InceptionV3 (compact). Reference: python/paddle/vision/models/inceptionv3.py."""
+from __future__ import annotations
+
+from ...nn import (
+    AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D, Dropout, Linear,
+    MaxPool2D, ReLU, Sequential,
+)
+from ...nn.layer_base import Layer
+from ...tensor_ops.manipulation import concat, flatten
+
+
+def _cbr(in_c, out_c, k, **kw):
+    return Sequential(Conv2D(in_c, out_c, k, bias_attr=False, **kw),
+                      BatchNorm2D(out_c), ReLU())
+
+
+class InceptionA(Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _cbr(in_c, 64, 1)
+        self.b5 = Sequential(_cbr(in_c, 48, 1), _cbr(48, 64, 5, padding=2))
+        self.b3 = Sequential(_cbr(in_c, 64, 1), _cbr(64, 96, 3, padding=1),
+                             _cbr(96, 96, 3, padding=1))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1), _cbr(in_c, pool_c, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)], axis=1)
+
+
+class InceptionB(Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _cbr(in_c, 384, 3, stride=2)
+        self.b3d = Sequential(_cbr(in_c, 64, 1), _cbr(64, 96, 3, padding=1),
+                              _cbr(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class InceptionC(Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _cbr(in_c, 192, 1)
+        self.b7 = Sequential(_cbr(in_c, c7, 1),
+                             _cbr(c7, c7, (1, 7), padding=(0, 3)),
+                             _cbr(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = Sequential(_cbr(in_c, c7, 1),
+                              _cbr(c7, c7, (7, 1), padding=(3, 0)),
+                              _cbr(c7, c7, (1, 7), padding=(0, 3)),
+                              _cbr(c7, c7, (7, 1), padding=(3, 0)),
+                              _cbr(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1), _cbr(in_c, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)], axis=1)
+
+
+class InceptionD(Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = Sequential(_cbr(in_c, 192, 1), _cbr(192, 320, 3, stride=2))
+        self.b7 = Sequential(_cbr(in_c, 192, 1),
+                             _cbr(192, 192, (1, 7), padding=(0, 3)),
+                             _cbr(192, 192, (7, 1), padding=(3, 0)),
+                             _cbr(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class InceptionE(Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _cbr(in_c, 320, 1)
+        self.b3_1 = _cbr(in_c, 384, 1)
+        self.b3_2a = _cbr(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = _cbr(384, 384, (3, 1), padding=(1, 0))
+        self.bd_1 = Sequential(_cbr(in_c, 448, 1), _cbr(448, 384, 3, padding=1))
+        self.bd_2a = _cbr(384, 384, (1, 3), padding=(0, 1))
+        self.bd_2b = _cbr(384, 384, (3, 1), padding=(1, 0))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1), _cbr(in_c, 192, 1))
+
+    def forward(self, x):
+        a = self.b3_1(x)
+        b = self.bd_1(x)
+        return concat([self.b1(x),
+                       concat([self.b3_2a(a), self.b3_2b(a)], axis=1),
+                       concat([self.bd_2a(b), self.bd_2b(b)], axis=1),
+                       self.bp(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            _cbr(3, 32, 3, stride=2), _cbr(32, 32, 3), _cbr(32, 64, 3, padding=1),
+            MaxPool2D(3, 2), _cbr(64, 80, 1), _cbr(80, 192, 3), MaxPool2D(3, 2))
+        self.blocks = Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160), InceptionC(768, 160),
+            InceptionC(768, 192), InceptionD(768),
+            InceptionE(1280), InceptionE(2048))
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = Dropout(0.5)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

@@ -1,0 +1,225 @@
+"""MobileNet V1/V2/V3. Reference: python/paddle/vision/models/
+mobilenetv{1,2,3}.py."""
+from __future__ import annotations
+
+from ...nn import (
+    AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout, Hardsigmoid, Hardswish,
+    Linear, ReLU, ReLU6, Sequential, Sigmoid,
+)
+from ...nn.layer_base import Layer
+from ...tensor_ops.manipulation import flatten
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNReLU(Sequential):
+    def __init__(self, in_c, out_c, kernel=3, stride=1, groups=1,
+                 act=ReLU6):
+        padding = (kernel - 1) // 2
+        layers = [Conv2D(in_c, out_c, kernel, stride=stride, padding=padding,
+                         groups=groups, bias_attr=False),
+                  BatchNorm2D(out_c)]
+        if act is not None:
+            layers.append(act())
+        super().__init__(*layers)
+
+
+class DepthwiseSeparable(Sequential):
+    def __init__(self, in_c, out_c1, out_c2, stride, scale):
+        c1 = int(out_c1 * scale)
+        c2 = int(out_c2 * scale)
+        super().__init__(
+            ConvBNReLU(in_c, c1, 3, stride=stride, groups=in_c, act=ReLU),
+            ConvBNReLU(c1, c2, 1, act=ReLU))
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        s = scale
+        self.conv1 = ConvBNReLU(3, int(32 * s), 3, stride=2, act=ReLU)
+        cfg = [(32, 32, 64, 1), (64, 64, 128, 2), (128, 128, 128, 1),
+               (128, 128, 256, 2), (256, 256, 256, 1), (256, 256, 512, 2),
+               (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 512, 1),
+               (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 1024, 2),
+               (1024, 1024, 1024, 1)]
+        blocks = []
+        for in_c, c1, c2, stride in cfg:
+            blocks.append(DepthwiseSeparable(int(in_c * s), c1, c2, stride, s))
+        self.blocks = Sequential(*blocks)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(int(1024 * s), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+class InvertedResidual(Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        self.stride = stride
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNReLU(inp, hidden, 1))
+        layers.extend([
+            ConvBNReLU(hidden, hidden, 3, stride=stride, groups=hidden),
+            Conv2D(hidden, oup, 1, bias_attr=False),
+            BatchNorm2D(oup)])
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        input_channel = _make_divisible(32 * scale)
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        features = [ConvBNReLU(3, input_channel, stride=2)]
+        for t, c, n, s in cfg:
+            out_c = _make_divisible(c * scale)
+            for i in range(n):
+                features.append(InvertedResidual(
+                    input_channel, out_c, s if i == 0 else 1, t))
+                input_channel = out_c
+        self.last_channel = _make_divisible(1280 * max(1.0, scale))
+        features.append(ConvBNReLU(input_channel, self.last_channel, 1))
+        self.features = Sequential(*features)
+        if with_pool:
+            self.pool2d_avg = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Sequential(Dropout(0.2),
+                                         Linear(self.last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+class SqueezeExcitation(Layer):
+    def __init__(self, channel, reduction=4):
+        super().__init__()
+        mid = _make_divisible(channel // reduction)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc1 = Conv2D(channel, mid, 1)
+        self.relu = ReLU()
+        self.fc2 = Conv2D(mid, channel, 1)
+        self.hsig = Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class InvertedResidualV3(Layer):
+    def __init__(self, inp, hidden, out, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == out
+        layers = []
+        if hidden != inp:
+            layers.append(ConvBNReLU(inp, hidden, 1, act=act))
+        layers.append(ConvBNReLU(hidden, hidden, kernel, stride=stride,
+                                 groups=hidden, act=act))
+        if use_se:
+            layers.append(SqueezeExcitation(hidden))
+        layers.append(Conv2D(hidden, out, 1, bias_attr=False))
+        layers.append(BatchNorm2D(out))
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_V3_LARGE = [
+    # k, exp, out, se, act, s
+    (3, 16, 16, False, ReLU, 1), (3, 64, 24, False, ReLU, 2),
+    (3, 72, 24, False, ReLU, 1), (5, 72, 40, True, ReLU, 2),
+    (5, 120, 40, True, ReLU, 1), (5, 120, 40, True, ReLU, 1),
+    (3, 240, 80, False, Hardswish, 2), (3, 200, 80, False, Hardswish, 1),
+    (3, 184, 80, False, Hardswish, 1), (3, 184, 80, False, Hardswish, 1),
+    (3, 480, 112, True, Hardswish, 1), (3, 672, 112, True, Hardswish, 1),
+    (5, 672, 160, True, Hardswish, 2), (5, 960, 160, True, Hardswish, 1),
+    (5, 960, 160, True, Hardswish, 1)]
+
+_V3_SMALL = [
+    (3, 16, 16, True, ReLU, 2), (3, 72, 24, False, ReLU, 2),
+    (3, 88, 24, False, ReLU, 1), (5, 96, 40, True, Hardswish, 2),
+    (5, 240, 40, True, Hardswish, 1), (5, 240, 40, True, Hardswish, 1),
+    (5, 120, 48, True, Hardswish, 1), (5, 144, 48, True, Hardswish, 1),
+    (5, 288, 96, True, Hardswish, 2), (5, 576, 96, True, Hardswish, 1),
+    (5, 576, 96, True, Hardswish, 1)]
+
+
+class MobileNetV3(Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _make_divisible(16 * scale)
+        layers = [ConvBNReLU(3, in_c, 3, stride=2, act=Hardswish)]
+        for k, exp, out, se, act, s in config:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            layers.append(InvertedResidualV3(in_c, exp_c, out_c, k, s, se, act))
+            in_c = out_c
+        last_conv = _make_divisible(6 * in_c)
+        layers.append(ConvBNReLU(in_c, last_conv, 1, act=Hardswish))
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(last_conv, last_channel), Hardswish(), Dropout(0.2),
+                Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3(_V3_LARGE, 1280, scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3(_V3_SMALL, 1024, scale=scale, **kwargs)

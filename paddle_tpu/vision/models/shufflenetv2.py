@@ -1,0 +1,102 @@
+"""ShuffleNetV2. Reference: python/paddle/vision/models/shufflenetv2.py."""
+from __future__ import annotations
+
+from ...nn import (
+    AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Linear, MaxPool2D, ReLU,
+    Sequential,
+)
+from ...nn.functional import channel_shuffle
+from ...nn.layer_base import Layer
+from ...tensor_ops.manipulation import concat, flatten, split
+
+_CFG = {"0.25": [24, 24, 48, 96, 512], "0.33": [24, 32, 64, 128, 512],
+        "0.5": [24, 48, 96, 192, 1024], "1.0": [24, 116, 232, 464, 1024],
+        "1.5": [24, 176, 352, 704, 1024], "2.0": [24, 244, 488, 976, 2048]}
+
+
+def _conv_bn(in_c, out_c, k, stride=1, groups=1, act=True):
+    layers = [Conv2D(in_c, out_c, k, stride=stride, padding=k // 2,
+                     groups=groups, bias_attr=False), BatchNorm2D(out_c)]
+    if act:
+        layers.append(ReLU())
+    return Sequential(*layers)
+
+
+class InvertedResidual(Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch = out_c // 2
+        if stride == 1:
+            self.branch2 = Sequential(
+                _conv_bn(branch, branch, 1),
+                _conv_bn(branch, branch, 3, stride, groups=branch, act=False),
+                _conv_bn(branch, branch, 1))
+        else:
+            self.branch1 = Sequential(
+                _conv_bn(in_c, in_c, 3, stride, groups=in_c, act=False),
+                _conv_bn(in_c, branch, 1))
+            self.branch2 = Sequential(
+                _conv_bn(in_c, branch, 1),
+                _conv_bn(branch, branch, 3, stride, groups=branch, act=False),
+                _conv_bn(branch, branch, 1))
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = _CFG[str(scale)]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = _conv_bn(3, cfg[0], 3, stride=2)
+        self.maxpool = MaxPool2D(3, 2, padding=1)
+        stages = []
+        in_c = cfg[0]
+        for out_c, repeat in zip(cfg[1:4], [4, 8, 4]):
+            blocks = [InvertedResidual(in_c, out_c, 2)]
+            for _ in range(repeat - 1):
+                blocks.append(InvertedResidual(out_c, out_c, 1))
+            stages.append(Sequential(*blocks))
+            in_c = out_c
+        self.stages = Sequential(*stages)
+        self.conv_last = _conv_bn(in_c, cfg[4], 1)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(cfg[4], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.25, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(2.0, **kwargs)

@@ -1,0 +1,71 @@
+"""Vision Transformer (ViT-B/16 is baseline config 3; reference pairing:
+PaddleClas ViT built on paddle.nn primitives)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn import (
+    Dropout, GELU, LayerNorm, Linear, Sequential, TransformerEncoder,
+    TransformerEncoderLayer,
+)
+from ...nn.initializer import TruncatedNormal
+from ...nn.layer.conv import Conv2D
+from ...nn.layer_base import Layer
+from ...tensor import Tensor
+from ...tensor_ops.manipulation import concat, flatten, reshape, transpose
+
+
+class PatchEmbed(Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, embed_dim=768):
+        super().__init__()
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = Conv2D(in_chans, embed_dim, patch_size, stride=patch_size)
+
+    def forward(self, x):
+        x = self.proj(x)  # B, E, H/P, W/P
+        x = flatten(x, 2)  # B, E, N
+        return transpose(x, (0, 2, 1))  # B, N, E
+
+
+class VisionTransformer(Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3,
+                 num_classes=1000, embed_dim=768, depth=12, num_heads=12,
+                 mlp_ratio=4.0, dropout=0.0, attn_dropout=0.0):
+        super().__init__()
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_chans, embed_dim)
+        n = self.patch_embed.num_patches
+        self.cls_token = self.create_parameter(
+            (1, 1, embed_dim), default_initializer=TruncatedNormal(std=0.02))
+        self.pos_embed = self.create_parameter(
+            (1, n + 1, embed_dim), default_initializer=TruncatedNormal(std=0.02))
+        self.pos_drop = Dropout(dropout)
+        enc_layer = TransformerEncoderLayer(
+            embed_dim, num_heads, int(embed_dim * mlp_ratio), dropout,
+            activation="gelu", attn_dropout=attn_dropout,
+            normalize_before=True)
+        self.encoder = TransformerEncoder(enc_layer, depth,
+                                          norm=LayerNorm(embed_dim))
+        self.head = Linear(embed_dim, num_classes) if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.patch_embed(x)
+        b = x.shape[0]
+        from ...tensor_ops.manipulation import expand
+        cls = expand(self.cls_token, (b, 1, self.cls_token.shape[2]))
+        x = concat([cls, x], axis=1)
+        x = self.pos_drop(x + self.pos_embed)
+        x = self.encoder(x)
+        cls_out = x[:, 0]
+        return self.head(cls_out) if self.head is not None else cls_out
+
+
+def vit_s_16(**kwargs):
+    return VisionTransformer(embed_dim=384, depth=12, num_heads=6, **kwargs)
+
+
+def vit_b_16(**kwargs):
+    return VisionTransformer(embed_dim=768, depth=12, num_heads=12, **kwargs)
+
+
+def vit_l_16(**kwargs):
+    return VisionTransformer(embed_dim=1024, depth=24, num_heads=16, **kwargs)

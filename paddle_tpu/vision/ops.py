@@ -1,0 +1,85 @@
+"""Vision ops. Reference: python/paddle/vision/ops.py (roi_align, nms,
+deform_conv2d)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, apply
+from ..tensor_ops._factory import raw
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Host-side NMS (data-dependent output size → eager only)."""
+    b = np.asarray(raw(boxes))
+    s = np.asarray(raw(scores)) if scores is not None else np.arange(len(b))[::-1].astype(np.float32)
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), dtype=bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(b[i, 0], b[:, 0])
+        yy1 = np.maximum(b[i, 1], b[:, 1])
+        xx2 = np.minimum(b[i, 2], b[:, 2])
+        yy2 = np.minimum(b[i, 3], b[:, 3])
+        inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
+        iou = inter / np.maximum(areas[i] + areas - inter, 1e-9)
+        same_cat = (np.asarray(raw(category_idxs)) ==
+                    np.asarray(raw(category_idxs))[i]) if category_idxs is not None else True
+        suppressed |= (iou > iou_threshold) & same_cat
+        suppressed[i] = True
+    keep = np.asarray(keep, dtype=np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Bilinear ROI-align; static over a fixed number of boxes."""
+    bx = raw(boxes)
+    os_ = (output_size, output_size) if isinstance(output_size, int) else output_size
+
+    def f(feat):
+        n, c, h, w = feat.shape
+        R = bx.shape[0]
+        oh, ow = os_
+        offset = 0.5 if aligned else 0.0
+        x1 = bx[:, 0] * spatial_scale - offset
+        y1 = bx[:, 1] * spatial_scale - offset
+        x2 = bx[:, 2] * spatial_scale - offset
+        y2 = bx[:, 3] * spatial_scale - offset
+        bw = jnp.maximum(x2 - x1, 1e-6)
+        bh = jnp.maximum(y2 - y1, 1e-6)
+        ys = y1[:, None] + (jnp.arange(oh) + 0.5)[None, :] * (bh[:, None] / oh)
+        xs = x1[:, None] + (jnp.arange(ow) + 0.5)[None, :] * (bw[:, None] / ow)
+        # bilinear sample feat[0] (batch handled via boxes_num upstream)
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1).astype(jnp.int32)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = ys - y0
+        wx = xs - x0
+        fm = feat[0]  # [C, H, W]
+        def gather(yy, xx):
+            return fm[:, yy[:, :, None], xx[:, None, :]]  # [C, R?]...
+        v00 = fm[:, y0[:, :, None], x0[:, None, :]]
+        v01 = fm[:, y0[:, :, None], x1i[:, None, :]]
+        v10 = fm[:, y1i[:, :, None], x0[:, None, :]]
+        v11 = fm[:, y1i[:, :, None], x1i[:, None, :]]
+        wy_ = wy[:, :, None][None]
+        wx_ = wx[:, None, :][None]
+        out = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_ +
+               v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+        return jnp.transpose(out, (1, 0, 2, 3))  # [R, C, oh, ow]
+    return apply(f, x)
+
+
+class DeformConv2D:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "deform_conv2d: planned (pallas gather kernel); use conv2d")

@@ -1,0 +1,14 @@
+"""Test env: 8 virtual CPU devices, never touch the TPU tunnel.
+
+The axon sitecustomize force-sets jax_platforms to "axon,cpu" via
+jax.config (env vars alone can't override it), so we update the config
+explicitly before any backend initialization.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

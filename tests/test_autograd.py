@@ -1,0 +1,124 @@
+"""Eager tape vs jax.grad oracle (SURVEY.md §4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def test_backward_simple():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = paddle.sum(x * x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_backward_chain():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    w = paddle.to_tensor([[0.5, -0.5], [1.0, 2.0]], stop_gradient=False)
+    out = paddle.matmul(x, w)
+    loss = paddle.mean(paddle.tanh(out) ** 2)
+
+    def oracle(xr, wr):
+        return jnp.mean(jnp.tanh(xr @ wr) ** 2)
+
+    gx, gw = jax.grad(oracle, argnums=(0, 1))(x.numpy(), w.numpy())
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), gx, rtol=1e-5)
+    np.testing.assert_allclose(w.grad.numpy(), gw, rtol=1e-5)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    loss = paddle.sum(x * y)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 5
+    assert y._node is None and y.stop_gradient
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    z = paddle.sum(y * x)
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])
+
+
+def test_multi_output_op():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    a, b, c = paddle.split(x, 3, axis=1)
+    loss = paddle.sum(a * 1 + b * 2 + c * 3)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[1, 2, 3], [1, 2, 3]])
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    a = x * 3
+    b = x * 4
+    loss = paddle.sum(a * b)  # d/dx(12 x^2) = 24x = 48
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [48.0])
+
+
+def test_functional_grad():
+    def f(x):
+        return paddle.sum(paddle.sin(x) * x)
+
+    g = paddle.grad(f)(paddle.to_tensor([1.0, 2.0]))
+    expected = np.sin([1.0, 2.0]) + np.asarray([1.0, 2.0]) * np.cos([1.0, 2.0])
+    np.testing.assert_allclose(g.numpy(), expected, rtol=1e-5)
+
+
+def test_py_layer():
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            return dy * 3 * x * x
+
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = Cube.apply(x)
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_backward_nonscalar_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * x
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 40.0])
+
+
+def test_second_order_via_functional():
+    def f(x):
+        return paddle.sum(x ** 3)
+
+    h = paddle.autograd.hessian(f, paddle.to_tensor([1.0, 2.0]))
+    np.testing.assert_allclose(np.diag(h.numpy()), [6.0, 12.0], rtol=1e-5)

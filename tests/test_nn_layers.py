@@ -1,0 +1,245 @@
+"""Layer forward/backward checks, cross-checked vs torch-cpu where subtle."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear():
+    paddle.seed(0)
+    layer = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    y = layer(x)
+    assert y.shape == [2, 3]
+    expected = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+    np.testing.assert_allclose(y.numpy(), expected, rtol=1e-5)
+
+
+def test_layer_registration():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+    sd = net.state_dict()
+    assert len(sd) == 4
+    net2 = Net()
+    net2.set_state_dict(sd)
+    np.testing.assert_allclose(net2.fc1.weight.numpy(), net.fc1.weight.numpy())
+
+
+def test_layer_train_eval_dropout():
+    layer = nn.Dropout(0.5)
+    x = paddle.ones([100])
+    layer.eval()
+    np.testing.assert_allclose(layer(x).numpy(), np.ones(100))
+    layer.train()
+    out = layer(x).numpy()
+    assert (out == 0).any() and out.max() > 1.0
+
+
+def test_conv2d_vs_torch():
+    torch = pytest.importorskip("torch")
+    paddle.seed(0)
+    conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    y = conv(x)
+    tconv = torch.nn.Conv2d(3, 8, 3, stride=2, padding=1)
+    with torch.no_grad():
+        tconv.weight.copy_(torch.from_numpy(conv.weight.numpy()))
+        tconv.bias.copy_(torch.from_numpy(conv.bias.numpy()))
+        ty = tconv(torch.from_numpy(x.numpy()))
+    np.testing.assert_allclose(y.numpy(), ty.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_groups_dilation_vs_torch():
+    torch = pytest.importorskip("torch")
+    conv = nn.Conv2D(4, 8, 3, padding=2, dilation=2, groups=2)
+    x = paddle.randn([1, 4, 10, 10])
+    y = conv(x)
+    tconv = torch.nn.Conv2d(4, 8, 3, padding=2, dilation=2, groups=2)
+    with torch.no_grad():
+        tconv.weight.copy_(torch.from_numpy(conv.weight.numpy()))
+        tconv.bias.copy_(torch.from_numpy(conv.bias.numpy()))
+        ty = tconv(torch.from_numpy(x.numpy()))
+    np.testing.assert_allclose(y.numpy(), ty.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_transpose_vs_torch():
+    torch = pytest.importorskip("torch")
+    conv = nn.Conv2DTranspose(4, 6, 3, stride=2, padding=1, output_padding=1)
+    x = paddle.randn([1, 4, 8, 8])
+    y = conv(x)
+    tconv = torch.nn.ConvTranspose2d(4, 6, 3, stride=2, padding=1,
+                                     output_padding=1)
+    with torch.no_grad():
+        tconv.weight.copy_(torch.from_numpy(conv.weight.numpy()))
+        tconv.bias.copy_(torch.from_numpy(conv.bias.numpy()))
+        ty = tconv(torch.from_numpy(x.numpy()))
+    assert list(y.shape) == list(ty.shape)
+    np.testing.assert_allclose(y.numpy(), ty.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_train_and_eval():
+    torch = pytest.importorskip("torch")
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([4, 3, 5, 5])
+    tbn = torch.nn.BatchNorm2d(3, momentum=0.1)  # paddle momentum=0.9 ≡ torch 0.1
+    y = bn(x)
+    ty = tbn(torch.from_numpy(x.numpy()))
+    np.testing.assert_allclose(y.numpy(), ty.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(bn._mean.numpy(),
+                               tbn.running_mean.numpy(), rtol=1e-4, atol=1e-5)
+    bn.eval()
+    tbn.eval()
+    y2 = bn(x)
+    ty2 = tbn(torch.from_numpy(x.numpy()))
+    np.testing.assert_allclose(y2.numpy(), ty2.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_layernorm_vs_torch():
+    torch = pytest.importorskip("torch")
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([2, 4, 8])
+    tln = torch.nn.LayerNorm(8)
+    with torch.no_grad():
+        tln.weight.copy_(torch.from_numpy(ln.weight.numpy()))
+        tln.bias.copy_(torch.from_numpy(ln.bias.numpy()))
+    np.testing.assert_allclose(ln(x).numpy(),
+                               tln(torch.from_numpy(x.numpy())).detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_maxpool_avgpool_vs_torch():
+    torch = pytest.importorskip("torch")
+    x = paddle.randn([2, 3, 8, 8])
+    tx = torch.from_numpy(x.numpy())
+    np.testing.assert_allclose(
+        nn.MaxPool2D(2, 2)(x).numpy(),
+        torch.nn.MaxPool2d(2, 2)(tx).numpy(), rtol=1e-5)
+    np.testing.assert_allclose(
+        nn.AvgPool2D(3, 2, padding=1)(x).numpy(),
+        torch.nn.AvgPool2d(3, 2, padding=1, count_include_pad=False)(tx).numpy(),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        nn.AdaptiveAvgPool2D((2, 2))(x).numpy(),
+        torch.nn.AdaptiveAvgPool2d((2, 2))(tx).numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor(np.asarray([[1, 0, 3]]))
+    out = emb(ids)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+
+
+def test_activations_finite():
+    x = paddle.randn([16])
+    for act in [nn.ReLU(), nn.GELU(), nn.Silu(), nn.Sigmoid(), nn.Tanh(),
+                nn.LeakyReLU(), nn.Hardswish(), nn.Mish(), nn.ELU(),
+                nn.Softplus(), nn.SELU()]:
+        y = act(x)
+        assert np.isfinite(y.numpy()).all()
+
+
+def test_cross_entropy_vs_torch():
+    torch = pytest.importorskip("torch")
+    logits = paddle.randn([8, 5])
+    labels = paddle.to_tensor(np.random.default_rng(0).integers(0, 5, 8))
+    loss = F.cross_entropy(logits, labels)
+    tloss = torch.nn.functional.cross_entropy(
+        torch.from_numpy(logits.numpy()),
+        torch.from_numpy(labels.numpy().astype(np.int64)))
+    np.testing.assert_allclose(loss.numpy(), tloss.numpy(), rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = paddle.randn([4, 3])
+    labels = paddle.to_tensor(np.asarray([0, -100, 2, -100]))
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    l0 = F.cross_entropy(logits[0:1], labels[0:1])
+    l2 = F.cross_entropy(logits[2:3], labels[2:3])
+    np.testing.assert_allclose(loss.numpy(),
+                               (l0.numpy() + l2.numpy()) / 2, rtol=1e-5)
+
+
+def test_multihead_attention_shapes():
+    mha = nn.MultiHeadAttention(32, 4)
+    x = paddle.randn([2, 6, 32])
+    y = mha(x, x, x)
+    assert y.shape == [2, 6, 32]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(32, 4, 64, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 5, 32])
+    y = enc(x)
+    assert y.shape == [2, 5, 32]
+    assert np.isfinite(y.numpy()).all()
+
+
+def test_rnn_lstm_gru():
+    for cls in (nn.SimpleRNN, nn.LSTM, nn.GRU):
+        net = cls(8, 16, num_layers=2)
+        x = paddle.randn([3, 5, 8])
+        out, state = net(x)
+        assert out.shape == [3, 5, 16]
+        assert np.isfinite(out.numpy()).all()
+    bi = nn.LSTM(8, 16, direction="bidirect")
+    out, (h, c) = bi(paddle.randn([3, 5, 8]))
+    assert out.shape == [3, 5, 32]
+
+
+def test_lstm_grad_flows():
+    net = nn.LSTM(4, 8)
+    x = paddle.randn([2, 6, 4])
+    out, _ = net(x)
+    loss = paddle.mean(out ** 2)
+    loss.backward()
+    assert net.rnns[0].cell.weight_ih.grad is not None
+    assert np.isfinite(net.rnns[0].cell.weight_ih.grad.numpy()).all()
+
+
+def test_sequential_and_containers():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    y = seq(paddle.randn([3, 4]))
+    assert y.shape == [3, 2]
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    ll.append(nn.Linear(2, 2))
+    assert len(list(ll.parameters())) == 8
+
+
+def test_grad_clip_global_norm():
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    lin = nn.Linear(4, 4)
+    x = paddle.randn([8, 4]) * 100
+    loss = paddle.sum(lin(x) ** 2)
+    loss.backward()
+    pgs = [(p, p.grad._data) for p in lin.parameters()]
+    clipped = clip(pgs)
+    total = np.sqrt(sum(float((g ** 2).sum()) for _, g in clipped))
+    assert total <= 1.01
+
+
+def test_rmsnorm():
+    rn = nn.RMSNorm(8)
+    x = paddle.randn([2, 3, 8])
+    y = rn(x)
+    ms = np.mean(x.numpy() ** 2, axis=-1, keepdims=True)
+    expected = x.numpy() / np.sqrt(ms + 1e-6)
+    np.testing.assert_allclose(y.numpy(), expected, rtol=1e-4, atol=1e-5)

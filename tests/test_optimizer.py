@@ -1,0 +1,140 @@
+"""Optimizer convergence micro-problems + scheduler math (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _fit_quadratic(opt_cls, lr=0.1, steps=60, **kw):
+    paddle.seed(0)
+    target = np.asarray([3.0, -2.0], dtype=np.float32)
+    w = paddle.Parameter(np.zeros(2, dtype=np.float32))
+    opt = opt_cls(learning_rate=lr, parameters=[w], **kw)
+    for _ in range(steps):
+        loss = paddle.sum((w - paddle.to_tensor(target)) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return w.numpy(), target
+
+
+@pytest.mark.parametrize("cls,lr", [
+    (optimizer.SGD, 0.1), (optimizer.Momentum, 0.05),
+    (optimizer.Adam, 0.2), (optimizer.AdamW, 0.2),
+    (optimizer.RMSProp, 0.05), (optimizer.Adamax, 0.3),
+    (optimizer.Adagrad, 0.9), (optimizer.Adadelta, 30.0),
+])
+def test_converges(cls, lr):
+    w, target = _fit_quadratic(cls, lr=lr, steps=120)
+    np.testing.assert_allclose(w, target, atol=0.3)
+
+
+def test_lamb_converges():
+    w, target = _fit_quadratic(optimizer.Lamb, lr=0.3, steps=200,
+                               lamb_weight_decay=0.0)
+    np.testing.assert_allclose(w, target, atol=0.3)
+
+
+def test_adam_matches_torch():
+    torch = pytest.importorskip("torch")
+    w0 = np.random.default_rng(0).normal(size=(3,)).astype(np.float32)
+    g = np.random.default_rng(1).normal(size=(3,)).astype(np.float32)
+
+    p = paddle.Parameter(w0.copy())
+    opt = optimizer.Adam(learning_rate=0.1, parameters=[p])
+    tp = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+    topt = torch.optim.Adam([tp], lr=0.1, eps=1e-8)
+    for _ in range(5):
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        tp.grad = torch.from_numpy(g.copy())
+        topt.step()
+    np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    w0 = np.ones(2, dtype=np.float32)
+    p = paddle.Parameter(w0.copy())
+    opt = optimizer.AdamW(learning_rate=0.0, parameters=[p], weight_decay=0.1)
+    p.grad = paddle.to_tensor(np.zeros(2, dtype=np.float32))
+    opt.step()
+    # lr=0 → update is -lr*decay*w = 0; decay scales with lr (true AdamW)
+    np.testing.assert_allclose(p.numpy(), w0)
+
+
+def test_weight_decay_coupled_sgd():
+    p = paddle.Parameter(np.ones(1, dtype=np.float32))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[p], weight_decay=0.5)
+    p.grad = paddle.to_tensor(np.zeros(1, dtype=np.float32))
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 0.5], rtol=1e-6)
+
+
+def test_grad_clip_in_optimizer():
+    p = paddle.Parameter(np.zeros(2, dtype=np.float32))
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[p],
+                        grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    p.grad = paddle.to_tensor(np.asarray([30.0, 40.0], dtype=np.float32))
+    opt.step()
+    np.testing.assert_allclose(np.linalg.norm(p.numpy()), 1.0, rtol=1e-4)
+
+
+def test_lr_scheduler_with_optimizer():
+    sched = optimizer.lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.1)
+    opt = optimizer.SGD(learning_rate=sched,
+                        parameters=[paddle.Parameter(np.zeros(1, np.float32))])
+    assert opt.get_lr() == 1.0
+    sched.step()
+    sched.step()
+    assert abs(opt.get_lr() - 0.1) < 1e-9
+
+
+def test_schedulers_shapes():
+    lr = optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+    vals = []
+    for _ in range(10):
+        vals.append(lr())
+        lr.step()
+    assert vals[0] == pytest.approx(1.0)
+    assert vals[-1] < 0.1
+
+    warm = optimizer.lr.LinearWarmup(0.5, warmup_steps=5, start_lr=0.0,
+                                     end_lr=0.5)
+    v0 = warm()
+    for _ in range(5):
+        warm.step()
+    assert v0 == pytest.approx(0.0)
+    assert warm() == pytest.approx(0.5)
+
+    noam = optimizer.lr.NoamDecay(d_model=512, warmup_steps=10)
+    seq = []
+    for _ in range(20):
+        seq.append(noam())
+        noam.step()
+    assert np.argmax(seq) in (9, 10, 11)
+
+
+def test_optimizer_state_dict_roundtrip():
+    p = paddle.Parameter(np.ones(2, dtype=np.float32), name="w")
+    opt = optimizer.Adam(learning_rate=0.1, parameters=[p])
+    p.grad = paddle.to_tensor(np.ones(2, dtype=np.float32))
+    opt.step()
+    st = opt.state_dict()
+    p2 = paddle.Parameter(p.numpy().copy(), name="w")
+    opt2 = optimizer.Adam(learning_rate=0.1, parameters=[p2])
+    opt2.set_state_dict(st)
+    p.grad = paddle.to_tensor(np.ones(2, dtype=np.float32))
+    p2.grad = paddle.to_tensor(np.ones(2, dtype=np.float32))
+    opt.step()
+    opt2.step()
+    np.testing.assert_allclose(p.numpy(), p2.numpy(), rtol=1e-6)
+
+
+def test_minimize():
+    p = paddle.Parameter(np.asarray([5.0], dtype=np.float32))
+    opt = optimizer.SGD(learning_rate=0.5, parameters=[p])
+    loss = paddle.sum(p * p)
+    opt.minimize(loss)
+    np.testing.assert_allclose(p.numpy(), [0.0], atol=1e-6)

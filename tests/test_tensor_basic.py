@@ -1,0 +1,150 @@
+"""Core tensor op parity vs numpy (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_roundtrip():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([4]).numpy().sum() == 4
+    np.testing.assert_allclose(paddle.full([2], 7.0).numpy(), [7, 7])
+    np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                               np.linspace(0, 1, 5), rtol=1e-6)
+    assert paddle.eye(3).numpy()[1, 1] == 1
+
+
+def test_arithmetic_dunders():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((x + y).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((x * y).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((y - x).numpy(), [3, 3, 3])
+    np.testing.assert_allclose((y / x).numpy(), [4, 2.5, 2], rtol=1e-6)
+    np.testing.assert_allclose((x ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((-x).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose((1.0 + x).numpy(), [2, 3, 4])
+    np.testing.assert_allclose((10.0 / x).numpy(), [10, 5, 10 / 3], rtol=1e-6)
+
+
+def test_matmul():
+    a = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+    b = np.random.default_rng(1).normal(size=(4, 5)).astype(np.float32)
+    out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+    out_t = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.T),
+                          transpose_y=True)
+    np.testing.assert_allclose(out_t.numpy(), a @ b, rtol=1e-5)
+
+
+def test_reductions():
+    a = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+    x = paddle.to_tensor(a)
+    np.testing.assert_allclose(paddle.sum(x).numpy(), a.sum(), rtol=1e-5)
+    np.testing.assert_allclose(paddle.mean(x, axis=1).numpy(), a.mean(1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.max(x, axis=0, keepdim=True).numpy(),
+                               a.max(0, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(x.std().numpy(), a.std(ddof=1), rtol=1e-4)
+
+
+def test_manipulation():
+    a = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    x = paddle.to_tensor(a)
+    assert paddle.reshape(x, [4, 6]).shape == [4, 6]
+    assert paddle.transpose(x, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(x, 1).shape == [2, 12]
+    parts = paddle.split(x, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    parts2 = paddle.split(x, [1, 2], axis=1)
+    assert parts2[1].shape == [2, 2, 4]
+    s = paddle.stack([x, x], axis=0)
+    assert s.shape == [2, 2, 3, 4]
+    c = paddle.concat([x, x], axis=2)
+    assert c.shape == [2, 3, 8]
+    assert paddle.squeeze(paddle.unsqueeze(x, 0), 0).shape == [2, 3, 4]
+    np.testing.assert_allclose(paddle.flip(x, [0]).numpy(), a[::-1])
+
+
+def test_indexing():
+    a = np.arange(12).reshape(3, 4).astype(np.float32)
+    x = paddle.to_tensor(a)
+    np.testing.assert_allclose(x[1].numpy(), a[1])
+    np.testing.assert_allclose(x[:, 2].numpy(), a[:, 2])
+    np.testing.assert_allclose(x[0:2, 1:3].numpy(), a[0:2, 1:3])
+    x[0, 0] = 100.0
+    assert x.numpy()[0, 0] == 100.0
+
+
+def test_comparison_and_logic():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([3.0, 2.0, 1.0])
+    np.testing.assert_array_equal((x < y).numpy(), [True, False, False])
+    np.testing.assert_array_equal((x == y).numpy(), [False, True, False])
+    np.testing.assert_array_equal(
+        paddle.logical_and(x > 1, y > 1).numpy(), [False, True, False])
+    assert bool(paddle.allclose(x, x))
+
+
+def test_search_sort():
+    a = np.asarray([[3.0, 1.0, 2.0], [9.0, 7.0, 8.0]], dtype=np.float32)
+    x = paddle.to_tensor(a)
+    assert int(paddle.argmax(x, axis=1).numpy()[0]) == 0
+    np.testing.assert_allclose(paddle.sort(x, axis=1).numpy(),
+                               np.sort(a, axis=1))
+    vals, idx = paddle.topk(x, 2, axis=1)
+    np.testing.assert_allclose(vals.numpy(), [[3, 2], [9, 8]])
+    w = paddle.where(x > 2, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(w.numpy(), [[3, 0, 0], [9, 7, 8]])
+
+
+def test_linalg():
+    a = np.random.default_rng(0).normal(size=(4, 4)).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    x = paddle.to_tensor(spd)
+    np.testing.assert_allclose(
+        paddle.linalg.cholesky(x).numpy(), np.linalg.cholesky(spd), rtol=1e-4,
+        atol=1e-4)
+    np.testing.assert_allclose(paddle.linalg.inv(x).numpy(),
+                               np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(paddle.linalg.norm(x).numpy(),
+                               np.linalg.norm(spd), rtol=1e-5)
+
+
+def test_einsum():
+    a = np.random.default_rng(0).normal(size=(2, 3)).astype(np.float32)
+    b = np.random.default_rng(1).normal(size=(3, 4)).astype(np.float32)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+def test_cast_dtype():
+    x = paddle.to_tensor([1.5, 2.5])
+    y = x.astype("int32")
+    assert y.numpy().dtype == np.int32
+    z = paddle.cast(x, paddle.bfloat16)
+    assert str(z.dtype) == "bfloat16"
+
+
+def test_gather_scatter():
+    a = np.arange(12).reshape(4, 3).astype(np.float32)
+    x = paddle.to_tensor(a)
+    idx = paddle.to_tensor(np.asarray([0, 2]))
+    np.testing.assert_allclose(paddle.gather(x, idx, axis=0).numpy(), a[[0, 2]])
+    upd = paddle.scatter(x, idx, paddle.zeros([2, 3]))
+    assert upd.numpy()[0].sum() == 0 and upd.numpy()[2].sum() == 0
+
+
+def test_random_reproducible():
+    paddle.seed(42)
+    a = paddle.rand([3, 3]).numpy()
+    paddle.seed(42)
+    b = paddle.rand([3, 3]).numpy()
+    np.testing.assert_allclose(a, b)
