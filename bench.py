@@ -1,0 +1,112 @@
+"""Headline benchmark: Llama-style decoder LM pretraining throughput on one
+chip (tokens/sec/chip), the single-chip proxy for BASELINE.json's
+Llama-2-7B Fleet sharding-stage3 config. Full 7B dims per layer don't fit a
+single chip with Adam fp32 moments, so layer count is scaled down while
+keeping the per-layer shapes MXU-saturating; tokens/sec/chip is comparable
+round over round.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.text.models.llama import LlamaConfig, LlamaForCausalLM
+
+    backend = jax.default_backend()
+    paddle_tpu.seed(0)
+
+    # ~0.5B params: 7B's hidden/head shapes halved, 8 layers; bf16 + flash
+    # attention + remat — fits one chip incl. Adam fp32 moments.
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5504, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=16,
+                      max_position_embeddings=2048, dtype="bfloat16",
+                      remat=True)
+    batch, seqlen = 4, 2048
+    if backend == "cpu":  # smoke mode off-TPU
+        cfg = LlamaConfig(vocab_size=1024, hidden_size=256,
+                          intermediate_size=688, num_hidden_layers=2,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=512, dtype="float32")
+        batch, seqlen = 2, 128
+
+    strategy = DistributedStrategy()
+    fleet.init(is_collective=True, strategy=strategy)
+    model = fleet.distributed_model(LlamaForCausalLM(cfg))
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+
+    opt = fleet.distributed_optimizer(
+        optim.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                    parameters=model.parameters()),
+        strategy=strategy)
+
+    def loss_fn(m, input_ids, labels):
+        return m(input_ids, labels=labels)
+
+    step = opt.make_train_step(model, loss_fn)
+
+    rng = np.random.default_rng(0)
+    ids = paddle_tpu.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32))
+    labels = paddle_tpu.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32))
+
+    # compile + warmup
+    loss = step(ids, labels)
+    jax.block_until_ready(loss._data)
+
+    n_steps = 10 if backend != "cpu" else 2
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = step(ids, labels)
+    jax.block_until_ready(loss._data)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seqlen * n_steps / dt
+    # MFU: 6 * n_params FLOPs/token (fwd+bwd), vs 197 TFLOPs bf16 (v5e ref)
+    flops_per_tok = 6 * n_params
+    mfu = tokens_per_sec * flops_per_tok / 197e12 if backend == "tpu" else 0.0
+
+    vs = 1.0
+    best = 0.0
+    for f in glob.glob(os.path.join(os.path.dirname(__file__) or ".",
+                                    "BENCH_r*.json")):
+        try:
+            with open(f) as fh:
+                rec = json.load(fh)
+            best = max(best, float(rec.get("value", 0.0)))
+        except Exception:
+            pass
+    if best > 0:
+        vs = tokens_per_sec / best
+
+    print(json.dumps({
+        "metric": f"llama-0.5B pretrain tokens/sec/chip "
+                  f"(bf16+flash+remat, AdamW, {backend})",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs, 4),
+        "extra": {"params": n_params, "mfu_est_v5e": round(mfu, 4),
+                  "loss": float(np.asarray(loss._data)),
+                  "batch": batch, "seqlen": seqlen, "steps": n_steps},
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = False
 
     def scale(self, var):
         if not self._enable:
@@ -35,8 +36,9 @@ class GradScaler:
         return var * self._scale
 
     def unscale_(self, optimizer):
-        if not self._enable:
+        if not self._enable or self._unscaled:
             return
+        self._unscaled = True
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._all_params():
@@ -74,6 +76,7 @@ class GradScaler:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
         self._found_inf = False
+        self._unscaled = False
 
     def is_enable(self):
         return self._enable

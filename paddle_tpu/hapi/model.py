@@ -127,6 +127,7 @@ class Model:
             for m in self._metrics:
                 m.reset()
             it = 0
+            logs = {}
             for batch in train_loader:
                 cbks.on_batch_begin("train", it, None)
                 xs, ys = self._split_batch(batch)

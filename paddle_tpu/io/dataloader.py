@@ -96,16 +96,20 @@ class DataLoader:
                 yield to_tensors(b)
             return
 
-        # native C++ ring-buffer prefetcher if available, else thread pool
+        # native C++ ring-buffer prefetcher if available, else thread pool.
+        # Availability is decided before the first batch is pulled so a
+        # mid-epoch failure propagates instead of restarting the iterator.
+        src = None
         try:
             from ..runtime.prefetcher import NativePrefetcher
             src = NativePrefetcher(self._make_batches(),
                                    depth=self.num_workers * self.prefetch_factor)
+        except Exception:
+            src = None
+        if src is not None:
             for b in src:
                 yield to_tensors(b)
             return
-        except Exception:
-            pass
 
         q: queue.Queue = queue.Queue(self.num_workers * self.prefetch_factor)
         sentinel = object()
@@ -114,8 +118,9 @@ class DataLoader:
             try:
                 for b in self._make_batches():
                     q.put(b)
-            finally:
                 q.put(sentinel)
+            except BaseException as e:  # surface dataset errors to consumer
+                q.put(e)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
@@ -123,4 +128,6 @@ class DataLoader:
             b = q.get()
             if b is sentinel:
                 break
+            if isinstance(b, BaseException):
+                raise b
             yield to_tensors(b)

@@ -216,11 +216,18 @@ class ReduceOnPlateau(LRScheduler):
             cur = float(metrics)
         except TypeError:
             cur = float(metrics.item())
-        better = (self.best is None or
-                  (self.mode == "min" and cur < self.best * (1 - self.threshold
-                   if self.threshold_mode == "rel" else 0) - (
-                       self.threshold if self.threshold_mode == "abs" else 0)) or
-                  (self.mode == "max" and cur > self.best))
+        if self.best is None:
+            better = True
+        elif self.mode == "min":
+            if self.threshold_mode == "rel":
+                better = cur < self.best * (1 - self.threshold)
+            else:
+                better = cur < self.best - self.threshold
+        else:
+            if self.threshold_mode == "rel":
+                better = cur > self.best * (1 + self.threshold)
+            else:
+                better = cur > self.best + self.threshold
         if better:
             self.best = cur
             self.num_bad = 0
@@ -246,6 +253,7 @@ class OneCycleLR(LRScheduler):
         self.end_lr = end_learning_rate
         self.phase_pct = phase_pct
         self.anneal = anneal_strategy
+        self.three_phase = three_phase
         super().__init__(self.initial_lr, last_epoch, verbose)
 
     def _interp(self, start, end, pct):
@@ -259,6 +267,16 @@ class OneCycleLR(LRScheduler):
         if step <= up_steps:
             return self._interp(self.initial_lr, self.max_lr,
                                 step / max(up_steps, 1))
+        if self.three_phase:
+            # phase 2: symmetric descent back to initial_lr, then phase 3:
+            # anneal initial_lr → end_lr over the remainder
+            down_end = min(2 * up_steps, self.total_steps)
+            if step <= down_end:
+                return self._interp(self.max_lr, self.initial_lr,
+                                    (step - up_steps) / max(up_steps, 1))
+            return self._interp(self.initial_lr, self.end_lr,
+                                (step - down_end) /
+                                max(self.total_steps - down_end, 1))
         return self._interp(self.max_lr, self.end_lr,
                             (step - up_steps) / max(self.total_steps - up_steps, 1))
 
@@ -273,6 +291,8 @@ class CyclicLR(LRScheduler):
         self.down = step_size_down or step_size_up
         self.mode = mode
         self.exp_gamma = exp_gamma
+        self.scale_fn = scale_fn
+        self.scale_mode = scale_mode
         super().__init__(base_learning_rate, last_epoch, verbose)
 
     def get_lr(self):
@@ -284,7 +304,10 @@ class CyclicLR(LRScheduler):
         else:
             pct = 1 - (pos - self.up) / self.down
         amp = (self.max_lr - self.base_lr) * pct
-        if self.mode == "triangular2":
+        if self.scale_fn is not None:
+            x = cycle + 1 if self.scale_mode == "cycle" else self.last_epoch
+            amp = amp * self.scale_fn(x)
+        elif self.mode == "triangular2":
             amp = amp / (2 ** cycle)
         elif self.mode == "exp_range":
             amp = amp * (self.exp_gamma ** self.last_epoch)

@@ -198,21 +198,37 @@ def cumprod(x, dim=None, dtype=None, name=None):
     return apply(f, x)
 
 
-def cummax(x, axis=None, dtype="int64", name=None):
+def _cum_minmax(take_right, axis, dtype):
+    """(values, indices) running extremum via a pairwise associative scan;
+    strictly-better comparison keeps the earliest index on ties (paddle
+    cummax/cummin contract)."""
+    idx_dtype = jax.dtypes.canonicalize_dtype(dtype)
+
     def f(a):
         ax = axis if axis is not None else 0
         aa = a.reshape(-1) if axis is None else a
-        vals = jax.lax.associative_scan(jnp.maximum, aa, axis=ax)
-        return vals
-    return apply(f, x)
+        shape = [1] * aa.ndim
+        shape[ax] = aa.shape[ax]
+        idx = jnp.broadcast_to(
+            jnp.arange(aa.shape[ax], dtype=idx_dtype).reshape(shape), aa.shape)
+
+        def combine(left, right):
+            lv, li = left
+            rv, ri = right
+            better = take_right(rv, lv)
+            return jnp.where(better, rv, lv), jnp.where(better, ri, li)
+
+        return jax.lax.associative_scan(combine, (aa, idx), axis=ax)
+
+    return f
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return apply(_cum_minmax(lambda r, l: r > l, axis, dtype), x, n_outputs=2)
 
 
 def cummin(x, axis=None, dtype="int64", name=None):
-    def f(a):
-        ax = axis if axis is not None else 0
-        aa = a.reshape(-1) if axis is None else a
-        return jax.lax.associative_scan(jnp.minimum, aa, axis=ax)
-    return apply(f, x)
+    return apply(_cum_minmax(lambda r, l: r < l, axis, dtype), x, n_outputs=2)
 
 
 # -- clip / misc ---------------------------------------------------------
