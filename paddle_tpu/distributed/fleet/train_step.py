@@ -143,6 +143,11 @@ class CompiledTrainStep:
     def __call__(self, *batch):
         raw_batch = jax.tree_util.tree_map(
             lambda x: x._data if isinstance(x, Tensor) else x, tuple(batch))
+        raw_batch = jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                x, NamedSharding(self._mesh, data_pspec(jnp.shape(x))))
+            if jnp.ndim(x) else x,
+            raw_batch)
         key = next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
         loss, self._param_vals, self._opt_state, self._buffer_vals = \

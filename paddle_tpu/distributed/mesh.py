@@ -33,11 +33,13 @@ def build_mesh(dp: int = 1, tp: int = 1, pp: int = 1, sharding: int = 1,
     if need == 1:
         dp = len(devices)
         need = dp
-    if need != len(devices):
+    if need > len(devices):
         raise ValueError(
-            f"mesh degrees {dp}x{sharding}x{tp}x{pp}x{sep}={need} != "
+            f"mesh degrees {dp}x{sharding}x{tp}x{pp}x{sep}={need} > "
             f"{len(devices)} devices")
-    arr = np.asarray(devices).reshape(pp, dp, sharding, sep, tp)
+    # fewer degrees than devices: run on a subset (parity testing on a
+    # virtual mesh; the reference requires product == world_size)
+    arr = np.asarray(devices[:need]).reshape(pp, dp, sharding, sep, tp)
     return Mesh(arr, AXES)
 
 
@@ -62,9 +64,20 @@ def named_sharding(spec: PartitionSpec) -> NamedSharding:
     return NamedSharding(get_mesh(), spec)
 
 
-def data_pspec(ndim: int) -> PartitionSpec:
-    """Batch dim sharded over (dp, sharding) — the data-parallel axes."""
-    return PartitionSpec(("dp", "sharding"), *([None] * (ndim - 1)))
+def data_pspec(shape) -> PartitionSpec:
+    """PartitionSpec for one batch leaf given its shape: batch dim over
+    (dp, sharding); the seq dim (dim 1) over "sep" when divisible (sequence
+    parallelism). Dims that don't divide stay replicated; scalars get P()."""
+    shape = tuple(shape)
+    if not shape:
+        return PartitionSpec()
+    dspan = mesh_axis_size("dp") * mesh_axis_size("sharding")
+    first = ("dp", "sharding") if shape[0] % dspan == 0 else None
+    rest = [None] * (len(shape) - 1)
+    sep = mesh_axis_size("sep")
+    if len(shape) >= 2 and sep > 1 and shape[1] % sep == 0:
+        rest[0] = "sep"
+    return PartitionSpec(first, *rest)
 
 
 def infer_param_pspec(shape, tp_spec: Optional[PartitionSpec], stage: int,

@@ -48,6 +48,21 @@ def _xla_sdpa(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None,
     return jnp.swapaxes(out, 1, 2)  # [B, L, H, D]
 
 
+def sdpa_raw(q, k, v, causal=False, scale=None):
+    """Raw-array causal/full attention with TPU flash routing ([B,L,H,D]).
+
+    Shared by the Tensor-level functional below and pure-jnp model code
+    (e.g. the stacked pipelined Llama)."""
+    if (q.dtype in (jnp.bfloat16, jnp.float32) and q.shape[1] >= 128
+            and q.shape[-1] <= 256 and jax.default_backend() == "tpu"):
+        try:
+            from ...ops.pallas.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        except Exception:
+            pass
+    return _xla_sdpa(q, k, v, causal=causal, scale=scale)
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, scale=None, name=None):
@@ -60,18 +75,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         dkey = next_key()
 
     def f(q, k, v):
-        use_flash = (
-            mask is None and not use_dropout
-            and q.dtype in (jnp.bfloat16, jnp.float32)
-            and q.shape[1] >= 128 and q.shape[-1] <= 256
-            and jax.default_backend() == "tpu"
-        )
-        if use_flash:
-            try:
-                from ...ops.pallas.flash_attention import flash_attention
-                return flash_attention(q, k, v, causal=is_causal, scale=scale)
-            except Exception:
-                pass
+        if mask is None and not use_dropout:
+            return sdpa_raw(q, k, v, causal=is_causal, scale=scale)
         return _xla_sdpa(q, k, v, mask=mask, causal=is_causal, scale=scale,
                          dropout_p=dropout_p if use_dropout else 0.0,
                          dropout_key=dkey)

@@ -10,3 +10,4 @@ from .llama import (  # noqa: F401
     LLAMA2_7B, LLAMA2_13B, LLAMA_TINY, LlamaConfig, LlamaForCausalLM,
     LlamaModel,
 )
+from .llama_pipe import LlamaForCausalLMPipe  # noqa: F401

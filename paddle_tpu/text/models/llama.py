@@ -39,6 +39,8 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     dtype: str = "bfloat16"
     remat: bool = False
+    # shard the sequence dim over the mesh "sep" axis and run ring attention
+    sequence_parallel: bool = False
 
 
 LLAMA2_7B = LlamaConfig()
@@ -77,6 +79,7 @@ class LlamaAttention(Layer):
         self.head_dim = c.hidden_size // c.num_attention_heads
         self.theta = c.rope_theta
         self.dtype = c.dtype
+        self.sequence_parallel = c.sequence_parallel
         h = c.hidden_size
         kv = self.num_kv_heads * self.head_dim
         self.q_proj = Linear(h, h, bias_attr=False)
@@ -112,7 +115,20 @@ class LlamaAttention(Layer):
             v = concat([cache[1], v], axis=1)
             new_cache = (k.detach(), v.detach())
 
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        if self.sequence_parallel and cache is None:
+            from ...distributed.mesh import get_mesh, mesh_axis_size
+            if mesh_axis_size("sep") > 1:
+                mesh = get_mesh()
+                from ...ops.ring_attention import ring_attention
+
+                def ring_fn(qq, kk, vv):
+                    return ring_attention(qq, kk, vv, mesh=mesh, causal=True)
+
+                out = apply(ring_fn, q, k, v)
+            else:
+                out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         out = self.o_proj(reshape(out, (b, l, h)))
         return (out, new_cache) if cache is not None else out
 
