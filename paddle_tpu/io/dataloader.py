@@ -19,8 +19,14 @@ from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
 
+def _stack(arrays):
+    from ..runtime.native import gather_stack
+    return gather_stack(arrays)
+
+
 def default_collate_fn(batch):
-    """Stack samples into batched numpy arrays (converted lazily to device)."""
+    """Stack samples into batched numpy arrays (converted lazily to device).
+    Large batches stack through the C++ parallel gather when built."""
     sample = batch[0]
     if isinstance(sample, (list, tuple)):
         return tuple(default_collate_fn([b[i] for b in batch])
@@ -28,9 +34,9 @@ def default_collate_fn(batch):
     if isinstance(sample, dict):
         return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
     if isinstance(sample, Tensor):
-        return np.stack([np.asarray(b._data) for b in batch])
+        return _stack([np.asarray(b._data) for b in batch])
     if isinstance(sample, np.ndarray):
-        return np.stack(batch)
+        return _stack(batch)
     if isinstance(sample, (int, float, np.integer, np.floating)):
         return np.asarray(batch)
     return batch

@@ -47,3 +47,4 @@ from .layer.transformer import (  # noqa: F401
     TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
 )
 from .moe import MoELayer, SwitchGate, TopKGate  # noqa: F401
+from . import quant  # noqa: F401

@@ -1,0 +1,132 @@
+"""Weight-only int8 quantization.
+
+Reference: paddle/nn/quant + incubate weight_only_linear (CUDA int8/int4
+GEMM epilogues). TPU-native form: weights stored int8 with per-output-
+channel fp scales; the forward dequantizes right at the matmul so XLA fuses
+scale multiplication into the MXU epilogue (int8 VMEM residency halves/
+quarters HBM traffic — the win weight-only quant is for). A pallas
+stochastic-rounding quantizer covers on-device conversion.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import Tensor, apply
+from ..layer_base import Layer
+from ..layer.common import Linear
+
+__all__ = ["quantize_int8", "dequantize_int8", "Int8Linear",
+           "quantize_model", "quantize_int8_stochastic"]
+
+
+def _quant_raw(w, axis=-1):
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis,
+                    keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-10)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def quantize_int8(w, axis: int = -1):
+    """Per-channel symmetric int8: returns (int8 Tensor, fp32 scale)."""
+    if isinstance(w, Tensor):
+        q, s = _quant_raw(w._data, axis)
+        return Tensor(q), Tensor(s)
+    return _quant_raw(w, axis)
+
+
+def dequantize_int8(q, scale, dtype="float32"):
+    f = lambda q, s: q.astype(dtype) * s.astype(dtype)
+    if isinstance(q, Tensor):
+        return apply(f, q, scale)
+    return f(q, scale)
+
+
+def quantize_int8_stochastic(w, seed: int = 0, interpret: bool = False):
+    """On-device int8 quantization with stochastic rounding (pallas PRNG).
+
+    w: [rows, cols] raw array; per-tensor scale. Returns (int8, scale[1,1]).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(x_ref, seed_ref, q_ref, s_ref):
+        pltpu.prng_seed(seed_ref[0])
+        amax = jnp.max(jnp.abs(x_ref[:]))
+        scale = jnp.maximum(amax / 127.0, 1e-10)
+        s_ref[0, 0] = scale
+        scaled = x_ref[:] / scale
+        bits = pltpu.bitcast(pltpu.prng_random_bits(scaled.shape),
+                             jnp.uint32)
+        q_ref[:] = pltpu.stochastic_round(scaled, bits,
+                                          target_dtype=jnp.int8)
+
+    rows, cols = w.shape
+    q, s = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY
+                               if interpret else pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.ANY
+                                if interpret else pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_shape=[jax.ShapeDtypeStruct((rows, cols), jnp.int8),
+                   jax.ShapeDtypeStruct((1, 1), jnp.float32)],
+        interpret=interpret,
+    )(w.astype(jnp.float32), jnp.asarray([seed], dtype=jnp.int32))
+    return q, s
+
+
+class Int8Linear(Layer):
+    """Linear with int8 weight + per-output-channel scale (weight-only)."""
+
+    def __init__(self, in_features, out_features, bias=True):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        qw = np.zeros((in_features, out_features), dtype=np.int8)
+        self.register_buffer("qweight", Tensor(jnp.asarray(qw)))
+        self.register_buffer(
+            "scale", Tensor(jnp.ones((1, out_features), dtype=jnp.float32)))
+        self.bias = self.create_parameter((out_features,), is_bias=True) \
+            if bias else None
+
+    @classmethod
+    def from_linear(cls, linear: Linear) -> "Int8Linear":
+        m = cls(linear.in_features, linear.out_features,
+                bias=linear.bias is not None)
+        q, s = quantize_int8(linear.weight, axis=0)  # per out-channel
+        m.qweight._data = q._data
+        m.scale._data = s._data
+        if linear.bias is not None:
+            m.bias._data = linear.bias._data
+        return m
+
+    def forward(self, x):
+        def f(x, q, s, *b):
+            w = q.astype(x.dtype) * s.astype(x.dtype)  # fused by XLA
+            y = x @ w
+            return y + b[0].astype(x.dtype) if b else y
+
+        args = (x, self.qweight, self.scale) + (
+            (self.bias,) if self.bias is not None else ())
+        return apply(f, *args)
+
+
+def quantize_model(model: Layer, include=None) -> Layer:
+    """Swap every nn.Linear (optionally filtered by name substring list)
+    for an Int8Linear holding the quantized weights. In-place; returns
+    model."""
+    for name, sub in list(model.named_sublayers(include_self=True)):
+        for child_name, child in list(sub._sub_layers.items()):
+            if isinstance(child, Linear) and not isinstance(child,
+                                                            Int8Linear):
+                full = f"{name}.{child_name}" if name else child_name
+                if include and not any(k in full for k in include):
+                    continue
+                sub._sub_layers[child_name] = Int8Linear.from_linear(child)
+    if isinstance(model, Linear) and not isinstance(model, Int8Linear):
+        raise TypeError("pass a container Layer, not a bare Linear")
+    return model

@@ -1,0 +1,56 @@
+"""Model export (paddle.onnx API shape).
+
+Reference: python/paddle/onnx/export.py (paddle2onnx). There is no ONNX
+runtime in the TPU stack; the portable interchange format for XLA programs
+is StableHLO. ``export`` traces the layer with jax.export and writes the
+serialized StableHLO program (plus a human-readable .mlir dump) to
+``path``. True ONNX emission is intentionally unsupported — load the
+.stablehlo artifact with jax.export.deserialize, or use jit.save for
+paddle-style checkpoints.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .autograd.tape import functional_mode
+from .jit.api import _swap_params
+from .static import InputSpec
+from .tensor import Tensor
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version=None, **kwargs):
+    """Export ``layer`` as serialized StableHLO at ``path``.stablehlo."""
+    if input_spec is None:
+        raise ValueError("input_spec is required for export")
+
+    args = []
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            shape = [1 if s is None or s < 0 else int(s) for s in spec.shape]
+            args.append(jnp.zeros(shape, dtype=spec.dtype or "float32"))
+        else:
+            args.append(jnp.asarray(spec._data if isinstance(spec, Tensor)
+                                    else spec))
+
+    params = dict(layer.named_parameters())
+    param_vals = {k: p._data for k, p in params.items()}
+
+    def fn(pv, *xs):
+        with functional_mode(), _swap_params(params, pv):
+            out = layer(*[Tensor(x) for x in xs])
+        return out._data if isinstance(out, Tensor) else out
+
+    exported = jax.export.export(jax.jit(fn))(param_vals, *args)
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    blob = exported.serialize()
+    with open(path + ".stablehlo", "wb") as f:
+        f.write(blob)
+    with open(path + ".mlir", "w") as f:
+        f.write(str(exported.mlir_module()))
+    return path + ".stablehlo"

@@ -1,0 +1,217 @@
+"""Profiler: paddle.profiler API surface over jax.profiler.
+
+Reference: python/paddle/profiler/profiler.py (Profiler, ProfilerTarget,
+make_scheduler, export_chrome_tracing) and utils.py (RecordEvent). The
+reference's CUPTI/host tracer is replaced by the XLA/TPU profiler:
+``start``/``stop`` bracket a ``jax.profiler`` trace whose output
+(perfetto/tensorboard trace dir) covers device kernels, XLA fusions, ICI
+collectives and host python — strictly more than the reference's op-level
+timeline. RecordEvent lowers to jax.profiler.TraceAnnotation so custom
+ranges show up inside the device trace.
+"""
+from __future__ import annotations
+
+import os
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+import jax
+
+__all__ = [
+    "ProfilerState", "ProfilerTarget", "make_scheduler",
+    "export_chrome_tracing", "export_protobuf", "Profiler", "RecordEvent",
+    "RecordInstantEvent", "load_profiler_result",
+]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Step-phase scheduler, same semantics as the reference."""
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def _default_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready handler: the jax trace dir already contains
+    perfetto/chrome-compatible traces; this just records the destination."""
+    def handler(prof):
+        prof._export_dir = dir_name
+    return handler
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+class Profiler:
+    """paddle.profiler.Profiler over jax.profiler traces.
+
+    Usage matches the reference::
+
+        with profiler.Profiler(targets=[...], on_trace_ready=...) as p:
+            for step ...: train(); p.step()
+        p.summary()
+    """
+
+    def __init__(self, *, targets: Optional[Iterable[ProfilerTarget]] = None,
+                 scheduler=None, on_trace_ready=None, timer_only=False,
+                 record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self.targets = list(targets or [ProfilerTarget.CPU,
+                                        ProfilerTarget.TPU])
+        if isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self.scheduler = make_scheduler(closed=max(0, lo), ready=0,
+                                            record=hi - lo, repeat=1)
+        else:
+            self.scheduler = scheduler or _default_scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self._export_dir = os.path.join("profiler_log",
+                                        time.strftime("%Y%m%d_%H%M%S"))
+        self.current_state = ProfilerState.CLOSED
+        self._tracing = False
+        self._step = 0
+        self._step_times = []
+        self._t0 = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        self.current_state = self.scheduler(self._step)
+        self._maybe_toggle()
+        self._t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+        self.current_state = ProfilerState.CLOSED
+        if self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._t0 is not None:
+            self._step_times.append(now - self._t0)
+        self._t0 = now
+        self._step += 1
+        self.current_state = self.scheduler(self._step)
+        self._maybe_toggle()
+
+    def _maybe_toggle(self):
+        want = self.current_state in (ProfilerState.RECORD,
+                                      ProfilerState.RECORD_AND_RETURN)
+        if want and not self._tracing and not self.timer_only:
+            os.makedirs(self._export_dir, exist_ok=True)
+            jax.profiler.start_trace(self._export_dir)
+            self._tracing = True
+        elif not want and self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- reporting -----------------------------------------------------------
+
+    def step_info(self, unit=None) -> str:
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+        t = np.asarray(self._step_times)
+        return (f"steps: {len(t)}  avg: {t.mean()*1e3:.2f} ms  "
+                f"min: {t.min()*1e3:.2f} ms  max: {t.max()*1e3:.2f} ms")
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        print(self.step_info())
+        if not self.timer_only:
+            print(f"trace dir: {self._export_dir} "
+                  f"(tensorboard --logdir or perfetto)")
+
+    def export(self, path: str, format: str = "json"):
+        print(f"trace already exported to {self._export_dir}")
+
+
+class RecordEvent:
+    """Custom named range; shows in the device trace (TraceAnnotation)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = None
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with RecordEvent(self.name):
+                return fn(*a, **k)
+        return wrapper
+
+
+class RecordInstantEvent(RecordEvent):
+    pass
+
+
+def load_profiler_result(filename: str):
+    raise NotImplementedError(
+        "jax traces are viewed with tensorboard/perfetto, not reloaded here")

@@ -1,0 +1,191 @@
+// Native host runtime for the TPU data pipeline.
+//
+// TPU-side analog of the reference's C++ buffered reader
+// (paddle/fluid/operators/reader/buffered_reader.cc) and its DataLoader
+// worker pool: a bounded ring buffer of byte blobs decouples python-side
+// batch production from the device feed (calls release the GIL via ctypes,
+// so producer backpressure and consumer waits run truly concurrently), and
+// a persistent thread pool does parallel sample->batch memcpy gather.
+//
+// Build: make -C paddle_tpu/runtime/cpp   (g++ -O3 -shared -pthread)
+// API consumed by paddle_tpu/runtime/prefetcher.py + native.py via ctypes.
+
+#include <condition_variable>
+#include <cstring>
+#include <cstdlib>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Blob {
+  char* data;
+  long size;
+};
+
+struct Ring {
+  std::deque<Blob> q;
+  size_t cap;
+  bool closed = false;
+  std::mutex mu;
+  std::condition_variable cv_space;  // signalled when a slot frees up
+  std::condition_variable cv_data;   // signalled when data or close arrives
+};
+
+// ---------------------------------------------------------------------------
+// persistent thread pool (shared by gather ops)
+// ---------------------------------------------------------------------------
+
+class Pool {
+ public:
+  explicit Pool(int n) : stop_(false) {
+    for (int i = 0; i < n; ++i)
+      workers_.emplace_back([this] { Loop(); });
+  }
+  ~Pool() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+  void Run(const std::vector<std::function<void()>>& tasks) {
+    std::mutex done_mu;
+    std::condition_variable done_cv;
+    size_t remaining = tasks.size();
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (const auto& t : tasks) {
+        q_.push_back([&, t] {
+          t();
+          std::lock_guard<std::mutex> dg(done_mu);
+          if (--remaining == 0) done_cv.notify_one();
+        });
+      }
+    }
+    cv_.notify_all();
+    std::unique_lock<std::mutex> dl(done_mu);
+    done_cv.wait(dl, [&] { return remaining == 0; });
+  }
+
+ private:
+  void Loop() {
+    for (;;) {
+      std::function<void()> task;
+      {
+        std::unique_lock<std::mutex> l(mu_);
+        cv_.wait(l, [this] { return stop_ || !q_.empty(); });
+        if (stop_ && q_.empty()) return;
+        task = std::move(q_.front());
+        q_.pop_front();
+      }
+      task();
+    }
+  }
+  std::vector<std::thread> workers_;
+  std::deque<std::function<void()>> q_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool stop_;
+};
+
+Pool* GlobalPool() {
+  static Pool pool(std::max(2u, std::thread::hardware_concurrency() / 2));
+  return &pool;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// ring buffer
+// ---------------------------------------------------------------------------
+
+void* rb_create(int depth) {
+  Ring* r = new Ring();
+  r->cap = depth > 0 ? static_cast<size_t>(depth) : 1;
+  return r;
+}
+
+// Copies [data, data+n) into the ring. Blocks while full. Returns 0 on
+// success, -1 if the ring was closed.
+int rb_push(void* h, const char* data, long n) {
+  Ring* r = static_cast<Ring*>(h);
+  char* buf = static_cast<char*>(std::malloc(n > 0 ? n : 1));
+  std::memcpy(buf, data, n);
+  std::unique_lock<std::mutex> l(r->mu);
+  r->cv_space.wait(l, [r] { return r->q.size() < r->cap || r->closed; });
+  if (r->closed) {
+    std::free(buf);
+    return -1;
+  }
+  r->q.push_back(Blob{buf, n});
+  r->cv_data.notify_one();
+  return 0;
+}
+
+// Pops the oldest blob; caller owns the buffer (free via rb_free_buf).
+// Blocks while empty; returns nullptr once the ring is closed AND drained.
+void* rb_pop(void* h, long* n) {
+  Ring* r = static_cast<Ring*>(h);
+  std::unique_lock<std::mutex> l(r->mu);
+  r->cv_data.wait(l, [r] { return !r->q.empty() || r->closed; });
+  if (r->q.empty()) {
+    *n = 0;
+    return nullptr;
+  }
+  Blob b = r->q.front();
+  r->q.pop_front();
+  r->cv_space.notify_one();
+  *n = b.size;
+  return b.data;
+}
+
+void rb_free_buf(void* p) { std::free(p); }
+
+// Producer signals end-of-stream (consumer drains whatever is queued).
+void rb_close(void* h) {
+  Ring* r = static_cast<Ring*>(h);
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    r->closed = true;
+  }
+  r->cv_data.notify_all();
+  r->cv_space.notify_all();
+}
+
+void rb_destroy(void* h) {
+  Ring* r = static_cast<Ring*>(h);
+  for (auto& b : r->q) std::free(b.data);
+  delete r;
+}
+
+// ---------------------------------------------------------------------------
+// parallel batch gather: stack n equal-size sample buffers into dst
+// (the memcpy half of collate/np.stack, spread over the pool)
+// ---------------------------------------------------------------------------
+
+void pf_gather(char* dst, const char** srcs, const long* sizes, int n) {
+  long total = 0;
+  std::vector<long> offs(n);
+  for (int i = 0; i < n; ++i) {
+    offs[i] = total;
+    total += sizes[i];
+  }
+  if (n <= 2 || total < (1 << 20)) {  // small: sequential beats dispatch
+    for (int i = 0; i < n; ++i) std::memcpy(dst + offs[i], srcs[i], sizes[i]);
+    return;
+  }
+  std::vector<std::function<void()>> tasks;
+  tasks.reserve(n);
+  for (int i = 0; i < n; ++i)
+    tasks.push_back([=] { std::memcpy(dst + offs[i], srcs[i], sizes[i]); });
+  GlobalPool()->Run(tasks);
+}
+
+}  // extern "C"

@@ -1,0 +1,89 @@
+"""ctypes loader for the native host runtime (runtime/cpp/prefetch.cc).
+
+Builds the shared library on first use when a C++ toolchain is present
+(make -C runtime/cpp); otherwise raises ImportError so callers fall back to
+pure-python paths.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_LIB = None
+_LOCK = threading.Lock()
+_HERE = os.path.dirname(__file__)
+_SO = os.path.join(_HERE, "cpp", "libptpu_runtime.so")
+
+
+def _build():
+    src = os.path.join(_HERE, "cpp", "prefetch.cc")
+    if not os.path.exists(src):
+        raise ImportError("native runtime source missing")
+    try:
+        subprocess.run(["make", "-C", os.path.join(_HERE, "cpp")],
+                       check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        raise ImportError(f"native runtime build failed: {e}") from e
+
+
+def load_lib():
+    """Load (building if needed) the native runtime; raises ImportError."""
+    global _LIB
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        if not os.path.exists(_SO):
+            _build()
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:  # corrupt / wrong-arch .so: fall back cleanly
+            raise ImportError(f"native runtime unloadable: {e}") from e
+        lib.rb_create.restype = ctypes.c_void_p
+        lib.rb_create.argtypes = [ctypes.c_int]
+        lib.rb_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_long]
+        lib.rb_push.restype = ctypes.c_int
+        lib.rb_pop.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_long)]
+        lib.rb_pop.restype = ctypes.c_void_p
+        lib.rb_free_buf.argtypes = [ctypes.c_void_p]
+        lib.rb_close.argtypes = [ctypes.c_void_p]
+        lib.rb_destroy.argtypes = [ctypes.c_void_p]
+        lib.pf_gather.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_long), ctypes.c_int]
+        _LIB = lib
+        return _LIB
+
+
+def gather_stack(arrays):
+    """np.stack equal-shape sample arrays via the C++ parallel gather.
+
+    Falls back to np.stack for small batches or when the runtime is
+    unavailable.
+    """
+    n = len(arrays)
+    total = sum(a.nbytes for a in arrays)
+    a0 = arrays[0]
+    uniform = all(a.shape == a0.shape and a.dtype == a0.dtype
+                  for a in arrays)
+    if n < 4 or total < (1 << 20) or not uniform:
+        return np.stack(arrays)  # np.stack raises cleanly on ragged input
+    try:
+        lib = load_lib()
+    except ImportError:
+        return np.stack(arrays)
+    out = np.empty((n, *a0.shape), dtype=a0.dtype)
+    srcs = (ctypes.c_void_p * n)()
+    sizes = (ctypes.c_long * n)()
+    keep = []
+    for i, a in enumerate(arrays):
+        c = np.ascontiguousarray(a)
+        keep.append(c)
+        srcs[i] = c.ctypes.data
+        sizes[i] = c.nbytes
+    lib.pf_gather(out.ctypes.data, srcs, sizes, n)
+    return out

@@ -12,37 +12,15 @@ DataLoader then uses its python thread queue.
 from __future__ import annotations
 
 import ctypes
-import os
 import pickle
 import threading
 
-_LIB = None
-
-
-def _load_lib():
-    global _LIB
-    if _LIB is not None:
-        return _LIB
-    here = os.path.dirname(__file__)
-    path = os.path.join(here, "cpp", "libptpu_runtime.so")
-    if not os.path.exists(path):
-        raise ImportError("native runtime not built")
-    _LIB = ctypes.CDLL(path)
-    _LIB.rb_create.restype = ctypes.c_void_p
-    _LIB.rb_create.argtypes = [ctypes.c_int]
-    _LIB.rb_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long]
-    _LIB.rb_push.restype = ctypes.c_int
-    _LIB.rb_pop.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_long)]
-    _LIB.rb_pop.restype = ctypes.c_void_p
-    _LIB.rb_free_buf.argtypes = [ctypes.c_void_p]
-    _LIB.rb_close.argtypes = [ctypes.c_void_p]
-    _LIB.rb_destroy.argtypes = [ctypes.c_void_p]
-    return _LIB
+from .native import load_lib
 
 
 class NativePrefetcher:
     def __init__(self, batch_iter, depth=8):
-        lib = _load_lib()
+        lib = load_lib()
         self._lib = lib
         self._rb = lib.rb_create(depth)
         self._producer = threading.Thread(

@@ -93,7 +93,9 @@ class GPTForCausalLM(Layer):
         hidden = self.gpt(input_ids)
         logits = self.lm_head(hidden)
         if labels is not None:
+            # next-token prediction: logits at t score labels at t+1
             return F.cross_entropy(
-                reshape(logits, (-1, self.config.vocab_size)).astype("float32"),
-                reshape(labels, (-1,)))
+                reshape(logits[:, :-1],
+                        (-1, self.config.vocab_size)).astype("float32"),
+                reshape(labels[:, 1:], (-1,)))
         return logits

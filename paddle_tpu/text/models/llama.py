@@ -115,18 +115,18 @@ class LlamaAttention(Layer):
             v = concat([cache[1], v], axis=1)
             new_cache = (k.detach(), v.detach())
 
+        use_ring = False
         if self.sequence_parallel and cache is None:
             from ...distributed.mesh import get_mesh, mesh_axis_size
-            if mesh_axis_size("sep") > 1:
-                mesh = get_mesh()
-                from ...ops.ring_attention import ring_attention
+            use_ring = mesh_axis_size("sep") > 1
+        if use_ring:
+            from ...ops.ring_attention import ring_attention
+            mesh = get_mesh()
 
-                def ring_fn(qq, kk, vv):
-                    return ring_attention(qq, kk, vv, mesh=mesh, causal=True)
+            def ring_fn(qq, kk, vv):
+                return ring_attention(qq, kk, vv, mesh=mesh, causal=True)
 
-                out = apply(ring_fn, q, k, v)
-            else:
-                out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            out = apply(ring_fn, q, k, v)
         else:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         out = self.o_proj(reshape(out, (b, l, h)))
@@ -222,25 +222,35 @@ class LlamaForCausalLM(Layer):
         super().__init__()
         self.config = config
         self.llama = LlamaModel(config)
-        self.lm_head = Linear(config.hidden_size, config.vocab_size,
-                              bias_attr=False)
-        self.lm_head.weight.pspec = P(None, "tp")
-        if config.dtype == "bfloat16":
-            self.lm_head.to(dtype="bfloat16")
-        if config.tie_word_embeddings:
-            self.lm_head.weight = self.llama.embed_tokens.weight
+        self.tie = config.tie_word_embeddings
+        if not self.tie:
+            # tied head reuses embed_tokens.weight [vocab, h] transposed
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+            self.lm_head.weight.pspec = P(None, "tp")
+            if config.dtype == "bfloat16":
+                self.lm_head.to(dtype="bfloat16")
+
+    def _logits(self, hidden):
+        if self.tie:
+            from ...tensor_ops.math import matmul
+            return matmul(hidden, self.llama.embed_tokens.weight,
+                          transpose_y=True)
+        return self.lm_head(hidden)
 
     def forward(self, input_ids, position_ids=None, labels=None, caches=None):
         if caches is not None:
             hidden, new_caches = self.llama(input_ids, position_ids, caches)
-            logits = self.lm_head(hidden)
+            logits = self._logits(hidden)
             return logits, new_caches
         hidden = self.llama(input_ids, position_ids)
-        logits = self.lm_head(hidden)
+        logits = self._logits(hidden)
         if labels is not None:
+            # next-token prediction: logits at t score labels at t+1
             loss = F.cross_entropy(
-                reshape(logits, (-1, self.config.vocab_size)).astype("float32"),
-                reshape(labels, (-1,)))
+                reshape(logits[:, :-1],
+                        (-1, self.config.vocab_size)).astype("float32"),
+                reshape(labels[:, 1:], (-1,)))
             return loss
         return logits
 

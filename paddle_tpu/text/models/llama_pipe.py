@@ -106,10 +106,12 @@ class LlamaForCausalLMPipe(Layer):
         self.embed_tokens = Embedding(c.vocab_size, c.hidden_size)
         self.embed_tokens.weight.pspec = P("tp", None)
         self.norm = RMSNorm(c.hidden_size, c.rms_norm_eps)
-        self.lm_head = Linear(c.hidden_size, c.vocab_size, bias_attr=False)
-        self.lm_head.weight.pspec = P(None, "tp")
-        if c.tie_word_embeddings:
-            self.lm_head.weight = self.embed_tokens.weight
+        self.tie = c.tie_word_embeddings
+        if not self.tie:
+            # tied head reuses embed_tokens.weight [vocab, h] transposed
+            self.lm_head = Linear(c.hidden_size, c.vocab_size,
+                                  bias_attr=False)
+            self.lm_head.weight.pspec = P(None, "tp")
         if c.dtype == "bfloat16":
             self.to(dtype="bfloat16")
 
@@ -147,9 +149,14 @@ class LlamaForCausalLMPipe(Layer):
 
         x = apply(run, x, *tensors)
         x = self.norm(x)
-        logits = self.lm_head(x)
+        if self.tie:
+            from ...tensor_ops.math import matmul
+            logits = matmul(x, self.embed_tokens.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(x)
         if labels is not None:
+            # next-token prediction: logits at t score labels at t+1
             return F.cross_entropy(
-                reshape(logits, (-1, c.vocab_size)).astype("float32"),
-                reshape(labels, (-1,)))
+                reshape(logits[:, :-1], (-1, c.vocab_size)).astype("float32"),
+                reshape(labels[:, 1:], (-1,)))
         return logits

@@ -19,11 +19,6 @@ from paddle_tpu.distributed.fleet import DistributedStrategy
 from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
 
 
-def _reset_mesh():
-    mesh_mod.set_mesh(None)
-    fleet._fleet_state = getattr(fleet, "_fleet_state", None)
-
-
 def _run_llama_steps(dp=1, mp=1, sharding=1, sep=1, stage=3, steps=3,
                      seq=32, batch=8, seed=0, sequence_parallel=False):
     """Build a fresh Llama-tiny + fleet train step; return loss history."""
