@@ -1,0 +1,18 @@
+"""Graph-learning primitives.
+
+Reference surface: python/paddle/geometric (message_passing/send_recv.py)
+plus the segment reductions from python/paddle/incubate/tensor/math.py.
+TPU-native design: message passing is gather → elementwise combine →
+``jax.ops.segment_*`` (which XLA lowers to sorted scatter-reduce); all
+static-shaped given ``out_size``/eager index maxima, and differentiable
+through the tape.
+"""
+from .message_passing import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum,
+    send_u_recv, send_ue_recv, send_uv,
+)
+
+__all__ = [
+    'send_u_recv', 'send_ue_recv', 'send_uv',
+    'segment_sum', 'segment_mean', 'segment_max', 'segment_min',
+]

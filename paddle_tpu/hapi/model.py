@@ -185,17 +185,44 @@ class Model:
                             num_workers=num_workers) \
             if isinstance(test_data, Dataset) else test_data
         outputs = []
+        k = self._n_inputs()  # signature parse once, not per batch
         for batch in loader:
-            xs, _ = self._split_batch(batch, has_labels=False)
+            if isinstance(batch, (list, tuple)):
+                xs = list(batch[:k]) if (k is not None
+                                         and k < len(batch)) else list(batch)
+            else:
+                xs = [batch]
             outputs.append(self.predict_batch(xs))
         if stack_outputs and outputs:
             from ..tensor_ops.manipulation import concat
             return [concat(outputs, axis=0)]
         return outputs
 
-    def _split_batch(self, batch, has_labels=True):
+    def _n_inputs(self):
+        """How many leading batch elements are network inputs: declared
+        InputSpecs win; otherwise the forward() MAX positional arity, so
+        optional-but-real inputs (masks, initial states) are kept and only
+        genuinely un-acceptable trailing elements (labels) are dropped."""
+        if self._inputs is not None:
+            specs = self._inputs if isinstance(self._inputs, (list, tuple)) \
+                else [self._inputs]
+            return len(specs)
+        import inspect
+        try:
+            sig = inspect.signature(self.network.forward)
+        except (TypeError, ValueError):
+            return None
+        n = 0
+        for p in sig.parameters.values():
+            if p.kind == p.VAR_POSITIONAL:
+                return None  # *args: take the whole batch
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+                n += 1
+        return n or None
+
+    def _split_batch(self, batch):
         if isinstance(batch, (list, tuple)):
-            if has_labels and len(batch) >= 2:
+            if len(batch) >= 2:
                 return list(batch[:-1]), [batch[-1]]
             return list(batch), []
         return [batch], []

@@ -31,14 +31,28 @@ def save(layer, path, input_spec=None, **configs):
     if input_spec is not None:
         try:
             from jax import export as jax_export
-            shapes = [jax.ShapeDtypeStruct(tuple(s.shape), s.dtype)
-                      for s in input_spec]
+            scope = jax_export.SymbolicScope()
+            shapes = []
+            for i, s in enumerate(input_spec):
+                # dynamic dims (None/-1) export as symbolic dimensions; only
+                # dim 0 (batch) shares one symbol across inputs so ids/mask
+                # pairs stay unified — later dynamic dims get per-input
+                # symbols so e.g. src_len and tgt_len aren't forced equal
+                dims = [("d0" if j == 0 else f"d{i}_{j}")
+                        if (d is None or d < 0) else str(d)
+                        for j, d in enumerate(s.shape)]
+                shp = jax_export.symbolic_shape(",".join(dims), scope=scope)
+                shapes.append(jax.ShapeDtypeStruct(shp, s.dtype))
+
             def fwd(*xs):
                 out = layer(*[Tensor(x) for x in xs])
                 return out._data if isinstance(out, Tensor) else out
             exported = jax_export.export(jax.jit(fwd))(*shapes)
             hlo = exported.serialize()
-        except Exception:
+        except Exception as e:
+            import warnings
+            warnings.warn(f"jit.save: StableHLO export failed ({e}); "
+                          "artifact will carry weights only")
             hlo = None
     payload["stablehlo"] = hlo
     with open(path + ".pdmodel", "wb") as f:

@@ -1,0 +1,178 @@
+"""Short-time Fourier transform ops.
+
+Reference surface: python/paddle/signal.py (frame, overlap_add, stft,
+istft). TPU-native design: framing is a gather with a static index grid and
+overlap-add is its scatter-add transpose — both XLA-fusable, static-shaped,
+and differentiable through :func:`paddle_tpu.tensor.apply`; the FFTs lower
+to XLA's native fft HLO.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor import Tensor, apply
+
+
+def _n_frames(size: int, frame_length: int, hop_length: int) -> int:
+    if size < frame_length:
+        raise ValueError(
+            f"frame_length ({frame_length}) > axis size ({size})")
+    return 1 + (size - frame_length) // hop_length
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice ``x`` into overlapping frames along ``axis`` (must be first or
+    last). Output puts frames next to the sliced axis: for ``axis=-1``
+    shape ``(..., frame_length, num_frames)``; for ``axis=0``
+    ``(num_frames, frame_length, ...)``. Reference: signal.py::frame."""
+    if hop_length <= 0:
+        raise ValueError(f"hop_length must be > 0, got {hop_length}")
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    nd = xt.ndim
+    if axis not in (0, -1, nd - 1):
+        raise ValueError("frame only supports axis=0 or axis=-1")
+    last = axis in (-1, nd - 1)
+    size = xt.shape[-1 if last else 0]
+    n = _n_frames(size, frame_length, hop_length)
+
+    def _frame(v):
+        if last:
+            # (..., frame_length, n): idx[i, j] = j*hop + i
+            idx = (jnp.arange(frame_length)[:, None]
+                   + hop_length * jnp.arange(n)[None, :])
+            return v[..., idx]
+        idx = (hop_length * jnp.arange(n)[:, None]
+               + jnp.arange(frame_length)[None, :])
+        return v[idx]
+
+    return apply(_frame, xt)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Scatter-add the transpose of :func:`frame`. Reference:
+    signal.py::overlap_add."""
+    if hop_length <= 0:
+        raise ValueError(f"hop_length must be > 0, got {hop_length}")
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    nd = xt.ndim
+    if nd < 2:
+        raise ValueError("overlap_add expects rank >= 2")
+    last = axis in (-1, nd - 1)
+    if not last and axis != 0:
+        raise ValueError("overlap_add only supports axis=0 or axis=-1")
+    if last:
+        frame_length, n = xt.shape[-2], xt.shape[-1]
+    else:
+        n, frame_length = xt.shape[0], xt.shape[1]
+    out_len = (n - 1) * hop_length + frame_length
+
+    def _ola(v):
+        if last:
+            idx = (jnp.arange(frame_length)[:, None]
+                   + hop_length * jnp.arange(n)[None, :])
+            out = jnp.zeros(v.shape[:-2] + (out_len,), dtype=v.dtype)
+            return out.at[..., idx].add(v)
+        idx = (hop_length * jnp.arange(n)[:, None]
+               + jnp.arange(frame_length)[None, :])
+        out = jnp.zeros((out_len,) + v.shape[2:], dtype=v.dtype)
+        return out.at[idx].add(v)
+
+    return apply(_ola, xt)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode='reflect', normalized=False, onesided=True,
+         name=None):
+    """STFT of a 1D/2D real or complex signal. Output
+    ``(..., n_fft//2 + 1 | n_fft, num_frames)`` complex.
+    Reference: signal.py::stft."""
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    if xt.ndim not in (1, 2):
+        raise ValueError(f"stft expects rank 1 or 2, got {xt.ndim}")
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if win_length > n_fft:
+        raise ValueError("win_length must be <= n_fft")
+    is_complex = jnp.issubdtype(xt.dtype, jnp.complexfloating)
+    if is_complex and onesided:
+        raise ValueError("onesided is not supported for complex input")
+
+    if window is not None:
+        w = window._data if isinstance(window, Tensor) else jnp.asarray(window)
+    else:
+        w = jnp.ones((win_length,), dtype=jnp.float32)
+    if w.shape[0] != win_length:
+        raise ValueError("window length must equal win_length")
+    pad = (n_fft - win_length) // 2
+    w = jnp.pad(w, (pad, n_fft - win_length - pad))
+
+    def _stft(v, w):
+        if center:
+            cfg = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            v = jnp.pad(v, cfg, mode=pad_mode)
+        size = v.shape[-1]
+        n = _n_frames(size, n_fft, hop_length)
+        idx = (jnp.arange(n_fft)[:, None]
+               + hop_length * jnp.arange(n)[None, :])
+        frames = v[..., idx] * w[:, None]
+        frames = jnp.moveaxis(frames, -2, -1)  # (..., n, n_fft)
+        spec = (jnp.fft.fft(frames, axis=-1) if is_complex
+                else jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames.astype(jnp.complex64), axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.moveaxis(spec, -1, -2)  # (..., freq, n)
+
+    return apply(_stft, xt, Tensor(w, stop_gradient=True))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization (NOLA).
+    Reference: signal.py::istft."""
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    if xt.ndim not in (2, 3):
+        raise ValueError(f"istft expects rank 2 or 3, got {xt.ndim}")
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    n_freq = xt.shape[-2]
+    if onesided and n_freq != n_fft // 2 + 1:
+        raise ValueError("onesided istft expects n_fft//2+1 freq bins")
+    if not onesided and n_freq != n_fft:
+        raise ValueError("two-sided istft expects n_fft freq bins")
+
+    if window is not None:
+        w = window._data if isinstance(window, Tensor) else jnp.asarray(window)
+    else:
+        w = jnp.ones((win_length,), dtype=jnp.float32)
+    pad = (n_fft - win_length) // 2
+    w = jnp.pad(w, (pad, n_fft - win_length - pad))
+
+    def _istft(v, w):
+        frames = jnp.moveaxis(v, -1, -2)  # (..., n, freq)
+        if onesided:
+            sig = jnp.fft.irfft(frames, n=n_fft, axis=-1)
+        else:
+            sig = jnp.fft.ifft(frames, axis=-1)
+            if not return_complex:
+                sig = sig.real
+        if normalized:
+            sig = sig * jnp.sqrt(jnp.asarray(n_fft, sig.real.dtype))
+        n = sig.shape[-2]
+        sig = sig * w
+        idx = (hop_length * jnp.arange(n)[:, None]
+               + jnp.arange(n_fft)[None, :])
+        out_len = (n - 1) * hop_length + n_fft
+        out = jnp.zeros(sig.shape[:-2] + (out_len,), dtype=sig.dtype)
+        out = out.at[..., idx].add(sig)
+        env = jnp.zeros((out_len,), dtype=w.dtype)
+        env = env.at[idx].add(jnp.broadcast_to(w * w, (n, n_fft)))
+        out = out / jnp.where(env > 1e-11, env, 1.0)
+        if center:
+            out = out[..., n_fft // 2:out_len - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return apply(_istft, xt, Tensor(w, stop_gradient=True))

@@ -1,0 +1,120 @@
+"""hapi Model fit/evaluate/predict, DataLoader, save/load, jit.save/load."""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as optim
+from paddle_tpu.io import DataLoader, Dataset, TensorDataset, random_split
+
+
+class _SynthDataset(Dataset):
+    def __init__(self, n=64):
+        rng = np.random.default_rng(0)
+        self.x = rng.normal(size=(n, 8)).astype(np.float32)
+        self.w = rng.normal(size=(8,)).astype(np.float32)
+        self.y = (self.x @ self.w > 0).astype(np.int64)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _mlp():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+
+
+def test_hapi_fit_evaluate_predict():
+    ds = _SynthDataset()
+    model = paddle.Model(_mlp())
+    model.prepare(
+        optim.Adam(learning_rate=5e-2,
+                   parameters=model.network.parameters()),
+        nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    model.fit(ds, epochs=4, batch_size=16, verbose=0)
+    res = model.evaluate(ds, batch_size=16, verbose=0)
+    assert res["acc"] > 0.8, f"did not learn: {res}"
+    preds = model.predict(ds, batch_size=16, verbose=0)
+    stacked = np.concatenate([np.asarray(p._data) for p in preds])
+    assert stacked.shape == (64, 2)
+
+
+def test_hapi_callbacks_checkpoint():
+    from paddle_tpu.hapi.callbacks import EarlyStopping, ModelCheckpoint
+
+    ds = _SynthDataset()
+    with tempfile.TemporaryDirectory() as td:
+        model = paddle.Model(_mlp())
+        model.prepare(
+            optim.Adam(learning_rate=5e-2,
+                       parameters=model.network.parameters()),
+            nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+        model.fit(ds, epochs=2, batch_size=16, verbose=0,
+                  callbacks=[ModelCheckpoint(save_dir=td, save_freq=1)])
+        assert any(f.endswith(".pdparams") for root, _, fs in os.walk(td)
+                   for f in fs), "no checkpoint written"
+
+
+def test_dataloader_shuffle_and_split():
+    ds = _SynthDataset(60)
+    train, val = random_split(ds, [48, 12])
+    assert len(train) == 48 and len(val) == 12
+    dl = DataLoader(train, batch_size=16, shuffle=True, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 3
+    xb, yb = batches[0]
+    assert list(xb.shape) == [16, 8]
+
+
+def test_tensor_dataset_and_workers():
+    x = np.arange(40 * 4, dtype=np.float32).reshape(40, 4)
+    y = np.arange(40, dtype=np.int64)
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+    dl = DataLoader(ds, batch_size=10, num_workers=2)
+    total = sum(int(b[1].shape[0]) for b in dl)
+    assert total == 40
+
+
+def test_save_load_optimizer_state():
+    model = _mlp()
+    opt = optim.Adam(learning_rate=1e-3, parameters=model.parameters())
+    x = paddle.to_tensor(np.ones((4, 8), dtype=np.float32))
+    loss = model(x).sum()
+    loss.backward()
+    opt.step()
+    with tempfile.TemporaryDirectory() as td:
+        mp = os.path.join(td, "m.pdparams")
+        op = os.path.join(td, "o.pdopt")
+        paddle.save(model.state_dict(), mp)
+        paddle.save(opt.state_dict(), op)
+        m2 = _mlp()
+        o2 = optim.Adam(learning_rate=1e-3, parameters=m2.parameters())
+        m2.set_state_dict(paddle.load(mp))
+        o2.set_state_dict(paddle.load(op))
+        for (k1, v1), (k2, v2) in zip(sorted(model.state_dict().items()),
+                                      sorted(m2.state_dict().items())):
+            assert k1 == k2
+            np.testing.assert_array_equal(np.asarray(v1._data),
+                                          np.asarray(v2._data))
+
+
+def test_jit_save_load_roundtrip():
+    from paddle_tpu import jit
+    from paddle_tpu.static import InputSpec
+
+    layer = _mlp()
+    layer.eval()
+    x = paddle.to_tensor(
+        np.random.default_rng(1).normal(size=(2, 8)).astype(np.float32))
+    ref = layer(x).numpy()
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "model")
+        jit.save(layer, path, input_spec=[InputSpec([None, 8], "float32")])
+        loaded = jit.load(path)
+        out = loaded(x)
+        out = out.numpy() if hasattr(out, "numpy") else np.asarray(out)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
