@@ -29,9 +29,16 @@ from .autograd.tape import set_grad_enabled  # noqa: F401
 
 from . import amp  # noqa: F401
 from . import autograd  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import cost_model  # noqa: F401
+from . import device  # noqa: F401
 from . import distributed  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
+from . import geometric  # noqa: F401
+from . import hub  # noqa: F401
+from . import incubate  # noqa: F401
+from . import inference  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401
@@ -39,11 +46,22 @@ from . import nn  # noqa: F401
 from . import onnx  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
+from . import reader  # noqa: F401
 from . import regularizer  # noqa: F401
+from . import signal  # noqa: F401
+from . import sparse  # noqa: F401
 from . import static  # noqa: F401
+from . import sysconfig  # noqa: F401
 from . import text  # noqa: F401
 from . import utils  # noqa: F401
 from . import vision  # noqa: F401
+
+from .batch import batch  # noqa: F401
+from .device import (  # noqa: F401
+    is_compiled_with_cinn, is_compiled_with_cuda, is_compiled_with_ipu,
+    is_compiled_with_mlu, is_compiled_with_npu, is_compiled_with_rocm,
+    is_compiled_with_xpu,
+)
 
 from .framework.io import load, save  # noqa: F401
 from .hapi.model import Model  # noqa: F401

@@ -1,0 +1,20 @@
+"""Reference: python/paddle/batch.py — minibatch generator wrapper."""
+from __future__ import annotations
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap a sample reader into a batch reader."""
+    if batch_size <= 0:
+        raise ValueError("batch_size should be a positive value")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

@@ -1,0 +1,8 @@
+"""Reference: python/paddle/callbacks.py — re-export of hapi callbacks."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    VisualDL,
+)
+
+__all__ = ['Callback', 'ProgBarLogger', 'ModelCheckpoint', 'LRScheduler',
+           'EarlyStopping', 'VisualDL']

@@ -1,0 +1,64 @@
+"""Device management namespace.
+
+Reference: python/paddle/device/__init__.py. The real device logic lives in
+paddle_tpu.framework (TPU/CPU via jax.devices); this module provides the
+`paddle.device.*` API surface, including the cuda submodule whose queries
+report absence (we target TPU, not CUDA).
+"""
+from ..framework import (  # noqa: F401
+    CPUPlace, CUDAPlace, TPUPlace, device_count, get_device, set_device,
+)
+from . import cuda  # noqa: F401
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    # XLA plays CINN's role and is always present
+    return False
+
+
+def get_all_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+XPUPlace = CPUPlace
+IPUPlace = CPUPlace
+MLUPlace = CPUPlace
+NPUPlace = CPUPlace

@@ -1,0 +1,71 @@
+"""CUDA device queries — present for API parity, report no CUDA.
+
+Reference: python/paddle/device/cuda/__init__.py. On the TPU stack these
+answer honestly (0 devices); memory/stream utilities map to their
+TPU-runtime equivalents where meaningful.
+"""
+from __future__ import annotations
+
+
+def device_count():
+    return 0
+
+
+def current_stream(device=None):
+    return None
+
+
+def synchronize(device=None):
+    import jax
+    # block on all outstanding async dispatches (device-agnostic)
+    jax.effects_barrier()
+    return 0
+
+
+def empty_cache():
+    return None
+
+
+def max_memory_allocated(device=None):
+    return _mem_stat("peak_bytes_in_use")
+
+
+def max_memory_reserved(device=None):
+    return _mem_stat("largest_alloc_size")
+
+
+def memory_allocated(device=None):
+    return _mem_stat("bytes_in_use")
+
+
+def memory_reserved(device=None):
+    return _mem_stat("bytes_reserved")
+
+
+def _mem_stat(key):
+    import jax
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return int(stats.get(key, 0))
+    except Exception:
+        return 0
+
+
+def get_device_properties(device=None):
+    import jax
+    d = jax.local_devices()[0]
+    class _Props:
+        name = getattr(d, "device_kind", d.platform)
+        major = 0
+        minor = 0
+        total_memory = _mem_stat("bytes_limit")
+        multi_processor_count = 0
+    return _Props()
+
+
+def get_device_name(device=None):
+    return get_device_properties(device).name
+
+
+def get_device_capability(device=None):
+    return (0, 0)

@@ -1,0 +1,114 @@
+"""Segment reductions and graph message passing.
+
+Reference surface: python/paddle/geometric/message_passing/send_recv.py
+(send_u_recv, send_ue_recv, send_uv) and the segment reductions of
+python/paddle/incubate/tensor/math.py (segment_sum/mean/max/min).
+
+TPU-native design: gather → elementwise message → ``jax.ops.segment_*``.
+XLA lowers segment reductions to one sorted scatter-reduce over the MXU-fed
+gathered rows; everything is static-shaped when ``out_size`` is given (pass
+it inside jit — otherwise the segment count is read eagerly from the ids).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, apply
+
+
+def _ids(x):
+    v = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return v.astype(jnp.int32)
+
+
+def _num_segments(ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    try:
+        return int(np.asarray(jax.device_get(ids)).max()) + 1 if ids.size \
+            else 0
+    except jax.errors.ConcretizationTypeError:
+        raise ValueError(
+            "segment ids are traced: pass out_size= explicitly under jit")
+
+
+def _segment(op, data, ids, n):
+    if op == "sum":
+        return jax.ops.segment_sum(data, ids, num_segments=n)
+    if op == "mean":
+        tot = jax.ops.segment_sum(data, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                                  ids, num_segments=n)
+        return tot / jnp.maximum(cnt, 1)[(...,) + (None,) * (data.ndim - 1)]
+    if op == "max":
+        out = jax.ops.segment_max(data, ids, num_segments=n)
+        return jnp.where(jnp.isfinite(out), out, jnp.zeros_like(out))
+    if op == "min":
+        out = jax.ops.segment_min(data, ids, num_segments=n)
+        return jnp.where(jnp.isfinite(out), out, jnp.zeros_like(out))
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
+def _make_segment(op):
+    def fn(data, segment_ids, name=None):
+        dt = data if isinstance(data, Tensor) else Tensor(data)
+        ids = _ids(segment_ids)
+        n = _num_segments(ids, None)
+        return apply(lambda v: _segment(op, v, ids, n), dt)
+    fn.__name__ = f"segment_{op}"
+    fn.__doc__ = (f"Segment {op} along dim 0 by ``segment_ids`` "
+                  "(reference: incubate/tensor/math.py). Empty segments "
+                  "give 0.")
+    return fn
+
+
+segment_sum = _make_segment("sum")
+segment_mean = _make_segment("mean")
+segment_max = _make_segment("max")
+segment_min = _make_segment("min")
+
+
+_MSG_OPS = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather ``x`` rows at ``src_index`` and reduce them at ``dst_index``.
+    Reference: geometric/message_passing/send_recv.py::send_u_recv."""
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    src, dst = _ids(src_index), _ids(dst_index)
+    reduce_op = reduce_op.lower()
+    n = int(out_size) if out_size is not None \
+        else max(_num_segments(dst, None), xt.shape[0])
+    return apply(lambda v: _segment(reduce_op, v[src], dst, n), xt)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Like :func:`send_u_recv` but the message combines node features
+    ``x[src]`` with edge features ``y`` via ``message_op``. Reference:
+    send_recv.py::send_ue_recv."""
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    yt = y if isinstance(y, Tensor) else Tensor(y)
+    src, dst = _ids(src_index), _ids(dst_index)
+    msg = _MSG_OPS[message_op.lower()]
+    reduce_op = reduce_op.lower()
+    n = int(out_size) if out_size is not None \
+        else max(_num_segments(dst, None), xt.shape[0])
+    return apply(lambda v, e: _segment(reduce_op, msg(v[src], e), dst, n),
+                 xt, yt)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge messages ``message_op(x[src], y[dst])`` (no reduction).
+    Reference: send_recv.py::send_uv."""
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    yt = y if isinstance(y, Tensor) else Tensor(y)
+    src, dst = _ids(src_index), _ids(dst_index)
+    msg = _MSG_OPS[message_op.lower()]
+    return apply(lambda u, v: msg(u[src], v[dst]), xt, yt)

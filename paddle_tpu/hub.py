@@ -1,0 +1,67 @@
+"""paddle.hub — load models from a hubconf.py repo.
+
+Reference: python/paddle/hub.py (list/help/load with github/gitee/local
+sources). This environment has no network egress, so only source='local'
+is functional; remote sources raise with a clear message.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+HUB_CONF = "hubconf.py"
+VAR_DEPENDENCY = "dependencies"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, HUB_CONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {HUB_CONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    deps = getattr(mod, VAR_DEPENDENCY, [])
+    missing = [d for d in deps if importlib.util.find_spec(d) is None]
+    if missing:
+        raise RuntimeError(f"hub repo requires missing packages: {missing}")
+    return mod
+
+
+def _check_source(source):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            f"unknown source {source!r}: expected github/gitee/local")
+    if source != "local":
+        raise RuntimeError(
+            "paddle_tpu.hub: remote sources are unavailable in this "
+            "environment (no network egress); use source='local'")
+
+
+def list(repo_dir, source="github", force_reload=False):
+    """Entrypoint names exposed by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):
+    """Docstring of one entrypoint."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no callable entrypoint {model!r} in hubconf")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Instantiate entrypoint ``model`` from the repo."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no callable entrypoint {model!r} in hubconf")
+    return fn(**kwargs)

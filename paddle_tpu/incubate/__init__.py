@@ -1,0 +1,23 @@
+"""Incubating APIs.
+
+Reference surface: python/paddle/incubate/__init__.py — fused nn layers,
+LookAhead/ModelAverage optimizers, autotune, segment math, sparse (2.3-era
+location), incubate.autograd functional transforms. Here each maps to the
+TPU-native implementation living in the main package; the `incubate`
+namespace exists for API parity.
+"""
+from .. import sparse  # noqa: F401  (2.3-era paddle.incubate.sparse)
+from ..autograd import functional as autograd  # noqa: F401
+from ..geometric import (  # noqa: F401  (incubate/tensor/math.py)
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+from . import autotune  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+__all__ = [
+    'sparse', 'nn', 'optimizer', 'autotune', 'autograd',
+    'segment_sum', 'segment_mean', 'segment_max', 'segment_min',
+    'LookAhead', 'ModelAverage',
+]

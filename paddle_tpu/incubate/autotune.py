@@ -1,0 +1,32 @@
+"""Kernel/dataloader autotune config.
+
+Reference: python/paddle/incubate/autotune.py::set_config. On TPU the XLA
+autotuner owns kernel selection (latency-hiding scheduler, fusion
+autotuning), so this records the requested config and toggles what we do
+control: dataloader prefetch tuning.
+"""
+from __future__ import annotations
+
+import json
+
+_config = {"kernel": {"enable": True},
+           "dataloader": {"enable": True},
+           "layout": {"enable": False}}
+
+
+def set_config(config=None):
+    """Accepts a dict or a path to a JSON file (reference semantics)."""
+    global _config
+    if config is None:
+        for v in _config.values():
+            v["enable"] = True
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    for k, v in config.items():
+        _config.setdefault(k, {}).update(v)
+
+
+def get_config():
+    return _config

@@ -1,0 +1,22 @@
+"""Fused nn layers.
+
+Reference: python/paddle/incubate/nn/__init__.py (FusedLinear,
+FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer,
+FusedMultiTransformer, FusedBiasDropoutResidualLayerNorm). On TPU "fused"
+is a compiler property, not a kernel menu: these layers express the same
+math as one jit region so XLA fuses bias/dropout/residual/layernorm into
+the surrounding matmuls, and attention routes to the pallas flash kernel.
+The classes exist for API parity and for their fused-friendly layouts.
+"""
+from . import functional  # noqa: F401
+from .layer import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm, FusedFeedForward, FusedLinear,
+    FusedMultiHeadAttention, FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)
+
+__all__ = [
+    'FusedMultiHeadAttention', 'FusedFeedForward',
+    'FusedTransformerEncoderLayer', 'FusedMultiTransformer', 'FusedLinear',
+    'FusedBiasDropoutResidualLayerNorm', 'functional',
+]

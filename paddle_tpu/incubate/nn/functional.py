@@ -1,0 +1,124 @@
+"""Fused functional ops.
+
+Reference: python/paddle/incubate/nn/functional (fused_matmul_bias,
+fused_linear, fused_multi_head_attention, fused_feedforward,
+fused_bias_dropout_residual_layer_norm). Each is the composite math under
+one call so a jit trace presents XLA a single fusable region; on the
+reference these pick fused CUDA kernels — here the XLA scheduler and the
+pallas flash kernel play that role.
+"""
+from __future__ import annotations
+
+from ...nn import functional as F
+from ...tensor import Tensor
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """Reference: incubate/nn/functional/fused_matmul_bias.py."""
+    from ...tensor_ops.math import matmul
+    out = matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    return out + bias if bias is not None else out
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """Reference: incubate/nn/functional/fused_matmul_bias.py."""
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True, mode=
+        'upscale_in_train', name=None):
+    """LN(residual + dropout(x + bias)). Reference:
+    incubate/nn/functional/fused_transformer.py."""
+    y = x + bias if bias is not None else x
+    y = F.dropout(y, p=dropout_rate, training=training, mode=mode)
+    y = residual + y
+    d = y.shape[-1]
+    return F.layer_norm(y, (d,), weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False,
+        pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+        pre_ln_epsilon=1e-5, qkv_bias=None, linear_bias=None,
+        cache_kv=None, attn_mask=None, dropout_rate=0.5,
+        attn_dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode='upscale_in_train', ring_id=-1, add_residual=True, name=None):
+    """Fused MHA block: (pre-)LN → QKV proj → flash attention → out proj →
+    dropout → residual → (post-)LN.
+
+    ``qkv_weight``: (3, num_heads, head_dim, embed_dim) as in the
+    reference; ``x``: (batch, seq, embed_dim). Reference:
+    incubate/nn/functional/fused_transformer.py::fused_multi_head_attention.
+    """
+    from ...tensor_ops.manipulation import reshape, transpose
+
+    residual = x
+    d = x.shape[-1]
+    if pre_layer_norm:
+        x = F.layer_norm(x, (d,), weight=pre_ln_scale, bias=pre_ln_bias,
+                         epsilon=pre_ln_epsilon)
+    w = qkv_weight if isinstance(qkv_weight, Tensor) else Tensor(qkv_weight)
+    three, n_heads, head_dim, embed = w.shape
+    assert three == 3 and embed == d
+    # (B, S, D) @ (D, 3*H*Dh)
+    w2d = reshape(transpose(w, [3, 0, 1, 2]), [d, 3 * n_heads * head_dim])
+    qkv = x.matmul(w2d)
+    if qkv_bias is not None:
+        b = qkv_bias if isinstance(qkv_bias, Tensor) else Tensor(qkv_bias)
+        qkv = qkv + reshape(b, [3 * n_heads * head_dim])
+    b_, s = x.shape[0], x.shape[1]
+    qkv = reshape(qkv, [b_, s, 3, n_heads, head_dim])
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    cache_kv_out = None
+    if cache_kv is not None:
+        from ...tensor_ops.manipulation import concat
+        k = concat([cache_kv[0], k], axis=1)
+        v = concat([cache_kv[1], v], axis=1)
+        cache_kv_out = (k, v)
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0, training=training)
+    out = reshape(out, [b_, s, n_heads * head_dim])
+    out = F.linear(out, linear_weight, linear_bias)
+    out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, (d,), weight=ln_scale, bias=ln_bias,
+                           epsilon=ln_epsilon)
+    # reference returns (out, updated cache) in decode mode
+    return (out, cache_kv_out) if cache_kv is not None else out
+
+
+def fused_feedforward(
+        x, linear1_weight, linear2_weight, linear1_bias=None,
+        linear2_bias=None, ln1_scale=None, ln1_bias=None, ln2_scale=None,
+        ln2_bias=None, dropout1_rate=0.5, dropout2_rate=0.5,
+        activation='relu', ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+        pre_layer_norm=False, training=True, mode='upscale_in_train',
+        ring_id=-1, add_residual=True, name=None):
+    """Fused FFN block: (pre-)LN → linear → act → dropout → linear →
+    dropout → residual → (post-)LN. Reference:
+    incubate/nn/functional/fused_transformer.py::fused_feedforward."""
+    residual = x
+    d = x.shape[-1]
+    if pre_layer_norm:
+        x = F.layer_norm(x, (d,), weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    act = getattr(F, activation)
+    y = F.linear(x, linear1_weight, linear1_bias)
+    y = F.dropout(act(y), p=dropout1_rate, training=training, mode=mode)
+    y = F.linear(y, linear2_weight, linear2_bias)
+    y = F.dropout(y, p=dropout2_rate, training=training, mode=mode)
+    if add_residual:
+        y = residual + y
+    if not pre_layer_norm:
+        y = F.layer_norm(y, (d,), weight=ln2_scale, bias=ln2_bias,
+                         epsilon=ln2_epsilon)
+    return y

@@ -1,0 +1,10 @@
+"""Incubating optimizers.
+
+Reference: python/paddle/incubate/optimizer (lookahead.py,
+modelaverage.py). Both wrap an inner optimizer / parameter set with extra
+slow-weight state kept as device arrays.
+"""
+from .lookahead import LookAhead  # noqa: F401
+from .modelaverage import ModelAverage  # noqa: F401
+
+__all__ = ['LookAhead', 'ModelAverage']

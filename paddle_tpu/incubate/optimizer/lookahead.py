@@ -1,0 +1,73 @@
+"""LookAhead optimizer (arXiv:1907.08610).
+
+Reference: python/paddle/incubate/optimizer/lookahead.py — wraps an inner
+("fast") optimizer; every k steps the slow weights move toward the fast
+weights by alpha and the fast weights are reset to them.
+"""
+from __future__ import annotations
+
+
+class LookAhead:
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner_optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        if not (isinstance(k, int) and k > 0):
+            raise ValueError("k should be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step = 0
+        self._slow = {}  # id(param) -> slow weight array
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def set_lr(self, v):
+        self.inner_optimizer.set_lr(v)
+
+    def _seed_slow(self):
+        for p in self.inner_optimizer._all_params():
+            if id(p) not in self._slow:
+                self._slow[id(p)] = p._data
+
+    def _sync(self):
+        for p in self.inner_optimizer._all_params():
+            slow = self._slow.get(id(p), p._data)
+            slow = slow + self.alpha * (p._data - slow)
+            self._slow[id(p)] = slow
+            p._data = slow
+
+    def step(self):
+        if self._step == 0:
+            self._seed_slow()  # slow weights start at the initial weights
+        self.inner_optimizer.step()
+        self._step += 1
+        if self._step % self.k == 0:
+            self._sync()
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def minimize(self, loss, **kw):
+        if self._step == 0:
+            self._seed_slow()
+        out = self.inner_optimizer.minimize(loss, **kw)
+        self._step += 1
+        if self._step % self.k == 0:
+            self._sync()
+        return out
+
+    def state_dict(self):
+        st = self.inner_optimizer.state_dict()
+        st["@lookahead_step"] = self._step
+        return st
+
+    def set_state_dict(self, state):
+        self._step = int(state.pop("@lookahead_step", 0))
+        self.inner_optimizer.set_state_dict(state)

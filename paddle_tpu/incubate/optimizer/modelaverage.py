@@ -1,0 +1,92 @@
+"""ModelAverage optimizer.
+
+Reference: python/paddle/incubate/optimizer/modelaverage.py and the
+average_accumulates kernel — per parameter it keeps three partial sums
+(sum_1 current bucket, sum_2 reserved, sum_3 rolled buckets) plus
+accumulate counters; the evaluation weights are
+(sum_1+sum_2+sum_3) / (num_accumulates + old_num_accumulates).
+``apply()`` swaps averaged weights in, ``restore()`` swaps them back.
+"""
+from __future__ import annotations
+
+import contextlib
+
+
+class _Slot:
+    __slots__ = ("sum_1", "sum_2", "sum_3", "num_acc", "old_num_acc",
+                 "num_upd")
+
+    def __init__(self):
+        self.sum_1 = 0
+        self.sum_2 = 0
+        self.sum_3 = 0
+        self.num_acc = 0
+        self.old_num_acc = 0
+        self.num_upd = 0
+
+
+class ModelAverage:
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.avg_rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._params = list(parameters) if parameters is not None else []
+        self._slots = {id(p): _Slot() for p in self._params}
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current weights (reference: one
+        average_accumulates op per parameter)."""
+        for p in self._params:
+            s = self._slots.setdefault(id(p), _Slot())
+            s.sum_1 = s.sum_1 + p._data
+            s.num_acc += 1
+            s.num_upd += 1
+            window = min(self.max_window,
+                         max(self.min_window,
+                             int(s.num_upd * self.avg_rate)))
+            if s.num_acc >= self.min_window and s.num_acc >= window:
+                s.sum_3 = s.sum_1 + s.sum_2
+                s.sum_1 = 0
+                s.sum_2 = 0
+                s.old_num_acc = s.num_acc
+                s.num_acc = 0
+
+    def minimize(self, loss, **kw):
+        self.step()
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._backup = [(p, p._data) for p in self._params]
+        for p in self._params:
+            s = self._slots[id(p)]
+            total = s.num_acc + s.old_num_acc
+            if total:
+                p._data = (s.sum_1 + s.sum_2 + s.sum_3) / total
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, v in self._backup:
+            p._data = v
+        self._backup = None
+
+    def state_dict(self):
+        return {"slots": {i: {k: getattr(s, k) for k in _Slot.__slots__}
+                          for i, s in enumerate(
+                              self._slots[id(p)] for p in self._params)}}
+
+    def set_state_dict(self, state):
+        for i, p in enumerate(self._params):
+            data = state.get("slots", {}).get(i)
+            if data:
+                s = self._slots[id(p)]
+                for k, v in data.items():
+                    setattr(s, k, v)

@@ -1,0 +1,115 @@
+"""Inference API.
+
+Reference: python/paddle/inference (Config, create_predictor, Predictor)
+— the deployment runtime over a saved program. Here a predictor runs a
+``jit.save`` StableHLO artifact through jax.export's loader: the graph was
+compiled AOT at save time and executes without python model code.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ['Config', 'Predictor', 'create_predictor']
+
+
+class Config:
+    """Reference: paddle/fluid/inference/api/analysis_config.cc surface
+    (the knobs that matter off-GPU)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        self._model_path = prog_file
+        self._use_gpu = False
+        self._threads = 1
+        self._enabled = {"memory_optim": True, "ir_optim": True}
+
+    def set_prog_file(self, path):
+        self._model_path = path
+
+    def prog_file(self):
+        return self._model_path
+
+    def disable_gpu(self):
+        self._use_gpu = False
+
+    def enable_use_gpu(self, *a, **k):
+        # TPU build: GPU requests are recorded but the device is chosen by
+        # the jax platform (TPU if present)
+        self._use_gpu = True
+
+    def use_gpu(self):
+        return self._use_gpu
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._threads = int(n)
+
+    def switch_ir_optim(self, on=True):
+        self._enabled["ir_optim"] = bool(on)
+
+    def enable_memory_optim(self, on=True):
+        self._enabled["memory_optim"] = bool(on)
+
+    def summary(self):
+        return dict(model=self._model_path, **self._enabled)
+
+
+class _Handle:
+    """Input/output handle mimicking ZeroCopyTensor."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.asarray(arr)
+
+    def reshape(self, shape):
+        pass
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit.serialization import load as jit_load
+        if config.prog_file() is None:
+            raise ValueError("Config has no model path")
+        path = config.prog_file()
+        if path.endswith(".pdmodel"):
+            path = path[:-len(".pdmodel")]
+        self._layer = jit_load(path)
+        n_in = getattr(self._layer, "n_inputs", None) or 1
+        self._inputs = [_Handle(f"x{i}") for i in range(n_in)]
+        self._outputs = [_Handle("out0")]
+
+    def get_input_names(self):
+        return [h.name for h in self._inputs]
+
+    def get_output_names(self):
+        return [h.name for h in self._outputs]
+
+    def get_input_handle(self, name):
+        return next(h for h in self._inputs if h.name == name)
+
+    def get_output_handle(self, name):
+        return next(h for h in self._outputs if h.name == name)
+
+    def run(self, inputs=None):
+        """Either positional (list of arrays → list of arrays) or through
+        the copy_from_cpu handles, as in the reference."""
+        if inputs is not None:
+            outs = self._layer(*inputs)
+        else:
+            outs = self._layer(*[h._value for h in self._inputs])
+        if not isinstance(outs, (tuple, list)):
+            outs = [outs]
+        arrays = [np.asarray(o._data if hasattr(o, "_data") else o)
+                  for o in outs]
+        self._outputs = [_Handle(f"out{i}") for i in range(len(arrays))]
+        for h, a in zip(self._outputs, arrays):
+            h._value = a
+        return arrays
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -66,10 +66,14 @@ class TranslatedLayer:
     def __init__(self, payload):
         self._payload = payload
         self._callable = None
+        self.n_inputs = None
+        self.input_avals = None
         if payload.get("stablehlo"):
             from jax import export as jax_export
             exported = jax_export.deserialize(payload["stablehlo"])
             self._callable = exported.call
+            self.input_avals = exported.in_avals
+            self.n_inputs = len(exported.in_avals)
 
     def state_dict(self):
         return {k: Tensor(jnp.asarray(v))

@@ -1,0 +1,165 @@
+"""Legacy reader decorators.
+
+Reference: python/paddle/reader/decorator.py (map_readers, buffered,
+compose, chain, shuffle, firstn, xmap_readers). These predate paddle.io
+but remain part of the public surface; implemented host-side (pure python
+iterators feeding the device pipeline).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+__all__ = ['map_readers', 'buffered', 'compose', 'chain', 'shuffle',
+           'firstn', 'xmap_readers', 'ComposeNotAligned']
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def shuffled():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return shuffled
+
+
+def chain(*readers):
+    def chained():
+        yield from itertools.chain(*[r() for r in readers])
+    return chained
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop('check_alignment', True)
+
+    def _flatten(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    _missing = object()
+
+    def composed():
+        rs = [r() for r in readers]
+        for items in itertools.zip_longest(*rs, fillvalue=_missing):
+            if _missing in items:
+                if check_alignment:
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                items = tuple(i for i in items if i is not _missing)
+            yield sum((_flatten(i) for i in items), ())
+    return composed
+
+
+def buffered(reader, size):
+    """Prefetch up to ``size`` items in a background thread."""
+    end = object()
+
+    def buffered_reader():
+        q = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for d in reader():
+                    q.put(d)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is end:
+                break
+            yield e
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                break
+            yield item
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size,
+                 order=False):
+    """Parallel map over a reader with worker threads (order-preserving
+    when ``order``)."""
+    end = object()
+
+    def xreader():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+
+        def feed():
+            for i, d in enumerate(reader()):
+                in_q.put((i, d))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, d = item
+                try:
+                    out_q.put((i, mapper(d)))
+                except BaseException as e:  # propagate to the consumer
+                    out_q.put(("__xmap_error__", e))
+                    out_q.put(end)
+                    return
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if order:
+            pending, want = {}, 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                i, v = item
+                if i == "__xmap_error__":
+                    raise v
+                pending[i] = v
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                if item[0] == "__xmap_error__":
+                    raise item[1]
+                yield item[1]
+    return xreader

@@ -31,7 +31,7 @@ def frame(x, frame_length, hop_length, axis=-1, name=None):
     nd = xt.ndim
     if axis not in (0, -1, nd - 1):
         raise ValueError("frame only supports axis=0 or axis=-1")
-    last = axis in (-1, nd - 1)
+    last = axis != 0  # axis=0 puts num_frames first, even for 1-D input
     size = xt.shape[-1 if last else 0]
     n = _n_frames(size, frame_length, hop_length)
 
@@ -57,9 +57,9 @@ def overlap_add(x, hop_length, axis=-1, name=None):
     nd = xt.ndim
     if nd < 2:
         raise ValueError("overlap_add expects rank >= 2")
-    last = axis in (-1, nd - 1)
-    if not last and axis != 0:
+    if axis not in (0, -1, nd - 1):
         raise ValueError("overlap_add only supports axis=0 or axis=-1")
+    last = axis != 0
     if last:
         frame_length, n = xt.shape[-2], xt.shape[-1]
     else:

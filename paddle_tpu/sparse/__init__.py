@@ -1,0 +1,30 @@
+"""Sparse tensors (COO / CSR).
+
+Reference surface: python/paddle/incubate/sparse (creation.py, unary.py,
+binary.py, multiary.py, nn/). TPU-native design: COO tensors are backed by
+``jax.experimental.sparse.BCOO`` — XLA lowers its matmuls to
+gather/scatter + dense dot on the gathered rows, which is the right TPU
+strategy (the MXU has no native sparse path; structured sparsity should use
+dense masking instead). CSR is held as (crows, cols, values) and converted
+through COO for compute. Values participate in the autograd tape; sparsity
+patterns are static non-differentiable metadata.
+"""
+from . import nn  # noqa: F401
+from .binary import add, divide, masked_matmul, matmul, multiply, mv, subtract  # noqa: F401
+from .creation import sparse_coo_tensor, sparse_csr_tensor  # noqa: F401
+from .multiary import addmm  # noqa: F401
+from .tensor import SparseCooTensor, SparseCsrTensor, is_sparse  # noqa: F401
+from .unary import (  # noqa: F401
+    abs, asin, asinh, atan, atanh, cast, coalesce, deg2rad, expm1, log1p,
+    neg, pow, rad2deg, sin, sinh, sqrt, square, tan, tanh,
+)
+
+__all__ = [
+    'sparse_coo_tensor', 'sparse_csr_tensor', 'SparseCooTensor',
+    'SparseCsrTensor', 'is_sparse',
+    'sin', 'tan', 'asin', 'atan', 'sinh', 'tanh', 'asinh', 'atanh', 'sqrt',
+    'square', 'log1p', 'abs', 'pow', 'cast', 'neg', 'deg2rad', 'rad2deg',
+    'expm1', 'coalesce',
+    'mv', 'matmul', 'masked_matmul', 'add', 'subtract', 'multiply', 'divide',
+    'addmm', 'nn',
+]

@@ -1,0 +1,106 @@
+"""Sparse binary ops.
+
+Reference: python/paddle/incubate/sparse/binary.py. TPU-native design:
+sparse @ dense is gather-rows → scale → segment-sum — the only sparse
+matmul shape XLA handles well on TPU (no native sparse MXU path); the
+pattern algebra (union/merge for elementwise ops) happens host-side in
+numpy at op-build time, while all value math stays on device and on the
+autograd tape.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, apply
+from .tensor import SparseCooTensor, SparseCsrTensor, is_sparse
+
+
+def _coo(x) -> SparseCooTensor:
+    return x.to_sparse_coo() if isinstance(x, SparseCsrTensor) \
+        else x.coalesce()
+
+
+def matmul(x, y, name=None):
+    """sparse (COO/CSR) @ dense. Reference: sparse/binary.py::matmul."""
+    if not is_sparse(x):
+        raise TypeError("sparse.matmul expects sparse lhs")
+    yt = y if isinstance(y, Tensor) else Tensor(y)
+    c = _coo(x)
+    if len(c.shape) != 2 or yt.ndim not in (1, 2):
+        raise ValueError("sparse.matmul supports 2-D sparse @ 1/2-D dense")
+    rows, cols = c._indices[0], c._indices[1]
+    m = c.shape[0]
+
+    def _mm(vals, dense):
+        gathered = dense[cols]  # (nnz, n) or (nnz,)
+        scaled = gathered * (vals[:, None] if dense.ndim == 2 else vals)
+        return jax.ops.segment_sum(scaled, rows, num_segments=m)
+
+    return apply(_mm, c._values, yt)
+
+
+def mv(x, vec, name=None):
+    """sparse matrix @ dense vector. Reference: sparse/binary.py::mv."""
+    return matmul(x, vec, name=name)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(dense @ dense) sampled at ``mask``'s sparsity pattern (SDDMM).
+    Reference: sparse/binary.py::masked_matmul."""
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    yt = y if isinstance(y, Tensor) else Tensor(y)
+    if not is_sparse(mask):
+        raise TypeError("mask must be sparse")
+    want_csr = isinstance(mask, SparseCsrTensor)
+    c = _coo(mask)
+    rows, cols = c._indices[0], c._indices[1]
+
+    vals = apply(lambda a, b: jnp.einsum("nk,nk->n", a[rows], b.T[cols]),
+                 xt, yt)
+    out = SparseCooTensor(c._indices, vals,
+                          [xt.shape[0], yt.shape[1]], coalesced=True)
+    return out.to_sparse_csr() if want_csr else out
+
+
+def _merge_patterns(a: SparseCooTensor, b: SparseCooTensor):
+    """Union of two coalesced COO patterns → (union_idx, map_a, map_b)."""
+    sp = tuple(a.shape[:a.sparse_dim])
+    fa = np.ravel_multi_index(np.asarray(a._indices), sp)
+    fb = np.ravel_multi_index(np.asarray(b._indices), sp)
+    union = np.union1d(fa, fb)
+    return (np.stack(np.unravel_index(union, sp)),
+            np.searchsorted(union, fa), np.searchsorted(union, fb))
+
+
+def _ew(op_name, jfn):
+    def fn(x, y, name=None):
+        if not (is_sparse(x) and is_sparse(y)):
+            raise TypeError(f"sparse.{op_name} expects two sparse tensors")
+        if list(x.shape) != list(y.shape):
+            raise ValueError("shape mismatch")
+        want_csr = isinstance(x, SparseCsrTensor)
+        a, b = _coo(x), _coo(y)
+        idx, ma, mb = _merge_patterns(a, b)
+        ma_j, mb_j = jnp.asarray(ma), jnp.asarray(mb)
+        n = idx.shape[1]
+
+        def _combine(va, vb):
+            za = jnp.zeros((n,) + va.shape[1:], va.dtype).at[ma_j].set(va)
+            zb = jnp.zeros((n,) + vb.shape[1:], vb.dtype).at[mb_j].set(vb)
+            return jfn(za, zb)
+
+        vals = apply(_combine, a._values, b._values)
+        out = SparseCooTensor(idx, vals, x.shape, coalesced=True)
+        return out.to_sparse_csr() if want_csr else out
+    fn.__name__ = op_name
+    fn.__doc__ = (f"Element-wise sparse {op_name} over the union pattern "
+                  "(reference: sparse/binary.py).")
+    return fn
+
+
+add = _ew("add", jnp.add)
+subtract = _ew("subtract", jnp.subtract)
+multiply = _ew("multiply", jnp.multiply)
+divide = _ew("divide", jnp.divide)

@@ -1,0 +1,70 @@
+"""Sparse tensor creation.
+
+Reference: python/paddle/incubate/sparse/creation.py (sparse_coo_tensor,
+sparse_csr_tensor) plus dense↔sparse conversion.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..tensor import Tensor
+from .tensor import SparseCooTensor, SparseCsrTensor
+
+
+def _as_np(x):
+    import jax
+    return np.asarray(jax.device_get(x._data)) if isinstance(x, Tensor) \
+        else np.asarray(x)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """Build a COO tensor from (sparse_dim, nnz) indices + nnz values.
+    Reference: creation.py::sparse_coo_tensor."""
+    idx = _as_np(indices)
+    if idx.ndim != 2:
+        raise ValueError("indices must be 2-D (sparse_dim, nnz)")
+    was_tensor = isinstance(values, Tensor)
+    vals = values if was_tensor else Tensor(
+        values, dtype=dtype_mod.convert_dtype(dtype))
+    if dtype is not None:
+        vals = Tensor(vals._data.astype(dtype_mod.convert_dtype(dtype)),
+                      stop_gradient=vals.stop_gradient)
+    if shape is None:
+        mins = idx.min(axis=1) if idx.size else np.zeros(idx.shape[0])
+        if idx.size and mins.min() < 0:
+            raise ValueError("negative indices need an explicit shape")
+        sparse_shape = [int(m) + 1 for m in
+                        (idx.max(axis=1) if idx.size else [0] * idx.shape[0])]
+        shape = sparse_shape + list(vals.shape[1:])
+    if not was_tensor:  # keep an existing Tensor's grad chain intact
+        vals.stop_gradient = stop_gradient
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """Build a CSR matrix. Reference: creation.py::sparse_csr_tensor."""
+    was_tensor = isinstance(values, Tensor)
+    vals = values if was_tensor else Tensor(
+        values, dtype=dtype_mod.convert_dtype(dtype))
+    if dtype is not None:
+        vals = Tensor(vals._data.astype(dtype_mod.convert_dtype(dtype)),
+                      stop_gradient=vals.stop_gradient)
+    if not was_tensor:
+        vals.stop_gradient = stop_gradient
+    return SparseCsrTensor(_as_np(crows), _as_np(cols), vals, shape)
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    """Dense Tensor → COO (reference: Tensor.to_sparse_coo)."""
+    xv = _as_np(x)
+    sparse_dim = sparse_dim or xv.ndim
+    flat = xv.reshape(xv.shape[:sparse_dim] + (-1,)) \
+        if sparse_dim < xv.ndim else xv
+    mask = np.abs(flat).sum(axis=tuple(range(sparse_dim, flat.ndim))) != 0 \
+        if flat.ndim > sparse_dim else flat != 0
+    idx = np.stack(np.nonzero(mask))
+    vals = xv[tuple(idx)]
+    return SparseCooTensor(idx, Tensor(vals), list(xv.shape), coalesced=True)

@@ -1,0 +1,16 @@
+"""Reference: python/paddle/incubate/sparse/multiary.py (addmm)."""
+from __future__ import annotations
+
+from ..tensor import Tensor
+from .binary import matmul
+from .tensor import is_sparse
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """``beta * input + alpha * (x @ y)`` with sparse ``x``, dense
+    ``input``/``y``. Reference: sparse/multiary.py::addmm."""
+    if not is_sparse(x):
+        raise TypeError("sparse.addmm expects sparse x")
+    inp = input if isinstance(input, Tensor) else Tensor(input)
+    prod = matmul(x, y)
+    return inp * beta + prod * alpha

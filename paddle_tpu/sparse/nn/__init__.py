@@ -1,0 +1,13 @@
+"""Sparse nn layers.
+
+Reference: python/paddle/incubate/sparse/nn (ReLU, Softmax, ReLU6,
+LeakyReLU, BatchNorm). Activations operate value-wise; Softmax normalizes
+per CSR row. The reference's sparse Conv3D/SubmConv3D target point-cloud
+workloads on GPU gather-scatter kernels; on TPU dense conv with masking is
+the supported path, so they are intentionally not provided.
+"""
+from . import functional  # noqa: F401
+from .layer import BatchNorm, LeakyReLU, ReLU, ReLU6, Softmax  # noqa: F401
+
+__all__ = ['ReLU', 'ReLU6', 'LeakyReLU', 'Softmax', 'BatchNorm',
+           'functional']

@@ -1,0 +1,66 @@
+"""Sparse functional activations.
+
+Reference: python/paddle/incubate/sparse/nn/functional (relu, relu6,
+leaky_relu, softmax). relu/relu6/leaky_relu are zero-preserving so they
+apply value-wise; softmax is per-row over the stored entries (absent
+entries are treated as -inf, matching the reference kernel).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor import apply
+from ..tensor import SparseCooTensor, SparseCsrTensor, is_sparse
+
+
+def relu(x, name=None):
+    if not is_sparse(x):
+        raise TypeError("sparse relu expects a sparse tensor")
+    return x._map_values(lambda v: jnp.maximum(v, 0))
+
+
+def relu6(x, name=None):
+    if not is_sparse(x):
+        raise TypeError("sparse relu6 expects a sparse tensor")
+    return x._map_values(lambda v: jnp.clip(v, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    if not is_sparse(x):
+        raise TypeError("sparse leaky_relu expects a sparse tensor")
+    return x._map_values(
+        lambda v: jnp.where(v >= 0, v, v * negative_slope))
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over stored entries (axis must be the last sparse
+    dim, as in the reference CSR kernel). Entries are grouped by ALL
+    leading sparse dims, so batched COO normalizes per row, not per
+    batch."""
+    want_csr = isinstance(x, SparseCsrTensor)
+    c = x.to_sparse_coo() if want_csr else x.coalesce()
+    nsp = c.sparse_dim
+    if axis not in (-1, nsp - 1):
+        raise ValueError("sparse softmax supports the last sparse axis only")
+    if nsp == 1:
+        rows = jnp.zeros_like(c._indices[0])
+        n_rows = 1
+    else:
+        import numpy as np
+        lead = np.asarray(c._indices[:-1])
+        lead_shape = tuple(c.shape[:nsp - 1])
+        rows = jnp.asarray(
+            np.ravel_multi_index(tuple(lead), lead_shape).astype(np.int32))
+        n_rows = int(np.prod(lead_shape))
+
+    def _softmax(v):
+        row_max = jax.ops.segment_max(v, rows, num_segments=n_rows)
+        row_max = jnp.where(jnp.isfinite(row_max), row_max, 0.0)
+        e = jnp.exp(v - row_max[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+        return e / denom[rows]
+
+    vals = apply(_softmax, c._values)
+    out = SparseCooTensor(c._indices, vals, c.shape, coalesced=True)
+    return out.to_sparse_csr() if want_csr else out

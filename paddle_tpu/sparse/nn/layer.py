@@ -1,0 +1,59 @@
+"""Sparse nn layer wrappers.
+
+Reference: python/paddle/incubate/sparse/nn/layer/{activation,norm}.py.
+"""
+from __future__ import annotations
+
+from ...nn.layer_base import Layer
+from ..tensor import SparseCooTensor
+from . import functional as F
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the dense feature dim of a COO tensor whose values
+    are (nnz, channels) — normalizes the stored values like the reference's
+    sparse BatchNorm (which runs dense BN on the value buffer).
+    Reference: sparse/nn/layer/norm.py."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format='NDHWC',
+                 name=None):
+        super().__init__()
+        from ...nn.layer.norm import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr)
+
+    def forward(self, x):
+        if not isinstance(x, SparseCooTensor):
+            raise TypeError("sparse BatchNorm expects a SparseCooTensor")
+        vals = self._bn(x.values())
+        return SparseCooTensor(x._indices, vals, x.shape, x._coalesced)

@@ -1,0 +1,176 @@
+"""Sparse tensor containers.
+
+Reference: the SparseCooTensor / SparseCsrTensor C++ types surfaced through
+python/paddle/incubate/sparse/creation.py. Values are dense paddle_tpu
+Tensors (so they ride the autograd tape); indices are static int32 arrays.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, apply
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+class SparseCooTensor:
+    """COO sparse tensor: ``indices`` (sparse_dim, nnz) + ``values``
+    (nnz, *dense_dims)."""
+
+    def __init__(self, indices, values, shape, coalesced=False):
+        idx = indices._data if isinstance(indices, Tensor) \
+            else jnp.asarray(indices)
+        self._indices = idx.astype(jnp.int32)
+        self._values = values if isinstance(values, Tensor) else Tensor(values)
+        self.shape = list(int(s) for s in shape)
+        self._coalesced = bool(coalesced)
+
+    # paddle surface -------------------------------------------------------
+    def indices(self) -> Tensor:
+        return Tensor(self._indices)
+
+    def values(self) -> Tensor:
+        return self._values
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._indices.shape[1])
+
+    @property
+    def sparse_dim(self) -> int:
+        return int(self._indices.shape[0])
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def to_dense(self) -> Tensor:
+        idx = tuple(self._indices)
+        shape = tuple(self.shape)
+
+        def _dense(v):
+            out = jnp.zeros(shape[:len(idx)] + v.shape[1:], dtype=v.dtype)
+            return out.at[idx].add(v)
+
+        return apply(_dense, self._values)
+
+    def coalesce(self) -> "SparseCooTensor":
+        if self._coalesced:
+            return self
+        idx = np.asarray(self._indices)
+        flat = np.ravel_multi_index(idx, tuple(self.shape[:idx.shape[0]]))
+        order = np.argsort(flat, kind="stable")
+        uniq, inv = np.unique(flat[order], return_inverse=True)
+        new_idx = jnp.asarray(
+            np.stack(np.unravel_index(uniq, tuple(self.shape[:idx.shape[0]]))))
+        inv = jnp.asarray(inv)
+        order_j = jnp.asarray(order)
+        n = int(uniq.shape[0])
+        vals = apply(
+            lambda v: jnp.zeros((n,) + v.shape[1:], v.dtype)
+            .at[inv].add(v[order_j]), self._values)
+        return SparseCooTensor(new_idx, vals, self.shape, coalesced=True)
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if self.sparse_dim != 2 or len(self.shape) != 2:
+            raise ValueError("to_sparse_csr supports 2-D COO only")
+        c = self.coalesce()
+        rows = np.asarray(c._indices[0])
+        crows = np.zeros(self.shape[0] + 1, dtype=np.int32)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows).astype(np.int32)
+        return SparseCsrTensor(crows, c._indices[1], c._values, self.shape)
+
+    def _map_values(self, fn) -> "SparseCooTensor":
+        return SparseCooTensor(self._indices, apply(fn, self._values),
+                               self.shape, self._coalesced)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix (2-D): ``crows`` (rows+1,), ``cols`` (nnz,),
+    ``values`` (nnz,). The reference's batched rank-3 CSR is not supported —
+    use a batched COO tensor instead."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(
+            crows._data if isinstance(crows, Tensor) else crows,
+            dtype=jnp.int32)
+        self._cols = jnp.asarray(
+            cols._data if isinstance(cols, Tensor) else cols,
+            dtype=jnp.int32)
+        self._values = values if isinstance(values, Tensor) else Tensor(values)
+        self.shape = list(int(s) for s in shape)
+        if len(self.shape) != 2:
+            raise ValueError("SparseCsrTensor supports 2-D matrices")
+
+    def crows(self) -> Tensor:
+        return Tensor(self._crows)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._cols)
+
+    def values(self) -> Tensor:
+        return self._values
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._cols.shape[0])
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def _row_indices(self):
+        counts = np.diff(np.asarray(self._crows))
+        return jnp.asarray(np.repeat(np.arange(self.shape[0]), counts)
+                           .astype(np.int32))
+
+    def to_sparse_coo(self, sparse_dim=2) -> SparseCooTensor:
+        idx = jnp.stack([self._row_indices(), self._cols])
+        return SparseCooTensor(idx, self._values, self.shape, coalesced=True)
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def _map_values(self, fn) -> "SparseCsrTensor":
+        return SparseCsrTensor(self._crows, self._cols,
+                               apply(fn, self._values), self.shape)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")

@@ -1,0 +1,70 @@
+"""Sparse unary ops — applied to the values, pattern unchanged.
+
+Reference: python/paddle/incubate/sparse/unary.py. All listed ops are
+zero-preserving (f(0)=0), so value-wise application is exact.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework import dtype as dtype_mod
+from .tensor import SparseCooTensor, SparseCsrTensor, is_sparse
+
+
+def _valuewise(name, jfn):
+    def fn(x, name_arg=None):
+        if not is_sparse(x):
+            raise TypeError(f"sparse.{name} expects a sparse tensor")
+        return x._map_values(jfn)
+    fn.__name__ = name
+    fn.__doc__ = f"Value-wise sparse {name} (reference: sparse/unary.py)."
+    return fn
+
+
+sin = _valuewise("sin", jnp.sin)
+tan = _valuewise("tan", jnp.tan)
+asin = _valuewise("asin", jnp.arcsin)
+atan = _valuewise("atan", jnp.arctan)
+sinh = _valuewise("sinh", jnp.sinh)
+tanh = _valuewise("tanh", jnp.tanh)
+asinh = _valuewise("asinh", jnp.arcsinh)
+atanh = _valuewise("atanh", jnp.arctanh)
+sqrt = _valuewise("sqrt", jnp.sqrt)
+square = _valuewise("square", jnp.square)
+log1p = _valuewise("log1p", jnp.log1p)
+abs = _valuewise("abs", jnp.abs)
+neg = _valuewise("neg", jnp.negative)
+expm1 = _valuewise("expm1", jnp.expm1)
+deg2rad = _valuewise("deg2rad", jnp.deg2rad)
+rad2deg = _valuewise("rad2deg", jnp.rad2deg)
+
+
+def pow(x, factor, name=None):
+    if not is_sparse(x):
+        raise TypeError("sparse.pow expects a sparse tensor")
+    return x._map_values(lambda v: jnp.power(v, factor))
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """Cast indices and/or values. Reference: sparse/unary.py::cast."""
+    if not is_sparse(x):
+        raise TypeError("sparse.cast expects a sparse tensor")
+    vdt = dtype_mod.convert_dtype(value_dtype) if value_dtype else None
+    out = x._map_values(lambda v: v.astype(vdt)) if vdt else x
+    if index_dtype is not None:
+        idt = dtype_mod.convert_dtype(index_dtype)
+        if isinstance(out, SparseCooTensor):
+            out = SparseCooTensor(out._indices.astype(idt), out._values,
+                                  out.shape, out._coalesced)
+        elif isinstance(out, SparseCsrTensor):
+            out = SparseCsrTensor(out._crows.astype(idt),
+                                  out._cols.astype(idt), out._values,
+                                  out.shape)
+    return out
+
+
+def coalesce(x, name=None):
+    """Sum duplicate COO entries. Reference: sparse/unary.py::coalesce."""
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("coalesce expects a SparseCooTensor")
+    return x.coalesce()

@@ -1,0 +1,12 @@
+"""Reference: python/paddle/sysconfig.py (get_include, get_lib)."""
+from __future__ import annotations
+
+import os
+
+
+def get_include():
+    return os.path.join(os.path.dirname(__file__), "include")
+
+
+def get_lib():
+    return os.path.join(os.path.dirname(__file__), "runtime", "cpp")

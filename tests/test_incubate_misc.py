@@ -1,0 +1,262 @@
+"""incubate fused layers/optimizers, device, hub, inference, batch/reader,
+cost_model."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import incubate, nn, optimizer as optim
+
+
+class TestFusedLayers:
+    def test_fused_mha_matches_unfused_math(self):
+        """Fused MHA == manual QKV attention with the same weights."""
+        paddle.seed(0)
+        d, h, s = 32, 4, 16
+        mha = incubate.nn.FusedMultiHeadAttention(
+            d, h, dropout_rate=0.0, attn_dropout_rate=0.0,
+            normalize_before=True)
+        mha.eval()
+        x = paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(2, s, d))
+            .astype(np.float32))
+        out = mha(x)
+        assert list(out.shape) == [2, s, d]
+        # pre-LN + residual: output differs from input, finite
+        assert np.isfinite(np.asarray(out._data)).all()
+        assert np.abs(np.asarray((out - x)._data)).max() > 1e-4
+
+    def test_fused_encoder_and_multi(self):
+        paddle.seed(0)
+        enc = incubate.nn.FusedTransformerEncoderLayer(
+            32, 4, 64, dropout_rate=0.0)
+        enc.eval()
+        x = paddle.to_tensor(np.ones((2, 8, 32), dtype=np.float32))
+        assert list(enc(x).shape) == [2, 8, 32]
+        mt = incubate.nn.FusedMultiTransformer(32, 4, 64, num_layers=2)
+        mt.eval()
+        assert list(mt(x).shape) == [2, 8, 32]
+
+    def test_fused_linear_matches_linear(self):
+        paddle.seed(0)
+        fl = incubate.nn.FusedLinear(8, 4)
+        x = paddle.to_tensor(
+            np.random.default_rng(1).normal(size=(3, 8)).astype(np.float32))
+        ref = x.matmul(fl.weight) + fl.bias
+        np.testing.assert_allclose(np.asarray(fl(x)._data),
+                                   np.asarray(ref._data), atol=1e-6)
+
+    def test_fused_mha_cache_returns_updated_kv(self):
+        paddle.seed(0)
+        mha = incubate.nn.FusedMultiHeadAttention(
+            16, 2, dropout_rate=0.0, attn_dropout_rate=0.0)
+        mha.eval()
+        x0 = paddle.to_tensor(np.ones((1, 4, 16), dtype=np.float32))
+        x1 = paddle.to_tensor(np.ones((1, 1, 16), dtype=np.float32))
+        from paddle_tpu.incubate.nn import functional as IF
+        # prime: no cache -> single tensor
+        out = mha(x0)
+        assert not isinstance(out, tuple)
+        # decode with a cache -> (out, (k, v)) with grown seq dim
+        zeros_kv = (paddle.to_tensor(np.zeros((1, 4, 2, 8), np.float32)),
+                    paddle.to_tensor(np.zeros((1, 4, 2, 8), np.float32)))
+        out, cache = mha(x1, cache=zeros_kv)
+        assert list(out.shape) == [1, 1, 16]
+        assert list(cache[0].shape) == [1, 5, 2, 8]
+
+    def test_fused_ffn_grad(self):
+        paddle.seed(0)
+        ffn = incubate.nn.FusedFeedForward(16, 32, dropout_rate=0.0)
+        x = paddle.to_tensor(np.ones((2, 4, 16), dtype=np.float32))
+        loss = ffn(x).sum()
+        loss.backward()
+        g = ffn.linear1_weight.grad
+        assert g is not None and np.isfinite(np.asarray(g._data)).all()
+
+    def test_bias_dropout_residual_ln(self):
+        layer = incubate.nn.FusedBiasDropoutResidualLayerNorm(
+            8, dropout_rate=0.0)
+        layer.eval()
+        x = paddle.to_tensor(
+            np.random.default_rng(2).normal(size=(2, 3, 8))
+            .astype(np.float32))
+        out = np.asarray(layer(x, x)._data)
+        np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)
+
+
+class TestIncubateOptimizers:
+    def _problem(self):
+        paddle.seed(0)
+        m = nn.Linear(4, 1)
+        x = paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(16, 4))
+            .astype(np.float32))
+        w = np.array([[1.], [-2.], [0.5], [3.]], dtype=np.float32)
+        y = paddle.to_tensor(np.asarray(x._data) @ w)
+        return m, x, y
+
+    def test_lookahead_converges(self):
+        m, x, y = self._problem()
+        la = incubate.LookAhead(
+            optim.Adam(learning_rate=5e-2, parameters=m.parameters()),
+            alpha=0.5, k=5)
+        first = None
+        for i in range(150):
+            loss = ((m(x) - y) ** 2).mean()
+            if first is None:
+                first = float(loss._data)
+            loss.backward()
+            la.step()
+            la.clear_grad()
+        assert float(loss._data) < first * 0.1
+
+    def test_modelaverage_apply_restore(self):
+        m, x, y = self._problem()
+        sgd = optim.SGD(learning_rate=1e-2, parameters=m.parameters())
+        ma = incubate.ModelAverage(0.5, parameters=m.parameters(),
+                                   min_average_window=2,
+                                   max_average_window=100)
+        for _ in range(5):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            sgd.step()
+            sgd.clear_grad()
+            ma.step()
+        raw = np.asarray(m.weight._data).copy()
+        with ma.apply():
+            averaged = np.asarray(m.weight._data).copy()
+        restored = np.asarray(m.weight._data)
+        np.testing.assert_array_equal(restored, raw)
+        assert np.abs(averaged - raw).max() > 0  # window average differs
+
+    def test_autotune_set_config(self):
+        incubate.autotune.set_config({"kernel": {"enable": False}})
+        assert incubate.autotune.get_config()["kernel"]["enable"] is False
+        incubate.autotune.set_config(None)
+        assert incubate.autotune.get_config()["kernel"]["enable"] is True
+
+
+class TestDeviceAndMisc:
+    def test_device_queries(self):
+        assert not paddle.is_compiled_with_cuda()
+        assert paddle.device.cuda.device_count() == 0
+        assert len(paddle.device.get_all_device_type()) >= 1
+        assert paddle.device.cuda.synchronize() == 0
+
+    def test_batch_and_reader(self):
+        b = paddle.batch(lambda: iter(range(7)), 3)
+        assert [len(x) for x in b()] == [3, 3, 1]
+        b = paddle.batch(lambda: iter(range(7)), 3, drop_last=True)
+        assert [len(x) for x in b()] == [3, 3]
+        assert list(paddle.reader.firstn(lambda: iter(range(9)), 4)()) \
+            == [0, 1, 2, 3]
+        got = sorted(paddle.reader.xmap_readers(
+            lambda v: v * v, lambda: iter(range(6)), 3, 4)())
+        assert got == [0, 1, 4, 9, 16, 25]
+        composed = paddle.reader.compose(
+            lambda: iter([1, 2]), lambda: iter([(3, 4), (5, 6)]))
+        assert list(composed()) == [(1, 3, 4), (2, 5, 6)]
+
+    def test_compose_misaligned_raises(self):
+        bad = paddle.reader.compose(lambda: iter([1, 2, 3]),
+                                    lambda: iter([4]))
+        with pytest.raises(paddle.reader.ComposeNotAligned):
+            list(bad())
+        ok = paddle.reader.compose(lambda: iter([1, 2, 3]),
+                                   lambda: iter([4]),
+                                   check_alignment=False)
+        assert list(ok()) == [(1, 4), (2,), (3,)]
+
+    def test_xmap_propagates_worker_error(self):
+        def bad_mapper(v):
+            if v == 3:
+                raise ValueError("boom")
+            return v
+
+        r = paddle.reader.xmap_readers(bad_mapper, lambda: iter(range(6)),
+                                       2, 4)
+        with pytest.raises(ValueError, match="boom"):
+            list(r())
+
+    def test_hub_local(self):
+        with tempfile.TemporaryDirectory() as td:
+            with open(os.path.join(td, "hubconf.py"), "w") as f:
+                f.write("dependencies=['numpy']\n"
+                        "def tiny_model(scale=1):\n"
+                        "    '''a tiny model'''\n"
+                        "    return {'scale': scale}\n")
+            assert paddle.hub.list(td, source="local") == ["tiny_model"]
+            assert "tiny" in paddle.hub.help(td, "tiny_model",
+                                             source="local")
+            assert paddle.hub.load(td, "tiny_model", source="local",
+                                   scale=3) == {"scale": 3}
+            with pytest.raises(RuntimeError):
+                paddle.hub.list(td, source="github")
+
+    def test_cost_model(self):
+        import jax.numpy as jnp
+        cm = paddle.cost_model.CostModel()
+        res = cm.xla_cost(lambda a, b: a @ b,
+                          jnp.ones((32, 32)), jnp.ones((32, 32)))
+        assert res["flops"] > 0
+        timing = cm.profile_measure(lambda: jnp.ones((8, 8)).sum())
+        assert timing["time"] > 0
+        assert cm.profile_measure(lambda: jnp.ones(4), warmup=0,
+                                  iters=2)["time"] > 0
+
+    def test_inference_predictor(self):
+        paddle.seed(0)
+        layer = nn.Sequential(nn.Linear(8, 4), nn.ReLU())
+        layer.eval()
+        x = np.random.default_rng(0).normal(size=(2, 8)).astype(np.float32)
+        ref = np.asarray(layer(paddle.to_tensor(x))._data)
+        from paddle_tpu.static import InputSpec
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "m")
+            paddle.jit.save(layer, path,
+                            input_spec=[InputSpec([None, 8], "float32")])
+            config = paddle.inference.Config(path)
+            pred = paddle.inference.create_predictor(config)
+            out = pred.run([x])
+            np.testing.assert_allclose(out[0], ref, atol=1e-5)
+            # handle-style API
+            h = pred.get_input_handle(pred.get_input_names()[0])
+            h.copy_from_cpu(x)
+            pred.run()
+            out2 = pred.get_output_handle(
+                pred.get_output_names()[0]).copy_to_cpu()
+            np.testing.assert_allclose(out2, ref, atol=1e-5)
+
+    def test_inference_predictor_multi_input(self):
+        class TwoIn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, a, b):
+                return self.fc(a) + self.fc(b)
+
+        paddle.seed(0)
+        layer = TwoIn()
+        layer.eval()
+        a = np.ones((2, 4), dtype=np.float32)
+        b = 2 * np.ones((2, 4), dtype=np.float32)
+        ref = np.asarray(layer(paddle.to_tensor(a),
+                               paddle.to_tensor(b))._data)
+        from paddle_tpu.static import InputSpec
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "m2")
+            paddle.jit.save(layer, path,
+                            input_spec=[InputSpec([None, 4], "float32"),
+                                        InputSpec([None, 4], "float32")])
+            pred = paddle.inference.create_predictor(
+                paddle.inference.Config(path))
+            assert pred.get_input_names() == ["x0", "x1"]
+            pred.get_input_handle("x0").copy_from_cpu(a)
+            pred.get_input_handle("x1").copy_from_cpu(b)
+            pred.run()
+            got = pred.get_output_handle(
+                pred.get_output_names()[0]).copy_to_cpu()
+            np.testing.assert_allclose(got, ref, atol=1e-5)

@@ -1,0 +1,236 @@
+"""signal (stft/istft), sparse (COO/CSR ops), geometric (segment/message
+passing) — numeric parity vs numpy/scipy-style references."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestSignal:
+    def test_frame_matches_manual(self):
+        x = np.arange(32, dtype=np.float32)
+        f = paddle.signal.frame(paddle.to_tensor(x), 8, 4)
+        assert f.shape == [8, 7]
+        got = np.asarray(f._data)
+        for j in range(7):
+            np.testing.assert_array_equal(got[:, j], x[4 * j:4 * j + 8])
+
+    def test_frame_axis0_and_batch(self):
+        x = np.arange(24, dtype=np.float32)
+        f0 = paddle.signal.frame(paddle.to_tensor(x), 6, 3, axis=0)
+        assert f0.shape == [7, 6]
+        xb = np.stack([np.arange(32), np.arange(32) * 2]).astype(np.float32)
+        fb = paddle.signal.frame(paddle.to_tensor(xb), 8, 8)
+        assert fb.shape == [2, 8, 4]
+
+    def test_overlap_add_inverts_hop_eq_frame(self):
+        x = np.random.default_rng(0).normal(size=(40,)).astype(np.float32)
+        f = paddle.signal.frame(paddle.to_tensor(x), 8, 8)
+        y = paddle.signal.overlap_add(f, 8)
+        np.testing.assert_allclose(np.asarray(y._data), x, atol=1e-6)
+
+    def test_stft_matches_numpy(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(256,)).astype(np.float32)
+        n_fft, hop = 64, 16
+        S = paddle.signal.stft(paddle.to_tensor(x), n_fft, hop,
+                               center=False)
+        got = np.asarray(S._data)
+        n = 1 + (256 - n_fft) // hop
+        assert got.shape == (n_fft // 2 + 1, n)
+        for j in range(n):
+            ref = np.fft.rfft(x[j * hop:j * hop + n_fft])
+            np.testing.assert_allclose(got[:, j], ref, atol=1e-3)
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(2, 512)).astype(np.float32)
+        win = np.hanning(128).astype(np.float32)
+        S = paddle.signal.stft(paddle.to_tensor(x), 128, 32,
+                               window=paddle.to_tensor(win))
+        y = paddle.signal.istft(S, 128, 32, window=paddle.to_tensor(win),
+                                length=512)
+        np.testing.assert_allclose(np.asarray(y._data), x, atol=1e-4)
+
+    def test_stft_grad_flows(self):
+        x = paddle.to_tensor(
+            np.random.default_rng(3).normal(size=(128,)).astype(np.float32),
+            stop_gradient=False)
+        S = paddle.signal.stft(x, 32, 8)
+        loss = (S.abs() ** 2).sum()
+        loss.backward()
+        assert x.grad is not None
+        assert np.isfinite(np.asarray(x.grad._data)).all()
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        data = paddle.to_tensor(
+            np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]],
+                     dtype=np.float32))
+        ids = np.array([0, 0, 1, 1])
+        np.testing.assert_allclose(
+            np.asarray(paddle.geometric.segment_sum(data, ids)._data),
+            [[4., 6.], [12., 14.]])
+        np.testing.assert_allclose(
+            np.asarray(paddle.geometric.segment_mean(data, ids)._data),
+            [[2., 3.], [6., 7.]])
+        np.testing.assert_allclose(
+            np.asarray(paddle.geometric.segment_max(data, ids)._data),
+            [[3., 4.], [7., 8.]])
+        np.testing.assert_allclose(
+            np.asarray(paddle.geometric.segment_min(data, ids)._data),
+            [[1., 2.], [5., 6.]])
+
+    def test_segment_empty_segment_is_zero(self):
+        data = paddle.to_tensor(np.ones((2, 3), dtype=np.float32))
+        out = paddle.geometric.segment_max(data, np.array([0, 2]))
+        np.testing.assert_allclose(np.asarray(out._data)[1], 0.0)
+
+    def test_send_u_recv_sum_mean(self):
+        x = paddle.to_tensor(np.array([[1.], [2.], [4.]], dtype=np.float32))
+        src = np.array([0, 1, 2, 0])
+        dst = np.array([1, 2, 1, 0])
+        out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum")
+        # dst 0 <- x[0]; dst 1 <- x[0]+x[2]; dst 2 <- x[1]
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   [[1.], [5.], [2.]])
+        out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="mean")
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   [[1.], [2.5], [2.]])
+
+    def test_send_ue_recv_and_uv(self):
+        x = paddle.to_tensor(np.array([[1.], [2.]], dtype=np.float32))
+        e = paddle.to_tensor(np.array([[10.], [20.]], dtype=np.float32))
+        src = np.array([0, 1])
+        dst = np.array([1, 0])
+        out = paddle.geometric.send_ue_recv(x, e, src, dst,
+                                            message_op="add")
+        np.testing.assert_allclose(np.asarray(out._data), [[22.], [11.]])
+        uv = paddle.geometric.send_uv(x, x, src, dst, message_op="mul")
+        np.testing.assert_allclose(np.asarray(uv._data), [[2.], [2.]])
+
+    def test_message_passing_grad(self):
+        x = paddle.to_tensor(np.ones((3, 2), dtype=np.float32),
+                             stop_gradient=False)
+        out = paddle.geometric.send_u_recv(
+            x, np.array([0, 1, 2]), np.array([0, 0, 1]))
+        out.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._data),
+                                   np.ones((3, 2)))
+
+
+class TestSparse:
+    def _coo(self):
+        idx = np.array([[0, 1, 2], [1, 0, 2]])
+        vals = np.array([1., 2., 3.], dtype=np.float32)
+        return paddle.sparse.sparse_coo_tensor(idx, vals, [3, 3])
+
+    def test_coo_dense_roundtrip(self):
+        sp = self._coo()
+        dense = np.asarray(sp.to_dense()._data)
+        expect = np.zeros((3, 3), dtype=np.float32)
+        expect[0, 1], expect[1, 0], expect[2, 2] = 1, 2, 3
+        np.testing.assert_array_equal(dense, expect)
+        assert sp.nnz == 3 and sp.is_sparse_coo()
+
+    def test_coalesce_merges_duplicates(self):
+        idx = np.array([[0, 0, 1], [1, 1, 0]])
+        sp = paddle.sparse.sparse_coo_tensor(
+            idx, np.array([1., 2., 5.], dtype=np.float32), [2, 2])
+        c = paddle.sparse.coalesce(sp)
+        assert c.nnz == 2
+        np.testing.assert_allclose(np.asarray(c.to_dense()._data),
+                                   [[0., 3.], [5., 0.]])
+
+    def test_csr_conversion_and_matmul(self):
+        sp = self._coo()
+        csr = sp.to_sparse_csr()
+        assert csr.is_sparse_csr() and csr.nnz == 3
+        np.testing.assert_array_equal(np.asarray(csr.crows()._data),
+                                      [0, 1, 2, 3])
+        y = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+        out = paddle.sparse.matmul(sp, paddle.to_tensor(y))
+        ref = np.asarray(sp.to_dense()._data) @ y
+        np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-5)
+        mv = paddle.sparse.mv(csr, paddle.to_tensor(y[:, 0]))
+        np.testing.assert_allclose(np.asarray(mv._data), ref[:, 0],
+                                   atol=1e-5)
+
+    def test_matmul_grad_wrt_values_and_dense(self):
+        idx = np.array([[0, 1], [1, 0]])
+        vals = paddle.to_tensor(np.array([2., 3.], dtype=np.float32),
+                                stop_gradient=False)
+        sp = paddle.sparse.sparse_coo_tensor(idx, vals, [2, 2])
+        y = paddle.to_tensor(np.ones((2, 2), dtype=np.float32),
+                             stop_gradient=False)
+        out = paddle.sparse.matmul(sp, y)
+        out.sum().backward()
+        np.testing.assert_allclose(np.asarray(vals.grad._data), [2., 2.])
+        np.testing.assert_allclose(np.asarray(y.grad._data),
+                                   [[3., 3.], [2., 2.]])
+
+    def test_elementwise_union_pattern(self):
+        a = paddle.sparse.sparse_coo_tensor(
+            np.array([[0], [0]]), np.array([1.], dtype=np.float32), [2, 2])
+        b = paddle.sparse.sparse_coo_tensor(
+            np.array([[1], [1]]), np.array([2.], dtype=np.float32), [2, 2])
+        s = paddle.sparse.add(a, b)
+        np.testing.assert_allclose(np.asarray(s.to_dense()._data),
+                                   [[1., 0.], [0., 2.]])
+        m = paddle.sparse.multiply(a, b)
+        np.testing.assert_allclose(np.asarray(m.to_dense()._data),
+                                   np.zeros((2, 2)))
+
+    def test_unary_valuewise(self):
+        sp = self._coo()
+        out = paddle.sparse.square(sp)
+        np.testing.assert_allclose(np.asarray(out.values()._data),
+                                   [1., 4., 9.])
+        neg = paddle.sparse.neg(sp)
+        np.testing.assert_allclose(np.asarray(neg.values()._data),
+                                   [-1., -2., -3.])
+
+    def test_masked_matmul_addmm(self):
+        rng = np.random.default_rng(4)
+        a = rng.normal(size=(3, 4)).astype(np.float32)
+        b = rng.normal(size=(4, 3)).astype(np.float32)
+        mask = self._coo()
+        out = paddle.sparse.masked_matmul(
+            paddle.to_tensor(a), paddle.to_tensor(b), mask)
+        full = a @ b
+        got = np.asarray(out.to_dense()._data)
+        pattern = np.asarray(mask.to_dense()._data) != 0
+        np.testing.assert_allclose(got[pattern], full[pattern], atol=1e-5)
+        assert (got[~pattern] == 0).all()
+        inp = paddle.to_tensor(np.ones((3, 3), dtype=np.float32))
+        am = paddle.sparse.addmm(
+            inp, mask, paddle.to_tensor(rng.normal(size=(3, 3))
+                                        .astype(np.float32)),
+            beta=0.5, alpha=2.0)
+        assert list(am.shape) == [3, 3]
+
+    def test_sparse_softmax_rows_sum_to_one(self):
+        sp = self._coo().to_sparse_csr()
+        sm = paddle.sparse.nn.functional.softmax(sp)
+        dense = np.asarray(sm.to_dense()._data)
+        rows = dense.sum(axis=1)
+        np.testing.assert_allclose(rows, [1., 1., 1.], atol=1e-6)
+
+    def test_sparse_softmax_batched_groups_per_row(self):
+        # batch 0 row 0 has TWO entries; batch 1 row 0 has one — each ROW
+        # (not each batch) must sum to 1
+        idx = np.array([[0, 0, 1], [0, 0, 0], [0, 1, 1]])
+        sp = paddle.sparse.sparse_coo_tensor(
+            idx, np.array([1., 2., 5.], dtype=np.float32), [2, 2, 2])
+        sm = paddle.sparse.nn.functional.softmax(sp)
+        dense = np.asarray(sm.to_dense()._data)
+        np.testing.assert_allclose(dense[0, 0].sum(), 1.0, atol=1e-6)
+        np.testing.assert_allclose(dense[1, 0].sum(), 1.0, atol=1e-6)
+
+    def test_sparse_relu_layer(self):
+        idx = np.array([[0, 1], [0, 1]])
+        sp = paddle.sparse.sparse_coo_tensor(
+            idx, np.array([-1., 2.], dtype=np.float32), [2, 2])
+        out = paddle.sparse.nn.ReLU()(sp)
+        np.testing.assert_allclose(np.asarray(out.values()._data), [0., 2.])
