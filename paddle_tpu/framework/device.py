@@ -86,5 +86,17 @@ class TPUPlace:
         return f"Place(tpu:{self.idx})"
 
 
-# Alias so scripts doing paddle.CUDAPlace(0) keep working.
+# Aliases so scripts doing paddle.CUDAPlace(0) / NPUPlace(0) keep working.
 CUDAPlace = TPUPlace
+NPUPlace = TPUPlace
+XPUPlace = TPUPlace
+MLUPlace = TPUPlace
+IPUPlace = TPUPlace
+
+
+class CUDAPinnedPlace:
+    """Host pinned memory place (reference: CUDAPinnedPlace). Host arrays
+    feed the device through PJRT's own pinned staging on TPU."""
+
+    def __repr__(self):
+        return "Place(gpu_pinned)"

@@ -1,0 +1,113 @@
+"""FLOPs counter.
+
+Reference: python/paddle/hapi/dynamic_flops.py (paddle.flops) — registers
+forward hooks on leaf layers, runs one dummy forward, sums per-layer FLOPs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn import layer_base
+from ..tensor import Tensor
+
+__all__ = ['flops']
+
+
+def _prod(shape):
+    return int(np.prod([s for s in shape if s is not None])) if shape else 1
+
+
+def _count_linear(layer, x, y):
+    return _prod(x.shape) // x.shape[-1] * layer.weight.shape[0] \
+        * layer.weight.shape[1]
+
+
+def _count_conv(layer, x, y):
+    w = layer.weight
+    kernel_ops = _prod(w.shape[1:])  # cin/groups * prod(kernel)
+    return _prod(y.shape) * kernel_ops
+
+
+def _count_norm(layer, x, y):
+    return 2 * _prod(x.shape)
+
+
+def _count_act(layer, x, y):
+    return _prod(x.shape)
+
+
+def _count_pool(layer, x, y):
+    return _prod(y.shape)
+
+
+def _count_embedding(layer, x, y):
+    return 0
+
+
+def _default_counters():
+    from .. import nn
+    table = {}
+    for cls, fn in [
+        (nn.Linear, _count_linear),
+        (getattr(nn, 'Conv1D', None), _count_conv),
+        (getattr(nn, 'Conv2D', None), _count_conv),
+        (getattr(nn, 'Conv3D', None), _count_conv),
+        (getattr(nn, 'BatchNorm1D', None), _count_norm),
+        (getattr(nn, 'BatchNorm2D', None), _count_norm),
+        (getattr(nn, 'BatchNorm3D', None), _count_norm),
+        (getattr(nn, 'LayerNorm', None), _count_norm),
+        (getattr(nn, 'ReLU', None), _count_act),
+        (getattr(nn, 'GELU', None), _count_act),
+        (getattr(nn, 'Sigmoid', None), _count_act),
+        (getattr(nn, 'AvgPool2D', None), _count_pool),
+        (getattr(nn, 'MaxPool2D', None), _count_pool),
+        (getattr(nn, 'AdaptiveAvgPool2D', None), _count_pool),
+        (getattr(nn, 'Embedding', None), _count_embedding),
+    ]:
+        if cls is not None:
+            table[cls] = fn
+    return table
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Total multiply-accumulate FLOPs for one forward at ``input_size``.
+    Reference: hapi/dynamic_flops.py::flops."""
+    counters = _default_counters()
+    if custom_ops:
+        counters.update(custom_ops)
+    records = []
+    handles = []
+
+    def make_hook(layer, fn):
+        def hook(lyr, inputs, output):
+            x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+            y = output[0] if isinstance(output, (tuple, list)) else output
+            try:
+                records.append((type(lyr).__name__, int(fn(lyr, x, y))))
+            except Exception:
+                records.append((type(lyr).__name__, 0))
+        return hook
+
+    for layer in net.sublayers(include_self=True):
+        fn = counters.get(type(layer))
+        if fn is not None:
+            handles.append(layer.register_forward_post_hook(
+                make_hook(layer, fn)))
+
+    was_training = net.training
+    net.eval()
+    x = Tensor(np.zeros(input_size, dtype=np.float32))
+    try:
+        net(x)
+    finally:
+        for h in handles:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(f for _, f in records)
+    if print_detail:
+        for name, f in records:
+            print(f"  {name:<24s} {f:>16,d}")
+        print(f"Total FLOPs: {total:,d}")
+    return total

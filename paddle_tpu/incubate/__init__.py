@@ -14,6 +14,11 @@ from ..geometric import (  # noqa: F401  (incubate/tensor/math.py)
 from . import autotune  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from .graph_ops import (  # noqa: F401
+    graph_khop_sampler, graph_reindex, graph_sample_neighbors,
+    graph_send_recv, identity_loss, softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 
 __all__ = [

@@ -1,0 +1,139 @@
+"""Incubate graph ops + fused softmax masks.
+
+Reference: python/paddle/incubate/operators (graph_send_recv,
+graph_khop_sampler, graph_reindex, graph_sample_neighbors,
+softmax_mask_fuse, softmax_mask_fuse_upper_triangle) and identity_loss.
+Sampling ops have data-dependent output sizes → host-side numpy (eager
+only), like the reference's CPU fallbacks; the fused masks are jnp
+composites XLA fuses into one kernel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..geometric import send_u_recv
+from ..tensor import Tensor, apply
+
+__all__ = ['graph_send_recv', 'graph_khop_sampler', 'graph_reindex',
+           'graph_sample_neighbors', 'identity_loss', 'softmax_mask_fuse',
+           'softmax_mask_fuse_upper_triangle']
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """2.3-era name for geometric.send_u_recv."""
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def _np_ids(x):
+    v = x._data if isinstance(x, Tensor) else x
+    return np.asarray(jax.device_get(v)).astype(np.int64)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Sample up to ``sample_size`` in-neighbors per input node from a CSC
+    graph (row indices + column pointers). Reference:
+    incubate/operators/graph_sample_neighbors.py."""
+    rows = _np_ids(row)
+    cptr = _np_ids(colptr)
+    nodes = _np_ids(input_nodes)
+    rng = np.random.default_rng(int(nodes.sum()) + len(nodes))
+    out_neighbors, out_counts, out_eids = [], [], []
+    for n in nodes:
+        beg, end = cptr[n], cptr[n + 1]
+        nbrs = rows[beg:end]
+        ids = np.arange(beg, end)
+        if sample_size > 0 and len(nbrs) > sample_size:
+            pick = rng.choice(len(nbrs), size=sample_size, replace=False)
+            nbrs, ids = nbrs[pick], ids[pick]
+        out_neighbors.append(nbrs)
+        out_counts.append(len(nbrs))
+        out_eids.append(ids)
+    neigh = Tensor(np.concatenate(out_neighbors) if out_neighbors
+                   else np.zeros((0,), np.int64))
+    counts = Tensor(np.asarray(out_counts, dtype=np.int64))
+    if return_eids:
+        return neigh, counts, Tensor(np.concatenate(out_eids)
+                                     if out_eids else
+                                     np.zeros((0,), np.int64))
+    return neigh, counts
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex a sampled subgraph to contiguous ids: x (center nodes) take
+    0..n-1, unseen neighbors get fresh ids. Reference:
+    incubate/operators/graph_reindex.py."""
+    xs = _np_ids(x)
+    nbrs = _np_ids(neighbors)
+    cnt = _np_ids(count)
+    mapping = {}
+    for v in xs:
+        mapping.setdefault(int(v), len(mapping))
+    for v in nbrs:
+        mapping.setdefault(int(v), len(mapping))
+    reindex_src = np.asarray([mapping[int(v)] for v in nbrs],
+                             dtype=np.int64)
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    out_nodes = np.asarray(sorted(mapping, key=mapping.get),
+                           dtype=np.int64)
+    return Tensor(reindex_src), Tensor(reindex_dst), Tensor(out_nodes)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling + reindex: hop h samples
+    ``sample_sizes[h]`` in-neighbors for every node of the previous
+    frontier; all sampled edges are reindexed together. Reference:
+    incubate/operators/graph_khop_sampler.py."""
+    frontiers = [_np_ids(input_nodes)]
+    all_neighbors, all_counts = [], []
+    for size in sample_sizes:
+        neigh, cnt = graph_sample_neighbors(
+            row, colptr, Tensor(frontiers[-1]), sample_size=size)
+        nb = _np_ids(neigh)
+        all_neighbors.append(nb)
+        all_counts.append(_np_ids(cnt))
+        frontiers.append(np.unique(nb))
+    neighbors = np.concatenate(all_neighbors)
+    counts = np.concatenate(all_counts)
+    centers = np.concatenate(frontiers[:-1])  # one count per center node
+    src, dst, nodes = graph_reindex(Tensor(centers), Tensor(neighbors),
+                                    Tensor(counts))
+    if return_eids:
+        return src, dst, nodes, Tensor(counts), None
+    return src, dst, nodes, Tensor(counts)
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as the loss (IPU-era op). Reference:
+    incubate/nn/functional? identity_loss."""
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+    if red == "mean":
+        return apply(jnp.mean, x)
+    if red == "sum":
+        return apply(jnp.sum, x)
+    return x
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) fused (XLA fuses the composite). Reference:
+    incubate/operators/softmax_mask_fuse.py."""
+    return apply(lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """softmax with the causal (upper-triangle masked) pattern fused.
+    x: [B, H, S, S]. Reference:
+    incubate/operators/softmax_mask_fuse_upper_triangle.py."""
+    def f(a):
+        s = a.shape[-1]
+        causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+        return jax.nn.softmax(jnp.where(causal, a, -1e4), axis=-1)
+    return apply(f, x)

@@ -7,3 +7,11 @@ from .sampler import (  # noqa: F401
     SequenceSampler, WeightedRandomSampler,
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+
+
+def get_worker_info():
+    """Reference: io/dataloader/worker.py::get_worker_info. Our DataLoader
+    workers are threads in one process; inside a worker this returns its
+    (id, num_workers, dataset), in the main thread None."""
+    from .dataloader import _worker_info
+    return _worker_info()

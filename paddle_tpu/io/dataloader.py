@@ -18,6 +18,21 @@ from ..tensor import Tensor
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
+_WORKER_TLS = threading.local()
+
+
+class WorkerInfo:
+    """Reference: io/dataloader/worker.py::WorkerInfo."""
+
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def _worker_info():
+    return getattr(_WORKER_TLS, "info", None)
+
 
 def _stack(arrays):
     from ..runtime.native import gather_stack
@@ -105,10 +120,19 @@ class DataLoader:
         # native C++ ring-buffer prefetcher if available, else thread pool.
         # Availability is decided before the first batch is pulled so a
         # mid-epoch failure propagates instead of restarting the iterator.
+        def tagged_batches():
+            # mark the producing thread as worker 0 of num_workers so
+            # get_worker_info() answers inside dataset/collate code
+            _WORKER_TLS.info = WorkerInfo(0, self.num_workers, self.dataset)
+            try:
+                yield from self._make_batches()
+            finally:
+                _WORKER_TLS.info = None
+
         src = None
         try:
             from ..runtime.prefetcher import NativePrefetcher
-            src = NativePrefetcher(self._make_batches(),
+            src = NativePrefetcher(tagged_batches(),
                                    depth=self.num_workers * self.prefetch_factor)
         except Exception:
             src = None
@@ -122,7 +146,7 @@ class DataLoader:
 
         def producer():
             try:
-                for b in self._make_batches():
+                for b in tagged_batches():
                     q.put(b)
                 q.put(sentinel)
             except BaseException as e:  # surface dataset errors to consumer

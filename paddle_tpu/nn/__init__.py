@@ -14,10 +14,10 @@ from .layer.activation import (  # noqa: F401
     Tanh, Tanhshrink, ThresholdedReLU,
 )
 from .layer.common import (  # noqa: F401
-    AlphaDropout, Bilinear, CosineSimilarity, Dropout, Dropout2D, Dropout3D,
-    Embedding, Flatten, Fold, Identity, Linear, Pad1D, Pad2D, Pad3D,
-    PairwiseDistance, Unfold, Upsample, UpsamplingBilinear2D,
-    UpsamplingNearest2D, ZeroPad2D,
+    AlphaDropout, Bilinear, ChannelShuffle, CosineSimilarity, Dropout,
+    Dropout2D, Dropout3D, Embedding, Flatten, Fold, Identity, Linear, Pad1D,
+    Pad2D, Pad3D, PairwiseDistance, PixelShuffle, PixelUnshuffle, Softmax2D,
+    Unfold, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D,
 )
 from .layer.container import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
 from .layer.conv import (  # noqa: F401
@@ -25,19 +25,23 @@ from .layer.conv import (  # noqa: F401
 )
 from .layer.loss import (  # noqa: F401
     BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss, CrossEntropyLoss,
-    CTCLoss, HingeEmbeddingLoss, KLDivLoss, L1Loss, MarginRankingLoss,
-    MSELoss, NLLLoss, SmoothL1Loss, TripletMarginLoss,
+    CTCLoss, HingeEmbeddingLoss, HSigmoidLoss, KLDivLoss, L1Loss,
+    MarginRankingLoss, MSELoss, MultiLabelSoftMarginLoss, NLLLoss,
+    SmoothL1Loss, SoftMarginLoss, TripletMarginLoss,
+    TripletMarginWithDistanceLoss,
 )
 from .layer.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
     InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LayerNorm,
-    LocalResponseNorm, RMSNorm, SyncBatchNorm,
+    LocalResponseNorm, RMSNorm, SpectralNorm, SyncBatchNorm,
 )
 from .layer.pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
     AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
-    AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D,
+    AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D, MaxUnPool1D,
+    MaxUnPool2D, MaxUnPool3D,
 )
+from .layer.decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
 from .layer.rnn import (  # noqa: F401
     BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN, RNNCellBase, SimpleRNN,
     SimpleRNNCell,

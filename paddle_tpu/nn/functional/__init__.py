@@ -10,12 +10,19 @@ from .conv import (  # noqa: F401
     conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d,
     conv3d_transpose,
 )
+from .extension import (  # noqa: F401
+    class_center_sample, diag_embed, gather_tree, sequence_mask,
+    temporal_shift,
+)
 from .loss import (  # noqa: F401
     binary_cross_entropy, binary_cross_entropy_with_logits,
-    cosine_embedding_loss, cross_entropy, ctc_loss, hinge_embedding_loss,
-    kl_div, l1_loss, log_loss, margin_ranking_loss, mse_loss, nll_loss,
-    smooth_l1_loss, softmax_with_cross_entropy, square_error_cost,
-    triplet_margin_loss,
+    cosine_embedding_loss, cross_entropy, ctc_loss, dice_loss,
+    hinge_embedding_loss, hsigmoid_loss, kl_div, l1_loss, log_loss,
+    margin_cross_entropy, margin_ranking_loss, mse_loss,
+    multi_label_soft_margin_loss, nll_loss, npair_loss,
+    sigmoid_focal_loss, smooth_l1_loss, soft_margin_loss,
+    softmax_with_cross_entropy, square_error_cost, triplet_margin_loss,
+    triplet_margin_with_distance_loss,
 )
 from .norm import (  # noqa: F401
     batch_norm, group_norm, instance_norm, layer_norm, local_response_norm,
@@ -24,7 +31,8 @@ from .norm import (  # noqa: F401
 from .pooling import (  # noqa: F401
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
     adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d, avg_pool1d,
-    avg_pool2d, avg_pool3d, max_pool1d, max_pool2d, max_pool3d,
+    avg_pool2d, avg_pool3d, max_pool1d, max_pool2d, max_pool3d, max_unpool1d,
+    max_unpool2d, max_unpool3d,
 )
 from .vision import (  # noqa: F401
     affine_grid, channel_shuffle, grid_sample, pixel_shuffle, pixel_unshuffle,

@@ -133,3 +133,33 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 
 def thresholded_relu(x, threshold=1.0, name=None):
     return apply(lambda a: jnp.where(a > threshold, a, 0.0), x)
+
+
+def _inplace(x, op):
+    """Run op on a detached clone of x, rebind x to the result
+    (inplace-variant semantics; XLA buffers are immutable so 'inplace' is
+    a rebind, with true in-place reuse coming from donation under jit).
+    The clone keeps x from becoming its own autograd ancestor."""
+    from ...tensor_ops.extras import _detached_clone
+    out = op(_detached_clone(x))
+    x._data = out._data
+    x._node = out._node
+    x._out_index = out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def relu_(x, name=None):
+    return _inplace(x, relu)
+
+
+def elu_(x, alpha=1.0, name=None):
+    return _inplace(x, lambda c: elu(c, alpha))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return _inplace(x, lambda c: softmax(c, axis, dtype))
+
+
+def tanh_(x, name=None):
+    return _inplace(x, tanh)

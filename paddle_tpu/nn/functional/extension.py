@@ -1,0 +1,121 @@
+"""Extension functionals.
+
+Reference: python/paddle/nn/functional/extension.py (diag_embed,
+sequence_mask, gather_tree, temporal_shift).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import Tensor, apply, nondiff
+
+__all__ = ['diag_embed', 'sequence_mask', 'gather_tree', 'temporal_shift',
+           'class_center_sample']
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal embed: last dim of ``input`` becomes the
+    (dim1, dim2) diagonal. Reference: extension.py::diag_embed."""
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        out = out.at[..., idx + max(-offset, 0), idx + max(offset, 0)].set(a)
+        nd = a.ndim + 1
+        d1, d2 = dim1 % nd, dim2 % nd
+        if (d1, d2) != (nd - 2, nd - 1):
+            out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+        return out
+    return apply(f, input if isinstance(input, Tensor) else Tensor(input))
+
+
+def sequence_mask(x, maxlen=None, dtype='int64', name=None):
+    """lengths → 0/1 mask [..., maxlen]. Reference:
+    extension.py::sequence_mask."""
+    from ...framework.dtype import convert_dtype
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    if maxlen is None:
+        import jax
+        maxlen = int(np.asarray(jax.device_get(xt._data)).max())
+    dt = convert_dtype(dtype)
+
+    def f(lens):
+        return (jnp.arange(maxlen) < lens[..., None]).astype(dt)
+
+    return nondiff(f, xt)
+
+
+def gather_tree(ids, parents):
+    """Back-trace beam-search ids along parent pointers.
+    ids/parents: [max_time, batch, beam]. Reference:
+    extension.py::gather_tree (C++ gather_tree op)."""
+    import jax
+
+    def f(ids_a, parents_a):
+        t_max = ids_a.shape[0]
+        beam = jnp.arange(ids_a.shape[2])
+
+        def step(carry, t):
+            parent = carry  # [batch, beam] indices into beam dim
+            idx = t_max - 1 - t
+            out = jnp.take_along_axis(ids_a[idx], parent, axis=-1)
+            parent = jnp.take_along_axis(parents_a[idx], parent, axis=-1)
+            return parent, out
+
+        init = jnp.broadcast_to(beam, ids_a.shape[1:])
+        _, outs = jax.lax.scan(step, init, jnp.arange(t_max))
+        return outs[::-1]
+
+    return nondiff(f, ids, parents)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """TSM temporal shift: shift a channel slice one step along time.
+    x: [N*T, C, H, W]. Reference: extension.py::temporal_shift."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError("data_format must be NCHW or NHWC")
+
+    def f(a):
+        if data_format == "NHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.pad(v[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0),
+                                       (0, 0)))
+        fwd = jnp.pad(v[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0),
+                                         (0, 0)))
+        out = jnp.concatenate([back, fwd, v[:, :, c2:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply(f, x if isinstance(x, Tensor) else Tensor(x))
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample class centers: all positive classes plus random negatives up
+    to ``num_samples``; remap labels into the sampled index space.
+    Data-dependent sizes — eager-only (host-side sampling), as in the
+    reference's GPU kernel which also materializes the sampled set.
+    Reference: common.py::class_center_sample."""
+    import jax
+
+    lt = label if isinstance(label, Tensor) else Tensor(label)
+    y = np.asarray(jax.device_get(lt._data)).astype(np.int64)
+    pos = np.unique(y)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg = np.setdiff1d(np.arange(num_classes), pos)
+        rng = np.random.default_rng(len(y) + int(pos.sum()))
+        extra = rng.choice(neg, size=num_samples - len(pos), replace=False)
+        sampled = np.concatenate([pos, np.sort(extra)])
+    remap = -np.ones((num_classes,), dtype=np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(remap[y]), Tensor(sampled))

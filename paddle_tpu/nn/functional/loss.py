@@ -241,3 +241,166 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return _reduce(nll, reduction)
 
     return apply(f, log_probs)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - Dice coefficient between softmaxed predictions and one-hot
+    labels. input [N, ..., C] probabilities, label [N, ..., 1] int.
+    Reference: loss.py::dice_loss."""
+    def f(p, y):
+        yi = jnp.squeeze(y, -1) if y.shape[-1] == 1 else y
+        onehot = jax.nn.one_hot(yi, p.shape[-1], dtype=p.dtype)
+        dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * onehot, axis=dims)
+        union = jnp.sum(p, axis=dims) + jnp.sum(onehot, axis=dims)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+    return apply(f, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """Improved-triplet N-pair loss. anchor/positive [N, D], labels [N].
+    Reference: loss.py::npair_loss."""
+    def f(a, p, y):
+        sim = a @ p.T  # [N, N]
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = same / jnp.sum(same, axis=1, keepdims=True)
+        xent = jnp.mean(jnp.sum(
+            -tgt * jax.nn.log_softmax(sim, axis=1), axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1))
+                        + jnp.mean(jnp.sum(p * p, 1))) * 0.25
+        return xent + reg
+    return apply(f, anchor, positive, labels)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction='sum', name=None):
+    """Focal loss on logits (RetinaNet). Reference:
+    loss.py::sigmoid_focal_loss."""
+    def f(x, y, *maybe_norm):
+        p = jax.nn.sigmoid(x)
+        ce = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if maybe_norm:
+            loss = loss / maybe_norm[0]
+        return _reduce(loss, reduction)
+    args = (logit, label) + ((normalizer,) if normalizer is not None else ())
+    return apply(f, *args)
+
+
+def soft_margin_loss(input, label, reduction='mean', name=None):
+    """log(1 + exp(-label * input)), label in {-1, 1}. Reference:
+    loss.py::soft_margin_loss."""
+    def f(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y.astype(x.dtype) * x)),
+                       reduction)
+    return apply(f, input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction='mean', name=None):
+    """Mean-over-classes BCE-with-logits vs multi-hot labels. Reference:
+    loss.py::multi_label_soft_margin_loss."""
+    def f(x, y, *w):
+        yf = y.astype(x.dtype)
+        term = yf * jax.nn.log_sigmoid(x) + (1 - yf) * jax.nn.log_sigmoid(-x)
+        if w:
+            term = term * w[0]
+        return _reduce(-jnp.mean(term, axis=-1), reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(f, *args)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction='mean',
+                                      name=None):
+    """Triplet loss with a custom distance callable. Reference:
+    loss.py::triplet_margin_with_distance_loss."""
+    if distance_function is None:
+        def distance_function(a, b):
+            d = a - b
+            return jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-12) \
+                if isinstance(a, jnp.ndarray) else ((a - b) ** 2).sum(-1)
+
+    def f(x, p, n):
+        def dist(u, v):
+            out = distance_function(u, v)
+            return out._data if isinstance(out, Tensor) else out
+        d_pos = dist(x, p)
+        d_neg = dist(x, n)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(p, n))
+        return _reduce(jnp.maximum(d_pos - d_neg + margin, 0), reduction)
+    return apply(f, input, positive, negative)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over the default complete binary tree.
+    input [N, D], label [N], weight [num_classes-1, D], bias
+    [num_classes-1]. Reference: loss.py::hsigmoid_loss (phi
+    hierarchical_sigmoid kernel's default-tree mode)."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom-tree hsigmoid (path_table/path_code) is not supported; "
+            "use the default complete binary tree")
+    import numpy as np
+    depth = int(np.ceil(np.log2(max(num_classes, 2))))
+
+    # static per-class paths over the complete tree: internal node ids and
+    # left/right codes, root is node 0; class c enters at leaf c +
+    # (num_classes - 1)
+    codes = np.zeros((num_classes, depth), dtype=np.int8)
+    nodes = np.zeros((num_classes, depth), dtype=np.int32)
+    lengths = np.zeros((num_classes,), dtype=np.int32)
+    for c in range(num_classes):
+        node = c + num_classes - 1
+        path = []
+        while node > 0:
+            parent = (node - 1) // 2
+            path.append((parent, node == 2 * parent + 2))
+            node = parent
+        lengths[c] = len(path)
+        for i, (n_, code) in enumerate(reversed(path)):
+            nodes[c, i] = n_
+            codes[c, i] = code
+    nodes_j, codes_j, len_j = (jnp.asarray(nodes), jnp.asarray(codes),
+                               jnp.asarray(lengths))
+
+    def f(x, y, w, *maybe_b):
+        yn = nodes_j[y]          # [N, depth]
+        yc = codes_j[y].astype(x.dtype)
+        yl = len_j[y]            # [N]
+        wv = w[yn]               # [N, depth, D]
+        logits = jnp.einsum('nd,nkd->nk', x, wv)
+        if maybe_b:
+            logits = logits + maybe_b[0][yn]
+        # p(go right) = sigmoid(logit); NLL of the observed code
+        ll = yc * jax.nn.log_sigmoid(logits) \
+            + (1 - yc) * jax.nn.log_sigmoid(-logits)
+        mask = jnp.arange(ll.shape[1])[None, :] < yl[:, None]
+        return -jnp.sum(ll * mask, axis=1, keepdims=True)
+    args = (input, label, weight) + ((bias,) if bias is not None else ())
+    return apply(f, *args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction='mean'):
+    """ArcFace-family margin softmax: cos(m1*theta + m2) - m3 on the
+    target class, scaled. Reference: loss.py::margin_cross_entropy
+    (single-group path; model-parallel sharding comes from pjit specs)."""
+    def f(lg, y):
+        cos = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(y, lg.shape[-1], dtype=lg.dtype)
+        adjusted = scale * (onehot * target + (1 - onehot) * cos)
+        logp = jax.nn.log_softmax(adjusted, axis=-1)
+        loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+        return _reduce(loss, reduction), jnp.exp(logp)
+    out, sm = apply(f, logits, label, n_outputs=2)
+    return (out, sm) if return_softmax else out

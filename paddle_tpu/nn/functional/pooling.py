@@ -57,6 +57,9 @@ def _pool_nd(x, n, kernel, stride, padding, kind, ceil_mode=False,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, 1, kernel_size, stride, padding,
+                                   channel_last=data_format == "NLC")
     df = "NWC" if data_format == "NLC" else "NCW"
     return _pool_nd(x, 1, kernel_size, stride, padding, "max", ceil_mode,
                     data_format=df)
@@ -64,12 +67,18 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, 2, kernel_size, stride, padding,
+                                   channel_last=data_format == "NHWC")
     return _pool_nd(x, 2, kernel_size, stride, padding, "max", ceil_mode,
                     data_format=data_format)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, 3, kernel_size, stride, padding,
+                                   channel_last=data_format == "NDHWC")
     return _pool_nd(x, 3, kernel_size, stride, padding, "max", ceil_mode,
                     data_format=data_format)
 
@@ -156,3 +165,134 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool(x, 3, output_size, "max", "NCDHW")
+
+
+def _max_pool_with_mask(x, n, kernel, stride, padding, channel_last,
+                        ceil_mode=False):
+    """Max pool that also returns the argmax mask (flat index into the
+    input spatial plane, the reference's mask convention). Built from an
+    explicit window gather — only used on the return_mask/unpool path;
+    the plain path stays on reduce_window."""
+    if ceil_mode:
+        raise NotImplementedError(
+            "return_mask=True with ceil_mode=True is not supported")
+    if isinstance(padding, str):
+        raise NotImplementedError(
+            "return_mask=True requires integer padding, got "
+            f"{padding!r}")
+    ks = _norm_tuple(kernel, n)
+    st = _norm_tuple(stride if stride is not None else kernel, n)
+    pd = _norm_tuple(padding if not isinstance(padding, str) else 0, n)
+
+    def f(a):
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)
+        lead = a.shape[:2]
+        spatial = a.shape[2:]
+        pads = [(0, 0), (0, 0)] + [(p, p) for p in pd]
+        neg = jnp.finfo(a.dtype).min if np.dtype(a.dtype).kind == "f" \
+            else np.iinfo(np.dtype(a.dtype)).min
+        ap = jnp.pad(a, pads, constant_values=neg)
+        out_dims = [(spatial[i] + 2 * pd[i] - ks[i]) // st[i] + 1
+                    for i in range(n)]
+        # index grids: for each output position o and kernel offset k, the
+        # padded input coordinate o*stride + k
+        grids = []
+        for i in range(n):
+            g = (jnp.arange(out_dims[i])[:, None] * st[i]
+                 + jnp.arange(ks[i])[None, :])  # [O_i, K_i]
+            grids.append(g)
+        # windows gathered as [N, C, O..., K...]; mask = flat index of the
+        # winning element in the UNPADDED input plane
+        if n == 1:
+            win = ap[:, :, grids[0]]                        # N,C,O1,K1
+            flat = win.reshape(lead + (out_dims[0], -1))
+            in_flat = grids[0] - pd[0]                      # O1,K1
+            flat_idx = in_flat.reshape(1, 1, out_dims[0], -1)
+        elif n == 2:
+            win = ap[:, :, grids[0][:, None, :, None],
+                     grids[1][None, :, None, :]]             # N,C,O1,O2,K1,K2
+            flat = win.reshape(lead + (out_dims[0], out_dims[1], -1))
+            r = grids[0] - pd[0]                             # O1,K1
+            c = grids[1] - pd[1]                             # O2,K2
+            in_flat = (r[:, None, :, None] * spatial[1]
+                       + c[None, :, None, :])                # O1,O2,K1,K2
+            flat_idx = in_flat.reshape(1, 1, out_dims[0], out_dims[1], -1)
+        else:
+            win = ap[:, :, grids[0][:, None, None, :, None, None],
+                     grids[1][None, :, None, None, :, None],
+                     grids[2][None, None, :, None, None, :]]
+            flat = win.reshape(lead + tuple(out_dims) + (-1,))
+            d0 = grids[0] - pd[0]
+            d1 = grids[1] - pd[1]
+            d2 = grids[2] - pd[2]
+            in_flat = (d0[:, None, None, :, None, None]
+                       * (spatial[1] * spatial[2])
+                       + d1[None, :, None, None, :, None] * spatial[2]
+                       + d2[None, None, :, None, None, :])
+            flat_idx = in_flat.reshape((1, 1) + tuple(out_dims) + (-1,))
+        amax = jnp.argmax(flat, axis=-1)
+        out = jnp.take_along_axis(flat, amax[..., None], axis=-1)[..., 0]
+        mask = jnp.take_along_axis(
+            jnp.broadcast_to(flat_idx, flat.shape), amax[..., None],
+            axis=-1)[..., 0].astype(jnp.int32)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+            mask = jnp.moveaxis(mask, 1, -1)
+        return out, mask
+
+    return apply(f, x, n_outputs=2)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Scatter pooled values back to their argmax positions. Reference:
+    pooling.py::max_unpool1d."""
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, channel_last=data_format == "NLC")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, channel_last=data_format == "NHWC")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, channel_last=data_format == "NDHWC")
+
+
+def _max_unpool(x, indices, n, kernel, stride, padding, output_size,
+                channel_last):
+    ks = _norm_tuple(kernel, n)
+    st = _norm_tuple(stride if stride is not None else kernel, n)
+    pd = _norm_tuple(padding, n)
+    xt = x
+    ind = indices._data if hasattr(indices, "_data") else jnp.asarray(indices)
+
+    def f(a):
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)
+            ii = jnp.moveaxis(ind, -1, 1)
+        else:
+            ii = ind
+        lead = a.shape[:2]
+        out_sp = output_size
+        if out_sp is None:
+            sp = a.shape[2:]
+            out_sp = [(sp[i] - 1) * st[i] - 2 * pd[i] + ks[i]
+                      for i in range(n)]
+        out_sp = tuple(int(s) for s in out_sp[-n:])
+        flat_out = jnp.zeros(lead + (int(np.prod(out_sp)),), dtype=a.dtype)
+        flat_vals = a.reshape(lead + (-1,))
+        flat_ii = ii.reshape(lead + (-1,))
+        out = jax.vmap(jax.vmap(lambda o, i_, v: o.at[i_].set(v)))(
+            flat_out, flat_ii, flat_vals)
+        out = out.reshape(lead + out_sp)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply(f, xt)

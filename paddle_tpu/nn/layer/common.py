@@ -234,3 +234,47 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, self.output_sizes, *self.args)
+
+
+class PixelShuffle(Layer):
+    """Reference: nn/layer/vision.py::PixelShuffle."""
+
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor, self.data_format)
+
+
+class PixelUnshuffle(Layer):
+    """Reference: nn/layer/vision.py::PixelUnshuffle."""
+
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.downscale_factor = downscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor, self.data_format)
+
+
+class ChannelShuffle(Layer):
+    """Reference: nn/layer/vision.py::ChannelShuffle."""
+
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input. Reference:
+    nn/layer/activation.py::Softmax2D."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)

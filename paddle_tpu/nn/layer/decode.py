@@ -1,0 +1,189 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference: python/paddle/nn/decode.py (BeamSearchDecoder, dynamic_decode,
+Decoder base). TPU-native notes: each decode step is static-shaped
+([batch*beam, ...]); the step loop itself runs on the host because the
+stop condition is data-dependent (same structure the reference uses in
+dygraph mode). The backtrace is the gather_tree functional.
+"""
+from __future__ import annotations
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import Tensor
+from ..functional.extension import gather_tree
+from ..layer_base import Layer
+
+__all__ = ['Decoder', 'BeamSearchDecoder', 'dynamic_decode']
+
+
+class Decoder:
+    """Reference: nn/decode.py::Decoder — initialize/step/finalize."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _map_structure(fn, obj):
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_map_structure(fn, o) for o in obj)
+    return fn(obj)
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNN cell. Reference:
+    nn/decode.py::BeamSearchDecoder."""
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- beam layout helpers (reference's merge/split batch-beams) ---------
+    def _merge(self, x):
+        x = _raw(x)
+        return x.reshape((-1,) + x.shape[2:])
+
+    def _split(self, x, batch):
+        x = _raw(x)
+        return x.reshape((batch, self.beam_size) + x.shape[1:])
+
+    def tile_beam_merge_with_batch(self, x):
+        x = _raw(x)
+        tiled = jnp.repeat(x[:, None], self.beam_size, axis=1)
+        return tiled.reshape((-1,) + x.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        cell_states = _map_structure(
+            self.tile_beam_merge_with_batch, initial_cell_states)
+        probe = cell_states[0] if isinstance(cell_states, (list, tuple)) \
+            else cell_states
+        batch = probe.shape[0] // self.beam_size
+        # beam 0 starts live at log-prob 0, others at -inf
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1),
+                        dtype=jnp.float32), (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), dtype=bool)
+        lengths = jnp.zeros((batch, self.beam_size), dtype=jnp.int32)
+        init_inputs = jnp.full((batch * self.beam_size,), self.start_token,
+                               dtype=jnp.int32)
+        state = self.StateWrapper(cell_states, log_probs, finished, lengths)
+        return init_inputs, state, finished
+
+    def step(self, time, inputs, states, **kwargs):
+        batch = states.log_probs.shape[0]
+        cell_inputs = inputs
+        if self.embedding_fn is not None:
+            emb = self.embedding_fn(Tensor(jnp.asarray(cell_inputs)))
+            cell_inputs = _raw(emb)
+        cell_out, next_cell_states = self.cell(
+            Tensor(cell_inputs),
+            _map_structure(Tensor, states.cell_states), **kwargs)
+        logits = self.output_fn(cell_out) if self.output_fn is not None \
+            else cell_out
+        logits = _raw(logits)
+        vocab = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(logits, axis=-1)
+        step_lp = self._split(step_lp, batch)  # [B, beam, V]
+
+        # finished beams only extend with end_token at zero added cost
+        end_mask = jax.nn.one_hot(self.end_token, vocab, dtype=bool)
+        fin = states.finished[..., None]
+        step_lp = jnp.where(
+            fin, jnp.where(end_mask, 0.0, -1e9), step_lp)
+
+        total = states.log_probs[..., None] + step_lp  # [B, beam, V]
+        flat = total.reshape(batch, -1)
+        top_scores, top_idx = jax.lax.top_k(flat, self.beam_size)
+        parent = (top_idx // vocab).astype(jnp.int32)
+        token = (top_idx % vocab).astype(jnp.int32)
+
+        def pick_parent(s):
+            s = self._split(s, batch)
+            out = jnp.take_along_axis(
+                s, parent.reshape(parent.shape + (1,) * (s.ndim - 2)),
+                axis=1)
+            return out.reshape((-1,) + s.shape[2:])
+
+        next_cells = _map_structure(lambda s: pick_parent(_raw(s)),
+                                    next_cell_states)
+        prev_fin = jnp.take_along_axis(states.finished, parent, axis=1)
+        prev_len = jnp.take_along_axis(states.lengths, parent, axis=1)
+        now_fin = prev_fin | (token == self.end_token)
+        lengths = jnp.where(prev_fin, prev_len, prev_len + 1)
+
+        next_state = self.StateWrapper(next_cells, top_scores, now_fin,
+                                       lengths)
+        out = self.OutputWrapper(top_scores, token, parent)
+        next_inputs = token.reshape(-1)
+        return out, next_state, next_inputs, now_fin
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        # outputs.*: [T, B, beam] stacked — backtrace via gather_tree
+        preds = gather_tree(Tensor(outputs.predicted_ids),
+                            Tensor(outputs.parent_ids))
+        return self.OutputWrapper(Tensor(outputs.scores), preds,
+                                  Tensor(outputs.parent_ids)), final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=
+                   False, impute_finished=False, is_test=False,
+                   return_length=False, **kwargs):
+    """Run ``decoder`` until all beams finish or ``max_step_num``.
+    Reference: nn/decode.py::dynamic_decode."""
+    inputs, states, finished = decoder.initialize(inits)
+    outs = []
+    step = 0
+    max_steps = max_step_num if max_step_num is not None else 256
+    while step < max_steps:
+        out, states, inputs, finished = decoder.step(step, inputs, states,
+                                                     **kwargs)
+        outs.append(out)
+        step += 1
+        if bool(np.asarray(jax.device_get(jnp.all(finished)))):
+            break
+
+    stacked = type(outs[0])(*[jnp.stack([_raw(getattr(o, f))
+                                         for o in outs])
+                              for f in outs[0]._fields])
+    final_out, final_states = decoder.finalize(
+        stacked, states, getattr(states, "lengths", None))
+
+    if not output_time_major:
+        final_out = type(final_out)(*[
+            Tensor(jnp.moveaxis(_raw(f), 0, 1)) if _raw(f).ndim >= 2 else f
+            for f in final_out])
+    if return_length:
+        return final_out, final_states, Tensor(states.lengths)
+    return final_out, final_states

@@ -143,3 +143,73 @@ class CTCLoss(Layer):
                 norm_by_times=False):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           self.blank, self.reduction, norm_by_times)
+
+
+class SoftMarginLoss(Layer):
+    """Reference: nn/layer/loss.py::SoftMarginLoss."""
+
+    def __init__(self, reduction='mean', name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    """Reference: nn/layer/loss.py::MultiLabelSoftMarginLoss."""
+
+    def __init__(self, weight=None, reduction='mean', name=None):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    """Reference: nn/layer/loss.py::TripletMarginWithDistanceLoss."""
+
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction='mean', name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin = margin
+        self.swap = swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid over the default complete binary tree.
+    Reference: nn/layer/loss.py::HSigmoidLoss."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError(
+                "custom-tree HSigmoid is not supported (default tree only)")
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        from ..initializer import Uniform
+        import math
+        c = 2 * math.sqrt(1.0 / feature_size)
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr,
+            default_initializer=Uniform(-c, c))
+        self.bias = self.create_parameter(
+            (num_classes - 1,), attr=bias_attr, is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias)

@@ -175,3 +175,56 @@ class LocalResponseNorm(Layer):
 
     def forward(self, x):
         return F.local_response_norm(x, *self._args)
+
+
+class SpectralNorm(Layer):
+    """Standalone spectral-norm layer: normalizes an input WEIGHT tensor by
+    its largest singular value via power iteration (the reference's
+    nn/layer/norm.py::SpectralNorm, distinct from the nn.utils hook form).
+    """
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        h = int(weight_shape[dim])
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= int(s)
+        import numpy as np
+        from ...framework.random_seed import next_key
+        import jax
+        ku, kv = jax.random.split(next_key())
+        self.weight_u = self.create_parameter(
+            (h,), default_initializer=None)
+        self.weight_v = self.create_parameter(
+            (w,), default_initializer=None)
+        self.weight_u._data = jax.random.normal(ku, (h,)) * 0.1
+        self.weight_v._data = jax.random.normal(kv, (w,)) * 0.1
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        from ...tensor import Tensor, apply
+
+        dim, iters, eps = self._dim, self._power_iters, self._eps
+        w_raw = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        wm = jnp.moveaxis(w_raw, dim, 0).reshape(w_raw.shape[dim], -1)
+        u, v = self.weight_u._data, self.weight_v._data
+        for _ in range(iters):  # power iteration updates the u/v buffers
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        self.weight_u._data, self.weight_v._data = u, v
+
+        def f(w):
+            wf = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            sigma = u @ wf @ v
+            return w / sigma
+
+        return apply(f, x)

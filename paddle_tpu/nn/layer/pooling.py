@@ -94,3 +94,51 @@ class AdaptiveMaxPool2D(_Pool):
 class AdaptiveMaxPool3D(_Pool):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__("adaptive_max_pool3d", output_size=output_size)
+
+
+class MaxUnPool1D(Layer):
+    """Reference: nn/layer/pooling.py::MaxUnPool1D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding)
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, *self._args,
+                              data_format=self.data_format,
+                              output_size=self.output_size)
+
+
+class MaxUnPool2D(Layer):
+    """Reference: nn/layer/pooling.py::MaxUnPool2D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding)
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, *self._args,
+                              data_format=self.data_format,
+                              output_size=self.output_size)
+
+
+class MaxUnPool3D(Layer):
+    """Reference: nn/layer/pooling.py::MaxUnPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding)
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, *self._args,
+                              data_format=self.data_format,
+                              output_size=self.output_size)

@@ -89,6 +89,17 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static.program import _current_main
+        if _current_main is not None:
+            # static-graph recording: defer backward+update to each
+            # Executor.run replay (reference: optimizer ops appended to the
+            # program, run by the executor)
+            def thunk():
+                loss.backward()
+                self.step()
+                self.clear_grad()
+            _current_main._append_thunk(thunk)
+            return None, None
         loss.backward()
         self.step()
         return None, None

@@ -34,3 +34,19 @@ class InputSpec:
 
     def unbatch(self):
         return InputSpec(tuple(self.shape[1:]), self.dtype, self.name)
+
+
+from .program import (  # noqa: F401,E402
+    BuildStrategy, CompiledProgram, ExecutionStrategy, Executor,
+    ExponentialMovingAverage, IpuCompiledProgram, IpuStrategy,
+    ParallelExecutor, Print, Program, Variable, WeightNormParamAttr,
+    accuracy, append_backward, auc, cpu_places, create_global_var,
+    create_parameter, ctr_metric_bundle, cuda_places, data,
+    default_main_program, default_startup_program, deserialize_persistables,
+    deserialize_program, device_guard, exponential_decay, global_scope,
+    gradients, ipu_shard_guard, load, load_from_file, load_inference_model,
+    load_program_state, mlu_places, name_scope, normalize_program,
+    npu_places, program_guard, py_func, save, save_inference_model,
+    save_to_file, scope_guard, serialize_persistables, serialize_program,
+    set_ipu_shard, set_program_state, xpu_places,
+)

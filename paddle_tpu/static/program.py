@@ -1,0 +1,651 @@
+"""Static graph: Program / Executor / program_guard and friends.
+
+Reference: python/paddle/static + fluid framework (Program, Executor,
+program_guard, data, append_backward, scopes, places). TPU-native design —
+"define-by-run recording, replay-to-execute": under ``program_guard`` every
+primitive flowing through :func:`paddle_tpu.tensor.apply` is appended to
+the active Program's op list with its input/output Tensor objects.
+``Executor.run`` writes feed values into the placeholder Tensors, replays
+the ops in order (rebuilding the eager tape so recorded
+``minimize``/``append_backward`` thunks can run backward+update), and
+fetches results. The XLA performance path for static graphs remains
+``paddle_tpu.jit.to_static`` — this module provides the full fluid-era
+API surface on the same primitives.
+"""
+from __future__ import annotations
+
+import contextlib
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..tensor import Tensor, set_op_recorder
+
+Variable = Tensor  # reference: fluid.framework.Variable
+
+
+class Program:
+    """Reference: fluid/framework.py::Program."""
+
+    def __init__(self):
+        self._ops = []          # ("op", fn, args, kwargs, outs) | ("thunk", f)
+        self._feed_vars = {}    # name -> placeholder Tensor
+        self._vars = {}         # name -> Tensor (parameters/globals/fetch)
+        self.random_seed = None
+
+    # -- recording ---------------------------------------------------------
+    def _recorder(self, fn, args, kwargs, outs):
+        outs_t = outs if isinstance(outs, tuple) else (outs,)
+        self._ops.append(("op", fn, args, kwargs, outs_t))
+
+    def _append_thunk(self, thunk):
+        self._ops.append(("thunk", thunk))
+
+    # -- introspection -----------------------------------------------------
+    def list_vars(self):
+        return list(self._vars.values())
+
+    def all_parameters(self):
+        from ..tensor import Parameter
+        return [v for v in self._vars.values() if isinstance(v, Parameter)]
+
+    def global_block(self):
+        return self
+
+    @property
+    def blocks(self):
+        return [self]
+
+    def var(self, name):
+        if name in self._vars:
+            return self._vars[name]
+        if name in self._feed_vars:
+            return self._feed_vars[name]
+        raise KeyError(name)
+
+    def clone(self, for_test=False):
+        return self  # replay is stateless modulo parameters
+
+    # -- execution ---------------------------------------------------------
+    def _replay(self):
+        from ..tensor import apply
+        for entry in self._ops:
+            if entry[0] == "thunk":
+                entry[1]()
+                continue
+            _, fn, args, kwargs, outs = entry
+            res = apply(fn, *args, **kwargs)
+            new = res if isinstance(res, tuple) else (res,)
+            for old, fresh in zip(outs, new):
+                old._data = fresh._data
+                old._node = fresh._node
+                old._out_index = fresh._out_index
+                old.stop_gradient = fresh.stop_gradient
+
+
+_default_main = Program()
+_default_startup = Program()
+_current_main = None
+_current_startup = None
+
+
+def default_main_program():
+    return _current_main if _current_main is not None else _default_main
+
+
+def default_startup_program():
+    return _current_startup if _current_startup is not None \
+        else _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    """Reference: fluid/framework.py::program_guard."""
+    global _current_main, _current_startup
+    prev_m, prev_s = _current_main, _current_startup
+    _current_main = main_program
+    _current_startup = startup_program
+    prev_rec = set_op_recorder(main_program._recorder)
+    try:
+        yield
+    finally:
+        set_op_recorder(prev_rec)
+        _current_main, _current_startup = prev_m, prev_s
+
+
+@contextlib.contextmanager
+def _no_record():
+    prev = set_op_recorder(None)
+    try:
+        yield
+    finally:
+        set_op_recorder(prev)
+
+
+def data(name, shape, dtype='float32', lod_level=0):
+    """Feed placeholder (reference: static/input.py::data). Dims given as
+    None/-1 materialize as 1 during recording; Executor.run replays with
+    the fed shapes."""
+    prog = default_main_program()
+    concrete = tuple(1 if (s is None or s < 0) else int(s) for s in shape)
+    with _no_record():
+        t = Tensor(jnp.zeros(concrete,
+                             dtype=dtype_mod.convert_dtype(dtype)),
+                   stop_gradient=True, name=name)
+    prog._feed_vars[name] = t
+    prog._vars[name] = t
+    return t
+
+
+class Executor:
+    """Reference: fluid/executor.py::Executor — replays the recorded
+    program with fed placeholder values."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        prog = program if program is not None else default_main_program()
+        if isinstance(prog, CompiledProgram):
+            prog = prog._program
+        with _no_record():
+            for name, val in (feed or {}).items():
+                ph = prog._feed_vars.get(name)
+                if ph is None:
+                    raise KeyError(f"no feed placeholder named {name!r}")
+                ph._data = jnp.asarray(
+                    val._data if isinstance(val, Tensor) else val)
+                ph._node = None
+            prog._replay()
+        outs = []
+        for f in (fetch_list or []):
+            t = prog.var(f) if isinstance(f, str) else f
+            outs.append(np.asarray(t._data) if return_numpy else t)
+        return outs
+
+    def close(self):
+        return None
+
+
+# -- gradients ------------------------------------------------------------
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Record a backward pass over the replayed tape; returns
+    (param, grad_holder) pairs whose grads refresh every run.
+    Reference: fluid/backward.py::append_backward."""
+    prog = default_main_program()
+    params = parameter_list if parameter_list is not None \
+        else prog.all_parameters()
+    grad_holders = [(p, Tensor(jnp.zeros_like(p._data))) for p in params]
+
+    def thunk():
+        for p, _ in grad_holders:  # fresh grads each run, no accumulation
+            p.grad = None
+        loss.backward()
+        for p, g in grad_holders:
+            if p.grad is not None:
+                g._data = p.grad._data
+    prog._append_thunk(thunk)
+    return grad_holders
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Record d(targets)/d(inputs); returns grad holder Tensors.
+    Reference: fluid/backward.py::gradients."""
+    prog = default_main_program()
+    tgts = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    holders = [Tensor(jnp.zeros_like(i._data)) for i in ins]
+
+    def thunk():
+        for i in ins:
+            i.stop_gradient = False
+            i.grad = None  # fresh grads each run, no accumulation
+        total = tgts[0].sum()
+        for t in tgts[1:]:
+            total = total + t.sum()
+        total.backward()
+        for i, h in zip(ins, holders):
+            if i.grad is not None:
+                h._data = i.grad._data
+    prog._append_thunk(thunk)
+    return holders
+
+
+# -- vars / params ---------------------------------------------------------
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    prog = default_main_program()
+    with _no_record():
+        t = Tensor(jnp.full(tuple(shape), value,
+                            dtype=dtype_mod.convert_dtype(dtype)),
+                   name=name)
+    t.persistable = persistable
+    key = name or f"gvar_{len(prog._vars)}"
+    prog._vars[key] = t
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..tensor_ops.extras import create_parameter as _cp
+    prog = default_main_program()
+    with _no_record():
+        p = _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+                default_initializer=default_initializer)
+    key = name or f"param_{len(prog._vars)}"
+    prog._vars[key] = p
+    return p
+
+
+# -- state dict save/load --------------------------------------------------
+
+def save(program, model_prefix, protocol=4):
+    """Persist program parameters (reference: static/io.py::save)."""
+    state = {k: np.asarray(v._data) for k, v in program._vars.items()}
+    with open(model_prefix + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_prefix, executor=None, var_list=None):
+    with open(model_prefix + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    with _no_record():
+        for k, v in state.items():
+            if k in program._vars:
+                program._vars[k]._data = jnp.asarray(v)
+
+
+def load_program_state(model_prefix, var_list=None):
+    with open(model_prefix + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    with _no_record():
+        for k, v in state_dict.items():
+            if k in program._vars:
+                program._vars[k]._data = jnp.asarray(v)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+# -- inference model artifacts --------------------------------------------
+
+def normalize_program(program, feeds, fetches):
+    program._normalized = ([f.name for f in feeds], fetches)
+    return program
+
+
+def serialize_program(feeds, fetches, program=None, **kwargs):
+    """Serialize the traced graph as StableHLO bytes via jax.export
+    (reference serializes the ProgramDesc proto)."""
+    import jax
+    from jax import export as jax_export
+    prog = program if program is not None else default_main_program()
+    if not prog._ops:
+        raise ValueError(
+            "program has no recorded ops — pass program= explicitly or "
+            "call inside the program_guard that built the graph")
+
+    def fwd(*vals):
+        with _no_record():
+            for ph, v in zip(feeds, vals):
+                ph._data = v
+                ph._node = None
+            prog._replay()
+            fs = fetches if isinstance(fetches, (list, tuple)) else [fetches]
+            return tuple(f._data for f in fs)
+
+    specs = [jax.ShapeDtypeStruct(tuple(f.shape), f.dtype) for f in feeds]
+    exported = jax_export.export(jax.jit(fwd))(*specs)
+    return exported.serialize()
+
+
+def serialize_persistables(feeds, fetches, executor=None, program=None,
+                           **kwargs):
+    prog = program if program is not None else default_main_program()
+    state = {k: np.asarray(v._data) for k, v in prog._vars.items()}
+    return pickle.dumps(state)
+
+
+def deserialize_program(data):
+    from jax import export as jax_export
+    return jax_export.deserialize(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    state = pickle.loads(data)
+    with _no_record():
+        for k, v in state.items():
+            if k in program._vars:
+                program._vars[k]._data = jnp.asarray(v)
+    return state
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Reference: static/io.py::save_inference_model — one artifact holding
+    the StableHLO graph + feed/fetch metadata. Pass ``program=`` when
+    calling outside the program_guard that built the graph."""
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    payload = {
+        "stablehlo": serialize_program(feeds, fetch_vars, program=program),
+        "feed_names": [f.name for f in feeds],
+        "n_fetch": len(fetch_vars) if isinstance(fetch_vars, (list, tuple))
+                   else 1,
+    }
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (program_callable, feed_names, fetch_count) — the callable
+    runs the deserialized StableHLO graph."""
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        payload = pickle.load(f)
+    exported = deserialize_program(payload["stablehlo"])
+    return exported.call, payload["feed_names"], payload["n_fetch"]
+
+
+# -- scopes / guards / places ---------------------------------------------
+
+class _Scope:
+    def find_var(self, name):
+        prog = default_main_program()
+        try:
+            v = prog.var(name)
+        except KeyError:
+            return None
+
+        class _Var:
+            def get_tensor(self):
+                return np.asarray(v._data)
+        return _Var()
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    from ..utils import unique_name
+    with unique_name.guard(prefix or ""):
+        yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    yield
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func
+
+
+def cpu_places(device_count=None):
+    from ..framework.device import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    import jax
+    from ..framework.device import TPUPlace
+    ids = device_ids if device_ids is not None \
+        else range(len(jax.devices()))
+    return [TPUPlace(i) for i in ids]
+
+
+xpu_places = cuda_places
+npu_places = cuda_places
+mlu_places = cuda_places
+
+
+# -- misc ops --------------------------------------------------------------
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase='both'):
+    """Record a host print of the tensor at each run. Reference:
+    fluid/layers/control_flow.py::Print."""
+    prog = default_main_program()
+    state = {"n": 0}
+
+    def thunk():
+        if first_n < 0 or state["n"] < first_n:
+            state["n"] += 1
+            vals = np.asarray(input._data).ravel()[:summarize]
+            print(f"{message or ''} "
+                  f"{input.name or 'var'} shape={list(input.shape)} "
+                  f"values={vals}")
+    prog._append_thunk(thunk)
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Record an arbitrary python op. Reference:
+    fluid/layers/nn.py::py_func."""
+    prog = default_main_program()
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+
+    def thunk():
+        res = func(*xs)
+        res = res if isinstance(res, (list, tuple)) else [res]
+        for o, r in zip(outs, res):
+            o._data = r._data if isinstance(r, Tensor) else jnp.asarray(r)
+    prog._append_thunk(thunk)
+    return out
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Top-k accuracy op. Reference: static/nn/metric.py::accuracy."""
+    from ..tensor import apply
+
+    def f(pred, y):
+        topk = jnp.argsort(pred, axis=-1)[..., -k:]
+        yv = y.reshape(-1, 1)
+        hit = jnp.any(topk == yv, axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+    return apply(f, input, label)
+
+
+def auc(input, label, curve='ROC', num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Streaming-free AUC op (single-batch ROC). Reference:
+    static/nn/metric.py::auc."""
+    from ..tensor import nondiff
+
+    def f(pred, y):
+        pos_score = pred[:, 1] if pred.ndim == 2 else pred
+        order = jnp.argsort(-pos_score)
+        ys = y.reshape(-1)[order]
+        n_pos = jnp.sum(ys)
+        n_neg = ys.shape[0] - n_pos
+        ranks = jnp.arange(1, ys.shape[0] + 1)
+        # Mann-Whitney U from positive ranks (descending order)
+        pos_rank_sum = jnp.sum(jnp.where(ys > 0, ranks, 0))
+        u = n_pos * n_neg + n_pos * (n_pos + 1) / 2 - pos_rank_sum
+        return jnp.where(n_pos * n_neg > 0,
+                         u / jnp.maximum(n_pos * n_neg, 1), 0.5)
+    a = nondiff(f, input, label)
+    return a, a, [a]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metrics (auc + mae-style stats). Reference:
+    static/nn/metric.py::ctr_metric_bundle."""
+    from ..tensor import nondiff
+    a, _, _ = auc(input, label)
+
+    def f(pred, y):
+        p = pred.reshape(-1)
+        return jnp.mean(jnp.abs(p - y.reshape(-1)))
+    mae = nondiff(f, input, label)
+    return a, mae
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    from ..optimizer.lr import ExponentialDecay
+    return ExponentialDecay(learning_rate, decay_rate)
+
+
+# -- strategy / compiled-program stubs ------------------------------------
+
+class BuildStrategy:
+    """Reference: BuildStrategy — fusion/memory flags. XLA owns all of
+    these decisions on TPU; values are recorded for API compat."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.fuse_all_optimizer_ops = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.build_cuda_graph = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class IpuStrategy:
+    def __init__(self):
+        self._config = {}
+
+    def set_graph_config(self, **kw):
+        self._config.update(kw)
+
+    def set_pipelining_config(self, **kw):
+        self._config.update(kw)
+
+    def set_precision_config(self, **kw):
+        self._config.update(kw)
+
+
+class CompiledProgram:
+    """Reference: fluid/compiler.py::CompiledProgram. Replay already runs
+    through XLA eagerly; with_data_parallel is the fleet mesh's job."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self
+
+
+class IpuCompiledProgram(CompiledProgram):
+    def __init__(self, program=None, ipu_strategy=None, scope=None):
+        super().__init__(program)
+        self._ipu_strategy = ipu_strategy
+
+    def compile(self, feed_list, fetch_list):
+        return self._program
+
+
+class ParallelExecutor:
+    """Reference: fluid/parallel_executor.py — superseded by the fleet
+    mesh path; kept as a thin Executor alias."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 **kwargs):
+        self._program = main_program
+        self._exe = Executor()
+
+    def run(self, fetch_list=None, feed=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+class WeightNormParamAttr:
+    """Reference: fluid/param_attr.py::WeightNormParamAttr."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters with apply/restore context. Reference:
+    fluid/optimizer.py::ExponentialMovingAverage."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema = {}
+        self._params = None
+        self._backup = None
+        self._step = 0
+
+    def update(self, parameters=None):
+        if parameters is not None:
+            self._params = list(parameters)
+        if self._params is None:
+            raise ValueError("ExponentialMovingAverage.update needs "
+                             "parameters on first call")
+        self._step += 1
+        # bias-corrected decay as in the reference (min with (1+t)/(10+t))
+        d = min(self._decay, (1.0 + self._step) / (10.0 + self._step))
+        for p in self._params:
+            prev = self._ema.get(id(p))
+            self._ema[id(p)] = p._data if prev is None \
+                else d * prev + (1.0 - d) * p._data
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._backup = [(p, p._data) for p in (self._params or [])]
+        for p in (self._params or []):
+            if id(p) in self._ema:
+                p._data = self._ema[id(p)]
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p, v in self._backup:
+                p._data = v
+        self._backup = None

@@ -237,6 +237,19 @@ jax.tree_util.register_pytree_node(
 )
 
 
+# Static-graph recorder (paddle.static emulation): when set, every op that
+# flows through apply()/nondiff() is appended to the active Program so
+# Executor.run can replay it with fed placeholder values.
+_op_recorder = None
+
+
+def set_op_recorder(recorder):
+    global _op_recorder
+    prev = _op_recorder
+    _op_recorder = recorder
+    return prev
+
+
 def _unwrap(x):
     return x._data if isinstance(x, Tensor) else x
 
@@ -262,8 +275,12 @@ def apply(fn: Callable, *args, n_outputs: Any = 1, **kwargs):
     if not diff_idx:
         out = fn(*raw, **kwargs)
         if isinstance(out, (tuple, list)):
-            return tuple(_wrap_out(o, True) for o in out)
-        return _wrap_out(out, True)
+            res = tuple(_wrap_out(o, True) for o in out)
+        else:
+            res = _wrap_out(out, True)
+        if _op_recorder is not None:
+            _op_recorder(fn, args, kwargs, res)
+        return res
 
     parents = [args[i] for i in diff_idx]
 
@@ -286,7 +303,10 @@ def apply(fn: Callable, *args, n_outputs: Any = 1, **kwargs):
 
     wrapped = tuple(_wrap_out(o, False) for o in outs)
     tape.record(vjp_fn, parents, wrapped)
-    return wrapped if multi else wrapped[0]
+    res = wrapped if multi else wrapped[0]
+    if _op_recorder is not None:
+        _op_recorder(fn, args, kwargs, res)
+    return res
 
 
 def nondiff(fn: Callable, *args, **kwargs):
@@ -294,8 +314,12 @@ def nondiff(fn: Callable, *args, **kwargs):
     raw = [_unwrap(a) for a in args]
     out = fn(*raw, **kwargs)
     if isinstance(out, (tuple, list)):
-        return tuple(_wrap_out(o, True) for o in out)
-    return _wrap_out(out, True)
+        res = tuple(_wrap_out(o, True) for o in out)
+    else:
+        res = _wrap_out(out, True)
+    if _op_recorder is not None:
+        _op_recorder(fn, args, kwargs, res)
+    return res
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):

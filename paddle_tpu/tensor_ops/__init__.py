@@ -7,6 +7,7 @@ from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
 
 # names that collide between modules: stat.mean/std/var win over math's

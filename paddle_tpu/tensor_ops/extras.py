@@ -1,0 +1,165 @@
+"""Remaining top-level tensor ops.
+
+Reference surface: the tail of python/paddle/__init__.py's __all__ —
+add_n, mv, sgn, logcumsumexp, reverse, inplace variants (reshape_,
+squeeze_, unsqueeze_, scatter_, tanh_), shape/rank/tolist helpers.
+Inplace variants rebind the Tensor's buffer to the op result (XLA arrays
+are immutable; donation inside jit gives the true in-place behavior).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, apply, nondiff
+
+__all__ = [
+    'add_n', 'mv', 'sgn', 'logcumsumexp', 'reverse', 'shape', 'rank',
+    'tolist', 'reshape_', 'squeeze_', 'unsqueeze_', 'scatter_', 'tanh_',
+    'create_parameter', 'set_printoptions',
+]
+
+
+def add_n(inputs, name=None):
+    """Element-wise sum of a list of tensors. Reference:
+    python/paddle/tensor/math.py::add_n."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    ts = [x if isinstance(x, Tensor) else Tensor(x) for x in inputs]
+    return apply(lambda *xs: sum(xs[1:], xs[0]), *ts)
+
+
+def mv(x, vec, name=None):
+    """Matrix @ vector. Reference: tensor/linalg.py::mv."""
+    return apply(jnp.matmul, x, vec)
+
+
+def sgn(x, name=None):
+    """sign for real, x/|x| for complex. Reference: tensor/math.py::sgn."""
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0.0 + 0.0j, a / mag)
+        return jnp.sign(a)
+    return apply(f, x)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """log(cumsum(exp(x))) computed stably. Reference:
+    tensor/math.py::logcumsumexp."""
+    def f(a):
+        if dtype is not None:
+            from ..framework.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        v = a.ravel() if axis is None else a
+        ax = 0 if axis is None else axis
+        import jax
+        # exact + stable: logaddexp is associative, so XLA scans it in
+        # O(log n) depth on device
+        return jax.lax.associative_scan(jnp.logaddexp, v, axis=ax)
+    return apply(f, x)
+
+
+def reverse(x, axis, name=None):
+    """Reference: fluid reverse == flip."""
+    from .manipulation import flip
+    return flip(x, axis)
+
+
+def shape(x, name=None):
+    """The runtime shape as an int32 Tensor (reference: paddle.shape)."""
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    return Tensor(jnp.asarray(xt._data.shape, dtype=jnp.int32))
+
+
+def rank(x, name=None):
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    return Tensor(jnp.asarray(xt._data.ndim, dtype=jnp.int32))
+
+
+def tolist(x):
+    import jax
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    return np.asarray(jax.device_get(xt._data)).tolist()
+
+
+def _detached_clone(x):
+    """A shallow clone that keeps x's place in the autograd graph, so the
+    inplace-rebound original can't become its own ancestor."""
+    c = Tensor(x._data, stop_gradient=x.stop_gradient)
+    c._node = x._node
+    c._out_index = x._out_index
+    return c
+
+
+def _inplace_rebind(x, op):
+    """Run ``op`` on a clone of x, then point x at the result (inplace-op
+    semantics; buffers are immutable under XLA — true reuse comes from
+    donation inside jit)."""
+    out = op(_detached_clone(x))
+    x._data = out._data
+    x._node = out._node
+    x._out_index = out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def reshape_(x, shape, name=None):
+    from .manipulation import reshape
+    return _inplace_rebind(x, lambda c: reshape(c, shape))
+
+
+def squeeze_(x, axis=None, name=None):
+    from .manipulation import squeeze
+    return _inplace_rebind(x, lambda c: squeeze(c, axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    from .manipulation import unsqueeze
+    return _inplace_rebind(x, lambda c: unsqueeze(c, axis))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    from .manipulation import scatter
+    return _inplace_rebind(x, lambda c: scatter(c, index, updates,
+                                                overwrite))
+
+
+def tanh_(x, name=None):
+    return _inplace_rebind(x, lambda c: apply(jnp.tanh, c))
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone Parameter factory (reference: paddle.create_parameter)."""
+    from ..nn.initializer import Constant, XavierUniform, _to_initializer
+    from ..framework import dtype as dtype_mod
+    from ..framework.random_seed import next_key
+    from ..tensor import Parameter
+    init = default_initializer
+    if attr is not None and getattr(attr, "initializer", None) is not None:
+        init = attr.initializer
+    if init is None:
+        init = Constant(0.0) if is_bias else XavierUniform()
+    init = _to_initializer(init)
+    dt = dtype_mod.convert_dtype(dtype)
+    data = init(tuple(shape), dt, next_key())
+    return Parameter(data, dtype=dt)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Reference: paddle.set_printoptions — numpy printing drives our
+    Tensor repr."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)

@@ -1,4 +1,9 @@
-"""paddle_tpu.text — NLP model zoo + tokenizer (reference pairing:
-python/paddle/text + PaddleNLP model families named in BASELINE.json)."""
+"""paddle_tpu.text — NLP model zoo, tokenizer, datasets, viterbi decode
+(reference pairing: python/paddle/text + PaddleNLP model families named in
+BASELINE.json)."""
 from . import models  # noqa: F401
+from .datasets import (  # noqa: F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)
 from .tokenizer import BpeTokenizer, WhitespaceTokenizer  # noqa: F401
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401

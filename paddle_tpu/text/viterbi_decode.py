@@ -1,0 +1,91 @@
+"""Viterbi decoding.
+
+Reference: python/paddle/text/viterbi_decode.py (viterbi_decode,
+ViterbiDecoder — C++ viterbi_decode op). TPU-native design: one
+``lax.scan`` over time carrying (alpha, backpointers) — static shapes,
+no data-dependent python control flow, batched over the leading dim.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer_base import Layer
+from ..tensor import Tensor, nondiff
+
+__all__ = ['viterbi_decode', 'ViterbiDecoder']
+
+
+def _viterbi(pot, trans, lengths, include_bos_eos_tag):
+    b, maxlen, n = pot.shape
+    lengths = lengths.astype(jnp.int32)
+    start = pot[:, 0]
+    if include_bos_eos_tag:
+        # last tag is BOS: transitions out of it initialize alpha
+        start = start + trans[-1][None, :]
+    alpha0 = start
+
+    def step(carry, inp):
+        alpha = carry
+        emit, t = inp
+        scores = alpha[:, :, None] + trans[None]  # [b, prev, cur]
+        best = jnp.max(scores, axis=1) + emit
+        idx = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        live = (t < lengths)[:, None]
+        alpha = jnp.where(live, best, alpha)
+        # dead steps backtrace through themselves
+        idx = jnp.where(live, idx, jnp.arange(n, dtype=jnp.int32)[None, :])
+        return alpha, idx
+
+    ts = jnp.arange(1, maxlen)
+    alpha, history = jax.lax.scan(
+        step, alpha0, (jnp.moveaxis(pot[:, 1:], 1, 0), ts))
+    if include_bos_eos_tag:
+        # second-to-last tag is EOS: transitions into it close the path
+        alpha = alpha + trans[:, -2][None, :]
+
+    scores = jnp.max(alpha, axis=-1)
+    last = jnp.argmax(alpha, axis=-1).astype(jnp.int32)
+
+    def back(tag, idx_t):
+        # idx_t[b, cur] = best previous tag; emit the tag at position t-1
+        prev = jnp.take_along_axis(idx_t, tag[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    _, prevs = jax.lax.scan(back, last, history, reverse=True)
+    # prevs[t-1] is the tag at position t-1 (t = 1..maxlen-1)
+    path = last[:, None] if maxlen == 1 else jnp.concatenate(
+        [jnp.moveaxis(prevs, 0, 1), last[:, None]], axis=1)
+    mask = jnp.arange(maxlen)[None, :] < lengths[:, None]
+    return scores, jnp.where(mask, path, 0).astype(jnp.int64)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Highest-scoring tag path. potentials [B, L, C] float, transitions
+    [C, C], lengths [B] int. Returns (scores [B], paths [B, max(len)]).
+    Reference: text/viterbi_decode.py::viterbi_decode."""
+    pot = potentials if isinstance(potentials, Tensor) \
+        else Tensor(potentials)
+    trans = transition_params if isinstance(transition_params, Tensor) \
+        else Tensor(transition_params)
+    lens = lengths if isinstance(lengths, Tensor) else Tensor(lengths)
+    maxlen = int(np.asarray(jax.device_get(lens._data)).max())
+    pot_trunc = pot._data[:, :maxlen]
+    scores, path = _viterbi(pot_trunc, trans._data, lens._data,
+                            include_bos_eos_tag)
+    return nondiff(lambda: (scores, path))
+
+
+class ViterbiDecoder(Layer):
+    """Reference: text/viterbi_decode.py::ViterbiDecoder."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,2 +1,51 @@
 from . import datasets, models, transforms  # noqa: F401
 from .ops import nms, roi_align  # noqa: F401
+
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """Reference: vision/image.py::set_image_backend."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"expected 'pil', 'cv2' or 'tensor', got {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    """Reference: vision/image.py::get_image_backend."""
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file with the configured backend. Reference:
+    vision/image.py::image_load."""
+    backend = backend or _image_backend
+    if backend in ("pil", "tensor"):
+        try:
+            from PIL import Image
+            img = Image.open(path)
+            if backend == "pil":
+                return img
+            import numpy as np
+            from ..tensor import Tensor
+            return Tensor(np.asarray(img))
+        except ImportError:
+            pass
+    if backend == "cv2":
+        try:
+            import cv2
+            return cv2.imread(path)
+        except ImportError:
+            pass
+    # fallback: numpy-decodable formats (.npy) keep pipelines testable
+    import numpy as np
+    if str(path).endswith(".npy"):
+        arr = np.load(path)
+        from ..tensor import Tensor
+        return arr if backend != "tensor" else Tensor(arr)
+    raise RuntimeError(
+        f"image_load: backend {backend!r} unavailable in this environment "
+        "and file is not .npy")

@@ -1,0 +1,309 @@
+"""Top-level extras, new functionals/layers, distribution additions, fft
+hermitian transforms, beam search, worker info."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+class TestTensorExtras:
+    def test_add_n_mv_sgn(self):
+        a = paddle.to_tensor(np.ones((2, 2), dtype=np.float32))
+        s = paddle.add_n([a, a, a])
+        np.testing.assert_allclose(np.asarray(s._data), 3 * np.ones((2, 2)))
+        m = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        v = paddle.to_tensor(np.ones(3, dtype=np.float32))
+        np.testing.assert_allclose(np.asarray(paddle.mv(m, v)._data),
+                                   [3., 12.])
+        np.testing.assert_allclose(
+            np.asarray(paddle.sgn(paddle.to_tensor([-3., 0., 5.]))._data),
+            [-1., 0., 1.])
+
+    def test_logcumsumexp_matches_numpy(self):
+        x = np.random.default_rng(0).normal(size=(10,)).astype(np.float32)
+        got = np.asarray(paddle.logcumsumexp(paddle.to_tensor(x))._data)
+        ref = np.log(np.cumsum(np.exp(x)))
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_inplace_variants_keep_grad(self):
+        x = paddle.to_tensor(np.ones((4,), dtype=np.float32),
+                             stop_gradient=False)
+        y = (x * 2.0)
+        paddle.tanh_(y)
+        y.sum().backward()
+        ref = 2.0 / np.cosh(2.0) ** 2
+        np.testing.assert_allclose(np.asarray(x.grad._data),
+                                   np.full(4, ref), atol=1e-6)
+
+    def test_shape_rank_tolist_reverse(self):
+        t = paddle.to_tensor(np.arange(6).reshape(2, 3))
+        assert np.asarray(paddle.shape(t)._data).tolist() == [2, 3]
+        assert int(paddle.rank(t)._data) == 2
+        assert paddle.tolist(t) == [[0, 1, 2], [3, 4, 5]]
+        r = paddle.reverse(paddle.to_tensor([1., 2., 3.]), axis=0)
+        np.testing.assert_allclose(np.asarray(r._data), [3., 2., 1.])
+
+
+class TestPoolingMaskUnpool:
+    def test_mask_is_argmax_flat_index(self):
+        x = np.zeros((1, 1, 4, 4), dtype=np.float32)
+        x[0, 0, 1, 2] = 9.0  # flat index 6 within its 2x2 window region
+        out, mask = F.max_pool2d(paddle.to_tensor(x), 2, 2,
+                                 return_mask=True)
+        assert np.asarray(out._data)[0, 0, 0, 1] == 9.0
+        assert np.asarray(mask._data)[0, 0, 0, 1] == 1 * 4 + 2
+
+    def test_unpool_roundtrip_2d_3d_1d(self):
+        rng = np.random.default_rng(0)
+        x2 = paddle.to_tensor(
+            rng.normal(size=(2, 3, 8, 8)).astype(np.float32))
+        o, m = F.max_pool2d(x2, 2, 2, return_mask=True)
+        r = F.max_unpool2d(o, m, 2, 2)
+        assert list(r.shape) == [2, 3, 8, 8]
+        np.testing.assert_allclose(np.asarray(r._data).sum(),
+                                   np.asarray(o._data).sum(), rtol=1e-5)
+        x1 = paddle.to_tensor(rng.normal(size=(2, 3, 8)).astype(np.float32))
+        o1, m1 = F.max_pool1d(x1, 2, 2, return_mask=True)
+        assert list(F.max_unpool1d(o1, m1, 2, 2).shape) == [2, 3, 8]
+        x3 = paddle.to_tensor(
+            rng.normal(size=(1, 2, 4, 4, 4)).astype(np.float32))
+        o3, m3 = F.max_pool3d(x3, 2, 2, return_mask=True)
+        assert list(F.max_unpool3d(o3, m3, 2, 2).shape) == [1, 2, 4, 4, 4]
+
+    def test_unpool_layer(self):
+        x = paddle.to_tensor(np.random.default_rng(1)
+                             .normal(size=(1, 2, 6, 6)).astype(np.float32))
+        o, m = F.max_pool2d(x, 2, 2, return_mask=True)
+        layer = nn.MaxUnPool2D(2, 2)
+        assert list(layer(o, m).shape) == [1, 2, 6, 6]
+
+
+class TestNewLosses:
+    def test_dice_loss_perfect_prediction_near_zero(self):
+        label = paddle.to_tensor(np.array([[0], [1], [2]]))
+        probs = paddle.to_tensor(np.eye(3, dtype=np.float32))
+        loss = F.dice_loss(probs, label)
+        assert float(loss._data) < 1e-4
+
+    def test_soft_margin_matches_formula(self):
+        x = np.array([[0.5, -1.0]], dtype=np.float32)
+        y = np.array([[1.0, -1.0]], dtype=np.float32)
+        got = float(F.soft_margin_loss(paddle.to_tensor(x),
+                                       paddle.to_tensor(y))._data)
+        ref = np.mean(np.log1p(np.exp(-y * x)))
+        assert abs(got - ref) < 1e-6
+
+    def test_hsigmoid_loss_decreases_with_training(self):
+        paddle.seed(0)
+        from paddle_tpu import optimizer as optim
+        layer = nn.HSigmoidLoss(8, 6)
+        feats = paddle.to_tensor(np.random.default_rng(0)
+                                 .normal(size=(32, 8)).astype(np.float32))
+        labels = paddle.to_tensor(
+            np.random.default_rng(1).integers(0, 6, (32,)))
+        opt = optim.Adam(learning_rate=5e-2,
+                         parameters=layer.parameters())
+        first = None
+        for _ in range(30):
+            loss = layer(feats, labels).mean()
+            if first is None:
+                first = float(loss._data)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss._data) < first * 0.7
+
+    def test_margin_cross_entropy_zero_margin_is_softmax_ce(self):
+        rng = np.random.default_rng(2)
+        cos = rng.uniform(-1, 1, (4, 6)).astype(np.float32)
+        y = rng.integers(0, 6, (4,))
+        got = float(F.margin_cross_entropy(
+            paddle.to_tensor(cos), paddle.to_tensor(y), margin1=1.0,
+            margin2=0.0, margin3=0.0, scale=1.0)._data)
+        z = cos - cos.max(-1, keepdims=True)
+        logp = z - np.log(np.exp(z).sum(-1, keepdims=True))
+        ref = -logp[np.arange(4), y].mean()
+        assert abs(got - ref) < 1e-5
+
+    def test_sigmoid_focal_loss_gamma0_is_weighted_bce(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(5,)).astype(np.float32)
+        y = (rng.random(5) > 0.5).astype(np.float32)
+        got = float(F.sigmoid_focal_loss(
+            paddle.to_tensor(x), paddle.to_tensor(y), alpha=0.5,
+            gamma=0.0, reduction='sum')._data)
+        ce = np.maximum(x, 0) - x * y + np.log1p(np.exp(-np.abs(x)))
+        assert abs(got - 0.5 * ce.sum()) < 1e-5
+
+
+class TestExtension:
+    def test_sequence_mask_diag_embed(self):
+        m = F.sequence_mask(paddle.to_tensor(np.array([2, 0, 3])), maxlen=3)
+        np.testing.assert_array_equal(
+            np.asarray(m._data), [[1, 1, 0], [0, 0, 0], [1, 1, 1]])
+        d = F.diag_embed(paddle.to_tensor(np.array([1., 2.],
+                                                   dtype=np.float32)))
+        np.testing.assert_allclose(np.asarray(d._data),
+                                   [[1., 0.], [0., 2.]])
+        off = F.diag_embed(paddle.to_tensor(
+            np.array([1.], dtype=np.float32)), offset=1)
+        assert np.asarray(off._data)[0, 1] == 1.0
+
+    def test_temporal_shift_moves_channels(self):
+        x = np.zeros((4, 4, 1, 1), dtype=np.float32)  # N*T=4 (T=2), C=4
+        x[0, 0] = 1.0  # clip 0, time 0, channel 0
+        out = np.asarray(F.temporal_shift(
+            paddle.to_tensor(x), seg_num=2, shift_ratio=0.25)._data)
+        # channel 0 shifts backward: value from t=1 lands at t=0 → zeroed
+        assert out[0, 0] == 0.0
+
+    def test_class_center_sample(self):
+        y = paddle.to_tensor(np.array([3, 7, 3]))
+        remapped, sampled = F.class_center_sample(y, 20, 5)
+        s = np.asarray(sampled._data)
+        r = np.asarray(remapped._data)
+        assert len(s) == 5 and 3 in s and 7 in s
+        assert (s[r] == np.array([3, 7, 3])).all()
+
+
+class TestDistributionExtras:
+    def test_multinomial_mean_logprob(self):
+        p = np.array([0.2, 0.3, 0.5], dtype=np.float32)
+        d = paddle.distribution.Multinomial(10, paddle.to_tensor(p))
+        np.testing.assert_allclose(np.asarray(d.mean._data), 10 * p,
+                                   rtol=1e-6)
+        counts = paddle.to_tensor(np.array([2., 3., 5.], dtype=np.float32))
+        from scipy import stats  # scipy is available via jax dependency
+        ref = stats.multinomial.logpmf([2, 3, 5], 10, p)
+        assert abs(float(d.log_prob(counts)._data) - ref) < 1e-4
+        s = d.sample((7,))
+        assert np.asarray(s._data).sum(-1).tolist() == [10.0] * 7
+
+    def test_independent_sums_event_dims(self):
+        base = paddle.distribution.Normal(
+            paddle.to_tensor(np.zeros((2, 3), dtype=np.float32)),
+            paddle.to_tensor(np.ones((2, 3), dtype=np.float32)))
+        ind = paddle.distribution.Independent(base, 1)
+        v = paddle.to_tensor(np.zeros((2, 3), dtype=np.float32))
+        lp = ind.log_prob(v)
+        assert list(lp.shape) == [2]
+        np.testing.assert_allclose(np.asarray(lp._data),
+                                   3 * -0.5 * np.log(2 * np.pi), rtol=1e-5)
+
+    def test_transformed_distribution_lognormal(self):
+        base = paddle.distribution.Normal(0.0, 1.0)
+        d = paddle.distribution.TransformedDistribution(
+            base, [paddle.distribution.ExpTransform()])
+        v = paddle.to_tensor(np.array(2.0, dtype=np.float32))
+        got = float(d.log_prob(v)._data)
+        from scipy import stats
+        assert abs(got - stats.lognorm.logpdf(2.0, 1.0)) < 1e-5
+
+    def test_register_kl(self):
+        from paddle_tpu.distribution import (Bernoulli, kl_divergence,
+                                             register_kl)
+
+        @register_kl(Bernoulli, Bernoulli)
+        def _kl_bb(p, q):
+            return paddle.to_tensor(np.float32(0.125))
+
+        out = kl_divergence(Bernoulli(0.3), Bernoulli(0.7))
+        assert float(out._data) == 0.125
+
+
+class TestFFTHermitian:
+    def test_hfft2_roundtrip(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 5)).astype(np.float32)
+        spec = paddle.fft.ihfft2(paddle.to_tensor(x))
+        back = paddle.fft.hfft2(spec, s=x.shape)
+        np.testing.assert_allclose(np.asarray(back._data), x, atol=1e-4)
+
+    def test_hfftn_matches_numpy_last_axis(self):
+        rng = np.random.default_rng(1)
+        x = (rng.normal(size=(6,)) + 1j * rng.normal(size=(6,)))
+        x[0] = x[0].real  # hermitian-compatible DC
+        got = np.asarray(paddle.fft.hfftn(
+            paddle.to_tensor(x.astype(np.complex64)), axes=(0,))._data)
+        ref = np.fft.hfft(x)
+        np.testing.assert_allclose(got, ref, atol=1e-3)
+
+
+class TestBeamSearch:
+    def test_beam_search_finds_best_path(self):
+        """A cell emitting FIXED per-step logits: beam search must find the
+        argmax-sum token sequence that brute force finds."""
+        vocab, steps, beam = 5, 3, 4
+
+        rng = np.random.default_rng(0)
+        step_logits = rng.normal(size=(steps, vocab)).astype(np.float32)
+        step_logits[:, 1] -= 100.0  # token 1 = end token, keep alive
+
+        class FixedCell(nn.Layer):
+            def forward(self, inputs, states):
+                t = int(np.asarray(states._data).flat[0])
+                batch = inputs.shape[0]
+                logits = np.tile(step_logits[min(t, steps - 1)],
+                                 (batch, 1))
+                return (paddle.to_tensor(logits),
+                        paddle.to_tensor(
+                            np.asarray(states._data) + 1))
+
+        dec = nn.BeamSearchDecoder(FixedCell(), start_token=0, end_token=1,
+                                   beam_size=beam)
+        init_state = paddle.to_tensor(np.zeros((2, 1), dtype=np.float32))
+        out, _ = nn.dynamic_decode(dec, inits=init_state,
+                                   max_step_num=steps)
+        preds = np.asarray(out.predicted_ids._data)  # [B, T, beam]
+        # brute force best token per step (greedy == optimal: per-step
+        # independent logits)
+        best = step_logits.argmax(-1)
+        np.testing.assert_array_equal(preds[0, :, 0], best)
+        np.testing.assert_array_equal(preds[1, :, 0], best)
+        # beams are score-sorted: beam 0 total >= beam 1 total
+        scores = np.asarray(out.scores._data)
+        assert scores[0, -1, 0] >= scores[0, -1, 1]
+
+    def test_beam_search_stops_at_end_token(self):
+        vocab = 4
+
+        class EndCell(nn.Layer):
+            def forward(self, inputs, states):
+                batch = inputs.shape[0]
+                logits = np.full((batch, vocab), -5.0, dtype=np.float32)
+                logits[:, 2] = 5.0  # always pick end token 2
+                return paddle.to_tensor(logits), states
+
+        dec = nn.BeamSearchDecoder(EndCell(), start_token=0, end_token=2,
+                                   beam_size=2)
+        init = paddle.to_tensor(np.zeros((1, 1), dtype=np.float32))
+        out, states, lengths = nn.dynamic_decode(
+            dec, inits=init, max_step_num=50, return_length=True)
+        # beam 0 finishes at step 1; beam 1 (forked survivor) by step 2 —
+        # far before max_step_num
+        assert np.asarray(out.predicted_ids._data).shape[1] <= 2
+        assert np.asarray(lengths._data).max() <= 2
+        assert np.asarray(out.predicted_ids._data)[0, 0, 0] == 2
+
+
+class TestWorkerInfo:
+    def test_get_worker_info_inside_worker(self):
+        seen = []
+
+        class Probe(paddle.io.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                info = paddle.io.get_worker_info()
+                seen.append(None if info is None else info.num_workers)
+                return np.float32(i)
+
+        dl = paddle.io.DataLoader(Probe(), batch_size=4, num_workers=2)
+        list(dl)
+        assert any(s == 2 for s in seen)
+        assert paddle.io.get_worker_info() is None

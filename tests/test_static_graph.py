@@ -1,0 +1,212 @@
+"""paddle.static Program/Executor emulation + incubate graph ops."""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+from paddle_tpu import optimizer as optim
+
+
+class TestStaticProgram:
+    def test_feed_fetch_forward(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [None, 4], 'float32')
+            w = static.create_parameter([4, 2], 'float32')
+            y = x.matmul(w)
+        exe = static.Executor()
+        feed_x = np.ones((3, 4), dtype=np.float32)
+        out, = exe.run(main, feed={'x': feed_x}, fetch_list=[y])
+        ref = feed_x @ np.asarray(w._data)
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+        # replay with a DIFFERENT batch size — recording is shape-agnostic
+        feed_x2 = np.random.default_rng(0).normal(size=(7, 4)) \
+            .astype(np.float32)
+        out2, = exe.run(main, feed={'x': feed_x2}, fetch_list=[y])
+        np.testing.assert_allclose(out2, feed_x2 @ np.asarray(w._data),
+                                   atol=1e-5)
+
+    def test_static_training_loop_converges(self):
+        paddle.seed(0)
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data('x', [None, 4], 'float32')
+            yt = static.data('y', [None, 1], 'float32')
+            layer = nn.Linear(4, 1)
+            pred = layer(x)
+            loss = ((pred - yt) ** 2).mean()
+            sgd = optim.SGD(learning_rate=0.1,
+                            parameters=layer.parameters())
+            sgd.minimize(loss)
+        exe = static.Executor()
+        rng = np.random.default_rng(1)
+        xs = rng.normal(size=(64, 4)).astype(np.float32)
+        w_true = np.array([[1.], [2.], [-1.], [0.5]], dtype=np.float32)
+        ys = xs @ w_true
+        first = None
+        for _ in range(40):
+            lv, = exe.run(main, feed={'x': xs, 'y': ys},
+                          fetch_list=[loss])
+            if first is None:
+                first = float(lv)
+        assert float(lv) < first * 0.05, (first, float(lv))
+
+    def test_append_backward_grads(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [None, 3], 'float32')
+            w = static.create_parameter([3, 1], 'float32')
+            w.stop_gradient = False
+            loss = x.matmul(w).sum()
+            grads = static.append_backward(loss, parameter_list=[w])
+        exe = static.Executor()
+        feed = np.ones((5, 3), dtype=np.float32)
+        _, g = exe.run(main, feed={'x': feed},
+                       fetch_list=[loss, grads[0][1]])
+        np.testing.assert_allclose(g, 5 * np.ones((3, 1)), atol=1e-6)
+
+    def test_program_var_registry_and_print(self, capsys):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [2, 2], 'float32')
+            static.Print(x, message='dbg')
+            y = x + 1.0
+        exe = static.Executor()
+        out, = exe.run(main, feed={'x': np.zeros((2, 2), np.float32)},
+                       fetch_list=[y])
+        assert 'dbg' in capsys.readouterr().out
+        np.testing.assert_allclose(out, np.ones((2, 2)))
+        assert main.var('x') is x
+
+    def test_py_func(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [3], 'float32')
+            out = paddle.to_tensor(np.zeros(3, dtype=np.float32))
+            static.py_func(lambda t: paddle.to_tensor(
+                np.asarray(t._data) * 3), x, out)
+        exe = static.Executor()
+        res, = exe.run(main, feed={'x': np.ones(3, np.float32)},
+                       fetch_list=[out])
+        np.testing.assert_allclose(res, 3 * np.ones(3))
+
+    def test_accuracy_auc_ops(self):
+        pred = paddle.to_tensor(np.array(
+            [[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], dtype=np.float32))
+        label = paddle.to_tensor(np.array([[1], [0], [0]]))
+        acc = static.accuracy(pred, label)
+        np.testing.assert_allclose(float(acc._data), 2 / 3, atol=1e-6)
+        a, _, _ = static.auc(pred, paddle.to_tensor(
+            np.array([1, 0, 1], dtype=np.float32)))
+        assert 0.0 <= float(a._data) <= 1.0
+
+    def test_save_load_roundtrip(self):
+        main = static.Program()
+        with static.program_guard(main):
+            w = static.create_parameter([2, 2], 'float32', name='w')
+        orig = np.asarray(w._data).copy()
+        with tempfile.TemporaryDirectory() as td:
+            prefix = os.path.join(td, 'model')
+            static.save(main, prefix)
+            w._data = w._data * 0
+            static.load(main, prefix)
+            np.testing.assert_allclose(np.asarray(w._data), orig)
+
+    def test_save_load_inference_model(self):
+        paddle.seed(0)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [2, 4], 'float32')
+            layer = nn.Linear(4, 3)
+            out = layer(x)
+        feed = np.random.default_rng(0).normal(size=(2, 4)) \
+            .astype(np.float32)
+        exe = static.Executor()
+        ref, = exe.run(main, feed={'x': feed}, fetch_list=[out])
+        with tempfile.TemporaryDirectory() as td, \
+                static.program_guard(main):
+            prefix = os.path.join(td, 'inf')
+            static.save_inference_model(prefix, [x], [out], exe)
+            fn, feed_names, n_fetch = static.load_inference_model(prefix,
+                                                                  exe)
+            assert feed_names == ['x'] and n_fetch == 1
+            got = fn(feed)[0]
+            np.testing.assert_allclose(np.asarray(got), ref, atol=1e-5)
+
+    def test_ema(self):
+        p = paddle.Parameter(np.ones((2,), dtype=np.float32))
+        ema = static.ExponentialMovingAverage(0.5)
+        ema.update([p])
+        p._data = p._data * 3
+        ema.update()
+        with ema.apply():
+            averaged = np.asarray(p._data).copy()
+        np.testing.assert_allclose(np.asarray(p._data), [3., 3.])
+        assert averaged[0] < 3.0  # pulled toward the older value
+
+    def test_places_and_guards(self):
+        assert len(static.cpu_places(2)) == 2
+        assert len(static.cuda_places()) >= 1
+        with static.device_guard('cpu'), static.name_scope('blk'):
+            pass
+        assert static.default_main_program() is not None
+
+
+class TestGraphOps:
+    def _csc(self):
+        # graph: 0<-1, 0<-2, 1<-2 (row=in-neighbor ids per column)
+        colptr = np.array([0, 2, 3, 3])
+        rows = np.array([1, 2, 2])
+        return rows, colptr
+
+    def test_sample_neighbors_all(self):
+        rows, colptr = self._csc()
+        from paddle_tpu import incubate
+        neigh, cnt = incubate.graph_sample_neighbors(
+            paddle.to_tensor(rows), paddle.to_tensor(colptr),
+            paddle.to_tensor(np.array([0, 1])), sample_size=-1)
+        assert np.asarray(cnt._data).tolist() == [2, 1]
+        assert sorted(np.asarray(neigh._data).tolist()) == [1, 2, 2]
+
+    def test_reindex(self):
+        from paddle_tpu import incubate
+        src, dst, nodes = incubate.graph_reindex(
+            paddle.to_tensor(np.array([10, 20])),
+            paddle.to_tensor(np.array([20, 30, 30])),
+            paddle.to_tensor(np.array([2, 1])))
+        assert np.asarray(nodes._data).tolist() == [10, 20, 30]
+        assert np.asarray(src._data).tolist() == [1, 2, 2]
+        assert np.asarray(dst._data).tolist() == [0, 0, 1]
+
+    def test_khop_and_send_recv(self):
+        rows, colptr = self._csc()
+        from paddle_tpu import incubate
+        src, dst, nodes, cnt = incubate.graph_khop_sampler(
+            paddle.to_tensor(rows), paddle.to_tensor(colptr),
+            paddle.to_tensor(np.array([0])), [2, 2])
+        assert len(np.asarray(nodes._data)) >= 2
+        x = paddle.to_tensor(np.eye(3, dtype=np.float32))
+        out = incubate.graph_send_recv(x, np.array([1, 2]),
+                                       np.array([0, 0]), pool_type="sum")
+        np.testing.assert_allclose(np.asarray(out._data)[0], [0., 1., 1.])
+
+    def test_softmax_mask_fuse(self):
+        from paddle_tpu import incubate
+        x = paddle.to_tensor(np.zeros((1, 1, 2, 2), dtype=np.float32))
+        m = paddle.to_tensor(np.array([[[[0., -1e4], [0., 0.]]]],
+                                      dtype=np.float32))
+        out = np.asarray(incubate.softmax_mask_fuse(x, m)._data)
+        np.testing.assert_allclose(out[0, 0, 0], [1., 0.], atol=1e-4)
+        tri = np.asarray(incubate.softmax_mask_fuse_upper_triangle(
+            x)._data)
+        np.testing.assert_allclose(tri[0, 0, 0], [1., 0.], atol=1e-4)
+        np.testing.assert_allclose(tri[0, 0, 1], [0.5, 0.5], atol=1e-4)
+
+    def test_identity_loss(self):
+        from paddle_tpu import incubate
+        x = paddle.to_tensor(np.array([1., 2., 3.], dtype=np.float32))
+        assert float(incubate.identity_loss(x, "mean")._data) == 2.0
+        assert float(incubate.identity_loss(x, "sum")._data) == 6.0

@@ -1,0 +1,87 @@
+"""paddle.text: viterbi decode (vs brute force), datasets, tokenizer."""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def _brute_viterbi(pot, trans, length, include, n_tags):
+    best, bp = -1e30, None
+    for path in itertools.product(range(n_tags), repeat=length):
+        s = pot[0, path[0]] + (trans[-1, path[0]] if include else 0.0)
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + pot[t, path[t]]
+        if include:
+            s += trans[path[-1], -2]
+        if s > best:
+            best, bp = s, path
+    return best, bp
+
+
+class TestViterbi:
+    def test_matches_brute_force_with_lengths(self):
+        rng = np.random.default_rng(0)
+        B, L, C = 3, 5, 4
+        pot = rng.normal(size=(B, L, C)).astype(np.float32)
+        trans = rng.normal(size=(C, C)).astype(np.float32)
+        lens = np.array([5, 3, 1], dtype=np.int64)
+        for include in (False, True):
+            scores, paths = paddle.text.viterbi_decode(
+                pot, trans, lens, include)
+            sv = np.asarray(scores._data)
+            pv = np.asarray(paths._data)
+            for b in range(B):
+                bs, bp = _brute_viterbi(pot[b], trans, int(lens[b]),
+                                        include, C)
+                assert abs(sv[b] - bs) < 1e-4
+                assert tuple(pv[b, :lens[b]]) == bp
+                assert (pv[b, lens[b]:] == 0).all()
+
+    def test_decoder_layer(self):
+        rng = np.random.default_rng(1)
+        pot = paddle.to_tensor(
+            rng.normal(size=(2, 4, 3)).astype(np.float32))
+        trans = paddle.to_tensor(
+            rng.normal(size=(3, 3)).astype(np.float32))
+        dec = paddle.text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+        scores, paths = dec(pot, paddle.to_tensor(
+            np.array([4, 4], dtype=np.int64)))
+        assert list(scores.shape) == [2] and list(paths.shape) == [2, 4]
+
+
+class TestTextDatasets:
+    def test_uci_housing_splits(self):
+        tr = paddle.text.UCIHousing(mode='train')
+        te = paddle.text.UCIHousing(mode='test')
+        assert len(tr) > len(te) > 0
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_imdb_and_imikolov(self):
+        ds = paddle.text.Imdb(mode='train')
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label.shape == (1,)
+        ng = paddle.text.Imikolov(data_type='NGRAM', window_size=5)
+        assert len(ng[0]) == 5
+        sq = paddle.text.Imikolov(data_type='SEQ', window_size=5)
+        a, b = sq[0]
+        assert len(a) == 4 and len(b) == 4
+
+    def test_movielens_conll_wmt(self):
+        mv = paddle.text.Movielens(mode='test')
+        assert len(mv[0]) == 8
+        c5 = paddle.text.Conll05st()
+        words, verb, mark, labels = c5[0]
+        assert len(words) == len(mark) == len(labels)
+        for cls in (paddle.text.WMT14, paddle.text.WMT16):
+            w = cls(mode='test')
+            src, trg_in, trg_out = w[0]
+            assert len(trg_in) == len(trg_out)
+            assert trg_in[0] == 0 and trg_out[-1] == 1  # BOS / EOS
+
+    def test_datasets_feed_dataloader(self):
+        ds = paddle.text.UCIHousing(mode='test')
+        dl = paddle.io.DataLoader(ds, batch_size=16, drop_last=True)
+        xb, yb = next(iter(dl))
+        assert list(xb.shape) == [16, 13] and list(yb.shape) == [16, 1]
