@@ -86,7 +86,8 @@ _node_counter = itertools.count()
 class Node:
     """One recorded primitive application."""
 
-    __slots__ = ("id", "vjp_fn", "parents", "n_outputs", "out_ids")
+    __slots__ = ("id", "vjp_fn", "parents", "n_outputs", "out_ids",
+                 "out_refs")
 
     def __init__(self, vjp_fn, parents, n_outputs):
         self.id = next(_node_counter)
@@ -94,15 +95,47 @@ class Node:
         self.parents = parents  # list[Tensor] (the diff inputs, in order)
         self.n_outputs = n_outputs
         self.out_ids = []  # python id() of output Tensors, parallel to outputs
+        self.out_refs = []  # weakrefs to outputs (for grad hooks)
 
 
 def record(vjp_fn, parents, outputs) -> Node:
+    import weakref
+
     node = Node(vjp_fn, parents, len(outputs))
     for o in outputs:
         o._node = node
         o._out_index = len(node.out_ids)
         node.out_ids.append(id(o))
+        node.out_refs.append(weakref.ref(o))
     return node
+
+
+class HookHandle:
+    """Removable handle returned by Tensor.register_hook."""
+
+    _ids = itertools.count()
+
+    def __init__(self, store: dict, hook: Callable):
+        self.hook_id = next(HookHandle._ids)
+        self._store = store
+        store[self.hook_id] = hook
+
+    def remove(self):
+        self._store.pop(self.hook_id, None)
+
+
+def _apply_hooks(tensor, g):
+    """Run a tensor's registered grad hooks over cotangent g (raw array)."""
+    hooks = tensor._grad_hooks
+    if not hooks:
+        return g
+    from ..tensor import Tensor
+
+    for hook in list(hooks.values()):
+        out = hook(Tensor(g, stop_gradient=True))
+        if out is not None:
+            g = out._data if isinstance(out, Tensor) else out
+    return g
 
 
 def backward(tensor, grad_tensor=None, retain_graph=False):
@@ -165,6 +198,9 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
             ct = cts.pop((nid, i), None)
             if ct is not None:
                 has_any = True
+                out_t = node.out_refs[i]() if i < len(node.out_refs) else None
+                if out_t is not None and out_t._grad_hooks:
+                    ct = _apply_hooks(out_t, ct)
             outs_ct.append(ct)
         if not has_any:
             continue
@@ -196,6 +232,8 @@ def _accum_leaf(tensor, g):
 
     if tensor.stop_gradient:
         return
+    if tensor._grad_hooks:
+        g = _apply_hooks(tensor, g)
     if tensor.grad is None:
         tensor.grad = Tensor(g, stop_gradient=True)
     else:

@@ -31,5 +31,60 @@ def shard_tensor(x, process_mesh=None, shard_spec=None, dist_attr=None):
     return Tensor(placed)
 
 
+def _to_pspec(spec, mesh):
+    if spec is None:
+        return None
+    if isinstance(spec, PartitionSpec):
+        return spec
+    return PartitionSpec(*[s if s in mesh.axis_names else None for s in spec])
+
+
 def shard_op(op, process_mesh=None, in_shard_specs=None, out_shard_specs=None):
-    return op
+    """Wrap ``op`` so its inputs/outputs carry sharding constraints
+    (reference: auto_parallel/interface.py shard_op annotates the op's
+    dist_attr; here the constraint is real — under jit it becomes
+    lax.with_sharding_constraint, so GSPMD must produce that layout, and
+    eagerly it device_puts)."""
+    mesh = process_mesh or mesh_mod.get_mesh()
+
+    def _place_raw(data, spec):
+        import jax.core as jcore
+        if isinstance(data, jcore.Tracer):
+            return jax.lax.with_sharding_constraint(
+                data, NamedSharding(mesh, spec))
+        return jax.device_put(data, NamedSharding(mesh, spec))
+
+    def _constrain(x, spec):
+        if spec is None:
+            return x
+        if isinstance(x, Tensor):
+            # through the tape (apply) so eager autograd keeps flowing —
+            # the placement is an identity op with an identity vjp
+            from ..tensor import apply
+            return apply(lambda a: _place_raw(a, spec), x)
+        if not hasattr(x, "shape"):
+            return x
+        return _place_raw(x, spec)
+
+    def wrapper(*args, **kwargs):
+        if in_shard_specs is not None:
+            args = tuple(
+                _constrain(a, _to_pspec(s, mesh))
+                for a, s in zip(args, list(in_shard_specs) +
+                                [None] * (len(args) - len(in_shard_specs))))
+        out = op(*args, **kwargs)
+        if out_shard_specs is None:
+            return out
+        if isinstance(out, (tuple, list)):
+            specs = list(out_shard_specs) + [None] * (len(out) -
+                                                      len(out_shard_specs))
+            res = [_constrain(o, _to_pspec(s, mesh))
+                   for o, s in zip(out, specs)]
+            return type(out)(res)
+        return _constrain(out, _to_pspec(out_shard_specs[0]
+                                         if isinstance(out_shard_specs,
+                                                       (list, tuple))
+                                         else out_shard_specs, mesh))
+
+    wrapper.__wrapped__ = op
+    return wrapper

@@ -1,12 +1,21 @@
 """Distributed (sharded, async) checkpointing.
 
-Reference analog: python/paddle/incubate/checkpoint + fleet utils. Backed by
-orbax when available (async, per-shard files, TPU-friendly); falls back to
-the numpy pickle writer in framework/io.py.
+Reference analog: python/paddle/incubate/checkpoint (auto_checkpoint) +
+fleet utils checkpoint paths. Backed by orbax: per-shard files written in
+parallel, async save on a background thread (training continues while the
+write completes), restore resharded onto any mesh via a sharding template.
+Falls back to the numpy pickle writer in framework/io.py when orbax is
+unavailable.
+
+Accepts arbitrary pytrees (params, optimizer moments, scaler state, ...),
+with Tensor leaves unwrapped/rewrapped transparently.
 """
 from __future__ import annotations
 
 import os
+import re
+import shutil
+from typing import Any, Optional
 
 import jax
 import numpy as np
@@ -19,35 +28,144 @@ try:
 except Exception:
     _HAS_ORBAX = False
 
+_async_ckptr = None
 
-def save_distributed(state_dict, path, async_save=False):
-    """state_dict: name → Tensor (possibly sharded jax arrays)."""
-    raw = {k: (v._data if isinstance(v, Tensor) else v)
-           for k, v in state_dict.items()}
+
+def _checkpointer():
+    global _async_ckptr
+    if _async_ckptr is None:
+        _async_ckptr = ocp.StandardCheckpointer()  # async under the hood
+    return _async_ckptr
+
+
+def _unwrap(tree):
+    return jax.tree_util.tree_map(
+        lambda v: v._data if isinstance(v, Tensor) else v, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _rewrap_like(tree, like):
+    leaves_like = jax.tree_util.tree_leaves(
+        like, is_leaf=lambda x: isinstance(x, Tensor))
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    out = [Tensor(v) if isinstance(t, Tensor) else v
+           for v, t in zip(flat, leaves_like)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def save_distributed(state, path, async_save=False):
+    """Save a pytree of (possibly sharded) arrays/Tensors.
+
+    async_save=True returns immediately; the per-shard write proceeds on
+    orbax's background thread — call :func:`wait_for_checkpoints` (or the
+    next save) to join it."""
+    raw = _unwrap(state)
     if _HAS_ORBAX:
         path = os.path.abspath(path)
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save(path, raw, force=True)
+        ckptr = _checkpointer()
+        # join any in-flight async save first: deleting/overwriting a path
+        # that a background commit is still renaming into corrupts it
+        ckptr.wait_until_finished()
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+        ckptr.save(path, raw)
         if not async_save:
             ckptr.wait_until_finished()
         return path
     from ..framework.io import save as _save
-    _save({k: Tensor(np.asarray(v)) for k, v in raw.items()}, path)
+    _save(jax.tree_util.tree_map(lambda v: np.asarray(v), raw), path)
     return path
 
 
+def wait_for_checkpoints():
+    """Block until outstanding async saves are durable."""
+    if _HAS_ORBAX and _async_ckptr is not None:
+        _async_ckptr.wait_until_finished()
+
+
+def _as_abstract(template):
+    """Template leaves -> jax.ShapeDtypeStruct carrying target shardings,
+    so orbax restores each shard directly onto its devices."""
+
+    def conv(v):
+        if isinstance(v, Tensor):
+            v = v._data
+        if isinstance(v, jax.Array):
+            return jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=v.sharding)
+        if isinstance(v, jax.ShapeDtypeStruct):
+            return v
+        arr = np.asarray(v)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+    return jax.tree_util.tree_map(conv, template,
+                                  is_leaf=lambda x: isinstance(x, Tensor))
+
+
 def load_distributed(path, template=None):
-    """Returns name → Tensor. With orbax + template, restores with the
-    template's shardings (resharded load)."""
+    """Restore a pytree. With a template (same structure; leaves are arrays,
+    Tensors or ShapeDtypeStructs), each leaf is restored WITH the template's
+    sharding — i.e. resharded onto the current mesh, whatever mesh wrote
+    it."""
     if _HAS_ORBAX and os.path.isdir(path):
-        ckptr = ocp.StandardCheckpointer()
+        ckptr = _checkpointer()
+        ckptr.wait_until_finished()
         if template is not None:
-            tmpl = {k: (v._data if isinstance(v, Tensor) else v)
-                    for k, v in template.items()}
-            restored = ckptr.restore(os.path.abspath(path), tmpl)
-        else:
-            restored = ckptr.restore(os.path.abspath(path))
-        return {k: Tensor(v) for k, v in restored.items()}
+            restored = ckptr.restore(os.path.abspath(path),
+                                     _as_abstract(template))
+            return _rewrap_like(restored, template)
+        return ckptr.restore(os.path.abspath(path))
     from ..framework.io import load as _load
     out = _load(path)
+    if template is not None:
+        return _rewrap_like(_unwrap(out), template)
     return out
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention (reference:
+    incubate/checkpoint/auto_checkpoint.py train-epoch-range bookkeeping).
+
+    save(step, state) writes <dir>/ckpt-<step> asynchronously and prunes to
+    ``max_to_keep``; restore_latest() reloads the newest durable step.
+    """
+
+    def __init__(self, directory, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _step_dirs(self):
+        out = []
+        for name in os.listdir(self.directory):
+            m = re.fullmatch(r"ckpt-(\d+)", name)
+            if m:
+                out.append((int(m.group(1)),
+                            os.path.join(self.directory, name)))
+        return sorted(out)
+
+    def all_steps(self):
+        return [s for s, _ in self._step_dirs()]
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, state: Any, async_save=True):
+        path = os.path.join(self.directory, f"ckpt-{step}")
+        save_distributed(state, path, async_save=async_save)
+        for s, p in self._step_dirs()[:-self.max_to_keep or None]:
+            if s != step and len(self.all_steps()) > self.max_to_keep:
+                shutil.rmtree(p, ignore_errors=True)
+        return path
+
+    def restore(self, step: int, template=None):
+        return load_distributed(
+            os.path.join(self.directory, f"ckpt-{step}"), template)
+
+    def restore_latest(self, template=None):
+        step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        return step, self.restore(step, template)

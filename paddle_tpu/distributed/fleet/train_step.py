@@ -60,7 +60,8 @@ class CompiledTrainStep:
 
     def __init__(self, model, optimizer, loss_fn: Callable, strategy=None,
                  amp_level: Optional[str] = None, amp_dtype="bfloat16",
-                 donate: bool = True):
+                 donate: bool = True, accumulate_steps: Optional[int] = None,
+                 scaler=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -68,6 +69,41 @@ class CompiledTrainStep:
         self.stage = strategy.sharding_stage if strategy is not None else 0
         self.amp_level = amp_level
         self.amp_dtype = amp_dtype
+
+        # Gradient accumulation (reference: gradient_merge_optimizer.py
+        # k_steps / pipeline accumulate_steps): k micro-steps scanned inside
+        # ONE compiled program, fp32 grad accumulation, one update.
+        if accumulate_steps is None:
+            accumulate_steps = 1
+            if strategy is not None:
+                if strategy.gradient_merge:
+                    accumulate_steps = int(
+                        strategy.gradient_merge_configs.get("k_steps", 1))
+                elif strategy.pipeline:
+                    accumulate_steps = int(
+                        strategy.pipeline_configs.get("accumulate_steps", 1))
+        self.accumulate_steps = max(1, int(accumulate_steps))
+
+        # Dynamic loss scaling (reference: amp/grad_scaler.py) compiled into
+        # the step: scaled loss, unscale grads, found_inf -> skip update and
+        # decay the scale; all with lax/where, no host sync.
+        self._scaler_cfg = None
+        if scaler is not None and getattr(scaler, "_enable", True):
+            self._scaler_cfg = {
+                "init": float(getattr(scaler, "_scale", 2.0 ** 15)),
+                "incr_ratio": float(getattr(scaler, "_incr_ratio", 2.0)),
+                "decr_ratio": float(getattr(scaler, "_decr_ratio", 0.5)),
+                "incr_every": int(getattr(scaler, "_incr_every", 1000)),
+                "decr_every": int(getattr(scaler, "_decr_every", 1)),
+                "dynamic": bool(getattr(scaler, "_dynamic", True)),
+            }
+        self._scaler_state = {
+            "scale": jnp.float32(self._scaler_cfg["init"]
+                                 if self._scaler_cfg else 1.0),
+            "good": jnp.int32(0),
+            "bad": jnp.int32(0),
+        }
+        self.last_found_inf = jnp.asarray(False)
 
         self._params = dict(model.named_parameters())
         self._buffers = dict(model.named_buffers())
@@ -96,21 +132,34 @@ class CompiledTrainStep:
         in_shardings = (to_sharding(self._param_specs),
                         to_sharding(self._opt_specs),
                         to_sharding(self._buffer_specs),
+                        None,   # scaler state: replicated scalars
                         None,   # batch: placed by caller via device_put
                         None,   # rng key: replicated
                         None)   # lr scalar: replicated
         out_shardings = (None,
                          to_sharding(self._param_specs),
                          to_sharding(self._opt_specs),
-                         to_sharding(self._buffer_specs))
+                         to_sharding(self._buffer_specs),
+                         None,   # scaler state
+                         None)   # found_inf
 
-        # place initial params; opt state is placed by jit's in_shardings on
-        # the first call (uncommitted arrays reshard freely)
+        # Commit params, opt state AND buffers to their shardings up front.
+        # Leaving any of them uncommitted makes the first call compile a
+        # second executable once committed outputs feed call 2 — an ~85s
+        # double-compile on the TPU tunnel (round-2 profiling finding).
         self._param_vals = {
             k: jax.device_put(v, NamedSharding(mesh, self._param_specs[k]))
             for k, v in self._param_vals.items()}
+        self._opt_state = {
+            k: jax.tree_util.tree_map(
+                lambda leaf, s: jax.device_put(leaf, NamedSharding(mesh, s)),
+                self._opt_state[k], self._opt_specs[k])
+            for k in self._opt_state}
+        self._buffer_vals = {
+            k: jax.device_put(v, NamedSharding(mesh, self._buffer_specs[k]))
+            for k, v in self._buffer_vals.items()}
 
-        donate_argnums = (0, 1, 2) if donate else ()
+        donate_argnums = (0, 1, 2, 3) if donate else ()
         self._compiled = jax.jit(self._step, donate_argnums=donate_argnums,
                                  in_shardings=in_shardings,
                                  out_shardings=out_shardings)
@@ -118,27 +167,102 @@ class CompiledTrainStep:
 
     # the pure function that gets compiled; lr is an argument (NOT a traced
     # constant) so schedulers take effect without recompiling
-    def _step(self, param_vals, opt_state, buffer_vals, batch, key, lr):
-        def loss_of(pv):
+    def _step(self, param_vals, opt_state, buffer_vals, scaler_state, batch,
+              key, lr):
+        scale = scaler_state["scale"]
+
+        def loss_of(pv, bufs, mb, mkey):
             with functional_mode(), _swap_params(self._params, pv), \
-                    _swap_params(self._buffers, buffer_vals), \
-                    functional_key(key):
+                    _swap_params(self._buffers, bufs), \
+                    functional_key(mkey):
                 if self.amp_level:
                     from ...amp.auto_cast import auto_cast
                     with auto_cast(True, level=self.amp_level,
                                    dtype=self.amp_dtype):
-                        loss = self.loss_fn(self.model, *batch)
+                        loss = self.loss_fn(self.model, *mb)
                 else:
-                    loss = self.loss_fn(self.model, *batch)
+                    loss = self.loss_fn(self.model, *mb)
                 new_bufs = {k: b._data for k, b in self._buffers.items()}
             lraw = loss._data if isinstance(loss, Tensor) else loss
-            return lraw.astype(jnp.float32), new_bufs
+            lraw = lraw.astype(jnp.float32)
+            return lraw * scale, (lraw, new_bufs)
 
-        (loss, new_bufs), grads = jax.value_and_grad(loss_of, has_aux=True)(
-            param_vals)
+        k_acc = self.accumulate_steps
+        if k_acc > 1:
+            for leaf in jax.tree_util.tree_leaves(batch):
+                if jnp.ndim(leaf) and leaf.shape[0] % k_acc:
+                    raise ValueError(
+                        f"batch dim {leaf.shape[0]} not divisible by "
+                        f"accumulate_steps {k_acc}")
+        if k_acc == 1:
+            (_, (loss, new_bufs)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(param_vals, buffer_vals, batch, key)
+        else:
+            # split each batch leaf [B, ...] -> [k, B/k, ...] and scan;
+            # mean-of-micro-losses == full-batch loss for equal micro sizes
+            micro = jax.tree_util.tree_map(
+                lambda x: x.reshape(k_acc, x.shape[0] // k_acc, *x.shape[1:])
+                if jnp.ndim(x) else x, batch)
+            keys = jax.random.split(key, k_acc)
+
+            def body(carry, mk):
+                acc, bufs = carry
+                mb, mkey = mk
+                (_, (loss, bufs)), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(param_vals, bufs, mb, mkey)
+                acc = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                return (acc, bufs), loss
+
+            acc0 = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), param_vals)
+            (acc, new_bufs), losses = jax.lax.scan(
+                body, (acc0, buffer_vals), (micro, keys))
+            loss = jnp.mean(losses)
+            grads = jax.tree_util.tree_map(
+                lambda a, p: (a / k_acc).astype(p.dtype), acc, param_vals)
+
+        if self._scaler_cfg:
+            grads = jax.tree_util.tree_map(
+                lambda g: g / scale.astype(g.dtype), grads)
+            found_inf = jax.tree_util.tree_reduce(
+                lambda a, g: jnp.logical_or(a, jnp.any(~jnp.isfinite(g))),
+                grads, jnp.asarray(False))
+            # poison-free grads for the update; the update is discarded via
+            # `where` when found_inf, so zeros keep moments finite
+            grads = jax.tree_util.tree_map(
+                lambda g: jnp.where(found_inf, jnp.zeros_like(g), g), grads)
+        else:
+            found_inf = jnp.asarray(False)
+
         new_params, new_opt = self.optimizer.apply_gradients_functional(
             param_vals, grads, opt_state, lr)
-        return loss, new_params, new_opt, new_bufs
+
+        if self._scaler_cfg:
+            keep = lambda old, new: jax.tree_util.tree_map(
+                lambda o, n: jnp.where(found_inf, o, n), old, new)
+            new_params = keep(param_vals, new_params)
+            new_opt = keep(opt_state, new_opt)
+            new_scaler = self._next_scaler_state(scaler_state, found_inf)
+        else:
+            new_scaler = scaler_state
+        return loss, new_params, new_opt, new_bufs, new_scaler, found_inf
+
+    def _next_scaler_state(self, st, found_inf):
+        cfg = self._scaler_cfg
+        if not cfg["dynamic"]:
+            return st
+        scale, good, bad = st["scale"], st["good"], st["bad"]
+        bad2 = jnp.where(found_inf, bad + 1, jnp.int32(0))
+        good2 = jnp.where(found_inf, jnp.int32(0), good + 1)
+        shrink = bad2 >= cfg["decr_every"]
+        grow = good2 >= cfg["incr_every"]
+        new_scale = jnp.where(
+            shrink, jnp.maximum(scale * cfg["decr_ratio"], 1.0),
+            jnp.where(grow, scale * cfg["incr_ratio"], scale))
+        return {"scale": new_scale.astype(jnp.float32),
+                "good": jnp.where(grow, jnp.int32(0), good2),
+                "bad": jnp.where(shrink, jnp.int32(0), bad2)}
 
     def __call__(self, *batch):
         raw_batch = jax.tree_util.tree_map(
@@ -150,9 +274,11 @@ class CompiledTrainStep:
             raw_batch)
         key = next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
-        loss, self._param_vals, self._opt_state, self._buffer_vals = \
+        (loss, self._param_vals, self._opt_state, self._buffer_vals,
+         self._scaler_state, self.last_found_inf) = \
             self._compiled(self._param_vals, self._opt_state,
-                           self._buffer_vals, raw_batch, key, lr)
+                           self._buffer_vals, self._scaler_state, raw_batch,
+                           key, lr)
         # reflect updated state into the eager Layer/optimizer views
         for k, p in self._params.items():
             p._data = self._param_vals[k]
@@ -170,6 +296,7 @@ class CompiledTrainStep:
 
 
 def make_train_step(model, optimizer, loss_fn, strategy=None, amp_level=None,
-                    amp_dtype="bfloat16", donate=True) -> CompiledTrainStep:
+                    amp_dtype="bfloat16", donate=True, accumulate_steps=None,
+                    scaler=None) -> CompiledTrainStep:
     return CompiledTrainStep(model, optimizer, loss_fn, strategy, amp_level,
-                             amp_dtype, donate)
+                             amp_dtype, donate, accumulate_steps, scaler)

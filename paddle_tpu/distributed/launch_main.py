@@ -1,20 +1,26 @@
-"""python -m paddle_tpu.distributed.launch — multi-host launcher.
+"""python -m paddle_tpu.distributed.launch — multi-process / multi-host
+launcher with supervision.
 
-Reference: python/paddle/distributed/launch. On TPU pods each host runs the
-same script under the jax multi-controller runtime; this launcher just sets
-the env contract (PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID / PADDLE_MASTER)
-and execs the training script, matching how reference launch scripts are
-invoked so they keep working.
+Reference: python/paddle/distributed/launch (controllers/collective.py
+process management + fleet elastic restart). Each host runs
+``--nproc_per_node`` worker processes under a supervisor: the gang shares
+the PADDLE_* env contract, a crashed worker tears down (and with
+``--max_restarts`` relaunches) the whole local gang — the reference
+launcher's watch/restart loop. ``--nproc_per_node 1`` (TPU pods: one
+process per host under the jax multi-controller runtime) execs in-process.
 """
 from __future__ import annotations
 
 import argparse
 import os
 import runpy
+import signal
+import subprocess
 import sys
+import time
 
 
-def main(argv=None):
+def _parse(argv):
     parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
     parser.add_argument("--nnodes", type=int,
                         default=int(os.environ.get("PADDLE_TRAINERS_NUM", 1)))
@@ -22,17 +28,110 @@ def main(argv=None):
                         default=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
     parser.add_argument("--master", default=os.environ.get("PADDLE_MASTER", ""))
     parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--max_restarts", type=int, default=0,
+                        help="elastic-style gang relaunches on worker failure")
+    parser.add_argument("--log_dir", default=None,
+                        help="per-rank stdout/stderr files instead of inherit")
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
-    args = parser.parse_args(argv)
+    return parser.parse_args(argv)
 
+
+def _run_inline(args):
     os.environ["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
     os.environ["PADDLE_TRAINER_ID"] = str(args.node_rank)
     if args.master:
         os.environ["PADDLE_MASTER"] = args.master
     sys.argv = [args.script] + args.script_args
     runpy.run_path(args.script, run_name="__main__")
+    return 0
+
+
+def _spawn_gang(args):
+    """Start nproc_per_node workers; returns list of (proc, logfile)."""
+    world = args.nnodes * args.nproc_per_node
+    procs = []
+    for local in range(args.nproc_per_node):
+        rank = args.node_rank * args.nproc_per_node + local
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_LOCAL_RANK": str(local),
+            "PADDLE_LOCAL_SIZE": str(args.nproc_per_node),
+        })
+        if args.master:
+            env["PADDLE_MASTER"] = args.master
+        log = None
+        kw = {}
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            # append: a restarted gang must not truncate the previous
+            # attempt's crash traceback
+            log = open(os.path.join(args.log_dir, f"worker.{rank}.log"), "a")
+            kw = {"stdout": log, "stderr": subprocess.STDOUT}
+        p = subprocess.Popen(
+            [sys.executable, args.script] + args.script_args, env=env, **kw)
+        procs.append((p, log))
+    return procs
+
+
+def _supervise(procs):
+    """Wait for the gang; first failure terminates the rest. Returns rc."""
+    try:
+        while True:
+            alive = False
+            for p, _ in procs:
+                rc = p.poll()
+                if rc is None:
+                    alive = True
+                elif rc != 0:
+                    for q, _ in procs:
+                        if q.poll() is None:
+                            q.terminate()
+                    deadline = time.time() + 10
+                    for q, _ in procs:
+                        try:
+                            q.wait(timeout=max(0.1, deadline - time.time()))
+                        except subprocess.TimeoutExpired:
+                            q.kill()
+                    return rc
+            if not alive:
+                return 0
+            time.sleep(0.2)
+    finally:
+        for _, log in procs:
+            if log is not None:
+                log.close()
+
+
+def main(argv=None):
+    args = _parse(argv)
+    if args.nproc_per_node <= 1:
+        return _run_inline(args)
+
+    attempts = args.max_restarts + 1
+    rc = 1
+    for attempt in range(attempts):
+        if attempt:
+            print(f"[launch] gang failed (rc={rc}); restart "
+                  f"{attempt}/{args.max_restarts}", file=sys.stderr)
+        procs = _spawn_gang(args)
+
+        def _forward(signum, frame):
+            for p, _ in procs:
+                if p.poll() is None:
+                    p.send_signal(signum)
+
+        old = signal.signal(signal.SIGTERM, _forward)
+        try:
+            rc = _supervise(procs)
+        finally:
+            signal.signal(signal.SIGTERM, old)
+        if rc == 0:
+            return 0
+    return rc
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

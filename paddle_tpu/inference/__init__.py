@@ -53,17 +53,37 @@ class Config:
 
 
 class _Handle:
-    """Input/output handle mimicking ZeroCopyTensor."""
+    """Input/output handle mimicking ZeroCopyTensor
+    (paddle/fluid/inference/api/details/zero_copy_tensor.cc): ``reshape``
+    declares the shape, ``copy_from_cpu`` fills data (validated against the
+    declared shape), ``copy_to_cpu`` reads back."""
 
-    def __init__(self, name):
+    def __init__(self, name, shape=None):
         self.name = name
+        self._shape = tuple(shape) if shape is not None else None
         self._value = None
 
-    def copy_from_cpu(self, arr):
-        self._value = np.asarray(arr)
-
     def reshape(self, shape):
-        pass
+        self._shape = tuple(int(s) for s in shape)
+        if self._value is not None and self._value.size == int(
+                np.prod(self._shape)):
+            self._value = self._value.reshape(self._shape)
+
+    def shape(self):
+        if self._value is not None:
+            return list(self._value.shape)
+        return list(self._shape) if self._shape is not None else None
+
+    def copy_from_cpu(self, arr):
+        arr = np.asarray(arr)
+        if self._shape is not None and arr.shape != self._shape:
+            if arr.size == int(np.prod(self._shape)):
+                arr = arr.reshape(self._shape)
+            else:
+                raise ValueError(
+                    f"handle '{self.name}' declared shape {self._shape}, "
+                    f"got {arr.shape}")
+        self._value = arr
 
     def copy_to_cpu(self):
         return np.asarray(self._value)
@@ -78,9 +98,21 @@ class Predictor:
         if path.endswith(".pdmodel"):
             path = path[:-len(".pdmodel")]
         self._layer = jit_load(path)
-        n_in = getattr(self._layer, "n_inputs", None) or 1
-        self._inputs = [_Handle(f"x{i}") for i in range(n_in)]
-        self._outputs = [_Handle("out0")]
+        in_names = getattr(self._layer, "input_names", None) or ["x0"]
+        out_names = getattr(self._layer, "output_names", None) or ["out0"]
+        in_avals = getattr(self._layer, "input_avals", None)
+        out_avals = getattr(self._layer, "output_avals", None)
+
+        def _shape(avals, i):
+            if avals is None or i >= len(avals):
+                return None
+            shp = avals[i].shape
+            return None if any(not isinstance(d, int) for d in shp) else shp
+
+        self._inputs = [_Handle(n, _shape(in_avals, i))
+                        for i, n in enumerate(in_names)]
+        self._outputs = [_Handle(n, _shape(out_avals, i))
+                         for i, n in enumerate(out_names)]
 
     def get_input_names(self):
         return [h.name for h in self._inputs]
@@ -96,16 +128,22 @@ class Predictor:
 
     def run(self, inputs=None):
         """Either positional (list of arrays → list of arrays) or through
-        the copy_from_cpu handles, as in the reference."""
+        the copy_from_cpu handles, as in the reference. Output handle
+        identity and names are stable across runs."""
         if inputs is not None:
             outs = self._layer(*inputs)
         else:
+            missing = [h.name for h in self._inputs if h._value is None]
+            if missing:
+                raise RuntimeError(
+                    f"input handles not filled: {missing}")
             outs = self._layer(*[h._value for h in self._inputs])
         if not isinstance(outs, (tuple, list)):
             outs = [outs]
         arrays = [np.asarray(o._data if hasattr(o, "_data") else o)
                   for o in outs]
-        self._outputs = [_Handle(f"out{i}") for i in range(len(arrays))]
+        while len(self._outputs) < len(arrays):
+            self._outputs.append(_Handle(f"out{len(self._outputs)}"))
         for h, a in zip(self._outputs, arrays):
             h._value = a
         return arrays

@@ -1,13 +1,19 @@
 """DataLoader. Reference: python/paddle/io/dataloader/dataloader_iter.py +
 the C++ reader ops (paddle/fluid/operators/reader).
 
-The hot path on TPU is keeping the XLA queue fed: batches are collated to
-numpy on worker threads and prefetched ahead of consumption. When the native
-C++ prefetch runtime is built (paddle_tpu/runtime/cpp), its lock-free ring
-buffer replaces the python queue; otherwise a thread pool is used.
+The hot path on TPU is keeping the XLA queue fed. ``num_workers > 0`` runs
+true multiprocess workers (the analog of reference
+``_DataLoaderIterMultiProcess``, dataloader_iter.py:342): each worker
+process pulls batch-index tasks from a shared queue, collates to numpy and
+ships the batch back; the parent reorders to preserve batch order. GIL-bound
+transforms therefore scale ~linearly with workers. If the dataset/collate
+can't cross a process boundary (unpicklable closures), a thread pool +
+optional C++ ring-buffer prefetcher is the fallback.
 """
 from __future__ import annotations
 
+import multiprocessing
+import os
 import queue
 import threading
 from typing import Callable, Optional
@@ -32,6 +38,53 @@ class WorkerInfo:
 
 def _worker_info():
     return getattr(_WORKER_TLS, "info", None)
+
+
+class _ExcInfo:
+    """Pickled exception crossing the worker → parent queue."""
+
+    def __init__(self, exc):
+        import traceback
+
+        self.exc = exc
+        self.tb = traceback.format_exc()
+
+
+def _mp_worker_loop(dataset, collate_fn, idx_q, out_q, worker_id,
+                    num_workers, worker_init_fn, iterable, batch_size,
+                    drop_last):
+    """Runs in a child process (module-level for spawn picklability)."""
+    _WORKER_TLS.info = WorkerInfo(worker_id, num_workers, dataset)
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+        if iterable:
+            # each worker iterates its own dataset copy; sharding is the
+            # dataset's job via get_worker_info() (reference worker.py)
+            batch = []
+            for item in dataset:
+                batch.append(item)
+                if len(batch) == batch_size:
+                    out_q.put(("data", collate_fn(batch)))
+                    batch = []
+            if batch and not drop_last:
+                out_q.put(("data", collate_fn(batch)))
+        else:
+            while True:
+                task = idx_q.get()
+                if task is None:
+                    break
+                bidx, idxs = task
+                try:
+                    out = ("batch", bidx,
+                           collate_fn([dataset[i] for i in idxs]))
+                except Exception as e:  # ship to parent, keep serving
+                    out = ("batch", bidx, _ExcInfo(e))
+                out_q.put(out)
+    except Exception as e:
+        out_q.put(("fatal", _ExcInfo(e)))
+    finally:
+        out_q.put(("done", worker_id))
 
 
 def _stack(arrays):
@@ -69,6 +122,8 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = max(2, prefetch_factor)
         self.return_list = return_list
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -100,6 +155,154 @@ class DataLoader:
             for idxs in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in idxs])
 
+    def _try_multiprocess_iter(self):
+        """Spawn worker processes; None if state can't cross processes
+        (unpicklable dataset/collate → caller falls back to threads).
+        Picklability surfaces from Process.start() itself (spawn pickles
+        the args there) — no wasteful pre-serialization of the dataset."""
+        method = os.environ.get("PADDLE_TPU_MP_START", "spawn")
+        try:
+            ctx = multiprocessing.get_context(method)
+            return self._multiprocess_iter(ctx)
+        except (TypeError, AttributeError, ValueError, ImportError,
+                OSError) as e:
+            import pickle
+            if isinstance(e, pickle.PicklingError) or "pickle" in str(e):
+                return None
+            if isinstance(e, (TypeError, AttributeError)):
+                return None  # unpicklable closures raise these from spawn
+            raise
+
+    def _multiprocess_iter(self, ctx):
+        n = self.num_workers
+        out_q = ctx.Queue()
+        idx_q = ctx.Queue() if not self._iterable_mode else None
+        procs = []
+        timeout = self.timeout if self.timeout and self.timeout > 0 else None
+        # Workers are host-side (numpy) processes and must NEVER claim the
+        # accelerator: unpickling a device-array-holding dataset initializes
+        # a jax backend in the child, and on a tunneled single-chip TPU
+        # (axon) that blocks on the device claim and deadlocks the loader.
+        # Strip the axon activation and pin the child to the CPU platform.
+        saved = {k: os.environ.get(k)
+                 for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")}
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            for wid in range(n):
+                p = ctx.Process(
+                    target=_mp_worker_loop,
+                    args=(self.dataset, self.collate_fn, idx_q, out_q, wid,
+                          n, self.worker_init_fn, self._iterable_mode,
+                          getattr(self, "batch_size", 1),
+                          getattr(self, "drop_last", False)),
+                    daemon=True)
+                p.start()
+                procs.append(p)
+        except BaseException:
+            for p in procs:  # failed mid-gang (e.g. unpicklable args)
+                if p.is_alive():
+                    p.terminate()
+            raise
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+        def shutdown():
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=1.0)
+
+        def get(block_timeout):
+            # poll in short slices so a worker that died before signaling
+            # (bad unpickle, OOM-kill) raises instead of hanging forever
+            import time as _time
+
+            deadline = (_time.monotonic() + block_timeout
+                        if block_timeout else None)
+            while True:
+                try:
+                    return out_q.get(timeout=1.0)
+                except queue.Empty:
+                    pass
+                dead = [p.pid for p in procs
+                        if not p.is_alive() and p.exitcode not in (0, None)]
+                if dead:
+                    raise RuntimeError(
+                        f"DataLoader worker(s) {dead} died unexpectedly "
+                        f"(exitcodes: "
+                        f"{[p.exitcode for p in procs]})")
+                if deadline and _time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"DataLoader worker timed out after {block_timeout}s")
+
+        if self._iterable_mode:
+            def gen():
+                done = 0
+                try:
+                    while done < n:
+                        msg = get(timeout)
+                        if msg[0] == "done":
+                            done += 1
+                        elif msg[0] == "fatal":
+                            raise RuntimeError(
+                                "DataLoader worker failed:\n" + msg[1].tb)
+                        else:
+                            yield msg[1]
+                finally:
+                    shutdown()
+            return gen()
+
+        def gen():
+            tasks = list(enumerate(self.batch_sampler))
+            n_tasks = len(tasks)
+            inflight_target = n * self.prefetch_factor
+            sent = 0
+            try:
+                for _ in range(min(inflight_target, n_tasks)):
+                    idx_q.put(tasks[sent])
+                    sent += 1
+                buffered = {}
+                next_idx = 0
+                done = 0
+                while next_idx < n_tasks:
+                    while next_idx in buffered:
+                        b = buffered.pop(next_idx)
+                        if isinstance(b, _ExcInfo):
+                            raise RuntimeError(
+                                "DataLoader worker raised:\n" + b.tb)
+                        next_idx += 1
+                        if sent < n_tasks:
+                            idx_q.put(tasks[sent])
+                            sent += 1
+                        yield b
+                    if next_idx >= n_tasks:
+                        break
+                    msg = get(timeout)
+                    if msg[0] == "batch":
+                        buffered[msg[1]] = msg[2]
+                    elif msg[0] == "fatal":
+                        raise RuntimeError(
+                            "DataLoader worker failed:\n" + msg[1].tb)
+                    elif msg[0] == "done":
+                        done += 1
+                        if done == n and next_idx < n_tasks:
+                            raise RuntimeError(
+                                "all DataLoader workers exited early")
+            finally:
+                for _ in procs:
+                    try:
+                        idx_q.put(None)
+                    except Exception:
+                        pass
+                shutdown()
+        return gen()
+
     def __iter__(self):
         def to_tensors(b):
             if isinstance(b, tuple):
@@ -116,6 +319,20 @@ class DataLoader:
             for b in self._make_batches():
                 yield to_tensors(b)
             return
+
+        # Iterable datasets keep the single-producer path: multiprocess
+        # workers would each replay the full stream (num_workers x
+        # duplication) unless the dataset shards itself; opt in with
+        # PADDLE_TPU_ITERABLE_MP=1 when it does (via get_worker_info,
+        # reference worker.py contract).
+        mp_ok = (not self._iterable_mode
+                 or os.environ.get("PADDLE_TPU_ITERABLE_MP") == "1")
+        if mp_ok and os.environ.get("PADDLE_TPU_DATALOADER_MP", "1") != "0":
+            mp_iter = self._try_multiprocess_iter()
+            if mp_iter is not None:
+                for b in mp_iter:
+                    yield to_tensors(b)
+                return
 
         # native C++ ring-buffer prefetcher if available, else thread pool.
         # Availability is decided before the first batch is pulled so a

@@ -58,7 +58,11 @@ def _swap_params(params: dict, raw_tree: dict):
 
 
 class StaticFunction:
-    def __init__(self, fn: Callable, input_spec=None, jit_kwargs=None):
+    def __init__(self, fn: Callable, input_spec=None, jit_kwargs=None,
+                 convert_control_flow: bool = True):
+        if convert_control_flow:
+            from .dy2static import convert_control_flow as _ccf
+            fn = _ccf(fn)
         self._fn = fn
         self._layer = getattr(fn, "__self__", None)
         self._input_spec = input_spec

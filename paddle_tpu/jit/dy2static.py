@@ -1,0 +1,382 @@
+"""Dynamic-to-static control-flow conversion.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:999 + convert_operators.py — the reference rewrites a
+function's AST so python ``if``/``while`` over tensors become graph ops
+(convert_ifelse/convert_while_loop). The TPU-native analog rewrites them to
+``lax.cond``/``lax.while_loop`` calls; when the predicate is a concrete
+(non-traced) value the original python control flow runs unchanged, so the
+same converted function works eagerly and under jit.
+
+Conversion contract (the "common cases" shim):
+* ``if``/``elif``/``else`` and ``while`` statements are converted when their
+  bodies contain no ``return``/``break``/``continue``/``yield`` — those fall
+  back to python control flow (fine eagerly; under jit a tensor predicate
+  will raise jax's concretization error, pointing here).
+* names assigned inside a branch/loop body are threaded through the
+  lax primitive as carried state; reads of enclosing locals happen via
+  closure. Both branches of a converted ``if`` must produce matching
+  shapes/dtypes for threaded names (lax.cond's contract).
+* conversion is source-based (inspect.getsource); functions without
+  retrievable source (REPL lambdas, C extensions) run unconverted.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+class _Undefined:
+    """Sentinel for a name not yet bound when control flow is converted."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEFINED = _Undefined()
+
+
+def pack_args(*thunks):
+    """Evaluate name thunks, mapping unbound locals to UNDEFINED."""
+    vals = []
+    for t in thunks:
+        try:
+            vals.append(t())
+        except NameError:
+            vals.append(UNDEFINED)
+    return tuple(vals)
+
+
+def _raw(x):
+    from ..tensor import Tensor
+
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    return isinstance(_raw(x), jax.core.Tracer)
+
+
+def _to_carry(vals):
+    """Tensors -> raw arrays; python scalars -> arrays (stable carry
+    dtypes); returns (raw_leaves, rewrap) where rewrap restores Tensors."""
+    from ..tensor import Tensor
+
+    is_tensor = [isinstance(v, Tensor) for v in vals]
+    raws = []
+    for v in vals:
+        r = _raw(v)
+        if isinstance(r, _Undefined):
+            r = jnp.int32(0)  # dummy; branches must assign before use
+        elif isinstance(r, (bool, int, float)):
+            r = jnp.asarray(r)
+        raws.append(r)
+
+    def rewrap(raws_out):
+        return tuple(
+            Tensor(r, stop_gradient=False) if t else r
+            for r, t in zip(raws_out, is_tensor))
+
+    return tuple(raws), rewrap
+
+
+def convert_ifelse(pred, true_fn, false_fn, vals):
+    """``if pred: ... else: ...`` with assigned names threaded via vals."""
+    from ..tensor import Tensor
+
+    p = _raw(pred)
+    if not isinstance(p, jax.core.Tracer):
+        return true_fn(*vals) if bool(p) else false_fn(*vals)
+
+    raws, rewrap = _to_carry(vals)
+    out_kinds = []  # is-Tensor per output, recorded while tracing branches
+
+    def _branch(fn):
+        def run(raw_ops):
+            outs = fn(*rewrap(raw_ops))
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            out_kinds[:] = [isinstance(o, Tensor) for o in outs]
+            return tuple(jnp.asarray(_raw(o)) for o in outs)
+        return run
+
+    out = jax.lax.cond(jnp.asarray(p, bool), _branch(true_fn),
+                       _branch(false_fn), raws)
+    return tuple(Tensor(o, stop_gradient=False) if t else o
+                 for o, t in zip(out, out_kinds))
+
+
+def convert_while(cond_fn, body_fn, vals):
+    """``while cond: body`` with assigned names threaded via vals."""
+    probe = cond_fn(*vals)
+    traced = _is_traced(probe) or any(_is_traced(v) for v in vals)
+    if not traced:
+        while bool(_raw(cond_fn(*vals))):
+            new = body_fn(*vals)
+            vals = new if isinstance(new, tuple) else (new,)
+        return vals
+
+    from ..tensor import Tensor
+
+    raws, rewrap = _to_carry(vals)
+    undef = [isinstance(_raw(v), _Undefined) for v in vals]
+    out_kinds = []
+
+    def cond(raw_ops):
+        return jnp.asarray(_raw(cond_fn(*rewrap(raw_ops))), bool)
+
+    def body(raw_ops):
+        outs = body_fn(*rewrap(raw_ops))
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        out_kinds[:] = [isinstance(o, Tensor) for o in outs]
+        return tuple(jnp.asarray(_raw(o)) for o in outs)
+
+    # Settle the carry structure: names first assigned inside the loop enter
+    # as dummies, and weak-typed scalars can promote — run the body
+    # abstractly (eval_shape) and align the init carry to its output avals
+    # (two rounds reach the fixed point for dtype promotion chains).
+    for _ in range(2):
+        out_avals = jax.eval_shape(body, raws)
+        aligned = []
+        for r, a, u in zip(raws, out_avals, undef):
+            r = jnp.asarray(r)
+            if u and (tuple(r.shape) != tuple(a.shape) or r.dtype != a.dtype):
+                aligned.append(jnp.zeros(a.shape, a.dtype))
+            elif r.dtype != a.dtype and tuple(r.shape) == tuple(a.shape):
+                aligned.append(r.astype(a.dtype))
+            else:
+                aligned.append(r)
+        raws = tuple(aligned)
+
+    out = jax.lax.while_loop(cond, body, raws)
+    if len(out_kinds) == len(out):
+        return tuple(Tensor(o, stop_gradient=False) if t else o
+                     for o, t in zip(out, out_kinds))
+    return rewrap(out)
+
+
+def convert_bool(x):
+    """Predicate coercion used by converted ``if`` tests (keeps Tensors /
+    tracers as-is; convert_ifelse decides the path)."""
+    return x
+
+
+# ---------------------------------------------------------------------------
+# AST transformation
+# ---------------------------------------------------------------------------
+
+_JST = "_pt_jst"  # module alias injected into the function's globals
+
+
+class _AssignCollector(ast.NodeVisitor):
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)  # def binds the name; don't descend
+
+    def visit_AsyncFunctionDef(self, node):
+        self.names.add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _assigned(stmts) -> set:
+    c = _AssignCollector()
+    for s in stmts:
+        c.visit(s)
+    return c.names
+
+
+class _Disallowed(ast.NodeVisitor):
+    def __init__(self):
+        self.found = False
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_Break(self, node):
+        self.found = True
+
+    def visit_Continue(self, node):
+        self.found = True
+
+    def visit_Yield(self, node):
+        self.found = True
+
+    def visit_YieldFrom(self, node):
+        self.found = True
+
+    def visit_FunctionDef(self, node):
+        pass  # nested defs own their returns
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _has_disallowed(stmts) -> bool:
+    d = _Disallowed()
+    for s in stmts:
+        d.visit(s)
+    return d.found
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _tuple_of(names, ctx=None):
+    return ast.Tuple(elts=[_name(n, ctx or ast.Load()) for n in names],
+                     ctx=ctx or ast.Load())
+
+
+def _pack_call(names):
+    # _pt_jst.pack_args((lambda: a), (lambda: b), ...)
+    lams = [ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                           kw_defaults=[], defaults=[]),
+        body=_name(n)) for n in names]
+    return ast.Call(
+        func=ast.Attribute(value=_name(_JST), attr="pack_args",
+                           ctx=ast.Load()),
+        args=lams, keywords=[])
+
+
+def _fn_def(fname, argnames, body_stmts, ret_names):
+    body = list(body_stmts)
+    body.append(ast.Return(value=_tuple_of(ret_names)))
+    return ast.FunctionDef(
+        name=fname,
+        args=ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=a) for a in argnames],
+            kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=body, decorator_list=[], returns=None)
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.n = 0
+
+    def _next(self):
+        self.n += 1
+        return self.n
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_disallowed(node.body) or _has_disallowed(node.orelse):
+            return node
+        names = sorted(_assigned(node.body) | _assigned(node.orelse))
+        i = self._next()
+        tname, fname = f"__pt_true_{i}", f"__pt_false_{i}"
+        true_def = _fn_def(tname, names, node.body, names)
+        false_def = _fn_def(fname, names, node.orelse or [ast.Pass()], names)
+        call = ast.Call(
+            func=ast.Attribute(value=_name(_JST), attr="convert_ifelse",
+                               ctx=ast.Load()),
+            args=[node.test, _name(tname), _name(fname), _pack_call(names)],
+            keywords=[])
+        if names:
+            assign = ast.Assign(targets=[_tuple_of(names, ast.Store())],
+                                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [true_def, false_def, assign]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if (_has_disallowed(node.body) or node.orelse):
+            return node
+        names = sorted(_assigned(node.body))
+        if not names:
+            return node
+        i = self._next()
+        cname, bname = f"__pt_cond_{i}", f"__pt_body_{i}"
+        cond_def = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=a) for a in names],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[ast.Return(value=node.test)],
+            decorator_list=[], returns=None)
+        body_def = _fn_def(bname, names, node.body, names)
+        call = ast.Call(
+            func=ast.Attribute(value=_name(_JST), attr="convert_while",
+                               ctx=ast.Load()),
+            args=[_name(cname), _name(bname), _pack_call(names)],
+            keywords=[])
+        assign = ast.Assign(targets=[_tuple_of(names, ast.Store())],
+                            value=call)
+        return [cond_def, body_def, assign]
+
+
+def convert_control_flow(fn: Callable) -> Callable:
+    """Return fn with tensor control flow converted; fn itself on failure."""
+    inner = fn.__func__ if inspect.ismethod(fn) else fn
+    if not inspect.isfunction(inner):
+        return fn
+    if inner.__code__.co_freevars:
+        # Closure cells can only be materialized by VALUE into the exec'd
+        # copy — a later rebinding of the closed-over variable (or zero-arg
+        # super()'s __class__ cell) would silently diverge from the
+        # original function. Skip conversion; tensor control flow inside
+        # closures falls back to static.nn.cond/while_loop.
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(inner))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    for dec in fdef.decorator_list:
+        # only the to_static decorator itself may be stripped; any other
+        # decorator would be silently dropped by re-exec — skip conversion
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        name = d.attr if isinstance(d, ast.Attribute) else getattr(d, "id",
+                                                                   "")
+        if name not in ("to_static", "not_to_static"):
+            return fn
+    fdef.decorator_list = []
+    new_tree = _ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+
+    import paddle_tpu.jit.dy2static as _self
+
+    glb = dict(inner.__globals__)
+    glb[_JST] = _self
+    try:
+        code = compile(new_tree, filename=f"<dy2static {inner.__name__}>",
+                       mode="exec")
+        exec(code, glb)
+        converted = glb[fdef.name]
+    except Exception:
+        return fn
+    functools.update_wrapper(converted, inner, updated=())
+    converted.__wrapped_original__ = inner
+    if inspect.ismethod(fn):
+        return converted.__get__(fn.__self__, type(fn.__self__))
+    return converted

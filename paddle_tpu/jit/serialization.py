@@ -46,9 +46,16 @@ def save(layer, path, input_spec=None, **configs):
 
             def fwd(*xs):
                 out = layer(*[Tensor(x) for x in xs])
-                return out._data if isinstance(out, Tensor) else out
+                return jax.tree_util.tree_map(
+                    lambda o: o._data if isinstance(o, Tensor) else o, out,
+                    is_leaf=lambda o: isinstance(o, Tensor))
             exported = jax_export.export(jax.jit(fwd))(*shapes)
             hlo = exported.serialize()
+            payload["input_names"] = [
+                (s.name if getattr(s, "name", None) else f"x{i}")
+                for i, s in enumerate(input_spec)]
+            payload["output_names"] = [
+                f"out{i}" for i in range(len(exported.out_avals))]
         except Exception as e:
             import warnings
             warnings.warn(f"jit.save: StableHLO export failed ({e}); "
@@ -68,12 +75,21 @@ class TranslatedLayer:
         self._callable = None
         self.n_inputs = None
         self.input_avals = None
+        self.output_avals = None
+        self.input_names = payload.get("input_names")
+        self.output_names = payload.get("output_names")
         if payload.get("stablehlo"):
             from jax import export as jax_export
             exported = jax_export.deserialize(payload["stablehlo"])
             self._callable = exported.call
             self.input_avals = exported.in_avals
+            self.output_avals = exported.out_avals
             self.n_inputs = len(exported.in_avals)
+            if self.input_names is None:
+                self.input_names = [f"x{i}" for i in range(self.n_inputs)]
+            if self.output_names is None:
+                self.output_names = [
+                    f"out{i}" for i in range(len(exported.out_avals))]
 
     def state_dict(self):
         return {k: Tensor(jnp.asarray(v))

@@ -48,18 +48,44 @@ def _xla_sdpa(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None,
     return jnp.swapaxes(out, 1, 2)  # [B, L, H, D]
 
 
+# Which kernel the last sdpa_raw trace chose, and why — recorded so
+# bench.py can assert/report the attention path instead of a silent
+# fallback hiding a 30x regression (round-1 verdict, weak #3).
+_last_path = {"path": None, "reason": None}
+
+
+def attention_path():
+    """("flash"|"xla", reason) selected by the most recent sdpa_raw trace."""
+    return dict(_last_path)
+
+
+def _record(path, reason):
+    _last_path["path"] = path
+    _last_path["reason"] = reason
+
+
 def sdpa_raw(q, k, v, causal=False, scale=None):
     """Raw-array causal/full attention with TPU flash routing ([B,L,H,D]).
 
     Shared by the Tensor-level functional below and pure-jnp model code
-    (e.g. the stacked pipelined Llama)."""
-    if (q.dtype in (jnp.bfloat16, jnp.float32) and q.shape[1] >= 128
-            and q.shape[-1] <= 256 and jax.default_backend() == "tpu"):
-        try:
-            from ...ops.pallas.flash_attention import flash_attention
-            return flash_attention(q, k, v, causal=causal, scale=scale)
-        except Exception:
-            pass
+    (e.g. the stacked pipelined Llama). The pallas flash kernel is used
+    whenever eligible on TPU; kernel failures propagate (no silent XLA
+    fallback). Set PADDLE_TPU_ATTENTION=xla to force the XLA composite."""
+    import os
+
+    forced = os.environ.get("PADDLE_TPU_ATTENTION", "")
+    if forced == "xla":
+        _record("xla", "forced via PADDLE_TPU_ATTENTION")
+        return _xla_sdpa(q, k, v, causal=causal, scale=scale)
+    eligible = (q.dtype in (jnp.bfloat16, jnp.float32) and q.shape[1] >= 128
+                and q.shape[1] % 128 == 0 and q.shape[-1] <= 256
+                and jax.default_backend() == "tpu")
+    if eligible or forced == "flash":
+        from ...ops.pallas.flash_attention import flash_attention
+        _record("flash", "eligible on tpu" if eligible else "forced")
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    _record("xla", f"ineligible: dtype={q.dtype} shape={q.shape} "
+                   f"backend={jax.default_backend()}")
     return _xla_sdpa(q, k, v, causal=causal, scale=scale)
 
 

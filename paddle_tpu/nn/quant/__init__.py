@@ -105,10 +105,26 @@ class Int8Linear(Layer):
         return m
 
     def forward(self, x):
-        def f(x, q, s, *b):
-            w = q.astype(x.dtype) * s.astype(x.dtype)  # fused by XLA
-            y = x @ w
-            return y + b[0].astype(x.dtype) if b else y
+        import os
+
+        mode = os.environ.get("PADDLE_TPU_INT8_MXU", "auto")
+        use_mxu = (mode == "1"
+                   or (mode == "auto"
+                       and jax.default_backend() == "tpu"
+                       and self.in_features % 128 == 0
+                       and self.in_features <= 16384))
+
+        if use_mxu:
+            from ...ops.pallas.int8_matmul import int8_linear
+
+            def f(x, q, s, *b):
+                y = int8_linear(x, q, s, jnp.dtype(x.dtype))
+                return y + b[0].astype(y.dtype) if b else y
+        else:
+            def f(x, q, s, *b):
+                w = q.astype(x.dtype) * s.astype(x.dtype)  # fused by XLA
+                y = x @ w
+                return y + b[0].astype(x.dtype) if b else y
 
         args = (x, self.qweight, self.scale) + (
             (self.bias,) if self.bias is not None else ())

@@ -36,6 +36,8 @@ class InputSpec:
         return InputSpec(tuple(self.shape[1:]), self.dtype, self.name)
 
 
+from . import nn  # noqa: F401,E402
+from .nn import case, cond, switch_case, while_loop  # noqa: F401,E402
 from .program import (  # noqa: F401,E402
     BuildStrategy, CompiledProgram, ExecutionStrategy, Executor,
     ExponentialMovingAverage, IpuCompiledProgram, IpuStrategy,

@@ -1,0 +1,67 @@
+"""paddle.static.nn control-flow ops.
+
+Reference: python/paddle/fluid/layers/control_flow.py — ``cond`` (:2445) and
+``while_loop`` (:1209) build ConditionalBlock / While ops into the Program.
+TPU-native: lax.cond / lax.while_loop when the predicate is traced, plain
+python control flow when it is concrete (eager), via jit.dy2static's runtime
+helpers.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+from ..jit import dy2static as _jst
+
+
+def cond(pred, true_fn: Callable = None, false_fn: Callable = None,
+         name=None):
+    """Run true_fn() or false_fn() depending on ``pred``.
+
+    Both callables take no arguments and must return matching structures
+    (lax.cond contract under tracing)."""
+    tf = (lambda: None) if true_fn is None else true_fn
+    ff = (lambda: None) if false_fn is None else false_fn
+    out = _jst.convert_ifelse(pred, lambda: (tf(),), lambda: (ff(),), ())
+    return out[0]
+
+
+def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
+               is_test=False, name=None):
+    """Repeat ``body(*loop_vars)`` while ``cond(*loop_vars)``.
+
+    Returns the final loop_vars list. body must return the same arity with
+    matching shapes/dtypes."""
+    if not loop_vars:
+        raise ValueError("loop_vars cannot be empty")
+    out = _jst.convert_while(
+        cond, lambda *vs: tuple(_as_tuple(body(*vs))), tuple(loop_vars))
+    return list(out)
+
+
+def _as_tuple(x):
+    if isinstance(x, (tuple, list)):
+        return tuple(x)
+    return (x,)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Reference: control_flow.case — first true pred wins."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs cannot be empty")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if not rest:
+        if default is None:
+            return cond(pred, fn, fn)
+        return cond(pred, fn, default)
+    return cond(pred, fn, lambda: case(rest, default))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Reference: control_flow.switch_case — dispatch on an int index."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    else:
+        pairs = list(enumerate(branch_fns))
+    preds = [(branch_index == i, fn) for i, fn in pairs]
+    return case(preds, default)

@@ -27,7 +27,7 @@ from .framework import dtype as dtype_mod
 
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_index",
-                 "name", "persistable", "__weakref__")
+                 "name", "persistable", "_grad_hooks", "__weakref__")
 
     def __init__(self, data, dtype=None, stop_gradient: bool = True,
                  name: Optional[str] = None):
@@ -50,6 +50,7 @@ class Tensor:
         self._out_index = 0
         self.name = name
         self.persistable = False
+        self._grad_hooks = None
 
     # -- basic properties ---------------------------------------------------
     @property
@@ -142,8 +143,21 @@ class Tensor:
         self.grad = None
 
     def register_hook(self, hook):
-        # Eager-path grad hook: wrap the node vjp. Minimal support.
-        raise NotImplementedError("register_hook is not supported yet")
+        """Register a backward hook ``hook(grad) -> Tensor | None``.
+
+        Called when this tensor's gradient is computed during ``backward()``;
+        a non-None return replaces the gradient that continues to propagate
+        (and, for leaves, what accumulates into ``.grad``). Returns a handle
+        with ``.remove()``. Reference:
+        fluid/dygraph/varbase_patch_methods.py:353 (register_hook on the
+        C++ GradNode); here the tape applies hooks to the accumulated
+        cotangent of this tensor."""
+        if self.stop_gradient:
+            raise RuntimeError(
+                "Cannot register hook on a Tensor with stop_gradient=True")
+        if self._grad_hooks is None:
+            self._grad_hooks = {}
+        return tape.HookHandle(self._grad_hooks, hook)
 
     # -- display ------------------------------------------------------------
     def __repr__(self):

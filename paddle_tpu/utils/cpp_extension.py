@@ -1,0 +1,161 @@
+"""Custom-op extension mechanism.
+
+Reference: python/paddle/utils/cpp_extension/cpp_extension.py:51
+(CppExtension/CUDAExtension + load) — users register new operators without
+touching the framework. The TPU-native split:
+
+* ``register_custom_op`` — the device path: register a python/pallas kernel
+  (with optional custom VJP) as a first-class Tensor op. This is the analog
+  of a CUDA kernel op: the kernel runs ON the accelerator (pallas/Mosaic or
+  jnp/XLA), differentiates, and jits.
+* ``load`` — the host path: compile C++ sources with the system toolchain
+  into a shared library (the reference's JIT-build flow) and expose its
+  functions; ``host_op_from_library`` wraps an exported C function as an op
+  callable inside jit via ``jax.pure_callback`` (host callback — the TPU
+  equivalent of a CPU kernel op).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, apply
+
+_REGISTRY = {}
+
+
+def register_custom_op(name: str, forward: Callable,
+                       backward: Optional[Callable] = None,
+                       n_outputs: int = 1):
+    """Register ``forward`` (raw-jax-array function) as Tensor op ``name``.
+
+    forward(*arrays) -> array(s): any jnp/lax/pallas computation.
+    backward(residuals, *cotangents) -> tuple of input grads; residuals is
+    whatever forward's companion ``forward_res`` returns — if backward is
+    given, forward must return (outputs, residuals) when called with
+    ``save_residuals=True``... simplified contract: backward receives
+    (inputs, outputs, cotangents). With no backward, jax AD differentiates
+    the forward directly.
+
+    Returns the Tensor-level callable; also available via
+    :func:`get_custom_op` and usable from layers like any built-in.
+    Reference contract: cpp_extension's custom op with grad kernel
+    (paddle/fluid/framework/custom_operator.cc registration).
+    """
+    if backward is not None:
+        @jax.custom_vjp
+        def raw(*args):
+            return forward(*args)
+
+        def fwd(*args):
+            out = forward(*args)
+            return out, (args, out)
+
+        def bwd(res, ct):
+            args, out = res
+            grads = backward(args, out, ct)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            return tuple(grads)
+
+        raw.defvjp(fwd, bwd)
+    else:
+        raw = forward
+
+    def op(*tensors, **kw):
+        return apply(raw, *tensors, n_outputs=n_outputs, **kw) \
+            if n_outputs != 1 else apply(raw, *tensors, **kw)
+
+    op.__name__ = name
+    op.raw = raw
+    _REGISTRY[name] = op
+    return op
+
+
+def get_custom_op(name: str):
+    return _REGISTRY[name]
+
+
+def list_custom_ops():
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# host path: C++ build + ctypes / pure_callback bridge
+# ---------------------------------------------------------------------------
+
+class BuildExtension:
+    """Placeholder for setuptools interop (reference BuildExtension);
+    paddle_tpu's JIT path is :func:`load`."""
+
+
+def CppExtension(sources, **kw):
+    return {"sources": list(sources), **kw}
+
+
+def CUDAExtension(sources, **kw):  # capability parity: no CUDA on TPU hosts
+    raise RuntimeError("CUDA extensions are not supported in the TPU build; "
+                       "use CppExtension (host) or register_custom_op "
+                       "(pallas device kernel)")
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_flags=(),
+         build_directory: Optional[str] = None, verbose: bool = False):
+    """Compile C++ sources into lib<name>.so and dlopen it (the reference's
+    jit-compile flow, minus nvcc). Returns the ctypes CDLL."""
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), f"paddle_tpu_ext_{name}")
+    os.makedirs(build_dir, exist_ok=True)
+    so_path = os.path.join(build_dir, f"lib{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if (not os.path.exists(so_path)
+            or os.path.getmtime(so_path) < newest_src):
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               *extra_cxx_flags, "-o", so_path, *srcs]
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(so_path)
+
+
+def host_op_from_library(lib, symbol: str, out_like: Callable,
+                         name: Optional[str] = None):
+    """Wrap C function ``symbol(float* out, const float* in, int64 n)`` as a
+    Tensor op running on host inside jit (jax.pure_callback — the TPU
+    analog of registering a CPU kernel for an op).
+
+    out_like(in_aval) -> ShapeDtypeStruct for the output.
+    """
+    cfun = getattr(lib, symbol)
+    cfun.restype = None
+    cfun.argtypes = [ctypes.POINTER(ctypes.c_float),
+                     ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+
+    def host_impl(x):
+        x = np.ascontiguousarray(np.asarray(x, dtype=np.float32))
+        out = np.empty(x.shape, dtype=np.float32)
+        cfun(out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+             x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+             ctypes.c_int64(x.size))
+        return out
+
+    def raw(x):
+        return jax.pure_callback(
+            host_impl, out_like(jax.ShapeDtypeStruct(x.shape, jnp.float32)),
+            x, vmap_method="sequential")
+
+    def op(x):
+        return apply(raw, x)
+
+    op.__name__ = name or symbol
+    if name:
+        _REGISTRY[name] = op
+    return op

@@ -1,0 +1,89 @@
+"""Sharded async checkpoint save/restore on an 8-device mesh.
+
+Reference: incubate/checkpoint + fleet checkpoint utils — the contract
+verified here: per-shard async save; restore resharded onto a (different)
+mesh sharding via template; step manager retention.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.checkpoint import (
+    CheckpointManager, load_distributed, save_distributed,
+    wait_for_checkpoints)
+from paddle_tpu.distributed.mesh import build_mesh
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("JAX_PLATFORMS", "").startswith("cpu"),
+    reason="needs the 8-device CPU mesh")
+
+
+def _state(mesh):
+    w = jax.device_put(np.arange(64, dtype=np.float32).reshape(8, 8),
+                       NamedSharding(mesh, P("dp", "tp")))
+    m = jax.device_put(np.ones((8, 8), np.float32) * 2,
+                       NamedSharding(mesh, P("sharding", None)))
+    return {"params": {"w": w}, "opt": {"w": {"moment1": m}},
+            "step": jnp.int32(7)}
+
+
+def test_sharded_roundtrip_resharded(tmp_path):
+    mesh = build_mesh(dp=2, tp=2, sharding=2)
+    state = _state(mesh)
+    path = save_distributed(state, tmp_path / "ck", async_save=False)
+
+    # restore with a DIFFERENT target sharding (resharded load)
+    tmpl = {
+        "params": {"w": jax.ShapeDtypeStruct(
+            (8, 8), jnp.float32,
+            sharding=NamedSharding(mesh, P("tp", None)))},
+        "opt": {"w": {"moment1": jax.ShapeDtypeStruct(
+            (8, 8), jnp.float32,
+            sharding=NamedSharding(mesh, P(None, "dp")))}},
+        "step": jax.ShapeDtypeStruct((), jnp.int32),
+    }
+    out = load_distributed(path, tmpl)
+    np.testing.assert_array_equal(
+        np.asarray(out["params"]["w"]),
+        np.arange(64, dtype=np.float32).reshape(8, 8))
+    np.testing.assert_array_equal(np.asarray(out["opt"]["w"]["moment1"]),
+                                  np.full((8, 8), 2.0, np.float32))
+    assert int(out["step"]) == 7
+    got = out["params"]["w"].sharding
+    assert isinstance(got, NamedSharding) and got.spec == P("tp", None)
+
+
+def test_async_save_then_wait(tmp_path):
+    mesh = build_mesh(dp=2, tp=2, sharding=2)
+    state = _state(mesh)
+    path = save_distributed(state, tmp_path / "ck_async", async_save=True)
+    wait_for_checkpoints()
+    out = load_distributed(path, _state(mesh))
+    np.testing.assert_array_equal(
+        np.asarray(out["params"]["w"]._data
+                   if hasattr(out["params"]["w"], "_data")
+                   else out["params"]["w"]),
+        np.arange(64, dtype=np.float32).reshape(8, 8))
+    # orbax wrote a real checkpoint directory with per-array metadata
+    assert os.path.isdir(path)
+
+
+def test_manager_retention_and_latest(tmp_path):
+    mesh = build_mesh(dp=2, tp=2, sharding=2)
+    mgr = CheckpointManager(tmp_path / "run", max_to_keep=2)
+    for step in (1, 2, 3):
+        st = {"w": jax.device_put(
+            np.full((4,), float(step), np.float32),
+            NamedSharding(mesh, P(None)))}
+        mgr.save(step, st, async_save=False)
+    assert mgr.latest_step() == 3
+    assert len(mgr.all_steps()) <= 2
+    step, out = mgr.restore_latest(
+        {"w": jax.ShapeDtypeStruct((4,), jnp.float32,
+                                   sharding=NamedSharding(mesh, P(None)))})
+    assert step == 3
+    np.testing.assert_array_equal(np.asarray(out["w"]), [3.0] * 4)

@@ -1,0 +1,81 @@
+"""Inference Predictor hardening (reference:
+paddle/fluid/inference/api/analysis_predictor.cc surface): named handles,
+multi-output artifacts, working reshape, stable handle identity."""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.static import InputSpec
+
+
+class TwoOut(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 3)
+
+    def forward(self, ids, mask):
+        h = self.fc(ids) * mask
+        return h, h.sum(axis=-1)
+
+
+def _save(layer, td, specs):
+    path = os.path.join(td, "m")
+    paddle.jit.save(layer, path, input_spec=specs)
+    return path
+
+
+def test_named_inputs_and_multi_output():
+    paddle.seed(0)
+    layer = TwoOut()
+    layer.eval()
+    ids = np.random.default_rng(0).normal(size=(2, 4)).astype(np.float32)
+    mask = np.ones((2, 3), dtype=np.float32)
+    r1, r2 = layer(paddle.to_tensor(ids), paddle.to_tensor(mask))
+    with tempfile.TemporaryDirectory() as td:
+        path = _save(layer, td, [InputSpec([None, 4], "float32", name="ids"),
+                                 InputSpec([None, 3], "float32",
+                                           name="mask")])
+        pred = paddle.inference.create_predictor(paddle.inference.Config(path))
+        assert pred.get_input_names() == ["ids", "mask"]
+        assert pred.get_output_names() == ["out0", "out1"]
+        pred.get_input_handle("ids").copy_from_cpu(ids)
+        pred.get_input_handle("mask").copy_from_cpu(mask)
+        h_out0 = pred.get_output_handle("out0")
+        h_out1 = pred.get_output_handle("out1")
+        pred.run()
+        np.testing.assert_allclose(h_out0.copy_to_cpu(),
+                                   np.asarray(r1._data), atol=1e-5)
+        np.testing.assert_allclose(h_out1.copy_to_cpu(),
+                                   np.asarray(r2._data), atol=1e-5)
+        # run again: SAME handle objects see the new values (stable identity)
+        pred.get_input_handle("ids").copy_from_cpu(ids * 2)
+        pred.run()
+        np.testing.assert_allclose(
+            h_out1.copy_to_cpu(),
+            np.asarray(layer(paddle.to_tensor(ids * 2),
+                             paddle.to_tensor(mask))[1]._data), atol=1e-5)
+
+
+def test_handle_reshape_and_validation():
+    paddle.seed(0)
+    layer = nn.Sequential(nn.Linear(6, 2))
+    layer.eval()
+    with tempfile.TemporaryDirectory() as td:
+        path = _save(layer, td, [InputSpec([None, 6], "float32", name="x")])
+        pred = paddle.inference.create_predictor(paddle.inference.Config(path))
+        h = pred.get_input_handle("x")
+        h.reshape([3, 6])
+        assert h.shape() == [3, 6]
+        flat = np.arange(18, dtype=np.float32)
+        h.copy_from_cpu(flat)  # reshaped to the declared [3, 6]
+        pred.run()
+        out = pred.get_output_handle("out0").copy_to_cpu()
+        assert out.shape == (3, 2)
+        try:
+            h.copy_from_cpu(np.zeros((4, 4), np.float32))
+            raise AssertionError("expected shape validation error")
+        except ValueError:
+            pass

@@ -1,0 +1,62 @@
+"""Tensor.register_hook parity (reference:
+fluid/dygraph/varbase_patch_methods.py:353 — hooks observe/replace the
+gradient of a tensor during backward)."""
+import numpy as np
+
+import paddle_tpu
+
+
+def test_hook_observes_intermediate_grad():
+    x = paddle_tpu.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 2.0
+    seen = {}
+    y.register_hook(lambda g: seen.setdefault("g", g.numpy()))
+    z = (y * y).sum()
+    z.backward()
+    # dz/dy = 2y = [4, 8, 12]
+    np.testing.assert_allclose(seen["g"], [4.0, 8.0, 12.0])
+    np.testing.assert_allclose(x.grad.numpy(), [8.0, 16.0, 24.0])
+
+
+def test_hook_replaces_grad_upstream():
+    x = paddle_tpu.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3.0
+    y.register_hook(lambda g: g * 2.0)
+    y.sum().backward()
+    # dy/dx = 3, hook doubles the cotangent at y -> grad = 6
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+
+def test_leaf_hook_modifies_accumulated_grad():
+    x = paddle_tpu.to_tensor([1.0, 2.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 10.0)
+    (x * 2.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0, 20.0])
+
+
+def test_hook_remove():
+    x = paddle_tpu.to_tensor([1.0], stop_gradient=False)
+    y = x * 2.0
+    h = y.register_hook(lambda g: g * 100.0)
+    h.remove()
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_multiple_hooks_chain():
+    x = paddle_tpu.to_tensor([1.0], stop_gradient=False)
+    y = x * 1.0
+    y.register_hook(lambda g: g + 1.0)
+    y.register_hook(lambda g: g * 2.0)
+    y.sum().backward()
+    # seed 1 -> +1 = 2 -> *2 = 4
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_hook_on_stop_gradient_raises():
+    x = paddle_tpu.to_tensor([1.0])
+    try:
+        x.register_hook(lambda g: g)
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError:
+        pass

@@ -1,0 +1,121 @@
+"""CompiledTrainStep gradient accumulation + dynamic loss scaling.
+
+Reference: fleet/meta_optimizers/gradient_merge_optimizer.py (k_steps grad
+merge) and python/paddle/amp/grad_scaler.py (found_inf step skip, dynamic
+scale update) — here both are compiled into the single pjit train step.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import nn, optimizer as optim
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle_tpu.nn.functional.relu(self.fc1(x)))
+
+
+def _loss_fn(m, x, y):
+    out = m(x)
+    return ((out - y) ** 2).mean()
+
+
+def _make(accumulate_steps=None, scaler=None, seed=0):
+    paddle_tpu.seed(seed)
+    fleet.init(is_collective=True, strategy=DistributedStrategy())
+    model = fleet.distributed_model(_MLP())
+    opt = fleet.distributed_optimizer(
+        optim.Adam(learning_rate=1e-2, parameters=model.parameters()))
+    step = opt.make_train_step(model, _loss_fn,
+                               accumulate_steps=accumulate_steps,
+                               scaler=scaler)
+    return model, step
+
+
+def test_accumulation_matches_full_batch():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 8)).astype(np.float32)
+    y = rng.standard_normal((8, 4)).astype(np.float32)
+
+    m1, s1 = _make(accumulate_steps=1)
+    l1 = s1(paddle_tpu.to_tensor(x), paddle_tpu.to_tensor(y))
+    p1 = {k: np.asarray(v._data) for k, v in m1.named_parameters()}
+
+    m4, s4 = _make(accumulate_steps=4)
+    l4 = s4(paddle_tpu.to_tensor(x), paddle_tpu.to_tensor(y))
+    p4 = {k: np.asarray(v._data) for k, v in m4.named_parameters()}
+
+    np.testing.assert_allclose(float(np.asarray(l1._data)),
+                               float(np.asarray(l4._data)), rtol=1e-5)
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p4[k], rtol=1e-5, atol=1e-6)
+
+
+def test_scaler_skips_step_on_inf():
+    from paddle_tpu.amp import GradScaler
+
+    scaler = GradScaler(init_loss_scaling=1024.0, decr_ratio=0.5,
+                        incr_every_n_steps=1000, decr_every_n_nan_or_inf=1)
+    model, step = _make(scaler=scaler)
+    before = {k: np.asarray(v._data).copy()
+              for k, v in model.named_parameters()}
+
+    x = np.full((4, 8), np.inf, dtype=np.float32)
+    y = np.zeros((4, 4), dtype=np.float32)
+    step(paddle_tpu.to_tensor(x), paddle_tpu.to_tensor(y))
+
+    assert bool(np.asarray(step.last_found_inf))
+    after = {k: np.asarray(v._data) for k, v in model.named_parameters()}
+    for k in before:
+        np.testing.assert_array_equal(before[k], after[k])
+    # scale decayed 1024 -> 512
+    assert float(np.asarray(step._scaler_state["scale"])) == 512.0
+
+
+def test_scaler_good_steps_update_and_grow():
+    from paddle_tpu.amp import GradScaler
+
+    scaler = GradScaler(init_loss_scaling=8.0, incr_ratio=2.0,
+                        incr_every_n_steps=2)
+    model, step = _make(scaler=scaler)
+    before = {k: np.asarray(v._data).copy()
+              for k, v in model.named_parameters()}
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((4, 8)).astype(np.float32)
+    y = rng.standard_normal((4, 4)).astype(np.float32)
+    step(paddle_tpu.to_tensor(x), paddle_tpu.to_tensor(y))
+    assert not bool(np.asarray(step.last_found_inf))
+    after = {k: np.asarray(v._data) for k, v in model.named_parameters()}
+    changed = any(not np.array_equal(before[k], after[k]) for k in before)
+    assert changed, "params should update on finite grads"
+    step(paddle_tpu.to_tensor(x), paddle_tpu.to_tensor(y))
+    # 2 good steps with incr_every=2 -> scale 8 -> 16
+    assert float(np.asarray(step._scaler_state["scale"])) == 16.0
+
+
+def test_scaled_update_matches_unscaled():
+    """With a finite-grad problem, scaler on/off must give identical params
+    (the scale cancels exactly in fp32)."""
+    from paddle_tpu.amp import GradScaler
+
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((4, 8)).astype(np.float32)
+    y = rng.standard_normal((4, 4)).astype(np.float32)
+
+    m1, s1 = _make()
+    s1(paddle_tpu.to_tensor(x), paddle_tpu.to_tensor(y))
+    p1 = {k: np.asarray(v._data) for k, v in m1.named_parameters()}
+
+    m2, s2 = _make(scaler=GradScaler(init_loss_scaling=256.0))
+    s2(paddle_tpu.to_tensor(x), paddle_tpu.to_tensor(y))
+    p2 = {k: np.asarray(v._data) for k, v in m2.named_parameters()}
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p2[k], rtol=1e-5, atol=1e-6)
