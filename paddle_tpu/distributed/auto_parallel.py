@@ -1,5 +1,6 @@
 """auto_parallel marker API (reference: python/paddle/distributed/
-auto_parallel/interface.py shard_tensor/shard_op).
+auto_parallel/interface.py shard_tensor/shard_op) + the planning Engine
+(engine.py analog, in .auto_engine).
 
 On TPU these become real placements: shard_tensor device_puts with a
 NamedSharding over the global mesh so downstream jit computations start
@@ -12,6 +13,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ..tensor import Tensor
 from . import mesh as mesh_mod
+from .auto_engine import Engine, Plan  # noqa: F401 (engine.py analog)
 
 
 def shard_tensor(x, process_mesh=None, shard_spec=None, dist_attr=None):

@@ -1,0 +1,71 @@
+"""Compiled 1F1B pipeline train step.
+
+Reference: fleet/meta_parallel/pipeline_parallel.py — PipelineParallel
+.train_batch runs forward_backward_pipeline (1F1B) then the optimizer
+update. TPU-native: ONE jit program — embedding vjp outside the ring,
+ops.pipeline.pipeline_1f1b (fused fwd+bwd schedule, O(P) activation
+memory) over the decoder stack with final-norm/head/loss inside the last
+stage, then the functional optimizer update on donated buffers.
+
+Model contract: ``model.pipeline_parts()`` returning
+(embed_params, stacked_params, last_params, embed_fn, stage_fn, last_fn) —
+see text/models/llama_pipe.LlamaForCausalLMPipe.pipeline_parts.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .train_step import CompiledTrainStep
+
+
+class Compiled1F1BTrainStep(CompiledTrainStep):
+    """CompiledTrainStep whose gradients come from the 1F1B schedule
+    instead of whole-program AD (which would GPipe-shape the backward and
+    hold O(n_micro) activations)."""
+
+    def __init__(self, model, optimizer, n_micro=None, strategy=None,
+                 donate=True):
+        self.n_micro = n_micro
+        (self._embed_p, self._stacked_p, self._last_p, self._embed_fn,
+         self._stage_fn, self._last_fn) = model.pipeline_parts()
+        super().__init__(model, optimizer,
+                         loss_fn=lambda m, i, l: (_ for _ in ()).throw(
+                             RuntimeError("1F1B step owns the loss")),
+                         strategy=strategy, donate=donate)
+
+    def _step(self, param_vals, opt_state, buffer_vals, scaler_state, batch,
+              key, lr):
+        from ...ops.pipeline import pipeline_1f1b
+
+        from ...tensor import Tensor
+
+        ids, labels = (b._data if isinstance(b, Tensor) else b
+                       for b in batch)
+        embed_vals = {k: param_vals[k] for k in self._embed_p}
+        stacked_vals = {k: param_vals[k] for k in self._stacked_p}
+        last_vals = {k: param_vals[k] for k in self._last_p}
+
+        x, embed_vjp = jax.vjp(
+            lambda ev: self._embed_fn(ev, ids), embed_vals)
+
+        loss, g_stack, g_last, dx = pipeline_1f1b(
+            self._stage_fn, self._last_fn, stacked_vals, x, labels,
+            last_params=last_vals, mesh=self._mesh, n_micro=self.n_micro)
+        (g_embed,) = embed_vjp(dx.astype(x.dtype))
+
+        grads = {}
+        for src in (g_stack, g_last, g_embed):
+            for k, g in src.items():
+                grads[k] = g.astype(param_vals[k].dtype)
+
+        new_params, new_opt = self.optimizer.apply_gradients_functional(
+            param_vals, grads, opt_state, lr)
+        return (loss, new_params, new_opt, buffer_vals, scaler_state,
+                jnp.asarray(False))
+
+
+def make_1f1b_train_step(model, optimizer, n_micro=None,
+                         strategy=None) -> Compiled1F1BTrainStep:
+    return Compiled1F1BTrainStep(model, optimizer, n_micro=n_micro,
+                                 strategy=strategy)

@@ -66,10 +66,12 @@ def _block_visible(iq, ik, block_q, block_k, causal, offset):
 def _recompute_p_ds(q, k, v, do, lse, delta, mask, scale):
     """Shared backward-block math: p from saved lse, then ds.
 
-    Returns (p, ds) with ds already carrying the score scale.
+    Operands stay in their storage dtype (bf16) so the dots run in the
+    MXU's native mode; accumulation and softmax math are fp32. Returns
+    (p, ds) with ds already carrying the score scale.
     """
-    s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
     p = jnp.where(mask, jnp.exp(s - lse), 0.0)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -98,11 +100,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(_block_visible(iq, ik, block_q, block_k, causal, offset))
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32) * scale
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
+        # bf16 operands straight into the MXU; fp32 accumulation only
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
 
         mask = _block_mask(iq, ik, block_q, block_k, causal, kv_len, offset)
         s = jnp.where(mask, s, _MASK_VALUE)
@@ -115,7 +118,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         p = jnp.where(mask, p, 0.0)
         l_next = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_next, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_next, l_scr.shape)
 
@@ -195,14 +198,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(_block_visible(iq, ik, block_q, block_k, causal, offset))
     def _compute():
-        k = k_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0]
         mask = _block_mask(iq, ik, block_q, block_k, causal, kv_len, offset)
         _, ds = _recompute_p_ds(
-            q_ref[0, 0].astype(jnp.float32), k,
-            v_ref[0, 0].astype(jnp.float32),
-            do_ref[0, 0].astype(jnp.float32),
+            q_ref[0, 0], k, v_ref[0, 0], do_ref[0, 0],
             lse_ref[0, 0][:, :1], delta_ref[0, 0][:, :1], mask, scale)
-        acc_scr[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        acc_scr[:] += jnp.dot(ds.astype(k.dtype), k,
+                              preferred_element_type=jnp.float32)
 
     @pl.when(ik == num_kv - 1)
     def _finalize():
@@ -222,18 +224,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(_block_visible(iq, ik, block_q, block_k, causal, offset))
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
         mask = _block_mask(iq, ik, block_q, block_k, causal, kv_len, offset)
         p, ds = _recompute_p_ds(
-            q, k_ref[0, 0].astype(jnp.float32),
-            v_ref[0, 0].astype(jnp.float32), do,
+            q, k_ref[0, 0], v_ref[0, 0], do,
             lse_ref[0, 0][:, :1], delta_ref[0, 0][:, :1], mask, scale)
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(iq == num_q - 1)
@@ -367,13 +368,21 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
 _flash_bhld.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=False):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, interpret=False):
     """Flash attention on paddle layout [batch, seq, heads, head_dim].
 
     GQA supported when q heads are a multiple of kv heads. Returns the same
     layout/dtype as q. Differentiable (custom flash backward kernels).
+    Block sizes default to 256x512 (VMEM-sized for D<=256 on v5e+) and can
+    be pinned via PADDLE_TPU_FLASH_BLOCK_Q / PADDLE_TPU_FLASH_BLOCK_K.
     """
+    import os
+
+    if block_q is None:
+        block_q = int(os.environ.get("PADDLE_TPU_FLASH_BLOCK_Q", 256))
+    if block_k is None:
+        block_k = int(os.environ.get("PADDLE_TPU_FLASH_BLOCK_K", 512))
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     qh = jnp.swapaxes(q, 1, 2)

@@ -27,6 +27,15 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 
+def _pvary(x, axis_name):
+    """Mark x device-varying over axis_name (pcast on jax>=0.9, pvary
+    before the rename)."""
+    try:
+        return jax.lax.pcast(x, axis_name, to="varying")
+    except (AttributeError, TypeError):
+        return jax.lax.pvary(x, axis_name)
+
+
 def _shift_right(x, axis_name, n):
     """Send stage p's activation to stage p+1 (non-circular: stage 0
     receives zeros, last stage's output falls off)."""
@@ -43,9 +52,9 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name, n_stages,
     mb_shape = microbatches.shape[1:]
     # pvary: loop state is device-varying from the start so scan/where keep
     # consistent varying-manual-axes types under check_vma
-    state = jax.lax.pvary(jnp.zeros(mb_shape, microbatches.dtype), axis_name)
-    outputs = jax.lax.pvary(jnp.zeros(microbatches.shape, microbatches.dtype),
-                            axis_name)
+    state = _pvary(jnp.zeros(mb_shape, microbatches.dtype), axis_name)
+    outputs = _pvary(jnp.zeros(microbatches.shape, microbatches.dtype),
+                     axis_name)
 
     def tick(carry, t):
         state, outputs = carry
@@ -131,8 +140,8 @@ def _shift_left(x, axis_name, n):
                             perm=[(i, i - 1) for i in range(1, n)])
 
 
-def _pipeline_1f1b_local(stage_params, micro_x, micro_tgt, stage_fn, last_fn,
-                         axis_name, n_stages, n_micro):
+def _pipeline_1f1b_local(stage_params, last_params, micro_x, micro_tgt,
+                         stage_fn, last_fn, axis_name, n_stages, n_micro):
     """Per-device 1F1B loop (reference schedule:
     fleet/meta_parallel/pipeline_parallel.py:82 forward_backward_pipeline).
 
@@ -154,7 +163,7 @@ def _pipeline_1f1b_local(stage_params, micro_x, micro_tgt, stage_fn, last_fn,
     S = 2 * P_  # rotating input-buffer slots
 
     def pv(x):
-        return jax.lax.pvary(x, axis_name)
+        return _pvary(x, axis_name)
 
     state_y = pv(jnp.zeros(mb_shape, dt))          # activation moving right
     state_ct = pv(jnp.zeros(mb_shape, dt))         # cotangent moving left
@@ -162,13 +171,15 @@ def _pipeline_1f1b_local(stage_params, micro_x, micro_tgt, stage_fn, last_fn,
     dx_out = pv(jnp.zeros((M,) + mb_shape, dt))    # d loss / d micro_x
     grad_acc = jax.tree_util.tree_map(
         lambda l: pv(jnp.zeros(l.shape, jnp.float32)), stage_params)
+    last_grad_acc = jax.tree_util.tree_map(
+        lambda l: pv(jnp.zeros(jnp.shape(l), jnp.float32)), last_params)
     loss_acc = pv(jnp.float32(0.0))
 
     is_first = p == 0
     is_last = p == P_ - 1
     seed = jnp.float32(1.0 / M)  # d(mean over microbatches)/d(mb loss)
 
-    def comb(chunk, x, tgt):
+    def comb(chunk, lastp, x, tgt):
         y = stage_fn(chunk, x)
         # Non-last stages evaluate last_fn at zeros: its value/partials are
         # masked there anyway, and real intermediate activations could
@@ -176,10 +187,11 @@ def _pipeline_1f1b_local(stage_params, micro_x, micro_tgt, stage_fn, last_fn,
         # 0*inf=NaN-poison grad_acc through the masked vjp. The `where`
         # also cuts the y-cotangent path on non-last stages exactly.
         y_loss = jnp.where(is_last, y, jnp.zeros_like(y))
-        return last_fn(y_loss, tgt), y
+        return last_fn(lastp, y_loss, tgt), y
 
     def tick(carry, t):
-        state_y, state_ct, buf, dx_out, grad_acc, loss_acc = carry
+        (state_y, state_ct, buf, dx_out, grad_acc, last_grad_acc,
+         loss_acc) = carry
         f = t - p                    # fwd microbatch index at this device
         b = t - 2 * P_ + 2 + p       # bwd microbatch index at this device
         f_ok = jnp.logical_and(f >= 0, f < M)
@@ -192,7 +204,7 @@ def _pipeline_1f1b_local(stage_params, micro_x, micro_tgt, stage_fn, last_fn,
                          jax.lax.dynamic_index_in_dim(micro_x, fc, 0, False),
                          state_y)
         tgt_f = jax.lax.dynamic_index_in_dim(micro_tgt, fc, 0, False)
-        loss_f, y_f = comb(stage_params, x_in, tgt_f)
+        loss_f, y_f = comb(stage_params, last_params, x_in, tgt_f)
         loss_acc = loss_acc + jnp.where(
             jnp.logical_and(is_last, f_ok),
             loss_f.astype(jnp.float32) / M, 0.0)
@@ -209,15 +221,17 @@ def _pipeline_1f1b_local(stage_params, micro_x, micro_tgt, stage_fn, last_fn,
             is_last, x_in,
             jax.lax.dynamic_index_in_dim(buf, jnp.mod(bc, S), 0, False))
         tgt_b = jax.lax.dynamic_index_in_dim(micro_tgt, bc, 0, False)
-        _, vjp = jax.vjp(lambda c, x: comb(c, x, tgt_b), stage_params,
-                         x_saved)
+        _, vjp = jax.vjp(lambda c, lp, x: comb(c, lp, x, tgt_b),
+                         stage_params, last_params, x_saved)
         bmask = b_ok.astype(jnp.float32)
         ct_loss = jnp.where(is_last, seed, 0.0) * bmask
         ct_y = jnp.where(is_last, jnp.zeros_like(state_ct),
                          state_ct) * bmask.astype(dt)
-        g_chunk, g_x = vjp((ct_loss, ct_y))
+        g_chunk, g_last, g_x = vjp((ct_loss, ct_y))
         grad_acc = jax.tree_util.tree_map(
             lambda a, g: a + g.astype(jnp.float32), grad_acc, g_chunk)
+        last_grad_acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32), last_grad_acc, g_last)
         dx_out = jax.lax.dynamic_update_index_in_dim(
             dx_out,
             jnp.where(jnp.logical_and(is_first, b_ok), g_x.astype(dt),
@@ -227,23 +241,29 @@ def _pipeline_1f1b_local(stage_params, micro_x, micro_tgt, stage_fn, last_fn,
         # ---- boundary transfers ----
         state_y = _shift_right(y_f.astype(dt), axis_name, P_)
         state_ct = _shift_left(g_x.astype(dt), axis_name, P_)
-        return (state_y, state_ct, buf, dx_out, grad_acc, loss_acc), None
+        return (state_y, state_ct, buf, dx_out, grad_acc, last_grad_acc,
+                loss_acc), None
 
     n_ticks = M + 2 * P_ - 2
-    carry = (state_y, state_ct, buf, dx_out, grad_acc, loss_acc)
+    carry = (state_y, state_ct, buf, dx_out, grad_acc, last_grad_acc,
+             loss_acc)
     carry, _ = jax.lax.scan(tick, carry, jnp.arange(n_ticks))
-    _, _, _, dx_out, grad_acc, loss_acc = carry
+    _, _, _, dx_out, grad_acc, last_grad_acc, loss_acc = carry
 
-    # loss lives on the last stage, dx on the first: replicate both
+    # loss and head grads live on the last stage, dx on the first:
+    # replicate via psum
     loss = jax.lax.psum(jnp.where(is_last, loss_acc, 0.0), axis_name)
+    last_grads = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(jnp.where(is_last, g, jnp.zeros_like(g)),
+                               axis_name), last_grad_acc)
     dx = jax.lax.psum(jnp.where(is_first, dx_out, jnp.zeros_like(dx_out)),
                       axis_name)
-    return loss, grad_acc, dx
+    return loss, grad_acc, last_grads, dx
 
 
 def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stacked_params, x,
-                  targets, *, mesh=None, axis_name: str = "pp",
-                  n_micro: int | None = None):
+                  targets, *, last_params=None, mesh=None,
+                  axis_name: str = "pp", n_micro: int | None = None):
     """Fused forward+backward 1F1B pipeline over the "pp" mesh axis.
 
     Unlike :func:`spmd_pipeline` (forward-only; AD produces a GPipe-shaped
@@ -255,14 +275,18 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stacked_params, x,
     microbatches.
 
     stage_fn(local_params, x) -> y applies one stage.
-    last_fn(y, tgt) -> scalar per-microbatch loss, applied after the final
-    stage (e.g. lm-head + cross entropy).
-    Returns (loss, param_grads, dx): mean microbatch loss, grads for
-    stacked_params (same structure, fp32), and d loss/d x.
+    last_fn(last_params, y, tgt) -> scalar per-microbatch loss, applied
+    after the final stage (e.g. final norm + lm-head + cross entropy);
+    ``last_params`` (replicated pytree, may be empty) gets grads too.
+    Returns (loss, param_grads, last_param_grads, dx).
     """
     if mesh is None:
         from ..distributed.mesh import get_mesh
         mesh = get_mesh()
+    if last_params is None:
+        last_params = {}
+        user_last_fn = last_fn
+        last_fn = lambda lp, y, tgt: user_last_fn(y, tgt)  # noqa: E731
     n_stages = mesh.shape[axis_name]
     n_micro = n_micro or max(n_stages, 1)
     b = x.shape[0]
@@ -273,16 +297,18 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stacked_params, x,
 
     param_specs = jax.tree_util.tree_map(
         lambda l: P(axis_name, *([None] * (l.ndim - 1))), stacked_params)
+    last_specs = jax.tree_util.tree_map(lambda l: P(), last_params)
     manual = frozenset({axis_name})
     fn = shard_map(
         functools.partial(_pipeline_1f1b_local, stage_fn=stage_fn,
                           last_fn=last_fn, axis_name=axis_name,
                           n_stages=n_stages, n_micro=n_micro),
         mesh=mesh,
-        in_specs=(param_specs, P(), P()),
-        out_specs=(P(), param_specs, P()),
+        in_specs=(param_specs, last_specs, P(), P()),
+        out_specs=(P(), param_specs, last_specs, P()),
         axis_names=manual,
         check_vma=frozenset(mesh.axis_names) != manual,
     )
-    loss, grads, dx = fn(stacked_params, micro_x, micro_t)
-    return loss, grads, dx.reshape(b, *dx.shape[2:])
+    loss, grads, last_grads, dx = fn(stacked_params, last_params, micro_x,
+                                     micro_t)
+    return loss, grads, last_grads, dx.reshape(b, *dx.shape[2:])

@@ -120,6 +120,45 @@ class LlamaForCausalLMPipe(Layer):
                 "wg": self.wg, "wu": self.wu, "wd": self.wd,
                 "ln1": self.ln1, "ln2": self.ln2}
 
+    def pipeline_parts(self):
+        """Decomposition consumed by the fleet 1F1B train step
+        (reference PipelineLayer's stage partition,
+        fleet/meta_parallel/pp_layers.py): name-keyed param groups plus
+        pure functions (embed_fn, stage_fn, last_fn) over raw arrays.
+        last_fn fuses final-norm + lm-head + shifted CE into the last
+        stage so its backward starts inside the pipeline (true 1F1B)."""
+        import functools
+
+        if self.tie:
+            raise NotImplementedError(
+                "1F1B train step requires untied embeddings (the tied head "
+                "weight would need grads from two pipeline roles)")
+        c = self.config
+        embed = {"embed_tokens.weight": self.embed_tokens.weight}
+        stacked = {k: p for k, p in self._stacked().items()}
+        last = {"norm.weight": self.norm.weight,
+                "lm_head.weight": self.lm_head.weight}
+
+        def embed_fn(ev, ids):
+            return jnp.take(ev["embed_tokens.weight"], ids, axis=0)
+
+        stage_fn = functools.partial(
+            _decoder_chunk, n_heads=c.num_attention_heads,
+            n_kv=c.num_key_value_heads, eps=c.rms_norm_eps,
+            theta=c.rope_theta, remat=False)
+
+        def last_fn(lp, y, labels):
+            h = _rms(y, lp["norm.weight"], c.rms_norm_eps)
+            logits = h @ lp["lm_head.weight"]
+            logits = logits[:, :-1].reshape(-1, c.vocab_size)
+            logits = logits.astype(jnp.float32)
+            tgt = labels[:, 1:].reshape(-1)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.mean(
+                jnp.take_along_axis(logp, tgt[:, None], axis=1))
+
+        return embed, stacked, last, embed_fn, stage_fn, last_fn
+
     def forward(self, input_ids, labels=None):
         c = self.config
         x = self.embed_tokens(input_ids)

@@ -59,7 +59,7 @@ def test_1f1b_matches_sequential(n_micro, batch):
     x = jax.random.normal(jax.random.PRNGKey(1), (batch, H), jnp.float32)
     tgt = jax.random.normal(jax.random.PRNGKey(2), (batch, H), jnp.float32)
 
-    loss, grads, dx = jax.jit(functools.partial(
+    loss, grads, _, dx = jax.jit(functools.partial(
         pipeline_1f1b, _stage_fn, _last_fn, mesh=mesh,
         n_micro=n_micro))(params, x, tgt)
 
