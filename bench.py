@@ -248,6 +248,40 @@ def bench_ernie_moe(backend):
             "batch": batch, "seqlen": seqlen}
 
 
+def bench_int8_matmul(backend):
+    """Weight-only int8 MXU matmul vs bf16 at a memory-bound shape
+    (small M, large KxN: weight HBM traffic dominates, int8 halves it)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.nn.quant import quantize_int8
+    from paddle_tpu.ops.pallas.int8_matmul import int8_linear
+
+    if backend != "tpu":
+        return {"skipped": "tpu only"}
+    rng = np.random.default_rng(0)
+    M, K, N = 256, 8192, 8192
+    x = jnp.asarray(rng.standard_normal((M, K)), dtype=jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((K, N)) * 0.02, dtype=jnp.bfloat16)
+    wq, ws = quantize_int8(w, axis=0)
+
+    f_bf16 = jax.jit(lambda x, w: x @ w)
+    f_int8 = jax.jit(lambda x, wq, ws: int8_linear(x, wq, ws, jnp.bfloat16))
+
+    def timed(f, *a, n=30):
+        out = f(*a)
+        _sync(out)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = f(*a)
+        _sync(out)
+        return (time.perf_counter() - t0) / n * 1e3
+
+    t_bf16 = timed(f_bf16, x, w)
+    t_int8 = timed(f_int8, x, wq, ws)
+    return {"bf16_ms": round(t_bf16, 3), "int8_ms": round(t_int8, 3),
+            "speedup": round(t_bf16 / t_int8, 2), "shape": [M, K, N]}
+
+
 def _best_previous():
     best = 0.0
     for f in glob.glob(os.path.join(os.path.dirname(__file__) or ".",
@@ -274,7 +308,8 @@ def main():
         for name, fn in (("resnet50", bench_resnet50),
                          ("bert_base_dp", bench_bert),
                          ("vit_b16", bench_vit),
-                         ("ernie_moe_ep", bench_ernie_moe)):
+                         ("ernie_moe_ep", bench_ernie_moe),
+                         ("int8_matmul", bench_int8_matmul)):
             try:
                 secondary[name] = fn(backend)
             except Exception as e:
