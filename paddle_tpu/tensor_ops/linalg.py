@@ -178,3 +178,48 @@ def householder_product(x, tau, name=None):
             q = q - t[i] * (q @ jnp.outer(v, v))
         return q[:, :n]
     return apply(f, x, tau)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack lu()'s packed factors into (P, L, U).
+
+    Reference: python/paddle/tensor/linalg.py lu_unpack. y is 1-indexed
+    sequential transposition pivots (lu_factor convention)."""
+    lu_ = raw(x)
+    piv = raw(y) - 1
+    m, n = lu_.shape[-2], lu_.shape[-1]
+    k = min(m, n)
+    L = U = P = None
+    if unpack_ludata:
+        L = jnp.tril(lu_[..., :, :k], k=-1) + jnp.eye(m, k, dtype=lu_.dtype)
+        U = jnp.triu(lu_[..., :k, :])
+    if unpack_pivots:
+        perm = jnp.arange(m)
+        for i in range(piv.shape[-1]):
+            j = piv[..., i]
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj).at[j].set(pi)
+        P = jnp.eye(m, dtype=lu_.dtype)[perm].T
+    return (Tensor(P) if P is not None else None,
+            Tensor(L) if L is not None else None,
+            Tensor(U) if U is not None else None)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Low-rank PCA: returns (U, S, V) with x ~= U diag(S) V^T.
+
+    Reference: python/paddle/tensor/linalg.py pca_lowrank (randomized
+    algorithm); computed exactly via SVD here — same contract, and XLA's
+    batched SVD is fast at the sizes the API targets."""
+    def f(a):
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+        rank = q or min(a.shape[-2], a.shape[-1])
+        return u[..., :rank], s[..., :rank], jnp.swapaxes(
+            vh, -1, -2)[..., :rank]
+    return apply(f, x, n_outputs=3)
+
+
+# paddle.linalg re-exports of stat ops (reference linalg.py:18-19)
+from .stat import corrcoef, cov  # noqa: E402,F401

@@ -1,0 +1,200 @@
+"""Autoregressive generation with a static KV cache.
+
+Reference pairing: PaddleNLP's GenerationMixin (model.generate: greedy /
+sampling with top-k/top-p, eos early-exit) driving the reference's
+incremental decode. TPU-native design: ONE jitted program — prefill runs
+the model's normal forward over the prompt, then `lax.scan` decodes
+max_new_tokens steps against a PREALLOCATED [layers, B, total_len, kv, hd]
+cache (static shapes: no per-step recompilation, no concat growth), with
+sampling and eos masking inside the scan.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .models.llama import _rope
+
+
+def _stacked_weights(model):
+    """Stack per-layer decoder weights of a LlamaForCausalLM into
+    [L, ...] arrays (host-side, once per generate call)."""
+    layers = model.llama.layers
+    def st(get):
+        return jnp.stack([get(l) for l in layers])
+    w = {
+        "wq": st(lambda l: l.self_attn.q_proj.weight._data),
+        "wk": st(lambda l: l.self_attn.k_proj.weight._data),
+        "wv": st(lambda l: l.self_attn.v_proj.weight._data),
+        "wo": st(lambda l: l.self_attn.o_proj.weight._data),
+        "wg": st(lambda l: l.mlp.gate_proj.weight._data),
+        "wu": st(lambda l: l.mlp.up_proj.weight._data),
+        "wd": st(lambda l: l.mlp.down_proj.weight._data),
+        "ln1": st(lambda l: l.input_layernorm.weight._data),
+        "ln2": st(lambda l: l.post_attention_layernorm.weight._data),
+    }
+    w["embed"] = model.llama.embed_tokens.weight._data
+    w["norm"] = model.llama.norm.weight._data
+    w["head"] = (model.llama.embed_tokens.weight._data.T if model.tie
+                 else model.lm_head.weight._data)
+    return w
+
+
+def _rms(x, w, eps):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_heads", "n_kv", "eps", "theta", "max_new", "do_sample", "top_k",
+    "eos_id"))
+def _generate_jit(w, input_ids, prompt_len_mask, key, *, n_heads, n_kv, eps,
+                  theta, max_new, do_sample, top_k, eos_id, temperature):
+    """input_ids: [B, L0] right-padded prompt; prompt_len_mask [B, L0]
+    (1 = real token). Returns [B, L0 + max_new]."""
+    B, L0 = input_ids.shape
+    h = w["embed"].shape[1]
+    hd = h // n_heads
+    T = L0 + max_new
+    nL = w["wq"].shape[0]
+    dt = w["embed"].dtype
+
+    # ---- prefill: full causal pass over the (padded) prompt ----
+    x = jnp.take(w["embed"], input_ids, axis=0)
+    pos = jnp.arange(L0)
+    kcache = jnp.zeros((nL, B, T, n_kv, hd), dt)
+    vcache = jnp.zeros((nL, B, T, n_kv, hd), dt)
+
+    def one_prefill(x, lw):
+        h1 = _rms(x, lw["ln1"], eps)
+        q = (h1 @ lw["wq"]).reshape(B, L0, n_heads, hd)
+        k = (h1 @ lw["wk"]).reshape(B, L0, n_kv, hd)
+        v = (h1 @ lw["wv"]).reshape(B, L0, n_kv, hd)
+        q, k = _rope(q, k, pos, theta, dt)
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.repeat(jnp.swapaxes(k, 1, 2), n_heads // n_kv, axis=1)
+        vh = jnp.repeat(jnp.swapaxes(v, 1, 2), n_heads // n_kv, axis=1)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                       preferred_element_type=jnp.float32) / jnp.sqrt(
+                           jnp.float32(hd))
+        cm = jnp.tril(jnp.ones((L0, L0), bool))
+        s = jnp.where(cm, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(dt)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+        o = jnp.swapaxes(o, 1, 2).reshape(B, L0, h)
+        x = x + o @ lw["wo"]
+        h2 = _rms(x, lw["ln2"], eps)
+        x = x + (jax.nn.silu(h2 @ lw["wg"]) * (h2 @ lw["wu"])) @ lw["wd"]
+        return x, (k, v)
+
+    stack = {k: w[k] for k in
+             ("wq", "wk", "wv", "wo", "wg", "wu", "wd", "ln1", "ln2")}
+    x, kvs = jax.lax.scan(lambda c, lw: one_prefill(c, lw), x, stack)
+    kcache = kcache.at[:, :, :L0].set(kvs[0])
+    vcache = vcache.at[:, :, :L0].set(kvs[1])
+
+    # last real token index per row
+    last_idx = jnp.sum(prompt_len_mask, axis=1).astype(jnp.int32) - 1
+    hidden = _rms(x, w["norm"], eps)
+    logits0 = jnp.take_along_axis(
+        hidden, last_idx[:, None, None].repeat(h, 2), axis=1)[:, 0] @ w["head"]
+
+    def sample(logits, key):
+        logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+        if not do_sample:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if top_k:
+            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    key, sk = jax.random.split(key)
+    tok0 = sample(logits0, sk)
+
+    out = jnp.zeros((B, max_new), jnp.int32)
+    out = out.at[:, 0].set(tok0)
+    done0 = (tok0 == eos_id) if eos_id is not None else jnp.zeros(
+        (B,), bool)
+
+    def decode_step(carry, i):
+        tok, cur_pos, kcache, vcache, key, done = carry
+        xt = jnp.take(w["embed"], tok, axis=0)[:, None]          # [B,1,h]
+
+        def one(cx, lw_kv):
+            xt, kc_l, vc_l = cx["x"], lw_kv["kc"], lw_kv["vc"]
+            lw = lw_kv
+            h1 = _rms(xt, lw["ln1"], eps)
+            q = (h1 @ lw["wq"]).reshape(B, 1, n_heads, hd)
+            k = (h1 @ lw["wk"]).reshape(B, 1, n_kv, hd)
+            v = (h1 @ lw["wv"]).reshape(B, 1, n_kv, hd)
+            q, k = _rope(q, k, cur_pos[None], theta, dt)
+            kc_l = jax.lax.dynamic_update_slice(
+                kc_l, k, (0, cur_pos, 0, 0))
+            vc_l = jax.lax.dynamic_update_slice(
+                vc_l, v, (0, cur_pos, 0, 0))
+            qh = q[:, 0]                                         # [B,H,hd]
+            kh = jnp.repeat(kc_l, n_heads // n_kv, axis=2)       # [B,T,H,hd]
+            vh = jnp.repeat(vc_l, n_heads // n_kv, axis=2)
+            s = jnp.einsum("bhd,bthd->bht", qh, kh,
+                           preferred_element_type=jnp.float32) / jnp.sqrt(
+                               jnp.float32(hd))
+            valid = jnp.arange(T) <= cur_pos
+            s = jnp.where(valid[None, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(dt)
+            o = jnp.einsum("bht,bthd->bhd", p, vh).reshape(B, 1, h)
+            xt2 = xt + o @ lw["wo"]
+            h2 = _rms(xt2, lw["ln2"], eps)
+            xt2 = xt2 + (jax.nn.silu(h2 @ lw["wg"])
+                         * (h2 @ lw["wu"])) @ lw["wd"]
+            return {"x": xt2}, (kc_l, vc_l)
+
+        lw_kv = dict(stack)
+        lw_kv["kc"] = kcache
+        lw_kv["vc"] = vcache
+        cx, (kcache, vcache) = jax.lax.scan(one, {"x": xt}, lw_kv)
+        hidden = _rms(cx["x"][:, 0], w["norm"], eps)
+        logits = hidden @ w["head"]
+        key, sk = jax.random.split(key)
+        nxt = sample(logits, sk)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = jnp.logical_or(done, nxt == eos_id)
+        return (nxt, cur_pos + 1, kcache, vcache, key, done), nxt
+
+    if max_new > 1:
+        carry = (tok0, jnp.int32(L0), kcache, vcache, key, done0)
+        _, toks = jax.lax.scan(decode_step, carry,
+                               jnp.arange(1, max_new))
+        out = out.at[:, 1:].set(jnp.swapaxes(toks, 0, 1))
+    return jnp.concatenate([input_ids, out], axis=1)
+
+
+def generate(model, input_ids, max_new_tokens: int = 32,
+             do_sample: bool = False, top_k: int = 0,
+             temperature: float = 1.0,
+             eos_token_id: Optional[int] = None, seed: int = 0):
+    """Greedy / top-k sampled generation for LlamaForCausalLM.
+
+    input_ids: Tensor [B, L0] (no padding between rows' real tokens
+    required; right padding is allowed with identical lengths semantics).
+    Returns Tensor [B, L0 + max_new_tokens].
+    """
+    c = model.config
+    ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(
+        input_ids)
+    ids = ids.astype(jnp.int32)
+    mask = jnp.ones_like(ids)
+    w = _stacked_weights(model)
+    key = jax.random.PRNGKey(seed)
+    out = _generate_jit(
+        w, ids, mask, key, n_heads=c.num_attention_heads,
+        n_kv=c.num_key_value_heads, eps=c.rms_norm_eps, theta=c.rope_theta,
+        max_new=int(max_new_tokens), do_sample=bool(do_sample),
+        top_k=int(top_k), eos_id=eos_token_id,
+        temperature=jnp.float32(temperature))
+    return Tensor(out)

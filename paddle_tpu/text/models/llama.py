@@ -254,6 +254,17 @@ class LlamaForCausalLM(Layer):
             return loss
         return logits
 
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 top_k=0, temperature=1.0, eos_token_id=None, seed=0):
+        """Jitted autoregressive decode with a static KV cache
+        (PaddleNLP GenerationMixin.generate analog; see
+        text/generation.py for the TPU design)."""
+        from ..generation import generate as _gen
+        return _gen(self, input_ids, max_new_tokens=max_new_tokens,
+                    do_sample=do_sample, top_k=top_k,
+                    temperature=temperature, eos_token_id=eos_token_id,
+                    seed=seed)
+
     def init_cache(self, batch_size):
         c = self.config
         kv = c.num_key_value_heads
