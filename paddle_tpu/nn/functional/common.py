@@ -97,19 +97,20 @@ def zeropad2d(x, padding, data_format="NCHW", name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    idx = raw(x)
-    def f(w):
+    # indices go through apply (not a closure constant) so the static
+    # recorder / jit replay sees fresh values each execution
+    def f(idx, w):
         out = jnp.take(w, idx, axis=0)
         if padding_idx is not None:
             mask = (idx == padding_idx)[..., None]
             out = jnp.where(mask, 0.0, out)
         return out
-    return apply(f, weight)
+    return apply(f, x, weight)
 
 
 def one_hot(x, num_classes, name=None):
-    idx = raw(x)
-    return Tensor(jax.nn.one_hot(idx, num_classes, dtype=jnp.float32))
+    return apply(lambda idx: jax.nn.one_hot(idx, num_classes,
+                                            dtype=jnp.float32), x)
 
 
 def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):

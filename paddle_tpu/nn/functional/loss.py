@@ -19,10 +19,10 @@ def _reduce(val, reduction):
 def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0, name=None):
-    lbl = raw(label)
-    w = raw(weight) if weight is not None else None
-
-    def f(logits):
+    # labels/weights flow through apply (NOT closure constants) so static
+    # program replay and op recorders see fresh values each execution
+    def f(logits, lbl, *wargs):
+        w = wargs[0] if wargs else None
         logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
             jnp.clip(logits, 1e-30, None))
         if soft_label:
@@ -55,7 +55,8 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                 return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
         return _reduce(loss, reduction)
 
-    return apply(f, input)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(f, *args)
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,
@@ -72,10 +73,8 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
 
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
              name=None):
-    lbl = raw(label)
-    w = raw(weight) if weight is not None else None
-
-    def f(logp):
+    def f(logp, lbl, *wargs):
+        w = wargs[0] if wargs else None
         li = lbl.astype(jnp.int32)
         valid = li != ignore_index
         safe = jnp.where(valid, li, 0)
@@ -88,7 +87,8 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
             return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
         return _reduce(loss, reduction)
 
-    return apply(f, input)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(f, *args)
 
 
 def mse_loss(input, label, reduction="mean", name=None):
@@ -123,7 +123,7 @@ def binary_cross_entropy(input, label, weight=None, reduction="mean",
 def binary_cross_entropy_with_logits(logit, label, weight=None,
                                      reduction="mean", pos_weight=None,
                                      name=None):
-    pw = raw(pos_weight) if pos_weight is not None else None
+    pw = raw(pos_weight) if pos_weight is not None else None  # hyperparam
 
     def f(z, t, *w):
         mx = jnp.maximum(z, 0)
@@ -197,12 +197,8 @@ def square_error_cost(input, label):
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
     """CTC via the standard alpha recursion in log space (lax.scan over time)."""
-    lp = raw(log_probs)  # [T, B, C] paddle layout
-    lab = raw(labels)    # [B, S]
-    il = raw(input_lengths)
-    ll = raw(label_lengths)
-
-    def f(logits):
+    def f(logits, lab, il, ll):
+        lab, il, ll = (a.astype(jnp.int32) for a in (lab, il, ll))
         logits = jax.nn.log_softmax(logits, axis=-1)
         T, B, C = logits.shape
         S = lab.shape[1]
@@ -240,7 +236,7 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
             return jnp.mean(nll / jnp.maximum(ll.astype(nll.dtype), 1))
         return _reduce(nll, reduction)
 
-    return apply(f, log_probs)
+    return apply(f, log_probs, labels, input_lengths, label_lengths)
 
 
 def dice_loss(input, label, epsilon=1e-5, name=None):

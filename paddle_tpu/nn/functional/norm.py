@@ -48,13 +48,15 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         running_mean._data = momentum * rm + (1 - momentum) * m_
         running_var._data = momentum * rv + (1 - momentum) * unbiased
 
-    def f(a, *wb):
+    def f(a, mr, vr, *wb):
         if use_batch:
             ax = stats_axes(a)
             m = jnp.mean(a, axis=ax)
             v = jnp.var(a, axis=ax)
         else:
-            m, v = rm, rv
+            # eval stats flow through apply so recorders/replay see the
+            # buffers' CURRENT values, not record-time snapshots
+            m, v = mr, vr
         c = m.size
         shp = ch_shape(a, c)
         out = (a - m.reshape(shp)) * jax.lax.rsqrt(v.reshape(shp) + epsilon)
@@ -66,7 +68,8 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             out = out + wb[i].reshape(shp)
         return out
 
-    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    args = (x, running_mean, running_var) + tuple(
+        t for t in (weight, bias) if t is not None)
     return apply(f, *args)
 
 

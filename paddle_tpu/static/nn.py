@@ -65,3 +65,149 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
         pairs = list(enumerate(branch_fns))
     preds = [(branch_index == i, fn) for i, fn in pairs]
     return case(preds, default)
+
+
+# ---------------------------------------------------------------------------
+# layer builders (reference: python/paddle/static/nn/common.py — fc,
+# batch_norm, embedding, conv layers create parameters in the startup
+# program and append ops to the main program; here create_parameter
+# registers params on the active Program and the functional ops record
+# through the Tensor op recorder)
+# ---------------------------------------------------------------------------
+
+def _uniq(prefix):
+    from ..utils import unique_name
+    return unique_name.generate(prefix)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Reference: static/nn/common.py::fc."""
+    from .program import create_parameter
+    from ..nn import functional as F
+    from ..tensor_ops.manipulation import reshape
+
+    shape = tuple(x.shape)
+    in_dim = 1
+    for d in shape[num_flatten_dims:]:
+        in_dim *= int(d)
+    x2 = reshape(x, (*shape[:num_flatten_dims], in_dim)) \
+        if len(shape) != num_flatten_dims + 1 else x
+    w = create_parameter((in_dim, size), str(x.dtype),
+                         name=name or _uniq("fc_w"), attr=weight_attr)
+    from ..tensor_ops.math import matmul
+    out = matmul(x2, w)
+    if bias_attr is not False:
+        b = create_parameter((size,), str(x.dtype),
+                             name=_uniq("fc_b"), attr=bias_attr,
+                             is_bias=True)
+        out = out + b
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    """Reference: static/nn/common.py::embedding."""
+    from .program import create_parameter
+    from ..nn import functional as F
+
+    w = create_parameter(tuple(size), dtype, name=name or _uniq("emb_w"),
+                         attr=param_attr)
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    """Reference: static/nn/common.py::conv2d (NCHW)."""
+    from .program import create_parameter
+    from ..nn import functional as F
+
+    ks = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    cin = int(input.shape[1])
+    w = create_parameter((num_filters, cin // groups, *ks), str(input.dtype),
+                         name=name or _uniq("conv_w"), attr=param_attr)
+    b = None
+    if bias_attr is not False:
+        b = create_parameter((num_filters,), str(input.dtype),
+                             name=_uniq("conv_b"), attr=bias_attr,
+                             is_bias=True)
+    out = F.conv2d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, is_test=False,
+               data_layout="NCHW", name=None):
+    """Reference: static/nn/common.py::batch_norm. Static-graph batch norm
+    runs in inference form (is_test semantics) unless the caller replays
+    with training stats — matching the executor contract here."""
+    from .program import create_parameter, create_global_var
+    from ..nn import functional as F
+
+    c = int(input.shape[1 if data_layout == "NCHW" else -1])
+    dt = str(input.dtype)
+    scale = create_parameter((c,), dt, name=name or _uniq("bn_scale"),
+                             attr=param_attr,
+                             default_initializer=None)
+    from ..nn.initializer import Constant
+    with_init = create_parameter  # readability
+    bias = with_init((c,), dt, name=_uniq("bn_bias"), attr=bias_attr,
+                     is_bias=True)
+    mean = create_global_var((c,), 0.0, dt, persistable=True,
+                             name=_uniq("bn_mean"))
+    var = create_global_var((c,), 1.0, dt, persistable=True,
+                            name=_uniq("bn_var"))
+    # scale initializes to ones (Constant default for BN)
+    import jax.numpy as jnp
+    scale._data = jnp.ones((c,), scale._data.dtype)
+    out = F.batch_norm(input, mean, var, weight=scale, bias=bias,
+                       training=not is_test, momentum=momentum,
+                       epsilon=epsilon, data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """Reference: static/nn/common.py::layer_norm."""
+    from .program import create_parameter
+    from ..nn import functional as F
+    import numpy as np
+
+    shape = tuple(int(s) for s in input.shape[begin_norm_axis:])
+    dt = str(input.dtype)
+    w = b = None
+    if scale:
+        w = create_parameter(shape, dt, name=name or _uniq("ln_w"),
+                             attr=param_attr)
+        import jax.numpy as jnp
+        w._data = jnp.ones(shape, w._data.dtype)
+    if shift:
+        b = create_parameter(shape, dt, name=_uniq("ln_b"), attr=bias_attr,
+                             is_bias=True)
+    out = F.layer_norm(input, shape, weight=w, bias=b, epsilon=epsilon)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    """Reference: static/nn/common.py::prelu."""
+    from .program import create_parameter
+    from ..nn import functional as F
+
+    n = 1 if mode == "all" else int(x.shape[1])
+    alpha = create_parameter((n,), str(x.dtype),
+                             name=name or _uniq("prelu_alpha"),
+                             attr=param_attr)
+    import jax.numpy as jnp
+    alpha._data = jnp.full((n,), 0.25, alpha._data.dtype)
+    return F.prelu(x, alpha)

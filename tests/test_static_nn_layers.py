@@ -1,0 +1,77 @@
+"""paddle.static.nn layer builders + recorder-freshness regressions.
+
+Reference: python/paddle/static/nn/common.py (fc/conv2d/batch_norm/
+embedding/layer_norm/prelu create parameters in the program and append
+ops). Also locks the fix where labels/indices flowed into ops as closure
+constants and static replay reused record-time values.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def test_fc_conv_bn_ln_pipeline():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 1, 8, 8], "float32")
+        conv = static.nn.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                                act="relu")
+        bn = static.nn.batch_norm(conv, is_test=True)
+        flat = paddle.flatten(bn, start_axis=1)
+        fc1 = static.nn.fc(flat, 16, activation="relu")
+        ln = static.nn.layer_norm(fc1)
+        out = static.nn.fc(ln, 3)
+    exe = static.Executor()
+    rng = np.random.default_rng(0)
+    for batch in (2, 5):  # replay adapts to fed batch size
+        res = exe.run(main, feed={
+            "x": rng.standard_normal((batch, 1, 8, 8)).astype(np.float32)},
+            fetch_list=[out])
+        assert res[0].shape == (batch, 3)
+        assert np.isfinite(res[0]).all()
+    # parameters registered on the program
+    assert len(main.all_parameters()) >= 6
+
+
+def test_embedding_fresh_indices_on_replay():
+    main = static.Program()
+    with static.program_guard(main):
+        ids = static.data("ids", [None, 4], "int64")
+        emb = static.nn.embedding(ids, size=(16, 8))
+        out = paddle.sum(emb, axis=(1, 2))
+    exe = static.Executor()
+    a = exe.run(main, feed={"ids": np.zeros((2, 4), np.int64)},
+                fetch_list=[out])[0]
+    b = exe.run(main, feed={"ids": np.full((3, 4), 7, np.int64)},
+                fetch_list=[out])[0]
+    assert a.shape == (2,) and b.shape == (3,)
+    assert not np.allclose(a[0], b[0])  # different rows looked up
+
+
+def test_cross_entropy_fresh_labels_on_replay():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 6], "float32")
+        y = static.data("y", [None], "int64")
+        logits = static.nn.fc(x, 6)
+        loss = paddle.nn.functional.cross_entropy(logits, y)
+    exe = static.Executor()
+    rng = np.random.default_rng(1)
+    xv = rng.standard_normal((4, 6)).astype(np.float32)
+    l0 = exe.run(main, feed={"x": xv, "y": np.zeros(4, np.int64)},
+                 fetch_list=[loss])[0]
+    l1 = exe.run(main, feed={"x": xv, "y": np.full(4, 5, np.int64)},
+                 fetch_list=[loss])[0]
+    assert not np.allclose(l0, l1), "labels were baked in at record time"
+
+
+def test_prelu_builder():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        out = static.nn.prelu(x)
+    res = static.Executor().run(
+        main, feed={"x": np.asarray([[-1.0, 2.0, -4.0]], np.float32)},
+        fetch_list=[out])[0]
+    np.testing.assert_allclose(res, [[-0.25, 2.0, -1.0]], rtol=1e-6)
