@@ -304,12 +304,17 @@ def main():
     headline = bench_llama(backend)
 
     secondary = {}
+    t_start = time.perf_counter()
+    budget = float(os.environ.get("PADDLE_TPU_BENCH_BUDGET_S", "900"))
     if os.environ.get("PADDLE_TPU_BENCH_SECONDARY", "1") != "0":
         for name, fn in (("resnet50", bench_resnet50),
                          ("bert_base_dp", bench_bert),
                          ("vit_b16", bench_vit),
                          ("ernie_moe_ep", bench_ernie_moe),
                          ("int8_matmul", bench_int8_matmul)):
+            if time.perf_counter() - t_start > budget:
+                secondary[name] = {"skipped": "bench time budget exhausted"}
+                continue
             try:
                 secondary[name] = fn(backend)
             except Exception as e:

@@ -248,12 +248,27 @@ class _RNNBase(Layer):
             else:
                 self.rnns.append(RNN(make_cell(in_sz), False, time_major))
 
+    def _layer_states(self, initial_states, i):
+        """Slice paddle-layout initial states ([L*D, B, H], LSTM: tuple of
+        two) down to what layer i's RNN/BiRNN expects."""
+        if initial_states is None:
+            return None
+        D = self.num_directions
+        if self.mode == "LSTM":
+            h, c = initial_states
+            if D == 1:
+                return (h[i], c[i])
+            return ((h[2 * i], c[2 * i]), (h[2 * i + 1], c[2 * i + 1]))
+        h = initial_states
+        if D == 1:
+            return h[i]
+        return (h[2 * i], h[2 * i + 1])
+
     def forward(self, inputs, initial_states=None, sequence_length=None):
         out = inputs
         final_states = []
         for i, rnn in enumerate(self.rnns):
-            st = None if initial_states is None else initial_states
-            out, fs = rnn(out, None)
+            out, fs = rnn(out, self._layer_states(initial_states, i))
             final_states.append(fs)
             if self.dropout > 0 and i < self.num_layers - 1:
                 from .. import functional as Fn

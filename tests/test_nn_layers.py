@@ -243,3 +243,88 @@ def test_rmsnorm():
     ms = np.mean(x.numpy() ** 2, axis=-1, keepdims=True)
     expected = x.numpy() / np.sqrt(ms + 1e-6)
     np.testing.assert_allclose(y.numpy(), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_vs_torch():
+    """Multi-layer LSTM forward + final states vs torch (including fed
+    initial states — regression: initial_states was ignored)."""
+    torch = pytest.importorskip("torch")
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    B, T, I, H, L = 3, 5, 4, 6, 2
+    ours = nn.LSTM(I, H, num_layers=L)
+    tl = torch.nn.LSTM(I, H, num_layers=L, batch_first=True)
+    with torch.no_grad():
+        for i, cell_holder in enumerate(ours.rnns):
+            cell = cell_holder.cell
+            getattr(tl, f"weight_ih_l{i}").copy_(
+                torch.from_numpy(np.asarray(cell.weight_ih._data)))
+            getattr(tl, f"weight_hh_l{i}").copy_(
+                torch.from_numpy(np.asarray(cell.weight_hh._data)))
+            getattr(tl, f"bias_ih_l{i}").copy_(
+                torch.from_numpy(np.asarray(cell.bias_ih._data)))
+            getattr(tl, f"bias_hh_l{i}").copy_(
+                torch.from_numpy(np.asarray(cell.bias_hh._data)))
+    x = rng.standard_normal((B, T, I)).astype(np.float32)
+    h0 = rng.standard_normal((L, B, H)).astype(np.float32) * 0.1
+    c0 = rng.standard_normal((L, B, H)).astype(np.float32) * 0.1
+
+    out, (hn, cn) = ours(paddle.to_tensor(x),
+                         (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+    tout, (thn, tcn) = tl(torch.from_numpy(x),
+                          (torch.from_numpy(h0), torch.from_numpy(c0)))
+    np.testing.assert_allclose(np.asarray(out._data), tout.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hn._data), thn.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cn._data), tcn.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gru_vs_torch():
+    torch = pytest.importorskip("torch")
+    paddle.seed(1)
+    rng = np.random.default_rng(1)
+    B, T, I, H = 2, 7, 5, 4
+    ours = nn.GRU(I, H)
+    tg = torch.nn.GRU(I, H, batch_first=True)
+    cell = ours.rnns[0].cell
+    with torch.no_grad():
+        tg.weight_ih_l0.copy_(torch.from_numpy(np.asarray(cell.weight_ih._data)))
+        tg.weight_hh_l0.copy_(torch.from_numpy(np.asarray(cell.weight_hh._data)))
+        tg.bias_ih_l0.copy_(torch.from_numpy(np.asarray(cell.bias_ih._data)))
+        tg.bias_hh_l0.copy_(torch.from_numpy(np.asarray(cell.bias_hh._data)))
+    x = rng.standard_normal((B, T, I)).astype(np.float32)
+    h0 = rng.standard_normal((1, B, H)).astype(np.float32) * 0.1
+    out, hn = ours(paddle.to_tensor(x), paddle.to_tensor(h0))
+    tout, thn = tg(torch.from_numpy(x), torch.from_numpy(h0))
+    np.testing.assert_allclose(np.asarray(out._data), tout.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hn._data), thn.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_adamw_single_step_vs_torch():
+    """AdamW update parity vs torch.optim.AdamW (decoupled decay)."""
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(2)
+    w0 = rng.standard_normal((4, 3)).astype(np.float32)
+    g = rng.standard_normal((4, 3)).astype(np.float32)
+
+    from paddle_tpu import optimizer as optim
+    p = paddle.to_tensor(w0.copy(), stop_gradient=False)
+    from paddle_tpu.tensor import Parameter
+    param = Parameter(paddle.Tensor(p._data))
+    param.stop_gradient = False
+    opt = optim.AdamW(learning_rate=0.01, weight_decay=0.1, beta1=0.9,
+                      beta2=0.999, epsilon=1e-8, parameters=[param])
+    param.grad = paddle.to_tensor(g.copy())
+    opt.step()
+
+    tw = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+    topt = torch.optim.AdamW([tw], lr=0.01, weight_decay=0.1,
+                             betas=(0.9, 0.999), eps=1e-8)
+    tw.grad = torch.from_numpy(g.copy())
+    topt.step()
+    np.testing.assert_allclose(np.asarray(param._data),
+                               tw.detach().numpy(), rtol=1e-5, atol=1e-7)
