@@ -175,6 +175,16 @@ def convert_bool(x):
     return x
 
 
+def loop_cond(i, stop, step):
+    """`for i in range(start, stop, step)` desugars to a while with this
+    condition; handles tensor bounds (negative tensor steps assume the
+    caller's python semantics — positive — like the reference's
+    convert_range)."""
+    if isinstance(step, (int, float)) and step < 0:
+        return i > stop
+    return i < stop
+
+
 # ---------------------------------------------------------------------------
 # AST transformation
 # ---------------------------------------------------------------------------
@@ -304,6 +314,46 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         else:
             assign = ast.Expr(value=call)
         return [true_def, false_def, assign]
+
+    def visit_For(self, node):
+        """``for i in range(...)`` → init + while (then converted like any
+        while). Other iterables stay python (reference converts range and
+        enumerate; range covers the tensor-bound cases)."""
+        self.generic_visit(node)
+        if (_has_disallowed(node.body) or node.orelse
+                or not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or node.iter.keywords):
+            return node
+        args = node.iter.args
+        if len(args) == 1:
+            start, stop, step = ast.Constant(0), args[0], ast.Constant(1)
+        elif len(args) == 2:
+            start, stop, step = args[0], args[1], ast.Constant(1)
+        elif len(args) == 3:
+            start, stop, step = args
+        else:
+            return node
+        i = self._next()
+        ev, tv = f"__pt_rstop_{i}", f"__pt_rstep_{i}"
+        tgt = node.target.id
+        inits = [
+            ast.Assign(targets=[_name(ev, ast.Store())], value=stop),
+            ast.Assign(targets=[_name(tv, ast.Store())], value=step),
+            ast.Assign(targets=[_name(tgt, ast.Store())], value=start),
+        ]
+        bump = ast.Assign(
+            targets=[_name(tgt, ast.Store())],
+            value=ast.BinOp(left=_name(tgt), op=ast.Add(), right=_name(tv)))
+        test = ast.Call(
+            func=ast.Attribute(value=_name(_JST), attr="loop_cond",
+                               ctx=ast.Load()),
+            args=[_name(tgt), _name(ev), _name(tv)], keywords=[])
+        wh = ast.While(test=test, body=list(node.body) + [bump], orelse=[])
+        out = self.visit_While(wh)
+        return inits + (out if isinstance(out, list) else [out])
 
     def visit_While(self, node):
         self.generic_visit(node)

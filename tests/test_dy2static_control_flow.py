@@ -159,3 +159,38 @@ def test_grad_through_converted_cond():
     g = jax.grad(lambda a: sf(paddle_tpu.Tensor(a, stop_gradient=False))._data)(
         np.asarray([1.0, 2.0], dtype=np.float32))
     np.testing.assert_allclose(np.asarray(g), [2.0, 4.0])
+
+
+def test_for_range_tensor_bound_converts():
+    """`for i in range(n)` with a traced tensor bound compiles to a
+    lax.while_loop (reference convert_range) and matches eager."""
+
+    def f(x, n):
+        s = paddle_tpu.zeros_like(x)
+        for i in range(n):
+            s = s + x * float(1.0) + i * 0.0
+        return s
+
+    # eager with python int bound
+    eager = f(paddle_tpu.to_tensor([1.0, 2.0]), 3)
+    np.testing.assert_allclose(np.asarray(eager._data), [3.0, 6.0])
+
+    sf = jit.to_static(f)
+    got = sf(paddle_tpu.to_tensor([1.0, 2.0]), paddle_tpu.to_tensor(3))
+    np.testing.assert_allclose(np.asarray(got._data), [3.0, 6.0])
+    got = sf(paddle_tpu.to_tensor([1.0, 2.0]), paddle_tpu.to_tensor(5))
+    np.testing.assert_allclose(np.asarray(got._data), [5.0, 10.0])
+
+
+def test_for_range_start_step_converts():
+    def f(x, n):
+        acc = paddle_tpu.to_tensor(0.0)
+        for i in range(2, n, 2):
+            acc = acc + x.sum() * 0 + i
+        return acc
+
+    eager = f(paddle_tpu.to_tensor([0.0]), 8)  # i = 2,4,6 -> 12
+    assert float(eager) == 12.0
+    sf = jit.to_static(f)
+    got = sf(paddle_tpu.to_tensor([0.0]), paddle_tpu.to_tensor(8))
+    assert float(np.asarray(got._data)) == 12.0
