@@ -1,0 +1,32 @@
+"""Autoregressive generation with the jitted static-KV-cache decoder.
+
+    python examples/generate_llama.py
+"""
+import os
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+import dataclasses
+
+
+def main():
+    paddle.seed(0)
+    cfg = dataclasses.replace(LLAMA_TINY, dtype="float32")
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    prompt = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (2, 8)).astype(np.int32)
+    out = model.generate(paddle.to_tensor(prompt), max_new_tokens=16,
+                         do_sample=True, top_k=16, temperature=0.9)
+    print("prompt:", prompt.tolist())
+    print("output:", np.asarray(out._data).tolist())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Pretrain a Llama decoder LM with Fleet hybrid parallelism.
+
+Run on any device count — the mesh axes are configurable:
+    python examples/train_llama_hybrid.py --dp 2 --tp 2 --sharding 2
+
+On CPU for a smoke run:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/train_llama_hybrid.py
+(the script force-sets the platform when JAX_PLATFORMS=cpu is exported)
+"""
+import argparse
+import os
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as optim
+from paddle_tpu.amp import GradScaler
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.text.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dp", type=int, default=2)
+    ap.add_argument("--tp", type=int, default=2)
+    ap.add_argument("--sharding", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--accumulate", type=int, default=1)
+    args = ap.parse_args()
+
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": args.dp, "mp_degree": args.tp, "pp_degree": 1,
+        "sharding_degree": args.sharding, "sep_degree": 1,
+    }
+    strategy.sharding = args.sharding > 1
+    strategy.sharding_configs["sharding_stage"] = 3
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=1024, hidden_size=256,
+                      intermediate_size=688, num_hidden_layers=4,
+                      num_attention_heads=8, num_key_value_heads=8,
+                      max_position_embeddings=256, dtype="float32")
+    model = fleet.distributed_model(LlamaForCausalLM(cfg))
+    opt = fleet.distributed_optimizer(
+        optim.AdamW(learning_rate=3e-4, weight_decay=0.01,
+                    parameters=model.parameters()),
+        strategy=strategy)
+    step = opt.make_train_step(model, lambda m, i, l: m(i, labels=l),
+                               accumulate_steps=args.accumulate,
+                               scaler=GradScaler(init_loss_scaling=2.0**10))
+
+    rng = np.random.default_rng(0)
+    batch = max(8, 2 * args.dp * args.sharding * max(1, args.accumulate))
+    for i in range(args.steps):
+        ids = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (batch, 128)).astype(np.int32))
+        loss = step(ids, ids)
+        print(f"step {i}: loss={float(np.asarray(loss._data)):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Train ResNet-18 with the high-level paddle.Model API (synthetic data).
+
+    python examples/train_resnet_hapi.py
+"""
+import os
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.vision.datasets import MNIST
+
+
+def main():
+    paddle.seed(0)
+    net = paddle.vision.models.LeNet(num_classes=10)
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer.Adam(learning_rate=1e-3, parameters=net.parameters()),
+        nn.CrossEntropyLoss(),
+        paddle.metric.Accuracy())
+    train = MNIST(mode="train")   # synthetic when the real files are absent
+    model.fit(train, epochs=1, batch_size=64, verbose=1)
+    print(model.evaluate(train, batch_size=128, verbose=0))
+
+
+if __name__ == "__main__":
+    main()

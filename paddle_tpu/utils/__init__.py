@@ -9,3 +9,58 @@ def try_import(name):
         return importlib.import_module(name)
     except ImportError:
         return None
+
+
+from . import cpp_extension  # noqa: F401,E402
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference:
+    utils/deprecated.py) — warns once per call site."""
+    import functools
+    import warnings
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*a, **k):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **k)
+        return inner
+    return wrap
+
+
+def run_check():
+    """Sanity-check the installation on the current backend (reference:
+    utils/install_check.py::run_check): runs a tiny train step and, when
+    more than one device is visible, a sharded matmul."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer as optim
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    y = paddle.to_tensor(np.zeros((2, 2), np.float32))
+    loss = ((net(x) - y) ** 2).mean()
+    loss.backward()
+    opt.step()
+    n = len(jax.devices())
+    if n > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from paddle_tpu.distributed.mesh import build_mesh
+        mesh = build_mesh(dp=n)
+        a = jax.device_put(np.ones((n * 2, 4), np.float32),
+                           NamedSharding(mesh, P("dp", None)))
+        _ = np.asarray(a @ a.T)
+    print(f"paddle_tpu is installed successfully! "
+          f"({n} {jax.default_backend()} device(s) visible)")
