@@ -1,0 +1,3 @@
+"""paddle.dataset compatibility namespace (reference:
+python/paddle/dataset/__init__.py)."""
+from . import common  # noqa: F401

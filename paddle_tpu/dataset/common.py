@@ -1,0 +1,75 @@
+"""Dataset cache/download helpers.
+
+Reference: python/paddle/dataset/common.py — DATA_HOME cache directory,
+``download(url, module_name, md5sum)`` with md5 verification and retries.
+Supports http(s) (urllib; the build/test environment is typically
+zero-egress so failures surface clearly) and file:// / local-path sources
+(used by tests and air-gapped mirrors via PADDLE_TPU_DATASET_MIRROR).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import urllib.parse
+import urllib.request
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _resolve(url: str) -> str:
+    """Apply PADDLE_TPU_DATASET_MIRROR=<base> rewriting: the last path
+    component is looked up under the mirror (file path or URL)."""
+    mirror = os.environ.get("PADDLE_TPU_DATASET_MIRROR")
+    if not mirror:
+        return url
+    name = urllib.parse.urlparse(url).path.rsplit("/", 1)[-1]
+    if mirror.startswith(("http://", "https://", "file://")):
+        return mirror.rstrip("/") + "/" + name
+    return os.path.join(mirror, name)
+
+
+def download(url: str, module_name: str, md5sum: str | None = None,
+             save_name: str | None = None, retries: int = 2) -> str:
+    """Fetch url into DATA_HOME/module_name, verifying md5. Returns the
+    local path; raises RuntimeError when unreachable/corrupt."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    os.makedirs(dirname, exist_ok=True)
+    url = _resolve(url)
+    fname = save_name or urllib.parse.urlparse(url).path.rsplit("/", 1)[-1]
+    path = os.path.join(dirname, fname)
+
+    if os.path.exists(path) and (md5sum is None or md5file(path) == md5sum):
+        return path
+
+    last_err = None
+    for _ in range(max(1, retries)):
+        try:
+            if url.startswith(("http://", "https://", "file://")):
+                with urllib.request.urlopen(url, timeout=30) as r, \
+                        open(path + ".part", "wb") as out:
+                    shutil.copyfileobj(r, out)
+            elif os.path.exists(url):
+                shutil.copyfile(url, path + ".part")
+            else:
+                raise FileNotFoundError(url)
+            if md5sum is not None and md5file(path + ".part") != md5sum:
+                last_err = RuntimeError(f"md5 mismatch for {url}")
+                os.remove(path + ".part")
+                continue
+            os.replace(path + ".part", path)
+            return path
+        except Exception as e:  # network/IO: retry then raise
+            last_err = e
+    raise RuntimeError(
+        f"download failed for {url} (into {dirname}): {last_err}. "
+        f"Offline? Point PADDLE_TPU_DATASET_MIRROR at a local copy.")

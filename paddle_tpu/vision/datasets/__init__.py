@@ -41,9 +41,32 @@ class MNIST(Dataset):
     """Loads idx-format MNIST from image_path/label_path; synthesizes 28x28
     data when files are absent."""
 
+    URL_BASE = "https://dataset.bj.bcebos.com/mnist/"
+    FILES = {  # reference vision/datasets/mnist.py:95-103 URL/md5 table
+        "train": (("train-images-idx3-ubyte.gz",
+                   "f68b3c2dcbeaaa9fbdd348bbdeb94873"),
+                  ("train-labels-idx1-ubyte.gz",
+                   "d53e105ee54ea40749a09fcbcd1e9432")),
+        "test": (("t10k-images-idx3-ubyte.gz",
+                  "9fb629c4189551a2d022fa330f9573f3"),
+                 ("t10k-labels-idx1-ubyte.gz",
+                  "ec29112dd5afa0611ce80d1b7f02629c")),
+    }
+
     def __init__(self, image_path=None, label_path=None, mode="train",
                  transform=None, download=True, backend=None):
         self.transform = transform
+        if (image_path is None and label_path is None and download):
+            # reference contract: fetch into DATA_HOME when paths are not
+            # given; offline (zero-egress) falls through to synthetic
+            from ...dataset.common import download as _dl
+            try:
+                imgs, lbls = self.FILES["train" if mode == "train"
+                                        else "test"]
+                image_path = _dl(self.URL_BASE + imgs[0], "mnist", imgs[1])
+                label_path = _dl(self.URL_BASE + lbls[0], "mnist", lbls[1])
+            except Exception:
+                image_path = label_path = None
         if image_path and os.path.exists(image_path) and label_path and \
                 os.path.exists(label_path):
             with gzip.open(image_path, "rb") as f:

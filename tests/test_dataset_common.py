@@ -1,0 +1,73 @@
+"""paddle.dataset.common download/cache machinery.
+
+Reference: python/paddle/dataset/common.py — DATA_HOME, md5-verified
+download with retries; mirror/local-file sources for air-gapped envs.
+"""
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.dataset import common
+
+
+def test_download_local_file_and_cache(tmp_path, monkeypatch):
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path / "home"))
+    src = tmp_path / "blob.bin"
+    src.write_bytes(b"hello dataset")
+    md5 = common.md5file(str(src))
+    p1 = common.download(str(src), "unit", md5)
+    assert os.path.exists(p1)
+    assert open(p1, "rb").read() == b"hello dataset"
+    # second call hits the cache (delete the source to prove it)
+    src.unlink()
+    p2 = common.download(str(tmp_path / "blob.bin"), "unit", md5)
+    assert p2 == p1
+
+
+def test_download_md5_mismatch_raises(tmp_path, monkeypatch):
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path / "home"))
+    src = tmp_path / "blob2.bin"
+    src.write_bytes(b"payload")
+    with pytest.raises(RuntimeError, match="md5|failed"):
+        common.download(str(src), "unit", "0" * 32, retries=1)
+
+
+def test_mirror_env_rewrites(tmp_path, monkeypatch):
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path / "home"))
+    mirror = tmp_path / "mirror"
+    mirror.mkdir()
+    (mirror / "archive.gz").write_bytes(b"mirrored")
+    monkeypatch.setenv("PADDLE_TPU_DATASET_MIRROR", str(mirror))
+    p = common.download("https://unreachable.example/data/archive.gz",
+                        "unit", None)
+    assert open(p, "rb").read() == b"mirrored"
+
+
+def test_mnist_download_path_via_mirror(tmp_path, monkeypatch):
+    """MNIST(download=True) consumes the download machinery when a mirror
+    provides real idx files."""
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path / "home"))
+    mirror = tmp_path / "mirror"
+    mirror.mkdir()
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (7, 28, 28), dtype=np.uint8)
+    lbls = rng.integers(0, 10, (7,), dtype=np.uint8)
+    with gzip.open(mirror / "train-images-idx3-ubyte.gz", "wb") as f:
+        f.write(b"\x00" * 16 + imgs.tobytes())
+    with gzip.open(mirror / "train-labels-idx1-ubyte.gz", "wb") as f:
+        f.write(b"\x00" * 8 + lbls.tobytes())
+    monkeypatch.setenv("PADDLE_TPU_DATASET_MIRROR", str(mirror))
+
+    from paddle_tpu.vision.datasets import MNIST
+
+    class NoMd5MNIST(MNIST):
+        FILES = {k: ((v[0][0], None), (v[1][0], None))
+                 for k, v in MNIST.FILES.items()}
+
+    ds = NoMd5MNIST(mode="train")
+    assert len(ds) == 7
+    np.testing.assert_array_equal(ds.images[3], imgs[3])
+    _, lab = ds[3]
+    assert int(lab) == int(lbls[3])

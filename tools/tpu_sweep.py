@@ -1,0 +1,127 @@
+"""One-shot TPU perf sweep for the headline llama config.
+
+Usage (TPU env untouched; run ONE at a time — the axon tunnel is
+single-client):
+    python tools/tpu_sweep.py flash            # flash block-size sweep
+    python tools/tpu_sweep.py step             # train-step config sweep
+    python tools/tpu_sweep.py int8             # int8 kernel vs bf16
+
+All timing syncs by host value fetch (block_until_ready does not block
+through the tunnel).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _sync(x):
+    import jax
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return np.asarray(leaf.reshape(-1)[0])
+
+
+def timed(f, *a, n=10):
+    out = f(*a)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = f(*a)
+    _sync(out)
+    return (time.perf_counter() - t0) / n
+
+
+def sweep_flash():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+    from paddle_tpu.nn.functional.attention import _xla_sdpa
+
+    B, L, H, D = 4, 2048, 16, 128
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, L, H, D)), dtype=jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, L, H, D)), dtype=jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, L, H, D)), dtype=jnp.bfloat16)
+
+    def fb(bq, bk):
+        f = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=bq, block_k=bk))
+        g = jax.jit(jax.grad(lambda q, k, v: f(q, k, v).astype(
+            jnp.float32).sum(), argnums=(0, 1, 2)))
+        tf = timed(f, q, k, v)
+        tg = timed(g, q, k, v)
+        print(f"flash bq={bq} bk={bk}: fwd {tf*1e3:.2f} ms  "
+              f"fwd+bwd {tg*1e3:.2f} ms", flush=True)
+
+    fx = jax.jit(lambda q, k, v: _xla_sdpa(q, k, v, causal=True))
+    gx = jax.jit(jax.grad(lambda q, k, v: fx(q, k, v).astype(
+        jnp.float32).sum(), argnums=(0, 1, 2)))
+    print(f"xla: fwd {timed(fx, q, k, v)*1e3:.2f} ms  "
+          f"fwd+bwd {timed(gx, q, k, v)*1e3:.2f} ms", flush=True)
+    for bq, bk in ((128, 128), (256, 512), (512, 512), (256, 1024)):
+        try:
+            fb(bq, bk)
+        except Exception as e:
+            print(f"flash bq={bq} bk={bk}: FAILED {type(e).__name__} "
+                  f"{str(e)[:150]}", flush=True)
+
+
+def sweep_step():
+    import jax
+
+    import paddle_tpu
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.text.models.llama import LlamaConfig, LlamaForCausalLM
+
+    for batch, remat, note in ((4, False, "headline"), (8, False, "b8"),
+                               (4, True, "remat")):
+        paddle_tpu.seed(0)
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048, dtype="bfloat16",
+                          remat=remat)
+        fleet.init(is_collective=True, strategy=DistributedStrategy())
+        model = fleet.distributed_model(LlamaForCausalLM(cfg))
+        n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+        opt = fleet.distributed_optimizer(
+            optim.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                        parameters=model.parameters()))
+        step = opt.make_train_step(model, lambda m, i, l: m(i, labels=l))
+        rng = np.random.default_rng(0)
+        ids = paddle_tpu.to_tensor(
+            rng.integers(0, cfg.vocab_size, (batch, 2048)).astype(np.int32))
+        t = timed(lambda: step(ids, ids), n=8)
+        tps = batch * 2048 / t
+        print(f"step {note}: {t*1e3:.0f} ms  {tps:.0f} tok/s  "
+              f"mfu={tps*6*n_params/197e12:.3f}", flush=True)
+
+
+def sweep_int8():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.nn.quant import quantize_int8
+    from paddle_tpu.ops.pallas.int8_matmul import int8_linear
+
+    rng = np.random.default_rng(0)
+    for M, K, N in ((256, 8192, 8192), (32, 8192, 8192), (1024, 4096, 4096)):
+        x = jnp.asarray(rng.standard_normal((M, K)), dtype=jnp.bfloat16)
+        w = jnp.asarray(rng.standard_normal((K, N)) * 0.02,
+                        dtype=jnp.bfloat16)
+        wq, ws = quantize_int8(w, axis=0)
+        fb = jax.jit(lambda x, w: x @ w)
+        fi = jax.jit(lambda x, wq, ws: int8_linear(x, wq, ws, jnp.bfloat16))
+        tb = timed(fb, x, w, n=30)
+        ti = timed(fi, x, wq, ws, n=30)
+        print(f"int8 {M}x{K}x{N}: bf16 {tb*1e3:.3f} ms  int8 {ti*1e3:.3f} "
+              f"ms  speedup {tb/ti:.2f}x", flush=True)
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "step"
+    {"flash": sweep_flash, "step": sweep_step, "int8": sweep_int8}[mode]()
