@@ -1,0 +1,113 @@
+"""Manipulation/search op parity sweep vs numpy (reference unittest
+breadth for tensor/manipulation.py and search.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RNG = np.random.default_rng(11)
+
+
+def _t(a):
+    return paddle.to_tensor(a)
+
+
+def test_reshape_transpose_squeeze_family():
+    x = RNG.standard_normal((2, 3, 4)).astype(np.float32)
+    np.testing.assert_array_equal(
+        paddle.reshape(_t(x), [4, 6]).numpy(), x.reshape(4, 6))
+    np.testing.assert_array_equal(
+        paddle.reshape(_t(x), [-1, 4]).numpy(), x.reshape(-1, 4))
+    np.testing.assert_array_equal(
+        paddle.transpose(_t(x), [2, 0, 1]).numpy(), x.transpose(2, 0, 1))
+    np.testing.assert_array_equal(
+        paddle.squeeze(_t(x[None]), axis=0).numpy(), x)
+    np.testing.assert_array_equal(
+        paddle.unsqueeze(_t(x), axis=1).numpy(), x[:, None])
+    np.testing.assert_array_equal(paddle.flatten(_t(x)).numpy(), x.ravel())
+    np.testing.assert_array_equal(
+        paddle.flip(_t(x), axis=[1]).numpy(), np.flip(x, 1))
+    np.testing.assert_array_equal(
+        paddle.roll(_t(x), shifts=2, axis=1).numpy(), np.roll(x, 2, 1))
+
+
+def test_concat_split_stack_family():
+    a = RNG.standard_normal((2, 3)).astype(np.float32)
+    b = RNG.standard_normal((2, 3)).astype(np.float32)
+    np.testing.assert_array_equal(
+        paddle.concat([_t(a), _t(b)], axis=0).numpy(),
+        np.concatenate([a, b], 0))
+    np.testing.assert_array_equal(
+        paddle.stack([_t(a), _t(b)], axis=1).numpy(), np.stack([a, b], 1))
+    parts = paddle.split(_t(a), 3, axis=1)
+    for i, p in enumerate(parts):
+        np.testing.assert_array_equal(p.numpy(), a[:, i:i + 1])
+    chunks = paddle.chunk(_t(a), 2, axis=0)
+    np.testing.assert_array_equal(chunks[0].numpy(), a[:1])
+    np.testing.assert_array_equal(
+        paddle.tile(_t(a), [2, 1]).numpy(), np.tile(a, (2, 1)))
+    np.testing.assert_array_equal(
+        paddle.expand(_t(a[:1]), [4, 3]).numpy(),
+        np.broadcast_to(a[:1], (4, 3)))
+
+
+def test_gather_scatter_index_family():
+    x = RNG.standard_normal((5, 4)).astype(np.float32)
+    idx = np.asarray([3, 0, 4])
+    np.testing.assert_array_equal(
+        paddle.gather(_t(x), _t(idx), axis=0).numpy(), x[idx])
+    np.testing.assert_array_equal(
+        paddle.index_select(_t(x), _t(idx), axis=0).numpy(), x[idx])
+    upd = RNG.standard_normal((3, 4)).astype(np.float32)
+    want = x.copy()
+    want[idx] = upd
+    np.testing.assert_allclose(
+        paddle.scatter(_t(x), _t(idx), _t(upd), overwrite=True).numpy(),
+        want, rtol=1e-6)
+    tk_v, tk_i = paddle.topk(_t(x), k=2, axis=1)
+    np.testing.assert_array_equal(
+        tk_v.numpy(), np.sort(x, axis=1)[:, ::-1][:, :2])
+    np.testing.assert_array_equal(
+        paddle.argsort(_t(x), axis=1).numpy(), np.argsort(x, axis=1))
+    np.testing.assert_array_equal(
+        paddle.sort(_t(x), axis=1).numpy(), np.sort(x, axis=1))
+    np.testing.assert_array_equal(
+        paddle.argmax(_t(x), axis=1).numpy(), np.argmax(x, axis=1))
+    np.testing.assert_array_equal(
+        paddle.argmin(_t(x), axis=0).numpy(), np.argmin(x, axis=0))
+
+
+def test_where_select_pad_family():
+    x = RNG.standard_normal((3, 3)).astype(np.float32)
+    y = RNG.standard_normal((3, 3)).astype(np.float32)
+    m = x > 0
+    np.testing.assert_array_equal(
+        paddle.where(_t(m), _t(x), _t(y)).numpy(), np.where(m, x, y))
+    np.testing.assert_array_equal(
+        paddle.masked_select(_t(x), _t(m)).numpy(), x[m])
+    np.testing.assert_array_equal(
+        paddle.nn.functional.pad(_t(x[None, None]), [1, 1, 2, 2]).numpy(),
+        np.pad(x[None, None], ((0, 0), (0, 0), (2, 2), (1, 1))))
+    np.testing.assert_array_equal(
+        paddle.clip(_t(x), -0.5, 0.5).numpy(), np.clip(x, -0.5, 0.5))
+
+
+def test_unique_nonzero_eager():
+    x = np.asarray([3, 1, 3, 2, 1, 0], np.int64)
+    u = paddle.unique(_t(x))
+    np.testing.assert_array_equal(u.numpy(), np.unique(x))
+    nz = paddle.nonzero(_t(x))
+    np.testing.assert_array_equal(nz.numpy().ravel(), np.nonzero(x)[0])
+
+
+def test_diag_tril_triu_eye():
+    x = RNG.standard_normal((4, 4)).astype(np.float32)
+    np.testing.assert_array_equal(paddle.tril(_t(x)).numpy(), np.tril(x))
+    np.testing.assert_array_equal(
+        paddle.triu(_t(x), 1).numpy(), np.triu(x, 1))
+    np.testing.assert_array_equal(
+        paddle.diag(_t(np.asarray([1.0, 2.0]))).numpy(),
+        np.diag([1.0, 2.0]))
+    np.testing.assert_array_equal(paddle.eye(3, 4).numpy(), np.eye(3, 4))
+    np.testing.assert_array_equal(
+        paddle.diagonal(_t(x)).numpy(), np.diagonal(x))
