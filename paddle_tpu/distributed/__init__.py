@@ -5,11 +5,13 @@ from . import mesh  # noqa: F401
 from .auto_parallel import shard_op, shard_tensor  # noqa: F401
 from .checkpoint import load_distributed, save_distributed  # noqa: F401
 from .collective import (  # noqa: F401
-    Group, ReduceOp, all_gather, all_gather_object, all_reduce, alltoall,
-    alltoall_single, barrier, broadcast, destroy_process_group, get_group,
-    get_rank, get_world_size, init_parallel_env, irecv, is_initialized,
-    isend, new_group, recv, reduce, reduce_scatter, scatter, send, split,
-    wait,
+    Group, P2POp, ReduceOp, all_gather, all_gather_object, all_reduce,
+    all_to_all_single, alltoall, alltoall_single, barrier,
+    batch_isend_irecv, broadcast, broadcast_object_list,
+    destroy_process_group, get_group, get_rank, get_world_size,
+    init_parallel_env, irecv, is_initialized, isend, monitored_barrier,
+    new_group, recv, reduce, reduce_scatter, scatter, scatter_object_list,
+    send, split, wait,
 )
 from .parallel import DataParallel, ParallelEnv  # noqa: F401
 from .ps_dataset import (  # noqa: F401
@@ -33,9 +35,47 @@ def gloo_release():
     return None
 
 
-def spawn(func, args=(), nprocs=-1, **kwargs):
-    """Single-controller: run inline (XLA owns all local devices)."""
-    func(*args)
+def spawn(func, args=(), nprocs=-1, join=True, **kwargs):
+    """Reference: distributed/spawn.py — run ``func`` in worker processes.
+
+    nprocs <= 1 runs inline (the usual TPU case: one process per host, XLA
+    owns every local device). nprocs > 1 starts real spawn processes with
+    the PADDLE_* env contract; workers are pinned to the CPU platform (a
+    tunneled single TPU cannot be shared between processes)."""
+    if nprocs is None or nprocs <= 1:
+        func(*args)
+        return
+
+    import multiprocessing
+    import os
+
+    ctx = multiprocessing.get_context("spawn")
+    saved = {k: os.environ.get(k)
+             for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS",
+                       "PADDLE_TRAINERS_NUM", "PADDLE_TRAINER_ID")}
+    procs = []
+    try:
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+        for rank in range(nprocs):
+            os.environ["PADDLE_TRAINER_ID"] = str(rank)
+            p = ctx.Process(target=func, args=args, daemon=True)
+            p.start()
+            procs.append(p)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode != 0]
+        if bad:
+            raise RuntimeError(f"spawn workers failed: exitcodes {bad}")
+    return procs
 
 
 def launch():

@@ -292,3 +292,65 @@ def ppermute(x, axis_name, perm):
 
 def axis_index(axis_name):
     return jax.lax.axis_index(axis_name)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Single-controller: every rank already holds the same python objects
+    (reference collective.py broadcast_object_list pickles over NCCL)."""
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Single-controller: rank 0's view IS the global view; hand back the
+    first slot (reference scatters pickled slices per rank)."""
+    if in_object_list:
+        out_object_list.append(in_object_list[0])
+    return out_object_list
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
+    barrier(group)
+
+
+class P2POp:
+    """Batched p2p descriptor (reference collective.py:2378). Under SPMD
+    the batch is expressed as one ppermute; this object records intent for
+    batch_isend_irecv."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Reference collective.py:2436. Inside shard_map the isend/irecv pairs
+    coalesce into ppermute; eager single-controller they are no-ops that
+    complete immediately. Returns completed 'request' placeholders."""
+    reqs = []
+    for p in p2p_op_list:
+        p.op(p.tensor, p.peer, p.group)
+        reqs.append(p)
+    return reqs
+
+
+def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None,
+                      out_split_sizes=None, group=None, sync_op=True):
+    """Reference collective.py all_to_all_single: equal-split all-to-all on
+    one tensor. Inside shard_map → lax.all_to_all over the group axis;
+    eager single-controller → identity copy."""
+    axes = _axes(group)
+    if _in_shard_map(axes):
+        ax = axes[0]
+        n = jax.lax.axis_size(ax)
+        out = apply(lambda a: jax.lax.all_to_all(
+            a.reshape(n, -1, *a.shape[1:]), ax, split_axis=0,
+            concat_axis=0, tiled=False).reshape(a.shape), in_tensor)
+        out_tensor._data = out._data
+        out_tensor._node = out._node
+        out_tensor._out_index = out._out_index
+        return out_tensor
+    out_tensor._data = in_tensor._data
+    return out_tensor

@@ -189,3 +189,39 @@ def test_moe_sparse_dispatch_matches_dense():
     loss.backward()
     assert sparse.w_up.grad is not None
     assert np.any(sparse.w_up.grad.numpy() != 0)
+
+
+def test_collective_api_tails():
+    """broadcast/scatter object lists, P2POp/batch_isend_irecv,
+    all_to_all_single, monitored_barrier (reference collective.py tails)."""
+    import paddle_tpu.distributed as dist
+
+    objs = []
+    dist.broadcast_object_list(objs)
+    dist.scatter_object_list(objs, [["a"], ["b"]])
+    assert objs == [["a"]]
+
+    t = paddle.to_tensor(np.ones(4, np.float32))
+    ops = [dist.P2POp(dist.isend, t, 1), dist.P2POp(dist.irecv, t, 0)]
+    reqs = dist.batch_isend_irecv(ops)
+    assert len(reqs) == 2
+    out = paddle.to_tensor(np.zeros(4, np.float32))
+    dist.all_to_all_single(out, t)
+    np.testing.assert_array_equal(out.numpy(), np.ones(4, np.float32))
+    dist.monitored_barrier()
+
+
+def _spawn_worker(path):
+    import os
+
+    with open(os.path.join(path, f"r{os.environ['PADDLE_TRAINER_ID']}"),
+              "w") as f:
+        f.write(os.environ["PADDLE_TRAINERS_NUM"])
+
+
+def test_spawn_multi_process(tmp_path):
+    import paddle_tpu.distributed as dist
+
+    dist.spawn(_spawn_worker, args=(str(tmp_path),), nprocs=2)
+    assert (tmp_path / "r0").read_text() == "2"
+    assert (tmp_path / "r1").read_text() == "2"
