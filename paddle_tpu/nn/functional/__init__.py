@@ -1,6 +1,7 @@
 """paddle_tpu.nn.functional — mirrors paddle.nn.functional."""
 from .activation import *  # noqa: F401,F403
 from .attention import scaled_dot_product_attention, sparse_attention  # noqa: F401
+from .fused_ce import fused_linear_cross_entropy  # noqa: F401
 from .common import (  # noqa: F401
     alpha_dropout, bilinear, cosine_similarity, dropout, dropout2d, dropout3d,
     embedding, fold, interpolate, label_smooth, linear, one_hot, pad,

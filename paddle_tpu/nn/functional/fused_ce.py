@@ -1,0 +1,72 @@
+"""Fused LM-head linear + cross entropy, chunked over the vocabulary.
+
+Reference capability: fused softmax-cross-entropy kernels in
+paddle/phi/kernels (softmax_with_cross_entropy) applied at the LM head.
+TPU-native: the [N, V] fp32 logits tensor (1+ GB at pretraining shapes)
+never materializes — a lax.scan walks vocab chunks computing an ONLINE
+logsumexp and gathering the label logit; jax.checkpoint on the chunk body
+recomputes chunk logits in the backward, so peak memory is O(N * chunk)
+instead of O(N * V). Exact (not approximate): matches cross_entropy to
+fp32 accumulation order.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor import Tensor, apply
+
+
+def _fused_raw(hidden, w, labels, chunk):
+    """hidden [N, H] (any float dtype), w [H, V], labels [N] int -> scalar
+    mean CE."""
+    N, H = hidden.shape
+    V = w.shape[1]
+    nc = (V + chunk - 1) // chunk
+    vp = nc * chunk
+    if vp != V:
+        w = jnp.pad(w, ((0, 0), (0, vp - V)))
+    wc = w.reshape(H, nc, chunk).transpose(1, 0, 2)  # [nc, H, chunk]
+    labels = labels.astype(jnp.int32)
+
+    def body(carry, args):
+        m, s, lab_logit = carry
+        w_c, off = args
+        logits = jnp.dot(hidden, w_c,
+                         preferred_element_type=jnp.float32)  # [N, chunk]
+        col = off + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        valid = col < V
+        logits = jnp.where(valid, logits, -jnp.inf)
+        m_c = jnp.max(logits, axis=1)
+        m_new = jnp.maximum(m, m_c)
+        # guard exp(-inf - -inf): rows are never fully masked after chunk 0
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=1)
+        in_chunk = jnp.logical_and(labels >= off, labels < off + chunk)
+        idx = jnp.clip(labels - off, 0, chunk - 1)
+        picked = jnp.take_along_axis(logits, idx[:, None], axis=1)[:, 0]
+        lab_logit = lab_logit + jnp.where(in_chunk, picked, 0.0)
+        return (m_new, s, lab_logit), None
+
+    m0 = jnp.full((N,), -jnp.inf, jnp.float32)
+    s0 = jnp.zeros((N,), jnp.float32)
+    l0 = jnp.zeros((N,), jnp.float32)
+    offs = jnp.arange(nc, dtype=jnp.int32) * chunk
+    (m, s, lab_logit), _ = jax.lax.scan(
+        jax.checkpoint(body), (m0, s0, l0), (wc, offs))
+    nll = jnp.log(s) + m - lab_logit
+    return jnp.mean(nll)
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, chunk_size: int = 8192,
+                               name=None):
+    """mean CE of ``hidden @ weight`` against int ``labels`` without
+    materializing the [N, V] logits. hidden: [..., H] Tensor; weight:
+    [H, V]; labels: [...] int."""
+    def f(h, w, lab):
+        h2 = h.reshape(-1, h.shape[-1])
+        return _fused_raw(h2, w, lab.reshape(-1), int(chunk_size))
+
+    return apply(f, hidden, weight, labels)

@@ -41,6 +41,9 @@ class LlamaConfig:
     remat: bool = False
     # shard the sequence dim over the mesh "sep" axis and run ring attention
     sequence_parallel: bool = False
+    # chunked fused lm-head CE: never materializes [N, vocab] fp32 logits
+    # (nn/functional/fused_ce.py); 0 disables
+    fused_ce_chunk: int = 0
 
 
 LLAMA2_7B = LlamaConfig()
@@ -244,6 +247,12 @@ class LlamaForCausalLM(Layer):
             logits = self._logits(hidden)
             return logits, new_caches
         hidden = self.llama(input_ids, position_ids)
+        if labels is not None and self.config.fused_ce_chunk and not self.tie:
+            # next-token prediction through the chunked fused head: the
+            # [N, vocab] fp32 logits never materialize
+            return F.fused_linear_cross_entropy(
+                hidden[:, :-1], self.lm_head.weight, labels[:, 1:],
+                chunk_size=self.config.fused_ce_chunk)
         logits = self._logits(hidden)
         if labels is not None:
             # next-token prediction: logits at t score labels at t+1
