@@ -58,11 +58,12 @@ def bench_llama(backend):
     # ~0.5B params: 7B's hidden/head shapes halved, 8 layers; bf16 + flash
     # attention; activations fit without remat at batch 4 (remat costs ~30%
     # extra forward FLOPs — measured round 2).
+    fused_ce = int(os.environ.get("PADDLE_TPU_BENCH_FUSED_CE", "0"))
     cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                       intermediate_size=5504, num_hidden_layers=8,
                       num_attention_heads=16, num_key_value_heads=16,
                       max_position_embeddings=2048, dtype="bfloat16",
-                      remat=False)
+                      remat=False, fused_ce_chunk=fused_ce)
     batch, seqlen, n_steps = 4, 2048, 10
     if backend == "cpu":  # smoke mode off-TPU
         cfg = LlamaConfig(vocab_size=1024, hidden_size=256,

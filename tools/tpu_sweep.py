@@ -78,14 +78,16 @@ def sweep_step():
     from paddle_tpu.distributed.fleet import DistributedStrategy
     from paddle_tpu.text.models.llama import LlamaConfig, LlamaForCausalLM
 
-    for batch, remat, note in ((4, False, "headline"), (8, False, "b8"),
-                               (4, True, "remat")):
+    for batch, remat, fused, note in (
+            (4, False, 0, "headline"), (8, False, 0, "b8"),
+            (4, True, 0, "remat"), (4, False, 8192, "fused-ce"),
+            (8, False, 8192, "b8+fused-ce")):
         paddle_tpu.seed(0)
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                           intermediate_size=5504, num_hidden_layers=8,
                           num_attention_heads=16, num_key_value_heads=16,
                           max_position_embeddings=2048, dtype="bfloat16",
-                          remat=remat)
+                          remat=remat, fused_ce_chunk=fused)
         fleet.init(is_collective=True, strategy=DistributedStrategy())
         model = fleet.distributed_model(LlamaForCausalLM(cfg))
         n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
