@@ -1,0 +1,81 @@
+"""FFT namespace parity vs numpy.fft and distribution sampling statistics.
+
+Reference: python/paddle/fft.py (wraps fft kernels), paddle/distribution.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RNG = np.random.default_rng(5)
+
+
+def _t(a):
+    return paddle.to_tensor(a)
+
+
+FFT_CASES = [
+    ("fft", np.fft.fft), ("ifft", np.fft.ifft),
+    ("rfft", np.fft.rfft), ("fft2", np.fft.fft2),
+    ("ifft2", np.fft.ifft2),
+]
+
+
+@pytest.mark.parametrize("name,ref", FFT_CASES, ids=[c[0] for c in FFT_CASES])
+def test_fft_parity(name, ref):
+    x = RNG.standard_normal((4, 8)).astype(np.float32)
+    got = getattr(paddle.fft, name)(_t(x)).numpy()
+    want = ref(x)
+    np.testing.assert_allclose(got, want.astype(got.dtype), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_fftfreq_shift():
+    np.testing.assert_allclose(paddle.fft.fftfreq(8, d=0.5).numpy(),
+                               np.fft.fftfreq(8, 0.5), rtol=1e-6)
+    x = RNG.standard_normal((8,)).astype(np.float32)
+    np.testing.assert_allclose(paddle.fft.fftshift(_t(x)).numpy(),
+                               np.fft.fftshift(x), rtol=1e-6)
+
+
+def test_irfft_roundtrip():
+    x = RNG.standard_normal((16,)).astype(np.float32)
+    back = paddle.fft.irfft(paddle.fft.rfft(_t(x)), n=16).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+
+
+def test_stft_istft_roundtrip():
+    x = RNG.standard_normal((1, 512)).astype(np.float32)
+    spec = paddle.signal.stft(_t(x), n_fft=128, hop_length=32)
+    back = paddle.signal.istft(spec, n_fft=128, hop_length=32)
+    n = min(back.shape[-1], 512)
+    np.testing.assert_allclose(back.numpy()[0, 64:n - 64],
+                               x[0, 64:n - 64], rtol=1e-3, atol=1e-3)
+
+
+def test_normal_sampling_stats():
+    paddle.seed(7)
+    d = paddle.distribution.Normal(loc=2.0, scale=0.5)
+    s = d.sample([20000]).numpy()
+    assert abs(s.mean() - 2.0) < 0.02
+    assert abs(s.std() - 0.5) < 0.02
+    np.testing.assert_allclose(
+        float(d.log_prob(paddle.to_tensor(2.0)).numpy()),
+        -np.log(0.5 * np.sqrt(2 * np.pi)), rtol=1e-5)
+
+
+def test_categorical_sampling_stats():
+    paddle.seed(8)
+    probs = np.asarray([0.1, 0.2, 0.7], np.float32)
+    d = paddle.distribution.Categorical(paddle.to_tensor(np.log(probs)))
+    s = d.sample([30000]).numpy()
+    freq = np.bincount(s.ravel().astype(int), minlength=3) / s.size
+    np.testing.assert_allclose(freq, probs, atol=0.02)
+
+
+def test_kl_divergence_normal():
+    p = paddle.distribution.Normal(0.0, 1.0)
+    q = paddle.distribution.Normal(1.0, 2.0)
+    kl = float(paddle.distribution.kl_divergence(p, q).numpy())
+    want = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    np.testing.assert_allclose(kl, want, rtol=1e-5)
