@@ -1,0 +1,71 @@
+"""Vision transforms (reference: python/paddle/vision/transforms)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms as T
+
+RNG = np.random.default_rng(3)
+
+
+def _img(h=16, w=12, c=3):
+    return RNG.integers(0, 256, (h, w, c), dtype=np.uint8)
+
+
+def test_to_tensor_and_normalize():
+    img = _img()
+    t = T.ToTensor()(img)
+    arr = np.asarray(t._data if hasattr(t, "_data") else t)
+    assert arr.shape == (3, 16, 12)
+    assert arr.max() <= 1.0 + 1e-6
+    norm = T.Normalize(mean=[0.5] * 3, std=[0.5] * 3)(arr)
+    narr = np.asarray(norm._data if hasattr(norm, "_data") else norm)
+    np.testing.assert_allclose(narr, (arr - 0.5) / 0.5, rtol=1e-5)
+
+
+def test_resize_and_crops():
+    img = _img(32, 32)
+    assert np.asarray(T.Resize(16)(img)).shape[:2] == (16, 16)
+    assert np.asarray(T.CenterCrop(8)(img)).shape[:2] == (8, 8)
+    assert np.asarray(T.RandomCrop(8)(img)).shape[:2] == (8, 8)
+    assert np.asarray(T.RandomResizedCrop(8)(img)).shape[:2] == (8, 8)
+
+
+def test_flips_deterministic():
+    img = _img(4, 4)
+    np.testing.assert_array_equal(
+        np.asarray(T.RandomHorizontalFlip(prob=1.0)(img)), img[:, ::-1])
+    np.testing.assert_array_equal(
+        np.asarray(T.RandomVerticalFlip(prob=1.0)(img)), img[::-1])
+
+
+def test_compose_pipeline():
+    pipe = T.Compose([T.Resize(20), T.CenterCrop(16), T.ToTensor(),
+                      T.Normalize(mean=[0.0] * 3, std=[1.0] * 3)])
+    out = pipe(_img(33, 27))
+    arr = np.asarray(out._data if hasattr(out, "_data") else out)
+    assert arr.shape == (3, 16, 16)
+
+
+def test_functional_pad_crop():
+    img = _img(8, 8)
+    padded = np.asarray(T.pad(img, 2))
+    assert padded.shape[:2] == (12, 12)
+    crop = np.asarray(T.crop(img, 2, 3, 4, 5))
+    np.testing.assert_array_equal(crop, img[2:6, 3:8])
+
+
+def test_watchdog_nan_and_stall():
+    import pytest
+
+    from paddle_tpu.utils.watchdog import TrainingWatchdog
+
+    events = []
+    wd = TrainingWatchdog(step_timeout_s=1e9, nan_patience=2,
+                          on_nan=lambda streak: events.append(("nan",
+                                                               streak)))
+    assert wd.step(1.0)
+    assert not wd.step(float("nan"))
+    with pytest.raises(FloatingPointError):
+        wd.step(float("nan"))
+    assert events == [("nan", 1), ("nan", 2)]
+    assert wd.stats["nan_steps"] == 2
