@@ -1,0 +1,62 @@
+"""Checkpoint interop: load HuggingFace/torch Llama weights.
+
+Reference pairing: PaddleNLP's `from_pretrained` conversion utilities
+(torch -> paddle state dict mapping). The mapping here is HF
+LlamaForCausalLM -> paddle_tpu LlamaForCausalLM:
+
+* HF linear weights are [out, in]; paddle-convention Linears store
+  [in, out] -> transpose.
+* rotary convention matches (half-split rotate, not interleaved).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+_LINEAR_SUFFIXES = (
+    "q_proj.weight", "k_proj.weight", "v_proj.weight", "o_proj.weight",
+    "gate_proj.weight", "up_proj.weight", "down_proj.weight",
+)
+
+
+def convert_hf_llama_state_dict(hf_state: dict) -> dict:
+    """HF LlamaForCausalLM state dict (torch tensors or numpy arrays) ->
+    paddle_tpu LlamaForCausalLM state dict (numpy arrays)."""
+    out = {}
+    for name, val in hf_state.items():
+        arr = np.asarray(getattr(val, "detach", lambda: val)())
+        if name.startswith("model."):
+            ours = "llama." + name[len("model."):]
+        elif name == "lm_head.weight":
+            ours = "lm_head.weight"
+            arr = arr.T  # [V, H] -> [H, V]
+            out[ours] = arr
+            continue
+        else:
+            ours = name
+        if ours.endswith(_LINEAR_SUFFIXES):
+            arr = arr.T  # torch [out, in] -> paddle [in, out]
+        if "rotary_emb" in ours:
+            continue  # computed on the fly
+        out[ours] = arr
+    return out
+
+
+def load_hf_llama_weights(model, hf_state: dict, strict: bool = True):
+    """Copy converted HF weights into a paddle_tpu LlamaForCausalLM."""
+    converted = convert_hf_llama_state_dict(hf_state)
+    params = dict(model.named_parameters())
+    missing = [k for k in params if k not in converted]
+    unexpected = [k for k in converted if k not in params]
+    if strict and (missing or unexpected):
+        raise ValueError(f"state dict mismatch: missing={missing[:5]} "
+                         f"unexpected={unexpected[:5]}")
+    for k, p in params.items():
+        if k in converted:
+            src = converted[k]
+            if tuple(src.shape) != tuple(p._data.shape):
+                raise ValueError(
+                    f"{k}: shape {src.shape} != {tuple(p._data.shape)}")
+            p._data = jnp.asarray(src, dtype=p._data.dtype)
+    return model

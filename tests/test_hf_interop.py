@@ -1,0 +1,46 @@
+"""HF/torch checkpoint interop: our Llama must reproduce transformers'
+logits given converted weights (PaddleNLP from_pretrained analog)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text.models.convert import (convert_hf_llama_state_dict,
+                                            load_hf_llama_weights)
+from paddle_tpu.text.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def test_hf_llama_logits_parity():
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=172,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False, attn_implementation="eager")
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    hf.eval()
+
+    ours = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=172,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, dtype="float32"))
+    load_hf_llama_weights(ours, hf.state_dict())
+    ours.eval()
+
+    ids = np.random.default_rng(0).integers(0, 128, (2, 10)).astype(np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    got = np.asarray(ours(paddle.to_tensor(ids.astype(np.int32)))._data)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_convert_transposes_linears():
+    sd = {"model.layers.0.self_attn.q_proj.weight": np.zeros((8, 4)),
+          "model.norm.weight": np.ones((4,)),
+          "lm_head.weight": np.zeros((16, 4))}
+    out = convert_hf_llama_state_dict(sd)
+    assert out["llama.layers.0.self_attn.q_proj.weight"].shape == (4, 8)
+    assert out["lm_head.weight"].shape == (4, 16)
+    assert out["llama.norm.weight"].shape == (4,)
