@@ -43,6 +43,65 @@ def convert_hf_llama_state_dict(hf_state: dict) -> dict:
     return out
 
 
+_BERT_LAYER_MAP = {
+    "attention.self.query": "self_attn.q_proj",
+    "attention.self.key": "self_attn.k_proj",
+    "attention.self.value": "self_attn.v_proj",
+    "attention.output.dense": "self_attn.out_proj",
+    "attention.output.LayerNorm": "norm1",
+    "intermediate.dense": "linear1",
+    "output.dense": "linear2",
+    "output.LayerNorm": "norm2",
+}
+
+
+def convert_hf_bert_state_dict(hf_state: dict) -> dict:
+    """HF BertModel state dict -> paddle_tpu BertModel state dict."""
+    out = {}
+    for name, val in hf_state.items():
+        arr = np.asarray(getattr(val, "detach", lambda: val)())
+        ours = name
+        if ours.startswith("bert."):
+            ours = ours[len("bert."):]
+        if ours.startswith("embeddings."):
+            ours = ours.replace("LayerNorm", "layer_norm")
+        elif ours.startswith("encoder.layer."):
+            parts = ours.split(".")
+            idx = parts[2]
+            rest = ".".join(parts[3:-1])  # drop weight/bias suffix
+            suffix = parts[-1]
+            mapped = _BERT_LAYER_MAP.get(rest)
+            if mapped is None:
+                continue
+            ours = f"encoder.layers.{idx}.{mapped}.{suffix}"
+        elif "position_ids" in ours:
+            continue
+        if ours.endswith(".weight") and arr.ndim == 2 \
+                and "embeddings" not in ours:
+            arr = arr.T  # torch Linear [out, in] -> paddle [in, out]
+        out[ours] = arr
+    return out
+
+
+def load_hf_bert_weights(model, hf_state: dict, strict: bool = True):
+    """Copy converted HF BertModel weights into paddle_tpu BertModel."""
+    converted = convert_hf_bert_state_dict(hf_state)
+    params = dict(model.named_parameters())
+    missing = [k for k in params if k not in converted]
+    unexpected = [k for k in converted if k not in params]
+    if strict and (missing or unexpected):
+        raise ValueError(f"state dict mismatch: missing={missing[:6]} "
+                         f"unexpected={unexpected[:6]}")
+    for k, p in params.items():
+        if k in converted:
+            src = converted[k]
+            if tuple(src.shape) != tuple(p._data.shape):
+                raise ValueError(
+                    f"{k}: shape {src.shape} != {tuple(p._data.shape)}")
+            p._data = jnp.asarray(src, dtype=p._data.dtype)
+    return model
+
+
 def load_hf_llama_weights(model, hf_state: dict, strict: bool = True):
     """Copy converted HF weights into a paddle_tpu LlamaForCausalLM."""
     converted = convert_hf_llama_state_dict(hf_state)

@@ -44,3 +44,40 @@ def test_convert_transposes_linears():
     assert out["llama.layers.0.self_attn.q_proj.weight"].shape == (4, 8)
     assert out["lm_head.weight"].shape == (4, 16)
     assert out["llama.norm.weight"].shape == (4,)
+
+
+def test_hf_bert_hidden_states_parity():
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+
+    from paddle_tpu.text.models.bert import BertConfig, BertModel
+    from paddle_tpu.text.models.convert import load_hf_bert_weights
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=96, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=32, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        attn_implementation="eager")
+    torch.manual_seed(1)
+    hf = transformers.BertModel(hf_cfg)
+    hf.eval()
+
+    ours = BertModel(BertConfig(
+        vocab_size=96, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=32, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+    load_hf_bert_weights(ours, hf.state_dict())
+    ours.eval()
+
+    ids = np.random.default_rng(1).integers(0, 96, (2, 12)).astype(np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids))
+    seq, pooled = ours(paddle.to_tensor(ids.astype(np.int32)))
+    np.testing.assert_allclose(np.asarray(seq._data),
+                               ref.last_hidden_state.numpy(),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(pooled._data),
+                               ref.pooler_output.numpy(),
+                               rtol=2e-4, atol=2e-4)
