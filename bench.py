@@ -326,6 +326,9 @@ def main():
     tokens_per_sec = headline["tokens_per_sec"]
     best = _best_previous()
     vs = tokens_per_sec / best if best > 0 else 1.0
+    if backend == "tpu" and vs < 0.95:
+        print(f"PERF REGRESSION: {tokens_per_sec} tok/s vs best {best} "
+              f"(ratio {vs:.3f} < 0.95)", file=sys.stderr)
 
     print(json.dumps({
         "metric": f"llama-0.5B pretrain tokens/sec/chip "

@@ -13,9 +13,19 @@ import threading
 
 import jax
 
-_key = jax.random.PRNGKey(0)
+# lazily initialized: creating a PRNGKey at import time would initialize
+# the jax backend (and block on a tunneled TPU) before the user runs
+# anything
+_key = None
 _seed_value = 0
 _tls = threading.local()
+
+
+def _global_key():
+    global _key
+    if _key is None:
+        _key = jax.random.PRNGKey(_seed_value)
+    return _key
 
 
 def seed(value: int):
@@ -42,7 +52,7 @@ def next_key():
         stack[-1], sub = jax.random.split(stack[-1])
         return sub
     global _key
-    _key, sub = jax.random.split(_key)
+    _key, sub = jax.random.split(_global_key())
     return sub
 
 
@@ -60,7 +70,7 @@ def functional_key(key):
 
 
 def get_rng_state():
-    return _key
+    return _global_key()
 
 
 def set_rng_state(state):
