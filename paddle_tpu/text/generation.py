@@ -174,6 +174,179 @@ def _generate_jit(w, input_ids, prompt_len_mask, key, *, n_heads, n_kv, eps,
     return jnp.concatenate([input_ids, out], axis=1)
 
 
+# ---------------------------------------------------------------------------
+# GPT (pre-LN, learned positions, combined qkv)
+# ---------------------------------------------------------------------------
+
+def _gpt_stacked_weights(model):
+    blocks = model.gpt.blocks
+
+    def st(get):
+        return jnp.stack([get(b) for b in blocks])
+
+    w = {
+        "wqkv": st(lambda b: b.qkv.weight._data),
+        "bqkv": st(lambda b: b.qkv.bias._data),
+        "wproj": st(lambda b: b.proj.weight._data),
+        "bproj": st(lambda b: b.proj.bias._data),
+        "ln1w": st(lambda b: b.ln_1.weight._data),
+        "ln1b": st(lambda b: b.ln_1.bias._data),
+        "ln2w": st(lambda b: b.ln_2.weight._data),
+        "ln2b": st(lambda b: b.ln_2.bias._data),
+        "wfc1": st(lambda b: b.fc1.weight._data),
+        "bfc1": st(lambda b: b.fc1.bias._data),
+        "wfc2": st(lambda b: b.fc2.weight._data),
+        "bfc2": st(lambda b: b.fc2.bias._data),
+    }
+    w["wte"] = model.gpt.wte.weight._data
+    w["wpe"] = model.gpt.wpe.weight._data
+    w["lnfw"] = model.gpt.ln_f.weight._data
+    w["lnfb"] = model.gpt.ln_f.bias._data
+    w["head"] = model.lm_head.weight._data
+    return w
+
+
+def _ln(x, w, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=-1, keepdims=True)
+    v = jnp.var(xf, axis=-1, keepdims=True)
+    return (((xf - m) * jax.lax.rsqrt(v + eps)).astype(x.dtype) * w + b)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_heads", "max_new", "do_sample", "top_k", "eos_id"))
+def _gpt_generate_jit(w, input_ids, key, *, n_heads, max_new, do_sample,
+                      top_k, eos_id, temperature):
+    B, L0 = input_ids.shape
+    h = w["wte"].shape[1]
+    hd = h // n_heads
+    T = L0 + max_new
+    dt = w["wte"].dtype
+
+    def split_heads(x, L):
+        return x.reshape(B, L, n_heads, hd)
+
+    def attn_full(q, k, v, L):
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                       preferred_element_type=jnp.float32) / jnp.sqrt(
+                           jnp.float32(hd))
+        cm = jnp.tril(jnp.ones((L, L), bool))
+        s = jnp.where(cm, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(dt)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+        return jnp.swapaxes(o, 1, 2).reshape(B, L, h)
+
+    pos = jnp.arange(L0)
+    x = jnp.take(w["wte"], input_ids, axis=0) + w["wpe"][pos][None]
+    kcache = jnp.zeros((w["wqkv"].shape[0], B, T, n_heads, hd), dt)
+    vcache = jnp.zeros_like(kcache)
+
+    stack_keys = ("wqkv", "bqkv", "wproj", "bproj", "ln1w", "ln1b", "ln2w",
+                  "ln2b", "wfc1", "bfc1", "wfc2", "bfc2")
+    stack = {k: w[k] for k in stack_keys}
+
+    def one_prefill(x, lw):
+        hN = _ln(x, lw["ln1w"], lw["ln1b"])
+        qkv = hN @ lw["wqkv"] + lw["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (split_heads(t, L0) for t in (q, k, v))
+        o = attn_full(q, k, v, L0)
+        x = x + o @ lw["wproj"] + lw["bproj"]
+        h2 = _ln(x, lw["ln2w"], lw["ln2b"])
+        x = x + jax.nn.gelu(h2 @ lw["wfc1"] + lw["bfc1"],
+                            approximate=False) @ lw["wfc2"] + lw["bfc2"]
+        return x, (k, v)
+
+    x, kvs = jax.lax.scan(one_prefill, x, stack)
+    kcache = kcache.at[:, :, :L0].set(kvs[0])
+    vcache = vcache.at[:, :, :L0].set(kvs[1])
+
+    logits0 = _ln(x[:, -1], w["lnfw"], w["lnfb"]) @ w["head"]
+
+    def sample(logits, key):
+        logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+        if not do_sample:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if top_k:
+            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    key, sk = jax.random.split(key)
+    tok0 = sample(logits0, sk)
+    out = jnp.zeros((B, max_new), jnp.int32).at[:, 0].set(tok0)
+    done0 = (tok0 == eos_id) if eos_id is not None else jnp.zeros((B,), bool)
+
+    def decode_step(carry, _):
+        tok, cur_pos, kcache, vcache, key, done = carry
+        xt = (jnp.take(w["wte"], tok, axis=0)
+              + w["wpe"][cur_pos][None])[:, None]
+
+        def one(cx, lw_kv):
+            xt, kc_l, vc_l = cx["x"], lw_kv["kc"], lw_kv["vc"]
+            lw = lw_kv
+            hN = _ln(xt, lw["ln1w"], lw["ln1b"])
+            qkv = hN @ lw["wqkv"] + lw["bqkv"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, 1, n_heads, hd)
+            k = k.reshape(B, 1, n_heads, hd)
+            v = v.reshape(B, 1, n_heads, hd)
+            kc_l = jax.lax.dynamic_update_slice(kc_l, k, (0, cur_pos, 0, 0))
+            vc_l = jax.lax.dynamic_update_slice(vc_l, v, (0, cur_pos, 0, 0))
+            s = jnp.einsum("bhd,bthd->bht", q[:, 0], kc_l,
+                           preferred_element_type=jnp.float32) / jnp.sqrt(
+                               jnp.float32(hd))
+            valid = jnp.arange(T) <= cur_pos
+            s = jnp.where(valid[None, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(dt)
+            o = jnp.einsum("bht,bthd->bhd", p, vc_l).reshape(B, 1, h)
+            xt2 = xt + o @ lw["wproj"] + lw["bproj"]
+            h2 = _ln(xt2, lw["ln2w"], lw["ln2b"])
+            xt2 = xt2 + jax.nn.gelu(h2 @ lw["wfc1"] + lw["bfc1"],
+                                    approximate=False) @ lw["wfc2"] \
+                + lw["bfc2"]
+            return {"x": xt2}, (kc_l, vc_l)
+
+        lw_kv = dict(stack)
+        lw_kv["kc"] = kcache
+        lw_kv["vc"] = vcache
+        cx, (kcache, vcache) = jax.lax.scan(one, {"x": xt}, lw_kv)
+        logits = _ln(cx["x"][:, 0], w["lnfw"], w["lnfb"]) @ w["head"]
+        key, sk = jax.random.split(key)
+        nxt = sample(logits, sk)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = jnp.logical_or(done, nxt == eos_id)
+        return (nxt, cur_pos + 1, kcache, vcache, key, done), nxt
+
+    if max_new > 1:
+        carry = (tok0, jnp.int32(L0), kcache, vcache, key, done0)
+        _, toks = jax.lax.scan(decode_step, carry, jnp.arange(1, max_new))
+        out = out.at[:, 1:].set(jnp.swapaxes(toks, 0, 1))
+    return jnp.concatenate([input_ids, out], axis=1)
+
+
+def gpt_generate(model, input_ids, max_new_tokens: int = 32,
+                 do_sample: bool = False, top_k: int = 0,
+                 temperature: float = 1.0,
+                 eos_token_id: Optional[int] = None, seed: int = 0):
+    """Greedy / top-k generation for GPTForCausalLM (same static-cache
+    design as the Llama path)."""
+    ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(
+        input_ids)
+    w = _gpt_stacked_weights(model)
+    out = _gpt_generate_jit(
+        w, ids.astype(jnp.int32), jax.random.PRNGKey(seed),
+        n_heads=model.config.num_attention_heads,
+        max_new=int(max_new_tokens), do_sample=bool(do_sample),
+        top_k=int(top_k), eos_id=eos_token_id,
+        temperature=jnp.float32(temperature))
+    return Tensor(out)
+
+
 def generate(model, input_ids, max_new_tokens: int = 32,
              do_sample: bool = False, top_k: int = 0,
              temperature: float = 1.0,

@@ -99,3 +99,13 @@ class GPTForCausalLM(Layer):
                         (-1, self.config.vocab_size)).astype("float32"),
                 reshape(labels[:, 1:], (-1,)))
         return logits
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 top_k=0, temperature=1.0, eos_token_id=None, seed=0):
+        """Jitted static-KV-cache decode (text/generation.py gpt path)."""
+        from ..generation import gpt_generate
+        return gpt_generate(self, input_ids,
+                            max_new_tokens=max_new_tokens,
+                            do_sample=do_sample, top_k=top_k,
+                            temperature=temperature,
+                            eos_token_id=eos_token_id, seed=seed)

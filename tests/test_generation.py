@@ -61,3 +61,16 @@ def test_sampled_generation_runs():
     assert arr.shape == (2, 9)
     assert (arr[:, :4] == 0).all()
     assert (arr >= 0).all() and (arr < CFG.vocab_size).all()
+
+
+def test_gpt_greedy_matches_full_context():
+    from paddle_tpu.text.models.gpt import GPT_TINY, GPTForCausalLM
+
+    paddle.seed(0)
+    model = GPTForCausalLM(GPT_TINY)
+    model.eval()
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, GPT_TINY.vocab_size, (2, 7)).astype(np.int32)
+    want = _naive_greedy(model, prompt, 5)
+    got = model.generate(paddle.to_tensor(prompt), max_new_tokens=5)
+    np.testing.assert_array_equal(np.asarray(got._data), want)
