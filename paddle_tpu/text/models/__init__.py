@@ -11,3 +11,7 @@ from .llama import (  # noqa: F401
     LlamaModel,
 )
 from .llama_pipe import LlamaForCausalLMPipe  # noqa: F401
+from .t5 import (  # noqa: F401
+    T5_TINY, T5Config, T5ForConditionalGeneration, T5Model,
+)
+from . import convert  # noqa: F401

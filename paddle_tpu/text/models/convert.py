@@ -102,6 +102,85 @@ def load_hf_bert_weights(model, hf_state: dict, strict: bool = True):
     return model
 
 
+def _t5_map_layer(parts, is_decoder):
+    """HF t5 block sublayer path -> ours."""
+    sub = parts[0]  # "0"/"1"/"2"
+    rest = parts[1:]
+    if sub == "0":
+        if rest[0] == "SelfAttention":
+            if rest[1] == "relative_attention_bias":
+                return "self_attn.relative_attention_bias." + rest[-1]
+            return f"self_attn.{rest[1]}.{rest[-1]}"
+        if rest[0] == "layer_norm":
+            return "ln1." + rest[-1]
+    if is_decoder and sub == "1":
+        if rest[0] == "EncDecAttention":
+            return f"cross_attn.{rest[1]}.{rest[-1]}"
+        if rest[0] == "layer_norm":
+            return "ln_cross." + rest[-1]
+    # feed-forward sublayer: 1 (encoder) or 2 (decoder)
+    if rest[0] == "DenseReluDense":
+        return f"ff.{rest[1]}.{rest[-1]}"
+    if rest[0] == "layer_norm":
+        return "ln2." + rest[-1]
+    return None
+
+
+def convert_hf_t5_state_dict(hf_state: dict) -> dict:
+    """HF T5ForConditionalGeneration state dict -> paddle_tpu T5."""
+    out = {}
+    for name, val in hf_state.items():
+        arr = np.asarray(getattr(val, "detach", lambda: val)())
+        parts = name.split(".")
+        ours = None
+        if name == "shared.weight":
+            ours = "t5.shared.weight"
+        elif name == "lm_head.weight":
+            ours = "lm_head.weight"
+            arr = arr.T
+            out[ours] = arr
+            continue
+        elif parts[0] in ("encoder", "decoder"):
+            if parts[1] == "embed_tokens":
+                continue  # alias of shared
+            if parts[1] == "final_layer_norm":
+                ours = f"t5.{parts[0]}.final_layer_norm.{parts[-1]}"
+            elif parts[1] == "block":
+                # encoder.block.<i>.layer.<j>.<Module>...
+                mapped = _t5_map_layer(parts[4:], parts[0] == "decoder")
+                if mapped is None:
+                    continue
+                ours = f"t5.{parts[0]}.blocks.{parts[2]}.{mapped}"
+        if ours is None:
+            continue
+        if (ours.endswith(".weight") and arr.ndim == 2
+                and "shared" not in ours
+                and "relative_attention_bias" not in ours):
+            arr = arr.T
+        out[ours] = arr
+    return out
+
+
+def load_hf_t5_weights(model, hf_state: dict, strict: bool = True):
+    converted = convert_hf_t5_state_dict(hf_state)
+    params = dict(model.named_parameters())
+    missing = [k for k in params if k not in converted]
+    # tied models carry lm_head.weight as an alias of shared — ignore
+    unexpected = [k for k in converted
+                  if k not in params and k != "lm_head.weight"]
+    if strict and (missing or unexpected):
+        raise ValueError(f"state dict mismatch: missing={missing[:6]} "
+                         f"unexpected={unexpected[:6]}")
+    for k, p in params.items():
+        if k in converted:
+            src = converted[k]
+            if tuple(src.shape) != tuple(p._data.shape):
+                raise ValueError(
+                    f"{k}: shape {src.shape} != {tuple(p._data.shape)}")
+            p._data = jnp.asarray(src, dtype=p._data.dtype)
+    return model
+
+
 def load_hf_llama_weights(model, hf_state: dict, strict: bool = True):
     """Copy converted HF weights into a paddle_tpu LlamaForCausalLM."""
     converted = convert_hf_llama_state_dict(hf_state)

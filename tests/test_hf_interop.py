@@ -81,3 +81,60 @@ def test_hf_bert_hidden_states_parity():
     np.testing.assert_allclose(np.asarray(pooled._data),
                                ref.pooler_output.numpy(),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_hf_t5_logits_parity():
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+
+    from paddle_tpu.text.models.convert import load_hf_t5_weights
+    from paddle_tpu.text.models.t5 import T5Config, T5ForConditionalGeneration
+
+    hf_cfg = transformers.T5Config(
+        vocab_size=120, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+        num_decoder_layers=2, num_heads=4,
+        relative_attention_num_buckets=8,
+        relative_attention_max_distance=20, dropout_rate=0.0,
+        tie_word_embeddings=True, decoder_start_token_id=0, pad_token_id=0)
+    torch.manual_seed(2)
+    hf = transformers.T5ForConditionalGeneration(hf_cfg)
+    hf.eval()
+
+    ours = T5ForConditionalGeneration(T5Config(
+        vocab_size=120, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+        num_decoder_layers=2, num_heads=4,
+        relative_attention_num_buckets=8,
+        relative_attention_max_distance=20, tie_word_embeddings=True))
+    load_hf_t5_weights(ours, hf.state_dict())
+    ours.eval()
+
+    rng = np.random.default_rng(4)
+    enc_ids = rng.integers(1, 120, (2, 9)).astype(np.int64)
+    dec_ids = rng.integers(1, 120, (2, 6)).astype(np.int64)
+    with torch.no_grad():
+        ref = hf(input_ids=torch.from_numpy(enc_ids),
+                 decoder_input_ids=torch.from_numpy(dec_ids)).logits.numpy()
+    got = np.asarray(ours(paddle.to_tensor(enc_ids.astype(np.int32)),
+                          decoder_input_ids=paddle.to_tensor(
+                              dec_ids.astype(np.int32)))._data)
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_t5_trains_with_labels():
+    from paddle_tpu.text.models.t5 import T5_TINY, T5ForConditionalGeneration
+    from paddle_tpu import optimizer as optim
+
+    paddle.seed(0)
+    model = T5ForConditionalGeneration(T5_TINY)
+    opt = optim.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    rng = np.random.default_rng(5)
+    src = paddle.to_tensor(rng.integers(1, 256, (4, 12)).astype(np.int32))
+    tgt = paddle.to_tensor(rng.integers(1, 256, (4, 8)).astype(np.int32))
+    losses = []
+    for _ in range(4):
+        loss = model(src, labels=tgt)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0], losses
