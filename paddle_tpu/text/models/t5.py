@@ -250,3 +250,52 @@ class T5ForConditionalGeneration(Layer):
                 reshape(logits, (-1, c.vocab_size)).astype("float32"),
                 reshape(labels, (-1,)), ignore_index=-100)
         return logits
+
+    def generate(self, input_ids, max_new_tokens=32, eos_token_id=1,
+                 name=None):
+        """Greedy seq2seq decode (HF t5.generate greedy analog): ONE jitted
+        program — the decoder runs on a padded [B, max_new] buffer inside a
+        lax.scan, masking future positions, so shapes stay static (no
+        per-length recompiles). O(n^2) decoder compute; fine at seq2seq
+        generation lengths."""
+        import jax
+        import jax.numpy as jnp
+
+        c = self.config
+        ids = input_ids._data if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        ids = ids.astype(jnp.int32)
+        params = dict(self.named_parameters())
+        pv = {k: p._data for k, p in params.items()}
+        from ...autograd.tape import functional_mode
+        from ...jit.api import _swap_params
+
+        M = int(max_new_tokens)
+
+        def run(pv, ids):
+            with functional_mode(), _swap_params(params, pv):
+                B = ids.shape[0]
+                dec = jnp.full((B, M + 1), c.pad_token_id, jnp.int32)
+                dec = dec.at[:, 0].set(c.decoder_start_token_id)
+                done0 = jnp.zeros((B,), bool)
+
+                def step(carry, t):
+                    dec, done = carry
+                    logits = self(Tensor(ids),
+                                  decoder_input_ids=Tensor(dec))._data
+                    nxt = jnp.argmax(
+                        logits.astype(jnp.float32), axis=-1)
+                    tok = jnp.take_along_axis(
+                        nxt, t[None, None].repeat(B, 0), axis=1)[:, 0]
+                    tok = tok.astype(jnp.int32)
+                    if eos_token_id is not None:
+                        tok = jnp.where(done, eos_token_id, tok)
+                        done = jnp.logical_or(done, tok == eos_token_id)
+                    dec = dec.at[:, t + 1].set(tok)
+                    return (dec, done), None
+
+                (dec, _), _ = jax.lax.scan(step, (dec, done0),
+                                           jnp.arange(M))
+                return dec
+
+        return Tensor(jax.jit(run)(pv, ids))
