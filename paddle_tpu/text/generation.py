@@ -175,6 +175,168 @@ def _generate_jit(w, input_ids, prompt_len_mask, key, *, n_heads, n_kv, eps,
 
 
 # ---------------------------------------------------------------------------
+# beam search (Llama decoder)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_heads", "n_kv", "eps", "theta", "max_new", "num_beams", "eos_id"))
+def _beam_generate_jit(w, input_ids, *, n_heads, n_kv, eps, theta, max_new,
+                       num_beams, eos_id, length_penalty):
+    """Beam search with the same static cache design: beams fold into the
+    batch dim; caches reorder by beam index each step (HF/PaddleNLP
+    BeamSearchScorer semantics, length-penalized log-prob)."""
+    B, L0 = input_ids.shape
+    K = num_beams
+    h = w["embed"].shape[1]
+    hd = h // n_heads
+    T = L0 + max_new
+    nL = w["wq"].shape[0]
+    dt = w["embed"].dtype
+    NEG = jnp.float32(-1e9)
+
+    # ---- prefill once per batch row, then tile to beams ----
+    x = jnp.take(w["embed"], input_ids, axis=0)
+    pos = jnp.arange(L0)
+    stack = {k: w[k] for k in
+             ("wq", "wk", "wv", "wo", "wg", "wu", "wd", "ln1", "ln2")}
+
+    def one_prefill(x, lw):
+        h1 = _rms(x, lw["ln1"], eps)
+        q = (h1 @ lw["wq"]).reshape(B, L0, n_heads, hd)
+        k = (h1 @ lw["wk"]).reshape(B, L0, n_kv, hd)
+        v = (h1 @ lw["wv"]).reshape(B, L0, n_kv, hd)
+        q, k = _rope(q, k, pos, theta, dt)
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.repeat(jnp.swapaxes(k, 1, 2), n_heads // n_kv, axis=1)
+        vh = jnp.repeat(jnp.swapaxes(v, 1, 2), n_heads // n_kv, axis=1)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                       preferred_element_type=jnp.float32) / jnp.sqrt(
+                           jnp.float32(hd))
+        cm = jnp.tril(jnp.ones((L0, L0), bool))
+        s = jnp.where(cm, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(dt)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+        o = jnp.swapaxes(o, 1, 2).reshape(B, L0, h)
+        x = x + o @ lw["wo"]
+        h2 = _rms(x, lw["ln2"], eps)
+        x = x + (jax.nn.silu(h2 @ lw["wg"]) * (h2 @ lw["wu"])) @ lw["wd"]
+        return x, (k, v)
+
+    x, kvs = jax.lax.scan(one_prefill, x, stack)
+    kcache = jnp.zeros((nL, B * K, T, n_kv, hd), dt)
+    vcache = jnp.zeros_like(kcache)
+    kcache = kcache.at[:, :, :L0].set(jnp.repeat(kvs[0], K, axis=1))
+    vcache = vcache.at[:, :, :L0].set(jnp.repeat(kvs[1], K, axis=1))
+
+    hidden = _rms(x[:, -1], w["norm"], eps)
+    logp0 = jax.nn.log_softmax(
+        (hidden @ w["head"]).astype(jnp.float32), axis=-1)   # [B, V]
+    V = logp0.shape[-1]
+    top0, tok0 = jax.lax.top_k(logp0, K)                     # [B, K]
+    scores = top0                                            # [B, K]
+    toks = jnp.zeros((B, K, max_new), jnp.int32).at[..., 0].set(tok0)
+    done = (tok0 == eos_id) if eos_id is not None else jnp.zeros((B, K),
+                                                                 bool)
+
+    def decode_step(carry, i):
+        toks, scores, cur_pos, kcache, vcache, done = carry
+        tok = jax.lax.dynamic_index_in_dim(toks, i - 1, 2, False)  # [B,K]
+        xt = jnp.take(w["embed"], tok.reshape(B * K), axis=0)[:, None]
+
+        def one(cx, lw_kv):
+            xt, kc_l, vc_l = cx["x"], lw_kv["kc"], lw_kv["vc"]
+            lw = lw_kv
+            h1 = _rms(xt, lw["ln1"], eps)
+            q = (h1 @ lw["wq"]).reshape(B * K, 1, n_heads, hd)
+            k = (h1 @ lw["wk"]).reshape(B * K, 1, n_kv, hd)
+            v = (h1 @ lw["wv"]).reshape(B * K, 1, n_kv, hd)
+            q, k = _rope(q, k, cur_pos[None], theta, dt)
+            kc_l = jax.lax.dynamic_update_slice(kc_l, k, (0, cur_pos, 0, 0))
+            vc_l = jax.lax.dynamic_update_slice(vc_l, v, (0, cur_pos, 0, 0))
+            kh = jnp.repeat(kc_l, n_heads // n_kv, axis=2)
+            vh = jnp.repeat(vc_l, n_heads // n_kv, axis=2)
+            s = jnp.einsum("bhd,bthd->bht", q[:, 0], kh,
+                           preferred_element_type=jnp.float32) / jnp.sqrt(
+                               jnp.float32(hd))
+            valid = jnp.arange(T) <= cur_pos
+            s = jnp.where(valid[None, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(dt)
+            o = jnp.einsum("bht,bthd->bhd", p, vh).reshape(B * K, 1, h)
+            xt2 = xt + o @ lw["wo"]
+            h2 = _rms(xt2, lw["ln2"], eps)
+            xt2 = xt2 + (jax.nn.silu(h2 @ lw["wg"])
+                         * (h2 @ lw["wu"])) @ lw["wd"]
+            return {"x": xt2}, (kc_l, vc_l)
+
+        lw_kv = dict(stack)
+        lw_kv["kc"] = kcache
+        lw_kv["vc"] = vcache
+        cx, (kcache, vcache) = jax.lax.scan(one, {"x": xt}, lw_kv)
+        hidden = _rms(cx["x"][:, 0], w["norm"], eps)
+        logp = jax.nn.log_softmax(
+            (hidden @ w["head"]).astype(jnp.float32),
+            axis=-1).reshape(B, K, V)
+        if eos_id is not None:
+            # finished beams may only extend with eos at unchanged score
+            frozen = jnp.full((V,), NEG).at[eos_id].set(0.0)
+            logp = jnp.where(done[..., None], frozen[None, None, :], logp)
+        total = scores[..., None] + logp                      # [B, K, V]
+        flat = total.reshape(B, K * V)
+        new_scores, idx = jax.lax.top_k(flat, K)              # [B, K]
+        beam_idx = idx // V
+        new_tok = (idx % V).astype(jnp.int32)
+
+        # reorder beam state
+        gidx = (jnp.arange(B)[:, None] * K + beam_idx).reshape(B * K)
+        kcache = kcache[:, gidx]
+        vcache = vcache[:, gidx]
+        toks = jnp.take_along_axis(toks, beam_idx[..., None], axis=1)
+        done = jnp.take_along_axis(done, beam_idx, axis=1)
+        toks = jax.lax.dynamic_update_index_in_dim(
+            toks, new_tok, i, 2)
+        if eos_id is not None:
+            done = jnp.logical_or(done, new_tok == eos_id)
+        return (toks, new_scores, cur_pos + 1, kcache, vcache, done), None
+
+    if max_new > 1:
+        carry = (toks, scores, jnp.int32(L0), kcache, vcache, done)
+        carry, _ = jax.lax.scan(decode_step, carry,
+                                jnp.arange(1, max_new))
+        toks, scores, _, _, _, done = carry
+
+    # length penalty on the final ranking (HF BeamSearchScorer)
+    if eos_id is not None:
+        lengths = jnp.argmax(
+            jnp.concatenate([toks == eos_id,
+                             jnp.ones((B, K, 1), bool)], axis=2),
+            axis=2) + 1
+    else:
+        lengths = jnp.full((B, K), max_new)
+    ranked = scores / (lengths.astype(jnp.float32) ** length_penalty)
+    best = jnp.argmax(ranked, axis=1)
+    best_toks = jnp.take_along_axis(
+        toks, best[:, None, None].repeat(max_new, 2), axis=1)[:, 0]
+    return jnp.concatenate([input_ids, best_toks], axis=1)
+
+
+def beam_search_generate(model, input_ids, max_new_tokens: int = 32,
+                         num_beams: int = 4,
+                         eos_token_id: Optional[int] = None,
+                         length_penalty: float = 1.0):
+    """Beam search for LlamaForCausalLM (HF/PaddleNLP beam semantics)."""
+    c = model.config
+    ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(
+        input_ids)
+    w = _stacked_weights(model)
+    out = _beam_generate_jit(
+        w, ids.astype(jnp.int32), n_heads=c.num_attention_heads,
+        n_kv=c.num_key_value_heads, eps=c.rms_norm_eps, theta=c.rope_theta,
+        max_new=int(max_new_tokens), num_beams=int(num_beams),
+        eos_id=eos_token_id, length_penalty=jnp.float32(length_penalty))
+    return Tensor(out)
+
+
+# ---------------------------------------------------------------------------
 # GPT (pre-LN, learned positions, combined qkv)
 # ---------------------------------------------------------------------------
 

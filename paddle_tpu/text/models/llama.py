@@ -264,10 +264,18 @@ class LlamaForCausalLM(Layer):
         return logits
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
-                 top_k=0, temperature=1.0, eos_token_id=None, seed=0):
+                 top_k=0, temperature=1.0, eos_token_id=None, seed=0,
+                 num_beams=1, length_penalty=1.0):
         """Jitted autoregressive decode with a static KV cache
         (PaddleNLP GenerationMixin.generate analog; see
-        text/generation.py for the TPU design)."""
+        text/generation.py for the TPU design). num_beams > 1 runs beam
+        search (greedy/sampling args ignored there)."""
+        if num_beams and num_beams > 1:
+            from ..generation import beam_search_generate
+            return beam_search_generate(
+                self, input_ids, max_new_tokens=max_new_tokens,
+                num_beams=num_beams, eos_token_id=eos_token_id,
+                length_penalty=length_penalty)
         from ..generation import generate as _gen
         return _gen(self, input_ids, max_new_tokens=max_new_tokens,
                     do_sample=do_sample, top_k=top_k,

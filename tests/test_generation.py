@@ -74,3 +74,48 @@ def test_gpt_greedy_matches_full_context():
     want = _naive_greedy(model, prompt, 5)
     got = model.generate(paddle.to_tensor(prompt), max_new_tokens=5)
     np.testing.assert_array_equal(np.asarray(got._data), want)
+
+
+def _seq_logprob(model, full, prompt_len):
+    """Sum log p(token_t | prefix) for the generated continuation."""
+    logits = np.asarray(model(paddle.to_tensor(
+        full.astype(np.int32)))._data).astype(np.float64)
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    total = 0.0
+    for t in range(prompt_len, full.shape[1]):
+        total += logp[0, t - 1, full[0, t]]
+    return total
+
+
+def test_beam_search_beats_or_matches_greedy():
+    paddle.seed(0)
+    model = LlamaForCausalLM(CFG)
+    model.eval()
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(0, CFG.vocab_size, (1, 6)).astype(np.int32)
+
+    greedy = np.asarray(model.generate(paddle.to_tensor(prompt),
+                                       max_new_tokens=5)._data)
+    beam = np.asarray(model.generate(paddle.to_tensor(prompt),
+                                     max_new_tokens=5, num_beams=4)._data)
+    assert beam.shape == greedy.shape
+    sg = _seq_logprob(model, greedy, 6)
+    sb = _seq_logprob(model, beam, 6)
+    assert sb >= sg - 1e-6, (sb, sg)
+
+
+def test_beam_one_equals_greedy():
+    paddle.seed(0)
+    model = LlamaForCausalLM(CFG)
+    model.eval()
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, CFG.vocab_size, (2, 5)).astype(np.int32)
+    from paddle_tpu.text.generation import beam_search_generate
+
+    greedy = np.asarray(model.generate(paddle.to_tensor(prompt),
+                                       max_new_tokens=4)._data)
+    beam1 = np.asarray(beam_search_generate(model,
+                                            paddle.to_tensor(prompt),
+                                            max_new_tokens=4,
+                                            num_beams=1)._data)
+    np.testing.assert_array_equal(beam1, greedy)
