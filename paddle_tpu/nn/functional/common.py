@@ -142,8 +142,34 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         method = {"nearest": "nearest", "bilinear": "bilinear",
                   "trilinear": "trilinear", "bicubic": "bicubic",
                   "linear": "linear", "area": "linear"}[mode]
+        if align_corners and method in ("linear", "bilinear", "trilinear"):
+            # jax.image.resize implements half-pixel (align_corners=False)
+            # sampling only; align_corners uses scale (in-1)/(out-1) —
+            # separable per-axis linear gather
+            out = a
+            first_sp = 2 if nchw else 1
+            for d, target in enumerate(out_size):
+                out = _interp_axis_align(out, first_sp + d, target)
+            return out
         return jax.image.resize(a, tgt_shape, method=method)
     return apply(f, x)
+
+
+def _interp_axis_align(a, axis, out_len):
+    in_len = a.shape[axis]
+    if in_len == out_len:
+        return a
+    if out_len == 1 or in_len == 1:
+        return jnp.take(a, jnp.zeros((out_len,), jnp.int32), axis=axis)
+    coords = jnp.linspace(0.0, in_len - 1, out_len)
+    lo = jnp.floor(coords).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, in_len - 1)
+    w = (coords - lo).astype(a.dtype)
+    shape = [1] * a.ndim
+    shape[axis] = out_len
+    w = w.reshape(shape)
+    return (jnp.take(a, lo, axis=axis) * (1 - w)
+            + jnp.take(a, hi, axis=axis) * w)
 
 
 def upsample(x, size=None, scale_factor=None, mode="nearest",

@@ -328,3 +328,72 @@ def test_adamw_single_step_vs_torch():
     topt.step()
     np.testing.assert_allclose(np.asarray(param._data),
                                tw.detach().numpy(), rtol=1e-5, atol=1e-7)
+
+
+def test_conv1d_conv3d_vs_torch():
+    torch = pytest.importorskip("torch")
+    paddle.seed(2)
+    rng = np.random.default_rng(2)
+
+    c1 = nn.Conv1D(3, 5, 3, stride=2, padding=1)
+    t1 = torch.nn.Conv1d(3, 5, 3, stride=2, padding=1)
+    with torch.no_grad():
+        t1.weight.copy_(torch.from_numpy(np.asarray(c1.weight._data)))
+        t1.bias.copy_(torch.from_numpy(np.asarray(c1.bias._data)))
+    x = rng.standard_normal((2, 3, 16)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(c1(paddle.to_tensor(x))._data),
+        t1(torch.from_numpy(x)).detach().numpy(), rtol=1e-4, atol=1e-5)
+
+    c3 = nn.Conv3D(2, 4, 3, padding=1)
+    t3 = torch.nn.Conv3d(2, 4, 3, padding=1)
+    with torch.no_grad():
+        t3.weight.copy_(torch.from_numpy(np.asarray(c3.weight._data)))
+        t3.bias.copy_(torch.from_numpy(np.asarray(c3.bias._data)))
+    x = rng.standard_normal((1, 2, 6, 6, 6)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(c3(paddle.to_tensor(x))._data),
+        t3(torch.from_numpy(x)).detach().numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_pooling_vs_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2, 3, 10, 10)).astype(np.float32)
+    tx = torch.from_numpy(x)
+
+    np.testing.assert_allclose(
+        np.asarray(nn.MaxPool2D(3, stride=2, padding=1)(
+            paddle.to_tensor(x))._data),
+        torch.nn.MaxPool2d(3, stride=2, padding=1)(tx).numpy(),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(nn.AvgPool2D(2)(paddle.to_tensor(x))._data),
+        torch.nn.AvgPool2d(2)(tx).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(nn.AdaptiveAvgPool2D(4)(paddle.to_tensor(x))._data),
+        torch.nn.AdaptiveAvgPool2d(4)(tx).numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(nn.AdaptiveMaxPool2D(5)(paddle.to_tensor(x))._data),
+        torch.nn.AdaptiveMaxPool2d(5)(tx).numpy(), rtol=1e-6)
+
+
+def test_interpolate_vs_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((1, 2, 8, 8)).astype(np.float32)
+    tx = torch.from_numpy(x)
+    from paddle_tpu.nn import functional as F
+
+    got = np.asarray(F.interpolate(paddle.to_tensor(x), size=[16, 16],
+                                   mode="nearest")._data)
+    want = torch.nn.functional.interpolate(tx, size=(16, 16),
+                                           mode="nearest").numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    got = np.asarray(F.interpolate(paddle.to_tensor(x), size=[15, 17],
+                                   mode="bilinear",
+                                   align_corners=True)._data)
+    want = torch.nn.functional.interpolate(
+        tx, size=(15, 17), mode="bilinear", align_corners=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
