@@ -43,6 +43,76 @@ def convert_hf_llama_state_dict(hf_state: dict) -> dict:
     return out
 
 
+_VIT_LAYER_MAP = {
+    "attention.attention.query": "self_attn.q_proj",
+    "attention.attention.key": "self_attn.k_proj",
+    "attention.attention.value": "self_attn.v_proj",
+    "attention.output.dense": "self_attn.out_proj",
+    "layernorm_before": "norm1",
+    "layernorm_after": "norm2",
+    "intermediate.dense": "linear1",
+    "output.dense": "linear2",
+}
+
+
+def convert_hf_vit_state_dict(hf_state: dict) -> dict:
+    """HF ViTModel/ViTForImageClassification state dict -> paddle_tpu
+    VisionTransformer."""
+    out = {}
+    for name, val in hf_state.items():
+        arr = np.asarray(getattr(val, "detach", lambda: val)())
+        ours = name
+        if ours.startswith("vit."):
+            ours = ours[len("vit."):]
+        if ours == "embeddings.cls_token":
+            ours = "cls_token"
+        elif ours == "embeddings.position_embeddings":
+            ours = "pos_embed"
+        elif ours.startswith("embeddings.patch_embeddings.projection."):
+            ours = "patch_embed.proj." + ours.rsplit(".", 1)[-1]
+        elif ours.startswith("encoder.layer."):
+            parts = ours.split(".")
+            idx = parts[2]
+            rest = ".".join(parts[3:-1])
+            mapped = _VIT_LAYER_MAP.get(rest)
+            if mapped is None:
+                continue
+            suffix = parts[-1]
+            ours = f"encoder.layers.{idx}.{mapped}.{suffix}"
+            if suffix == "weight" and arr.ndim == 2:
+                arr = arr.T
+            out[ours] = arr
+            continue
+        elif ours.startswith("layernorm."):
+            ours = "encoder.norm." + ours.rsplit(".", 1)[-1]
+        elif ours.startswith("classifier."):
+            ours = "head." + ours.rsplit(".", 1)[-1]
+            if ours.endswith("weight"):
+                arr = arr.T
+        elif "pooler" in ours:
+            continue
+        out[ours] = arr
+    return out
+
+
+def load_hf_vit_weights(model, hf_state: dict, strict: bool = True):
+    converted = convert_hf_vit_state_dict(hf_state)
+    params = dict(model.named_parameters())
+    missing = [k for k in params if k not in converted]
+    unexpected = [k for k in converted if k not in params]
+    if strict and (missing or unexpected):
+        raise ValueError(f"state dict mismatch: missing={missing[:6]} "
+                         f"unexpected={unexpected[:6]}")
+    for k, p in params.items():
+        if k in converted:
+            src = converted[k]
+            if tuple(src.shape) != tuple(p._data.shape):
+                raise ValueError(
+                    f"{k}: shape {src.shape} != {tuple(p._data.shape)}")
+            p._data = jnp.asarray(src, dtype=p._data.dtype)
+    return model
+
+
 _BERT_LAYER_MAP = {
     "attention.self.query": "self_attn.q_proj",
     "attention.self.key": "self_attn.k_proj",

@@ -161,3 +161,39 @@ def test_t5_greedy_generate_matches_incremental():
                                     max_new_tokens=5,
                                     eos_token_id=None)._data)
     np.testing.assert_array_equal(got[:, :6], dec)
+
+
+def test_hf_vit_logits_parity():
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+
+    from paddle_tpu.text.models.convert import load_hf_vit_weights
+    from paddle_tpu.vision.models.vit import VisionTransformer
+
+    hf_cfg = transformers.ViTConfig(
+        image_size=32, patch_size=8, num_channels=3, hidden_size=48,
+        num_hidden_layers=2, num_attention_heads=4, intermediate_size=96,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        num_labels=7, attn_implementation="eager")
+    torch.manual_seed(3)
+    hf = transformers.ViTForImageClassification(hf_cfg)
+    hf.eval()
+
+    ours = VisionTransformer(img_size=32, patch_size=8, in_chans=3,
+                             num_classes=7, embed_dim=48, depth=2,
+                             num_heads=4, mlp_ratio=2.0, dropout=0.0,
+                             attn_dropout=0.0)
+    load_hf_vit_weights(ours, hf.state_dict())
+    ours.eval()
+    # HF ViT uses layer_norm_eps=1e-12 (ours defaults to paddle's 1e-5)
+    from paddle_tpu.nn.layer.norm import LayerNorm
+    for _, sub in ours.named_sublayers(include_self=True):
+        if isinstance(sub, LayerNorm):
+            sub._epsilon = 1e-12
+
+    x = np.random.default_rng(7).standard_normal(
+        (2, 3, 32, 32)).astype(np.float32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(x)).logits.numpy()
+    got = np.asarray(ours(paddle.to_tensor(x))._data)
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
