@@ -64,7 +64,8 @@ from .device import (  # noqa: F401
 )
 from .distributed.parallel import DataParallel  # noqa: F401
 from .framework.device import (  # noqa: F401
-    CUDAPinnedPlace, IPUPlace, MLUPlace, NPUPlace, XPUPlace,
+    CUDAPinnedPlace, CustomPlace, IPUPlace, MLUPlace, NPUPlace, XPUPlace,
+    get_cudnn_version,
 )
 from .hapi.dynamic_flops import flops  # noqa: F401
 from .nn.layer_base import ParamAttr  # noqa: F401

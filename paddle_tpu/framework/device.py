@@ -94,6 +94,23 @@ MLUPlace = TPUPlace
 IPUPlace = TPUPlace
 
 
+class CustomPlace(TPUPlace):
+    """Reference: paddle.CustomPlace('device', idx) for plugin devices."""
+
+    def __init__(self, device_type: str = "tpu", idx: int = 0):
+        super().__init__(idx)
+        self.device_type = device_type
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.idx})"
+
+
+def get_cudnn_version():
+    """Reference: paddle.get_cudnn_version — None on the TPU build (no
+    cuDNN; absence-reporting like the other cuda queries)."""
+    return None
+
+
 class CUDAPinnedPlace:
     """Host pinned memory place (reference: CUDAPinnedPlace). Host arrays
     feed the device through PJRT's own pinned staging on TPU."""
