@@ -2,6 +2,8 @@
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from . import utils  # noqa: F401
+from .layer import loss  # noqa: F401 (paddle.nn.loss submodule alias)
+from .utils import spectral_norm  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue, clip_grad_norm_,
     clip_grad_value_,

@@ -37,7 +37,13 @@ class InputSpec:
 
 
 from . import nn  # noqa: F401,E402
+from . import sparsity  # noqa: F401,E402
+from ..amp import auto_cast as amp  # noqa: F401,E402 (static.amp alias)
+from .. import amp as _amp_mod  # noqa: E402
+amp = _amp_mod  # paddle.static.amp namespace (reference re-export)
+from ..batch import batch  # noqa: F401,E402
 from .nn import case, cond, switch_case, while_loop  # noqa: F401,E402
+from .program import Scope, load_vars, save_vars  # noqa: F401,E402
 from .program import (  # noqa: F401,E402
     BuildStrategy, CompiledProgram, ExecutionStrategy, Executor,
     ExponentialMovingAverage, IpuCompiledProgram, IpuStrategy,

@@ -15,6 +15,7 @@ API surface on the same primitives.
 from __future__ import annotations
 
 import contextlib
+import os
 import pickle
 
 import jax.numpy as jnp
@@ -649,3 +650,49 @@ class ExponentialMovingAverage:
             for p, v in self._backup:
                 p._data = v
         self._backup = None
+
+
+Scope = _Scope  # public alias (reference: paddle.static.Scope)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Persist selected program variables (reference: fluid/io.py:284).
+    Saves one pickle per var (or a combined file when filename given)."""
+    import pickle
+
+    prog = main_program or default_main_program()
+    items = {k: np.asarray(v._data) for k, v in prog._vars.items()
+             if (vars is None or k in vars)
+             and (predicate is None or predicate(v))}
+    os.makedirs(dirname, exist_ok=True)
+    if filename:
+        with open(os.path.join(dirname, filename), "wb") as f:
+            pickle.dump(items, f)
+    else:
+        for k, arr in items.items():
+            with open(os.path.join(dirname, k), "wb") as f:
+                pickle.dump(arr, f)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Restore variables saved by save_vars (reference: fluid/io.py:733)."""
+    import pickle
+
+    prog = main_program or default_main_program()
+    if filename:
+        with open(os.path.join(dirname, filename), "rb") as f:
+            items = pickle.load(f)
+    else:
+        items = {}
+        for k in prog._vars:
+            p = os.path.join(dirname, k)
+            if os.path.exists(p):
+                with open(p, "rb") as f:
+                    items[k] = pickle.load(f)
+    for k, arr in items.items():
+        if k in prog._vars and (vars is None or k in vars):
+            v = prog._vars[k]
+            if predicate is None or predicate(v):
+                v._data = jnp.asarray(arr)

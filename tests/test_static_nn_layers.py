@@ -75,3 +75,42 @@ def test_prelu_builder():
         main, feed={"x": np.asarray([[-1.0, 2.0, -4.0]], np.float32)},
         fetch_list=[out])[0]
     np.testing.assert_allclose(res, [[-0.25, 2.0, -1.0]], rtol=1e-6)
+
+
+def test_sparsity_prune_and_density():
+    """static.sparsity 2:4 pruning (ASP analog): every 4-group along the
+    last axis keeps exactly 2 nonzeros; density reports 0.5."""
+    from paddle_tpu import nn
+    from paddle_tpu.static import sparsity
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 4))
+    masks = sparsity.prune_model(net, n=2, m=4)
+    assert masks
+    w = np.asarray(net[0].weight._data)
+    d = sparsity.calculate_density(net[0].weight)
+    assert abs(d - 0.5) < 1e-6
+    groups = w.reshape(8, 2, 4)
+    nz = (groups != 0).sum(axis=-1)
+    assert (nz <= 2).all()
+
+
+def test_static_vars_roundtrip(tmp_path):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        out = static.nn.fc(x, 2)
+    exe = static.Executor()
+    before = {k: np.asarray(v._data)
+              for k, v in main._vars.items() if "fc" in k}
+    static.save_vars(exe, str(tmp_path), main_program=main,
+                     filename="allvars")
+    # clobber then restore
+    for k, v in main._vars.items():
+        if "fc" in k:
+            import jax.numpy as jnp
+            v._data = jnp.zeros_like(v._data)
+    static.load_vars(exe, str(tmp_path), main_program=main,
+                     filename="allvars")
+    for k, want in before.items():
+        np.testing.assert_array_equal(np.asarray(main._vars[k]._data), want)
