@@ -1,6 +1,28 @@
 from . import datasets, models, transforms  # noqa: F401
 from .ops import nms, roi_align  # noqa: F401
 
+# Reference vision/__init__.py flattens models/transforms/datasets into the
+# vision namespace (paddle.vision.ResNet AND paddle.vision.models.ResNet);
+# mirror every public name.
+from .models import *  # noqa: F401,F403,E402
+from .transforms import *  # noqa: F401,F403,E402
+from .datasets import *  # noqa: F401,F403,E402
+
+
+def _flatten(mod):
+    out = []
+    for n in dir(mod):
+        if not n.startswith("_") and n not in globals():
+            globals()[n] = getattr(mod, n)
+            out.append(n)
+    return out
+
+
+_flatten(models)
+_flatten(transforms)
+_flatten(datasets)
+del _flatten
+
 
 _image_backend = "pil"
 
@@ -23,6 +45,13 @@ def image_load(path, backend=None):
     """Load an image file with the configured backend. Reference:
     vision/image.py::image_load."""
     backend = backend or _image_backend
+    if str(path).endswith(".npy"):  # numpy blobs bypass the image decoders
+        import numpy as np
+        arr = np.load(path)
+        if backend == "tensor":
+            from ..tensor import Tensor
+            return Tensor(arr)
+        return arr
     if backend in ("pil", "tensor"):
         try:
             from PIL import Image

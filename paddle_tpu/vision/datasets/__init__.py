@@ -146,3 +146,111 @@ class FakeData(_SyntheticImages):
         if shape[0] in (1, 3):  # CHW → HWC storage
             shape = (shape[1], shape[2], shape[0])
         super().__init__(size, shape, num_classes, transform)
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class dataset (reference:
+    vision/datasets/folder.py::DatasetFolder): root/<class>/<file>."""
+
+    IMG_EXTS = (".npy", ".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = tuple(e.lower() for e in (extensions or self.IMG_EXTS))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for f in sorted(files):
+                    path = os.path.join(dirpath, f)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else f.lower().endswith(exts))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"found no valid files under {root}")
+
+    @staticmethod
+    def _default_loader(path):
+        from .. import image_load
+        img = image_load(path)
+        return np.asarray(img)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat/recursive image listing without labels (reference:
+    vision/datasets/folder.py::ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        exts = tuple(e.lower()
+                     for e in (extensions or DatasetFolder.IMG_EXTS))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                path = os.path.join(dirpath, f)
+                ok = (is_valid_file(path) if is_valid_file
+                      else f.lower().endswith(exts))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"found no valid files under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(_SyntheticImages):
+    """Flowers-102 (file-gated in this environment; synthetic fallback
+    keeps pipelines runnable — reference vision/datasets/flowers.py)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        n = 512 if mode == "train" else 128
+        super().__init__(n, (64, 64, 3), 102, transform=transform,
+                         seed=0 if mode == "train" else 1)
+
+
+class VOC2012(_SyntheticImages):
+    """VOC2012 segmentation (file-gated; synthetic fallback — reference
+    vision/datasets/voc2012.py). Returns (image, label_mask)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        n = 128 if mode == "train" else 32
+        super().__init__(n, (64, 64, 3), 21, transform=transform,
+                         seed=2 if mode == "train" else 3)
+        rng = np.random.default_rng(9)
+        self.masks = rng.integers(0, 21, size=(n, 64, 64)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.masks[idx]

@@ -223,3 +223,15 @@ def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
     return MobileNetV3(_V3_SMALL, 1024, scale=scale, **kwargs)
+
+
+class MobileNetV3Large(MobileNetV3):
+    """Reference: vision/models/mobilenetv3.py MobileNetV3Large."""
+
+    def __init__(self, scale=1.0, **kwargs):
+        super().__init__(_V3_LARGE, 1280, scale=scale, **kwargs)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, **kwargs):
+        super().__init__(_V3_SMALL, 1024, scale=scale, **kwargs)

@@ -100,3 +100,11 @@ def shufflenet_v2_x1_5(pretrained=False, **kwargs):
 
 def shufflenet_v2_x2_0(pretrained=False, **kwargs):
     return ShuffleNetV2(2.0, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.33, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.0, act="swish", **kwargs)

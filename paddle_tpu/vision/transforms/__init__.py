@@ -221,3 +221,166 @@ def crop(img, top, left, height, width):
 
 def pad(img, padding, fill=0, padding_mode="constant"):
     return Pad(padding, fill, padding_mode)(img)
+
+
+# -- color / geometry transforms (reference: vision/transforms/transforms.py
+# ColorJitter family + rotation; functional forms in functional.py) --------
+
+def _as_float(arr):
+    arr = np.asarray(arr)
+    scale = 255.0 if arr.dtype == np.uint8 or arr.max() > 1.5 else 1.0
+    return arr.astype(np.float32) / scale, scale
+
+
+def _restore(arr, scale):
+    arr = np.clip(arr, 0.0, 1.0) * scale
+    return arr.astype(np.uint8) if scale == 255.0 else arr
+
+
+def adjust_brightness(img, factor):
+    a, s = _as_float(_hwc(img))
+    return _restore(a * factor, s)
+
+
+def adjust_contrast(img, factor):
+    a, s = _as_float(_hwc(img))
+    mean = a.mean()
+    return _restore(mean + factor * (a - mean), s)
+
+
+def adjust_saturation(img, factor):
+    a, s = _as_float(_hwc(img))
+    gray = a @ np.asarray([0.299, 0.587, 0.114], np.float32)
+    gray = gray[..., None]
+    return _restore(gray + factor * (a - gray), s)
+
+
+def adjust_hue(img, factor):
+    """factor in [-0.5, 0.5]: shift hue via HSV round-trip."""
+    a, s = _as_float(_hwc(img))
+    r, g, b = a[..., 0], a[..., 1], a[..., 2]
+    mx = a.max(-1)
+    mn = a.min(-1)
+    diff = mx - mn + 1e-12
+    h = np.zeros_like(mx)
+    mask = mx == r
+    h[mask] = ((g - b) / diff)[mask] % 6
+    mask = mx == g
+    h[mask] = ((b - r) / diff + 2)[mask]
+    mask = mx == b
+    h[mask] = ((r - g) / diff + 4)[mask]
+    h = (h / 6.0 + factor) % 1.0
+    v = mx
+    sat = np.where(mx > 0, diff / (mx + 1e-12), 0)
+    i = np.floor(h * 6).astype(np.int32) % 6
+    f = h * 6 - np.floor(h * 6)
+    p = v * (1 - sat)
+    q = v * (1 - f * sat)
+    t = v * (1 - (1 - f) * sat)
+    choices = np.stack([
+        np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+        np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+        np.stack([t, p, v], -1), np.stack([v, p, q], -1)], 0)
+    out = np.take_along_axis(choices, i[None, ..., None].repeat(3, -1),
+                             axis=0)[0]
+    return _restore(out, s)
+
+
+def to_grayscale(img, num_output_channels=1):
+    a, s = _as_float(_hwc(img))
+    gray = a @ np.asarray([0.299, 0.587, 0.114], np.float32)
+    gray = gray[..., None].repeat(num_output_channels, -1)
+    return _restore(gray, s)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate (degrees, counter-clockwise) via inverse-affine sampling."""
+    a = np.asarray(_hwc(img))
+    h, w = a.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    # inverse map: output pixel -> source coordinate (counter-clockwise
+    # positive angle, image y axis pointing down)
+    sx = cos * (xs - cx) - sin * (ys - cy) + cx
+    sy = sin * (xs - cx) + cos * (ys - cy) + cy
+    valid = (sx >= 0) & (sx <= w - 1) & (sy >= 0) & (sy <= h - 1)
+    sxc = np.clip(np.round(sx).astype(np.int32), 0, w - 1)
+    syc = np.clip(np.round(sy).astype(np.int32), 0, h - 1)
+    out = a[syc, sxc]
+    out = np.where(valid[..., None] if a.ndim == 3 else valid, out, fill)
+    return out.astype(a.dtype)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        alpha = 1 + random.uniform(-self.value, self.value)
+        return adjust_contrast(img, alpha)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        alpha = 1 + random.uniform(-self.value, self.value)
+        return adjust_saturation(img, alpha)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """Reference transforms.ColorJitter: random brightness/contrast/
+    saturation/hue in random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.transforms = []
+        if brightness:
+            self.transforms.append(BrightnessTransform(brightness))
+        if contrast:
+            self.transforms.append(ContrastTransform(contrast))
+        if saturation:
+            self.transforms.append(SaturationTransform(saturation))
+        if hue:
+            self.transforms.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        order = list(self.transforms)
+        random.shuffle(order)
+        for t in order:
+            img = t._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, (int, float)):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.kw = dict(interpolation=interpolation, expand=expand,
+                       center=center, fill=fill)
+
+    def _apply_image(self, img):
+        return rotate(img, random.uniform(*self.degrees), **self.kw)

@@ -69,3 +69,39 @@ def test_watchdog_nan_and_stall():
         wd.step(float("nan"))
     assert events == [("nan", 1), ("nan", 2)]
     assert wd.stats["nan_steps"] == 2
+
+
+def test_color_transforms_and_rotate():
+    img = _img(8, 8)
+    assert np.asarray(T.ColorJitter(0.3, 0.3, 0.3, 0.1)(img)).shape == \
+        (8, 8, 3)
+    g = np.asarray(T.Grayscale(3)(img))
+    assert g.shape == (8, 8, 3)
+    np.testing.assert_allclose(g[..., 0], g[..., 1])
+    sq = np.arange(9, dtype=np.uint8).reshape(3, 3)
+    np.testing.assert_array_equal(np.squeeze(T.rotate(sq, 90)),
+                                  np.rot90(sq, 1))
+    np.testing.assert_array_equal(
+        np.asarray(T.adjust_brightness(img, 1.0)), img)
+    c2 = T.adjust_contrast(img, 1.0)
+    np.testing.assert_allclose(np.asarray(c2), img, atol=1)
+
+
+def test_folder_datasets(tmp_path):
+    import numpy as np
+    from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            np.save(d / f"{i}.npy",
+                    np.full((4, 4, 3), i, np.uint8))
+    ds = DatasetFolder(str(tmp_path))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 6
+    img, label = ds[0]
+    assert img.shape == (4, 4, 3) and label == 0
+    flat = ImageFolder(str(tmp_path))
+    assert len(flat) == 6
+    assert flat[0][0].shape == (4, 4, 3)
