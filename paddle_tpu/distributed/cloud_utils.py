@@ -1,0 +1,16 @@
+"""paddle.distributed.cloud_utils (reference: cluster env introspection
+for paddlecloud jobs; here backed by the same PADDLE_* env contract)."""
+from __future__ import annotations
+
+import os
+
+
+def get_cluster_and_pod(args=None):
+    from .utils import get_cluster_from_args
+    cluster = get_cluster_from_args(args)
+    pod = {"rank": cluster["rank"]}
+    return cluster, pod
+
+
+def use_paddlecloud():
+    return os.environ.get("PADDLE_RUNNING_ENV", "") == "PADDLE_CLOUD"

@@ -177,3 +177,14 @@ class QueueDataset(_FileLinesDataset):
     """Streaming file reader (no memory load). Reference:
     fluid/dataset.py::QueueDataset."""
     pass
+
+
+class BoxPSDataset(InMemoryDataset):
+    """Reference: fluid/dataset.py BoxPSDataset — the BoxPS accelerator
+    path degenerates to the in-memory dataset on TPU (no GPU PS cache)."""
+
+    def begin_pass(self):
+        return None
+
+    def end_pass(self, need_save_delta=False):
+        return None

@@ -1,0 +1,41 @@
+"""paddle.distributed.utils (reference: distributed/utils/__init__.py —
+host/endpoint helpers used by launch scripts)."""
+from __future__ import annotations
+
+import os
+import socket
+
+
+def get_host_name_ip():
+    try:
+        name = socket.gethostname()
+        return name, socket.gethostbyname(name)
+    except OSError:
+        return "localhost", "127.0.0.1"
+
+
+def get_cluster_from_args(args=None):
+    """Single-controller view of the PADDLE_* env contract."""
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    master = os.environ.get("PADDLE_MASTER", "127.0.0.1:0")
+    return {"world_size": world, "rank": rank, "master": master}
+
+
+def find_free_ports(num=1):
+    ports = []
+    socks = []
+    for _ in range(num):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+def add_arguments(argname, dtype, default, help, argparser, **kwargs):
+    """Reference utils.add_arguments (fluid style argparse helper)."""
+    argparser.add_argument("--" + argname, default=default, type=dtype,
+                           help=help, **kwargs)

@@ -26,3 +26,35 @@ __all__ = [
     'segment_sum', 'segment_mean', 'segment_max', 'segment_min',
     'LookAhead', 'ModelAverage',
 ]
+
+
+from . import auto_checkpoint  # noqa: F401
+from ..static import sparsity as asp  # noqa: F401 (incubate.asp alias)
+from ..distributed import fleet  # noqa: F401 (incubate.fleet alias)
+from ..optimizer.algorithms import Lamb as DistributedFusedLamb  # noqa: F401
+# (single-program SPMD: the "distributed fused" variant is the same
+# compiled Lamb update partitioned by GSPMD)
+
+
+class LayerHelper:
+    """Minimal reference-compat layer builder (fluid/layer_helper.py): the
+    pieces custom-op/layer authors actually use — parameter creation and
+    dtype bookkeeping over the active default program."""
+
+    def __init__(self, layer_type, **kwargs):
+        self.layer_type = layer_type
+        self.kwargs = kwargs
+
+    def create_parameter(self, attr=None, shape=None, dtype="float32",
+                         is_bias=False, default_initializer=None):
+        from ..static.program import create_parameter
+        return create_parameter(shape, dtype, attr=attr, is_bias=is_bias,
+                                default_initializer=default_initializer)
+
+    def append_activation(self, x, act=None):
+        if act is None:
+            act = self.kwargs.get("act")
+        if act is None:
+            return x
+        from ..nn import functional as F
+        return getattr(F, act)(x)

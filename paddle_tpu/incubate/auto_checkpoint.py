@@ -1,0 +1,45 @@
+"""incubate.auto_checkpoint (reference:
+incubate/checkpoint/auto_checkpoint.py — train_epoch_range checkpoints
+training state periodically and resumes after failures). TPU-native:
+backed by distributed.checkpoint.CheckpointManager (async orbax shards).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class _EpochRange:
+    def __init__(self, name, max_epoch_num, save_checkpoint_inter=None):
+        from ..distributed.checkpoint import (CheckpointManager,
+                                              wait_for_checkpoints)
+
+        root = os.environ.get("PADDLE_TPU_CHECKPOINT_DIR",
+                              os.path.join(os.getcwd(), ".auto_checkpoint"))
+        wait_for_checkpoints()  # join in-flight async saves before listing
+        self._mgr = CheckpointManager(os.path.join(root, name),
+                                      max_to_keep=3)
+        self.max_epoch_num = max_epoch_num
+        start = self._mgr.latest_step()
+        self._start = 0 if start is None else start + 1
+
+    def __iter__(self):
+        for e in range(self._start, self.max_epoch_num):
+            yield e
+
+    def save(self, epoch, state):
+        self._mgr.save(epoch, state, async_save=True)
+
+    def restore(self, template=None):
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        return self._mgr.restore(step, template)
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=None,
+                      name: Optional[str] = None):
+    """for epoch in train_epoch_range(90): ... — resumes from the last
+    checkpointed epoch (reference auto_checkpoint contract)."""
+    return _EpochRange(name or "default", max_epoch_num,
+                       save_checkpoint_inter)

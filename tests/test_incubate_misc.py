@@ -260,3 +260,41 @@ class TestDeviceAndMisc:
             got = pred.get_output_handle(
                 pred.get_output_names()[0]).copy_to_cpu()
             np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+class TestNamespaceTails:
+    def test_auto_checkpoint_epoch_range(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_CHECKPOINT_DIR", str(tmp_path))
+        from paddle_tpu.incubate import auto_checkpoint as ac
+
+        r = ac.train_epoch_range(5, name="job1")
+        seen = []
+        for e in r:
+            seen.append(e)
+            r.save(e, {"epoch": np.asarray(e)})
+            if e == 2:
+                break
+        # new range resumes after the last saved epoch
+        r2 = ac.train_epoch_range(5, name="job1")
+        assert list(r2) == [3, 4]
+        restored = r2.restore({"epoch": np.asarray(0)})
+        assert int(np.asarray(restored["epoch"])) == 2
+
+    def test_layer_helper_and_asp(self):
+        from paddle_tpu.incubate import LayerHelper, asp
+
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            h = LayerHelper("fc", act="relu")
+            w = h.create_parameter(shape=(4, 4), dtype="float32")
+            assert tuple(w.shape) == (4, 4)
+        assert hasattr(asp, "prune_model")
+
+    def test_distributed_utils(self):
+        from paddle_tpu.distributed import cloud_utils, utils
+
+        name, ip = utils.get_host_name_ip()
+        assert ip.count(".") == 3
+        assert len(utils.find_free_ports(2)) == 2
+        cluster, pod = cloud_utils.get_cluster_and_pod()
+        assert cluster["world_size"] >= 1
