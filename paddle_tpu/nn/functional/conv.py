@@ -63,13 +63,14 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
             perm = tuple(range(2, 2 + n)) + (1, 0)
             w = jnp.transpose(w, perm)
         dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, (dn_in, dn_w, dn_out))
+        # NB: no preferred_element_type here — the MXU accumulates bf16
+        # convs in fp32 regardless, and requesting an fp32 output breaks
+        # the conv transpose (grad) rule: the cotangent arrives as fp32
+        # while lhs stays bf16, and conv_general_dilated rejects the mix.
         out = jax.lax.conv_general_dilated(
             a, w, window_strides=stride, padding=pad,
             rhs_dilation=dilation, dimension_numbers=dn,
-            feature_group_count=groups,
-            preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None)
-        if a.dtype == jnp.bfloat16 and out.dtype == jnp.float32:
-            out = out.astype(jnp.bfloat16)
+            feature_group_count=groups)
         if bs:
             b = bs[0].astype(out.dtype)
             shape = [1] * out.ndim

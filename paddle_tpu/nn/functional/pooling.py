@@ -36,7 +36,8 @@ def _pool_nd(x, n, kernel, stride, padding, kind, ceil_mode=False,
         else:
             pads = [(0, 0), (0, 0)] + pad
         if kind == "max":
-            init = -jnp.inf if np.dtype(a.dtype).kind == "f" else np.iinfo(np.dtype(a.dtype)).min
+            init = (-jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+                    else np.iinfo(np.dtype(a.dtype)).min)
             out = jax.lax.reduce_window(a, init, jax.lax.max, window, strides, pads)
         else:
             s = jax.lax.reduce_window(a, 0.0, jax.lax.add,

@@ -1,0 +1,91 @@
+"""Model export (paddle.onnx API shape).
+
+Reference: python/paddle/onnx/export.py:21 (delegates to paddle2onnx).
+``export`` traces the layer's forward and writes a real ONNX ModelProto
+(``path``.onnx) using the in-tree jaxpr->ONNX converter and the bundled
+protobuf schema — no external onnx package required. Pass
+``format="stablehlo"`` for the XLA-native interchange artifact instead
+(serialized via jax.export, loadable with jax.export.deserialize), or
+``format="both"`` for both files.
+
+``paddle_tpu.onnx.run(model, {name: array})`` executes an exported model
+with the bundled numpy runtime (verification / host-side inference).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.tape import functional_mode
+from ..jit.api import _swap_params
+from ..static import InputSpec
+from ..tensor import Tensor
+from .converter import OnnxExportError, jaxpr_to_onnx  # noqa: F401
+from .runtime import load, run  # noqa: F401
+
+__all__ = ["export", "load", "run", "jaxpr_to_onnx", "OnnxExportError"]
+
+
+def _example_args(input_spec):
+    args = []
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            shape = [1 if s is None or s < 0 else int(s) for s in spec.shape]
+            args.append(jnp.zeros(shape, dtype=spec.dtype or "float32"))
+        else:
+            args.append(jnp.asarray(spec._data if isinstance(spec, Tensor)
+                                    else spec))
+    return args
+
+
+def export(layer, path, input_spec=None, opset_version=13, *,
+           format="onnx", input_names=None, **kwargs):
+    """Export ``layer`` to ``path``.onnx (and/or ``path``.stablehlo).
+
+    Returns the path of the primary artifact written.
+    """
+    if input_spec is None:
+        raise ValueError("input_spec is required for export")
+    if format not in ("onnx", "stablehlo", "both"):
+        raise ValueError(f"format must be onnx|stablehlo|both, got {format}")
+
+    args = _example_args(input_spec)
+    params = dict(layer.named_parameters())
+    param_vals = {k: p._data for k, p in params.items()}
+
+    def fn(pv, *xs):
+        with functional_mode(), _swap_params(params, pv):
+            out = layer(*[Tensor(x) for x in xs])
+        return out._data if isinstance(out, Tensor) else out
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    result = None
+
+    if format in ("onnx", "both"):
+        # params as a flat leading tuple so leaf order is deterministic
+        names = list(param_vals)
+        closed = jax.make_jaxpr(
+            lambda flat, *xs: fn(dict(zip(names, flat)), *xs))(
+                tuple(param_vals.values()), *args)
+        in_names = input_names or [
+            getattr(s, "name", None) or f"input_{i}"
+            for i, s in enumerate(input_spec)]
+        model = jaxpr_to_onnx(
+            closed, input_names=in_names, param_values=param_vals,
+            graph_name=type(layer).__name__, opset=opset_version)
+        with open(path + ".onnx", "wb") as f:
+            f.write(model.SerializeToString())
+        result = path + ".onnx"
+
+    if format in ("stablehlo", "both"):
+        exported = jax.export.export(jax.jit(fn))(param_vals, *args)
+        with open(path + ".stablehlo", "wb") as f:
+            f.write(exported.serialize())
+        with open(path + ".mlir", "w") as f:
+            f.write(str(exported.mlir_module()))
+        if result is None:
+            result = path + ".stablehlo"
+
+    return result

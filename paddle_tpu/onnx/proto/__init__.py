@@ -1,0 +1,10 @@
+"""Self-contained ONNX protobuf bindings.
+
+`onnx_pb2` is generated from the hand-authored `onnx.proto` (a
+wire-compatible subset of the official ONNX schema) via::
+
+    protoc --python_out=. onnx.proto
+
+and committed, so the `onnx` pip package is never required.
+"""
+from . import onnx_pb2  # noqa: F401

@@ -1,0 +1,343 @@
+"""Minimal numpy evaluator for ONNX models.
+
+Covers the op subset `paddle_tpu.onnx.export` emits (plus Gemm, so
+models exported by other frontends parse too). Used by the test suite to
+verify exported graphs numerically WITHOUT jax in the loop — conv and
+pooling run on `numpy.lib.stride_tricks.sliding_window_view`, everything
+else on plain numpy — and usable as a tiny host-side inference runtime.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .proto import onnx_pb2 as P
+
+_NP_DTYPE = {1: "float32", 2: "uint8", 3: "int8", 4: "uint16", 5: "int16",
+             6: "int32", 7: "int64", 9: "bool", 10: "float16",
+             11: "float64", 12: "uint32", 13: "uint64", 16: "bfloat16"}
+
+
+def _np_dtype(code):
+    name = _NP_DTYPE[code]
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def tensor_to_numpy(t):
+    dt = _np_dtype(t.data_type)
+    if t.raw_data:
+        arr = np.frombuffer(t.raw_data, dtype=dt)
+    elif t.float_data:
+        arr = np.asarray(list(t.float_data), dtype=dt)
+    elif t.int64_data:
+        arr = np.asarray(list(t.int64_data), dtype=dt)
+    elif t.int32_data:
+        arr = np.asarray(list(t.int32_data), dtype=dt)
+    elif t.double_data:
+        arr = np.asarray(list(t.double_data), dtype=dt)
+    else:
+        arr = np.zeros(0, dtype=dt)
+    return arr.reshape(list(t.dims))
+
+
+def load(path_or_bytes):
+    model = P.ModelProto()
+    if isinstance(path_or_bytes, bytes):
+        model.ParseFromString(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as f:
+            model.ParseFromString(f.read())
+    return model
+
+
+def _attrs(node):
+    out = {}
+    T = P.AttributeProto
+    for a in node.attribute:
+        if a.type == T.INT:
+            out[a.name] = int(a.i)
+        elif a.type == T.FLOAT:
+            out[a.name] = float(a.f)
+        elif a.type == T.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == T.INTS:
+            out[a.name] = [int(x) for x in a.ints]
+        elif a.type == T.FLOATS:
+            out[a.name] = [float(x) for x in a.floats]
+        elif a.type == T.TENSOR:
+            out[a.name] = tensor_to_numpy(a.t)
+        else:
+            raise NotImplementedError(f"attribute type {a.type}")
+    return out
+
+
+def _windows(x, kernel, strides, pads, pad_value):
+    """[N, C, *spatial] -> [N, C, *out_spatial, *kernel] view."""
+    nsp = len(kernel)
+    lo, hi = pads[:nsp], pads[nsp:]
+    widths = [(0, 0), (0, 0)] + [(l, h) for l, h in zip(lo, hi)]
+    x = np.pad(x, widths, constant_values=pad_value)
+    win = np.lib.stride_tricks.sliding_window_view(
+        x, kernel, axis=tuple(range(2, 2 + nsp)))
+    idx = (slice(None), slice(None)) + tuple(
+        slice(None, None, s) for s in strides)
+    return win[idx + (Ellipsis,)]
+
+
+def _conv(x, w, attrs):
+    group = attrs.get("group", 1)
+    strides = attrs.get("strides", [1] * (x.ndim - 2))
+    dil = attrs.get("dilations", [1] * (x.ndim - 2))
+    pads = attrs.get("pads", [0] * 2 * (x.ndim - 2))
+    if any(d != 1 for d in dil):
+        w = _dilate_kernel(w, dil)
+    kernel = list(w.shape[2:])
+    win = _windows(x.astype(np.float64), kernel, strides, pads, 0.0)
+    # win: [N, C, *out, *k]; w: [O, C/g, *k]
+    n = x.shape[0]
+    o = w.shape[0]
+    cin_g = w.shape[1]
+    out_sp = win.shape[2:2 + len(kernel)]
+    outs = []
+    for gi in range(group):
+        wg = w[gi * (o // group):(gi + 1) * (o // group)].astype(np.float64)
+        xg = win[:, gi * cin_g:(gi + 1) * cin_g]
+        outs.append(np.einsum(
+            xg.reshape(n, cin_g, int(np.prod(out_sp)), -1),
+            [0, 1, 2, 3],
+            wg.reshape(o // group, cin_g, -1), [4, 1, 3], [0, 4, 2]))
+    out = np.concatenate(outs, axis=1)
+    return out.reshape((n, o) + tuple(out_sp)).astype(x.dtype)
+
+
+def _dilate_kernel(w, dil):
+    sp = w.shape[2:]
+    new_sp = [(k - 1) * d + 1 for k, d in zip(sp, dil)]
+    out = np.zeros(w.shape[:2] + tuple(new_sp), dtype=w.dtype)
+    idx = (slice(None), slice(None)) + tuple(
+        slice(None, None, d) for d in dil)
+    out[idx] = w
+    return out
+
+
+def _maxpool(x, attrs):
+    kernel = attrs["kernel_shape"]
+    strides = attrs.get("strides", [1] * len(kernel))
+    pads = attrs.get("pads", [0] * 2 * len(kernel))
+    if any(d != 1 for d in attrs.get("dilations", [1] * len(kernel))):
+        raise NotImplementedError("dilated MaxPool")
+    if np.issubdtype(x.dtype, np.floating):
+        fill = -np.inf
+    else:
+        fill = np.iinfo(x.dtype).min
+    win = _windows(x, kernel, strides, pads, fill)
+    return win.max(axis=tuple(range(-len(kernel), 0)))
+
+
+def _avgpool(x, attrs):
+    kernel = attrs["kernel_shape"]
+    strides = attrs.get("strides", [1] * len(kernel))
+    pads = attrs.get("pads", [0] * 2 * len(kernel))
+    win = _windows(x.astype(np.float64), kernel, strides, pads, 0.0)
+    s = win.sum(axis=tuple(range(-len(kernel), 0)))
+    if attrs.get("count_include_pad", 0):
+        n = float(np.prod(kernel))
+        return (s / n).astype(x.dtype)
+    ones = _windows(np.ones(x.shape, np.float64), kernel, strides, pads, 0.0)
+    return (s / ones.sum(axis=tuple(range(-len(kernel), 0)))).astype(x.dtype)
+
+
+def _slice_op(data, starts, ends, axes=None, steps=None):
+    axes = list(range(data.ndim)) if axes is None else [int(a) for a in axes]
+    steps = [1] * len(axes) if steps is None else [int(s) for s in steps]
+    idx = [slice(None)] * data.ndim
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        st, en = int(st), int(en)
+        en = None if (sp < 0 and en < -data.shape[ax]) else en
+        idx[ax] = slice(st, en, sp)
+    return data[tuple(idx)]
+
+
+def _gemm(a, b, c=None, alpha=1.0, beta=1.0, transA=0, transB=0):
+    if transA:
+        a = a.T
+    if transB:
+        b = b.T
+    out = alpha * (a @ b)
+    if c is not None:
+        out = out + beta * c
+    return out.astype(a.dtype)
+
+
+def _erf(x):
+    try:
+        from scipy.special import erf as _serf
+
+        return _serf(x).astype(x.dtype)
+    except ImportError:
+        import math
+
+        return np.vectorize(math.erf)(
+            x.astype(np.float64)).astype(x.dtype)
+
+
+def _div(a, b):
+    if np.issubdtype(np.asarray(a).dtype, np.floating):
+        return a / b
+    # ONNX Div (like lax.div) truncates toward zero for integers
+    return (np.sign(a) * np.sign(b)
+            * (np.abs(a) // np.abs(b))).astype(np.asarray(a).dtype)
+
+
+def _freduce(fn, xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = fn(out, x)
+    return out
+
+
+def _run_node(node, attrs, ins):
+    op = node.op_type
+    E = {
+        "Add": lambda a, b: a + b, "Sub": lambda a, b: a - b,
+        "Mul": lambda a, b: a * b, "Div": _div,
+        "Mod": lambda a, b: (np.fmod(a, b) if attrs.get("fmod")
+                             else np.mod(a, b)),
+        "Pow": lambda a, b: np.power(a, b.astype(a.dtype)),
+        "Max": lambda *xs: _freduce(np.maximum, xs),
+        "Min": lambda *xs: _freduce(np.minimum, xs),
+        "Equal": np.equal, "Less": np.less, "LessOrEqual": np.less_equal,
+        "Greater": np.greater, "GreaterOrEqual": np.greater_equal,
+        "And": np.logical_and, "Or": np.logical_or, "Xor": np.logical_xor,
+        "Not": np.logical_not,
+        "BitwiseAnd": np.bitwise_and, "BitwiseOr": np.bitwise_or,
+        "BitwiseXor": np.bitwise_xor, "BitwiseNot": np.invert,
+        "Neg": np.negative, "Abs": np.abs, "Sign": np.sign,
+        "Floor": np.floor, "Ceil": np.ceil,
+        "Round": lambda x: np.round(x, 0),
+        "Sqrt": np.sqrt, "Reciprocal": lambda x: 1.0 / x,
+        "Exp": np.exp, "Log": np.log, "Tanh": np.tanh,
+        "Sin": np.sin, "Cos": np.cos, "Tan": np.tan,
+        "Asin": np.arcsin, "Acos": np.arccos, "Atan": np.arctan,
+        "Sinh": np.sinh, "Cosh": np.cosh, "Asinh": np.arcsinh,
+        "Acosh": np.arccosh, "Atanh": np.arctanh,
+        "Sigmoid": lambda x: 1.0 / (1.0 + np.exp(-x)),
+        "Erf": _erf,
+        "IsNaN": np.isnan, "IsInf": np.isinf,
+        "Relu": lambda x: np.maximum(x, 0),
+        "Identity": lambda x: x,
+    }
+    if op in E:
+        out = E[op](*ins)
+        if op in ("Equal", "Less", "LessOrEqual", "Greater",
+                  "GreaterOrEqual", "And", "Or", "Xor", "Not",
+                  "IsNaN", "IsInf"):
+            return [np.asarray(out, dtype=np.bool_)]
+        ref = next((x for x in ins if hasattr(x, "dtype")), None)
+        if op in ("Sigmoid", "Reciprocal", "Erf") and ref is not None:
+            out = np.asarray(out, dtype=ref.dtype)
+        return [np.asarray(out)]
+
+    if op == "MatMul":
+        a, b = ins
+        return [(a.astype(np.float64) @ b.astype(np.float64))
+                .astype(a.dtype)]
+    if op == "Einsum":
+        eq = attrs["equation"]
+        return [np.einsum(eq, *[x.astype(np.float64) for x in ins])
+                .astype(ins[0].dtype)]
+    if op == "Gemm":
+        return [_gemm(*ins, **attrs)]
+    if op == "Conv":
+        return [_conv(ins[0], ins[1], attrs)
+                + (ins[2].reshape((1, -1) + (1,) * (ins[0].ndim - 2))
+                   if len(ins) > 2 else 0)]
+    if op == "MaxPool":
+        return [_maxpool(ins[0], attrs)]
+    if op == "AveragePool":
+        return [_avgpool(ins[0], attrs)]
+    if op == "Reshape":
+        return [ins[0].reshape([int(d) for d in ins[1]])]
+    if op == "Transpose":
+        return [np.transpose(ins[0], attrs.get("perm"))]
+    if op == "Expand":
+        return [np.broadcast_to(
+            ins[0], np.broadcast_shapes(ins[0].shape,
+                                        tuple(int(d) for d in ins[1])))]
+    if op == "Concat":
+        return [np.concatenate(ins, axis=attrs["axis"])]
+    if op == "Slice":
+        return [_slice_op(*ins)]
+    if op == "Pad":
+        data, pads = ins[0], [int(p) for p in ins[1]]
+        value = ins[2] if len(ins) > 2 else np.zeros((), data.dtype)
+        n = data.ndim
+        widths = list(zip(pads[:n], pads[n:]))
+        return [np.pad(data, widths, constant_values=value)]
+    if op == "Where":
+        return [np.where(*ins)]
+    if op == "Cast":
+        return [ins[0].astype(_np_dtype(attrs["to"]))]
+    if op == "Gather":
+        return [np.take(ins[0], ins[1].astype(np.int64),
+                        axis=attrs.get("axis", 0))]
+    if op == "ReduceSum":
+        axes = tuple(int(a) for a in ins[1]) if len(ins) > 1 else None
+        return [ins[0].astype(np.float64).sum(
+            axis=axes, keepdims=bool(attrs.get("keepdims", 1)))
+            .astype(ins[0].dtype)]
+    if op in ("ReduceMax", "ReduceMin", "ReduceProd", "ReduceMean"):
+        fn = {"ReduceMax": np.max, "ReduceMin": np.min,
+              "ReduceProd": np.prod, "ReduceMean": np.mean}[op]
+        axes = tuple(attrs["axes"]) if "axes" in attrs else None
+        return [np.asarray(fn(ins[0], axis=axes,
+                              keepdims=bool(attrs.get("keepdims", 1))),
+                           dtype=ins[0].dtype)]
+    if op in ("ArgMax", "ArgMin"):
+        fn = np.argmax if op == "ArgMax" else np.argmin
+        out = fn(ins[0], axis=attrs.get("axis", 0))
+        if attrs.get("keepdims", 1):
+            out = np.expand_dims(out, attrs.get("axis", 0))
+        return [out.astype(np.int64)]
+    if op == "CumSum":
+        out = np.cumsum(
+            np.flip(ins[0], int(ins[1])) if attrs.get("reverse")
+            else ins[0], axis=int(ins[1]), dtype=np.float64)
+        if attrs.get("reverse"):
+            out = np.flip(out, int(ins[1]))
+        return [out.astype(ins[0].dtype)]
+    if op == "TopK":
+        x, k = ins[0], int(ins[1].reshape(-1)[0])
+        axis = attrs.get("axis", -1)
+        largest = attrs.get("largest", 1)
+        order = np.argsort(-x if largest else x, axis=axis, kind="stable")
+        idx = np.take(order, np.arange(k), axis=axis)
+        vals = np.take_along_axis(x, idx, axis=axis)
+        return [vals, idx.astype(np.int64)]
+    if op == "Softmax":
+        axis = attrs.get("axis", -1)
+        e = np.exp(ins[0] - ins[0].max(axis=axis, keepdims=True))
+        return [(e / e.sum(axis=axis, keepdims=True)).astype(ins[0].dtype)]
+    raise NotImplementedError(f"numpy runtime: op {op}")
+
+
+def run(model, inputs):
+    """Execute a ModelProto on a dict of numpy inputs; returns a list of
+    output arrays."""
+    if isinstance(model, (str, bytes)):
+        model = load(model)
+    g = model.graph
+    env = {t.name: tensor_to_numpy(t) for t in g.initializer}
+    for vi in g.input:
+        if vi.name not in inputs:
+            raise KeyError(f"missing input {vi.name}")
+    env.update({k: np.asarray(v) for k, v in inputs.items()})
+    for node in g.node:
+        ins = [env[name] for name in node.input if name]
+        outs = _run_node(node, _attrs(node), ins)
+        for name, val in zip(node.output, outs):
+            env[name] = val
+    return [env[o.name] for o in g.output]

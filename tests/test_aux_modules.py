@@ -101,7 +101,8 @@ def test_stablehlo_export_roundtrip():
     with tempfile.TemporaryDirectory() as td:
         path = paddle.onnx.export(
             layer, os.path.join(td, "model"),
-            input_spec=[paddle.static.InputSpec([2, 8], "float32")])
+            input_spec=[paddle.static.InputSpec([2, 8], "float32")],
+            format="stablehlo")
         assert os.path.exists(path)
         with open(path, "rb") as f:
             rt = jax.export.deserialize(f.read())

@@ -1,0 +1,225 @@
+"""ONNX export: jaxpr->ONNX converter + bundled numpy runtime.
+
+Parity oracle runs under jax.default_matmul_precision('highest') because
+the exported graph computes matmuls exactly (numpy fp64) while XLA's CPU
+default uses reduced-precision dots.
+
+Reference: python/paddle/onnx/export.py:21 (paddle2onnx path).
+"""
+import os
+import tempfile
+
+import numpy as np
+import jax
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _roundtrip(layer, specs, inputs, atol=3e-5):
+    layer.eval()
+    with tempfile.TemporaryDirectory() as td:
+        path = paddle.onnx.export(layer, os.path.join(td, "model"),
+                                  input_spec=specs)
+        assert path.endswith(".onnx") and os.path.exists(path)
+        model = paddle.onnx.load(path)
+        outs = paddle.onnx.run(
+            model, {f"input_{i}": x for i, x in enumerate(inputs)})
+    with jax.default_matmul_precision("highest"):
+        ref = layer(*[paddle.to_tensor(x) for x in inputs])
+    refs = [r.numpy() for r in
+            (ref if isinstance(ref, (tuple, list)) else [ref])]
+    assert len(outs) == len(refs)
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(
+            np.asarray(o, np.float32), np.asarray(r, np.float32),
+            atol=atol, rtol=1e-4)
+    return model
+
+
+def test_mlp_parity():
+    paddle.seed(0)
+    layer = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.LayerNorm(16),
+                          nn.Linear(16, 4), nn.Softmax())
+    x = np.random.default_rng(1).normal(size=(2, 8)).astype(np.float32)
+    model = _roundtrip(layer, [paddle.static.InputSpec([2, 8], "float32")],
+                       [x])
+    ops = {n.op_type for n in model.graph.node}
+    assert "MatMul" in ops and "Erf" in ops
+    # weights exported under their parameter names
+    names = {t.name for t in model.graph.initializer}
+    assert "0.weight" in names and "3.bias" in names
+
+
+def test_cnn_parity():
+    paddle.seed(0)
+    from paddle_tpu.vision.models import LeNet
+
+    net = LeNet()
+    x = np.random.default_rng(2).normal(size=(2, 1, 28, 28)) \
+        .astype(np.float32)
+    model = _roundtrip(
+        net, [paddle.static.InputSpec([2, 1, 28, 28], "float32")], [x],
+        atol=1e-4)
+    ops = [n.op_type for n in model.graph.node]
+    assert "Conv" in ops and "MaxPool" in ops
+
+
+def test_bert_tiny_parity():
+    paddle.seed(0)
+    from paddle_tpu.text.models.bert import BertConfig, BertModel
+
+    cfg = BertConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=64,
+                     max_position_embeddings=64)
+    bert = BertModel(cfg)
+    ids = np.random.default_rng(3).integers(0, 128, (2, 16)) \
+        .astype(np.int32)
+    model = _roundtrip(bert, [paddle.to_tensor(ids)], [ids], atol=1e-4)
+    ops = {n.op_type for n in model.graph.node}
+    assert "Gather" in ops  # embeddings
+
+
+def test_pooling_and_reductions():
+    paddle.seed(0)
+    layer = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU6(),
+                          nn.AvgPool2D(2), nn.Flatten(),
+                          nn.Linear(8 * 4 * 4, 5))
+    x = np.random.default_rng(4).normal(size=(2, 3, 8, 8)) \
+        .astype(np.float32)
+    _roundtrip(layer, [paddle.static.InputSpec([2, 3, 8, 8], "float32")],
+               [x], atol=1e-4)
+
+
+def test_groups_and_strided_conv():
+    paddle.seed(0)
+    layer = nn.Sequential(
+        nn.Conv2D(4, 8, 3, stride=2, padding=1, groups=2),
+        nn.Sigmoid())
+    x = np.random.default_rng(5).normal(size=(2, 4, 9, 9)) \
+        .astype(np.float32)
+    _roundtrip(layer, [paddle.static.InputSpec([2, 4, 9, 9], "float32")],
+               [x], atol=1e-4)
+
+
+def test_unsupported_primitive_raises():
+    paddle.seed(0)
+    rnn = nn.LSTM(4, 8)  # lax.scan body -> no ONNX mapping
+    with tempfile.TemporaryDirectory() as td:
+        with pytest.raises(paddle.onnx.OnnxExportError):
+            paddle.onnx.export(
+                rnn, os.path.join(td, "m"),
+                input_spec=[paddle.static.InputSpec([2, 6, 4], "float32")])
+
+
+def test_runtime_parses_torch_exported_model():
+    """The hand-authored protobuf schema must parse files produced by an
+    independent exporter (torch's bundled C++ ONNX serializer)."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as tnn
+    import torch.onnx._internal.torchscript_exporter.onnx_proto_utils as opu
+
+    opu._add_onnxscript_fn = lambda proto, cg: proto  # needs onnx pkg
+    tm = tnn.Sequential(tnn.Linear(4, 8), tnn.ReLU(), tnn.Linear(8, 2))
+    tm.eval()
+    tx = torch.randn(3, 4)
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "torch.onnx")
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            torch.onnx.export(tm, (tx,), p, dynamo=False)
+        model = paddle.onnx.load(p)
+        assert model.producer_name == "pytorch"
+        ops = [n.op_type for n in model.graph.node]
+        assert ops.count("Gemm") == 2 and "Relu" in ops
+        in_name = model.graph.input[0].name
+        (out,) = paddle.onnx.run(model, {in_name: tx.numpy()})
+        ref = tm(tx).detach().numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_constant_folding_and_where():
+    paddle.seed(0)
+
+    class Masked(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(6, 6)
+
+        def forward(self, x):
+            h = self.fc(x)
+            mask = paddle.triu(paddle.ones((6, 6)))  # folds to a const
+            return paddle.where(mask.astype("bool"), h,
+                                paddle.zeros_like(h))
+
+    x = np.random.default_rng(6).normal(size=(6, 6)).astype(np.float32)
+    model = _roundtrip(Masked(),
+                       [paddle.static.InputSpec([6, 6], "float32")], [x])
+    ops = [n.op_type for n in model.graph.node]
+    assert "Where" in ops
+
+
+def test_topk_argmax_cast():
+    paddle.seed(0)
+
+    class Head(nn.Layer):
+        def forward(self, x):
+            vals, idx = paddle.topk(x, k=3, axis=-1)
+            return vals, idx.astype("float32"), \
+                paddle.argmax(x, axis=-1).astype("float32")
+
+    x = np.random.default_rng(7).normal(size=(4, 10)).astype(np.float32)
+    _roundtrip(Head(), [paddle.static.InputSpec([4, 10], "float32")], [x])
+
+
+def test_integer_div_rem_truncation():
+    """lax.div / lax.rem truncate toward zero; runtime must match."""
+    paddle.seed(0)
+
+    class IntOps(nn.Layer):
+        def forward(self, x):
+            import jax.numpy as jnp
+            from paddle_tpu.tensor import apply
+
+            return apply(lambda a: jax.lax.div(a, jnp.int32(2)), x), \
+                apply(lambda a: jax.lax.rem(a, jnp.int32(2)), x)
+
+    x = np.asarray([-7, -3, -1, 1, 3, 7], dtype=np.int32)
+    layer = IntOps()
+    layer.eval()
+    with tempfile.TemporaryDirectory() as td:
+        path = paddle.onnx.export(layer, os.path.join(td, "m"),
+                                  input_spec=[paddle.to_tensor(x)])
+        outs = paddle.onnx.run(paddle.onnx.load(path), {"input_0": x})
+    np.testing.assert_array_equal(outs[0], np.asarray([-3, -1, 0, 0, 1, 3]))
+    np.testing.assert_array_equal(outs[1], np.asarray([-1, -1, -1, 1, 1, 1]))
+
+
+def test_large_const_dedup_is_content_based():
+    """Distinct large constants must NOT collapse (id-reuse regression)."""
+    from paddle_tpu.onnx.converter import _Ctx
+    from paddle_tpu.onnx.proto import onnx_pb2 as P
+
+    ctx = _Ctx(P.GraphProto(), 13)
+    names = [ctx.initializer(np.full(10000, i, dtype=np.float32))
+             for i in range(20)]
+    assert len(set(names)) == 20
+    # identical content still dedups
+    assert ctx.initializer(np.full(10000, 3, dtype=np.float32)) == names[3]
+
+
+def test_both_formats():
+    paddle.seed(0)
+    layer = nn.Linear(4, 4)
+    layer.eval()
+    with tempfile.TemporaryDirectory() as td:
+        path = paddle.onnx.export(
+            layer, os.path.join(td, "m"),
+            input_spec=[paddle.static.InputSpec([1, 4], "float32")],
+            format="both")
+        assert path.endswith(".onnx")
+        assert os.path.exists(os.path.join(td, "m.onnx"))
+        assert os.path.exists(os.path.join(td, "m.stablehlo"))
