@@ -8,6 +8,7 @@ report absence (we target TPU, not CUDA).
 from ..framework import (  # noqa: F401
     CPUPlace, CUDAPlace, TPUPlace, device_count, get_device, set_device,
 )
+from ..framework.device import get_cudnn_version  # noqa: F401
 from . import cuda  # noqa: F401
 
 

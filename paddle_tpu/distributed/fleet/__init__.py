@@ -86,3 +86,50 @@ def is_first_worker():
 def barrier_worker():
     from ..collective import barrier
     barrier()
+
+
+from .compat import (  # noqa: F401,E402
+    CommunicateTopology, MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator, PaddleCloudRoleMaker, Role,
+    UserDefinedRoleMaker, UtilBase,
+)
+
+util = UtilBase()
+
+
+class Fleet:
+    """Class view of the fleet singleton (reference
+    fleet/base/fleet_base.py Fleet): the module-level functions are the
+    single-controller implementation; this class binds them so code
+    written against `fleet.Fleet()` keeps working."""
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        return init(role_maker, is_collective, strategy)
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    def worker_num(self):
+        return worker_num()
+
+    def worker_index(self):
+        return worker_index()
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        return barrier_worker()
+
+    @property
+    def util(self):
+        return util

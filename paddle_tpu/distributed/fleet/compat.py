@@ -1,0 +1,263 @@
+"""Fleet compatibility surface: topology math, util object, role
+makers, ps-style data generators.
+
+Reference: python/paddle/distributed/fleet/base/topology.py:52
+(CommunicateTopology), base/role_maker.py:28,526,1112 (Role,
+PaddleCloudRoleMaker, UserDefinedRoleMaker), base/util_factory.py
+(UtilBase), data_generator/data_generator.py (MultiSlotDataGenerator,
+MultiSlotStringDataGenerator). These are host-side coordinate/IO
+helpers with no device code — the mesh math mirrors how
+jax.sharding.Mesh lays ranks out (row-major over named axes).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+
+class CommunicateTopology:
+    """Rank <-> coordinate bookkeeping over named parallel axes,
+    row-major like a jax Mesh (reference base/topology.py:52)."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
+                                           "model"),
+                 dims=(1, 1, 1, 1)):
+        self._names = list(hybrid_group_names)
+        self._dims = [int(d) for d in dims]
+        self._strides = []
+        s = 1
+        for d in reversed(self._dims):
+            self._strides.append(s)
+            s *= d
+        self._strides.reverse()
+        self._world = s
+
+    def get_hybrid_group_names(self):
+        return self._names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **coords):
+        assert set(coords) == set(self._names), coords
+        rank = 0
+        for name, stride, dim in zip(self._names, self._strides,
+                                     self._dims):
+            c = int(coords[name])
+            assert 0 <= c < dim, f"{name}={c} out of range {dim}"
+            rank += c * stride
+        return rank
+
+    def get_coord(self, rank):
+        assert 0 <= rank < self._world, rank
+        out = {}
+        for name, stride, dim in zip(self._names, self._strides,
+                                     self._dims):
+            out[name] = (rank // stride) % dim
+        import collections
+
+        return collections.namedtuple("Coordinate", self._names)(**out)
+
+    def get_axis_list(self, axis_name, index):
+        return sorted(r for r in range(self._world)
+                      if getattr(self.get_coord(r), axis_name) == index)
+
+    def get_comm_list(self, axis_name):
+        """Groups of ranks varying only along `axis_name`."""
+        axis = self._names.index(axis_name)
+        groups = {}
+        for r in range(self._world):
+            coord = list(self.get_coord(r))
+            key = tuple(c for i, c in enumerate(coord) if i != axis)
+            groups.setdefault(key, []).append(r)
+        return [sorted(v) for _, v in sorted(groups.items())]
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = self.get_coord(global_rank)._asdict()
+        coord.update(kwargs)
+        return self.get_rank(**coord)
+
+
+class UtilBase:
+    """Cross-worker helpers (reference base/util_factory.py). Under the
+    single-controller SPMD runtime most collectives are identities on
+    one host; multi-host goes through distributed.collective."""
+
+    def all_gather(self, input, comm_world="worker"):
+        import jax
+
+        arr = np.asarray(input)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            return list(multihost_utils.process_allgather(arr))
+        return [arr]
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        stack = np.stack(self.all_gather(input, comm_world))
+        return {"sum": stack.sum(0), "min": stack.min(0),
+                "max": stack.max(0)}[mode]
+
+    def barrier(self, comm_world="worker"):
+        import jax
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("fleet_util_barrier")
+
+    def get_file_shard(self, files):
+        """Split a file list evenly over workers; trainer k takes the
+        k-th contiguous slice (remainder spread over the first ranks)."""
+        from .. import collective
+
+        rank = collective.get_rank()
+        n = max(collective.get_world_size(), 1)
+        files = list(files)
+        base, rem = divmod(len(files), n)
+        start = rank * base + min(rank, rem)
+        return files[start:start + base + (1 if rank < rem else 0)]
+
+    def print_on_rank(self, message, rank_id=0):
+        from .. import collective
+
+        if collective.get_rank() == rank_id:
+            print(message)
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """Env-var driven role resolution (reference
+    base/role_maker.py:526). On the TPU runtime every process is a
+    collective worker; PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM (or the
+    jax process index) define the gang."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+        self._kwargs = kwargs
+
+    def _worker_index(self):
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    def _worker_num(self):
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    def _is_worker(self):
+        return True
+
+    def _is_server(self):
+        return False
+
+    def _is_first_worker(self):
+        return self._worker_index() == 0
+
+    def _role_id(self):
+        return self._worker_index()
+
+    def _get_trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+    worker_index = _worker_index
+    worker_num = _worker_num
+    is_worker = _is_worker
+    is_server = _is_server
+    is_first_worker = _is_first_worker
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Role maker with explicitly supplied ranks (reference
+    base/role_maker.py:1112)."""
+
+    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
+        super().__init__(is_collective=is_collective, **kwargs)
+        self._cur_id = int(kwargs.get("current_id", 0))
+        self._n = int(kwargs.get("worker_num",
+                                 len(kwargs.get("server_endpoints", []))
+                                 or 1))
+
+    def _worker_index(self):
+        return self._cur_id
+
+    def _worker_num(self):
+        return self._n
+
+    worker_index = _worker_index
+    worker_num = _worker_num
+
+
+class _DataGeneratorBase:
+    """Line-oriented dataset feeding for InMemory/Queue datasets
+    (reference data_generator/data_generator.py): subclass, implement
+    generate_sample(line) returning [(slot_name, values), ...]."""
+
+    def __init__(self):
+        self._line_limit = None
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "implement generate_sample(self, line) in your subclass")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    def _format(self, record):
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        for line in sys.stdin:
+            gen = self.generate_sample(line)
+            for record in gen():
+                sys.stdout.write(self._format(record))
+
+    def run_from_memory(self, lines):
+        out = []
+        for line in lines:
+            gen = self.generate_sample(line)
+            for record in gen():
+                out.append(self._format(record))
+        return out
+
+
+class MultiSlotDataGenerator(_DataGeneratorBase):
+    """Formats records as `<n> v1 .. vn` per slot (values numeric)."""
+
+    def _format(self, record):
+        parts = []
+        for _, values in record:
+            vals = list(values)
+            parts.append(str(len(vals)))
+            parts.extend(str(v) for v in vals)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(_DataGeneratorBase):
+    """Formats records as `<n> s1 .. sn` per slot (values strings)."""
+
+    def _format(self, record):
+        parts = []
+        for _, values in record:
+            vals = [str(v) for v in values]
+            parts.append(str(len(vals)))
+            parts.extend(vals)
+        return " ".join(parts) + "\n"

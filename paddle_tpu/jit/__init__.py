@@ -1,2 +1,5 @@
 from .api import StaticFunction, in_to_static, not_to_static, to_static  # noqa: F401
-from .serialization import load, save  # noqa: F401
+from .compat import (  # noqa: F401
+    ProgramTranslator, TracedLayer, set_code_level, set_verbosity,
+)
+from .serialization import TranslatedLayer, load, save  # noqa: F401

@@ -58,8 +58,12 @@ def _swap_params(params: dict, raw_tree: dict):
 
 
 class StaticFunction:
+    # ProgramTranslator().enable(False) drops back to eager execution
+    global_enable = True
+
     def __init__(self, fn: Callable, input_spec=None, jit_kwargs=None,
                  convert_control_flow: bool = True):
+        self._orig_fn = fn
         if convert_control_flow:
             from .dy2static import convert_control_flow as _ccf
             fn = _ccf(fn)
@@ -75,6 +79,8 @@ class StaticFunction:
             return self._fn(*args, **kwargs)
 
     def __call__(self, *args, **kwargs):
+        if not StaticFunction.global_enable:
+            return self._orig_fn(*args, **kwargs)
         params = _collect_params(self._layer) if self._layer is not None else {}
         raw_params = {k: p._data for k, p in params.items()}
         return self._jit(raw_params, args, kwargs)

@@ -1,0 +1,97 @@
+"""Dygraph-to-static compatibility surface.
+
+Reference: python/paddle/jit/__init__.py exports (TracedLayer from
+fluid/dygraph/jit.py, ProgramTranslator from
+dygraph_to_static/program_translator.py, set_code_level/set_verbosity
+from jit/dy2static/logging_utils.py). In the TPU stack "tracing a
+program" IS jax.jit tracing, so these are thin, fully-functional
+adapters over StaticFunction/jit.save rather than a second machinery.
+"""
+from __future__ import annotations
+
+import logging
+
+from .api import StaticFunction, to_static
+
+_logger = logging.getLogger("paddle_tpu.dy2static")
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Log transformed code at `level` (reference
+    jit/dy2static/logging_utils.py)."""
+    _logger.setLevel(logging.DEBUG if level else logging.WARNING)
+    if also_to_stdout and not _logger.handlers:
+        _logger.addHandler(logging.StreamHandler())
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    _logger.setLevel(logging.DEBUG if level else logging.WARNING)
+    if also_to_stdout and not _logger.handlers:
+        _logger.addHandler(logging.StreamHandler())
+
+
+class ProgramTranslator:
+    """Singleton toggling dy2static conversion globally (reference
+    program_translator.py:999 ProgramTranslator)."""
+
+    _instance = None
+    _enabled = True
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def enable(self, enable_to_static):
+        type(self)._enabled = bool(enable_to_static)
+        StaticFunction.global_enable = bool(enable_to_static)
+
+    @property
+    def enable_to_static(self):
+        return type(self)._enabled
+
+    def get_output(self, dygraph_func, *args, **kwargs):
+        return to_static(dygraph_func)(*args, **kwargs)
+
+    def get_func(self, dygraph_func):
+        return to_static(dygraph_func)
+
+    def get_code(self, dygraph_func):
+        import inspect
+
+        return inspect.getsource(dygraph_func)
+
+
+class TracedLayer:
+    """Trace a dygraph layer into a compiled callable (reference
+    fluid/dygraph/jit.py TracedLayer): `outs, traced =
+    TracedLayer.trace(layer, inputs)`; `traced(inputs)` replays the
+    jitted program; `save_inference_model` writes a jit.save artifact.
+    """
+
+    def __init__(self, static_fn, layer, example_inputs):
+        self._static_fn = static_fn
+        self._layer = layer
+        self._example_inputs = example_inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+            else [inputs]
+        static_fn = to_static(layer)
+        outs = static_fn(*inputs)
+        return outs, TracedLayer(static_fn, layer, inputs)
+
+    def __call__(self, inputs):
+        inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+            else [inputs]
+        return self._static_fn(*inputs)
+
+    def set_strategy(self, build_strategy=None, exec_strategy=None):
+        pass  # XLA owns scheduling; accepted for API parity
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        from .serialization import save
+
+        save(self._layer, path, input_spec=self._example_inputs)
+        return path

@@ -164,3 +164,36 @@ def calculate_gain(nonlinearity, param=None):
              "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
              "selu": 3.0 / 4}
     return gains.get(nonlinearity, 1.0)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init (reference
+    fluid/initializer.py:855): every [i, j] spatial slice gets the
+    bilinear interpolation filter — pair with a grouped conv-transpose
+    of stride s and kernel 2s-s%2 for learnable upsampling."""
+
+    def __call__(self, shape, dtype, key):
+        if len(shape) < 3:
+            raise ValueError("Bilinear initializer needs a conv weight")
+        sp = shape[2:]
+        filt = np.ones((1,), dtype=np.float64)
+        for k in sp:
+            factor = (k + 1) // 2
+            center = factor - 1.0 if k % 2 == 1 else factor - 0.5
+            ax = 1 - np.abs(np.arange(k) - center) / factor
+            filt = filt[..., None] * ax
+        out = np.broadcast_to(filt, shape).astype(np.float32)
+        return jnp.asarray(out, dtype=dtype)
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Set process-wide default initializers used by create_parameter
+    when neither attr nor default_initializer specify one (reference
+    fluid/initializer.py:1105). Pass None to reset."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init

@@ -122,7 +122,16 @@ class Layer:
         if attr is False:
             return None
         dtype = dtype_mod.convert_dtype(dtype) or self._dtype
-        init = attr.initializer or default_initializer
+        from . import initializer as _init_mod
+
+        # priority mirrors the reference layer helper: explicit attr >
+        # set_global_initializer > the layer's default > framework default
+        init = attr.initializer
+        if init is None:
+            init = (_init_mod._global_bias_init if is_bias
+                    else _init_mod._global_weight_init)
+        if init is None:
+            init = default_initializer
         if init is None:
             init = Constant(0.0) if is_bias else XavierUniform()
         init = _to_initializer(init)

@@ -40,7 +40,7 @@ def _example_args(input_spec):
     return args
 
 
-def export(layer, path, input_spec=None, opset_version=13, *,
+def export(layer, path, input_spec=None, opset_version=None, *,
            format="onnx", input_names=None, **kwargs):
     """Export ``layer`` to ``path``.onnx (and/or ``path``.stablehlo).
 
@@ -74,7 +74,8 @@ def export(layer, path, input_spec=None, opset_version=13, *,
             for i, s in enumerate(input_spec)]
         model = jaxpr_to_onnx(
             closed, input_names=in_names, param_values=param_vals,
-            graph_name=type(layer).__name__, opset=opset_version)
+            graph_name=type(layer).__name__,
+            opset=13 if opset_version is None else opset_version)
         with open(path + ".onnx", "wb") as f:
             f.write(model.SerializeToString())
         result = path + ".onnx"

@@ -326,10 +326,14 @@ def _dynamic_slice(ctx, eqn, invals):
             data, ctx.i64(st, "starts"),
             ctx.i64([a + b for a, b in zip(st, sizes)], "ends"),
             ctx.i64(axes, "axes")])
+    shape = eqn.invars[0].aval.shape
     parts = []
-    for s in starts:
+    for s, d, sz in zip(starts, shape, sizes):
         nm = ctx.read(s, "start")
         nm = ctx.node("Cast", [nm], to=_ONNX_DTYPE["int64"])
+        # jax clamps starts into [0, dim - size]; ONNX Slice does not
+        nm = ctx.node("Max", [nm, ctx.i64(0, "zero")])
+        nm = ctx.node("Min", [nm, ctx.i64(int(d) - sz, "hi")])
         parts.append(ctx.node("Reshape", [nm, ctx.i64([1], "one")]))
     start_v = ctx.node("Concat", parts, axis=0)
     end_v = ctx.node("Add", [start_v, ctx.i64(sizes, "sizes")])

@@ -35,7 +35,13 @@ def tensor_to_numpy(t):
     elif t.int64_data:
         arr = np.asarray(list(t.int64_data), dtype=dt)
     elif t.int32_data:
-        arr = np.asarray(list(t.int32_data), dtype=dt)
+        # per the ONNX spec int32_data carries fp16/bf16 as raw bit
+        # patterns and the narrow int/bool types as plain values
+        ints = np.asarray(list(t.int32_data), dtype=np.int32)
+        if t.data_type in (10, 16):  # FLOAT16 / BFLOAT16
+            arr = ints.astype(np.uint16).view(dt)
+        else:
+            arr = ints.astype(dt)
     elif t.double_data:
         arr = np.asarray(list(t.double_data), dtype=dt)
     else:

@@ -21,7 +21,7 @@ import jax
 __all__ = [
     "ProfilerState", "ProfilerTarget", "make_scheduler",
     "export_chrome_tracing", "export_protobuf", "Profiler", "RecordEvent",
-    "RecordInstantEvent", "load_profiler_result",
+    "RecordInstantEvent", "load_profiler_result", "SortedKeys",
 ]
 
 
@@ -215,3 +215,16 @@ class RecordInstantEvent(RecordEvent):
 def load_profiler_result(filename: str):
     raise NotImplementedError(
         "jax traces are viewed with tensorboard/perfetto, not reloaded here")
+
+
+class SortedKeys(Enum):
+    """Sort order for summary tables (reference
+    profiler/profiler_statistic.py SortedKeys)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7

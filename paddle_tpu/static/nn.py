@@ -211,3 +211,822 @@ def prelu(x, mode="all", param_attr=None, name=None):
     import jax.numpy as jnp
     alpha._data = jnp.full((n,), 0.25, alpha._data.dtype)
     return F.prelu(x, alpha)
+
+
+# -- remaining static.nn builders (reference: python/paddle/static/nn/
+# __init__.py surface; fluid/layers/{nn,sequence_lod,rnn}.py) ----------
+#
+# Sequence ops: the reference operates on LoD tensors; the TPU-native
+# analog is padded-dense [B, T, ...] with an optional `length` ([B] int)
+# mask — LoD is a CPU pointer structure XLA cannot tile, a dense mask
+# is one fused select.
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """out[:, k] = x W_k y^T + b (reference fluid/layers/nn.py
+    bilinear_tensor_product)."""
+    from .program import create_parameter
+
+    dx, dy = int(x.shape[-1]), int(y.shape[-1])
+    w = create_parameter((size, dx, dy), str(x.dtype),
+                         name=name or _uniq("blt_w"), attr=param_attr)
+    from ..tensor_ops.einsum import einsum
+
+    out = einsum("bi,kij,bj->bk", x, w, y)
+    if bias_attr is not False:
+        b = create_parameter((size,), str(x.dtype), name=_uniq("blt_b"),
+                             attr=bias_attr, is_bias=True)
+        out = out + b
+    if act:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def _conv_nd(input, num_filters, filter_size, stride, padding, dilation,
+             groups, param_attr, bias_attr, act, name, ndim,
+             transpose=False):
+    from .program import create_parameter
+    from ..nn import functional as F
+
+    ks = (filter_size,) * ndim if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    cin = int(input.shape[1])
+    wshape = ((cin, num_filters // groups, *ks) if transpose
+              else (num_filters, cin // groups, *ks))
+    w = create_parameter(wshape, str(input.dtype),
+                         name=name or _uniq("conv_w"), attr=param_attr)
+    b = None
+    if bias_attr is not False:
+        b = create_parameter((num_filters,), str(input.dtype),
+                             name=_uniq("conv_b"), attr=bias_attr,
+                             is_bias=True)
+    fn = {(2, False): F.conv2d, (3, False): F.conv3d,
+          (2, True): F.conv2d_transpose,
+          (3, True): F.conv3d_transpose}[(ndim, transpose)]
+    out = fn(input, w, bias=b, stride=stride, padding=padding,
+             dilation=dilation, groups=groups)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    return _conv_nd(input, num_filters, filter_size, stride, padding,
+                    dilation, groups, param_attr, bias_attr, act, name, 3)
+
+
+def _transpose_filter_size(input, output_size, filter_size, padding,
+                           stride, dilation, ndim):
+    """Derive the kernel from the requested output size:
+    out = (in - 1) * stride - 2 * pad + dilation * (k - 1) + 1."""
+    if filter_size is not None:
+        return filter_size
+    if output_size is None:
+        raise ValueError("need output_size or filter_size")
+    outs = (output_size,) * ndim if isinstance(output_size, int) \
+        else tuple(output_size)
+    st = (stride,) * ndim if isinstance(stride, int) else tuple(stride)
+    pd = (padding,) * ndim if isinstance(padding, int) \
+        else tuple(padding)
+    dl = (dilation,) * ndim if isinstance(dilation, int) \
+        else tuple(dilation)
+    ins = tuple(int(s) for s in input.shape[2:])
+    return tuple(
+        (o - (i - 1) * s + 2 * p - 1) // d + 1
+        for o, i, s, p, d in zip(outs, ins, st, pd, dl))
+
+
+def conv2d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None,
+                     data_format="NCHW"):
+    filter_size = _transpose_filter_size(input, output_size, filter_size,
+                                         padding, stride, dilation, 2)
+    return _conv_nd(input, num_filters, filter_size, stride, padding,
+                    dilation, groups, param_attr, bias_attr, act, name,
+                    2, transpose=True)
+
+
+def conv3d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None,
+                     data_format="NCDHW"):
+    filter_size = _transpose_filter_size(input, output_size, filter_size,
+                                         padding, stride, dilation, 3)
+    return _conv_nd(input, num_filters, filter_size, stride, padding,
+                    dilation, groups, param_attr, bias_attr, act, name,
+                    3, transpose=True)
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from .program import create_parameter
+    from ..nn import functional as F
+
+    c = int(input.shape[1])
+    w = create_parameter((c,), str(input.dtype),
+                         name=name or _uniq("gn_w"), attr=param_attr)
+    b = create_parameter((c,), str(input.dtype), name=_uniq("gn_b"),
+                         attr=bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    out = F.group_norm(input, groups, epsilon=epsilon, weight=w, bias=b)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    from .program import create_parameter
+    from ..nn import functional as F
+
+    c = int(input.shape[1])
+    w = create_parameter((c,), str(input.dtype),
+                         name=name or _uniq("in_w"), attr=param_attr)
+    b = create_parameter((c,), str(input.dtype), name=_uniq("in_b"),
+                         attr=bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Power-iteration spectral normalization of a weight tensor
+    (reference fluid/layers/nn.py spectral_norm)."""
+    import jax.numpy as jnp
+
+    from ..tensor import apply
+
+    def f(w):
+        mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((mat.shape[0],), w.dtype) / jnp.sqrt(
+            1.0 * mat.shape[0])
+        v = None
+        for _ in range(max(power_iters, 1)):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ mat @ v
+        return w / sigma
+    return apply(f, weight)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Normalization by learned accumulated batch statistics (CTR-style,
+    reference fluid/layers/nn.py data_norm): params hold batch_size /
+    batch_sum / batch_square_sum accumulators."""
+    from .program import create_parameter
+    import jax.numpy as jnp
+
+    from ..tensor import apply
+    from ..nn.initializer import Constant
+
+    d = int(input.shape[-1])
+    bsz = create_parameter((d,), str(input.dtype), name=_uniq("dn_size"),
+                           attr=param_attr,
+                           default_initializer=Constant(1e4))
+    bsum = create_parameter((d,), str(input.dtype), name=_uniq("dn_sum"),
+                            attr=param_attr,
+                            default_initializer=Constant(0.0))
+    bsq = create_parameter((d,), str(input.dtype), name=_uniq("dn_sq"),
+                           attr=param_attr,
+                           default_initializer=Constant(1e4))
+
+    def f(x, n, s, sq):
+        mean = s / n
+        scale = jnp.sqrt(jnp.maximum(sq / n - mean ** 2, 0.0) + epsilon)
+        return (x - mean) / scale
+    out = apply(f, input, bsz, bsum, bsq)
+    if act:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size,
+                  stride=1, padding=0, dilation=1, groups=1,
+                  deformable_groups=1, im2col_step=1, param_attr=None,
+                  bias_attr=None, modulated=True, name=None):
+    from .program import create_parameter
+    from ..vision.ops import deform_conv2d as _dc
+
+    ks = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    cin = int(input.shape[1])
+    w = create_parameter((num_filters, cin // groups, *ks),
+                         str(input.dtype), name=name or _uniq("dcn_w"),
+                         attr=param_attr)
+    b = create_parameter((num_filters,), str(input.dtype),
+                         name=_uniq("dcn_b"), attr=bias_attr,
+                         is_bias=True) if bias_attr is not False else None
+    return _dc(input, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask if modulated else None)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None,
+                     name=None):
+    """Dense analog of the PS sparse table lookup (reference
+    fluid/contrib/layers sparse_embedding): on TPU the table is a
+    sharded dense parameter."""
+    return embedding(input, size, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype, name=name)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    """Lookahead row convolution (reference fluid/layers/nn.py
+    row_conv): out[t] = sum_{i<=ctx} x[t+i] * w[i], per feature."""
+    from .program import create_parameter
+    import jax.numpy as jnp
+
+    from ..tensor import apply
+
+    d = int(input.shape[-1])
+    ctx = int(future_context_size)
+    w = create_parameter((ctx + 1, d), str(input.dtype),
+                         name=name or _uniq("rowconv_w"),
+                         attr=param_attr)
+
+    def f(x, wt):
+        t = x.shape[-2]
+        xp = jnp.pad(x, [(0, 0)] * (x.ndim - 2) + [(0, ctx), (0, 0)])
+        out = 0.0
+        for i in range(ctx + 1):
+            out = out + xp[..., i:i + t, :] * wt[i]
+        return out
+    out = apply(f, input, w)
+    if act:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None,
+        name=None, sampler="uniform", custom_dist=None, seed=0,
+        is_sparse=False):
+    """Noise-contrastive estimation loss (reference fluid/layers/nn.py
+    nce): BCE on the true class plus `num_neg_samples` sampled noise
+    classes. Returns per-example loss [B, 1]."""
+    from .program import create_parameter
+    import jax
+    import jax.numpy as jnp
+    import numpy as np_
+
+    from ..tensor import apply
+
+    d = int(input.shape[-1])
+    k = int(num_neg_samples or 10)
+    w = create_parameter((num_total_classes, d), str(input.dtype),
+                         name=name or _uniq("nce_w"), attr=param_attr)
+    b = create_parameter((num_total_classes,), str(input.dtype),
+                         name=_uniq("nce_b"), attr=bias_attr,
+                         is_bias=True) if bias_attr is not False else None
+    if custom_dist is not None:
+        probs = np_.asarray(custom_dist, dtype=np_.float64)
+        probs = probs / probs.sum()
+    else:
+        probs = np_.full(num_total_classes, 1.0 / num_total_classes)
+    rng = np_.random.default_rng(seed or 0)
+    neg = rng.choice(num_total_classes, size=(k,), p=probs)
+
+    def f(x, lb, wt, *bs):
+        bias = bs[0] if bs else None
+        lb = lb.reshape(-1).astype(jnp.int32)
+        s_true = jnp.sum(x * wt[lb], -1)
+        s_neg = x @ wt[neg].T  # [B, k]
+        if bias is not None:
+            s_true = s_true + bias[lb]
+            s_neg = s_neg + bias[neg]
+        # NCE logits: s - log(k * Pn(class))
+        logq_true = jnp.log(k * jnp.asarray(probs, x.dtype)[lb])
+        logq_neg = jnp.log(k * jnp.asarray(probs[neg], x.dtype))
+        lt = s_true - logq_true
+        ln = s_neg - logq_neg[None, :]
+        loss = -(jax.nn.log_sigmoid(lt)
+                 + jnp.sum(jax.nn.log_sigmoid(-ln), -1))
+        return loss[:, None]
+    args = [input, label, w] + ([b] if b is not None else [])
+    return apply(f, *args)
+
+
+def crf_decoding(input, param_attr, length=None, label=None, name=None):
+    """Viterbi decode with start/stop-augmented transitions (reference
+    fluid/layers/nn.py crf_decoding): `param_attr` is the learned
+    [N+2, N] transition parameter (rows 0/1 = start/stop scores)."""
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor, apply
+    from ..text.viterbi_decode import viterbi_decode
+
+    trans = param_attr  # a Tensor parameter in this stack
+    start = apply(lambda t: t[0], trans)
+    stop = apply(lambda t: t[1], trans)
+    body = apply(lambda t: t[2:], trans)
+    if length is None:
+        length = Tensor(jnp.full((int(input.shape[0]),),
+                                 int(input.shape[1]), jnp.int32))
+
+    # start scores at t=0, stop scores at each sequence's LAST VALID
+    # step (not the padded tail)
+    def add_boundary(em, st, sp, ln):
+        em = em.at[:, 0, :].add(st)
+        last = jnp.maximum(ln.reshape(-1).astype(jnp.int32) - 1, 0)
+        return em.at[jnp.arange(em.shape[0]), last, :].add(sp)
+    em = apply(add_boundary, input, start, stop, length)
+    _, path = viterbi_decode(em, body, length,
+                             include_bos_eos_tag=False)
+    return path
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head (reference fluid/layers/detection.py
+    multi_box_head): per feature map a loc conv (priors*4) and a conf
+    conv (priors*classes), plus the prior boxes. Returns
+    (mbox_locs [B, P, 4], mbox_confs [B, P, C], boxes [P, 4],
+    variances [P, 4])."""
+    import numpy as np_
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor
+    from ..tensor_ops.manipulation import concat
+
+    n_maps = len(inputs)
+    if min_sizes is None:
+        min_ratio = min_ratio if min_ratio is not None else 20
+        max_ratio = max_ratio if max_ratio is not None else 90
+        step = int((max_ratio - min_ratio) / max(n_maps - 2, 1))
+        min_sizes, max_sizes = [base_size * 0.1], [base_size * 0.2]
+        for r in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+        min_sizes = min_sizes[:n_maps]
+        max_sizes = max_sizes[:n_maps]
+    img_h = int(image.shape[2])
+    img_w = int(image.shape[3])
+
+    locs, confs, priors, pvars = [], [], [], []
+    for i, feat in enumerate(inputs):
+        fh, fw = int(feat.shape[2]), int(feat.shape[3])
+        ars = list(aspect_ratios[i]) if not np_.isscalar(
+            aspect_ratios[i]) else [aspect_ratios[i]]
+        full_ars = [1.0]
+        for ar in ars:
+            if ar != 1.0:
+                full_ars.append(ar)
+                if flip:
+                    full_ars.append(1.0 / ar)
+        sizes = [(min_sizes[i], min_sizes[i])]
+        if max_sizes is not None and i < len(max_sizes):
+            sizes.append((np_.sqrt(min_sizes[i] * max_sizes[i]),) * 2)
+        boxes = []
+        sw = steps[i] if steps else (step_w[i] if step_w
+                                     else img_w / fw)
+        sh = steps[i] if steps else (step_h[i] if step_h
+                                     else img_h / fh)
+        for y in range(fh):
+            for x in range(fw):
+                cx = (x + offset) * sw
+                cy = (y + offset) * sh
+                for (bw, bh) in sizes:
+                    boxes.append([cx - bw / 2, cy - bh / 2,
+                                  cx + bw / 2, cy + bh / 2])
+                for ar in full_ars[1:]:
+                    bw = min_sizes[i] * np_.sqrt(ar)
+                    bh = min_sizes[i] / np_.sqrt(ar)
+                    boxes.append([cx - bw / 2, cy - bh / 2,
+                                  cx + bw / 2, cy + bh / 2])
+        boxes = np_.asarray(boxes, np_.float32)
+        boxes[:, 0::2] /= img_w
+        boxes[:, 1::2] /= img_h
+        if clip:
+            boxes = np_.clip(boxes, 0.0, 1.0)
+        n_priors = len(sizes) + len(full_ars) - 1
+        loc = conv2d(feat, n_priors * 4, kernel_size, stride=stride,
+                     padding=pad, name=_uniq(f"mbox_loc{i}"))
+        conf = conv2d(feat, n_priors * num_classes, kernel_size,
+                      stride=stride, padding=pad,
+                      name=_uniq(f"mbox_conf{i}"))
+        from ..tensor_ops.manipulation import reshape, transpose
+
+        b = int(feat.shape[0])
+        locs.append(reshape(transpose(loc, (0, 2, 3, 1)), (b, -1, 4)))
+        confs.append(reshape(transpose(conf, (0, 2, 3, 1)),
+                             (b, -1, num_classes)))
+        priors.append(boxes)
+        pvars.append(np_.tile(np_.asarray(variance, np_.float32),
+                              (len(boxes), 1)))
+    mbox_locs = concat(locs, axis=1)
+    mbox_confs = concat(confs, axis=1)
+    box = Tensor(jnp.asarray(np_.concatenate(priors, 0)))
+    var = Tensor(jnp.asarray(np_.concatenate(pvars, 0)))
+    return mbox_locs, mbox_confs, box, var
+
+
+# -- sequence ops on padded-dense [B, T, ...] + optional length mask ----
+
+def _time_mask(x, length, dtype=None):
+    import jax.numpy as jnp
+
+    t = int(x.shape[1])
+    if length is None:
+        return None
+    from ..tensor import apply
+
+    return apply(lambda ln: (jnp.arange(t)[None, :]
+                             < ln.reshape(-1, 1)).astype(dtype or
+                                                         "float32"),
+                 length)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, length=None):
+    from ..nn import functional as F
+
+    if length is None:
+        return F.softmax(input, axis=1)
+    import jax.numpy as jnp
+
+    from ..tensor import apply
+
+    t = int(input.shape[1])
+
+    def f(x, ln):
+        mask = jnp.arange(t)[None, :] < ln.reshape(-1, 1)
+        shape = mask.shape + (1,) * (x.ndim - 2)
+        m = mask.reshape(shape)
+        z = jnp.where(m, x, -jnp.inf)
+        z = z - jnp.max(z, 1, keepdims=True)
+        e = jnp.exp(z) * m
+        return e / jnp.maximum(e.sum(1, keepdims=True), 1e-9)
+    return apply(f, input, length)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
+                  length=None):
+    """sum/average/sqrt/max/last/first pooling over the time axis."""
+    import jax.numpy as jnp
+
+    from ..tensor import apply
+
+    t = int(input.shape[1])
+    pool_type = pool_type.lower()
+
+    def f(x, *ln_args):
+        if ln_args:
+            ln = ln_args[0].reshape(-1)
+            mask = (jnp.arange(t)[None, :] < ln[:, None])
+            m = mask.reshape(mask.shape + (1,) * (x.ndim - 2)) \
+                .astype(x.dtype)
+            n = jnp.maximum(ln.astype(x.dtype), 1.0) \
+                .reshape((-1,) + (1,) * (x.ndim - 2))
+        else:
+            ln = jnp.full((x.shape[0],), t)
+            m = jnp.ones_like(x)
+            n = jnp.asarray(float(t), x.dtype)
+        if pool_type == "sum":
+            return (x * m).sum(1)
+        if pool_type in ("average", "mean", "avg"):
+            return (x * m).sum(1) / n
+        if pool_type == "sqrt":
+            return (x * m).sum(1) / jnp.sqrt(n)
+        if pool_type == "max":
+            return jnp.where(m > 0, x, -jnp.inf).max(1)
+        if pool_type == "first":
+            return x[:, 0]
+        if pool_type == "last":
+            idx = jnp.maximum(ln - 1, 0).astype(jnp.int32)
+            return x[jnp.arange(x.shape[0]), idx]
+        raise ValueError(pool_type)
+    args = (input,) + ((length,) if length is not None else ())
+    return apply(f, *args)
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, "first", length=length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, "last", length=length)
+
+
+def sequence_concat(input, name=None):
+    from ..tensor_ops.manipulation import concat
+
+    return concat(list(input), axis=1)
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-sequence slice: out[b] = input[b, offset[b]:offset[b]+L]
+    (L = length[b], static max over the batch)."""
+    import jax.numpy as jnp
+
+    from ..tensor import apply
+    from ..tensor_ops._factory import raw
+    import numpy as np_
+
+    lmax = int(np_.asarray(raw(length)).max())
+
+    def f(x, off):
+        off = off.reshape(-1).astype(jnp.int32)
+        idx = off[:, None] + jnp.arange(lmax)[None, :]
+        idx = jnp.clip(idx, 0, x.shape[1] - 1)
+        return jnp.take_along_axis(
+            x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    return apply(f, input, offset)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Broadcast x's time axis to y's (dense analog of LoD expand:
+    valid when x has T==1 or T equal to y's)."""
+    import jax.numpy as jnp
+
+    from ..tensor import apply
+
+    ty = int(y.shape[1])
+
+    def f(a):
+        if a.shape[1] == ty:
+            return a
+        if a.shape[1] == 1:
+            return jnp.broadcast_to(a, (a.shape[0], ty) + a.shape[2:])
+        raise ValueError(
+            f"dense sequence_expand needs T==1 or T=={ty}, "
+            f"got {a.shape[1]}")
+    return apply(f, x)
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None, length=None):
+    """Pad the time axis to `maxlen`; returns (padded, length [B])."""
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor, apply
+
+    t = int(x.shape[1])
+    target = int(maxlen or t)
+
+    def f(a, pv):
+        if target <= t:
+            return a[:, :target]
+        widths = [(0, 0), (0, target - t)] + [(0, 0)] * (a.ndim - 2)
+        return jnp.pad(a, widths, constant_values=pv)
+    out = apply(f, x, pad_value if hasattr(pad_value, "_data")
+                else Tensor(jnp.asarray(pad_value)))
+    ln = length if length is not None else Tensor(
+        jnp.full((int(x.shape[0]),), t, jnp.int64))
+    return out, ln
+
+
+def sequence_unpad(x, length, name=None):
+    """Mask out positions beyond `length` (dense tensors cannot shrink
+    per row; consumers read `length`)."""
+    import jax.numpy as jnp
+
+    from ..tensor import apply
+
+    t = int(x.shape[1])
+
+    def f(a, ln):
+        mask = (jnp.arange(t)[None, :] < ln.reshape(-1, 1))
+        return a * mask.reshape(mask.shape + (1,) * (a.ndim - 2)) \
+            .astype(a.dtype)
+    return apply(f, x, length)
+
+
+def sequence_reshape(input, new_dim, name=None):
+    from ..tensor_ops.manipulation import reshape
+
+    b = int(input.shape[0])
+    return reshape(input, (b, -1, new_dim))
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """out[b, index[b, i]] += updates[b, i] - like scatter over time."""
+    import jax.numpy as jnp
+
+    from ..tensor import apply
+
+    def f(x, idx, upd):
+        idx = idx.astype(jnp.int32)
+        b = jnp.arange(x.shape[0])[:, None]
+        return x.at[b, idx].add(upd)
+    return apply(f, input, index, updates)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """Sliding windows over ids: [B, T] -> [B, T, win_size]."""
+    import jax.numpy as jnp
+
+    from ..tensor import apply
+
+    def f(x):
+        t = x.shape[1]
+        xp = jnp.pad(x, [(0, 0), (0, win_size - 1)],
+                     constant_values=pad_value)
+        return jnp.stack([xp[:, i:i + t] for i in range(win_size)], -1)
+    return apply(f, input)
+
+
+def sequence_reverse(x, name=None, length=None):
+    """Reverse the time axis; with `length`, reverse only each valid
+    prefix (matching LoD semantics)."""
+    import jax.numpy as jnp
+
+    from ..tensor import apply
+
+    t = int(x.shape[1])
+
+    def f(a, *ln_args):
+        if not ln_args:
+            return jnp.flip(a, 1)
+        ln = ln_args[0].reshape(-1, 1).astype(jnp.int32)
+        pos = jnp.arange(t)[None, :]
+        src = jnp.where(pos < ln, ln - 1 - pos, pos)
+        return jnp.take_along_axis(
+            a, src.reshape(src.shape + (1,) * (a.ndim - 2)), axis=1)
+    args = (x,) + ((length,) if length is not None else ())
+    return apply(f, *args)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Temporal context conv: each output step sees `filter_size`
+    neighboring steps (reference fluid/layers/sequence_lod.py
+    sequence_conv)."""
+    from .program import create_parameter
+    import jax.numpy as jnp
+
+    from ..tensor import apply
+
+    d = int(input.shape[-1])
+    w = create_parameter((filter_size * d, num_filters),
+                         str(input.dtype),
+                         name=name or _uniq("seqconv_w"),
+                         attr=param_attr)
+    b = create_parameter((num_filters,), str(input.dtype),
+                         name=_uniq("seqconv_b"), attr=bias_attr,
+                         is_bias=True) if bias_attr is not False else None
+    start = (-(filter_size // 2) if padding_start is None
+             else padding_start)
+
+    def f(x, wt, *bs):
+        t = x.shape[1]
+        lo = max(-start, 0)
+        hi = max(filter_size - 1 + start, 0)
+        xp = jnp.pad(x, [(0, 0), (lo, hi), (0, 0)])
+        ctx = jnp.concatenate(
+            [xp[:, i:i + t] for i in range(filter_size)], -1)
+        out = ctx @ wt
+        if bs:
+            out = out + bs[0]
+        return out
+    args = [input, w] + ([b] if b is not None else [])
+    out = apply(f, *args)
+    if act:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+class StaticRNN:
+    """Step-wise RNN builder (reference fluid/layers/rnn.py StaticRNN).
+
+    The `with rnn.step():` body records its ops into the current
+    Program; StaticRNN lifts that recorded slice out and replays it T
+    times (T = time dim of the first step_input, which is time-major
+    [T, B, ...]), rebinding step inputs and carrying memories — the
+    define-by-run analog of the reference's block-based RNN.
+    """
+
+    def __init__(self, name=None):
+        self._mems = []      # [placeholder, init Tensor, updated Tensor]
+        self._inputs = []    # (placeholder, sequence Tensor)
+        self._outputs = []
+        self._entries = None
+        self._prog = None
+
+    import contextlib as _ctx
+
+    @_ctx.contextmanager
+    def step(self):
+        from .program import default_main_program
+
+        self._prog = default_main_program()
+        start = len(self._prog._ops)
+        yield
+        self._entries = list(self._prog._ops[start:])
+        del self._prog._ops[start:]
+
+    def step_input(self, x):
+        from ..tensor import Tensor
+
+        ph = Tensor(x._data[0])
+        self._inputs.append((ph, x))
+        return ph
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0,
+               ref_batch_dim_idx=1):
+        import jax.numpy as jnp
+
+        from ..tensor import Tensor
+
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory() needs init or "
+                                 "(shape, batch_ref)")
+            shp = [int(s) for s in shape]
+            if shp[0] in (-1, 0):
+                shp[0] = int(batch_ref.shape[init_batch_dim_idx])
+            init = Tensor(jnp.full(tuple(shp), init_value, jnp.float32))
+        ph = Tensor(init._data)
+        self._mems.append([ph, init, None])
+        return ph
+
+    def update_memory(self, mem, var):
+        for entry in self._mems:
+            if entry[0] is mem:
+                entry[2] = var
+                return
+        raise ValueError("unknown memory")
+
+    def step_output(self, o):
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _run(self):
+        import jax.numpy as jnp
+
+        from ..tensor import apply
+
+        t = int(self._inputs[0][1].shape[0])
+        for m in self._mems:
+            m[0]._data = m[1]._data
+        collected = [[] for _ in self._outputs]
+        for step in range(t):
+            for ph, seq in self._inputs:
+                ph._data = seq._data[step]
+            for entry in self._entries:
+                if entry[0] == "thunk":
+                    entry[1]()
+                    continue
+                _, fn, args, kwargs, outs = entry
+                res = apply(fn, *args, **kwargs)
+                new = res if isinstance(res, tuple) else (res,)
+                for old, fresh in zip(outs, new):
+                    old._data = fresh._data
+                    old._node = fresh._node
+                    old._out_index = fresh._out_index
+            for i, o in enumerate(self._outputs):
+                collected[i].append(o._data)
+            for m in self._mems:
+                if m[2] is not None:
+                    m[0]._data = m[2]._data
+        return [jnp.stack(c) for c in collected]
+
+    def __call__(self):
+        from ..tensor import Tensor
+
+        if not self._entries or not self._inputs:
+            raise RuntimeError("StaticRNN: define steps with "
+                               "`with rnn.step():` first")
+        datas = self._run()
+        outs = [Tensor(d) for d in datas]
+
+        def replay():
+            for ot, d in zip(outs, self._run()):
+                ot._data = d
+        self._prog._append_thunk(replay)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+from .program import py_func  # noqa: F401,E402

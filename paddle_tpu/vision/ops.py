@@ -1,23 +1,511 @@
-"""Vision ops. Reference: python/paddle/vision/ops.py (roi_align, nms,
-deform_conv2d)."""
+"""Vision ops.
+
+Reference: python/paddle/vision/ops.py (yolo_loss:34, yolo_box:249,
+deform_conv2d:427, distribute_fpn_proposals:835, read_file:952,
+decode_jpeg:998, psroi_pool:1049, roi_pool:1167, roi_align:1295,
+nms:1509, generate_proposals:1660, matrix_nms:1811).
+
+TPU-first split: dense static-shape ops (yolo_box/yolo_loss,
+deform_conv2d, roi_align/roi_pool/psroi_pool) are jnp/lax programs and
+jit-able; proposal-stage ops with data-dependent output sizes (nms,
+generate_proposals, distribute_fpn_proposals, matrix_nms) run host-side
+on numpy — tiny tensors with dynamic shapes belong on the host, not the
+MXU.
+"""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..nn.layer_base import Layer
 from ..tensor import Tensor, apply
 from ..tensor_ops._factory import raw
 
+__all__ = [
+    "yolo_loss", "yolo_box", "deform_conv2d", "DeformConv2D",
+    "distribute_fpn_proposals", "generate_proposals", "read_file",
+    "decode_jpeg", "roi_pool", "RoIPool", "psroi_pool", "PSRoIPool",
+    "roi_align", "RoIAlign", "nms", "matrix_nms",
+]
 
+
+# ---------------------------------------------------------------- yolo --
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode a YOLOv3 head into boxes+scores in input-image scale.
+
+    x: [N, S*(5+class_num), H, W] (S*(6+class_num) when iou_aware).
+    Returns (boxes [N, S*H*W, 4] xyxy, scores [N, S*H*W, class_num]).
+    """
+    anchors = np.asarray(anchors, dtype=np.float32).reshape(-1, 2)
+    S = anchors.shape[0]
+
+    def f(xr, isz):
+        n, c, h, w = xr.shape
+        per = c // S
+        xr = xr.reshape(n, S, per, h, w)
+        if iou_aware:
+            iou_pred = jax.nn.sigmoid(xr[:, :, 0])
+            xr = xr[:, :, 1:]
+        tx, ty, tw, th, obj = (xr[:, :, 0], xr[:, :, 1], xr[:, :, 2],
+                               xr[:, :, 3], xr[:, :, 4])
+        cls = jax.nn.sigmoid(xr[:, :, 5:5 + class_num])
+        gx = jnp.arange(w, dtype=xr.dtype)
+        gy = jnp.arange(h, dtype=xr.dtype)
+        bx = (jax.nn.sigmoid(tx) * scale_x_y - 0.5 * (scale_x_y - 1.0)
+              + gx[None, None, None, :]) / w
+        by = (jax.nn.sigmoid(ty) * scale_x_y - 0.5 * (scale_x_y - 1.0)
+              + gy[None, None, :, None]) / h
+        # anchor units are input-image pixels
+        bw = jnp.exp(tw) * anchors[:, 0][None, :, None, None] \
+            / (w * downsample_ratio)
+        bh = jnp.exp(th) * anchors[:, 1][None, :, None, None] \
+            / (h * downsample_ratio)
+        conf = jax.nn.sigmoid(obj)
+        if iou_aware:
+            conf = conf ** (1.0 - iou_aware_factor) \
+                * iou_pred ** iou_aware_factor
+        keep = (conf >= conf_thresh).astype(xr.dtype)
+        score = cls * (conf * keep)[:, :, None]
+        imh = isz[:, 0].astype(xr.dtype)[:, None, None, None]
+        imw = isz[:, 1].astype(xr.dtype)[:, None, None, None]
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep[..., None]
+        return (boxes.reshape(n, S * h * w, 4),
+                jnp.moveaxis(score, 2, -1).reshape(n, S * h * w,
+                                                   class_num))
+    return apply(f, x, img_size)
+
+
+def _iou_wh(wh1, wh2):
+    """IoU of centered boxes given only width/height, [A,2] x [B,2]."""
+    inter = (jnp.minimum(wh1[:, None, 0], wh2[None, :, 0])
+             * jnp.minimum(wh1[:, None, 1], wh2[None, :, 1]))
+    a1 = wh1[:, 0] * wh1[:, 1]
+    a2 = wh2[:, 0] * wh2[:, 1]
+    return inter / jnp.maximum(a1[:, None] + a2[None, :] - inter, 1e-9)
+
+
+def _box_iou_xywh(b1, b2):
+    """IoU between broadcastable center-form [.., 4] boxes."""
+    b1x1, b1x2 = b1[..., 0] - b1[..., 2] / 2, b1[..., 0] + b1[..., 2] / 2
+    b1y1, b1y2 = b1[..., 1] - b1[..., 3] / 2, b1[..., 1] + b1[..., 3] / 2
+    b2x1, b2x2 = b2[..., 0] - b2[..., 2] / 2, b2[..., 0] + b2[..., 2] / 2
+    b2y1, b2y2 = b2[..., 1] - b2[..., 3] / 2, b2[..., 1] + b2[..., 3] / 2
+    iw = jnp.maximum(jnp.minimum(b1x2, b2x2) - jnp.maximum(b1x1, b2x1), 0)
+    ih = jnp.maximum(jnp.minimum(b1y2, b2y2) - jnp.maximum(b1y1, b2y1), 0)
+    inter = iw * ih
+    a1 = jnp.maximum(b1x2 - b1x1, 0) * jnp.maximum(b1y2 - b1y1, 0)
+    a2 = jnp.maximum(b2x2 - b2x1, 0) * jnp.maximum(b2y2 - b2y1, 0)
+    return inter / jnp.maximum(a1 + a2 - inter, 1e-9)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss for one detection scale, fully vectorized
+    (one gather/scatter program — no per-gt Python loops under jit).
+
+    x: [N, S*(5+class_num), H, W] with S = len(anchor_mask);
+    gt_box: [N, B, 4] center-form normalized to [0, 1];
+    gt_label: [N, B] int. Returns per-image loss [N].
+    """
+    all_anchors = np.asarray(anchors, dtype=np.float32).reshape(-1, 2)
+    mask = np.asarray(anchor_mask, dtype=np.int64)
+    S = len(mask)
+    sm_eps = 1.0 / class_num if use_label_smooth else 0.0
+
+    def bce(logit, target):
+        return -(target * jax.nn.log_sigmoid(logit)
+                 + (1 - target) * jax.nn.log_sigmoid(-logit))
+
+    def f(xr, gb, gl, gs):
+        n, c, h, w = xr.shape
+        xr = xr.reshape(n, S, 5 + class_num, h, w)
+        tx, ty, tw, th, obj = (xr[:, :, 0], xr[:, :, 1], xr[:, :, 2],
+                               xr[:, :, 3], xr[:, :, 4])
+        cls_logit = jnp.moveaxis(xr[:, :, 5:], 2, -1)  # [N,S,H,W,C]
+        in_w = w * downsample_ratio
+        in_h = h * downsample_ratio
+        pa = all_anchors[mask]
+
+        # decoded predictions (normalized center form) for the ignore mask
+        gx = jnp.arange(w, dtype=xr.dtype)[None, None, None, :]
+        gy = jnp.arange(h, dtype=xr.dtype)[None, None, :, None]
+        px = (jax.nn.sigmoid(tx) * scale_x_y
+              - 0.5 * (scale_x_y - 1.0) + gx) / w
+        py = (jax.nn.sigmoid(ty) * scale_x_y
+              - 0.5 * (scale_x_y - 1.0) + gy) / h
+        pw = jnp.exp(tw) * pa[:, 0][None, :, None, None] / in_w
+        ph = jnp.exp(th) * pa[:, 1][None, :, None, None] / in_h
+        pred = jnp.stack([px, py, pw, ph], -1)  # [N,S,H,W,4]
+
+        valid = (gb[..., 2] > 0) & (gb[..., 3] > 0)  # [N,B]
+        iou_all = _box_iou_xywh(pred[:, :, :, :, None, :],
+                                gb[:, None, None, None, :, :])
+        best_pred_iou = jnp.max(
+            jnp.where(valid[:, None, None, None, :], iou_all, 0.0), -1)
+        ignore = (best_pred_iou > ignore_thresh).astype(xr.dtype)
+
+        # gt -> anchor assignment by wh-IoU against ALL anchors
+        gwh = gb[..., 2:4] * jnp.asarray([in_w, in_h], dtype=xr.dtype)
+        iou_anchor = _iou_wh(
+            gwh.reshape(-1, 2), all_anchors).reshape(
+                gwh.shape[0], gwh.shape[1], len(all_anchors))
+        best_anchor = jnp.argmax(iou_anchor, -1)  # [N,B]
+        on_scale = jnp.any(
+            best_anchor[..., None] == mask[None, None, :], -1) & valid
+        local = jnp.argmax(
+            best_anchor[..., None] == mask[None, None, :], -1)  # [N,B]
+
+        gi = jnp.clip((gb[..., 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gb[..., 1] * h).astype(jnp.int32), 0, h - 1)
+        bidx = jnp.arange(n)[:, None]
+        obj_tgt = jnp.zeros((n, S, h, w), xr.dtype).at[
+            bidx, local, gj, gi].max(jnp.where(on_scale, 1.0, 0.0))
+
+        def sel(t):
+            return t[bidx, local, gj, gi]  # [N,B]
+
+        tx_t = gb[..., 0] * w - gi
+        ty_t = gb[..., 1] * h - gj
+        tw_t = jnp.log(jnp.maximum(
+            gwh[..., 0] / jnp.maximum(pa[local][..., 0], 1e-9), 1e-9))
+        th_t = jnp.log(jnp.maximum(
+            gwh[..., 1] / jnp.maximum(pa[local][..., 1], 1e-9), 1e-9))
+        box_w = (2.0 - gb[..., 2] * gb[..., 3]) * gs  # small-box upweight
+        m = on_scale.astype(xr.dtype) * box_w
+
+        loss_xy = (bce(sel(tx), tx_t) + bce(sel(ty), ty_t)) * m
+        loss_wh = (jnp.abs(sel(tw) - tw_t) + jnp.abs(sel(th) - th_t)) * m
+        cls_tgt = jax.nn.one_hot(gl, class_num, dtype=xr.dtype)
+        cls_tgt = cls_tgt * (1 - sm_eps) + sm_eps / 2
+        loss_cls = jnp.sum(
+            bce(cls_logit[bidx, local, gj, gi], cls_tgt), -1) \
+            * on_scale.astype(xr.dtype) * gs
+        noobj_w = (1.0 - obj_tgt) * (1.0 - ignore)
+        loss_obj = jnp.sum(bce(obj, obj_tgt) * (obj_tgt + noobj_w),
+                           (1, 2, 3))
+        return jnp.sum(loss_xy + loss_wh + loss_cls, 1) + loss_obj
+
+    if gt_score is None:
+        gt_score = Tensor(jnp.ones(raw(gt_label).shape, jnp.float32))
+    return apply(lambda a, b, c, d: f(a, b, c.astype(jnp.int32), d),
+                 x, gt_box, gt_label, gt_score)
+
+
+# ------------------------------------------------------- deform conv --
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1 (mask=None) / v2: bilinear-sample the input at
+    kernel positions shifted by learned offsets, then one einsum — a
+    gather+matmul program XLA fuses, not a CUDA scatter translation.
+
+    offset: [N, 2*dg*Kh*Kw, oh, ow] (paired (dy, dx) per kernel tap);
+    mask: [N, dg*Kh*Kw, oh, ow].
+    """
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    dg = deformable_groups
+
+    def f(xr, off, w, *rest):
+        mk = rest[0] if mask is not None else None
+        b = rest[-1] if bias is not None else None
+        n, cin, h, wd = xr.shape
+        cout, cin_g, kh, kw = w.shape
+        K = kh * kw
+        oh = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        ow = (wd + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        off = off.reshape(n, dg, K, 2, oh, ow)
+        base_y = (jnp.arange(oh) * s[0] - p[0]).astype(xr.dtype)
+        base_x = (jnp.arange(ow) * s[1] - p[1]).astype(xr.dtype)
+        ky = jnp.repeat(jnp.arange(kh) * d[0], kw).astype(xr.dtype)
+        kx = jnp.tile(jnp.arange(kw) * d[1], kh).astype(xr.dtype)
+        # sampling positions [N, dg, K, oh, ow]
+        yy = (base_y[None, None, None, :, None]
+              + ky[None, None, :, None, None] + off[:, :, :, 0])
+        xx = (base_x[None, None, None, None, :]
+              + kx[None, None, :, None, None] + off[:, :, :, 1])
+        # expand deformable groups to channels: [N, cin, K, oh, ow]
+        yyc = jnp.repeat(yy, cin // dg, axis=1)
+        xxc = jnp.repeat(xx, cin // dg, axis=1)
+
+        def sample_chan(im, iy, ix):
+            """im [h, w]; iy/ix [K, oh, ow] float -> [K, oh, ow]."""
+            y0 = jnp.floor(iy)
+            x0 = jnp.floor(ix)
+            wy = iy - y0
+            wx = ix - x0
+            acc = 0.0
+            for dy, wyv in ((0, 1 - wy), (1, wy)):
+                for dx, wxv in ((0, 1 - wx), (1, wx)):
+                    yi = (y0 + dy).astype(jnp.int32)
+                    xi = (x0 + dx).astype(jnp.int32)
+                    inside = ((yi >= 0) & (yi < h)
+                              & (xi >= 0) & (xi < wd)).astype(im.dtype)
+                    v = im[jnp.clip(yi, 0, h - 1),
+                           jnp.clip(xi, 0, wd - 1)]
+                    acc = acc + v * wyv * wxv * inside
+            return acc
+
+        cols = jax.vmap(jax.vmap(sample_chan))(xr, yyc, xxc)
+        if mk is not None:
+            mkr = jnp.repeat(mk.reshape(n, dg, K, oh, ow),
+                             cin // dg, axis=1)
+            cols = cols * mkr
+        wr = w.reshape(cout, cin_g, K)
+        outs = []
+        for gi in range(groups):
+            cg = cols[:, gi * cin_g:(gi + 1) * cin_g]
+            wg = wr[gi * (cout // groups):(gi + 1) * (cout // groups)]
+            outs.append(jnp.einsum("nckhw,ock->nohw", cg, wg))
+        out = outs[0] if groups == 1 else jnp.concatenate(outs, 1)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply(f, *args)
+
+
+class DeformConv2D(Layer):
+    """Deformable conv layer (reference vision/ops.py:642)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        from ..nn.initializer import XavierUniform
+
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups) + ks,
+            attr=weight_attr, default_initializer=XavierUniform())
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter((out_channels,), attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, self._stride,
+            self._padding, self._dilation, self._deformable_groups,
+            self._groups, mask)
+
+
+# ------------------------------------------------------------ roi ops --
+def _box_batch_index(boxes_num, total):
+    bn = np.asarray(raw(boxes_num)).astype(np.int64)
+    idx = np.repeat(np.arange(len(bn)), bn)
+    if len(idx) < total:  # trailing boxes default to the last image
+        idx = np.concatenate(
+            [idx, np.full(total - len(idx), max(len(bn) - 1, 0))])
+    return jnp.asarray(idx[:total], jnp.int32)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Bilinear ROI-align over a static number of boxes
+    (reference vision/ops.py:1295)."""
+    os_ = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    R = raw(boxes).shape[0]
+    bidx = _box_batch_index(boxes_num, R)
+
+    def f(feat, bx):
+        n, c, h, w = feat.shape
+        oh, ow = os_
+        offset = 0.5 if aligned else 0.0
+        x1 = bx[:, 0] * spatial_scale - offset
+        y1 = bx[:, 1] * spatial_scale - offset
+        x2 = bx[:, 2] * spatial_scale - offset
+        y2 = bx[:, 3] * spatial_scale - offset
+        bw = jnp.maximum(x2 - x1, 1e-6)
+        bh = jnp.maximum(y2 - y1, 1e-6)
+        ns = sampling_ratio if sampling_ratio > 0 else 2
+        sy = (jnp.arange(oh * ns) + 0.5) / ns  # in output-bin units
+        sx = (jnp.arange(ow * ns) + 0.5) / ns
+        ys = y1[:, None] + sy[None, :] * (bh[:, None] / oh)
+        xs = x1[:, None] + sx[None, :] * (bw[:, None] / ow)
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1).astype(jnp.int32)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = jnp.clip(ys - y0, 0, 1)
+        wx = jnp.clip(xs - x0, 0, 1)
+        fm = feat[bidx]  # [R, C, H, W]
+        ridx = jnp.arange(R)[:, None, None]
+
+        def gat(yy, xx):
+            return fm[ridx, :, yy[:, :, None], xx[:, None, :]] \
+                .transpose(0, 3, 1, 2)  # [R, C, Sy, Sx]
+
+        v = (gat(y0, x0)
+             * ((1 - wy)[:, :, None] * (1 - wx)[:, None, :])[:, None]
+             + gat(y0, x1i)
+             * ((1 - wy)[:, :, None] * wx[:, None, :])[:, None]
+             + gat(y1i, x0)
+             * (wy[:, :, None] * (1 - wx)[:, None, :])[:, None]
+             + gat(y1i, x1i)
+             * (wy[:, :, None] * wx[:, None, :])[:, None])
+        return v.reshape(R, c, oh, ns, ow, ns).mean((3, 5))
+    return apply(f, x, boxes)
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """Max ROI pooling (reference vision/ops.py:1167)."""
+    os_ = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    R = raw(boxes).shape[0]
+    bidx = _box_batch_index(boxes_num, R)
+
+    def f(feat, bx):
+        n, c, h, w = feat.shape
+        oh, ow = os_
+        x1 = jnp.round(bx[:, 0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(bx[:, 1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(bx[:, 2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(bx[:, 3] * spatial_scale).astype(jnp.int32)
+        bh = jnp.maximum(y2 - y1 + 1, 1)
+        bw = jnp.maximum(x2 - x1 + 1, 1)
+        fm = feat[bidx]
+        yy = jnp.arange(h)
+        xx = jnp.arange(w)
+        rows = []
+        for i in range(oh):
+            ys = y1 + (i * bh) // oh
+            ye = y1 + ((i + 1) * bh + oh - 1) // oh
+            rowm = (yy[None] >= ys[:, None]) & (yy[None] < ye[:, None])
+            cols = []
+            for j in range(ow):
+                xs = x1 + (j * bw) // ow
+                xe = x1 + ((j + 1) * bw + ow - 1) // ow
+                colm = (xx[None] >= xs[:, None]) \
+                    & (xx[None] < xe[:, None])
+                m = rowm[:, None, :, None] & colm[:, None, None, :]
+                cell = jnp.max(jnp.where(m, fm, -jnp.inf), (2, 3))
+                cols.append(jnp.where(jnp.isfinite(cell), cell, 0.0))
+            rows.append(jnp.stack(cols, -1))
+        return jnp.stack(rows, -2)  # [R, C, oh, ow]
+    return apply(f, x, boxes)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive ROI average pooling
+    (reference vision/ops.py:1049): output channel block (i, j) of the
+    grid reads input channel slice (i*ow+j)."""
+    os_ = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    oh, ow = os_
+    R = raw(boxes).shape[0]
+    bidx = _box_batch_index(boxes_num, R)
+
+    def f(feat, bx):
+        n, c, h, w = feat.shape
+        if c % (oh * ow) != 0:
+            raise ValueError(
+                f"psroi_pool needs channels % (oh*ow) == 0, got {c} "
+                f"for {oh}x{ow}")
+        co = c // (oh * ow)
+        x1 = bx[:, 0] * spatial_scale
+        y1 = bx[:, 1] * spatial_scale
+        x2 = bx[:, 2] * spatial_scale
+        y2 = bx[:, 3] * spatial_scale
+        bh = jnp.maximum(y2 - y1, 0.1)
+        bw = jnp.maximum(x2 - x1, 0.1)
+        fm = feat[bidx].reshape(R, oh, ow, co, h, w)
+        yy = jnp.arange(h, dtype=feat.dtype) + 0.5
+        xx = jnp.arange(w, dtype=feat.dtype) + 0.5
+        rows = []
+        for i in range(oh):
+            ys = y1 + bh * i / oh
+            ye = y1 + bh * (i + 1) / oh
+            rm = ((yy[None] >= ys[:, None])
+                  & (yy[None] < ye[:, None])).astype(feat.dtype)
+            cols = []
+            for j in range(ow):
+                xs = x1 + bw * j / ow
+                xe = x1 + bw * (j + 1) / ow
+                cm = ((xx[None] >= xs[:, None])
+                      & (xx[None] < xe[:, None])).astype(feat.dtype)
+                m = rm[:, None, :, None] * cm[:, None, None, :]
+                cnt = jnp.maximum(m.sum((2, 3)), 1.0)
+                cols.append((fm[:, i, j] * m).sum((2, 3)) / cnt)
+            rows.append(jnp.stack(cols, -1))
+        return jnp.stack(rows, -2)  # [R, co, oh, ow]
+    return apply(f, x, boxes)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+# ------------------------------------------------- host-side (eager) --
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         categories=None, top_k=None):
     """Host-side NMS (data-dependent output size → eager only)."""
     b = np.asarray(raw(boxes))
-    s = np.asarray(raw(scores)) if scores is not None else np.arange(len(b))[::-1].astype(np.float32)
+    s = (np.asarray(raw(scores)) if scores is not None
+         else np.arange(len(b))[::-1].astype(np.float32))
     order = np.argsort(-s)
     keep = []
     suppressed = np.zeros(len(b), dtype=bool)
     areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    cat = (np.asarray(raw(category_idxs))
+           if category_idxs is not None else None)
     for i in order:
         if suppressed[i]:
             continue
@@ -28,8 +516,7 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         yy2 = np.minimum(b[i, 3], b[:, 3])
         inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
         iou = inter / np.maximum(areas[i] + areas - inter, 1e-9)
-        same_cat = (np.asarray(raw(category_idxs)) ==
-                    np.asarray(raw(category_idxs))[i]) if category_idxs is not None else True
+        same_cat = (cat == cat[i]) if cat is not None else True
         suppressed |= (iou > iou_threshold) & same_cat
         suppressed[i] = True
     keep = np.asarray(keep, dtype=np.int64)
@@ -38,48 +525,195 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     return Tensor(jnp.asarray(keep))
 
 
-def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
-              sampling_ratio=-1, aligned=True, name=None):
-    """Bilinear ROI-align; static over a fixed number of boxes."""
-    bx = raw(boxes)
-    os_ = (output_size, output_size) if isinstance(output_size, int) else output_size
-
-    def f(feat):
-        n, c, h, w = feat.shape
-        R = bx.shape[0]
-        oh, ow = os_
-        offset = 0.5 if aligned else 0.0
-        x1 = bx[:, 0] * spatial_scale - offset
-        y1 = bx[:, 1] * spatial_scale - offset
-        x2 = bx[:, 2] * spatial_scale - offset
-        y2 = bx[:, 3] * spatial_scale - offset
-        bw = jnp.maximum(x2 - x1, 1e-6)
-        bh = jnp.maximum(y2 - y1, 1e-6)
-        ys = y1[:, None] + (jnp.arange(oh) + 0.5)[None, :] * (bh[:, None] / oh)
-        xs = x1[:, None] + (jnp.arange(ow) + 0.5)[None, :] * (bw[:, None] / ow)
-        # bilinear sample feat[0] (batch handled via boxes_num upstream)
-        y0 = jnp.clip(jnp.floor(ys), 0, h - 1).astype(jnp.int32)
-        x0 = jnp.clip(jnp.floor(xs), 0, w - 1).astype(jnp.int32)
-        y1i = jnp.clip(y0 + 1, 0, h - 1)
-        x1i = jnp.clip(x0 + 1, 0, w - 1)
-        wy = ys - y0
-        wx = xs - x0
-        fm = feat[0]  # [C, H, W]
-        def gather(yy, xx):
-            return fm[:, yy[:, :, None], xx[:, None, :]]  # [C, R?]...
-        v00 = fm[:, y0[:, :, None], x0[:, None, :]]
-        v01 = fm[:, y0[:, :, None], x1i[:, None, :]]
-        v10 = fm[:, y1i[:, :, None], x0[:, None, :]]
-        v11 = fm[:, y1i[:, :, None], x1i[:, None, :]]
-        wy_ = wy[:, :, None][None]
-        wx_ = wx[:, None, :][None]
-        out = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_ +
-               v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
-        return jnp.transpose(out, (1, 0, 2, 3))  # [R, C, oh, ow]
-    return apply(f, x)
+def _nms_np(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    areas = np.maximum(boxes[:, 2] - boxes[:, 0], 0) \
+        * np.maximum(boxes[:, 3] - boxes[:, 1], 0)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        xx1 = np.maximum(boxes[i, 0], boxes[order[1:], 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[order[1:], 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[order[1:], 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[order[1:], 3])
+        inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
+        iou = inter / np.maximum(
+            areas[i] + areas[order[1:]] - inter, 1e-9)
+        order = order[1:][iou <= thresh]
+    return np.asarray(keep, dtype=np.int64)
 
 
-class DeformConv2D:
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "deform_conv2d: planned (pallas gather kernel); use conv2d")
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation, host-side
+    (reference vision/ops.py:1660): per image decode anchors with
+    deltas/variances, clip to the image, drop tiny boxes, NMS."""
+    sc = np.asarray(raw(scores))          # [N, A, H, W]
+    bd = np.asarray(raw(bbox_deltas))     # [N, 4A, H, W]
+    isz = np.asarray(raw(img_size))       # [N, 2] (h, w)
+    an = np.asarray(raw(anchors)).reshape(-1, 4)
+    var = np.asarray(raw(variances)).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    all_rois, all_scores, rois_num = [], [], []
+    for i in range(n):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)   # h-major, anchor-minor
+        d = bd[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, anc, v = s[order], d[order], an[order], var[order]
+        aw = anc[:, 2] - anc[:, 0] + off
+        ah = anc[:, 3] - anc[:, 1] + off
+        acx = anc[:, 0] + aw / 2
+        acy = anc[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        bw = aw * np.exp(np.minimum(v[:, 2] * d[:, 2], np.log(1000 / 16)))
+        bh = ah * np.exp(np.minimum(v[:, 3] * d[:, 3], np.log(1000 / 16)))
+        boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - off, cy + bh / 2 - off], 1)
+        ih, iw = isz[i, 0], isz[i, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        big = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+               & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[big], s[big]
+        keep = _nms_np(boxes, s, nms_thresh)[:post_nms_top_n]
+        all_rois.append(boxes[keep])
+        all_scores.append(s[keep])
+        rois_num.append(len(keep))
+    rois = Tensor(jnp.asarray(
+        np.concatenate(all_rois, 0).astype(np.float32)))
+    rscores = Tensor(jnp.asarray(
+        np.concatenate(all_scores, 0).astype(np.float32)))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(
+            np.asarray(rois_num, np.int32)))
+    return rois, rscores
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale, host-side
+    (reference vision/ops.py:835). Returns (multi_rois, restore_index,
+    rois_num_per_level | None)."""
+    rois = np.asarray(raw(fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    ws = np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+    hs = np.maximum(rois[:, 3] - rois[:, 1] + off, 0)
+    scale = np.sqrt(ws * hs)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi, nums, index = [], [], []
+    for lv in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == lv)[0]
+        multi.append(Tensor(jnp.asarray(rois[idx].astype(np.float32))))
+        index.append(idx)
+        if rois_num is not None:
+            bn = np.asarray(raw(rois_num)).astype(np.int64)
+            bb = np.repeat(np.arange(len(bn)), bn)
+            nums.append(Tensor(jnp.asarray(np.bincount(
+                bb[idx], minlength=len(bn)).astype(np.int32))))
+    order = np.concatenate(index) if index else np.zeros(0, np.int64)
+    restore = np.argsort(order).astype(np.int32)
+    restore_t = Tensor(jnp.asarray(restore[:, None]))
+    return multi, restore_t, (nums if rois_num is not None else None)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               name=None, return_index=False, return_rois_num=True):
+    """Matrix NMS (SOLOv2-style decay), host-side
+    (reference vision/ops.py:1811).
+
+    bboxes [N, M, 4], scores [N, C, M]. Returns Out [No, 6] rows of
+    (label, decayed_score, x1, y1, x2, y2) (+ index, + rois_num)."""
+    bx = np.asarray(raw(bboxes))
+    sc = np.asarray(raw(scores))
+    n, c, m = sc.shape
+    off = 0.0 if normalized else 1.0
+    outs, idxs, nums = [], [], []
+    for i in range(n):
+        per_img = []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            s = sc[i, cls]
+            sel = np.nonzero(s > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            order = sel[np.argsort(-s[sel])][:nms_top_k]
+            b = bx[i, order]
+            s2 = s[order]
+            areas = (np.maximum(b[:, 2] - b[:, 0] + off, 0)
+                     * np.maximum(b[:, 3] - b[:, 1] + off, 0))
+            xx1 = np.maximum(b[:, None, 0], b[None, :, 0])
+            yy1 = np.maximum(b[:, None, 1], b[None, :, 1])
+            xx2 = np.minimum(b[:, None, 2], b[None, :, 2])
+            yy2 = np.minimum(b[:, None, 3], b[None, :, 3])
+            inter = (np.maximum(0, xx2 - xx1 + off)
+                     * np.maximum(0, yy2 - yy1 + off))
+            iou = inter / np.maximum(
+                areas[:, None] + areas[None, :] - inter, 1e-9)
+            iou = np.triu(iou, 1)  # row i: IoU with lower-scored col j
+            # compensation: how suppressed is suppressor i itself
+            iou_cmax = iou.max(0)
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - iou_cmax[:, None] ** 2)
+                               / gaussian_sigma)
+            else:
+                decay = (1 - iou) / np.maximum(
+                    1 - iou_cmax[:, None], 1e-9)
+            upper = np.triu(np.ones_like(iou), 1) > 0
+            decay = np.where(upper, decay, np.inf).min(0)
+            decay = np.where(np.isinf(decay), 1.0, decay)
+            s3 = s2 * decay
+            for j in np.nonzero(s3 > post_threshold)[0]:
+                per_img.append((cls, s3[j], *b[j], order[j]))
+        per_img.sort(key=lambda t: -t[1])
+        if keep_top_k > 0:
+            per_img = per_img[:keep_top_k]
+        nums.append(len(per_img))
+        for row in per_img:
+            outs.append(row[:6])
+            idxs.append(i * m + row[6])
+    out = Tensor(jnp.asarray(
+        np.asarray(outs, np.float32).reshape(-1, 6)))
+    ret = [out]
+    if return_index:
+        ret.append(Tensor(jnp.asarray(
+            np.asarray(idxs, np.int64).reshape(-1, 1))))
+    if return_rois_num:
+        ret.append(Tensor(jnp.asarray(np.asarray(nums, np.int32))))
+    return tuple(ret) if len(ret) > 1 else out
+
+
+# ----------------------------------------------------------- file io --
+def read_file(filename, name=None):
+    """File bytes as a uint8 tensor (reference vision/ops.py:952)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to [C, H, W] uint8 via PIL
+    (reference vision/ops.py:998)."""
+    import io
+
+    from PIL import Image
+
+    data = np.asarray(raw(x)).astype(np.uint8).tobytes()
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    arr = arr[None] if arr.ndim == 2 else arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

@@ -2,6 +2,7 @@
 Reference: python/paddle/vision/transforms/transforms.py."""
 from __future__ import annotations
 
+import math
 import numbers
 import random
 
@@ -384,3 +385,187 @@ class RandomRotation(BaseTransform):
 
     def _apply_image(self, img):
         return rotate(img, random.uniform(*self.degrees), **self.kw)
+
+
+# -- affine / perspective / erasing (reference:
+# vision/transforms/{transforms,functional}.py affine, perspective,
+# erase, RandomAffine, RandomPerspective, RandomErasing) -------------
+
+def _inverse_sample(a, inv_fn, interpolation="nearest", fill=0):
+    """Sample img at inv_fn(xs, ys) -> (sx, sy) source coords."""
+    h, w = a.shape[:2]
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    sx, sy = inv_fn(xs.astype(np.float64), ys.astype(np.float64))
+    valid = (sx >= 0) & (sx <= w - 1) & (sy >= 0) & (sy <= h - 1)
+    if interpolation == "bilinear":
+        x0 = np.clip(np.floor(sx), 0, w - 1).astype(np.int64)
+        y0 = np.clip(np.floor(sy), 0, h - 1).astype(np.int64)
+        x1 = np.clip(x0 + 1, 0, w - 1)
+        y1 = np.clip(y0 + 1, 0, h - 1)
+        wx = np.clip(sx - x0, 0, 1)[..., None]
+        wy = np.clip(sy - y0, 0, 1)[..., None]
+        af = a.astype(np.float64)
+        out = (af[y0, x0] * (1 - wy) * (1 - wx) + af[y0, x1] * (1 - wy) * wx
+               + af[y1, x0] * wy * (1 - wx) + af[y1, x1] * wy * wx)
+    else:
+        sxc = np.clip(np.round(sx).astype(np.int64), 0, w - 1)
+        syc = np.clip(np.round(sy).astype(np.int64), 0, h - 1)
+        out = a[syc, sxc]
+    out = np.where(valid[..., None] if a.ndim == 3 else valid, out, fill)
+    return out.astype(a.dtype)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """General affine: rotate(angle deg ccw) + translate + scale +
+    shear (deg), about `center` (default image center)."""
+    a = np.asarray(_hwc(img))
+    h, w = a.shape[:2]
+    cx, cy = ((w - 1) / 2.0, (h - 1) / 2.0) if center is None else center
+    rad = -np.deg2rad(angle)  # image y points down; match rotate()
+    shx, shy = (np.deg2rad(shear), 0.0) if np.isscalar(shear) \
+        else (np.deg2rad(shear[0]), np.deg2rad(shear[1]))
+    cos, sin = np.cos(rad), np.sin(rad)
+    rot = np.asarray([[cos, -sin], [sin, cos]])
+    sh = np.asarray([[1.0, np.tan(shx)], [np.tan(shy), 1.0]])
+    m = (rot @ sh) * scale
+    minv = np.linalg.inv(m)
+    tx, ty = translate
+
+    def inv(xs, ys):
+        dx = xs - cx - tx
+        dy = ys - cy - ty
+        return (minv[0, 0] * dx + minv[0, 1] * dy + cx,
+                minv[1, 0] * dx + minv[1, 1] * dy + cy)
+
+    return _inverse_sample(a, inv, interpolation, fill)
+
+
+def _homography(src, dst):
+    """8-dof homography H with H @ src ~ dst (both [4, 2])."""
+    A, b = [], []
+    for (x, y), (u, v) in zip(src, dst):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+        b.extend([u, v])
+    h = np.linalg.solve(np.asarray(A, np.float64),
+                        np.asarray(b, np.float64))
+    return np.append(h, 1.0).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Projective warp taking `startpoints` to `endpoints`
+    (each 4 x [x, y], TL TR BR BL)."""
+    a = np.asarray(_hwc(img))
+    # sample with the inverse: output pixel -> source location
+    hm = _homography(endpoints, startpoints)
+
+    def inv(xs, ys):
+        den = hm[2, 0] * xs + hm[2, 1] * ys + hm[2, 2]
+        sx = (hm[0, 0] * xs + hm[0, 1] * ys + hm[0, 2]) / den
+        sy = (hm[1, 0] * xs + hm[1, 1] * ys + hm[1, 2]) / den
+        return sx, sy
+
+    return _inverse_sample(a, inv, interpolation, fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Fill img[i:i+h, j:j+w] (HWC) / img[:, i:i+h, j:j+w] (CHW float)
+    with v."""
+    if isinstance(img, Tensor):
+        import jax.numpy as _jnp
+
+        data = img._data.at[..., i:i + h, j:j + w].set(
+            _jnp.asarray(v, img._data.dtype))
+        return Tensor(data)
+    a = np.asarray(img)
+    out = a if inplace else a.copy()
+    if a.ndim == 3 and a.shape[0] in (1, 3) and a.shape[-1] not in (1, 3):
+        out[:, i:i + h, j:j + w] = v  # CHW
+    else:
+        out[i:i + h, j:j + w] = v     # HW / HWC
+    return out
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        if isinstance(degrees, (int, float)):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        if isinstance(shear, (int, float)):
+            shear = (-shear, shear)
+        self.shear = shear
+        self.kw = dict(interpolation=interpolation, fill=fill,
+                       center=center)
+
+    def _apply_image(self, img):
+        h, w = np.asarray(_hwc(img)).shape[:2]
+        angle = random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = random.uniform(*self.scale) if self.scale else 1.0
+        sh = random.uniform(*self.shear) if self.shear else 0.0
+        return affine(img, angle, (tx, ty), sc, sh, **self.kw)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return img
+        h, w = np.asarray(_hwc(img)).shape[:2]
+        d = self.distortion_scale
+        dx = int(d * w / 2)
+        dy = int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [
+            (random.randint(0, dx), random.randint(0, dy)),
+            (w - 1 - random.randint(0, dx), random.randint(0, dy)),
+            (w - 1 - random.randint(0, dx), h - 1 - random.randint(0, dy)),
+            (random.randint(0, dx), h - 1 - random.randint(0, dy)),
+        ]
+        return perspective(img, start, end, self.interpolation, self.fill)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return img
+        a = np.asarray(img)
+        chw = a.ndim == 3 and a.shape[0] in (1, 3) \
+            and a.shape[-1] not in (1, 3)
+        h, w = (a.shape[1:3] if chw else a.shape[:2])
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ratio = math.exp(random.uniform(*[math.log(r)
+                                              for r in self.ratio]))
+            eh = int(round(math.sqrt(target * ratio)))
+            ew = int(round(math.sqrt(target / ratio)))
+            if eh < h and ew < w:
+                i = random.randint(0, h - eh)
+                j = random.randint(0, w - ew)
+                v = (random.random() if self.value == "random"
+                     else self.value)
+                return erase(img, i, j, eh, ew, v, self.inplace)
+        return img

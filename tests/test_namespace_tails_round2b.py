@@ -1,0 +1,491 @@
+"""Round-2b namespace completion: vision.ops detection suite,
+transforms affine family, static.nn sequence/builder tail, fleet
+topology/util, jit compat, initializer tail.
+
+References: python/paddle/vision/ops.py, vision/transforms,
+static/nn/__init__.py, distributed/fleet/base/{topology,role_maker}.py,
+jit/__init__.py, nn/initializer.
+"""
+import random
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+from paddle_tpu.vision import ops as V
+from paddle_tpu.vision import transforms as T
+
+
+# ------------------------------------------------------- vision.ops --
+def test_yolo_box_shapes_and_ranges():
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(
+        rng.standard_normal((2, 3 * 9, 4, 4)).astype(np.float32))
+    img = paddle.to_tensor(np.asarray([[64, 64], [32, 48]], np.int32))
+    boxes, scores = V.yolo_box(x, img, [10, 13, 16, 30, 33, 23], 4,
+                               0.01, 16)
+    assert tuple(boxes.shape) == (2, 48, 4)
+    assert tuple(scores.shape) == (2, 48, 4)
+    b = boxes.numpy()
+    assert b.min() >= 0.0 and b[0].max() <= 63.0  # clipped to image
+
+def test_yolo_loss_decreases_on_matching_prediction():
+    rng = np.random.default_rng(1)
+    anchors = [10, 13, 16, 30, 33, 23]
+    gt_box = paddle.to_tensor(
+        np.asarray([[[0.5, 0.5, 0.4, 0.5]]], np.float32))
+    gt_label = paddle.to_tensor(np.asarray([[1]], np.int32))
+    kw = dict(anchors=anchors, anchor_mask=[0, 1, 2], class_num=4,
+              ignore_thresh=0.7, downsample_ratio=16)
+    x0 = paddle.to_tensor(np.zeros((1, 27, 4, 4), np.float32))
+    l0 = float(V.yolo_loss(x0, gt_box, gt_label, **kw).numpy()[0])
+    # push the matched cell towards the gt: higher obj + right class
+    good = np.zeros((1, 3, 9, 4, 4), np.float32)
+    good[:, :, 4] = -8.0          # low obj everywhere...
+    good[0, :, 4, 2, 2] = 8.0     # ...except the gt cell
+    good[0, :, 5 + 1, 2, 2] = 8.0  # right class
+    good[0, :, 5 + 0, 2, 2] = -8.0
+    good[0, :, 5 + 2, 2, 2] = -8.0
+    good[0, :, 5 + 3, 2, 2] = -8.0
+    l1 = float(V.yolo_loss(paddle.to_tensor(good.reshape(1, 27, 4, 4)),
+                           gt_box, gt_label, **kw).numpy()[0])
+    assert l1 < l0
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    rng = np.random.default_rng(2)
+    from paddle_tpu.nn import functional as F
+
+    x = paddle.to_tensor(rng.standard_normal((1, 4, 6, 6))
+                         .astype(np.float32))
+    w = paddle.to_tensor(
+        (rng.standard_normal((5, 4, 3, 3)) * 0.1).astype(np.float32))
+    off = paddle.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+    dc = V.deform_conv2d(x, off, w, padding=1)
+    cv = F.conv2d(x, w, padding=1)
+    np.testing.assert_allclose(dc.numpy(), cv.numpy(), atol=1e-5)
+    # non-zero offsets vs the direct sampling definition
+    off1 = paddle.to_tensor(
+        (rng.standard_normal((1, 18, 6, 6)) * 0.7).astype(np.float32))
+    dc1 = V.deform_conv2d(x, off1, w, padding=1).numpy()
+    xn, wn, offn = x.numpy(), w.numpy(), off1.numpy()
+    ref = np.zeros_like(dc1)
+    offr = offn.reshape(1, 9, 2, 6, 6)
+    for p in range(6):
+        for q in range(6):
+            acc = np.zeros(5, np.float64)
+            for i in range(3):
+                for j in range(3):
+                    sy = p - 1 + i + offr[0, i * 3 + j, 0, p, q]
+                    sx = q - 1 + j + offr[0, i * 3 + j, 1, p, q]
+                    v = np.zeros(4, np.float64)
+                    y0, x0 = int(np.floor(sy)), int(np.floor(sx))
+                    for dy in (0, 1):
+                        for dx in (0, 1):
+                            yy, xx = y0 + dy, x0 + dx
+                            if 0 <= yy < 6 and 0 <= xx < 6:
+                                wgt = ((1 - abs(sy - yy))
+                                       * (1 - abs(sx - xx)))
+                                v += wgt * xn[0, :, yy, xx]
+                    acc += wn[:, :, i, j] @ v
+            ref[0, :, p, q] = acc
+    np.testing.assert_allclose(dc1, ref, atol=1e-4)
+
+
+def test_deform_conv2d_layer_with_mask():
+    paddle.seed(0)
+    layer = V.DeformConv2D(4, 6, 3, padding=1, deformable_groups=2)
+    x = paddle.to_tensor(np.random.default_rng(3)
+                         .standard_normal((2, 4, 5, 5)).astype(np.float32))
+    off = paddle.zeros((2, 2 * 2 * 9, 5, 5))
+    mask = paddle.ones((2, 2 * 9, 5, 5))
+    out = layer(x, off, mask)
+    assert tuple(out.shape) == (2, 6, 5, 5)
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_roi_align_linear_ramp_exact():
+    # feat[y, x] = x: bilinear sampling of a linear ramp is exact, so a
+    # whole-image 1x1 roi-align returns the mean of the sample columns
+    ramp = np.tile(np.arange(4, dtype=np.float32), (4, 1))
+    feat = paddle.to_tensor(ramp[None, None])
+    boxes = paddle.to_tensor(np.asarray([[0, 0, 4, 4]], np.float32))
+    bn = paddle.to_tensor(np.asarray([1], np.int32))
+    out = V.roi_align(feat, boxes, bn, 1, aligned=False)
+    # sample xs at 1.0 and 3.0 -> mean 2.0
+    np.testing.assert_allclose(out.numpy()[0, 0, 0, 0], 2.0, atol=1e-6)
+    # constant feature: any box returns the constant
+    cfeat = paddle.to_tensor(np.full((1, 3, 5, 5), 2.5, np.float32))
+    b2 = paddle.to_tensor(np.asarray([[0.7, 1.1, 3.9, 4.2]], np.float32))
+    o2 = V.roi_align(cfeat, b2, bn, 2)
+    np.testing.assert_allclose(o2.numpy(), np.full((1, 3, 2, 2), 2.5),
+                               atol=1e-6)
+
+
+def test_roi_pool_max_semantics():
+    feat_np = np.zeros((1, 1, 4, 4), np.float32)
+    feat_np[0, 0, 1, 1] = 5.0
+    feat_np[0, 0, 3, 3] = 7.0
+    feat = paddle.to_tensor(feat_np)
+    boxes = paddle.to_tensor(np.asarray([[0, 0, 3, 3]], np.float32))
+    bn = paddle.to_tensor(np.asarray([1], np.int32))
+    out = V.roi_pool(feat, boxes, bn, 2)
+    assert float(out.numpy()[0, 0, 0, 0]) == 5.0
+    assert float(out.numpy()[0, 0, 1, 1]) == 7.0
+
+
+def test_psroi_pool_position_sensitivity():
+    # channel block (i,j) only contributes to output bin (i,j)
+    feat_np = np.stack([np.full((4, 4), float(k)) for k in range(4)])
+    feat = paddle.to_tensor(feat_np[None].astype(np.float32))
+    boxes = paddle.to_tensor(np.asarray([[0, 0, 4, 4]], np.float32))
+    bn = paddle.to_tensor(np.asarray([1], np.int32))
+    out = V.psroi_pool(feat, boxes, bn, 2)  # C=4 -> co=1, 2x2
+    np.testing.assert_allclose(
+        out.numpy()[0, 0], np.asarray([[0.0, 1.0], [2.0, 3.0]]))
+
+
+def test_matrix_nms_decays_overlaps():
+    bb = paddle.to_tensor(np.asarray(
+        [[[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]]], np.float32))
+    ss = paddle.to_tensor(np.asarray(
+        [[[0, 0, 0], [0.9, 0.85, 0.8]]], np.float32))
+    out, nums = V.matrix_nms(bb, ss, 0.1, 0.3, 10, 5,
+                             background_label=0)
+    # the heavy overlap (IoU ~0.68) decays 0.85 -> ~0.27 < 0.3
+    assert int(nums.numpy()[0]) == 2
+    np.testing.assert_allclose(out.numpy()[:, 1], [0.9, 0.8], atol=1e-6)
+    out2, idx, nums2 = V.matrix_nms(bb, ss, 0.1, 0.05, 10, 5,
+                                    background_label=0,
+                                    return_index=True)
+    assert int(nums2.numpy()[0]) == 3 and idx.shape[0] == 3
+
+
+def test_generate_proposals_and_fpn_distribute():
+    rng = np.random.default_rng(5)
+    scores = paddle.to_tensor(rng.random((1, 3, 4, 4)).astype(np.float32))
+    deltas = paddle.to_tensor(
+        (rng.standard_normal((1, 12, 4, 4)) * 0.1).astype(np.float32))
+    grid = np.stack(np.meshgrid(np.arange(4) * 16, np.arange(4) * 16),
+                    -1).reshape(-1, 2)
+    anch = np.repeat(grid, 3, 0).astype(np.float32)
+    anch = np.concatenate([anch, anch + 16], 1)
+    rois, rsc, rn = V.generate_proposals(
+        scores, deltas, paddle.to_tensor(np.asarray([[64, 64]],
+                                                    np.float32)),
+        paddle.to_tensor(anch), paddle.to_tensor(np.ones_like(anch)),
+        pre_nms_top_n=20, post_nms_top_n=5, return_rois_num=True)
+    assert rois.shape[0] == int(rn.numpy()[0]) <= 5
+    r = rois.numpy()
+    assert (r[:, 2] >= r[:, 0]).all() and r.max() <= 64.0
+
+    fr = paddle.to_tensor(np.asarray(
+        [[0, 0, 16, 16], [0, 0, 200, 200], [0, 0, 60, 60]], np.float32))
+    multi, restore, _ = V.distribute_fpn_proposals(fr, 2, 5, 4, 224)
+    assert [m.shape[0] for m in multi] == [2, 1, 0, 0]
+    # restore index maps concatenated-level order back to input order
+    cat = np.concatenate([m.numpy() for m in multi if m.shape[0]])
+    np.testing.assert_allclose(cat[restore.numpy().ravel()], fr.numpy())
+
+
+def test_read_file_and_decode_jpeg(tmp_path):
+    from PIL import Image
+
+    yy, xx = np.meshgrid(np.arange(8), np.arange(9), indexing="ij")
+    arr = np.stack([yy * 20, xx * 20, yy * 10 + xx * 10], -1) \
+        .astype(np.uint8)  # smooth gradient: jpeg-friendly
+    p = tmp_path / "img.jpg"
+    Image.fromarray(arr).save(p, quality=95)
+    data = V.read_file(str(p))
+    img = V.decode_jpeg(data, mode="rgb")
+    assert tuple(img.shape) == (3, 8, 9)
+    assert np.abs(img.numpy().transpose(1, 2, 0).astype(int)
+                  - arr.astype(int)).mean() < 12  # jpeg lossy
+
+
+# ------------------------------------------------------- transforms --
+def test_affine_matches_rotate_and_identity():
+    img = (np.random.default_rng(7).random((16, 20, 3)) * 255) \
+        .astype(np.uint8)
+    assert np.array_equal(T.affine(img, 30, (0, 0), 1.0, 0.0),
+                          T.rotate(img, 30))
+    assert np.array_equal(T.affine(img, 0, (0, 0), 1.0, 0.0), img)
+    # pure translation moves content
+    tr = T.affine(img, 0, (3, 0), 1.0, 0.0)
+    assert np.array_equal(tr[:, 3:], img[:, :-3])
+
+
+def test_perspective_identity_and_erase():
+    img = (np.random.default_rng(8).random((10, 12, 3)) * 255) \
+        .astype(np.uint8)
+    corners = [(0, 0), (11, 0), (11, 9), (0, 9)]
+    assert np.array_equal(T.perspective(img, corners, corners), img)
+    e = T.erase(img, 2, 3, 4, 5, 9)
+    assert (e[2:6, 3:8] == 9).all()
+    assert np.array_equal(e[:2], img[:2])
+    chw = img.transpose(2, 0, 1).astype(np.float32)
+    e2 = T.erase(chw, 1, 2, 3, 4, 0.5)
+    assert (e2[:, 1:4, 2:6] == 0.5).all()
+
+
+def test_random_geometric_transforms_shapes():
+    random.seed(0)
+    img = (np.random.default_rng(9).random((16, 20, 3)) * 255) \
+        .astype(np.uint8)
+    for t in (T.RandomAffine(15, translate=(0.1, 0.1), scale=(0.9, 1.1),
+                             shear=(-5, 5)),
+              T.RandomPerspective(prob=1.0),
+              T.RandomErasing(prob=1.0)):
+        out = t(img)
+        assert out.shape == img.shape and out.dtype == img.dtype
+
+
+# -------------------------------------------------------- static.nn --
+def test_static_nn_sequence_ops_values():
+    with static.program_guard(static.Program(), static.Program()):
+        x = static.data("sq_x", [2, 4, 3], "float32")
+        ln = static.data("sq_ln", [2], "int64")
+        xv = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+        x._data = paddle.to_tensor(xv)._data
+        ln._data = paddle.to_tensor(np.asarray([2, 4], np.int64))._data
+
+        pool = static.nn.sequence_pool(x, "average", length=ln)
+        np.testing.assert_allclose(pool.numpy()[0], xv[0, :2].mean(0),
+                                   atol=1e-6)
+        np.testing.assert_allclose(pool.numpy()[1], xv[1].mean(0),
+                                   atol=1e-6)
+        last = static.nn.sequence_last_step(x, length=ln)
+        np.testing.assert_allclose(last.numpy()[0], xv[0, 1])
+        np.testing.assert_allclose(last.numpy()[1], xv[1, 3])
+        rev = static.nn.sequence_reverse(x, length=ln)
+        np.testing.assert_allclose(rev.numpy()[0, 0], xv[0, 1])
+        np.testing.assert_allclose(rev.numpy()[0, 2], xv[0, 2])  # pad kept
+        sm = static.nn.sequence_softmax(x, length=ln).numpy()
+        np.testing.assert_allclose(sm[0, :2].sum(0), np.ones(3), atol=1e-5)
+        np.testing.assert_allclose(sm[0, 2:], np.zeros((2, 3)), atol=1e-6)
+        en = static.nn.sequence_enumerate(
+            static.data("sq_ids", [1, 4], "int64"), 2, pad_value=0)
+        assert tuple(en.shape) == (1, 4, 2)
+
+
+def test_static_nn_builders_shapes():
+    paddle.seed(0)
+    with static.program_guard(static.Program(), static.Program()):
+        x = static.data("bx", [2, 3, 8, 8], "float32")
+        assert tuple(static.nn.conv2d_transpose(
+            x, 6, filter_size=4, stride=2, padding=1).shape) \
+            == (2, 6, 16, 16)
+        assert tuple(static.nn.group_norm(x, 3).shape) == (2, 3, 8, 8)
+        assert tuple(static.nn.instance_norm(x).shape) == (2, 3, 8, 8)
+        x3 = static.data("bx3", [2, 3, 4, 8, 8], "float32")
+        assert tuple(static.nn.conv3d(x3, 5, 3, padding=1).shape) \
+            == (2, 5, 4, 8, 8)
+        a = static.data("ba", [2, 4], "float32")
+        bb = static.data("bb", [2, 6], "float32")
+        assert tuple(static.nn.bilinear_tensor_product(a, bb, 5).shape) \
+            == (2, 5)
+        seq = static.data("bs", [2, 5, 4], "float32")
+        assert tuple(static.nn.row_conv(seq, 2).shape) == (2, 5, 4)
+        assert tuple(static.nn.sequence_conv(seq, 7, 3).shape) == (2, 5, 7)
+        inp = static.data("bi", [3, 8], "float32")
+        lbl = static.data("bl", [3, 1], "int64")
+        assert tuple(static.nn.nce(inp, lbl, 20,
+                                   num_neg_samples=5).shape) == (3, 1)
+        w = static.data("bw", [6, 4], "float32")
+        w._data = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal((6, 4))
+            .astype(np.float32))._data
+        sn = static.nn.spectral_norm(w, power_iters=3)
+        s = np.linalg.svd(sn.numpy(), compute_uv=False)
+        assert abs(s[0] - 1.0) < 0.1  # top singular value ~1
+
+
+def test_static_rnn_matches_python_loop():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        xt = static.data("srnn_x", [5, 2, 3], "float32")
+        rnn = static.nn.StaticRNN()
+        with rnn.step():
+            w = rnn.step_input(xt)
+            prev = rnn.memory(shape=[-1, 3], batch_ref=w)
+            h = prev * 0.5 + w
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out = rnn()
+    exe = static.Executor()
+    xv = np.random.default_rng(10).standard_normal((5, 2, 3)) \
+        .astype(np.float32)
+    res = exe.run(main, feed={"srnn_x": xv}, fetch_list=[out])
+    prev = np.zeros((2, 3), np.float32)
+    for t in range(5):
+        prev = prev * 0.5 + xv[t]
+        np.testing.assert_allclose(res[0][t], prev, atol=1e-6)
+
+
+def test_crf_decoding_prefers_transition_path():
+    # emissions tie two labels; transitions break the tie
+    em = np.zeros((1, 3, 2), np.float32)
+    trans = np.zeros((4, 2), np.float32)  # rows: start, stop, t0, t1
+    trans[2, 0] = 2.0   # 0 -> 0 strongly favored
+    trans[3, 1] = -2.0  # 1 -> 1 penalized
+    with static.program_guard(static.Program(), static.Program()):
+        inp = static.data("crf_in", [1, 3, 2], "float32")
+        inp._data = paddle.to_tensor(em)._data
+        path = static.nn.crf_decoding(
+            inp, paddle.to_tensor(trans))
+        assert path.numpy().ravel().tolist() == [0, 0, 0]
+
+
+def test_crf_decoding_stop_score_at_last_valid_step():
+    # stop transition strongly favors label 1; for a length-2 sequence
+    # in a T=4 batch it must apply at t=1, not the padded t=3
+    em = np.zeros((1, 4, 2), np.float32)
+    trans = np.zeros((4, 2), np.float32)
+    trans[1, 1] = 5.0  # stop scores favor ending on label 1
+    with static.program_guard(static.Program(), static.Program()):
+        inp = static.data("crf_in2", [1, 4, 2], "float32")
+        inp._data = paddle.to_tensor(em)._data
+        ln = static.data("crf_ln", [1], "int64")
+        ln._data = paddle.to_tensor(np.asarray([2], np.int64))._data
+        path = static.nn.crf_decoding(inp, paddle.to_tensor(trans),
+                                      length=ln)
+        assert path.numpy()[0, 1] == 1  # last valid step picks label 1
+
+
+def test_random_affine_scalar_shear():
+    random.seed(1)
+    img = (np.random.default_rng(12).random((8, 8, 3)) * 255) \
+        .astype(np.uint8)
+    out = T.RandomAffine(10, shear=5)(img)
+    assert out.shape == img.shape
+
+
+def test_onnx_runtime_int32_data_bit_patterns():
+    from paddle_tpu.onnx.proto import onnx_pb2 as P
+    from paddle_tpu.onnx.runtime import tensor_to_numpy
+
+    t = P.TensorProto(data_type=10)  # FLOAT16
+    t.dims.extend([2])
+    t.int32_data.extend([15360, 16384])  # bit patterns of 1.0, 2.0
+    np.testing.assert_allclose(
+        tensor_to_numpy(t).astype(np.float32), [1.0, 2.0])
+    t2 = P.TensorProto(data_type=3)  # INT8: plain values
+    t2.dims.extend([2])
+    t2.int32_data.extend([-5, 7])
+    np.testing.assert_array_equal(tensor_to_numpy(t2),
+                                  np.asarray([-5, 7], np.int8))
+
+
+def test_multi_box_head_shapes():
+    paddle.seed(0)
+    with static.program_guard(static.Program(), static.Program()):
+        img = static.data("mbh_img", [2, 3, 64, 64], "float32")
+        f1 = static.data("mbh_f1", [2, 8, 8, 8], "float32")
+        f2 = static.data("mbh_f2", [2, 8, 4, 4], "float32")
+        locs, confs, box, var = static.nn.multi_box_head(
+            [f1, f2], img, base_size=64, num_classes=3,
+            aspect_ratios=[[2.0], [2.0]], min_sizes=[16.0, 32.0],
+            max_sizes=[32.0, 64.0])
+        P = int(box.shape[0])
+        assert tuple(locs.shape) == (2, P, 4)
+        assert tuple(confs.shape) == (2, P, 3)
+        assert tuple(var.shape) == (P, 4)
+        b = box.numpy()
+        assert b.min() > -1.0 and b.max() < 2.0  # normalized-ish
+
+
+# ------------------------------------------------- fleet / jit / misc --
+def test_communicate_topology_math():
+    from paddle_tpu.distributed import fleet
+
+    topo = fleet.CommunicateTopology(["data", "pipe", "model"],
+                                     [2, 2, 2])
+    assert topo.world_size() == 8
+    assert topo.get_rank(data=1, pipe=0, model=1) == 5
+    c = topo.get_coord(5)
+    assert (c.data, c.pipe, c.model) == (1, 0, 1)
+    assert topo.get_comm_list("model") == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    assert topo.get_axis_list("data", 0) == [0, 1, 2, 3]
+    assert topo.get_rank_from_stage(5, pipe=1) == 7
+    assert topo.get_dim("pipe") == 2
+
+
+def test_fleet_class_and_util():
+    from paddle_tpu.distributed import fleet
+
+    f = fleet.Fleet()
+    assert f.is_worker() and not f.is_server()
+    shard = fleet.util.get_file_shard([f"f{i}" for i in range(10)])
+    assert shard == [f"f{i}" for i in range(10)]  # world size 1
+    assert fleet.util.all_reduce(np.asarray([1.0, 2.0])).tolist() \
+        == [1.0, 2.0]
+    gen = fleet.MultiSlotDataGenerator()
+
+    class G(fleet.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("ids", [1, 2]), ("label", [0])]
+            return it
+    lines = G().run_from_memory(["x"])
+    assert lines == ["2 1 2 1 0\n"]
+    rm = fleet.PaddleCloudRoleMaker(is_collective=True)
+    assert rm.worker_num() >= 1 and rm.is_worker()
+
+
+def test_jit_compat_shims():
+    paddle.seed(0)
+    layer = nn.Sequential(nn.Linear(4, 8), nn.ReLU())
+    layer.eval()
+    x = paddle.to_tensor(np.random.default_rng(11)
+                         .standard_normal((2, 4)).astype(np.float32))
+    outs, traced = paddle.jit.TracedLayer.trace(layer, [x])
+    np.testing.assert_allclose(traced([x]).numpy(), layer(x).numpy(),
+                               atol=1e-6)
+    pt = paddle.jit.ProgramTranslator()
+    assert pt is paddle.jit.ProgramTranslator()  # singleton
+    pt.enable(False)
+    try:
+        sf = paddle.jit.to_static(lambda t: t * 2)
+        assert not isinstance(sf(x), type(None))
+    finally:
+        pt.enable(True)
+    paddle.jit.set_code_level(0)
+    paddle.jit.set_verbosity(0)
+
+
+def test_multiplicative_decay_and_bilinear_init():
+    import paddle_tpu.optimizer as opt
+
+    sch = opt.lr.MultiplicativeDecay(0.5, lambda e: 0.9)
+    assert abs(sch.get_lr() - 0.5) < 1e-9
+    sch.step()
+    sch.step()
+    assert abs(sch.get_lr() - 0.5 * 0.81) < 1e-9
+
+    from paddle_tpu.nn import initializer as I
+
+    k = np.asarray(I.Bilinear()((1, 1, 4, 4), "float32", None))[0, 0]
+    np.testing.assert_allclose(k[0], [0.0625, 0.1875, 0.1875, 0.0625],
+                               atol=1e-6)
+    # separable: each axis profile is [0.25, 0.75, 0.75, 0.25]
+    assert abs(k.sum() - 4.0) < 1e-5
+
+
+def test_set_global_initializer_priority():
+    from paddle_tpu.nn import initializer as I
+
+    I.set_global_initializer(I.Constant(0.25), I.Constant(0.75))
+    try:
+        lin = nn.Linear(3, 3)
+        assert float(np.asarray(lin.weight._data)[0, 0]) == 0.25
+        assert float(np.asarray(lin.bias._data)[0]) == 0.75
+    finally:
+        I.set_global_initializer(None)
+    lin2 = nn.Linear(3, 3)
+    assert float(np.asarray(lin2.weight._data)[0, 0]) != 0.25
+
+
+def test_profiler_sortedkeys_and_device_tail():
+    assert paddle.profiler.SortedKeys.CPUTotal.value == 0
+    assert paddle.device.get_cudnn_version() is None
