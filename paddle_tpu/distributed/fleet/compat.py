@@ -187,7 +187,7 @@ class UserDefinedRoleMaker(PaddleCloudRoleMaker):
         super().__init__(is_collective=is_collective, **kwargs)
         self._cur_id = int(kwargs.get("current_id", 0))
         self._n = int(kwargs.get("worker_num",
-                                 len(kwargs.get("server_endpoints", []))
+                                 len(kwargs.get("worker_endpoints", []))
                                  or 1))
 
     def _worker_index(self):

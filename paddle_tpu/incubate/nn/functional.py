@@ -122,3 +122,101 @@ def fused_feedforward(
         y = F.layer_norm(y, (d,), weight=ln2_scale, bias=ln2_bias,
                          epsilon=ln2_epsilon)
     return y
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+        linear_weights, linear_biases, ffn_ln_scales, ffn_ln_biases,
+        ffn1_weights, ffn1_biases, ffn2_weights, ffn2_biases,
+        pre_layer_norm=True, epsilon=1e-05, cache_kvs=None,
+        pre_caches=None, seq_lens=None, rotary_embs=None,
+        rotary_emb_dims=0, time_step=None, attn_mask=None,
+        dropout_rate=0.0, activation="gelu", training=False,
+        mode="upscale_in_train", trans_qkvw=True, ring_id=-1,
+        name=None):
+    """Whole multi-layer transformer stack as one call (reference:
+    incubate/nn/functional/fused_transformer.py::fused_multi_transformer).
+
+    Weight lists carry one entry per layer; qkv weights are
+    [3, n_heads, head_dim, embed] when trans_qkvw (reference layout)
+    else [embed, 3, n_heads, head_dim]. One jit trace of this function
+    is a single XLA region — the fusion the reference gets from its
+    CUDA mega-kernel.
+    """
+    import jax.numpy as jnp
+
+    from ...tensor import apply
+    from ...tensor_ops.manipulation import reshape, transpose
+    from ...tensor_ops.math import matmul
+
+    if any(a is not None for a in (cache_kvs, pre_caches, seq_lens,
+                                   rotary_embs, time_step)):
+        raise NotImplementedError(
+            "fused_multi_transformer: cached autoregressive decode "
+            "(cache_kvs/pre_caches/seq_lens/rotary_embs/time_step) is "
+            "not supported — use LlamaForCausalLM.generate's static-KV "
+            "decode path instead")
+    num_layers = len(qkv_weights)
+    out = x
+    new_caches = []
+    for i in range(num_layers):
+        residual = out
+        h = F.layer_norm(out, (int(out.shape[-1]),),
+                         weight=ln_scales[i], bias=ln_biases[i],
+                         epsilon=epsilon) if pre_layer_norm else out
+        qkvw = qkv_weights[i]
+        if trans_qkvw:  # [3, nh, hd, embed]
+            three, nh, hd, emb = (int(s) for s in qkvw.shape)
+            w2d = transpose(reshape(qkvw, (three * nh * hd, emb)),
+                            (1, 0))
+        else:           # [embed, 3, nh, hd]
+            emb, three, nh, hd = (int(s) for s in qkvw.shape)
+            w2d = reshape(qkvw, (emb, three * nh * hd))
+        qkv = matmul(h, w2d)
+        if qkv_biases is not None and qkv_biases[i] is not None:
+            qkv = qkv + reshape(qkv_biases[i], (-1,))
+        b, s = int(h.shape[0]), int(h.shape[1])
+        qkv = reshape(qkv, (b, s, 3, nh, hd))
+
+        def attn(qkv_r, *mask):
+            q = jnp.moveaxis(qkv_r[:, :, 0], 1, 2)  # [B, nh, S, hd]
+            k = jnp.moveaxis(qkv_r[:, :, 1], 1, 2)
+            v = jnp.moveaxis(qkv_r[:, :, 2], 1, 2)
+            scores = q @ jnp.swapaxes(k, -1, -2) / jnp.sqrt(1.0 * hd)
+            if mask:
+                scores = scores + mask[0]
+            probs = jnp.exp(scores - jnp.max(scores, -1, keepdims=True))
+            probs = probs / probs.sum(-1, keepdims=True)
+            ctx = probs @ v  # [B, nh, S, hd]
+            return jnp.moveaxis(ctx, 1, 2).reshape(b, s, nh * hd)
+        ctx = apply(attn, qkv, *(
+            (attn_mask,) if attn_mask is not None else ()))
+        proj = matmul(ctx, linear_weights[i])
+        if linear_biases is not None and linear_biases[i] is not None:
+            proj = proj + linear_biases[i]
+        proj = F.dropout(proj, p=dropout_rate, training=training,
+                         mode=mode)
+        out = residual + proj
+        if not pre_layer_norm:
+            out = F.layer_norm(out, (int(out.shape[-1]),),
+                               weight=ln_scales[i], bias=ln_biases[i],
+                               epsilon=epsilon)
+
+        residual = out
+        h = F.layer_norm(out, (int(out.shape[-1]),),
+                         weight=ffn_ln_scales[i], bias=ffn_ln_biases[i],
+                         epsilon=epsilon) if pre_layer_norm else out
+        h = matmul(h, ffn1_weights[i])
+        if ffn1_biases is not None and ffn1_biases[i] is not None:
+            h = h + ffn1_biases[i]
+        h = getattr(F, activation)(h)
+        h = matmul(h, ffn2_weights[i])
+        if ffn2_biases is not None and ffn2_biases[i] is not None:
+            h = h + ffn2_biases[i]
+        h = F.dropout(h, p=dropout_rate, training=training, mode=mode)
+        out = residual + h
+        if not pre_layer_norm:
+            out = F.layer_norm(out, (int(out.shape[-1]),),
+                               weight=ffn_ln_scales[i],
+                               bias=ffn_ln_biases[i], epsilon=epsilon)
+    return out

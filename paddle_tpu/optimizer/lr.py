@@ -321,13 +321,17 @@ class MultiplicativeDecay(LRScheduler):
     def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
                  verbose=False):
         self.lr_lambda = lr_lambda
+        self._prod_epoch = 0   # lambda product cached through this epoch
+        self._prod = 1.0
         super().__init__(learning_rate, last_epoch, verbose)
 
     def get_lr(self):
-        cur = self.base_lr
-        for epoch in range(1, self.last_epoch + 1):
-            cur *= self.lr_lambda(epoch)
-        return cur
+        if self.last_epoch < self._prod_epoch:  # restored/rewound state
+            self._prod_epoch, self._prod = 0, 1.0
+        while self._prod_epoch < self.last_epoch:  # O(1) per step
+            self._prod_epoch += 1
+            self._prod *= self.lr_lambda(self._prod_epoch)
+        return self.base_lr * self._prod
 
     def state_dict(self):
         return {k: v for k, v in super().state_dict().items()

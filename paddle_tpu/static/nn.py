@@ -503,10 +503,12 @@ def nce(input, label, num_total_classes, sample_weight=None,
         probs = probs / probs.sum()
     else:
         probs = np_.full(num_total_classes, 1.0 / num_total_classes)
+    # fresh noise classes per execution (reference resamples each
+    # iteration); under define-by-run replay f runs eagerly each step
     rng = np_.random.default_rng(seed or 0)
-    neg = rng.choice(num_total_classes, size=(k,), p=probs)
 
     def f(x, lb, wt, *bs):
+        neg = rng.choice(num_total_classes, size=(k,), p=probs)
         bias = bs[0] if bs else None
         lb = lb.reshape(-1).astype(jnp.int32)
         s_true = jnp.sum(x * wt[lb], -1)
@@ -797,7 +799,7 @@ def sequence_pad(x, pad_value, maxlen=None, name=None, length=None):
     out = apply(f, x, pad_value if hasattr(pad_value, "_data")
                 else Tensor(jnp.asarray(pad_value)))
     ln = length if length is not None else Tensor(
-        jnp.full((int(x.shape[0]),), t, jnp.int64))
+        jnp.full((int(x.shape[0]),), min(t, target), jnp.int64))
     return out, ln
 
 
@@ -939,9 +941,13 @@ class StaticRNN:
 
         self._prog = default_main_program()
         start = len(self._prog._ops)
-        yield
-        self._entries = list(self._prog._ops[start:])
-        del self._prog._ops[start:]
+        try:
+            yield
+        finally:
+            # always lift the step slice out, even when the body raises
+            # — half-recorded step ops must not leak into the Program
+            self._entries = list(self._prog._ops[start:])
+            del self._prog._ops[start:]
 
     def step_input(self, x):
         from ..tensor import Tensor

@@ -12,6 +12,18 @@ def _swap(fn):
     return lambda self, other: fn(other if isinstance(other, Tensor) else Tensor(jnp.asarray(other)), self)
 
 
+# in-place variants (mutate _data; sever tape like paddle's inplace ops
+# do when the var is a leaf)
+def _make_inplace(fn):
+    def inplace(self, *args, **kwargs):
+        out = fn(self, *args, **kwargs)
+        self._data = out._data
+        self._node = out._node
+        self._out_index = out._out_index
+        return self
+    return inplace
+
+
 def bind():
     T = Tensor
 
@@ -80,17 +92,6 @@ def bind():
                 setattr(T, name, fn)
 
     from .einsum import einsum  # noqa: F401
-
-    # in-place variants (mutate _data; sever tape like paddle's inplace ops
-    # do when the var is a leaf)
-    def _make_inplace(fn):
-        def inplace(self, *args, **kwargs):
-            out = fn(self, *args, **kwargs)
-            self._data = out._data
-            self._node = out._node
-            self._out_index = out._out_index
-            return self
-        return inplace
 
     for base in ("add", "subtract", "multiply", "divide", "clip", "scale",
                  "floor", "ceil", "exp", "sqrt", "rsqrt", "reciprocal",

@@ -163,3 +163,52 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None,
     if sci_mode is not None:
         kw["suppress"] = not sci_mode
     np.set_printoptions(**kw)
+
+
+def erfinv(x, name=None):
+    """Inverse error function. Reference: tensor/math.py::erfinv."""
+    import jax
+
+    return apply(jax.scipy.special.erfinv, x)
+
+
+# -- remaining reference tensor_method_func entries (python/paddle/
+# tensor/__init__.py): attach the extras ops as Tensor methods and add
+# the missing in-place variants -----------------------------------------
+
+def _bind_extras():
+    from ..framework.random_seed import next_key
+    from ._bind import _make_inplace as _inplace_of
+    from .manipulation import put_along_axis
+    from .math import lerp
+
+    def uniform_(self, min=-1.0, max=1.0, seed=0, name=None):
+        import jax
+
+        self._data = jax.random.uniform(
+            next_key(), self._data.shape, self._data.dtype, min, max)
+        self._node = None
+        return self
+
+    def exponential_(self, lam=1.0, name=None):
+        import jax
+
+        self._data = jax.random.exponential(
+            next_key(), self._data.shape, self._data.dtype) / lam
+        self._node = None
+        return self
+
+    for name in ("add_n", "mv", "sgn", "logcumsumexp", "reverse",
+                 "rank", "erfinv"):
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, globals()[name])
+    Tensor.lerp_ = _inplace_of(lerp)
+    Tensor.erfinv_ = _inplace_of(erfinv)
+    Tensor.put_along_axis_ = _inplace_of(put_along_axis)
+    Tensor.uniform_ = uniform_
+    Tensor.exponential_ = exponential_
+    if not hasattr(Tensor, "scatter_"):
+        Tensor.scatter_ = scatter_
+
+
+_bind_extras()

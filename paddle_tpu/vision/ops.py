@@ -328,7 +328,12 @@ def _box_batch_index(boxes_num, total):
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True, name=None):
     """Bilinear ROI-align over a static number of boxes
-    (reference vision/ops.py:1295)."""
+    (reference vision/ops.py:1295).
+
+    With sampling_ratio <= 0 the reference picks ceil(roi/output)
+    samples PER ROI — a data-dependent count XLA cannot tile. The
+    TPU-native program uses a static 4x4 grid per bin instead (pass an
+    explicit sampling_ratio to control it)."""
     os_ = (output_size, output_size) if isinstance(output_size, int) \
         else tuple(output_size)
     R = raw(boxes).shape[0]
@@ -344,7 +349,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         y2 = bx[:, 3] * spatial_scale - offset
         bw = jnp.maximum(x2 - x1, 1e-6)
         bh = jnp.maximum(y2 - y1, 1e-6)
-        ns = sampling_ratio if sampling_ratio > 0 else 2
+        ns = sampling_ratio if sampling_ratio > 0 else 4
         sy = (jnp.arange(oh * ns) + 0.5) / ns  # in output-bin units
         sx = (jnp.arange(ow * ns) + 0.5) / ns
         ys = y1[:, None] + sy[None, :] * (bh[:, None] / oh)

@@ -110,11 +110,11 @@ def test_roi_align_linear_ramp_exact():
     # whole-image 1x1 roi-align returns the mean of the sample columns
     ramp = np.tile(np.arange(4, dtype=np.float32), (4, 1))
     feat = paddle.to_tensor(ramp[None, None])
-    boxes = paddle.to_tensor(np.asarray([[0, 0, 4, 4]], np.float32))
+    boxes = paddle.to_tensor(np.asarray([[0, 0, 3, 3]], np.float32))
     bn = paddle.to_tensor(np.asarray([1], np.int32))
     out = V.roi_align(feat, boxes, bn, 1, aligned=False)
-    # sample xs at 1.0 and 3.0 -> mean 2.0
-    np.testing.assert_allclose(out.numpy()[0, 0, 0, 0], 2.0, atol=1e-6)
+    # 4x4 grid samples xs at [0.375, 1.125, 1.875, 2.625] -> mean 1.5
+    np.testing.assert_allclose(out.numpy()[0, 0, 0, 0], 1.5, atol=1e-6)
     # constant feature: any box returns the constant
     cfeat = paddle.to_tensor(np.full((1, 3, 5, 5), 2.5, np.float32))
     b2 = paddle.to_tensor(np.asarray([[0.7, 1.1, 3.9, 4.2]], np.float32))
@@ -489,3 +489,54 @@ def test_set_global_initializer_priority():
 def test_profiler_sortedkeys_and_device_tail():
     assert paddle.profiler.SortedKeys.CPUTotal.value == 0
     assert paddle.device.get_cudnn_version() is None
+
+
+def test_tensor_method_tail_and_inplace():
+    t = paddle.to_tensor([0.1, 0.5])
+    np.testing.assert_allclose(
+        t.erfinv().numpy(),
+        [0.08885599, 0.47693628], atol=1e-5)
+    tl = paddle.to_tensor([0.0, 1.0])
+    tl.lerp_(paddle.to_tensor([2.0, 3.0]), 0.5)
+    np.testing.assert_allclose(tl.numpy(), [1.0, 2.0], atol=1e-6)
+    m = paddle.to_tensor(np.asarray([[1.0, 2.0], [3.0, 4.0]],
+                                    np.float32))
+    np.testing.assert_allclose(
+        m.mv(paddle.to_tensor([1.0, 1.0])).numpy(), [3.0, 7.0])
+    assert int(m.rank().numpy()) == 2
+    paddle.seed(7)
+    tu = paddle.zeros((2000,))
+    tu.uniform_(0.0, 2.0)
+    assert 0.9 < float(tu.numpy().mean()) < 1.1
+    te = paddle.zeros((4000,))
+    te.exponential_(2.0)
+    assert 0.4 < float(te.numpy().mean()) < 0.6
+    tp = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    tp.put_along_axis_(paddle.to_tensor(np.asarray([[1], [2]])),
+                       paddle.to_tensor(5.0), 1)
+    assert float(tp.numpy()[0, 1]) == 5.0 and float(tp.numpy()[1, 2]) == 5.0
+
+
+def test_fused_multi_transformer_functional():
+    from paddle_tpu.incubate.nn import functional as IF
+
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    B, S, E, NH, HD, FF, L = 2, 5, 16, 4, 4, 32, 2
+
+    def mk(*s):
+        return paddle.to_tensor(
+            (rng.standard_normal(s) * 0.1).astype(np.float32))
+
+    out = IF.fused_multi_transformer(
+        paddle.to_tensor(rng.standard_normal((B, S, E))
+                         .astype(np.float32)),
+        [mk(E) + 1.0 for _ in range(L)], [mk(E) for _ in range(L)],
+        [mk(3, NH, HD, E) for _ in range(L)],
+        [mk(3, NH, HD) for _ in range(L)],
+        [mk(E, E) for _ in range(L)], [mk(E) for _ in range(L)],
+        [mk(E) + 1.0 for _ in range(L)], [mk(E) for _ in range(L)],
+        [mk(E, FF) for _ in range(L)], [mk(FF) for _ in range(L)],
+        [mk(FF, E) for _ in range(L)], [mk(E) for _ in range(L)])
+    assert tuple(out.shape) == (B, S, E)
+    assert np.isfinite(out.numpy()).all()
