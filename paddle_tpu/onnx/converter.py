@@ -9,9 +9,10 @@ folded at export time.
 
 Coverage targets inference graphs of the shipped model zoo: dense /
 conv / norm / attention stacks (MatMul, Einsum, Conv, pooling,
-reductions, elementwise, Gather embeddings, Where, Cast, shape ops).
-`lax.scan`/`while`/`cond` bodies are out of scope — export those models
-with format="stablehlo" instead.
+reductions, elementwise, Gather embeddings, Where, Cast, shape ops) and
+structured control flow — `lax.scan` -> Scan, `lax.cond` -> If,
+`lax.while_loop` -> Loop with closure over outer-scope tensors — so
+RNNs and scan-stacked models export too.
 """
 from __future__ import annotations
 
@@ -108,6 +109,9 @@ def _attr(name, v):
     elif isinstance(v, P.TensorProto):
         a.type = T.TENSOR
         a.t.CopyFrom(v)
+    elif isinstance(v, P.GraphProto):
+        a.type = T.GRAPH
+        a.g.CopyFrom(v)
     elif isinstance(v, (list, tuple)):
         if all(isinstance(x, (int, np.integer)) for x in v):
             a.type = T.INTS
@@ -142,12 +146,26 @@ class _Name:
 
 
 class _Ctx:
-    def __init__(self, graph, opset):
+    def __init__(self, graph, opset, parent=None):
         self.graph = graph
         self.opset = opset
-        self._ids = itertools.count()
-        self._taken = set()
-        self._const_names = {}  # cache: (dtype, shape, bytes) -> name
+        if parent is None:
+            # initializers always land in the ROOT graph: ONNX subgraph
+            # nodes may reference outer-scope tensors by name
+            self.root_graph = graph
+            self._ids = itertools.count()
+            self._taken = set()
+            self._const_names = {}  # (dtype, shape, sha1) -> name
+        else:
+            self.root_graph = parent.root_graph
+            self._ids = parent._ids
+            self._taken = parent._taken
+            self._const_names = parent._const_names
+
+    def sub(self, graph):
+        """Child context emitting nodes into `graph` (a control-flow
+        body) while sharing names/initializers with the root."""
+        return _Ctx(graph, self.opset, parent=self)
 
     def fresh(self, hint="t"):
         while True:
@@ -169,7 +187,7 @@ class _Ctx:
         if key in self._const_names:
             return self._const_names[key]
         name = self.fresh(hint)
-        self.graph.initializer.append(_tensor_proto(name, arr))
+        self.root_graph.initializer.append(_tensor_proto(name, arr))
         self._const_names[key] = name
         return name
 
@@ -345,6 +363,155 @@ def _reduce_bool(ctx, eqn, ins, op):
     r = ctx.node(op, [x], axes=[int(a) for a in eqn.params["axes"]],
                  keepdims=0)
     return ctx.node("Cast", [r], to=_ONNX_DTYPE["bool"])
+
+
+def _outer_names(ctx, vals, hint):
+    """Resolve values to names usable from a subgraph (ONNX subgraphs
+    close over outer-scope tensors by name)."""
+    return [_Name(ctx.read(v, hint)) for v in vals]
+
+
+def _finish_subgraph(sub, outs, avals):
+    """Set a subgraph's outputs, inserting Identity for values not
+    produced by this graph's own nodes (consts / outer aliases)."""
+    produced = {o for n in sub.graph.node for o in n.output}
+    names = []
+    seen = set()
+    for val, aval in zip(outs, avals):
+        if isinstance(val, _Const):
+            name = sub.node("Identity", [sub.read(val, "out")])
+        elif val.name not in produced or val.name in seen:
+            # outer aliases AND repeated outvars (e.g. an RNN body
+            # returning new_h twice) need a fresh SSA name
+            name = sub.node("Identity", [val.name])
+        else:
+            name = val.name
+        seen.add(name)
+        sub.graph.output.append(_value_info(name, aval.shape, aval.dtype))
+        names.append(name)
+    return names
+
+
+def _bool_name(ctx, val, hint):
+    name = ctx.read(val, hint)
+    dt = val.val.dtype if isinstance(val, _Const) else None
+    if dt is None or np.dtype(dt) != np.bool_:
+        name = ctx.node("Cast", [name], to=_ONNX_DTYPE["bool"])
+    return name
+
+
+def _scan_node(ctx, eqn, invals):
+    """lax.scan -> ONNX Scan: carries map to state variables, xs to
+    scan inputs (consts close over the outer scope)."""
+    p = eqn.params
+    closed = p["jaxpr"]
+    nc, ncarry = p["num_consts"], p["num_carry"]
+    reverse = bool(p.get("reverse", False))
+    length = int(p["length"])
+    inner = closed.jaxpr
+    const_vals = _outer_names(ctx, invals[:nc], "scan_const")
+    carries = invals[nc:nc + ncarry]
+    xs = invals[nc + ncarry:]
+
+    body = P.GraphProto(name=ctx.fresh("scan_body"))
+    sub = ctx.sub(body)
+    body_invals = list(const_vals)
+    for var in inner.invars[nc:nc + ncarry]:
+        nm = sub.fresh("scan_carry")
+        body.input.append(_value_info(nm, var.aval.shape,
+                                      var.aval.dtype))
+        body_invals.append(_Name(nm))
+    x_vars = inner.invars[nc + ncarry:]
+    for var in x_vars:
+        nm = sub.fresh("scan_x")
+        body.input.append(_value_info(nm, var.aval.shape,
+                                      var.aval.dtype))
+        body_invals.append(_Name(nm))
+    dummy = not x_vars  # Scan requires >= 1 scan input
+    if dummy:
+        nm = sub.fresh("scan_tick")
+        body.input.append(_value_info(nm, (), "int32"))
+
+    outs = _walk(sub, inner, closed.consts, body_invals)
+    n_ys = len(outs) - ncarry
+    _finish_subgraph(sub, outs, [v.aval for v in inner.outvars])
+
+    scan_ins = [ctx.read(v, "scan_xs") for v in xs]
+    if dummy:
+        scan_ins = [ctx.initializer(
+            np.zeros(length, np.int32), "scan_ticks")]
+    n_scan = len(scan_ins)
+    direction = [1 if reverse else 0] * n_scan
+    node_outs = ctx.node(
+        "Scan", [ctx.read(v, "scan_carry0") for v in carries] + scan_ins,
+        n_out=ncarry + n_ys, body=body, num_scan_inputs=n_scan,
+        scan_input_directions=direction,
+        scan_output_directions=[1 if reverse else 0] * max(n_ys, 0)
+        if n_ys else [])
+    if isinstance(node_outs, str):
+        node_outs = [node_outs]
+    return [_Name(n) for n in node_outs]
+
+
+def _cond_node(ctx, eqn, invals):
+    """lax.cond -> ONNX If (two-branch; operands close over scope)."""
+    branches = eqn.params["branches"]
+    if len(branches) != 2:
+        raise OnnxExportError(
+            f"cond/switch with {len(branches)} branches")
+    op_vals = _outer_names(ctx, invals[1:], "cond_arg")
+    graphs = []
+    for br in branches:
+        g = P.GraphProto(name=ctx.fresh("branch"))
+        sub = ctx.sub(g)
+        outs = _walk(sub, br.jaxpr, br.consts, op_vals)
+        _finish_subgraph(sub, outs, [v.aval for v in eqn.outvars])
+        graphs.append(g)
+    pred = _bool_name(ctx, invals[0], "cond_pred")
+    node_outs = ctx.node("If", [pred], n_out=len(eqn.outvars),
+                         then_branch=graphs[1], else_branch=graphs[0])
+    if isinstance(node_outs, str):
+        node_outs = [node_outs]
+    return [_Name(n) for n in node_outs]
+
+
+def _while_node(ctx, eqn, invals):
+    """lax.while_loop -> ONNX Loop: body computes the next carry then
+    re-evaluates the cond jaxpr for the loop condition."""
+    p = eqn.params
+    cj, bj = p["cond_jaxpr"], p["body_jaxpr"]
+    cn, bn = p["cond_nconsts"], p["body_nconsts"]
+    cconsts = _outer_names(ctx, invals[:cn], "while_ccost")
+    bconsts = _outer_names(ctx, invals[cn:cn + bn], "while_bconst")
+    init = invals[cn + bn:]
+    init_names = [ctx.read(v, "loop_init") for v in init]
+
+    # initial condition evaluated in the outer graph
+    (cond0,) = _walk(ctx, cj.jaxpr, cj.consts,
+                     cconsts + [_Name(n) for n in init_names])
+    cond0_name = _bool_name(ctx, cond0, "loop_cond0")
+
+    body = P.GraphProto(name=ctx.fresh("loop_body"))
+    sub = ctx.sub(body)
+    body.input.append(_value_info(sub.fresh("loop_iter"), (), "int64"))
+    body.input.append(_value_info(sub.fresh("loop_cond_in"), (), "bool"))
+    carry_vals = []
+    for var in bj.jaxpr.invars[bn:]:
+        nm = sub.fresh("loop_carry")
+        body.input.append(_value_info(nm, var.aval.shape,
+                                      var.aval.dtype))
+        carry_vals.append(_Name(nm))
+    new_carry = _walk(sub, bj.jaxpr, bj.consts, bconsts + carry_vals)
+    (cond_out,) = _walk(sub, cj.jaxpr, cj.consts, cconsts + new_carry)
+    cond_aval = cj.jaxpr.outvars[0].aval
+    _finish_subgraph(sub, [cond_out] + new_carry,
+                     [cond_aval] + [v.aval for v in eqn.outvars])
+
+    node_outs = ctx.node("Loop", ["", cond0_name] + init_names,
+                         n_out=len(eqn.outvars), body=body)
+    if isinstance(node_outs, str):
+        node_outs = [node_outs]
+    return [_Name(n) for n in node_outs]
 
 
 def _emit(ctx, eqn, invals):
@@ -565,6 +732,21 @@ def _emit(ctx, eqn, invals):
         return [_Name(_gather_node(ctx, eqn, invals))]
     if prim == "dynamic_slice":
         return [_Name(_dynamic_slice(ctx, eqn, invals))]
+
+    if prim == "split":
+        sizes = [int(s) for s in p["sizes"]]
+        outs = ctx.node("Split", ins() + [ctx.i64(sizes, "split")],
+                        n_out=len(sizes), axis=int(p["axis"]))
+        if isinstance(outs, str):
+            outs = [outs]
+        return [_Name(n) for n in outs]
+
+    if prim == "scan":
+        return _scan_node(ctx, eqn, invals)
+    if prim == "cond":
+        return _cond_node(ctx, eqn, invals)
+    if prim == "while":
+        return _while_node(ctx, eqn, invals)
 
     raise OnnxExportError(f"primitive '{prim}' has no ONNX mapping")
 

@@ -75,6 +75,8 @@ def _attrs(node):
             out[a.name] = [float(x) for x in a.floats]
         elif a.type == T.TENSOR:
             out[a.name] = tensor_to_numpy(a.t)
+        elif a.type == T.GRAPH:
+            out[a.name] = a.g
         else:
             raise NotImplementedError(f"attribute type {a.type}")
     return out
@@ -323,11 +325,106 @@ def _run_node(node, attrs, ins):
         idx = np.take(order, np.arange(k), axis=axis)
         vals = np.take_along_axis(x, idx, axis=axis)
         return [vals, idx.astype(np.int64)]
+    if op == "Split":
+        axis = attrs.get("axis", 0)
+        if len(ins) > 1:
+            sizes = [int(s) for s in ins[1]]
+            idx = np.cumsum(sizes)[:-1]
+        else:
+            idx = attrs.get("num_outputs", len(node.output))
+        return list(np.split(ins[0], idx, axis=axis))
     if op == "Softmax":
         axis = attrs.get("axis", -1)
         e = np.exp(ins[0] - ins[0].max(axis=axis, keepdims=True))
         return [(e / e.sum(axis=axis, keepdims=True)).astype(ins[0].dtype)]
     raise NotImplementedError(f"numpy runtime: op {op}")
+
+
+def _exec_graph_body(graph, env, cache):
+    """Execute a (sub)graph's nodes against a shared env (tensor names
+    are globally unique; subgraphs close over outer names). `cache`
+    holds per-run() parsed attrs so Scan/Loop bodies don't re-decode
+    every node's attributes each iteration. Entries store the node
+    wrapper itself: upb frees transient wrappers between iterations and
+    recycles their ids, so the cache must pin each wrapper alive for
+    id(node) to stay unique."""
+    for node in graph.node:
+        hit = cache.get(id(node))
+        if hit is None:
+            attrs = _attrs(node)
+            cache[id(node)] = (node, attrs)
+        else:
+            attrs = hit[1]
+        if node.op_type == "Scan":
+            outs = _run_scan(node, attrs, env, cache)
+        elif node.op_type == "If":
+            branch = (attrs["then_branch"] if bool(env[node.input[0]])
+                      else attrs["else_branch"])
+            _exec_graph_body(branch, env, cache)
+            outs = [env[o.name] for o in branch.output]
+        elif node.op_type == "Loop":
+            outs = _run_loop(node, attrs, env, cache)
+        else:
+            ins = [env[name] for name in node.input if name]
+            outs = _run_node(node, attrs, ins)
+        for name, val in zip(node.output, outs):
+            env[name] = val
+
+
+def _run_scan(node, attrs, env, cache):
+    body = attrs["body"]
+    n_scan = attrs["num_scan_inputs"]
+    ins = [env[name] for name in node.input]
+    m = len(ins) - n_scan
+    states, xs = list(ins[:m]), ins[m:]
+    in_dirs = attrs.get("scan_input_directions", [0] * n_scan)
+    n_ys = len(body.output) - m
+    out_dirs = attrs.get("scan_output_directions", [0] * n_ys)
+    length = int(xs[0].shape[0])
+    ys = [[] for _ in range(n_ys)]
+    for t in range(length):
+        elems = [x[length - 1 - t] if d else x[t]
+                 for x, d in zip(xs, in_dirs)]
+        for vi, v in zip(body.input, states + elems):
+            env[vi.name] = v
+        _exec_graph_body(body, env, cache)
+        outs = [env[o.name] for o in body.output]
+        states = outs[:m]
+        for i, y in enumerate(outs[m:]):
+            ys[i].append(y)
+    stacked = []
+    for i, y in enumerate(ys):
+        if out_dirs and i < len(out_dirs) and out_dirs[i]:
+            y = y[::-1]
+        if y:
+            stacked.append(np.stack(y))
+        else:  # zero-length scan: take element shape from the body
+            vi = body.output[m + i].type.tensor_type
+            shape = [d.dim_value for d in vi.shape.dim]
+            stacked.append(np.zeros([0] + shape,
+                                    _np_dtype(vi.elem_type)))
+    return states + stacked
+
+
+def _run_loop(node, attrs, env, cache):
+    body = attrs["body"]
+    max_trip = env[node.input[0]] if node.input[0] else None
+    cond = bool(env[node.input[1]]) if node.input[1] else True
+    deps = [env[name] for name in node.input[2:]]
+    if len(body.output) > 1 + len(deps):
+        raise NotImplementedError(
+            "numpy runtime: Loop scan outputs are not supported")
+    it = 0
+    while cond and (max_trip is None or it < int(max_trip)):
+        bind = [np.asarray(it, np.int64), np.asarray(cond)] + deps
+        for vi, v in zip(body.input, bind):
+            env[vi.name] = v
+        _exec_graph_body(body, env, cache)
+        outs = [env[o.name] for o in body.output]
+        cond = bool(outs[0])
+        deps = outs[1:1 + len(deps)]
+        it += 1
+    return deps
 
 
 def run(model, inputs):
@@ -341,9 +438,5 @@ def run(model, inputs):
         if vi.name not in inputs:
             raise KeyError(f"missing input {vi.name}")
     env.update({k: np.asarray(v) for k, v in inputs.items()})
-    for node in g.node:
-        ins = [env[name] for name in node.input if name]
-        outs = _run_node(node, _attrs(node), ins)
-        for name, val in zip(node.output, outs):
-            env[name] = val
+    _exec_graph_body(g, env, cache={})
     return [env[o.name] for o in g.output]

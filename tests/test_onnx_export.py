@@ -28,8 +28,13 @@ def _roundtrip(layer, specs, inputs, atol=3e-5):
             model, {f"input_{i}": x for i, x in enumerate(inputs)})
     with jax.default_matmul_precision("highest"):
         ref = layer(*[paddle.to_tensor(x) for x in inputs])
-    refs = [r.numpy() for r in
-            (ref if isinstance(ref, (tuple, list)) else [ref])]
+
+    def _flat(x):
+        if isinstance(x, (tuple, list)):
+            return [leaf for item in x for leaf in _flat(item)]
+        return [x]
+
+    refs = [r.numpy() for r in _flat(ref)]
     assert len(outs) == len(refs)
     for o, r in zip(outs, refs):
         np.testing.assert_allclose(
@@ -105,12 +110,85 @@ def test_groups_and_strided_conv():
 
 def test_unsupported_primitive_raises():
     paddle.seed(0)
-    rnn = nn.LSTM(4, 8)  # lax.scan body -> no ONNX mapping
+    # transposed conv (lhs_dilation) has no ONNX mapping yet
+    net = nn.Conv2DTranspose(3, 4, 3, stride=2)
     with tempfile.TemporaryDirectory() as td:
         with pytest.raises(paddle.onnx.OnnxExportError):
             paddle.onnx.export(
-                rnn, os.path.join(td, "m"),
-                input_spec=[paddle.static.InputSpec([2, 6, 4], "float32")])
+                net, os.path.join(td, "m"),
+                input_spec=[paddle.static.InputSpec([1, 3, 8, 8],
+                                                    "float32")])
+
+
+def test_lstm_exports_via_scan():
+    paddle.seed(0)
+    lstm = nn.LSTM(4, 8)
+    x = np.random.default_rng(2).standard_normal((2, 6, 4)) \
+        .astype(np.float32)
+    model = _roundtrip(lstm, [paddle.static.InputSpec([2, 6, 4],
+                                                      "float32")], [x])
+    scans = [n for n in model.graph.node if n.op_type == "Scan"]
+    assert scans
+    # subgraph outputs must be SSA-unique even when the scan body
+    # returns the same var twice (new_h as both carry and y)
+    for n in scans:
+        for a in n.attribute:
+            if a.name == "body":
+                names = [o.name for o in a.g.output]
+                assert len(names) == len(set(names))
+
+
+def test_cond_and_while_export():
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    from paddle_tpu.onnx import jaxpr_to_onnx
+    from paddle_tpu.onnx import run as onnx_run
+
+    def f_cond(x):
+        return lax.cond(x.sum() > 0, lambda v: v * 2.0,
+                        lambda v: v - 1.0, x)
+
+    m = jaxpr_to_onnx(jax.make_jaxpr(f_cond)(jnp.asarray([1.0])),
+                      input_names=["x"])
+    assert any(n.op_type == "If" for n in m.graph.node)
+    for test in ([3.0], [-2.0]):
+        (o,) = onnx_run(m, {"x": np.asarray(test, np.float32)})
+        np.testing.assert_allclose(
+            o, np.asarray(f_cond(jnp.asarray(test))), atol=1e-6)
+
+    def f_while(x):
+        return lax.while_loop(lambda c: c[0] < 10.0,
+                              lambda c: (c[0] + c[1], c[1]),
+                              (x, jnp.float32(2.0)))[0]
+
+    m2 = jaxpr_to_onnx(jax.make_jaxpr(f_while)(jnp.float32(0.0)),
+                       input_names=["x"])
+    assert any(n.op_type == "Loop" for n in m2.graph.node)
+    (o,) = onnx_run(m2, {"x": np.asarray(0.5, np.float32)})
+    np.testing.assert_allclose(o, 10.5, atol=1e-6)
+
+
+def test_scan_reverse_export():
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    from paddle_tpu.onnx import jaxpr_to_onnx
+    from paddle_tpu.onnx import run as onnx_run
+
+    def f(x0, xs):
+        return lax.scan(lambda c, x: (c + x, c * x), x0, xs,
+                        reverse=True)
+
+    x0 = jnp.float32(1.0)
+    xs = jnp.asarray([1.0, 2.0, 3.0], jnp.float32)
+    m = jaxpr_to_onnx(jax.make_jaxpr(f)(x0, xs),
+                      input_names=["x0", "xs"])
+    carry, ys = onnx_run(m, {"x0": np.float32(1.0),
+                             "xs": np.asarray(xs)})
+    rc, rys = f(x0, xs)
+    np.testing.assert_allclose(carry, np.asarray(rc), atol=1e-6)
+    np.testing.assert_allclose(ys, np.asarray(rys), atol=1e-6)
 
 
 def test_runtime_parses_torch_exported_model():
