@@ -1,0 +1,110 @@
+"""bf16 forward+backward sweep over representative layers.
+
+Round-2 regression class: ops that work in fp32 but break under
+bfloat16 (conv's preferred_element_type broke the grad transpose rule;
+max_pool's np.iinfo crashed on ml_dtypes). Every layer here runs a
+full train step in bf16 and must produce finite bf16 outputs and
+finite grads.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _bf16_step(layer, x_shape, reduce_to_scalar=None, x=None):
+    paddle.seed(0)
+    layer.to(dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    if x is None:
+        x = paddle.to_tensor(
+            rng.standard_normal(x_shape).astype(np.float32)) \
+            .astype("bfloat16")
+    out = layer(x)
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    assert "bfloat16" in str(out.dtype), (layer, out.dtype)
+    loss = (out.astype("float32") ** 2).mean() if reduce_to_scalar is None \
+        else reduce_to_scalar(out)
+    loss.backward()
+    for name, p in layer.named_parameters():
+        if not p.stop_gradient:
+            assert p.grad is not None, f"{type(layer).__name__}.{name}"
+            g = np.asarray(p.grad._data, np.float32)
+            assert np.isfinite(g).all(), f"{type(layer).__name__}.{name}"
+    return out
+
+
+CASES = [
+    (lambda: nn.Linear(8, 16), (2, 8)),
+    (lambda: nn.Conv1D(3, 6, 3, padding=1), (2, 3, 10)),
+    (lambda: nn.Conv2D(3, 6, 3, padding=1), (2, 3, 8, 8)),
+    (lambda: nn.Conv2DTranspose(3, 6, 3, stride=2), (2, 3, 5, 5)),
+    (lambda: nn.Conv3D(2, 4, 3, padding=1), (1, 2, 4, 6, 6)),
+    (lambda: nn.Sequential(nn.Conv2D(3, 6, 3), nn.MaxPool2D(2)),
+     (2, 3, 8, 8)),
+    (lambda: nn.Sequential(nn.Conv2D(3, 6, 3), nn.AvgPool2D(2)),
+     (2, 3, 8, 8)),
+    (lambda: nn.Sequential(nn.Conv2D(3, 6, 3),
+                           nn.AdaptiveAvgPool2D(1)), (2, 3, 8, 8)),
+    (lambda: nn.BatchNorm2D(4), (2, 4, 6, 6)),
+    (lambda: nn.LayerNorm(12), (2, 5, 12)),
+    (lambda: nn.GroupNorm(2, 8), (2, 8, 5, 5)),
+    (lambda: nn.InstanceNorm2D(4), (2, 4, 6, 6)),
+    (lambda: nn.Embedding(20, 8), None),
+    (lambda: nn.GRU(6, 8), (2, 5, 6)),
+    (lambda: nn.LSTM(6, 8), (2, 5, 6)),
+    (lambda: nn.MultiHeadAttention(16, 4), (2, 6, 16)),
+    (lambda: nn.TransformerEncoderLayer(16, 4, 32), (2, 6, 16)),
+    (lambda: nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Silu(),
+                           nn.Hardswish(), nn.Mish()), (2, 8)),
+]
+
+
+@pytest.mark.parametrize(
+    "factory,shape", CASES,
+    ids=[f"{i}" for i in range(len(CASES))])
+def test_bf16_forward_backward(factory, shape):
+    layer = factory()
+    if isinstance(layer, nn.Embedding):
+        ids = paddle.to_tensor(
+            np.random.default_rng(1).integers(0, 20, (2, 5))
+            .astype(np.int32))
+        _bf16_step(layer, None, x=ids)
+    else:
+        _bf16_step(layer, shape)
+
+
+def test_bf16_losses():
+    paddle.seed(0)
+    from paddle_tpu.nn import functional as F
+
+    rng = np.random.default_rng(2)
+    logits = paddle.to_tensor(rng.standard_normal((4, 10))
+                              .astype(np.float32)).astype("bfloat16")
+    labels = paddle.to_tensor(rng.integers(0, 10, (4,)).astype(np.int64))
+    for loss in (F.cross_entropy(logits.astype("float32"), labels),
+                 F.mse_loss(logits.astype("float32"),
+                            paddle.zeros((4, 10)))):
+        v = float(np.asarray(loss._data))
+        assert np.isfinite(v)
+
+
+def test_bf16_flash_attention_interpret():
+    """The pallas flash kernel must accept bf16 operands (round-2 fix:
+    it used to upcast to fp32 before the MXU dots)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((1, 128, 2, 64)),
+                    dtype=jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((1, 128, 2, 64)),
+                    dtype=jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((1, 128, 2, 64)),
+                    dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
