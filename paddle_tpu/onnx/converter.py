@@ -263,6 +263,50 @@ def _einsum_letters(dn, lhs_rank, rhs_rank):
     return f"{''.join(lhs)},{''.join(rhs)}->{''.join(out)}"
 
 
+def _conv_transpose_node(ctx, eqn, ins):
+    """conv_general_dilated with lhs_dilation is XLA's transposed conv:
+    a unit-stride conv over the stride-dilated input with a spatially
+    flipped, in/out-swapped kernel. Invert those kernel transforms in
+    the graph and emit ONNX ConvTranspose."""
+    p = eqn.params
+    if any(s != 1 for s in p["window_strides"]):
+        raise OnnxExportError("conv with both lhs_dilation and strides")
+    if int(p["feature_group_count"]) != 1:
+        raise OnnxExportError("grouped transposed conv export")
+    k = list(eqn.invars[1].aval.shape[2:])
+    d = list(p["rhs_dilation"])
+    strides = [int(s) for s in p["lhs_dilation"]]
+    plo, phi, opad = [], [], []
+    for (lo, hi), ki, di in zip(p["padding"], k, d):
+        eff = di * (ki - 1)
+        if lo < 0 or hi < 0 or lo > eff:
+            # negative jax pads (conv padding > effective kernel) crop
+            # the output — not expressible as ConvTranspose pads
+            raise OnnxExportError(
+                "transposed conv pads outside the ONNX-representable "
+                "range")
+        plo.append(eff - lo)
+        if hi <= eff:
+            phi.append(eff - hi)
+            opad.append(0)
+        else:  # extra high-side output = ONNX output_padding
+            phi.append(0)
+            opad.append(hi - eff)
+    nsp = len(k)
+    # un-flip the spatial dims and un-swap (O,I)->(I,O)
+    w = ctx.node("Slice", [ins[1],
+                           ctx.i64([-1] * nsp, "starts"),
+                           ctx.i64([_INT64_MIN + 1] * nsp, "ends"),
+                           ctx.i64(list(range(2, 2 + nsp)), "axes"),
+                           ctx.i64([-1] * nsp, "steps")])
+    w = ctx.node("Transpose", [w],
+                 perm=[1, 0] + list(range(2, 2 + nsp)))
+    extra = {"output_padding": opad} if any(opad) else {}
+    return ctx.node("ConvTranspose", [ins[0], w], kernel_shape=k,
+                    strides=strides, pads=plo + phi, dilations=d,
+                    group=1, **extra)
+
+
 def _conv_node(ctx, eqn, ins):
     p = eqn.params
     dn = p["dimension_numbers"]
@@ -272,10 +316,10 @@ def _conv_node(ctx, eqn, ins):
             or tuple(dn.out_spec) != std):
         raise OnnxExportError(
             f"conv layout {dn} is not NC{'HW'[:ndim-2]}/OIHW")
-    if any(d != 1 for d in p["lhs_dilation"]):
-        raise OnnxExportError("transposed conv (lhs_dilation) export")
     if p.get("batch_group_count", 1) != 1:
         raise OnnxExportError("batch_group_count > 1")
+    if any(s != 1 for s in p["lhs_dilation"]):
+        return _conv_transpose_node(ctx, eqn, ins)
     pads_lo = [lo for lo, _ in p["padding"]]
     pads_hi = [hi for _, hi in p["padding"]]
     kernel = list(eqn.invars[1].aval.shape[2:])

@@ -131,6 +131,38 @@ def _dilate_kernel(w, dil):
     return out
 
 
+def _conv_transpose(x, w, b, attrs):
+    """ConvTranspose = conv over the stride-dilated input with the
+    flipped, (I,O)-swapped kernel and complemented pads."""
+    nsp = x.ndim - 2
+    strides = attrs.get("strides", [1] * nsp)
+    dil = attrs.get("dilations", [1] * nsp)
+    pads = attrs.get("pads", [0] * 2 * nsp)
+    opad = attrs.get("output_padding", [0] * nsp)
+    if attrs.get("group", 1) != 1:
+        raise NotImplementedError("numpy runtime: grouped ConvTranspose")
+    # dilate the input by the stride
+    sp = x.shape[2:]
+    dsp = [(s - 1) * st + 1 for s, st in zip(sp, strides)]
+    xd = np.zeros(x.shape[:2] + tuple(dsp), x.dtype)
+    xd[(slice(None), slice(None))
+       + tuple(slice(None, None, st) for st in strides)] = x
+    # w: [C_in, C_out, *k] -> conv kernel [C_out, C_in, *flip(k)]
+    w2 = np.flip(w, axis=tuple(range(2, 2 + nsp))).swapaxes(0, 1)
+    k = w.shape[2:]
+    conv_pads = ([d * (ki - 1) - p
+                  for d, ki, p in zip(dil, k, pads[:nsp])]
+                 + [d * (ki - 1) - p + o
+                    for d, ki, p, o in zip(dil, k, pads[nsp:], opad)])
+    if any(p < 0 for p in conv_pads):
+        raise NotImplementedError("numpy runtime: ConvTranspose pads")
+    out = _conv(xd, w2, {"strides": [1] * nsp, "dilations": dil,
+                         "pads": conv_pads})
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * nsp)
+    return out
+
+
 def _maxpool(x, attrs):
     kernel = attrs["kernel_shape"]
     strides = attrs.get("strides", [1] * len(kernel))
@@ -263,6 +295,9 @@ def _run_node(node, attrs, ins):
         return [_conv(ins[0], ins[1], attrs)
                 + (ins[2].reshape((1, -1) + (1,) * (ins[0].ndim - 2))
                    if len(ins) > 2 else 0)]
+    if op == "ConvTranspose":
+        return [_conv_transpose(ins[0], ins[1],
+                                ins[2] if len(ins) > 2 else None, attrs)]
     if op == "MaxPool":
         return [_maxpool(ins[0], attrs)]
     if op == "AveragePool":

@@ -110,14 +110,44 @@ def test_groups_and_strided_conv():
 
 def test_unsupported_primitive_raises():
     paddle.seed(0)
-    # transposed conv (lhs_dilation) has no ONNX mapping yet
-    net = nn.Conv2DTranspose(3, 4, 3, stride=2)
+
+    class Sorter(nn.Layer):
+        def forward(self, x):
+            # two-key lax.sort has no ONNX mapping
+            import jax
+
+            from paddle_tpu.tensor import apply
+
+            return apply(lambda a: jax.lax.sort(
+                (a, a * 2), num_keys=2)[0], x)
+
     with tempfile.TemporaryDirectory() as td:
         with pytest.raises(paddle.onnx.OnnxExportError):
             paddle.onnx.export(
-                net, os.path.join(td, "m"),
-                input_spec=[paddle.static.InputSpec([1, 3, 8, 8],
-                                                    "float32")])
+                Sorter(), os.path.join(td, "m"),
+                input_spec=[paddle.static.InputSpec([4], "float32")])
+
+
+def test_conv_transpose_export_parity():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Conv2DTranspose(3, 4, 3, stride=2, padding=1),
+                        nn.ReLU())
+    x = np.random.default_rng(13).standard_normal((2, 3, 6, 6)) \
+        .astype(np.float32)
+    model = _roundtrip(net, [paddle.static.InputSpec([2, 3, 6, 6],
+                                                     "float32")], [x],
+                       atol=1e-4)
+    assert any(n.op_type == "ConvTranspose" for n in model.graph.node)
+    # output_padding beyond the absorbable range needs the ONNX attr
+    net2 = nn.Conv2DTranspose(3, 4, 3, stride=2, padding=0,
+                              output_padding=1)
+    m2 = _roundtrip(net2, [paddle.static.InputSpec([1, 3, 5, 5],
+                                                   "float32")],
+                    [np.random.default_rng(17)
+                     .standard_normal((1, 3, 5, 5)).astype(np.float32)],
+                    atol=1e-4)
+    (ct,) = [n for n in m2.graph.node if n.op_type == "ConvTranspose"]
+    assert any(a.name == "output_padding" for a in ct.attribute)
 
 
 def test_lstm_exports_via_scan():
@@ -310,3 +340,41 @@ def test_both_formats():
         assert path.endswith(".onnx")
         assert os.path.exists(os.path.join(td, "m.onnx"))
         assert os.path.exists(os.path.join(td, "m.stablehlo"))
+
+
+def test_resnet18_export_parity():
+    paddle.seed(0)
+    from paddle_tpu.vision.models import resnet18
+
+    net = resnet18(num_classes=10)
+    x = np.random.default_rng(14).standard_normal((1, 3, 32, 32)) \
+        .astype(np.float32)
+    _roundtrip(net, [paddle.static.InputSpec([1, 3, 32, 32],
+                                             "float32")], [x],
+               atol=2e-4)
+
+
+def test_llama_tiny_export_parity():
+    import dataclasses
+
+    paddle.seed(0)
+    from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+    cfg = dataclasses.replace(LLAMA_TINY, dtype="float32")
+    lm = LlamaForCausalLM(cfg)
+    ids = np.random.default_rng(15).integers(
+        0, cfg.vocab_size, (1, 16)).astype(np.int32)
+    _roundtrip(lm, [paddle.to_tensor(ids)], [ids], atol=1e-4)
+
+
+def test_gpt_tiny_export_parity():
+    paddle.seed(0)
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(vocab_size=256, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=64)
+    gpt = GPTForCausalLM(cfg)
+    ids = np.random.default_rng(16).integers(0, 256, (1, 12)) \
+        .astype(np.int32)
+    _roundtrip(gpt, [paddle.to_tensor(ids)], [ids], atol=1e-4)
