@@ -13,7 +13,7 @@ from .collective import (  # noqa: F401
     new_group, recv, reduce, reduce_scatter, scatter, scatter_object_list,
     send, split, wait,
 )
-from . import cloud_utils, utils  # noqa: F401
+from . import cloud_utils, sharding, utils  # noqa: F401
 from .parallel import DataParallel, ParallelEnv  # noqa: F401
 from .ps_dataset import BoxPSDataset  # noqa: F401
 from .ps_dataset import (  # noqa: F401

@@ -7,6 +7,7 @@ from typing import Optional
 from .base import DistributedStrategy, HybridCommunicateGroup
 from .train_step import CompiledTrainStep, make_train_step
 from . import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
 
 _strategy: Optional[DistributedStrategy] = None
 _hcg: Optional[HybridCommunicateGroup] = None
