@@ -3,3 +3,6 @@ from .mp_layers import (  # noqa: F401
     VocabParallelEmbedding,
 )
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .random import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
+)

@@ -1,0 +1,81 @@
+"""Model-parallel RNG state tracking.
+
+Reference: distributed/fleet/meta_parallel/parallel_layers/random.py
+(RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed).
+The reference juggles CUDA generator states so TP-replicated regions
+draw identical randomness while dropout inside sharded regions differs
+per rank; on the jax stack randomness is an explicit key — the tracker
+keeps one named key stream per region and `rng_state(name)` swaps the
+framework's global key stream for the block.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        from ....framework import random_seed
+
+        prev = random_seed.swap_key(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = random_seed.swap_key(prev)
+
+
+RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import numpy as np
+
+    from ... import fleet
+
+    hcg = fleet.get_hybrid_communicate_group()
+    rank = hcg.get_model_parallel_rank()
+    if seed:
+        global_seed = seed
+        local_seed = seed * 1024 + rank * 100
+    else:
+        global_seed = int(np.random.randint(0, 655350))
+        local_seed = int(np.random.randint(rank * 10000 + 1,
+                                           (rank + 1) * 10000))
+    RNG_STATE_TRACKER.reset()
+    RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+    import paddle_tpu
+
+    paddle_tpu.seed(global_seed)

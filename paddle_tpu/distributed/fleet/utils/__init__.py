@@ -35,25 +35,41 @@ def recompute(function, *args, **kwargs):
     # Layers reachable as the function itself, a bound-method __self__,
     # or closure cells all contribute (a plain closure over a Layer
     # would otherwise train SILENTLY wrong with zero grads)
+    import functools as _ft
+
     params = {}
 
     def _add_layer(layer):
         for k, p in layer.named_parameters():
             params.setdefault(f"{k}@{id(p)}", p)
 
-    if isinstance(function, Layer):
-        _add_layer(function)
-    if isinstance(getattr(function, "__self__", None), Layer):
-        _add_layer(function.__self__)
-    for cell in getattr(function, "__closure__", None) or ():
-        try:
-            v = cell.cell_contents
-        except ValueError:
-            continue
-        if isinstance(v, Layer):
-            _add_layer(v)
-        elif isinstance(v, Tensor) and not v.stop_gradient:
-            params.setdefault(f"cell@{id(v)}", v)
+    def _scan(obj, depth=0):
+        if depth > 3:
+            return
+        if isinstance(obj, Layer):
+            _add_layer(obj)
+            return
+        if isinstance(obj, Tensor):
+            if not obj.stop_gradient:
+                params.setdefault(f"leaf@{id(obj)}", obj)
+            return
+        if isinstance(obj, _ft.partial):
+            _scan(obj.func, depth + 1)
+            for a in obj.args:
+                _scan(a, depth + 1)
+            for a in obj.keywords.values():
+                _scan(a, depth + 1)
+            return
+        if isinstance(getattr(obj, "__self__", None), Layer):
+            _add_layer(obj.__self__)
+        for cell in getattr(obj, "__closure__", None) or ():
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            _scan(v, depth + 1)
+
+    _scan(function)
     # Tensor kwargs must be traced too, not baked in as constants
     tensor_kw = {k: v for k, v in kwargs.items()
                  if isinstance(v, Tensor)}
@@ -63,23 +79,29 @@ def recompute(function, *args, **kwargs):
     names = list(params)
     n_params = len(names)
     n_kw = len(kw_names)
+    # non-tensor positional args (None, ints for shapes/flags) pass
+    # through untouched; only tensors are traced through the checkpoint
+    tensor_pos = [(i, a) for i, a in enumerate(args)
+                  if isinstance(a, Tensor)]
+    tensor_idx = [i for i, _ in tensor_pos]
 
     def raw_fn(*raw):
         pv = dict(zip(names, raw[:n_params]))
         kw = {k: Tensor(a) for k, a in
               zip(kw_names, raw[n_params:n_params + n_kw])}
-        xs = raw[n_params + n_kw:]
+        xs = list(args)
+        for i, a in zip(tensor_idx, raw[n_params + n_kw:]):
+            xs[i] = Tensor(a)
         with functional_mode(), _swap_params(params, pv):
-            out = function(*[Tensor(a) for a in xs], **kw, **static_kw)
+            out = function(*xs, **kw, **static_kw)
         if isinstance(out, (tuple, list)):
             return tuple(o._data if isinstance(o, Tensor) else o
                          for o in out)
         return out._data if isinstance(out, Tensor) else out
 
-    tensor_args = [a if isinstance(a, Tensor) else Tensor(a)
-                   for a in args]
     all_args = ([params[n] for n in names]
-                + [tensor_kw[k] for k in kw_names] + tensor_args)
+                + [tensor_kw[k] for k in kw_names]
+                + [a for _, a in tensor_pos])
     return apply(jax.checkpoint(raw_fn), *all_args)
 
 
@@ -231,7 +253,17 @@ class DistributedInfer:
                     "load parameters from a checkpoint directory")
             from ....static import load
 
-            load(self._main, dirname, exe)
+            prefix = dirname
+            if os.path.isdir(dirname):  # directory -> unique prefix
+                cands = [f[:-len(".pdparams")]
+                         for f in os.listdir(dirname)
+                         if f.endswith(".pdparams")]
+                if len(cands) != 1:
+                    raise ValueError(
+                        f"expected exactly one .pdparams under "
+                        f"{dirname}, found {sorted(cands)}")
+                prefix = os.path.join(dirname, cands[0])
+            load(self._main, prefix, exe)
 
     def get_dygraph_infer_model(self, model):
         model.eval()

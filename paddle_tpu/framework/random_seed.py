@@ -76,3 +76,12 @@ def get_rng_state():
 def set_rng_state(state):
     global _key
     _key = state
+
+
+def swap_key(new_key):
+    """Install ``new_key`` as the global key; returns the previous one
+    (meta_parallel RNG tracker support)."""
+    global _key
+    prev = _global_key()
+    _key = new_key
+    return prev

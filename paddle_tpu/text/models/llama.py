@@ -41,6 +41,9 @@ class LlamaConfig:
     remat: bool = False
     # shard the sequence dim over the mesh "sep" axis and run ring attention
     sequence_parallel: bool = False
+    # sequence-parallel kernel: "ring" (ppermute KV ring) or "ulysses"
+    # (all-to-all head re-shard; needs heads % sep == 0)
+    sep_mode: str = "ring"
     # chunked fused lm-head CE: never materializes [N, vocab] fp32 logits
     # (nn/functional/fused_ce.py); 0 disables
     fused_ce_chunk: int = 0
@@ -83,6 +86,7 @@ class LlamaAttention(Layer):
         self.theta = c.rope_theta
         self.dtype = c.dtype
         self.sequence_parallel = c.sequence_parallel
+        self.sep_mode = getattr(c, "sep_mode", "ring")
         h = c.hidden_size
         kv = self.num_kv_heads * self.head_dim
         self.q_proj = Linear(h, h, bias_attr=False)
@@ -123,11 +127,15 @@ class LlamaAttention(Layer):
             from ...distributed.mesh import get_mesh, mesh_axis_size
             use_ring = mesh_axis_size("sep") > 1
         if use_ring:
-            from ...ops.ring_attention import ring_attention
             mesh = get_mesh()
+            if getattr(self, "sep_mode", "ring") == "ulysses":
+                from ...ops.ulysses_attention import ulysses_attention \
+                    as sp_attn
+            else:
+                from ...ops.ring_attention import ring_attention as sp_attn
 
             def ring_fn(qq, kk, vv):
-                return ring_attention(qq, kk, vv, mesh=mesh, causal=True)
+                return sp_attn(qq, kk, vv, mesh=mesh, causal=True)
 
             out = apply(ring_fn, q, k, v)
         else:

@@ -172,3 +172,36 @@ def test_local_fs_mv_overwrite_replaces_dir(tmp_path):
     assert (dst / "f.txt").exists()
     assert not (dst / "stale.txt").exists()  # replaced, not nested
     assert not (dst / "new").exists()
+
+
+def test_recompute_partial_and_nontensor_args():
+    """functools.partial wrapping and non-tensor positional args
+    (None / ints) must work with full gradient routing."""
+    import functools
+
+    paddle.seed(0)
+    blk = nn.Linear(6, 6)
+    x = paddle.to_tensor(np.random.default_rng(5)
+                         .standard_normal((2, 6)).astype(np.float32))
+
+    def run(layer, t, mask, scale):
+        out = layer(t) * scale
+        if mask is not None:
+            out = out * mask
+        return out
+
+    for p in blk.parameters():
+        p.clear_grad()
+    out = fleet.utils.recompute(functools.partial(run, blk),
+                                x, None, 2.0)
+    (out ** 2).mean().backward()
+    g1 = {k: np.asarray(p.grad._data)
+          for k, p in blk.named_parameters()}
+    assert all(np.abs(v).max() > 0 for v in g1.values())
+
+    for p in blk.parameters():
+        p.clear_grad()
+    (run(blk, x, None, 2.0) ** 2).mean().backward()
+    for k, p in blk.named_parameters():
+        np.testing.assert_allclose(g1[k], np.asarray(p.grad._data),
+                                   atol=1e-6, err_msg=k)
