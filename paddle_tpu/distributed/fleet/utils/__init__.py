@@ -70,6 +70,13 @@ def recompute(function, *args, **kwargs):
             _scan(v, depth + 1)
 
     _scan(function)
+    # Layers handed in as positional args / kwargs contribute params too
+    for a in args:
+        if not isinstance(a, Tensor):
+            _scan(a)
+    for v in kwargs.values():
+        if not isinstance(v, Tensor):
+            _scan(v)
     # Tensor kwargs must be traced too, not baked in as constants
     tensor_kw = {k: v for k, v in kwargs.items()
                  if isinstance(v, Tensor)}

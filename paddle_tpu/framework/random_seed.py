@@ -79,8 +79,16 @@ def set_rng_state(state):
 
 
 def swap_key(new_key):
-    """Install ``new_key`` as the global key; returns the previous one
-    (meta_parallel RNG tracker support)."""
+    """Install ``new_key`` as the active key stream; returns the
+    previous one (meta_parallel RNG tracker support). Inside a
+    functional_key scope (jitted train steps) the TOP OF THE FUNCTIONAL
+    STACK is swapped — otherwise the tracker would silently no-op
+    exactly where model-parallel dropout isolation matters."""
+    stack = getattr(_tls, "fkeys", None)
+    if stack:
+        prev = stack[-1]
+        stack[-1] = new_key
+        return prev
     global _key
     prev = _global_key()
     _key = new_key

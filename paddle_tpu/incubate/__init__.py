@@ -58,3 +58,4 @@ class LayerHelper:
             return x
         from ..nn import functional as F
         return getattr(F, act)(x)
+from . import distributed  # noqa: F401  (models.moe experts-list API)

@@ -1,0 +1,189 @@
+"""incubate.distributed.models.moe — experts-list MoE API.
+
+Reference: python/paddle/incubate/distributed/models/moe/
+(moe_layer.py:244 MoELayer; gate/{naive,gshard,switch}_gate.py). The
+reference dispatches tokens with explicit alltoall calls per expert
+sub-program; here the gate produces a capacity-bounded dispatch mask and
+each expert Layer runs on its gathered [capacity, d_model] slice —
+static shapes throughout, with expert parallelism coming from sharding
+the stacked expert tensors over the mesh "ep" axis (see
+paddle_tpu.nn.moe for the batched-parameter fast path).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .....nn.layer_base import Layer
+from .....tensor import Tensor, apply
+
+__all__ = ["MoELayer", "BaseGate", "NaiveGate", "GShardGate",
+           "SwitchGate"]
+
+
+class BaseGate(Layer):
+    """Gate interface (reference gate/base_gate.py)."""
+
+    def __init__(self, num_expert, world_size=1):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = num_expert * world_size
+        self.loss = None
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def set_loss(self, loss):
+        self.loss = loss
+
+    def get_loss(self, clear=True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+
+class NaiveGate(BaseGate):
+    """Linear router, top-k softmax scores (reference
+    gate/naive_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(num_expert, world_size)
+        from .....nn.layer.common import Linear
+
+        self.gate = Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def forward(self, inp):
+        logits = self.gate(inp)
+
+        def route(lg):
+            val, idx = jax.lax.top_k(lg, self.top_k)
+            return val, idx.astype(jnp.int64)
+        value, index = apply(route, logits, n_outputs=2)
+        return value, index
+
+
+class GShardGate(NaiveGate):
+    """NaiveGate + load-balance aux loss (reference
+    gate/gshard_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True,
+                 group=None):
+        super().__init__(d_model, num_expert, world_size, topk)
+        self.capacity = capacity
+
+    def forward(self, x):
+        logits = self.gate(x)
+
+        def route(lg):
+            gates = jax.nn.softmax(lg, -1)
+            # raw logit values: MoELayer's masked softmax over the kept
+            # choices then reproduces renormalized probabilities exactly
+            val, idx = jax.lax.top_k(lg, self.top_k)
+            me = gates.mean(0)
+            top1 = jax.nn.one_hot(idx[:, 0], lg.shape[-1],
+                                  dtype=lg.dtype)
+            ce = top1.mean(0)
+            aux = jnp.sum(me * ce) * lg.shape[-1]
+            return val, idx.astype(jnp.int64), aux
+        value, index, aux = apply(route, logits, n_outputs=3)
+        self.set_loss(aux)
+        return value, index
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 switch router (reference gate/switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, topk=1)
+
+
+class MoELayer(Layer):
+    """Experts-list MoE (reference moe_layer.py:244).
+
+    `experts` is a LayerList of per-expert networks (each mapping
+    [*, d_model] -> [*, d_model]); `gate` is a config dict
+    ({"type": "naive"|"gshard"|"switch", "top_k": k}) or a gate
+    instance. Tokens route through a capacity-bounded dispatch and each
+    expert runs on its own [capacity, d_model] slice (static shapes).
+    """
+
+    def __init__(self, d_model, experts, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval=0, **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        self.experts = experts
+        self.num_expert = len(experts)
+        if gate is None:
+            gate = {"type": "gshard", "top_k": 2}
+        if isinstance(gate, dict):
+            kind = gate.get("type") or "gshard"
+            topk = gate.get("top_k", 2)
+            gate = {"naive": NaiveGate, "gshard": GShardGate,
+                    "switch": SwitchGate}[kind](
+                        d_model, self.num_expert, topk=topk)
+        self.gate = gate
+        self.capacity_factor = kwargs.get("capacity_factor", 1.25)
+
+    def forward(self, inp):
+        shape = tuple(inp.shape)
+        from .....tensor_ops.manipulation import reshape
+
+        x = reshape(inp, (-1, self.d_model))
+        s = int(x.shape[0])
+        e = self.num_expert
+        topk = getattr(self.gate, "top_k", 2)
+        cap = max(1, int(math.ceil(s * topk * self.capacity_factor / e)))
+
+        value, index = self.gate(x)
+
+        def build_dispatch(val, idx):
+            mask = jax.nn.one_hot(idx, e, dtype=val.dtype)  # [S,k,E]
+            flat = mask.reshape(-1, e)
+            # arrival position of each (token, choice) in its expert's
+            # queue; dropped beyond capacity
+            pos = (jnp.cumsum(flat, 0) - flat).reshape(mask.shape)
+            pos_sel = jnp.sum(pos * mask, -1)  # [S,k]
+            keep_sel = (pos_sel < cap).astype(val.dtype)
+            keep = mask * keep_sel[..., None]  # [S,k,E]
+            onec = jax.nn.one_hot(
+                jnp.clip(pos_sel, 0, cap - 1).astype(jnp.int32),
+                cap, dtype=val.dtype)  # [S,k,C]
+            # combine weight = softmax of the gate score over the kept
+            # choices — for softmax-prob gates (gshard) this equals
+            # renormalizing the top-k probabilities, and for raw-logit
+            # gates (naive/switch) it is the reference's
+            # softmax(topk_logits)
+            z = jnp.where(keep_sel > 0, val, -jnp.inf)
+            z = z - jax.lax.stop_gradient(
+                jnp.max(jnp.where(keep_sel > 0, val, -1e30), -1,
+                        keepdims=True))
+            ez = jnp.exp(z) * keep_sel
+            val_norm = ez / jnp.maximum(ez.sum(-1, keepdims=True), 1e-9)
+            disp = jnp.einsum("ske,skc->ecs", keep, onec)
+            comb = jnp.einsum("ske,skc,sk->ecs", keep, onec, val_norm)
+            return disp, comb
+        disp, comb = apply(build_dispatch, value, index, n_outputs=2)
+
+        # gather per-expert inputs [E, C, d] then run each expert
+        def gather(d_, xr):
+            return jnp.einsum("ecs,sd->ecd", d_, xr)
+        exp_in = apply(gather, disp, x)
+        outs = []
+        from .....tensor_ops.manipulation import squeeze
+
+        for i, expert in enumerate(self.experts):
+            xi = apply(lambda t, i=i: t[i], exp_in)  # [C, d]
+            outs.append(expert(xi))
+
+        def combine(c_, *ys):
+            stacked = jnp.stack(ys, 0)  # [E, C, d]
+            return jnp.einsum("ecs,ecd->sd", c_, stacked)
+        out = apply(combine, comb, *outs)
+        return reshape(out, shape)

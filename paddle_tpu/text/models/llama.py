@@ -86,7 +86,7 @@ class LlamaAttention(Layer):
         self.theta = c.rope_theta
         self.dtype = c.dtype
         self.sequence_parallel = c.sequence_parallel
-        self.sep_mode = getattr(c, "sep_mode", "ring")
+        self.sep_mode = c.sep_mode
         h = c.hidden_size
         kv = self.num_kv_heads * self.head_dim
         self.q_proj = Linear(h, h, bias_attr=False)
@@ -122,22 +122,22 @@ class LlamaAttention(Layer):
             v = concat([cache[1], v], axis=1)
             new_cache = (k.detach(), v.detach())
 
-        use_ring = False
+        use_sp = False
         if self.sequence_parallel and cache is None:
             from ...distributed.mesh import get_mesh, mesh_axis_size
-            use_ring = mesh_axis_size("sep") > 1
-        if use_ring:
+            use_sp = mesh_axis_size("sep") > 1
+        if use_sp:
             mesh = get_mesh()
-            if getattr(self, "sep_mode", "ring") == "ulysses":
+            if self.sep_mode == "ulysses":
                 from ...ops.ulysses_attention import ulysses_attention \
                     as sp_attn
             else:
                 from ...ops.ring_attention import ring_attention as sp_attn
 
-            def ring_fn(qq, kk, vv):
+            def sp_fn(qq, kk, vv):
                 return sp_attn(qq, kk, vv, mesh=mesh, causal=True)
 
-            out = apply(ring_fn, q, k, v)
+            out = apply(sp_fn, q, k, v)
         else:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         out = self.o_proj(reshape(out, (b, l, h)))

@@ -1,0 +1,111 @@
+"""incubate.distributed.models.moe experts-list API.
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py:244
+and gate/{naive,gshard,switch}_gate.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate.distributed.models.moe import (
+    GShardGate, MoELayer, NaiveGate, SwitchGate,
+)
+
+
+def _x(d=16, seed=0):
+    return paddle.to_tensor(
+        np.random.default_rng(seed).standard_normal((2, 8, d))
+        .astype(np.float32))
+
+
+def test_identity_experts_reconstruct_input():
+    """With identity experts and capacity to spare, the top-k combine
+    weights sum to 1 so the layer is the identity."""
+    paddle.seed(0)
+    d = 16
+    x = _x(d)
+    for gate_cfg in ({"type": "naive", "top_k": 2},
+                     {"type": "gshard", "top_k": 2},
+                     {"type": "switch"}):
+        n_exp = 4 if gate_cfg["type"] == "switch" else 2
+        moe = MoELayer(
+            d_model=d,
+            experts=nn.LayerList([nn.Identity() for _ in range(n_exp)]),
+            gate=dict(gate_cfg), capacity_factor=8.0)
+        out = moe(x)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-5,
+                                   err_msg=str(gate_cfg))
+
+
+def test_gshard_training_and_aux_loss():
+    paddle.seed(0)
+    d = 16
+    experts = nn.LayerList([
+        nn.Sequential(nn.Linear(d, 32), nn.GELU(), nn.Linear(32, d))
+        for _ in range(4)])
+    moe = MoELayer(d_model=d, experts=experts,
+                   gate={"type": "gshard", "top_k": 2})
+    x = _x(d)
+    out = moe(x)
+    assert tuple(out.shape) == tuple(x.shape)
+    aux = moe.gate.get_loss(clear=False)
+    assert aux is not None and np.isfinite(float(np.asarray(aux._data)))
+    loss = (out ** 2).mean() + aux * 0.01
+    loss.backward()
+    for name, p in moe.named_parameters():
+        assert p.grad is not None, name
+        assert np.isfinite(np.asarray(p.grad._data,
+                                      np.float32)).all(), name
+    # get_loss(clear=True) pops
+    assert moe.gate.get_loss() is not None
+    assert moe.gate.get_loss() is None
+
+
+def test_capacity_drops_tokens():
+    """All tokens routed to one expert with tiny capacity: overflow
+    tokens drop to zero output."""
+    paddle.seed(0)
+    d = 8
+
+    class OneHotGate(NaiveGate):
+        def forward(self, inp):
+            import jax.numpy as jnp
+
+            from paddle_tpu.tensor import apply
+
+            s = int(np.prod(inp.shape[:-1])) if len(inp.shape) > 2 \
+                else int(inp.shape[0])
+
+            def route(x2):
+                n = x2.shape[0]
+                val = jnp.ones((n, 1), x2.dtype)
+                idx = jnp.zeros((n, 1), jnp.int32)
+                return val, idx
+            return apply(route, inp, n_outputs=2)
+
+    gate = OneHotGate(d, 2, topk=1)
+    gate.top_k = 1
+    moe = MoELayer(d_model=d,
+                   experts=nn.LayerList([nn.Identity(), nn.Identity()]),
+                   gate=gate, capacity_factor=0.25)
+    x = _x(d, seed=1)
+    out = moe(x).numpy().reshape(-1, d)
+    xin = x.numpy().reshape(-1, d)
+    # capacity = ceil(16 * 1 * 0.25 / 2) = 2 slots on expert 0
+    kept = [i for i in range(16) if np.allclose(out[i], xin[i],
+                                                atol=1e-6)]
+    dropped = [i for i in range(16) if np.allclose(out[i], 0.0)]
+    assert len(kept) == 2 and len(dropped) == 14
+
+
+def test_gate_classes_surface():
+    d = 8
+    for cls in (NaiveGate, GShardGate, SwitchGate):
+        g = cls(d, 4)
+        assert g.tot_expert == 4
+        v, i = g(_x(d).reshape((-1, d)))
+        assert tuple(v.shape)[0] == 16
+    with pytest.raises(KeyError):
+        MoELayer(d_model=d, experts=nn.LayerList([nn.Identity()]),
+                 gate={"type": "bogus"})

@@ -205,3 +205,17 @@ def test_recompute_partial_and_nontensor_args():
     for k, p in blk.named_parameters():
         np.testing.assert_allclose(g1[k], np.asarray(p.grad._data),
                                    atol=1e-6, err_msg=k)
+
+
+def test_recompute_layer_as_positional_arg():
+    """A Layer passed positionally (not closed over) must still get
+    gradients routed through the checkpoint."""
+    paddle.seed(0)
+    blk = nn.Linear(6, 6)
+    x = paddle.to_tensor(np.random.default_rng(6)
+                         .standard_normal((2, 6)).astype(np.float32))
+    out = fleet.utils.recompute(lambda layer, t: layer(t), blk, x)
+    (out ** 2).mean().backward()
+    for k, p in blk.named_parameters():
+        assert p.grad is not None, k
+        assert np.abs(np.asarray(p.grad._data)).max() > 0, k

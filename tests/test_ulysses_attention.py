@@ -155,3 +155,33 @@ def test_meta_parallel_rng_tracker():
         b2 = paddle.rand((4,)).numpy()
     np.testing.assert_allclose(b1, b2)  # same seed -> same stream
     assert mp.get_rng_state_tracker() is mp.get_rng_state_tracker()
+
+
+def test_rng_tracker_works_under_functional_key():
+    """Inside a functional_key scope (jitted train steps) rng_state must
+    swap the functional stream, not the ignored eager global key."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.fleet import meta_parallel as mp
+    from paddle_tpu.framework import random_seed
+
+    tracker = mp.RNGStatesTracker()
+    tracker.add("mp_rng", 321)
+
+    key = jax.random.PRNGKey(0)
+    with random_seed.functional_key(key):
+        a = np.asarray(jax.random.uniform(random_seed.next_key(), (4,)))
+        with tracker.rng_state("mp_rng"):
+            b = np.asarray(jax.random.uniform(random_seed.next_key(),
+                                              (4,)))
+        c = np.asarray(jax.random.uniform(random_seed.next_key(), (4,)))
+    assert not np.allclose(a, b)
+    # the tracked draw must be reproducible from the same tracker seed
+    tracker2 = mp.RNGStatesTracker()
+    tracker2.add("mp_rng", 321)
+    with random_seed.functional_key(jax.random.PRNGKey(9)):
+        with tracker2.rng_state("mp_rng"):
+            b2 = np.asarray(jax.random.uniform(random_seed.next_key(),
+                                               (4,)))
+    np.testing.assert_allclose(b, b2)
+    assert not np.allclose(a, c)  # outer stream advanced, not reset
