@@ -10,6 +10,7 @@ from typing import Callable, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..tensor import Tensor
 from .tape import functional_mode
@@ -87,3 +88,135 @@ def hessian(func, xs, create_graph=False, allow_unused=False):
     raw = _raw_args(xs)
     h = jax.hessian(_wrap_fn(func), argnums=tuple(range(len(raw))) if len(raw) > 1 else 0)(*raw)
     return jax.tree_util.tree_map(Tensor, h)
+
+
+class Jacobian:
+    """Lazy Jacobian view (reference incubate/autograd/functional.py
+    Jacobian): computed once via jax.jacrev on first access, indexable
+    like the full matrix [prod(out_shape), sum_i prod(in_shape_i)].
+    `is_batched=True` vmaps over the leading batch dim and yields
+    [B, prod(out[1:]), prod(in[1:])]."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._func = func
+        self._xs = xs
+        self._is_batched = is_batched
+        self._jac = None
+
+    def _materialize(self):
+        if self._jac is not None:
+            return self._jac
+        raw = _raw_args(self._xs)
+        argnums = tuple(range(len(raw))) if len(raw) > 1 else 0
+        jfn = jax.jacrev(_wrap_fn(self._func), argnums=argnums)
+        if self._is_batched:
+            jac = jax.vmap(jfn)(*raw)
+            blocks = jac if isinstance(jac, tuple) else (jac,)
+            # per-sample: [B, *out[1:], *in[1:]] -> [B, M, N_i]
+            b = raw[0].shape[0]
+            flat = []
+            for blk, inp in zip(blocks, raw):
+                n_in = int(np.prod(inp.shape[1:]))
+                flat.append(blk.reshape(b, -1, n_in))
+            self._jac = flat[0] if len(flat) == 1 \
+                else jnp.concatenate(flat, -1)
+            return self._jac
+        jac = jfn(*raw)
+        blocks = jac if isinstance(jac, tuple) else (jac,)
+        flat = []
+        for blk, inp in zip(blocks, raw):
+            n_in = int(np.prod(inp.shape))
+            flat.append(blk.reshape(-1, n_in))  # rows = flattened output
+        self._jac = flat[0] if len(flat) == 1 \
+            else jnp.concatenate(flat, -1)
+        return self._jac
+
+    @property
+    def shape(self):
+        return tuple(self._materialize().shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._materialize()[idx])
+
+    def numpy(self):
+        return np.asarray(self._materialize())
+
+
+class Hessian:
+    """Lazy Hessian view (reference incubate/autograd/functional.py
+    Hessian) for scalar-output functions: the full
+    [sum_i n_i, sum_i n_i] block matrix over all inputs.
+    `is_batched=True` vmaps per sample -> [B, n, n]."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._func = func
+        self._xs = xs
+        self._is_batched = is_batched
+        self._hes = None
+
+    @staticmethod
+    def _assemble(h, raw, batch=None):
+        """Nested tuple of blocks -> one square matrix."""
+        if not isinstance(h, tuple):  # single input
+            n = int(np.prod(raw[0].shape[1 if batch else 0:]))
+            return h.reshape((batch, n, n) if batch else (n, n))
+        sizes = [int(np.prod(r.shape[1 if batch else 0:])) for r in raw]
+        rows = []
+        for i, hrow in enumerate(h):
+            cols = [blk.reshape(((batch,) if batch else ())
+                                + (sizes[i], sizes[j]))
+                    for j, blk in enumerate(hrow)]
+            rows.append(jnp.concatenate(cols, -1))
+        return jnp.concatenate(rows, -2)
+
+    def _materialize(self):
+        if self._hes is not None:
+            return self._hes
+        raw = _raw_args(self._xs)
+        argnums = tuple(range(len(raw))) if len(raw) > 1 else 0
+        hfn = jax.hessian(_wrap_fn(self._func), argnums=argnums)
+        if self._is_batched:
+            h = jax.vmap(hfn)(*raw)
+            self._hes = self._assemble(h, raw, batch=raw[0].shape[0])
+        else:
+            self._hes = self._assemble(hfn(*raw), raw)
+        return self._hes
+
+    @property
+    def shape(self):
+        return tuple(self._materialize().shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._materialize()[idx])
+
+    def numpy(self):
+        return np.asarray(self._materialize())
+
+
+def forward_grad(func, xs, v=None):
+    """Forward-mode derivative (reference incubate/autograd primapi
+    forward_grad; there it rewrites the static program to prim ops —
+    here forward-mode IS a first-class transform, jax.jvp). Returns the
+    tangent outputs."""
+    _, tangents = jvp(func, xs, v)
+    return tangents
+
+
+_prim_enabled = False
+
+
+def enable_prim():
+    """Reference toggles the primitive-operator lowering for autodiff
+    of the static graph; on the jax stack every op already IS a
+    differentiable primitive, so this records intent only."""
+    global _prim_enabled
+    _prim_enabled = True
+
+
+def disable_prim():
+    global _prim_enabled
+    _prim_enabled = False
+
+
+def prim_enabled():
+    return _prim_enabled

@@ -69,3 +69,53 @@ def get_device_name(device=None):
 
 def get_device_capability(device=None):
     return (0, 0)
+
+
+class Stream:
+    """CUDA stream shim (reference device/cuda/streams.py): XLA/PJRT
+    owns stream scheduling on TPU; the object exists for API parity and
+    synchronizes eagerly."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        import jax
+
+        (jax.device_put(0) + 0).block_until_ready()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    """CUDA event shim (reference device/cuda/streams.py)."""
+
+    def __init__(self, enable_timing=False, blocking=False,
+                 interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        pass
+
+
+import contextlib as _ctx
+
+
+@_ctx.contextmanager
+def stream_guard(stream):
+    """No-op guard: one implicit execution stream per device under
+    PJRT."""
+    yield

@@ -1,0 +1,83 @@
+"""Distributed pass framework surface.
+
+Reference: python/paddle/distributed/passes/__init__.py (new_pass,
+PassManager, PassContext over program-rewrite passes like
+fuse_all_reduce / recompute / sharding). On the TPU stack these graph
+rewrites are XLA's job — GSPMD inserts and fuses collectives, the
+scheduler overlaps them, and remat is jax.checkpoint — so passes here
+are recorded configuration the compiled train step reads, not IR
+surgery.
+"""
+from __future__ import annotations
+
+__all__ = ["new_pass", "PassManager", "PassContext"]
+
+_KNOWN_PASSES = {
+    "fuse_all_reduce", "fuse_elewise_add_act", "fuse_bn_act",
+    "fuse_bn_add_act", "fuse_relu_depthwise_conv", "fuse_optimizer",
+    "inplace_addto_op", "auto_parallel_gradient_merge",
+    "auto_parallel_sharding", "auto_parallel_amp", "auto_parallel_fp16",
+    "auto_parallel_recompute", "pipeline", "fuse_gemm_epilogue",
+}
+
+
+class PassContext:
+    def __init__(self):
+        self._applied = []
+        self.attrs = {}
+
+    def set_attr(self, key, value):
+        self.attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self.attrs.get(key, default)
+
+
+class _Pass:
+    def __init__(self, name, attrs=None):
+        self.name = name
+        self.attrs = dict(attrs or {})
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        """XLA already performs the fusion/placement this pass names;
+        record it so strategy consumers and tests can observe intent."""
+        if context is not None:
+            context._applied.append(self.name)
+        return main_programs
+
+    def set_attr(self, key, value):
+        self.attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self.attrs.get(key, default)
+
+
+def new_pass(name, pass_attrs=None):
+    if name not in _KNOWN_PASSES:
+        import warnings
+
+        warnings.warn(f"unknown pass {name!r}; treating as a no-op "
+                      "marker", stacklevel=2)
+    return _Pass(name, pass_attrs)
+
+
+class PassManager:
+    def __init__(self, passes=None):
+        self._passes = list(passes or [])
+        self._context = PassContext()
+
+    def append(self, p):
+        self._passes.append(p)
+
+    def apply(self, main_programs, startup_programs=None):
+        for p in self._passes:
+            p.apply(main_programs, startup_programs, self._context)
+        return main_programs, startup_programs
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]
+
+    @property
+    def context(self):
+        return self._context

@@ -59,3 +59,13 @@ class LayerHelper:
         from ..nn import functional as F
         return getattr(F, act)(x)
 from . import distributed  # noqa: F401  (models.moe experts-list API)
+
+# register submodule paths so `import paddle_tpu.incubate.{sparse,asp,
+# autograd}` works (they are aliases of top-level packages)
+import sys as _sys
+
+_sys.modules[__name__ + ".sparse"] = sparse
+_sys.modules[__name__ + ".sparse.nn"] = sparse.nn
+_sys.modules[__name__ + ".sparse.nn.functional"] = sparse.nn.functional
+_sys.modules[__name__ + ".asp"] = asp
+_sys.modules[__name__ + ".autograd"] = autograd

@@ -1,0 +1,146 @@
+"""Functional optimizers: BFGS / L-BFGS minimizers.
+
+Reference: python/paddle/incubate/optimizer/functional/{bfgs,lbfgs}.py.
+Both return the reference's result tuple
+(is_converge, num_func_calls, position, objective_value,
+objective_gradient). BFGS delegates to jax.scipy.optimize (whole solve
+is one XLA program); L-BFGS is a two-loop-recursion implementation with
+Armijo backtracking, jit-able end to end.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....tensor import Tensor
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap_obj(objective_func):
+    def f(x):
+        out = objective_func(Tensor(x))
+        out = out._data if isinstance(out, Tensor) else out
+        return out.reshape(())
+    return f
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn="strong_wolfe",
+                  max_line_search_iters=50, initial_step_length=1.0,
+                  dtype="float32", name=None):
+    f = _wrap_obj(objective_func)
+    x0 = _unwrap(initial_position)
+    from jax.scipy.optimize import minimize as _minimize
+
+    res = _minimize(
+        f, x0, method="BFGS",
+        options={"maxiter": int(max_iters), "gtol": tolerance_grad})
+    grad = jax.grad(f)(res.x)
+    # judge convergence by the gradient norm (jax's success flag also
+    # demands line-search niceties that fail on exactly-solved problems)
+    is_converge = Tensor(jnp.max(jnp.abs(grad)) <= tolerance_grad * 10)
+    return (is_converge, Tensor(res.nfev), Tensor(res.x),
+            Tensor(res.fun), Tensor(grad))
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-7,
+                   tolerance_change=1e-9,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe",
+                   max_line_search_iters=50, initial_step_length=1.0,
+                   dtype="float32", name=None):
+    """Limited-memory BFGS: two-loop recursion over the last
+    `history_size` (s, y) pairs, Armijo backtracking line search."""
+    f = _wrap_obj(objective_func)
+    fg = jax.value_and_grad(f)
+    x = _unwrap(initial_position).astype(dtype)
+    n = x.size
+    m = int(min(history_size, max(max_iters, 1)))
+
+    s_hist = jnp.zeros((m, n), x.dtype)
+    y_hist = jnp.zeros((m, n), x.dtype)
+    rho = jnp.zeros((m,), x.dtype)
+
+    f0, g0 = fg(x)
+
+    def direction(g, s_hist, y_hist, rho, k):
+        q = g.reshape(-1)
+        idx = (jnp.arange(m) + k) % m  # oldest..newest ring order
+
+        def bwd(carry, i):
+            q, alphas = carry
+            valid = rho[i] != 0
+            a = jnp.where(valid, rho[i] * jnp.dot(s_hist[i], q), 0.0)
+            q = q - a * y_hist[i]
+            return (q, alphas.at[i].set(a)), None
+
+        (q, alphas), _ = jax.lax.scan(
+            bwd, (q, jnp.zeros((m,), x.dtype)), idx[::-1])
+        # initial Hessian scaling from the newest pair
+        newest = (k - 1) % m
+        ys = jnp.dot(s_hist[newest], y_hist[newest])
+        yy = jnp.dot(y_hist[newest], y_hist[newest])
+        gamma = jnp.where((k > 0) & (yy > 0), ys / jnp.maximum(yy, 1e-20),
+                          1.0)
+        r = q * gamma
+
+        def fwd(r, i):
+            valid = rho[i] != 0
+            b = jnp.where(valid, rho[i] * jnp.dot(y_hist[i], r), 0.0)
+            r = r + s_hist[i] * (alphas[i] - b)
+            return r, None
+
+        r, _ = jax.lax.scan(fwd, r, idx)
+        return -r.reshape(x.shape)
+
+    def body(carry):
+        x, fx, g, s_hist, y_hist, rho, k, it, nfev, _ = carry
+        d = direction(g, s_hist, y_hist, rho, k)
+
+        def ls_body(ls):
+            t, fe, done = ls
+            fnew = f(x + t * d)
+            ok = fnew <= fx + 1e-4 * t * jnp.vdot(g, d)
+            return (jnp.where(ok, t, t * 0.5), fe + 1, done | ok)
+
+        def ls_cond(ls):
+            t, fe, done = ls
+            return (~done) & (fe < max_line_search_iters)
+
+        t, fe, _ = jax.lax.while_loop(
+            ls_cond, ls_body,
+            (jnp.asarray(initial_step_length, x.dtype), 0, False))
+        x_new = x + t * d
+        f_new, g_new = fg(x_new)
+        sv = (x_new - x).reshape(-1)
+        yv = (g_new - g).reshape(-1)
+        ys = jnp.dot(sv, yv)
+        slot = k % m
+        write = ys > 1e-10
+        s_hist = jnp.where(write, s_hist.at[slot].set(sv), s_hist)
+        y_hist = jnp.where(write, y_hist.at[slot].set(yv), y_hist)
+        rho = jnp.where(write, rho.at[slot].set(1.0 / ys), rho)
+        converged = (jnp.max(jnp.abs(g_new)) < tolerance_grad) | \
+            (jnp.abs(f_new - fx) < tolerance_change)
+        return (x_new, f_new, g_new, s_hist, y_hist, rho,
+                k + jnp.where(write, 1, 0), it + 1, nfev + fe + 1,
+                converged)
+
+    def cond(carry):
+        *_, it, nfev, converged = carry
+        return (~converged) & (it < max_iters)
+
+    init = (x, f0, g0, s_hist, y_hist, rho, jnp.asarray(0),
+            jnp.asarray(0), 1, False)
+    x_f, f_f, g_f, *_, nfev, converged = jax.lax.while_loop(
+        cond, body, init)
+    return (Tensor(jnp.asarray(converged)), Tensor(jnp.asarray(nfev)),
+            Tensor(x_f), Tensor(f_f), Tensor(g_f))

@@ -7,6 +7,8 @@ compiled AOT at save time and executes without python model code.
 """
 from __future__ import annotations
 
+import enum
+
 import numpy as np
 
 __all__ = ['Config', 'Predictor', 'create_predictor']
@@ -151,3 +153,81 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+# -- type/query surface (reference paddle/inference/__init__.py wraps
+# fluid.inference enums; values mirror the C++ analysis-config enums) --
+
+class DataType(enum.Enum):
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+class PlaceType(enum.Enum):
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    NPU = 3
+    CUSTOM = 4
+
+
+class PrecisionType(enum.Enum):
+    Float32 = 0
+    Int8 = 1
+    Half = 2
+    Bfloat16 = 3
+
+
+class BackendType(enum.Enum):
+    CPU = 0
+    GPU = 1
+    TENSORRT = 2
+    XPU = 3
+
+
+Tensor = _Handle  # reference exposes the handle type as inference.Tensor
+
+
+def get_version():
+    import paddle_tpu
+
+    return getattr(paddle_tpu, "__version__", "0.0.0-tpu")
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)  # no TensorRT in the XLA stack
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def get_num_bytes_of_data_type(dtype):
+    sizes = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+             DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+             DataType.BFLOAT16: 2}
+    return sizes[dtype]
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision,
+                               backend, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """The XLA stack handles mixed precision at trace time (amp /
+    bf16 params); artifact-level conversion is not applicable to
+    StableHLO bundles."""
+    raise NotImplementedError(
+        "convert_to_mixed_precision: re-export the model with bf16 "
+        "parameters (layer.to(dtype='bfloat16') + jit.save) instead")
+
+
+__all__ += ["DataType", "PlaceType", "PrecisionType", "BackendType",
+            "Tensor", "get_version", "get_trt_compile_version",
+            "get_trt_runtime_version", "get_num_bytes_of_data_type",
+            "convert_to_mixed_precision"]

@@ -9,6 +9,8 @@ kernels can exploit the pattern).
 """
 from __future__ import annotations
 
+import weakref
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -68,4 +70,55 @@ def prune_model(model_or_program=None, n=2, m=4, mask_algo="mask_1d",
         mask = _nm_mask(w, n, m)
         p._data = jnp.where(mask, w, 0).astype(w.dtype)
         masks[name] = mask
+        _masks[id(p)] = (weakref.ref(p), mask)
     return masks
+
+
+# param-id -> (weakref(param), mask): lets asp.decorate re-apply masks
+# post-step without pinning discarded models in memory
+_masks = {}
+
+
+_supported_layers = {"Linear", "Conv2D", "fc", "conv2d"}
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Register a layer type as prunable (reference
+    static/sparsity/supported_layer_list.py)."""
+    name = layer if isinstance(layer, str) else getattr(
+        layer, "__name__", str(layer))
+    _supported_layers.add(name)
+
+
+def decorate(optimizer):
+    """ASP optimizer decoration (reference incubate/asp decorate): after
+    each step, re-apply the recorded n:m masks — but only for THIS
+    optimizer's parameters, not every pruned model in the process."""
+    own = {id(p) for p in getattr(optimizer, "_parameter_list", None)
+           or []}
+
+    class _ASPOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def step(self):
+            out = self._inner.step()
+            _reapply_masks(own or None)
+            return out
+
+    return _ASPOptimizer(optimizer)
+
+
+def _reapply_masks(only_ids=None):
+    for pid, (ref, mask) in list(_masks.items()):
+        param = ref()
+        if param is None:
+            del _masks[pid]
+            continue
+        if only_ids is not None and pid not in only_ids:
+            continue
+        param._data = jnp.where(mask, param._data, 0) \
+            .astype(param._data.dtype)

@@ -64,3 +64,26 @@ def run_check():
         _ = np.asarray(a @ a.T)
     print(f"paddle_tpu is installed successfully! "
           f"({n} {jax.default_backend()} device(s) visible)")
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version against a range (reference
+    utils/install_check.py require_version). The TPU build always
+    reports a dev version and passes unless the caller pins an
+    impossible range."""
+    def parse(v):
+        parts = []
+        for tok in str(v).split("."):
+            num = ""
+            for ch in tok:
+                if ch.isdigit():
+                    num += ch
+                else:
+                    break
+            parts.append(int(num or 0))
+        return tuple((parts + [0, 0, 0])[:3])
+
+    if max_version is not None and parse(min_version) > parse(max_version):
+        raise ValueError(
+            f"min_version {min_version} > max_version {max_version}")
+    return True

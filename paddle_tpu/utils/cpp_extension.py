@@ -159,3 +159,36 @@ def host_op_from_library(lib, symbol: str, out_like: Callable,
     if name:
         _REGISTRY[name] = op
     return op
+
+
+def get_build_directory(verbose=False):
+    """Build cache directory for jit-compiled extensions (reference
+    utils/cpp_extension/extension_utils.py)."""
+    root = os.environ.get("PADDLE_EXTENSION_DIR",
+                          os.path.join(os.path.expanduser("~"),
+                                       ".cache", "paddle_tpu_extensions"))
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """setuptools-style build entry (reference cpp_extension.setup):
+    compiles each extension's sources (dicts from :func:`CppExtension`)
+    with the same toolchain :func:`load` uses. Returns the list of
+    built library paths."""
+    exts = ext_modules or []
+    if not isinstance(exts, (list, tuple)):
+        exts = [exts]
+    built = []
+    for ext in exts:
+        if not isinstance(ext, dict):
+            raise TypeError(
+                "ext_modules entries must come from CppExtension(...)")
+        sources = ext.get("sources")
+        ext_name = ext.get("name") or name
+        if not sources:
+            raise ValueError(f"extension {ext_name!r} has no sources")
+        built.append(load(
+            ext_name, sources,
+            extra_cxx_flags=tuple(ext.get("extra_compile_args", ()))))
+    return built

@@ -122,3 +122,32 @@ def test_second_order_via_functional():
 
     h = paddle.autograd.hessian(f, paddle.to_tensor([1.0, 2.0]))
     np.testing.assert_allclose(np.diag(h.numpy()), [6.0, 12.0], rtol=1e-5)
+
+
+def test_incubate_autograd_jacobian_hessian_forward_grad():
+    """Reference: python/paddle/incubate/autograd/__init__.py surface
+    (Jacobian, Hessian, forward_grad, enable_prim)."""
+    import numpy as np
+
+    from paddle_tpu.incubate import autograd as A
+
+    x = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+    J = A.Jacobian(lambda t: t ** 2, x)
+    np.testing.assert_allclose(J[:].numpy(),
+                               np.diag([2.0, 4.0]), atol=1e-6)
+    assert J.shape == (2, 2)
+    np.testing.assert_allclose(J[0].numpy(), [2.0, 0.0], atol=1e-6)
+
+    H = A.Hessian(lambda t: (t ** 3).sum(), x)
+    np.testing.assert_allclose(H[:].numpy(),
+                               np.diag([6.0, 12.0]), atol=1e-6)
+
+    tangent = A.forward_grad(
+        lambda t: t ** 2, x,
+        paddle.to_tensor(np.asarray([1.0, 0.0], np.float32)))
+    np.testing.assert_allclose(tangent.numpy(), [2.0, 0.0], atol=1e-6)
+
+    A.enable_prim()
+    assert A.prim_enabled()
+    A.disable_prim()
+    assert not A.prim_enabled()

@@ -1,0 +1,137 @@
+"""Final namespace-sweep tail: device.cuda streams,
+distributed.passes, incubate submodule aliases, functional BFGS/LBFGS,
+inference type surface, ASP decorate, utils.require_version,
+cpp_extension setup surface.
+
+References: python/paddle/device/cuda/streams.py,
+distributed/passes/__init__.py, incubate/optimizer/functional/{bfgs,
+lbfgs}.py, inference/__init__.py, static/sparsity, utils.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_cuda_stream_event_shims():
+    s = paddle.device.cuda.Stream()
+    e = s.record_event()
+    assert e.query()
+    e.synchronize()
+    s.synchronize()
+    with paddle.device.cuda.stream_guard(s):
+        pass
+
+
+def test_distributed_passes():
+    from paddle_tpu.distributed import passes
+
+    p = passes.new_pass("fuse_all_reduce", {"max_memory_size": 1024})
+    assert p.get_attr("max_memory_size") == 1024
+    pm = passes.PassManager([p, passes.new_pass("auto_parallel_amp")])
+    pm.apply([None])
+    assert pm.names == ["fuse_all_reduce", "auto_parallel_amp"]
+    assert pm.context._applied == pm.names
+
+
+def test_incubate_submodule_imports():
+    import importlib
+
+    for mod in ("paddle_tpu.incubate.sparse",
+                "paddle_tpu.incubate.sparse.nn",
+                "paddle_tpu.incubate.sparse.nn.functional",
+                "paddle_tpu.incubate.asp",
+                "paddle_tpu.incubate.autograd"):
+        m = importlib.import_module(mod)
+        assert m is not None
+    from paddle_tpu.incubate import asp
+
+    assert hasattr(asp, "prune_model") and hasattr(asp, "decorate")
+
+
+def test_minimize_bfgs_and_lbfgs_quadratic():
+    from paddle_tpu.incubate.optimizer.functional import (
+        minimize_bfgs, minimize_lbfgs,
+    )
+
+    A = np.asarray([[3.0, 0.5], [0.5, 1.0]], np.float32)
+    b = np.asarray([1.0, -2.0], np.float32)
+
+    def obj(x):
+        xr = x._data
+        return paddle.to_tensor(0.5 * xr @ A @ xr - b @ xr)
+
+    x0 = paddle.to_tensor(np.zeros(2, np.float32))
+    xstar = np.linalg.solve(A, b)
+    for fn in (minimize_bfgs, minimize_lbfgs):
+        conv, nfev, pos, val, grad = fn(obj, x0, max_iters=60)
+        assert bool(np.asarray(conv._data)), fn.__name__
+        np.testing.assert_allclose(pos.numpy(), xstar, atol=1e-4)
+        assert np.abs(grad.numpy()).max() < 1e-3
+
+
+def test_minimize_lbfgs_rosenbrock():
+    from paddle_tpu.incubate.optimizer.functional import minimize_lbfgs
+
+    def rosen(x):
+        xr = x._data
+        return paddle.to_tensor(
+            (1 - xr[0]) ** 2 + 100 * (xr[1] - xr[0] ** 2) ** 2)
+
+    conv, nfev, pos, val, grad = minimize_lbfgs(
+        rosen, paddle.to_tensor(np.asarray([-1.2, 1.0], np.float32)),
+        max_iters=1000)
+    np.testing.assert_allclose(pos.numpy(), [1.0, 1.0], atol=1e-3)
+
+
+def test_inference_type_surface():
+    from paddle_tpu import inference as I
+
+    assert I.get_num_bytes_of_data_type(I.DataType.FLOAT32) == 4
+    assert I.get_num_bytes_of_data_type(I.DataType.BFLOAT16) == 2
+    assert I.get_trt_compile_version() == (0, 0, 0)
+    assert isinstance(I.get_version(), str)
+    assert I.Tensor is not None and I.PlaceType.CPU.value == 0
+    with pytest.raises(NotImplementedError):
+        I.convert_to_mixed_precision("a", "b", "c", "d", None, None)
+
+
+def test_asp_decorate_keeps_masks():
+    from paddle_tpu import nn, optimizer as optim
+    from paddle_tpu.static import sparsity
+
+    paddle.seed(0)
+    net = nn.Linear(8, 8)
+    masks = sparsity.prune_model(net, n=2, m=4)
+    assert masks
+    opt = sparsity.decorate(
+        optim.SGD(learning_rate=0.1, parameters=net.parameters()))
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((4, 8)).astype(np.float32))
+    loss = (net(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    w = net.weight.numpy()
+    # n:m structure survives the update: each group of 4 has >= 2 zeros
+    groups = w.reshape(-1, 4)
+    assert ((groups == 0).sum(1) >= 2).all()
+    sparsity.add_supported_layer("MyLayer")
+
+
+def test_require_version_and_build_dir():
+    from paddle_tpu import utils
+    from paddle_tpu.utils import cpp_extension as ce
+
+    assert utils.require_version("0.0.0")
+    with pytest.raises(ValueError):
+        utils.require_version("3.0.0", "2.0.0")
+    d = ce.get_build_directory()
+    import os
+
+    assert os.path.isdir(d)
+    ext = ce.CppExtension(sources=["x.cc"])
+    assert ext["sources"] == ["x.cc"]
+    with pytest.raises(RuntimeError):
+        ce.CUDAExtension(sources=["k.cu"])  # no CUDA on the TPU stack
+    with pytest.raises(ValueError):
+        ce.setup(name="bad", ext_modules=[{"name": "bad"}])
