@@ -1,0 +1,45 @@
+"""Export a model to real ONNX and verify it with the bundled numpy
+runtime (no onnx pip package needed).
+
+Run: python examples/export_onnx.py
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+paddle.seed(0)
+model = paddle.vision.models.resnet18(num_classes=10)
+model.eval()
+
+path = paddle.onnx.export(
+    model, "/tmp/resnet18",
+    input_spec=[paddle.static.InputSpec([1, 3, 32, 32], "float32")])
+print("wrote", path)
+
+onnx_model = paddle.onnx.load(path)
+print("graph:", len(onnx_model.graph.node), "nodes,",
+      len(onnx_model.graph.initializer), "initializers")
+
+x = np.random.default_rng(0).standard_normal((1, 3, 32, 32)) \
+    .astype(np.float32)
+(onnx_out,) = paddle.onnx.run(onnx_model, {"input_0": x})
+with jax.default_matmul_precision("highest"):
+    ref = model(paddle.to_tensor(x)).numpy()
+print("max |onnx - eager| =", float(np.abs(onnx_out - ref).max()))
+
+# RNNs export too: lax.scan becomes ONNX Scan
+lstm = nn.LSTM(8, 16)
+lstm.eval()
+p2 = paddle.onnx.export(
+    lstm, "/tmp/lstm",
+    input_spec=[paddle.static.InputSpec([2, 10, 8], "float32")])
+ops = {n.op_type for n in paddle.onnx.load(p2).graph.node}
+print("lstm ops include Scan:", "Scan" in ops)

@@ -36,14 +36,22 @@ def group_sharded_parallel(model, optimizer, level, scaler=None,
     from ..fleet import DistributedStrategy
 
     strategy = fleet._strategy  # peek; get_strategy() would auto-init
-    if strategy is None:
+    nontrivial = strategy is not None and any(
+        strategy.hybrid_configs.get(k, 1) > 1
+        for k in ("dp_degree", "mp_degree", "pp_degree", "sep_degree"))
+    if strategy is None or (
+            not nontrivial
+            and strategy.hybrid_configs.get("sharding_degree", 1) <= 1):
+        # no parallel topology to preserve: give the EXISTING strategy
+        # (keeping its amp/recompute/other knobs) an all-device
+        # sharding axis and rebuild the mesh
         import jax
 
-        strategy = DistributedStrategy()
-        strategy.hybrid_configs = {
-            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
-            "sharding_degree": max(len(jax.devices()), 1),
-        }
+        strategy = strategy or DistributedStrategy()
+        strategy.hybrid_configs = dict(strategy.hybrid_configs)
+        strategy.hybrid_configs.update(
+            dp_degree=1, mp_degree=1, pp_degree=1,
+            sharding_degree=max(len(jax.devices()), 1))
         strategy.sharding = True
         fleet.init(is_collective=True, strategy=strategy)
     elif strategy.hybrid_configs.get("sharding_degree", 1) <= 1:

@@ -36,7 +36,7 @@ def minimize_bfgs(objective_func, initial_position, max_iters=50,
                   max_line_search_iters=50, initial_step_length=1.0,
                   dtype="float32", name=None):
     f = _wrap_obj(objective_func)
-    x0 = _unwrap(initial_position)
+    x0 = _unwrap(initial_position).astype(dtype)
     from jax.scipy.optimize import minimize as _minimize
 
     res = _minimize(

@@ -109,6 +109,11 @@ def decorate(optimizer):
             _reapply_masks(own or None)
             return out
 
+        def minimize(self, loss, *args, **kwargs):
+            out = self._inner.minimize(loss, *args, **kwargs)
+            _reapply_masks(own or None)
+            return out
+
     return _ASPOptimizer(optimizer)
 
 

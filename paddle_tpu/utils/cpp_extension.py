@@ -185,10 +185,13 @@ def setup(name=None, ext_modules=None, **kwargs):
             raise TypeError(
                 "ext_modules entries must come from CppExtension(...)")
         sources = ext.get("sources")
-        ext_name = ext.get("name") or name
         if not sources:
-            raise ValueError(f"extension {ext_name!r} has no sources")
+            raise ValueError(
+                f"extension {ext.get('name') or name!r} has no sources")
+        ext_name = (ext.get("name") or name
+                    or os.path.splitext(os.path.basename(sources[0]))[0])
         built.append(load(
             ext_name, sources,
-            extra_cxx_flags=tuple(ext.get("extra_compile_args", ()))))
+            extra_cxx_flags=tuple(ext.get("extra_compile_args", ())),
+            build_directory=get_build_directory()))
     return built
