@@ -1,0 +1,199 @@
+// Native byte-pair-encoding tokenizer for the text data pipeline.
+//
+// TPU-side analog of the reference stack's native tokenization (the
+// paddle ecosystem ships faster_tokenizer as a C++ library): python
+// calls enter through ctypes (GIL released), so DataLoader workers and
+// the prefetch ring can tokenize truly in parallel with model compute.
+//
+// Semantics mirror paddle_tpu/text/tokenizer.py::BpeTokenizer exactly:
+// split text on ' ', greedy lowest-rank pair merge per token over
+// UTF-8 codepoints, vocabulary lookup per merged piece (unknown pieces
+// dropped). Parity is pinned by tests/test_native_bpe.py.
+//
+// Build: make -C paddle_tpu/runtime/cpp  (builds libptpu_bpe.so)
+
+#include <cstring>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct PairHash {
+  size_t operator()(const std::pair<std::string, std::string>& p) const {
+    std::hash<std::string> h;
+    return h(p.first) * 1000003u ^ h(p.second);
+  }
+};
+
+struct Bpe {
+  std::unordered_map<std::string, int> vocab;
+  std::unordered_map<std::pair<std::string, std::string>, long, PairHash>
+      ranks;
+  // concurrent encode calls share the handle (ctypes releases the
+  // GIL), so the memo cache takes a reader/writer lock
+  std::shared_mutex cache_mu;
+  std::unordered_map<std::string, std::vector<int>> cache;
+};
+
+// split a UTF-8 string into codepoint-sized chunks (python tuple(token))
+std::vector<std::string> utf8_chars(const std::string& s) {
+  std::vector<std::string> out;
+  size_t i = 0;
+  while (i < s.size()) {
+    unsigned char c = s[i];
+    size_t n = (c < 0x80) ? 1 : (c >> 5) == 0x6 ? 2
+               : (c >> 4) == 0xE ? 3 : (c >> 3) == 0x1E ? 4 : 1;
+    if (i + n > s.size()) n = 1;
+    out.emplace_back(s.substr(i, n));
+    i += n;
+  }
+  return out;
+}
+
+void bpe_token(Bpe* h, const std::string& tok, std::vector<int>* ids) {
+  {
+    std::shared_lock<std::shared_mutex> lk(h->cache_mu);
+    auto it = h->cache.find(tok);
+    if (it != h->cache.end()) {
+      ids->insert(ids->end(), it->second.begin(), it->second.end());
+      return;
+    }
+  }
+  std::vector<std::string> word = utf8_chars(tok);
+  while (word.size() > 1) {
+    long best_rank = -1;
+    size_t best_i = 0;
+    for (size_t i = 0; i + 1 < word.size(); ++i) {
+      auto r = h->ranks.find({word[i], word[i + 1]});
+      if (r != h->ranks.end() &&
+          (best_rank < 0 || r->second < best_rank)) {
+        best_rank = r->second;
+        best_i = i;
+      }
+    }
+    if (best_rank < 0) break;
+    // merge every occurrence of the best pair (python semantics)
+    const std::string a = word[best_i], b = word[best_i + 1];
+    std::vector<std::string> merged;
+    merged.reserve(word.size());
+    for (size_t i = 0; i < word.size();) {
+      if (i + 1 < word.size() && word[i] == a && word[i + 1] == b) {
+        merged.emplace_back(a + b);
+        i += 2;
+      } else {
+        merged.emplace_back(word[i]);
+        i += 1;
+      }
+    }
+    word.swap(merged);
+  }
+  std::vector<int> toks;
+  for (const auto& piece : word) {
+    auto v = h->vocab.find(piece);
+    if (v != h->vocab.end()) toks.push_back(v->second);
+  }
+  {
+    std::unique_lock<std::shared_mutex> lk(h->cache_mu);
+    h->cache.emplace(tok, toks);
+  }
+  ids->insert(ids->end(), toks.begin(), toks.end());
+}
+
+void encode_text(Bpe* h, const char* text, long len,
+                 std::vector<int>* ids) {
+  const std::string s(text, (size_t)len);
+  size_t start = 0;
+  while (start <= s.size()) {
+    size_t sp = s.find(' ', start);
+    size_t end = (sp == std::string::npos) ? s.size() : sp;
+    if (end > start) bpe_token(h, s.substr(start, end - start), ids);
+    if (sp == std::string::npos) break;
+    start = sp + 1;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// vocab_buf: '\n'-separated token strings, id = line index.
+// merges_buf: '\n'-separated "first second" lines, rank = line index.
+void* ptpu_bpe_create(const char* vocab_buf, long vocab_len,
+                      const char* merges_buf, long merges_len) {
+  auto* h = new Bpe();
+  {
+    const std::string v(vocab_buf, (size_t)vocab_len);
+    size_t start = 0;
+    int id = 0;
+    while (start <= v.size()) {
+      size_t nl = v.find('\n', start);
+      size_t end = (nl == std::string::npos) ? v.size() : nl;
+      if (end > start) h->vocab.emplace(v.substr(start, end - start), id);
+      ++id;
+      if (nl == std::string::npos) break;
+      start = nl + 1;
+    }
+  }
+  {
+    const std::string m(merges_buf, (size_t)merges_len);
+    size_t start = 0;
+    long rank = 0;
+    while (start <= m.size()) {
+      size_t nl = m.find('\n', start);
+      size_t end = (nl == std::string::npos) ? m.size() : nl;
+      if (end > start && m[start] != '#') {  // python skips '#' lines
+        const std::string line = m.substr(start, end - start);
+        size_t sp = line.find(' ');
+        if (sp != std::string::npos) {
+          h->ranks.emplace(
+              std::make_pair(line.substr(0, sp), line.substr(sp + 1)),
+              rank);
+        }
+        ++rank;  // rank counts accepted merge lines only
+      }
+      if (nl == std::string::npos) break;
+      start = nl + 1;
+    }
+  }
+  return h;
+}
+
+void ptpu_bpe_destroy(void* handle) { delete static_cast<Bpe*>(handle); }
+
+// encode one string; returns the id count (truncated to max_out).
+long ptpu_bpe_encode(void* handle, const char* text, long text_len,
+                     int* out, long max_out) {
+  std::vector<int> ids;
+  encode_text(static_cast<Bpe*>(handle), text, text_len, &ids);
+  long n = (long)ids.size() < max_out ? (long)ids.size() : max_out;
+  if (n > 0) std::memcpy(out, ids.data(), (size_t)n * sizeof(int));
+  return (long)ids.size();
+}
+
+// encode n strings packed in `texts` with byte offsets[n+1]; writes ids
+// packed into `out` (capacity max_out) with per-string counts in
+// `counts[n]`. Returns total ids written (or the required capacity if
+// larger than max_out — caller re-invokes with a bigger buffer).
+long ptpu_bpe_encode_batch(void* handle, const char* texts,
+                           const long* offsets, long n, int* out,
+                           long max_out, long* counts) {
+  auto* h = static_cast<Bpe*>(handle);
+  long total = 0;
+  for (long i = 0; i < n; ++i) {
+    std::vector<int> ids;
+    encode_text(h, texts + offsets[i], offsets[i + 1] - offsets[i],
+                &ids);
+    counts[i] = (long)ids.size();
+    if (total + (long)ids.size() <= max_out) {
+      std::memcpy(out + total, ids.data(),
+                  ids.size() * sizeof(int));
+    }
+    total += (long)ids.size();
+  }
+  return total;
+}
+
+}  // extern "C"

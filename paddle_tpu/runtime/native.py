@@ -87,3 +87,48 @@ def gather_stack(arrays):
         sizes[i] = c.nbytes
     lib.pf_gather(out.ctypes.data, srcs, sizes, n)
     return out
+
+
+_BPE_SO = os.path.join(_HERE, "cpp", "libptpu_bpe.so")
+_bpe_lib = None
+
+
+def load_bpe_library():
+    """Load (building if needed) the native BPE tokenizer library;
+    raises ImportError (same contract/locking as load_lib)."""
+    global _bpe_lib
+    with _LOCK:
+        if _bpe_lib is not None:
+            return _bpe_lib
+        if not os.path.exists(_BPE_SO):
+            try:
+                subprocess.run(
+                    ["make", "-C", os.path.dirname(_BPE_SO),
+                     "libptpu_bpe.so"], check=True,
+                    capture_output=True, timeout=120)
+            except subprocess.CalledProcessError as e:
+                raise ImportError(
+                    "native BPE build failed: "
+                    f"{e.stderr.decode(errors='replace')[-500:]}") from e
+            except (OSError, subprocess.SubprocessError) as e:
+                raise ImportError(f"native BPE build failed: {e}") from e
+        try:
+            lib = ctypes.CDLL(_BPE_SO)
+        except OSError as e:
+            raise ImportError(f"native BPE unloadable: {e}") from e
+        lib.ptpu_bpe_create.restype = ctypes.c_void_p
+        lib.ptpu_bpe_create.argtypes = [ctypes.c_char_p, ctypes.c_long,
+                                        ctypes.c_char_p, ctypes.c_long]
+        lib.ptpu_bpe_destroy.argtypes = [ctypes.c_void_p]
+        lib.ptpu_bpe_encode.restype = ctypes.c_long
+        lib.ptpu_bpe_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_long]
+        lib.ptpu_bpe_encode_batch.restype = ctypes.c_long
+        lib.ptpu_bpe_encode_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_long), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long)]
+        _bpe_lib = lib
+        return lib

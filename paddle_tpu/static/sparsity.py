@@ -111,7 +111,16 @@ def decorate(optimizer):
 
         def minimize(self, loss, *args, **kwargs):
             out = self._inner.minimize(loss, *args, **kwargs)
-            _reapply_masks(own or None)
+            from . import program as _prog_mod
+
+            prog = _prog_mod._current_main
+            if prog is not None:
+                # static mode: minimize only RECORDED the update; mask
+                # re-application must replay after each executed step
+                prog._append_thunk(
+                    lambda: _reapply_masks(own or None))
+            else:
+                _reapply_masks(own or None)
             return out
 
     return _ASPOptimizer(optimizer)

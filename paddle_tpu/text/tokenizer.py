@@ -95,3 +95,89 @@ class BpeTokenizer:
     @property
     def vocab_size(self):
         return len(self.encoder)
+
+
+class NativeBpeTokenizer:
+    """BPE tokenizer backed by the native runtime
+    (runtime/cpp/bpe.cc): identical ids to :class:`BpeTokenizer`, but
+    encoding runs in C++ with the GIL released — DataLoader workers and
+    host prefetch tokenize in parallel with device compute. Falls back
+    is the caller's job (construct BpeTokenizer instead)."""
+
+    def __init__(self, vocab_file: str, merges_file: str):
+        import ctypes
+
+        from ..runtime.native import load_bpe_library
+
+        self._lib = load_bpe_library()
+        with open(vocab_file) as f:
+            self.encoder = json.load(f)
+        self.decoder = {v: k for k, v in self.encoder.items()}
+        if any("\n" in tok for tok in self.encoder):
+            raise ValueError("vocab tokens containing newlines are not "
+                             "supported by the native tokenizer")
+        max_id = max(self.encoder.values())
+        lines = [""] * (max_id + 1)
+        for tok, idx in self.encoder.items():
+            lines[idx] = tok
+        vocab_buf = "\n".join(lines).encode("utf-8")
+        # text mode: universal newlines strip \r so CRLF merges files
+        # produce the same ranks as the python tokenizer
+        with open(merges_file) as f:
+            merges_buf = f.read().encode("utf-8")
+        self._h = self._lib.ptpu_bpe_create(
+            vocab_buf, len(vocab_buf), merges_buf, len(merges_buf))
+        self._ctypes = ctypes
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ptpu_bpe_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    @property
+    def vocab_size(self):
+        return len(self.encoder)
+
+    def encode(self, text: str) -> List[int]:
+        ct = self._ctypes
+        data = text.encode("utf-8")
+        cap = max(4 * len(data) + 16, 64)
+        out = (ct.c_int * cap)()
+        n = self._lib.ptpu_bpe_encode(self._h, data, len(data), out, cap)
+        if n > cap:  # pessimistic capacity was too small; retry exact
+            out = (ct.c_int * n)()
+            n = self._lib.ptpu_bpe_encode(self._h, data, len(data),
+                                          out, n)
+        return list(out[:n])
+
+    def encode_batch(self, texts) -> List[List[int]]:
+        ct = self._ctypes
+        blobs = [t.encode("utf-8") for t in texts]
+        packed = b"".join(blobs)
+        offsets = (ct.c_long * (len(blobs) + 1))()
+        pos = 0
+        for i, b in enumerate(blobs):
+            offsets[i] = pos
+            pos += len(b)
+        offsets[len(blobs)] = pos
+        cap = max(4 * pos + 16 * len(blobs), 64)
+        out = (ct.c_int * cap)()
+        counts = (ct.c_long * len(blobs))()
+        total = self._lib.ptpu_bpe_encode_batch(
+            self._h, packed, offsets, len(blobs), out, cap, counts)
+        if total > cap:
+            out = (ct.c_int * total)()
+            total = self._lib.ptpu_bpe_encode_batch(
+                self._h, packed, offsets, len(blobs), out, total, counts)
+        res = []
+        at = 0
+        for i in range(len(blobs)):
+            res.append(list(out[at:at + counts[i]]))
+            at += counts[i]
+        return res
+
+    def decode(self, ids) -> str:
+        return "".join(self.decoder.get(int(i), "") for i in ids)

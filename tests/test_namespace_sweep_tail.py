@@ -135,3 +135,28 @@ def test_require_version_and_build_dir():
         ce.CUDAExtension(sources=["k.cu"])  # no CUDA on the TPU stack
     with pytest.raises(ValueError):
         ce.setup(name="bad", ext_modules=[{"name": "bad"}])
+
+
+def test_asp_decorate_static_mode_reapplies_after_each_run():
+    from paddle_tpu import nn, optimizer as optim, static
+    from paddle_tpu.static import sparsity
+
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        net = nn.Linear(8, 8)
+        masks = sparsity.prune_model(net, n=2, m=4)
+        assert masks
+        x = static.data("asp_x", [4, 8], "float32")
+        loss = (net(x) ** 2).mean()
+        opt = sparsity.decorate(
+            optim.SGD(learning_rate=0.1,
+                      parameters=net.parameters()))
+        opt.minimize(loss)
+    exe = static.Executor()
+    xv = np.random.default_rng(1).standard_normal((4, 8)) \
+        .astype(np.float32)
+    for _ in range(2):
+        exe.run(main, feed={"asp_x": xv}, fetch_list=[loss])
+        groups = net.weight.numpy().reshape(-1, 4)
+        assert ((groups == 0).sum(1) >= 2).all()

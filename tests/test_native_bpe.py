@@ -1,0 +1,119 @@
+"""Native C++ BPE tokenizer: exact id parity with the Python
+BpeTokenizer, batch API, and GIL-released concurrency.
+
+Reference analog: the paddle ecosystem's native faster_tokenizer;
+semantics pinned to text/tokenizer.py::BpeTokenizer.
+"""
+import json
+import random
+import string
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text.tokenizer import BpeTokenizer, NativeBpeTokenizer
+
+
+@pytest.fixture(scope="module")
+def bpe_files(tmp_path_factory):
+    """A small random-but-deterministic BPE vocab over ascii."""
+    rng = random.Random(0)
+    chars = list(string.ascii_lowercase)
+    merges = []
+    pieces = set(chars)
+    for _ in range(120):
+        a = rng.choice(sorted(pieces))
+        b = rng.choice(sorted(pieces))
+        if (a, b) not in [tuple(m.split()) for m in merges] \
+                and len(a + b) <= 6:
+            merges.append(f"{a} {b}")
+            pieces.add(a + b)
+    vocab = {tok: i for i, tok in enumerate(sorted(pieces))}
+    d = tmp_path_factory.mktemp("bpe")
+    (d / "vocab.json").write_text(json.dumps(vocab))
+    (d / "merges.txt").write_text("#version: test\n"
+                                  + "\n".join(merges) + "\n")
+    return str(d / "vocab.json"), str(d / "merges.txt")
+
+
+def _texts(n=50, seed=1):
+    rng = random.Random(seed)
+    return [" ".join("".join(rng.choices(string.ascii_lowercase,
+                                         k=rng.randint(1, 12)))
+                     for _ in range(rng.randint(1, 20)))
+            for _ in range(n)]
+
+
+def test_native_matches_python(bpe_files):
+    py = BpeTokenizer(*bpe_files)
+    nt = NativeBpeTokenizer(*bpe_files)
+    assert nt.vocab_size == py.vocab_size
+    for text in _texts():
+        assert nt.encode(text) == py.encode(text), text
+    t = "hello world"
+    assert nt.decode(nt.encode(t)) == py.decode(py.encode(t))
+
+
+def test_native_batch_matches_single(bpe_files):
+    nt = NativeBpeTokenizer(*bpe_files)
+    texts = _texts(n=30, seed=2)
+    batch = nt.encode_batch(texts)
+    assert batch == [nt.encode(t) for t in texts]
+
+
+def test_native_handles_empty_and_spaces(bpe_files):
+    py = BpeTokenizer(*bpe_files)
+    nt = NativeBpeTokenizer(*bpe_files)
+    for text in ("", " ", "  a  b ", "a", " lead", "trail "):
+        assert nt.encode(text) == py.encode(text), repr(text)
+
+
+def test_native_concurrent_encode_is_correct(bpe_files):
+    """Concurrent encodes on one handle (ctypes releases the GIL; the
+    C++ memo cache takes a shared_mutex) must stay correct."""
+    import os
+    import threading
+
+    nt = NativeBpeTokenizer(*bpe_files)
+    texts = _texts(n=100, seed=3)
+    expected = [nt.encode(t) for t in texts]
+    results = {}
+
+    def work(tid):
+        results[tid] = nt.encode_batch(texts)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for tid, got in results.items():
+        assert got == expected, tid
+    if os.cpu_count() and os.cpu_count() >= 2:
+        import time
+
+        big = _texts(n=200, seed=4) * 20
+
+        def heavy():
+            nt.encode_batch(big)
+
+        t0 = time.perf_counter()
+        heavy()
+        single = time.perf_counter() - t0
+        th = [threading.Thread(target=heavy) for _ in range(2)]
+        t0 = time.perf_counter()
+        for t in th:
+            t.start()
+        for t in th:
+            t.join()
+        dual = time.perf_counter() - t0
+        # serialized would be ~2x; allow wide slack for noisy machines
+        assert dual < 1.9 * single + 0.5, (single, dual)
+
+
+def test_utf8_multibyte(bpe_files):
+    py = BpeTokenizer(*bpe_files)
+    nt = NativeBpeTokenizer(*bpe_files)
+    text = "héllo wörld ζζ"
+    assert nt.encode(text) == py.encode(text)
