@@ -63,7 +63,14 @@ def test_recompute_recomputes_in_backward():
     assert ck.count("dot_general") == plain.count("dot_general") + 1
 
 
-def test_group_sharded_parallel_levels():
+def test_group_sharded_parallel_levels(monkeypatch):
+    # fresh-process semantics: earlier tests in the suite may leave a
+    # non-trivial fleet topology active, which the API (correctly)
+    # refuses to clobber; monkeypatch restores the prior state after
+    import paddle_tpu.distributed.fleet as _fleet
+
+    monkeypatch.setattr(_fleet, "_strategy", None)
+    monkeypatch.setattr(_fleet, "_hcg", None)
     paddle.seed(0)
     from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
 
