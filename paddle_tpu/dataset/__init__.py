@@ -1,3 +1,9 @@
 """paddle.dataset compatibility namespace (reference:
 python/paddle/dataset/__init__.py)."""
 from . import common  # noqa: F401
+
+from ._readers import _install as _install_legacy_readers
+
+_legacy = _install_legacy_readers()
+globals().update(_legacy)
+del _legacy

@@ -1,0 +1,178 @@
+"""Legacy `paddle.dataset.*` reader modules.
+
+Reference: python/paddle/dataset/{mnist,cifar,uci_housing,imdb,
+imikolov,movielens,conll05,flowers,voc2012,wmt14,wmt16}.py — the 1.x
+reader-creator API (`train()`/`test()` return generator factories).
+Deprecated in the reference (empty __all__) but still importable; here
+each module delegates to the 2.x dataset classes
+(paddle_tpu.vision.datasets / paddle_tpu.text.datasets), which download
+when allowed and fall back to deterministic synthetic data offline.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+
+
+def _reader_from_dataset(make_ds, transform=None):
+    def reader():
+        ds = make_ds()
+        for i in range(len(ds)):
+            item = ds[i]
+            yield transform(item) if transform else item
+    return reader
+
+
+def _mnist_sample(item):
+    # legacy readers yield [-1, 1] floats; the 2.x datasets yield
+    # [0, 1] floats (or raw uint8 with transform overrides) — branch on
+    # dtype, not per-sample content
+    img, label = item
+    raw = np.asarray(img)
+    arr = raw.astype(np.float32).reshape(-1)
+    if np.issubdtype(raw.dtype, np.integer):
+        arr = arr / 127.5 - 1.0
+    else:
+        arr = arr * 2.0 - 1.0
+    return arr, int(np.asarray(label).reshape(-1)[0])
+
+
+def _cifar_sample(item):
+    img, label = item
+    raw = np.asarray(img)
+    arr = raw.astype(np.float32).reshape(-1)
+    if np.issubdtype(raw.dtype, np.integer):
+        arr = arr / 255.0
+    return arr, int(np.asarray(label).reshape(-1)[0])
+
+
+def _pair(item):
+    return tuple(np.asarray(x) for x in item)
+
+
+def _module(name):
+    mod = types.ModuleType(f"{__package__}.{name}")
+    mod.__package__ = __package__
+    sys.modules[f"{__package__}.{name}"] = mod
+    return mod
+
+
+def _install():
+    from ..text import datasets as tds
+    from ..vision import datasets as vds
+
+    mnist = _module("mnist")
+    mnist.train = lambda: _reader_from_dataset(
+        lambda: vds.MNIST(mode="train"), _mnist_sample)
+    mnist.test = lambda: _reader_from_dataset(
+        lambda: vds.MNIST(mode="test"), _mnist_sample)
+
+    fashion_mnist = _module("fashion_mnist")
+    fashion_mnist.train = lambda: _reader_from_dataset(
+        lambda: vds.FashionMNIST(mode="train"), _mnist_sample)
+    fashion_mnist.test = lambda: _reader_from_dataset(
+        lambda: vds.FashionMNIST(mode="test"), _mnist_sample)
+
+    cifar = _module("cifar")
+    cifar.train10 = lambda: _reader_from_dataset(
+        lambda: vds.Cifar10(mode="train"), _cifar_sample)
+    cifar.test10 = lambda: _reader_from_dataset(
+        lambda: vds.Cifar10(mode="test"), _cifar_sample)
+    cifar.train100 = lambda: _reader_from_dataset(
+        lambda: vds.Cifar100(mode="train"), _cifar_sample)
+    cifar.test100 = lambda: _reader_from_dataset(
+        lambda: vds.Cifar100(mode="test"), _cifar_sample)
+
+    uci_housing = _module("uci_housing")
+    uci_housing.train = lambda: _reader_from_dataset(
+        lambda: tds.UCIHousing(mode="train"), _pair)
+    uci_housing.test = lambda: _reader_from_dataset(
+        lambda: tds.UCIHousing(mode="test"), _pair)
+    uci_housing.feature_names = [
+        "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS",
+        "RAD", "TAX", "PTRATIO", "B", "LSTAT"]
+
+    imdb = _module("imdb")
+    imdb.train = lambda word_idx=None: _reader_from_dataset(
+        lambda: tds.Imdb(mode="train"), _pair)
+    imdb.test = lambda word_idx=None: _reader_from_dataset(
+        lambda: tds.Imdb(mode="test"), _pair)
+    _imdb_dict_cache = {}
+
+    def _imdb_word_dict():
+        if "d" not in _imdb_dict_cache:
+            _imdb_dict_cache["d"] = getattr(
+                tds.Imdb(mode="train"), "word_idx", {})
+        return _imdb_dict_cache["d"]
+
+    imdb.word_dict = _imdb_word_dict
+
+    imikolov = _module("imikolov")
+    imikolov.train = lambda word_idx=None, n=5: _reader_from_dataset(
+        lambda: tds.Imikolov(mode="train", window_size=n), _pair)
+    imikolov.test = lambda word_idx=None, n=5: _reader_from_dataset(
+        lambda: tds.Imikolov(mode="test", window_size=n), _pair)
+    _imikolov_dict_cache = {}
+
+    def _imikolov_build_dict(min_word_freq=50):
+        if "d" not in _imikolov_dict_cache:
+            _imikolov_dict_cache["d"] = getattr(
+                tds.Imikolov(mode="train"), "word_idx", {})
+        return _imikolov_dict_cache["d"]
+
+    imikolov.build_dict = _imikolov_build_dict
+
+    movielens = _module("movielens")
+    movielens.train = lambda: _reader_from_dataset(
+        lambda: tds.Movielens(mode="train"), _pair)
+    movielens.test = lambda: _reader_from_dataset(
+        lambda: tds.Movielens(mode="test"), _pair)
+
+    conll05 = _module("conll05")
+    conll05.test = lambda: _reader_from_dataset(
+        lambda: tds.Conll05st(), _pair)
+    conll05.get_dict = lambda: ({}, {}, {})
+
+    flowers = _module("flowers")
+
+    def _flowers_reader(mode):
+        def make(mapper=None, buffered_size=1024, use_xmap=True):
+            def transform(item):
+                sample = _cifar_sample(item)
+                return mapper(sample) if mapper is not None else sample
+            return _reader_from_dataset(
+                lambda: vds.Flowers(mode=mode), transform)
+        return make
+
+    flowers.train = _flowers_reader("train")
+    flowers.test = _flowers_reader("test")
+
+    voc2012 = _module("voc2012")
+    voc2012.train = lambda: _reader_from_dataset(
+        lambda: vds.VOC2012(mode="train"), _pair)
+    voc2012.val = lambda: _reader_from_dataset(
+        lambda: vds.VOC2012(mode="valid"), _pair)
+
+    wmt14 = _module("wmt14")
+    wmt14.train = lambda dict_size=30000: _reader_from_dataset(
+        lambda: tds.WMT14(mode="train"), _pair)
+    wmt14.test = lambda dict_size=30000: _reader_from_dataset(
+        lambda: tds.WMT14(mode="test"), _pair)
+
+    wmt16 = _module("wmt16")
+
+    def _wmt16_reader(mode):
+        def make(src_dict_size=30000, trg_dict_size=30000,
+                 src_lang="en"):
+            return _reader_from_dataset(
+                lambda: tds.WMT16(mode=mode), _pair)
+        return make
+
+    wmt16.train = _wmt16_reader("train")
+    wmt16.test = _wmt16_reader("test")
+
+    return {m.__name__.rsplit(".", 1)[-1]: m for m in (
+        mnist, fashion_mnist, cifar, uci_housing, imdb, imikolov,
+        movielens, conll05, flowers, voc2012, wmt14, wmt16)}

@@ -1,0 +1,61 @@
+"""Legacy paddle.dataset.* reader-creator modules.
+
+Reference: python/paddle/dataset/{mnist,cifar,uci_housing,...}.py —
+1.x generator-factory API over the 2.x dataset classes.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import dataset
+
+
+def _first(reader):
+    return next(iter(reader()))
+
+
+def test_mnist_reader_format():
+    img, label = _first(dataset.mnist.train())
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert -1.0 <= float(img.min()) <= float(img.max()) <= 1.0
+    # legacy readers center pixels in [-1, 1], not [0, 1]
+    assert float(img.min()) < -0.5
+    assert isinstance(label, int) and 0 <= label <= 9
+    img2, _ = _first(dataset.mnist.test())
+    assert img2.shape == (784,)
+
+
+def test_cifar_readers():
+    img, label = _first(dataset.cifar.train10())
+    assert img.shape == (3072,) and 0 <= label <= 9
+    _, label100 = _first(dataset.cifar.train100())
+    assert 0 <= label100 <= 99
+
+
+def test_uci_housing_reader():
+    feat, price = _first(dataset.uci_housing.train())
+    assert np.asarray(feat).shape == (13,)
+    assert len(dataset.uci_housing.feature_names) == 13
+
+
+def test_text_readers_yield():
+    assert len(_first(dataset.imikolov.train(n=5))) == 5
+    assert len(_first(dataset.imdb.train())) == 2
+    assert len(_first(dataset.wmt14.train())) == 3
+    assert len(_first(dataset.movielens.train())) >= 2
+
+
+def test_modules_importable():
+    import importlib
+
+    for name in ("mnist", "fashion_mnist", "cifar", "uci_housing",
+                 "imdb", "imikolov", "movielens", "conll05", "flowers",
+                 "voc2012", "wmt14", "wmt16"):
+        m = importlib.import_module(f"paddle_tpu.dataset.{name}")
+        assert m is getattr(dataset, name)
+
+
+def test_reader_is_reiterable():
+    r = dataset.mnist.train()
+    a = [x for _, x in zip(range(3), r())]
+    b = [x for _, x in zip(range(3), r())]
+    assert len(a) == len(b) == 3

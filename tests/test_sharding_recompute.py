@@ -68,9 +68,11 @@ def test_group_sharded_parallel_levels(monkeypatch):
     # non-trivial fleet topology active, which the API (correctly)
     # refuses to clobber; monkeypatch restores the prior state after
     import paddle_tpu.distributed.fleet as _fleet
+    import paddle_tpu.distributed.mesh as _mesh
 
     monkeypatch.setattr(_fleet, "_strategy", None)
     monkeypatch.setattr(_fleet, "_hcg", None)
+    monkeypatch.setattr(_mesh, "_global_mesh", _mesh._global_mesh)
     paddle.seed(0)
     from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
 
