@@ -402,6 +402,44 @@ def _dynamic_slice(ctx, eqn, invals):
     return ctx.node("Slice", [data, start_v, end_v, ctx.i64(axes, "axes")])
 
 
+def _dynamic_update_slice(ctx, eqn, invals):
+    """lax.dynamic_update_slice -> ScatterND: a constant base grid of
+    update-element coordinates shifted by the (clamped) start vector."""
+    op_shape = [int(d) for d in eqn.invars[0].aval.shape]
+    up_shape = [int(d) for d in eqn.invars[1].aval.shape]
+    rank = len(op_shape)
+    if rank == 0:  # scalar DUS is just the update value
+        return ctx.node("Identity", [ctx.read(invals[1], "dus_update")])
+    n_up = int(np.prod(up_shape))
+    if n_up * rank > 5_000_000:
+        raise OnnxExportError(
+            "dynamic_update_slice with a very large update region")
+    data = ctx.read(invals[0], "dus_data")
+    update = ctx.read(invals[1], "dus_update")
+    grid = np.stack(np.meshgrid(
+        *[np.arange(d, dtype=np.int64) for d in up_shape],
+        indexing="ij"), axis=-1) if up_shape else \
+        np.zeros((1,) * rank + (rank,), np.int64)
+    starts = invals[2:]
+    if all(isinstance(s, _Const) for s in starts):
+        st = [min(max(int(s.val), 0), d - u)
+              for s, d, u in zip(starts, op_shape, up_shape)]
+        idx = ctx.initializer(grid + np.asarray(st, np.int64),
+                              "dus_idx")
+    else:
+        parts = []
+        for s, d, u in zip(starts, op_shape, up_shape):
+            nm = ctx.node("Cast", [ctx.read(s, "dus_start")],
+                          to=_ONNX_DTYPE["int64"])
+            nm = ctx.node("Max", [nm, ctx.i64(0, "zero")])
+            nm = ctx.node("Min", [nm, ctx.i64(d - u, "hi")])
+            parts.append(ctx.node("Reshape", [nm, ctx.i64([1], "one")]))
+        start_v = ctx.node("Concat", parts, axis=0)
+        idx = ctx.node("Add", [ctx.initializer(grid, "dus_grid"),
+                               start_v])
+    return ctx.node("ScatterND", [data, idx, update])
+
+
 def _reduce_bool(ctx, eqn, ins, op):
     x = ctx.node("Cast", ins, to=_ONNX_DTYPE["int32"])
     r = ctx.node(op, [x], axes=[int(a) for a in eqn.params["axes"]],
@@ -776,6 +814,8 @@ def _emit(ctx, eqn, invals):
         return [_Name(_gather_node(ctx, eqn, invals))]
     if prim == "dynamic_slice":
         return [_Name(_dynamic_slice(ctx, eqn, invals))]
+    if prim == "dynamic_update_slice":
+        return [_Name(_dynamic_update_slice(ctx, eqn, invals))]
 
     if prim == "split":
         sizes = [int(s) for s in p["sizes"]]

@@ -368,6 +368,18 @@ def _run_node(node, attrs, ins):
         else:
             idx = attrs.get("num_outputs", len(node.output))
         return list(np.split(ins[0], idx, axis=axis))
+    if op == "ScatterND":
+        data, indices, updates = ins[0].copy(), ins[1], ins[2]
+        red = attrs.get("reduction", "none")
+        k = indices.shape[-1]
+        flat_idx = indices.reshape(-1, k)
+        upd = updates.reshape((-1,) + updates.shape[indices.ndim - 1:])
+        where = tuple(flat_idx.T)
+        if red == "add":
+            np.add.at(data, where, upd)
+        else:
+            data[where] = upd
+        return [data]
     if op == "Softmax":
         axis = attrs.get("axis", -1)
         e = np.exp(ins[0] - ins[0].max(axis=axis, keepdims=True))

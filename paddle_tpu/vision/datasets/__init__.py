@@ -97,10 +97,12 @@ class FashionMNIST(MNIST):
 
 
 class Cifar10(Dataset):
+    NUM_CLASSES = 10
+
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=True, backend=None):
         self.transform = transform
-        self.num_classes = 10
+        self.num_classes = self.NUM_CLASSES
         if data_file and os.path.exists(data_file):
             self.images, self.labels = self._load(data_file, mode)
         else:
@@ -132,9 +134,9 @@ class Cifar10(Dataset):
 
 
 class Cifar100(Cifar10):
-    def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
-        self.num_classes = 100
+    # class attribute so the synthetic fallback draws 100-class labels
+    # (setting num_classes after super().__init__ left labels in 0..9)
+    NUM_CLASSES = 100
 
 
 class FakeData(_SyntheticImages):

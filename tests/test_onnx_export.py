@@ -378,3 +378,32 @@ def test_gpt_tiny_export_parity():
     ids = np.random.default_rng(16).integers(0, 256, (1, 12)) \
         .astype(np.int32)
     _roundtrip(gpt, [paddle.to_tensor(ids)], [ids], atol=1e-4)
+
+
+def test_dynamic_update_slice_export():
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    from paddle_tpu.onnx import jaxpr_to_onnx
+    from paddle_tpu.onnx import run as onnx_run
+
+    def f_static(x, u):
+        return lax.dynamic_update_slice(x, u, (1, 2))
+
+    x = jnp.zeros((4, 6), jnp.float32)
+    u = jnp.ones((2, 3), jnp.float32)
+    m = jaxpr_to_onnx(jax.make_jaxpr(f_static)(x, u),
+                      input_names=["x", "u"])
+    (o,) = onnx_run(m, {"x": np.asarray(x), "u": np.asarray(u)})
+    np.testing.assert_allclose(o, np.asarray(f_static(x, u)))
+
+    def f_dyn(x, u, i):
+        return lax.dynamic_update_slice(x, u, (i, i + 1))
+
+    m2 = jaxpr_to_onnx(jax.make_jaxpr(f_dyn)(x, u, jnp.int32(0)),
+                       input_names=["x", "u", "i"])
+    for iv in (0, 1, 5):  # 5 clamps: start limited to dim - size
+        (o,) = onnx_run(m2, {"x": np.asarray(x), "u": np.asarray(u),
+                             "i": np.int32(iv)})
+        np.testing.assert_allclose(
+            o, np.asarray(f_dyn(x, u, jnp.int32(iv))), err_msg=str(iv))
