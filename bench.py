@@ -249,6 +249,44 @@ def bench_ernie_moe(backend):
             "batch": batch, "seqlen": seqlen}
 
 
+def bench_llama_long_context(backend):
+    """Long-context single-chip throughput: same 0.5B llama at seq 8192
+    (batch 1, remat on — activations at 8k don't fit otherwise), flash
+    attention. Exercises the attention kernel's long-sequence tiling."""
+    import paddle_tpu
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.text.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if backend != "tpu":
+        return {"skipped": "tpu only"}
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5504, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=16,
+                      max_position_embeddings=8192, dtype="bfloat16",
+                      remat=True)
+    batch, seqlen, n_steps = 1, 8192, 6
+    fleet.init(is_collective=True, strategy=DistributedStrategy())
+    model = fleet.distributed_model(LlamaForCausalLM(cfg))
+    opt = fleet.distributed_optimizer(
+        optim.AdamW(learning_rate=1e-4,
+                    parameters=model.parameters()))
+    step = opt.make_train_step(model, lambda m, i, l: m(i, labels=l))
+    rng = np.random.default_rng(0)
+    ids = paddle_tpu.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32))
+    labels = paddle_tpu.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32))
+    dt, _ = _timed_steps(lambda: step(ids, labels), n_steps)
+    from paddle_tpu.nn.functional.attention import attention_path
+    return {"tokens_per_sec": round(batch * seqlen * n_steps / dt, 1),
+            "ms_per_step": round(dt / n_steps * 1000, 1),
+            "batch": batch, "seqlen": seqlen,
+            "attention": attention_path()}
+
+
 def bench_int8_matmul(backend):
     """Weight-only int8 MXU matmul vs bf16 at a memory-bound shape
     (small M, large KxN: weight HBM traffic dominates, int8 halves it)."""
@@ -336,6 +374,7 @@ def main():
                          ("bert_base_dp", bench_bert),
                          ("vit_b16", bench_vit),
                          ("ernie_moe_ep", bench_ernie_moe),
+                         ("llama_seq8192", bench_llama_long_context),
                          ("int8_matmul", bench_int8_matmul)):
             if time.perf_counter() - t_start > budget:
                 secondary[name] = {"skipped": "bench time budget exhausted"}

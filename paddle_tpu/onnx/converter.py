@@ -418,8 +418,7 @@ def _dynamic_update_slice(ctx, eqn, invals):
     update = ctx.read(invals[1], "dus_update")
     grid = np.stack(np.meshgrid(
         *[np.arange(d, dtype=np.int64) for d in up_shape],
-        indexing="ij"), axis=-1) if up_shape else \
-        np.zeros((1,) * rank + (rank,), np.int64)
+        indexing="ij"), axis=-1)
     starts = invals[2:]
     if all(isinstance(s, _Const) for s in starts):
         st = [min(max(int(s.val), 0), d - u)

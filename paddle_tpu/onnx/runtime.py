@@ -377,8 +377,11 @@ def _run_node(node, attrs, ins):
         where = tuple(flat_idx.T)
         if red == "add":
             np.add.at(data, where, upd)
-        else:
+        elif red in ("none", ""):
             data[where] = upd
+        else:
+            raise NotImplementedError(
+                f"numpy runtime: ScatterND reduction {red!r}")
         return [data]
     if op == "Softmax":
         axis = attrs.get("axis", -1)

@@ -115,8 +115,16 @@ class Cifar10(Dataset):
     def _load(self, path, mode):
         images, labels = [], []
         with tarfile.open(path) as tf:
+            # CIFAR-10 members: data_batch_*/test_batch;
+            # CIFAR-100 members: train/test
+            if mode == "train":
+                wanted = ("data_batch", "train")
+            else:
+                wanted = ("test_batch", "test")
             names = [n for n in tf.getnames()
-                     if ("data_batch" in n if mode == "train" else "test_batch" in n)]
+                     if any(os.path.basename(n) == w
+                            or os.path.basename(n).startswith(w + "_")
+                            for w in wanted)]
             for name in sorted(names):
                 d = pickle.load(tf.extractfile(name), encoding="bytes")
                 images.append(d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
