@@ -815,6 +815,51 @@ def _emit(ctx, eqn, invals):
         return [_Name(_dynamic_slice(ctx, eqn, invals))]
     if prim == "dynamic_update_slice":
         return [_Name(_dynamic_update_slice(ctx, eqn, invals))]
+    if prim in ("scatter", "scatter-add"):
+        dn = p["dimension_numbers"]
+        k = len(dn.scatter_dims_to_operand_dims)
+        idx_depth = int(eqn.invars[1].aval.shape[-1]) \
+            if eqn.invars[1].aval.shape else 0
+        if (dn.update_window_dims
+                or getattr(dn, "operand_batching_dims", ())
+                or tuple(dn.inserted_window_dims) != tuple(range(k))
+                or tuple(dn.scatter_dims_to_operand_dims)
+                != tuple(range(k))
+                or k != idx_depth):
+            raise OnnxExportError(
+                f"scatter pattern {dn} (only full-prefix scalar "
+                "scatters export)")
+        if prim == "scatter-add" and ctx.opset < 16:
+            raise OnnxExportError(
+                "scatter-add needs ScatterND reduction='add' (opset "
+                ">= 16); pass opset_version=16 to export")
+        data, idx, upd = ins("scat_data", "scat_idx", "scat_upd")
+        if np.dtype(eqn.invars[1].aval.dtype) != np.int64:
+            idx = ctx.node("Cast", [idx], to=_ONNX_DTYPE["int64"])
+        # jax FILL_OR_DROP drops out-of-bounds updates; emulate by
+        # clamping the index and neutralizing the dropped update
+        dims = [int(d) for d in eqn.invars[0].aval.shape[:k]]
+        limit = ctx.i64(dims, "scat_dims")
+        nonneg = ctx.node("GreaterOrEqual", [idx, ctx.i64(0, "zero")])
+        inb = ctx.node("Less", [idx, limit])
+        both = ctx.node("Cast", [ctx.node("And", [nonneg, inb])],
+                        to=_ONNX_DTYPE["int32"])
+        valid = ctx.node("Cast", [ctx.node(
+            "ReduceMin", [both], axes=[-1], keepdims=0)],
+            to=_ONNX_DTYPE["bool"])
+        safe = ctx.node("Max", [ctx.node(
+            "Min", [idx, ctx.i64([d - 1 for d in dims], "scat_hi")]),
+            ctx.i64(0, "zero")])
+        if prim == "scatter-add":  # adding zero == dropped
+            zero = ctx.initializer(
+                np.zeros((), eqn.invars[2].aval.dtype), "scat_zero")
+            upd2 = ctx.node("Where", [valid, upd, zero])
+            return [_Name(ctx.node("ScatterND", [data, safe, upd2],
+                                   reduction="add"))]
+        # overwrite: dropped rows rewrite their current value
+        current = ctx.node("GatherND", [data, safe])
+        upd2 = ctx.node("Where", [valid, upd, current])
+        return [_Name(ctx.node("ScatterND", [data, safe, upd2]))]
 
     if prim == "split":
         sizes = [int(s) for s in p["sizes"]]

@@ -368,6 +368,14 @@ def _run_node(node, attrs, ins):
         else:
             idx = attrs.get("num_outputs", len(node.output))
         return list(np.split(ins[0], idx, axis=axis))
+    if op == "GatherND":
+        data, indices = ins
+        if attrs.get("batch_dims", 0):
+            raise NotImplementedError("numpy runtime: GatherND batch_dims")
+        k = indices.shape[-1]
+        flat = indices.reshape(-1, k)
+        out = data[tuple(flat.T)]
+        return [out.reshape(indices.shape[:-1] + data.shape[k:])]
     if op == "ScatterND":
         data, indices, updates = ins[0].copy(), ins[1], ins[2]
         red = attrs.get("reduction", "none")

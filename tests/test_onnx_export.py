@@ -407,3 +407,40 @@ def test_dynamic_update_slice_export():
                              "i": np.int32(iv)})
         np.testing.assert_allclose(
             o, np.asarray(f_dyn(x, u, jnp.int32(iv))), err_msg=str(iv))
+
+
+def test_scatter_put_along_axis_export():
+    import jax.numpy as jnp
+
+    from paddle_tpu.onnx import OnnxExportError, jaxpr_to_onnx
+    from paddle_tpu.onnx import run as onnx_run
+
+    def f(x, idx, v):
+        return jnp.put_along_axis(x, idx, v, axis=1, inplace=False)
+
+    x = jnp.asarray(np.random.default_rng(20)
+                    .standard_normal((3, 5)), jnp.float32)
+    idx = jnp.asarray([[1], [4], [0]], jnp.int32)
+    v = jnp.asarray([[9.0], [8.0], [7.0]], jnp.float32)
+    m = jaxpr_to_onnx(jax.make_jaxpr(f)(x, idx, v),
+                      input_names=["x", "idx", "v"])
+    (o,) = onnx_run(m, {"x": np.asarray(x), "idx": np.asarray(idx),
+                        "v": np.asarray(v)})
+    np.testing.assert_allclose(o, np.asarray(f(x, idx, v)))
+    # out-of-bounds indices are DROPPED (jax FILL_OR_DROP semantics)
+    oob = np.asarray([[1], [7], [0]], np.int32)
+    (o_oob,) = onnx_run(m, {"x": np.asarray(x), "idx": oob,
+                            "v": np.asarray(v)})
+    np.testing.assert_allclose(
+        o_oob, np.asarray(f(x, jnp.asarray(oob), v)))
+
+    def g(x, idx, v):
+        return x.at[jnp.arange(3), idx.reshape(-1)].add(v.reshape(-1))
+
+    closed = jax.make_jaxpr(g)(x, idx, v)
+    with pytest.raises(OnnxExportError):
+        jaxpr_to_onnx(closed, input_names=["x", "idx", "v"])  # opset 13
+    m2 = jaxpr_to_onnx(closed, input_names=["x", "idx", "v"], opset=16)
+    (o2,) = onnx_run(m2, {"x": np.asarray(x), "idx": np.asarray(idx),
+                          "v": np.asarray(v)})
+    np.testing.assert_allclose(o2, np.asarray(g(x, idx, v)))
