@@ -14,7 +14,9 @@ Execution model (mirrors paddle's dygraph/static split, re-designed for XLA):
 """
 from __future__ import annotations
 
-__version__ = "0.1.0"
+from . import version  # noqa: F401
+from .version import full_version as __version__
+from .version import commit as __git_commit__  # noqa: F401
 
 from .framework import (  # noqa: F401
     CPUPlace, CUDAPlace, TPUPlace, bfloat16, bool_, complex64, complex128,

@@ -1,0 +1,59 @@
+"""Version metadata module.
+
+Reference: the build-generated python/paddle/version.py (full_version,
+major/minor/patch/rc, commit, show(), cuda()/cudnn()/mkl() queries).
+Here the values are static for the TPU build; accelerator queries
+report the XLA/TPU stack instead of CUDA.
+"""
+from __future__ import annotations
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = False
+commit = "tpu-native"
+with_mkl = "OFF"
+
+__all__ = ["full_version", "major", "minor", "patch", "rc", "istaged",
+           "commit", "with_mkl", "show", "mkl", "cuda", "cudnn",
+           "xla", "tpu"]
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"rc: {rc}")
+    print(f"commit: {commit}")
+    print(f"backend: jax/XLA (TPU-native build)")
+
+
+def mkl():
+    return with_mkl
+
+
+def cuda():
+    return "False"  # no CUDA in the TPU build
+
+
+def cudnn():
+    return "False"
+
+
+def xla():
+    import jax
+
+    return jax.__version__
+
+
+def tpu():
+    """Best-effort TPU runtime description (no device init)."""
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return "unknown"
