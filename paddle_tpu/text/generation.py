@@ -50,11 +50,29 @@ def _rms(x, w, eps):
     return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
 
 
+
+
+def _nucleus_filter(logits, top_p):
+    """Top-p (nucleus) mask: keep exactly the smallest set of tokens
+    whose cumulative probability reaches top_p (ties broken by sort
+    order; the highest-prob token is always kept, even for top_p=0)."""
+    order = jnp.argsort(-logits, axis=-1)          # descending
+    sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum_excl = jnp.cumsum(probs, axis=-1) - probs
+    keep_sorted = cum_excl < top_p
+    keep_sorted = keep_sorted.at[..., 0].set(True)  # argmax survives
+    inv = jnp.argsort(order, axis=-1)               # undo the sort
+    keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+    return jnp.where(keep, logits, -jnp.inf)
+
+
 @functools.partial(jax.jit, static_argnames=(
     "n_heads", "n_kv", "eps", "theta", "max_new", "do_sample", "top_k",
-    "eos_id"))
+    "eos_id", "top_p"))
 def _generate_jit(w, input_ids, prompt_len_mask, key, *, n_heads, n_kv, eps,
-                  theta, max_new, do_sample, top_k, eos_id, temperature):
+                  theta, max_new, do_sample, top_k, eos_id, temperature,
+                  top_p=None):
     """input_ids: [B, L0] right-padded prompt; prompt_len_mask [B, L0]
     (1 = real token). Returns [B, L0 + max_new]."""
     B, L0 = input_ids.shape
@@ -111,6 +129,8 @@ def _generate_jit(w, input_ids, prompt_len_mask, key, *, n_heads, n_kv, eps,
         if top_k:
             kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p is not None and top_p < 1.0:
+            logits = _nucleus_filter(logits, top_p)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     key, sk = jax.random.split(key)
@@ -376,9 +396,9 @@ def _ln(x, w, b, eps=1e-5):
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "n_heads", "max_new", "do_sample", "top_k", "eos_id"))
+    "n_heads", "max_new", "do_sample", "top_k", "eos_id", "top_p"))
 def _gpt_generate_jit(w, input_ids, key, *, n_heads, max_new, do_sample,
-                      top_k, eos_id, temperature):
+                      top_k, eos_id, temperature, top_p=None):
     B, L0 = input_ids.shape
     h = w["wte"].shape[1]
     hd = h // n_heads
@@ -435,6 +455,8 @@ def _gpt_generate_jit(w, input_ids, key, *, n_heads, max_new, do_sample,
         if top_k:
             kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p is not None and top_p < 1.0:
+            logits = _nucleus_filter(logits, top_p)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     key, sk = jax.random.split(key)
@@ -494,7 +516,8 @@ def _gpt_generate_jit(w, input_ids, key, *, n_heads, max_new, do_sample,
 def gpt_generate(model, input_ids, max_new_tokens: int = 32,
                  do_sample: bool = False, top_k: int = 0,
                  temperature: float = 1.0,
-                 eos_token_id: Optional[int] = None, seed: int = 0):
+                 eos_token_id: Optional[int] = None, seed: int = 0,
+                 top_p: Optional[float] = None):
     """Greedy / top-k generation for GPTForCausalLM (same static-cache
     design as the Llama path)."""
     ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(
@@ -505,14 +528,16 @@ def gpt_generate(model, input_ids, max_new_tokens: int = 32,
         n_heads=model.config.num_attention_heads,
         max_new=int(max_new_tokens), do_sample=bool(do_sample),
         top_k=int(top_k), eos_id=eos_token_id,
-        temperature=jnp.float32(temperature))
+        temperature=jnp.float32(temperature),
+        top_p=None if top_p is None else float(top_p))
     return Tensor(out)
 
 
 def generate(model, input_ids, max_new_tokens: int = 32,
              do_sample: bool = False, top_k: int = 0,
              temperature: float = 1.0,
-             eos_token_id: Optional[int] = None, seed: int = 0):
+             eos_token_id: Optional[int] = None, seed: int = 0,
+             top_p: Optional[float] = None):
     """Greedy / top-k sampled generation for LlamaForCausalLM.
 
     input_ids: Tensor [B, L0] (no padding between rows' real tokens
@@ -531,5 +556,6 @@ def generate(model, input_ids, max_new_tokens: int = 32,
         n_kv=c.num_key_value_heads, eps=c.rms_norm_eps, theta=c.rope_theta,
         max_new=int(max_new_tokens), do_sample=bool(do_sample),
         top_k=int(top_k), eos_id=eos_token_id,
-        temperature=jnp.float32(temperature))
+        temperature=jnp.float32(temperature),
+        top_p=None if top_p is None else float(top_p))
     return Tensor(out)

@@ -101,11 +101,12 @@ class GPTForCausalLM(Layer):
         return logits
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
-                 top_k=0, temperature=1.0, eos_token_id=None, seed=0):
+                 top_k=0, temperature=1.0, eos_token_id=None, seed=0,
+                 top_p=None):
         """Jitted static-KV-cache decode (text/generation.py gpt path)."""
         from ..generation import gpt_generate
         return gpt_generate(self, input_ids,
                             max_new_tokens=max_new_tokens,
                             do_sample=do_sample, top_k=top_k,
-                            temperature=temperature,
+                            top_p=top_p, temperature=temperature,
                             eos_token_id=eos_token_id, seed=seed)

@@ -273,7 +273,7 @@ class LlamaForCausalLM(Layer):
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  top_k=0, temperature=1.0, eos_token_id=None, seed=0,
-                 num_beams=1, length_penalty=1.0):
+                 num_beams=1, length_penalty=1.0, top_p=None):
         """Jitted autoregressive decode with a static KV cache
         (PaddleNLP GenerationMixin.generate analog; see
         text/generation.py for the TPU design). num_beams > 1 runs beam
@@ -286,7 +286,7 @@ class LlamaForCausalLM(Layer):
                 length_penalty=length_penalty)
         from ..generation import generate as _gen
         return _gen(self, input_ids, max_new_tokens=max_new_tokens,
-                    do_sample=do_sample, top_k=top_k,
+                    do_sample=do_sample, top_k=top_k, top_p=top_p,
                     temperature=temperature, eos_token_id=eos_token_id,
                     seed=seed)
 

@@ -119,3 +119,63 @@ def test_beam_one_equals_greedy():
                                             max_new_tokens=4,
                                             num_beams=1)._data)
     np.testing.assert_array_equal(beam1, greedy)
+
+
+def test_top_p_sampling_restricts_support():
+    """With a tiny top_p every sampled token must be the argmax; the
+    nucleus filter is verified directly against a hand computation."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.text.generation import _nucleus_filter
+    from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    filtered = _nucleus_filter(logits, 0.6)
+    # cum-exclusive: [0, .5, .8, .95] -> keep p<0.6: first two tokens
+    assert bool(jnp.isfinite(filtered[0, 0]))
+    assert bool(jnp.isfinite(filtered[0, 1]))
+    assert not bool(jnp.isfinite(filtered[0, 2]))
+    assert not bool(jnp.isfinite(filtered[0, 3]))
+    # exact nucleus under ties: only ONE of the tied 0.4s survives
+    tied = jnp.log(jnp.asarray([[0.4, 0.4, 0.2]]))
+    ft = _nucleus_filter(tied, 0.3)
+    assert int(jnp.isfinite(ft).sum()) == 1
+    # top_p = 0 still keeps the argmax
+    f0 = _nucleus_filter(logits, 0.0)
+    assert int(jnp.isfinite(f0).sum()) == 1 and bool(
+        jnp.isfinite(f0[0, 0]))
+
+    paddle.seed(0)
+    cfg = dataclasses.replace(LLAMA_TINY, dtype="float32")
+    lm = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(
+        np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (2, 8)).astype(np.int32))
+    greedy = lm.generate(ids, max_new_tokens=6, do_sample=False)
+    tiny_p = lm.generate(ids, max_new_tokens=6, do_sample=True,
+                         top_p=1e-6, seed=3)
+    np.testing.assert_array_equal(greedy.numpy(), tiny_p.numpy())
+    # permissive top_p with sampling still produces valid ids
+    samp = lm.generate(ids, max_new_tokens=6, do_sample=True,
+                       top_p=0.9, seed=3)
+    assert samp.numpy().shape == greedy.numpy().shape
+    assert int(samp.numpy().max()) < cfg.vocab_size
+
+
+def test_top_p_gpt_path():
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=64)
+    gpt = GPTForCausalLM(cfg)
+    ids = paddle.to_tensor(np.random.default_rng(1).integers(
+        0, 256, (2, 6)).astype(np.int32))
+    greedy = gpt.generate(ids, max_new_tokens=5, do_sample=False)
+    tiny_p = gpt.generate(ids, max_new_tokens=5, do_sample=True,
+                          top_p=1e-6, seed=5)
+    np.testing.assert_array_equal(greedy.numpy(), tiny_p.numpy())
