@@ -92,14 +92,17 @@ class _Handle:
 
 
 class Predictor:
-    def __init__(self, config: Config):
+    def __init__(self, config: Config, _shared_layer=None):
         from ..jit.serialization import load as jit_load
-        if config.prog_file() is None:
-            raise ValueError("Config has no model path")
-        path = config.prog_file()
-        if path.endswith(".pdmodel"):
-            path = path[:-len(".pdmodel")]
-        self._layer = jit_load(path)
+        if _shared_layer is not None:
+            self._layer = _shared_layer
+        else:
+            if config.prog_file() is None:
+                raise ValueError("Config has no model path")
+            path = config.prog_file()
+            if path.endswith(".pdmodel"):
+                path = path[:-len(".pdmodel")]
+            self._layer = jit_load(path)
         in_names = getattr(self._layer, "input_names", None) or ["x0"]
         out_names = getattr(self._layer, "output_names", None) or ["out0"]
         in_avals = getattr(self._layer, "input_avals", None)
@@ -227,7 +230,28 @@ def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
         "parameters (layer.to(dtype='bfloat16') + jit.save) instead")
 
 
+class PredictorPool:
+    """A pool of ``size`` predictors sharing one Config, for serving
+    threads that each want a private handle set. Reference:
+    paddle/fluid/inference/api/paddle_infer_contrib (PredictorPool pybind,
+    ``retrive(idx)``). The first predictor loads the artifact; the rest
+    clone it (shared compiled fn + params, private handles)."""
+
+    def __init__(self, config: Config, size: int = 1):
+        if size < 1:
+            raise ValueError("PredictorPool size must be >= 1")
+        first = create_predictor(config)
+        self._preds = [first] + [
+            Predictor(config, _shared_layer=first._layer)
+            for _ in range(int(size) - 1)]
+
+    def retrive(self, idx: int) -> Predictor:
+        return self._preds[int(idx)]
+
+    retrieve = retrive  # spelling-corrected alias
+
+
 __all__ += ["DataType", "PlaceType", "PrecisionType", "BackendType",
             "Tensor", "get_version", "get_trt_compile_version",
             "get_trt_runtime_version", "get_num_bytes_of_data_type",
-            "convert_to_mixed_precision"]
+            "convert_to_mixed_precision", "PredictorPool"]

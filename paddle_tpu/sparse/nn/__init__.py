@@ -1,13 +1,15 @@
 """Sparse nn layers.
 
 Reference: python/paddle/incubate/sparse/nn (ReLU, Softmax, ReLU6,
-LeakyReLU, BatchNorm). Activations operate value-wise; Softmax normalizes
-per CSR row. The reference's sparse Conv3D/SubmConv3D target point-cloud
-workloads on GPU gather-scatter kernels; on TPU dense conv with masking is
-the supported path, so they are intentionally not provided.
+LeakyReLU, BatchNorm, SyncBatchNorm, Conv3D/SubmConv3D, MaxPool3D).
+Activations operate value-wise; Softmax normalizes per CSR row; the conv
+family runs on static numpy rulebooks with dense MXU matmuls per kernel
+offset (see conv.py).
 """
 from . import functional  # noqa: F401
-from .layer import BatchNorm, LeakyReLU, ReLU, ReLU6, Softmax  # noqa: F401
+from .layer import (BatchNorm, Conv3D, LeakyReLU, MaxPool3D,  # noqa: F401
+                    ReLU, ReLU6, Softmax, SubmConv3D, SyncBatchNorm)
 
 __all__ = ['ReLU', 'ReLU6', 'LeakyReLU', 'Softmax', 'BatchNorm',
+           'SyncBatchNorm', 'Conv3D', 'SubmConv3D', 'MaxPool3D',
            'functional']

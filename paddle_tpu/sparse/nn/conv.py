@@ -1,0 +1,256 @@
+"""Sparse 3D convolution / pooling on static rulebooks.
+
+Reference: python/paddle/incubate/sparse/nn/functional/{conv.py,pooling.py}
+and nn/layer/conv.py (Conv3D / SubmConv3D over the GPU gather-scatter
+``final_state_sparse_conv3d`` kernel).
+
+TPU-first design: the sparsity pattern (COO indices) is static host data,
+so the gather/scatter "rulebook" (which input point feeds which output
+point under which kernel offset) is built once in numpy. The device-side
+compute is then a short static unroll over kernel offsets of dense
+``gather -> (nnz_k, Cin) @ (Cin, Cout) -> scatter-add`` — MXU matmuls over
+contiguous value rows, no dynamic shapes, fully jittable and
+differentiable through ``tensor.apply`` (values, weight and bias all ride
+the tape).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor import Tensor, apply
+from ..tensor import SparseCooTensor
+
+
+def _triple(v, name):
+    if isinstance(v, (list, tuple)):
+        out = [int(x) for x in v]
+        if len(out) != 3:
+            raise ValueError(f"{name} must have 3 elements, got {out}")
+        return out
+    return [int(v)] * 3
+
+
+def _padding3(padding, kernel_size, dilation):
+    """Resolve paddle padding spec -> per-dim (front) pad for D/H/W."""
+    if isinstance(padding, str):
+        p = padding.lower()
+        if p == "valid":
+            return [0, 0, 0]
+        if p == "same":
+            return [d * (k - 1) // 2
+                    for k, d in zip(kernel_size, dilation)]
+        raise ValueError(f"unknown padding {padding!r}")
+    if isinstance(padding, int):
+        return [padding] * 3
+    pads = list(padding)
+    if len(pads) == 3 and all(isinstance(p, int) for p in pads):
+        return [int(p) for p in pads]
+
+    def _sym(pairs):
+        out = []
+        for front, back in pairs:
+            if int(front) != int(back):
+                raise ValueError(
+                    "asymmetric padding is not supported for sparse conv: "
+                    f"{padding!r}")
+            out.append(int(front))
+        return out
+
+    if len(pads) == 6:  # front/back per dim, flattened
+        return _sym([(pads[0], pads[1]), (pads[2], pads[3]),
+                     (pads[4], pads[5])])
+    if len(pads) in (4, 5) and all(
+            isinstance(p, (list, tuple)) for p in pads):
+        spatial = pads[1:4] if len(pads) == 5 else pads[:3]
+        return _sym(spatial)
+    raise ValueError(f"unsupported padding spec {padding!r}")
+
+
+def _rulebook(indices, spatial_in, kernel_size, stride, padding, dilation,
+              subm):
+    """Build (out_indices, per-offset [in_row, out_row] pairs).
+
+    ``indices``: (4, nnz) numpy [batch, d, h, w]. Returns the compacted
+    output COO indices (4, n_out) plus, for each kernel offset, the pair of
+    row selectors into the input/output value buffers.
+    """
+    idx = np.asarray(indices)
+    n, coords = idx[0], idx[1:4].T  # (nnz,), (nnz, 3)
+    kd, kh, kw = kernel_size
+    offsets = np.stack(np.meshgrid(np.arange(kd), np.arange(kh),
+                                   np.arange(kw), indexing="ij"),
+                       axis=-1).reshape(-1, 3)
+
+    if subm:
+        out_spatial = list(spatial_in)
+        # output sites == input sites. Cross-correlation (paddle/torch
+        # convention): out[p] += W[off] * x[p + (off - center) * dilation].
+        # Vectorized lookup: ravel every site key, then locate each
+        # shifted neighbor with searchsorted over the sorted key table.
+        out_idx = idx
+        dims = np.asarray([int(n.max()) + 1 if idx.shape[1] else 1,
+                           *spatial_in], np.int64)
+        keys = np.ravel_multi_index(
+            np.concatenate([n[None], coords.T]), dims)
+        order = np.argsort(keys)
+        sorted_keys = keys[order]
+        center = [(k - 1) // 2 for k in kernel_size]
+        pairs = []
+        for off in offsets:
+            rel = (off - center) * np.asarray(dilation)
+            src = coords + rel  # neighbor sampled at this offset
+            ok = np.all((src >= 0) & (src < np.asarray(spatial_in)), axis=1)
+            rows = np.nonzero(ok)[0]
+            src_keys = np.ravel_multi_index(
+                np.concatenate([n[rows, None], src[rows]], axis=1).T, dims)
+            pos = np.searchsorted(sorted_keys, src_keys)
+            pos = np.clip(pos, 0, sorted_keys.size - 1)
+            hit = sorted_keys[pos] == src_keys
+            pairs.append((order[pos[hit]].astype(np.int32),
+                          rows[hit].astype(np.int32)))
+        return out_idx, out_spatial, pairs
+
+    out_spatial = [
+        (s + 2 * p - d * (k - 1) - 1) // st + 1
+        for s, p, d, k, st in zip(spatial_in, padding, dilation,
+                                  kernel_size, stride)]
+    # candidate output coords per (input point, offset)
+    cand_in, cand_out, cand_off = [], [], []
+    st = np.asarray(stride)
+    for oi, off in enumerate(offsets):
+        num = coords + np.asarray(padding) - off * np.asarray(dilation)
+        ok = np.all(num % st == 0, axis=1)
+        o = num // st
+        ok &= np.all((o >= 0) & (o < np.asarray(out_spatial)), axis=1)
+        rows = np.nonzero(ok)[0]
+        if rows.size == 0:
+            cand_in.append(rows.astype(np.int32))
+            cand_out.append(np.zeros((0, 4), np.int64))
+            cand_off.append(oi)
+            continue
+        oc = np.concatenate([n[rows, None], o[rows]], axis=1)
+        cand_in.append(rows.astype(np.int32))
+        cand_out.append(oc.astype(np.int64))
+        cand_off.append(oi)
+
+    all_out = (np.concatenate([c for c in cand_out], axis=0)
+               if cand_out else np.zeros((0, 4), np.int64))
+    if all_out.shape[0] == 0:
+        raise ValueError("sparse conv produced an empty output")
+    dims = np.asarray([int(idx[0].max()) + 1 if idx.shape[1] else 1,
+                       *out_spatial], np.int64)
+    flat = np.ravel_multi_index(all_out.T, dims)
+    uniq, inv = np.unique(flat, return_inverse=True)
+    out_idx = np.stack(np.unravel_index(uniq, dims)).astype(np.int32)
+    pairs, pos = [], 0
+    for rows in cand_in:
+        m = rows.shape[0]
+        pairs.append((rows, inv[pos:pos + m].astype(np.int32)))
+        pos += m
+    return out_idx, out_spatial, pairs
+
+
+def _check_coo(x, name):
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError(f"sparse {name} expects a SparseCooTensor")
+    if len(x.shape) != 5 or x.sparse_dim != 4:
+        raise ValueError(
+            f"sparse {name} expects NDHWC input with 4 sparse dims, got "
+            f"shape {x.shape} sparse_dim {x.sparse_dim}")
+
+
+def _conv3d_impl(x, weight, bias, stride, padding, dilation, groups,
+                 subm, data_format):
+    _check_coo(x, "conv3d")
+    if data_format != "NDHWC":
+        raise ValueError("sparse conv3d supports NDHWC only")
+    if groups != 1:
+        raise ValueError("sparse conv3d supports groups=1 only")
+    kshape = tuple(int(s) for s in weight.shape)
+    if len(kshape) != 5:
+        raise ValueError("weight must be (kd, kh, kw, Cin, Cout)")
+    kernel_size = list(kshape[:3])
+    stride = _triple(stride, "stride")
+    dilation = _triple(dilation, "dilation")
+    padding = _padding3(padding, kernel_size, dilation)
+    if subm and any(s != 1 for s in stride):
+        raise ValueError("subm_conv3d requires stride=1")
+
+    c = x.coalesce()
+    spatial_in = list(x.shape[1:4])
+    out_idx, out_spatial, pairs = _rulebook(
+        np.asarray(c._indices), spatial_in, kernel_size, stride, padding,
+        dilation, subm)
+    n_out = out_idx.shape[1]
+    cout = kshape[4]
+    gathers = [(jnp.asarray(i), jnp.asarray(o)) for i, o in pairs
+               if i.shape[0]]
+    koffsets = [k for k, (i, _) in enumerate(pairs) if i.shape[0]]
+
+    def _compute(vals, w, *maybe_bias):
+        wk = w.reshape(-1, kshape[3], cout)
+        out = jnp.zeros((n_out, cout), vals.dtype)
+        for k, (rows_in, rows_out) in zip(koffsets, gathers):
+            contrib = vals[rows_in] @ wk[k].astype(vals.dtype)
+            out = out.at[rows_out].add(contrib)
+        if maybe_bias:
+            out = out + maybe_bias[0].astype(vals.dtype)
+        return out
+
+    args = (c._values, weight) + ((bias,) if bias is not None else ())
+    out_vals = apply(_compute, *args)
+    out_shape = [x.shape[0], *out_spatial, cout]
+    return SparseCooTensor(out_idx, out_vals, out_shape, coalesced=True)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse 3D convolution over a SparseCooTensor (NDHWC).
+
+    Reference: incubate/sparse/nn/functional/conv.py:conv3d."""
+    return _conv3d_impl(x, weight, bias, stride, padding, dilation, groups,
+                        False, data_format)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold sparse conv: output sites == input sites.
+
+    Reference: incubate/sparse/nn/functional/conv.py:subm_conv3d."""
+    return _conv3d_impl(x, weight, bias, stride, padding, dilation, groups,
+                        True, data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """Sparse 3D max pooling over stored entries only (absent entries do
+    not contribute, matching the reference sparse kernel).
+
+    Reference: incubate/sparse/nn/functional/pooling.py:max_pool3d."""
+    _check_coo(x, "max_pool3d")
+    if data_format != "NDHWC":
+        raise ValueError("sparse max_pool3d supports NDHWC only")
+    if ceil_mode:
+        raise ValueError("ceil_mode is not supported for sparse max_pool3d")
+    kernel_size = _triple(kernel_size, "kernel_size")
+    stride = _triple(stride if stride is not None else kernel_size, "stride")
+    padding = _padding3(padding, kernel_size, [1, 1, 1])
+
+    c = x.coalesce()
+    out_idx, out_spatial, pairs = _rulebook(
+        np.asarray(c._indices), list(x.shape[1:4]), kernel_size, stride,
+        padding, [1, 1, 1], False)
+    n_out = out_idx.shape[1]
+    rows_in = np.concatenate([i for i, _ in pairs])
+    rows_out = np.concatenate([o for _, o in pairs])
+    gi, go = jnp.asarray(rows_in), jnp.asarray(rows_out)
+
+    def _pool(vals):
+        return jax.ops.segment_max(vals[gi], go, num_segments=n_out)
+
+    out_vals = apply(_pool, c._values)
+    out_shape = [x.shape[0], *out_spatial, int(x.shape[4])]
+    return SparseCooTensor(out_idx, out_vals, out_shape, coalesced=True)

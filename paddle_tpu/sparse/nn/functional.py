@@ -12,6 +12,7 @@ import jax.numpy as jnp
 
 from ...tensor import apply
 from ..tensor import SparseCooTensor, SparseCsrTensor, is_sparse
+from .conv import conv3d, max_pool3d, subm_conv3d  # noqa: F401
 
 
 def relu(x, name=None):
@@ -64,3 +65,65 @@ def softmax(x, axis=-1, name=None):
     vals = apply(_softmax, c._values)
     out = SparseCooTensor(c._indices, vals, c.shape, coalesced=True)
     return out.to_sparse_csr() if want_csr else out
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse attention: softmax(QK^T/sqrt(d) restricted to the stored
+    entries of ``sparse_mask``) @ V.
+
+    Reference: incubate/sparse/nn/functional/transformer.py:attention
+    (CUDA-11.7 CSR kernel). TPU-first: the mask layout is static host
+    data, so scores are computed only at the nnz (row, col) sites via
+    dense gathers and normalized with segment reductions — O(nnz) memory
+    instead of O(L^2), fully jittable.
+
+    ``query/key/value``: dense [batch, heads, seqlen, head_dim].
+    ``sparse_mask``: SparseCsrTensor [L, L] or SparseCooTensor with 2
+    sparse dims — the layout shared by every (batch, head) pair (the
+    reference requires identical nnz per batch for the same reason).
+    ``key_padding_mask`` [batch, L] and ``attn_mask`` [L, L] are additive
+    float masks (use -inf to exclude a key).
+    """
+    import numpy as np
+
+    from ..tensor import SparseCooTensor as _Coo, SparseCsrTensor as _Csr
+    if isinstance(sparse_mask, _Csr):
+        coo = sparse_mask.to_sparse_coo()
+    elif isinstance(sparse_mask, _Coo):
+        coo = sparse_mask.coalesce()
+    else:
+        raise TypeError("sparse_mask must be a sparse tensor")
+    if coo.sparse_dim < 2:
+        raise ValueError("sparse_mask needs 2 sparse dims (rows, cols)")
+    rows = jnp.asarray(np.asarray(coo._indices[-2]), jnp.int32)
+    cols = jnp.asarray(np.asarray(coo._indices[-1]), jnp.int32)
+
+    b, h, L, d = (int(s) for s in query.shape)
+    scale = 1.0 / float(np.sqrt(d))
+
+    def _attend(q, k, v, *masks):
+        qf = q.reshape(b * h, L, d)
+        kf = k.reshape(b * h, L, d)
+        vf = v.reshape(b * h, L, d)
+        s = jnp.einsum("ged,ged->ge", qf[:, rows, :], kf[:, cols, :])
+        s = (s * scale).T  # (nnz, BH): segment ops reduce the lead axis
+        mi = 0
+        if key_padding_mask is not None:
+            kp = masks[mi]; mi += 1
+            kp = jnp.repeat(kp.astype(s.dtype), h, axis=0)  # (BH, L)
+            s = s + kp[:, cols].T
+        if attn_mask is not None:
+            am = masks[mi].astype(s.dtype)
+            s = s + am[rows, cols][:, None]
+        smax = jax.ops.segment_max(s, rows, num_segments=L)
+        smax = jnp.where(jnp.isfinite(smax), smax, 0.0)
+        e = jnp.exp(s - smax[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=L)
+        p = e / jnp.maximum(denom[rows], 1e-38)
+        ctx = jnp.zeros((L, b * h, d), p.dtype).at[rows].add(
+            p[:, :, None] * jnp.swapaxes(vf, 0, 1)[cols])
+        return jnp.swapaxes(ctx, 0, 1).reshape(b, h, L, d)
+
+    extra = tuple(m for m in (key_padding_mask, attn_mask) if m is not None)
+    return apply(_attend, query, key, value, *extra)

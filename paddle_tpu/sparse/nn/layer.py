@@ -57,3 +57,99 @@ class BatchNorm(Layer):
             raise TypeError("sparse BatchNorm expects a SparseCooTensor")
         vals = self._bn(x.values())
         return SparseCooTensor(x._indices, vals, x.shape, x._coalesced)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica sparse BN. Like the dense ``nn.SyncBatchNorm``, the
+    per-device statistics are combined by XLA when the batch axis is
+    sharded under pjit; in eager single-process mode it equals BatchNorm.
+    Reference: incubate/sparse/nn/layer/norm.py:SyncBatchNorm."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, BatchNorm) and not isinstance(layer, cls):
+            new = cls(layer._bn._num_features, layer._bn._momentum,
+                      layer._bn._epsilon)
+            new._bn.weight = layer._bn.weight
+            new._bn.bias = layer._bn.bias
+            new._bn._mean = layer._bn._mean
+            new._bn._variance = layer._bn._variance
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class _SparseConv3DBase(Layer):
+    """Reference: incubate/sparse/nn/layer/conv.py:_Conv3D (filter shape
+    (kd, kh, kw, Cin, Cout), NDHWC only, groups=1)."""
+
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode='zeros',
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        import numpy as np
+
+        from ...nn.initializer import KaimingUniform, Uniform
+        from .conv import _triple
+        if groups != 1:
+            raise ValueError("sparse conv supports groups=1 only")
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = _triple(kernel_size, "kernel_size")
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+        fan_in = in_channels * int(np.prod(self._kernel_size))
+        bound = 1.0 / np.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            tuple(self._kernel_size) + (in_channels, out_channels),
+            attr=weight_attr, default_initializer=KaimingUniform(fan_in))
+        self.bias = (self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True,
+            default_initializer=Uniform(-bound, bound))
+            if bias_attr is not False else None)
+
+    def forward(self, x):
+        from .conv import _conv3d_impl
+        return _conv3d_impl(x, self.weight, self.bias, self._stride,
+                            self._padding, self._dilation, self._groups,
+                            self._subm, self._data_format)
+
+    def extra_repr(self):
+        return (f"{self._in_channels}, {self._out_channels}, "
+                f"kernel_size={self._kernel_size}, stride={self._stride}, "
+                f"data_format={self._data_format}")
+
+
+class Conv3D(_SparseConv3DBase):
+    _subm = False
+
+
+class SubmConv3D(_SparseConv3DBase):
+    _subm = True
+
+
+class MaxPool3D(Layer):
+    """Reference: incubate/sparse/nn/layer/pooling.py:MaxPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NDHWC", name=None):
+        super().__init__()
+        if return_mask:
+            raise ValueError("return_mask is not supported for sparse "
+                             "MaxPool3D")
+        self._kernel_size = kernel_size
+        self._stride = stride
+        self._padding = padding
+        self._ceil_mode = ceil_mode
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.max_pool3d(x, self._kernel_size, self._stride,
+                            self._padding, self._ceil_mode,
+                            self._data_format)

@@ -1,0 +1,236 @@
+"""Sparse 3D conv / submanifold conv / max pool / sparse attention.
+
+Oracle: densify the COO input and compare against the dense jax conv /
+pool / full attention restricted to the sparse layout. Reference APIs:
+python/paddle/incubate/sparse/nn/{functional/conv.py,functional/pooling.py,
+functional/transformer.py,layer/conv.py}.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.sparse import sparse_coo_tensor
+from paddle_tpu.sparse.nn import functional as SF
+
+
+def _random_coo(rng, shape, nnz, cin):
+    n, d, h, w, _ = shape
+    seen = set()
+    while len(seen) < nnz:
+        seen.add((int(rng.integers(n)), int(rng.integers(d)),
+                  int(rng.integers(h)), int(rng.integers(w))))
+    idx = np.asarray(sorted(seen), np.int32).T  # (4, nnz)
+    vals = rng.standard_normal((idx.shape[1], cin)).astype(np.float32)
+    return idx, vals
+
+
+def _dense_conv3d_oracle(dense, weight, bias, stride, padding):
+    import jax.lax as lax
+    import jax.numpy as jnp
+    # dense: (N, D, H, W, C); weight: (kd, kh, kw, Cin, Cout)
+    out = lax.conv_general_dilated(
+        jnp.asarray(dense), jnp.asarray(weight),
+        window_strides=(stride,) * 3, padding=[(padding, padding)] * 3,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    if bias is not None:
+        out = out + jnp.asarray(bias)
+    return np.asarray(out)
+
+
+def test_sparse_conv3d_matches_dense():
+    rng = np.random.default_rng(0)
+    shape = (2, 6, 6, 6, 3)
+    idx, vals = _random_coo(rng, shape, nnz=40, cin=3)
+    x = sparse_coo_tensor(idx, vals, shape=shape)
+    w = rng.standard_normal((3, 3, 3, 3, 5)).astype(np.float32) * 0.2
+    b = rng.standard_normal((5,)).astype(np.float32)
+
+    out = SF.conv3d(x, paddle.to_tensor(w), paddle.to_tensor(b),
+                    stride=1, padding=1)
+    dense_in = np.asarray(x.to_dense()._data)
+    ref = _dense_conv3d_oracle(dense_in, w, None, 1, 1)
+    got = np.asarray(out.to_dense()._data)
+    # sparse conv only materializes active output sites; compare there and
+    # check the bias landed on them
+    oi = np.asarray(out.indices()._data)
+    sites = tuple(oi)
+    np.testing.assert_allclose(got[sites], ref[sites] + b, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_sparse_conv3d_stride2_shape():
+    rng = np.random.default_rng(1)
+    shape = (1, 8, 8, 8, 2)
+    idx, vals = _random_coo(rng, shape, nnz=30, cin=2)
+    x = sparse_coo_tensor(idx, vals, shape=shape)
+    w = rng.standard_normal((3, 3, 3, 2, 4)).astype(np.float32)
+    out = SF.conv3d(x, paddle.to_tensor(w), stride=2, padding=1)
+    assert out.shape == [1, 4, 4, 4, 4]
+    dense_in = np.asarray(x.to_dense()._data)
+    ref = _dense_conv3d_oracle(dense_in, w, None, 2, 1)
+    oi = np.asarray(out.indices()._data)
+    np.testing.assert_allclose(
+        np.asarray(out.to_dense()._data)[tuple(oi)], ref[tuple(oi)],
+        rtol=2e-4, atol=2e-4)
+
+
+def test_subm_conv3d_preserves_sites_and_grad():
+    rng = np.random.default_rng(2)
+    shape = (1, 5, 5, 5, 3)
+    idx, vals = _random_coo(rng, shape, nnz=25, cin=3)
+    x = sparse_coo_tensor(idx, vals, shape=shape)
+    x.stop_gradient = False
+
+    from paddle_tpu.sparse.nn import SubmConv3D
+    layer = SubmConv3D(3, 4, kernel_size=3, padding=1)
+    out = layer(x)
+    assert out.shape == list(shape[:4]) + [4]
+    oi = np.sort(np.ravel_multi_index(
+        np.asarray(out.indices()._data), shape[:4]))
+    ii = np.sort(np.ravel_multi_index(idx, shape[:4]))
+    np.testing.assert_array_equal(oi, ii)  # submanifold: sites preserved
+
+    loss = out.values().sum()
+    loss.backward()
+    assert layer.weight.grad is not None
+    assert np.isfinite(np.asarray(layer.weight.grad._data)).all()
+    assert x.values().grad is not None
+
+
+def test_subm_conv3d_values_match_dense_cross_correlation():
+    """Values at active sites equal the dense cross-correlation (paddle
+    orientation, NOT a flipped-kernel true convolution)."""
+    rng = np.random.default_rng(7)
+    shape = (2, 5, 5, 5, 2)
+    idx, vals = _random_coo(rng, shape, nnz=30, cin=2)
+    x = sparse_coo_tensor(idx, vals, shape=shape)
+    w = rng.standard_normal((3, 3, 3, 2, 4)).astype(np.float32)
+
+    out = SF.subm_conv3d(x, paddle.to_tensor(w), padding=1)
+    dense_in = np.asarray(x.to_dense()._data)
+    ref = _dense_conv3d_oracle(dense_in, w, None, 1, 1)
+    oi = np.asarray(out.indices()._data)
+    np.testing.assert_allclose(
+        np.asarray(out.to_dense()._data)[tuple(oi)], ref[tuple(oi)],
+        rtol=2e-4, atol=2e-4)
+
+
+def test_sparse_conv3d_asymmetric_padding_rejected():
+    rng = np.random.default_rng(8)
+    shape = (1, 4, 4, 4, 2)
+    idx, vals = _random_coo(rng, shape, nnz=10, cin=2)
+    x = sparse_coo_tensor(idx, vals, shape=shape)
+    w = rng.standard_normal((3, 3, 3, 2, 2)).astype(np.float32)
+    with pytest.raises(ValueError, match="asymmetric"):
+        SF.conv3d(x, paddle.to_tensor(w), padding=[0, 2, 0, 2, 0, 2])
+    # symmetric 6-element form is accepted
+    out = SF.conv3d(x, paddle.to_tensor(w), padding=[1, 1, 1, 1, 1, 1])
+    assert out.shape[1:4] == [4, 4, 4]
+
+
+def test_sparse_max_pool3d_matches_dense_on_active():
+    rng = np.random.default_rng(3)
+    shape = (1, 4, 4, 4, 2)
+    idx, vals = _random_coo(rng, shape, nnz=20, cin=2)
+    vals = np.abs(vals) + 0.1  # positive: dense zeros never win the max
+    x = sparse_coo_tensor(idx, vals, shape=shape)
+    out = SF.max_pool3d(x, kernel_size=2, stride=2)
+    assert out.shape == [1, 2, 2, 2, 2]
+
+    dense = np.asarray(x.to_dense()._data)
+    ref = dense.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(2, 4, 6))
+    oi = np.asarray(out.indices()._data)
+    np.testing.assert_allclose(
+        np.asarray(out.to_dense()._data)[tuple(oi)],
+        ref[tuple(oi)], rtol=1e-6)
+
+
+def test_sparse_nn_layers_exported():
+    import paddle_tpu.incubate.sparse.nn as spnn
+
+    for name in ("Conv3D", "SubmConv3D", "MaxPool3D", "SyncBatchNorm"):
+        assert hasattr(spnn, name), name
+    for name in ("conv3d", "subm_conv3d", "max_pool3d", "attention"):
+        assert hasattr(spnn.functional, name), name
+
+
+def test_sparse_attention_matches_masked_dense():
+    rng = np.random.default_rng(4)
+    b, h, L, d = 2, 2, 8, 4
+    q, k, v = (rng.standard_normal((b, h, L, d)).astype(np.float32)
+               for _ in range(3))
+    keep = rng.random((L, L)) < 0.5
+    keep[np.arange(L), np.arange(L)] = True  # nonempty rows
+    rows, cols = np.nonzero(keep)
+    mask = sparse_coo_tensor(np.stack([rows, cols]),
+                             np.ones(len(rows), np.float32), shape=(L, L))
+
+    out = SF.attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                       paddle.to_tensor(v), mask)
+    s = np.einsum("bhid,bhjd->bhij", q, k) / np.sqrt(d)
+    s = np.where(keep[None, None], s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = np.einsum("bhij,bhjd->bhid", p, v)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_sparse_attention_key_padding_and_grad():
+    rng = np.random.default_rng(5)
+    b, h, L, d = 1, 2, 6, 4
+    q = paddle.to_tensor(rng.standard_normal((b, h, L, d)).astype(np.float32))
+    k = paddle.to_tensor(rng.standard_normal((b, h, L, d)).astype(np.float32))
+    v = paddle.to_tensor(rng.standard_normal((b, h, L, d)).astype(np.float32))
+    for t in (q, k, v):
+        t.stop_gradient = False
+    rows, cols = np.nonzero(np.ones((L, L), bool))
+    mask = sparse_coo_tensor(np.stack([rows, cols]),
+                             np.ones(len(rows), np.float32), shape=(L, L))
+    kp = np.zeros((b, L), np.float32)
+    kp[:, -2:] = -1e9  # mask the last two keys
+
+    out = SF.attention(q, k, v, mask, key_padding_mask=paddle.to_tensor(kp))
+    qn, kn, vn = (np.asarray(t._data) for t in (q, k, v))
+    s = np.einsum("bhid,bhjd->bhij", qn, kn) / np.sqrt(d) + kp[:, None, None]
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = np.einsum("bhij,bhjd->bhid", p, vn)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=2e-4,
+                               atol=2e-4)
+
+    out.sum().backward()
+    for t in (q, k, v):
+        assert t.grad is not None
+        assert np.isfinite(np.asarray(t.grad._data)).all()
+
+
+def test_predictor_pool():
+    import paddle_tpu.inference as infer
+
+    pytest.importorskip("jax")
+    # build a tiny artifact via jit.save
+    import tempfile
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu import jit
+    from paddle_tpu.static import InputSpec
+
+    net = nn.Linear(4, 3)
+    with tempfile.TemporaryDirectory() as td:
+        path = td + "/m"
+        jit.save(net, path,
+                 input_spec=[InputSpec(shape=[None, 4], dtype="float32")])
+        cfg = infer.Config(path + ".pdmodel", path + ".pdiparams")
+        pool = infer.PredictorPool(cfg, 2)
+        p0, p1 = pool.retrive(0), pool.retrieve(1)
+        assert p0 is not p1
+        x = np.random.default_rng(0).standard_normal((2, 4)).astype(np.float32)
+        outs = []
+        for p in (p0, p1):
+            h = p.get_input_handle(p.get_input_names()[0])
+            h.copy_from_cpu(x)
+            p.run()
+            outs.append(p.get_output_handle(
+                p.get_output_names()[0]).copy_to_cpu())
+        np.testing.assert_allclose(outs[0], outs[1], rtol=1e-6)
