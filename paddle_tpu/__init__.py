@@ -138,3 +138,8 @@ def disable_static(place=None):
 
 def enable_static(place=None):
     return None
+
+
+# 2.3-era `paddle.fluid` compat namespace — imported last: it aliases the
+# packages above.
+from . import fluid  # noqa: E402,F401

@@ -1,0 +1,124 @@
+"""`paddle.fluid` compatibility namespace.
+
+Reference: python/paddle/fluid/__init__.py. 2.3-era user code routinely
+does ``import paddle.fluid as fluid`` and uses the fluid spellings of the
+static-graph builders (`fluid.layers.*`), the dygraph layers
+(`fluid.dygraph.*`), fluid-style optimizers (`fluid.optimizer.
+AdamOptimizer(...).minimize(loss)`) and the Executor/Program workflow.
+This package maps that whole surface onto the TPU-native implementations
+(`paddle_tpu.static` record/replay programs, the eager tape, jnp ops) —
+no separate engine, just the fluid names and signatures.
+"""
+from __future__ import annotations
+
+# framework / program surface ------------------------------------------------
+from ..static import (Program, Scope, Variable,  # noqa: F401
+                      append_backward, cpu_places, cuda_places,
+                      default_main_program, default_startup_program,
+                      device_guard, global_scope, gradients, name_scope,
+                      program_guard, scope_guard)
+from ..static.program import Executor, CompiledProgram  # noqa: F401
+from ..static import ParallelExecutor, BuildStrategy  # noqa: F401
+from ..static import ExecutionStrategy  # noqa: F401
+from ..framework.device import (CPUPlace, CUDAPlace,  # noqa: F401
+                                CUDAPinnedPlace, CustomPlace, IPUPlace,
+                                MLUPlace, NPUPlace, XPUPlace)
+from ..tensor import Tensor  # noqa: F401
+from ..nn.layer_base import ParamAttr  # noqa: F401
+from ..static.program import WeightNormParamAttr  # noqa: F401
+
+# LoDTensor never exists on TPU; dense Tensor carries the surface
+LoDTensor = Tensor
+LoDTensorArray = list
+
+from . import core  # noqa: E402,F401
+from . import framework  # noqa: E402,F401
+from . import executor  # noqa: E402,F401
+from . import backward  # noqa: E402,F401
+from . import initializer  # noqa: E402,F401
+from . import layers  # noqa: E402,F401
+from . import dygraph  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
+from . import regularizer  # noqa: E402,F401
+from . import clip  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+from . import nets  # noqa: E402,F401
+from . import metrics  # noqa: E402,F401
+from . import unique_name  # noqa: E402,F401
+from . import profiler  # noqa: E402,F401
+from . import contrib  # noqa: E402,F401
+
+from .data_feeder import DataFeeder  # noqa: E402,F401
+from .dygraph.base import (enable_dygraph, disable_dygraph,  # noqa: E402,F401
+                           enable_imperative, disable_imperative,
+                           in_dygraph_mode)
+from .dygraph.checkpoint import (load_dygraph,  # noqa: E402,F401
+                                 save_dygraph)
+from .io import (load, load_program_state, save,  # noqa: E402,F401
+                 set_program_state)
+from .input import embedding, one_hot  # noqa: E402,F401
+from ..framework.random_seed import seed as _seed  # noqa: E402
+
+
+class Generator:
+    """Per-device RNG generator shim (reference fluid/generator.py)."""
+
+    def __init__(self, place=None):
+        self._place = place
+
+    def manual_seed(self, seed):
+        _seed(int(seed))
+        return self
+
+
+def _cuda_synchronize(place=None):  # pragma: no cover - trivial
+    return None
+
+
+def install_check():
+    """fluid.install_check.run_check analog lives in utils.run_check."""
+    from ..utils import run_check
+    return run_check()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def get_flags(flags):
+    from ..framework import get_flags as _g
+    return _g(flags)
+
+
+def set_flags(flags):
+    from ..framework import set_flags as _s
+    return _s(flags)
+
+
+__all__ = [
+    'Program', 'Executor', 'CompiledProgram', 'ParallelExecutor', 'Scope',
+    'Variable', 'program_guard', 'default_main_program',
+    'default_startup_program', 'scope_guard', 'global_scope', 'name_scope',
+    'device_guard', 'append_backward', 'gradients', 'cpu_places',
+    'cuda_places', 'CPUPlace', 'CUDAPlace', 'CUDAPinnedPlace', 'XPUPlace',
+    'NPUPlace', 'IPUPlace', 'MLUPlace', 'CustomPlace', 'LoDTensor',
+    'LoDTensorArray', 'Tensor', 'ParamAttr', 'WeightNormParamAttr',
+    'DataFeeder', 'layers', 'dygraph', 'optimizer', 'initializer',
+    'regularizer', 'clip', 'io', 'nets', 'metrics', 'unique_name',
+    'profiler', 'contrib', 'core', 'framework', 'executor', 'backward',
+    'enable_dygraph', 'disable_dygraph', 'enable_imperative',
+    'disable_imperative', 'in_dygraph_mode', 'save', 'load',
+    'save_dygraph', 'load_dygraph', 'load_program_state',
+    'set_program_state', 'embedding', 'one_hot', 'Generator',
+    'install_check', 'is_compiled_with_cuda', 'is_compiled_with_rocm',
+    'is_compiled_with_xpu', 'get_flags', 'set_flags', 'BuildStrategy',
+    'ExecutionStrategy',
+]

@@ -1,0 +1,2 @@
+"""fluid.backward compat (reference python/paddle/fluid/backward.py)."""
+from ..static import append_backward, gradients  # noqa: F401

@@ -1,0 +1,8 @@
+"""fluid.clip compat (reference python/paddle/fluid/clip.py): the fluid
+GradientClipBy* spellings of nn.clip."""
+from ..nn.clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
+                       ClipGradByValue)
+
+GradientClipByGlobalNorm = ClipGradByGlobalNorm
+GradientClipByNorm = ClipGradByNorm
+GradientClipByValue = ClipGradByValue

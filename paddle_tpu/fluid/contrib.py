@@ -1,0 +1,7 @@
+"""fluid.contrib shim: the pieces 2.x-era code reaches for (mixed
+precision decorator) re-exported from paddle_tpu.amp/static.amp."""
+from ..static import amp  # noqa: F401
+
+
+class layers:  # contrib.layers namespace stub
+    pass

@@ -1,0 +1,67 @@
+"""fluid.core shim (reference: the C++ pybind module paddle/fluid/pybind).
+
+Only the names 2.x-era python code actually touches: places, Scope,
+VarDesc dtype enums, and capability queries (reporting the TPU stack)."""
+from __future__ import annotations
+
+from ..framework.device import (CPUPlace, CUDAPinnedPlace,  # noqa: F401
+                                CUDAPlace, CustomPlace, IPUPlace, MLUPlace,
+                                NPUPlace, XPUPlace)
+from ..static import Scope, global_scope  # noqa: F401
+from ..tensor import Tensor  # noqa: F401
+from ..framework import dtype as _dtype_mod
+
+LoDTensor = Tensor
+LoDTensorArray = list
+_Scope = Scope
+
+
+class VarDesc:
+    class VarType:
+        FP16 = "float16"
+        BF16 = "bfloat16"
+        FP32 = "float32"
+        FP64 = "float64"
+        INT8 = "int8"
+        INT16 = "int16"
+        INT32 = "int32"
+        INT64 = "int64"
+        BOOL = "bool"
+        UINT8 = "uint8"
+        COMPLEX64 = "complex64"
+        COMPLEX128 = "complex128"
+        LOD_TENSOR = "lod_tensor"
+        SELECTED_ROWS = "selected_rows"
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def get_cuda_device_count():
+    return 0
+
+
+def globals():  # flag registry (reference core.globals())
+    from ..framework import _flags
+    return _flags() if callable(_flags) else {}

@@ -1,0 +1,16 @@
+"""fluid.data_feeder compat (reference python/paddle/fluid/data_feeder.py):
+DataFeeder converts minibatch rows into the Executor feed dict."""
+import numpy as np
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self._names = [v if isinstance(v, str) else getattr(v, "name", None)
+                       for v in feed_list]
+
+    def feed(self, iterable):
+        cols = list(zip(*iterable))
+        out = {}
+        for name, col in zip(self._names, cols):
+            out[name] = np.stack([np.asarray(c) for c in col])
+        return out

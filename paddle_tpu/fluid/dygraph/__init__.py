@@ -1,0 +1,42 @@
+"""`fluid.dygraph` compatibility: base mode switches, `to_variable`, the
+fluid-era layer classes (Linear/Conv2D/Pool2D/BatchNorm/Embedding/...),
+and save/load_dygraph.
+
+Reference: python/paddle/fluid/dygraph/{base.py,nn.py,layers.py,
+checkpoint.py}. Dygraph IS our native mode (the eager tape), so `guard`
+and enable/disable are bookkeeping only.
+"""
+from __future__ import annotations
+
+from ...nn.layer_base import Layer  # noqa: F401
+from ...nn.layer.container import (LayerList, ParameterList,  # noqa: F401
+                                   Sequential)
+from ...jit.api import to_static as declarative  # noqa: F401
+from ...jit import TracedLayer, ProgramTranslator  # noqa: F401
+from . import base  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .base import (enable_dygraph, disable_dygraph, enabled,  # noqa: F401
+                   guard, no_grad, to_variable, grad)
+from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
+from .nn import (BatchNorm, BilinearTensorProduct, Conv2D,  # noqa: F401
+                 Conv2DTranspose, Dropout, Embedding, GroupNorm, LayerNorm,
+                 Linear, NCE, Pool2D, PRelu, SpectralNorm)
+from .learning_rate_scheduler import (CosineDecay,  # noqa: F401
+                                      ExponentialDecay, InverseTimeDecay,
+                                      NaturalExpDecay, NoamDecay,
+                                      PiecewiseDecay, PolynomialDecay,
+                                      ReduceLROnPlateau, StepDecay,
+                                      MultiStepDecay, LambdaDecay)
+
+__all__ = [
+    'Layer', 'LayerList', 'ParameterList', 'Sequential', 'guard',
+    'to_variable', 'no_grad', 'grad', 'enable_dygraph', 'disable_dygraph',
+    'enabled', 'save_dygraph', 'load_dygraph', 'declarative',
+    'TracedLayer', 'ProgramTranslator', 'Linear', 'Conv2D',
+    'Conv2DTranspose', 'Pool2D', 'BatchNorm', 'Embedding', 'LayerNorm',
+    'GroupNorm', 'SpectralNorm', 'BilinearTensorProduct', 'PRelu', 'NCE',
+    'Dropout', 'NoamDecay', 'PiecewiseDecay', 'NaturalExpDecay',
+    'ExponentialDecay', 'InverseTimeDecay', 'PolynomialDecay',
+    'CosineDecay', 'StepDecay', 'MultiStepDecay', 'LambdaDecay',
+    'ReduceLROnPlateau',
+]

@@ -1,0 +1,70 @@
+"""fluid.dygraph.base: mode switches and to_variable.
+
+Reference: python/paddle/fluid/dygraph/base.py. Eager (dygraph) is the
+native execution model here, so enable/disable only flip a flag that
+`in_dygraph_mode` reports; `guard` is a context manager no-op around it.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ...autograd.tape import no_grad  # noqa: F401
+from ...tensor import Tensor
+
+_dygraph_on = True
+
+
+def enable_dygraph(place=None):
+    global _dygraph_on
+    _dygraph_on = True
+
+
+def disable_dygraph():
+    global _dygraph_on
+    _dygraph_on = False
+
+
+enable_imperative = enable_dygraph
+disable_imperative = disable_dygraph
+
+
+def enabled():
+    return _dygraph_on
+
+
+def in_dygraph_mode():
+    return _dygraph_on
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    global _dygraph_on
+    prev = _dygraph_on
+    enable_dygraph(place)
+    try:
+        yield
+    finally:
+        _dygraph_on = prev
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    """ndarray/list -> Tensor (reference dygraph/base.py:to_variable)."""
+    if isinstance(value, Tensor):
+        return value.astype(dtype) if dtype else value
+    arr = np.asarray(value)
+    if dtype is not None:
+        from ...framework import dtype as dtype_mod
+        arr = arr.astype(dtype_mod.convert_dtype(dtype) or dtype)
+    return Tensor(arr, name=name)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    from ...autograd import grad as _grad
+    return _grad(outputs, inputs, grad_outputs=grad_outputs,
+                 retain_graph=retain_graph, create_graph=create_graph,
+                 only_inputs=only_inputs, allow_unused=allow_unused,
+                 no_grad_vars=no_grad_vars)

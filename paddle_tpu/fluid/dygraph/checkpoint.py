@@ -1,0 +1,33 @@
+"""save_dygraph / load_dygraph (reference fluid/dygraph/checkpoint.py).
+
+``save_dygraph(state_dict, "path")`` writes ``path.pdparams`` (or
+``.pdopt`` for optimizer state); ``load_dygraph("path")`` returns
+``(param_dict, opt_dict)`` with missing halves as None.
+"""
+from __future__ import annotations
+
+import os
+
+
+def save_dygraph(state_dict, model_path):
+    from ...framework.io import save
+    # reference heuristic (fluid/dygraph/checkpoint.py:save_dygraph):
+    # optimizer state dicts carry the LR_Scheduler/master_weights keys or
+    # non-Tensor leaves; a model state_dict is a flat name->Tensor map.
+    # Substring matching on parameter names (e.g. 'beta_proj.weight')
+    # must NOT flip the suffix.
+    is_opt = any(k in state_dict for k in ("LR_Scheduler", "master_weights"))
+    suffix = ".pdopt" if is_opt else ".pdparams"
+    save(state_dict, model_path + suffix)
+
+
+def load_dygraph(model_path, **configs):
+    from ...framework.io import load
+    params, opt = None, None
+    if os.path.exists(model_path + ".pdparams"):
+        params = load(model_path + ".pdparams")
+    if os.path.exists(model_path + ".pdopt"):
+        opt = load(model_path + ".pdopt")
+    if params is None and opt is None:
+        raise ValueError(f"no .pdparams/.pdopt found at {model_path!r}")
+    return params, opt

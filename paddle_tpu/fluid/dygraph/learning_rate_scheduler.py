@@ -1,0 +1,18 @@
+"""fluid.dygraph LR scheduler aliases onto optimizer.lr schedulers.
+
+Reference: python/paddle/fluid/dygraph/learning_rate_scheduler.py. The
+2.x scheduler objects already implement step()/get_lr(); fluid-era code
+passes these as ``learning_rate=`` to fluid optimizers, which the compat
+optimizers accept unchanged.
+"""
+from ...optimizer.lr import (CosineAnnealingDecay as CosineDecay,  # noqa: F401
+                             ExponentialDecay, InverseTimeDecay,
+                             LambdaDecay, MultiStepDecay, NaturalExpDecay,
+                             NoamDecay, PiecewiseDecay, PolynomialDecay,
+                             ReduceOnPlateau as ReduceLROnPlateau,
+                             StepDecay)
+
+__all__ = ['NoamDecay', 'PiecewiseDecay', 'NaturalExpDecay',
+           'ExponentialDecay', 'InverseTimeDecay', 'PolynomialDecay',
+           'CosineDecay', 'StepDecay', 'MultiStepDecay', 'LambdaDecay',
+           'ReduceLROnPlateau']

@@ -1,0 +1,284 @@
+"""fluid.dygraph layer classes with the 1.x/2.0-era constructor
+signatures, implemented over the 2.x layers.
+
+Reference: python/paddle/fluid/dygraph/nn.py (Linear(input_dim,
+output_dim, act=...), Conv2D(num_channels, num_filters, filter_size...),
+Pool2D, BatchNorm(num_channels...), Embedding(size=[v, d])...).
+"""
+from __future__ import annotations
+
+from ... import nn as _nn
+from ...nn import functional as _F
+from ...nn.layer_base import Layer
+
+
+def _act(out, act):
+    return out if act is None else getattr(_F, act)(out)
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        self._linear = _nn.Linear(input_dim, output_dim,
+                                  weight_attr=param_attr,
+                                  bias_attr=bias_attr)
+        self._act = act
+        self.weight = self._linear.weight
+        self.bias = self._linear.bias
+
+    def forward(self, input):
+        return _act(self._linear(input), self._act)
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=None, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__()
+        self._conv = _nn.Conv2D(num_channels, num_filters, filter_size,
+                                stride=stride, padding=padding,
+                                dilation=dilation, groups=groups or 1,
+                                weight_attr=param_attr, bias_attr=bias_attr)
+        self._act = act
+        self.weight = self._conv.weight
+        self.bias = self._conv.bias
+
+    def forward(self, input):
+        return _act(self._conv(input), self._act)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, num_channels, num_filters, filter_size,
+                 output_size=None, padding=0, stride=1, dilation=1,
+                 groups=None, param_attr=None, bias_attr=None,
+                 use_cudnn=True, act=None, dtype="float32"):
+        super().__init__()
+        self._conv = _nn.Conv2DTranspose(
+            num_channels, num_filters, filter_size, stride=stride,
+            padding=padding, dilation=dilation, groups=groups or 1,
+            weight_attr=param_attr, bias_attr=bias_attr)
+        self._act = act
+        self._output_size = output_size
+
+    def forward(self, input):
+        out = self._conv(input, output_size=self._output_size)
+        return _act(out, self._act)
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True, data_format="NCHW"):
+        super().__init__()
+        self._args = (pool_size, pool_type, pool_stride, pool_padding,
+                      global_pooling, ceil_mode, exclusive, data_format)
+
+    def forward(self, input):
+        from ..layers import pool2d
+        (size, ptype, stride, pad, gp, ceil, excl, fmt) = self._args
+        return pool2d(input, size, ptype, stride, pad, gp, True, ceil,
+                      excl, fmt)
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-05, param_attr=None, bias_attr=None,
+                 dtype='float32', data_layout='NCHW', in_place=False,
+                 moving_mean_name=None, moving_variance_name=None,
+                 do_model_average_for_mean_and_var=True,
+                 use_global_stats=False, trainable_statistics=False):
+        super().__init__()
+        self._bn = _nn.BatchNorm2D(num_channels, momentum=momentum,
+                                   epsilon=epsilon, weight_attr=param_attr,
+                                   bias_attr=bias_attr,
+                                   data_format=data_layout)
+        self._act = act
+        if is_test:
+            self._bn.eval()
+
+    def forward(self, input):
+        bn = self._bn
+        if len(input.shape) == 2:
+            bn = self._flat_bn()
+            bn.training = self._bn.training
+        return _act(bn(input), self._act)
+
+    def _flat_bn(self):
+        # rank-2 adapter sharing the 2D layer's params/stats, built once
+        if getattr(self, "_bn1d", None) is None:
+            from ...nn.layer.norm import BatchNorm1D
+            flat = BatchNorm1D(self._bn._num_features,
+                               momentum=self._bn._momentum,
+                               epsilon=self._bn._epsilon)
+            flat.weight, flat.bias = self._bn.weight, self._bn.bias
+            flat._mean, flat._variance = self._bn._mean, self._bn._variance
+            object.__setattr__(self, "_bn1d", flat)
+        return self._bn1d
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype='float32'):
+        super().__init__()
+        self._emb = _nn.Embedding(int(size[0]), int(size[1]),
+                                  padding_idx=padding_idx,
+                                  weight_attr=param_attr)
+        self.weight = self._emb.weight
+
+    def forward(self, input):
+        return self._emb(input)
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True,
+                 epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+                 dtype='float32'):
+        super().__init__()
+        self._ln = _nn.LayerNorm(normalized_shape, epsilon=epsilon,
+                                 weight_attr=param_attr if scale else False,
+                                 bias_attr=bias_attr if shift else False)
+        self._act = act
+
+    def forward(self, input):
+        return _act(self._ln(input), self._act)
+
+
+class GroupNorm(Layer):
+    def __init__(self, channels, groups, epsilon=1e-05, param_attr=None,
+                 bias_attr=None, act=None, data_layout='NCHW'):
+        super().__init__()
+        self._gn = _nn.GroupNorm(groups, channels, epsilon=epsilon,
+                                 weight_attr=param_attr, bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, input):
+        return _act(self._gn(input), self._act)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype='float32'):
+        super().__init__()
+        self._sn = _nn.SpectralNorm(weight_shape, dim=dim,
+                                    power_iters=power_iters, eps=eps)
+
+    def forward(self, weight):
+        return self._sn(weight)
+
+
+class BilinearTensorProduct(Layer):
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None, dtype='float32'):
+        super().__init__()
+        self._bl = _nn.Bilinear(input1_dim, input2_dim, output_dim,
+                                weight_attr=param_attr, bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, x, y):
+        return _act(self._bl(x, y), self._act)
+
+
+class PRelu(Layer):
+    def __init__(self, mode, channel=None, input_shape=None,
+                 param_attr=None, dtype='float32'):
+        super().__init__()
+        if mode == 'all':
+            n = 1
+        elif mode == 'channel':
+            n = int(channel)
+        elif mode == 'element':
+            import numpy as np
+            n = int(np.prod(input_shape[1:]))
+        else:
+            raise ValueError(f"unknown PRelu mode {mode!r}")
+        self._mode = mode
+        self._shape = input_shape
+        self._prelu = _nn.PReLU(num_parameters=n, weight_attr=param_attr)
+
+    def forward(self, input):
+        if self._mode == 'element':
+            from ...tensor import apply
+            w = self._prelu.weight
+            import jax.numpy as jnp
+
+            def _p(x, a):
+                a = a.reshape((1,) + tuple(int(s)
+                                           for s in self._shape[1:]))
+                return jnp.where(x >= 0, x, x * a)
+            return apply(_p, input, w)
+        return self._prelu(input)
+
+
+class NCE(Layer):
+    """Dygraph NCE loss layer (reference fluid/dygraph/nn.py:NCE): BCE on
+    the true class vs `num_neg_samples` noise classes."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=None,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False, dtype='float32'):
+        super().__init__()
+        import numpy as np
+
+        from ...nn.initializer import XavierUniform
+        self._num_total_classes = int(num_total_classes)
+        self._k = int(num_neg_samples or 10)
+        self._seed = seed
+        if custom_dist is not None:
+            probs = np.asarray(custom_dist, np.float64)
+            self._probs = probs / probs.sum()
+        else:
+            self._probs = np.full(num_total_classes,
+                                  1.0 / num_total_classes)
+        self.weight = self.create_parameter(
+            (self._num_total_classes, int(dim)), attr=param_attr,
+            default_initializer=XavierUniform())
+        self.bias = (self.create_parameter(
+            (self._num_total_classes,), attr=bias_attr, is_bias=True)
+            if bias_attr is not False else None)
+
+    def forward(self, input, label, sample_weight=None):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ...tensor import apply
+        rng = np.random.default_rng(self._seed or None)
+        noise = rng.choice(self._num_total_classes, size=self._k,
+                           p=self._probs)
+        noise_j = jnp.asarray(noise)
+        pn = jnp.asarray(self._probs.astype(np.float32))
+
+        def _nce(x, lb, w, *bs):
+            lb = lb.reshape(x.shape[0]).astype(jnp.int32)
+            logit = lambda cls_w, cls_b: jnp.sum(x * cls_w, -1) + cls_b
+            wt = w[lb]
+            bt = bs[0][lb] if bs else 0.0
+            s_true = jnp.sum(x * wt, -1) + bt
+            # logistic loss w/ noise log-prob correction (NCE objective)
+            lt = s_true - jnp.log(self._k * pn[lb])
+            loss = jnp.log1p(jnp.exp(-lt))
+            wn = w[noise_j]
+            bn = bs[0][noise_j] if bs else 0.0
+            s_noise = x @ wn.T + bn
+            ln = s_noise - jnp.log(self._k * pn[noise_j])[None, :]
+            loss = loss + jnp.sum(jnp.log1p(jnp.exp(ln)), -1)
+            return loss[:, None]
+
+        args = (input, label, self.weight) + (
+            (self.bias,) if self.bias is not None else ())
+        return apply(_nce, *args)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, seed=None, is_test=False,
+                 dropout_implementation="downgrade_in_infer"):
+        super().__init__()
+        self._p = p
+        self._mode = dropout_implementation
+        self._is_test = is_test
+
+    def forward(self, input):
+        training = self.training and not self._is_test
+        return _F.dropout(input, p=self._p, training=training,
+                          mode=self._mode)

@@ -1,0 +1,3 @@
+"""fluid.executor compat (reference python/paddle/fluid/executor.py)."""
+from ..static import Scope, global_scope, scope_guard  # noqa: F401
+from ..static.program import Executor  # noqa: F401

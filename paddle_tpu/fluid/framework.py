@@ -1,0 +1,35 @@
+"""fluid.framework compat (reference python/paddle/fluid/framework.py)."""
+from __future__ import annotations
+
+from ..static import (Program, Variable, default_main_program,  # noqa: F401
+                      default_startup_program, device_guard, name_scope,
+                      program_guard)
+from ..nn.layer_base import ParamAttr, Parameter  # noqa: F401
+from ..framework.device import (CPUPlace, CUDAPinnedPlace,  # noqa: F401
+                                CUDAPlace)
+from .dygraph.base import in_dygraph_mode  # noqa: F401
+
+
+def _non_static_mode():
+    return in_dygraph_mode()
+
+
+in_dynamic_mode = in_dygraph_mode
+
+
+class Block:
+    """Placeholder for program blocks; record/replay programs are
+    single-block."""
+
+    def __init__(self, program):
+        self.program = program
+
+
+def get_flags(flags):
+    import paddle_tpu as _p
+    return _p.get_flags(flags)
+
+
+def set_flags(flags):
+    import paddle_tpu as _p
+    return _p.set_flags(flags)

@@ -1,0 +1,26 @@
+"""fluid.initializer compat (reference python/paddle/fluid/initializer.py):
+the fluid spellings (Xavier w/ uniform flag, MSRA, NumpyArrayInitializer)
+over nn.initializer."""
+from ..nn.initializer import (Assign, Bilinear, Constant,  # noqa: F401
+                              KaimingNormal, KaimingUniform, Normal,
+                              TruncatedNormal, Uniform, XavierNormal,
+                              XavierUniform, set_global_initializer)
+
+ConstantInitializer = Constant
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+UniformInitializer = Uniform
+NumpyArrayInitializer = Assign
+BilinearInitializer = Bilinear
+
+
+def Xavier(uniform=True, fan_in=None, fan_out=None, seed=0):
+    return XavierUniform() if uniform else XavierNormal()
+
+
+def MSRA(uniform=True, fan_in=None, seed=0):
+    return KaimingUniform() if uniform else KaimingNormal()
+
+
+XavierInitializer = Xavier
+MSRAInitializer = MSRA

@@ -1,0 +1,2 @@
+"""fluid.input compat (embedding/one_hot free functions)."""
+from .layers import embedding, one_hot  # noqa: F401

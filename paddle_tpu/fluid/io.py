@@ -1,0 +1,92 @@
+"""fluid.io compat (reference python/paddle/fluid/io.py): the 1.x-era
+save/load entry points (dirname + executor signatures) over the static
+save/load machinery, plus DataLoader re-export."""
+from __future__ import annotations
+
+import os
+
+from ..io import DataLoader  # noqa: F401
+from ..static import (load, load_program_state, save,  # noqa: F401
+                      set_program_state)
+from ..static import (deserialize_persistables,  # noqa: F401
+                      deserialize_program, load_vars, normalize_program,
+                      save_vars, serialize_persistables, serialize_program)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    from ..static import default_main_program, save as _save
+    prog = main_program or default_main_program()
+    _save(prog, os.path.join(dirname, filename or "params"))
+
+
+save_persistables = save_params
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    from ..static import default_main_program, load as _load
+    prog = main_program or default_main_program()
+    _load(prog, os.path.join(dirname, filename or "params"))
+
+
+load_persistables = load_params
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    """1.x signature (dirname + feed var NAMES) -> static 2.x
+    save_inference_model (path prefix + feed var objects)."""
+    from ..static import default_main_program
+    from ..static import save_inference_model as _sim
+    prog = main_program or default_main_program()
+    feeds = []
+    for name in feeded_var_names:
+        var = prog._feed_vars.get(name)
+        if var is None:
+            var = prog._vars.get(name)
+        if var is None:
+            raise KeyError(f"feed var {name!r} not found in program")
+        feeds.append(var)
+    os.makedirs(dirname, exist_ok=True)
+    _sim(os.path.join(dirname, "model"), feeds, list(target_vars),
+         executor, program=prog)
+    return [getattr(v, "name", None) for v in target_vars]
+
+
+class _LoadedInferenceProgram:
+    """Program-shaped adapter over the deserialized StableHLO callable so
+    the classic ``exe.run(program, feed=..., fetch_list=fetch_targets)``
+    workflow keeps working (duck-types the Executor.run surface:
+    `_feed_vars` + `_replay`)."""
+
+    def __init__(self, call, feed_names, n_fetch):
+        import jax.numpy as jnp
+
+        from ..tensor import Tensor
+        self._call = call
+        self._names = list(feed_names)
+        self._feed_vars = {n: Tensor(jnp.zeros((1,), jnp.float32))
+                           for n in self._names}
+        self._vars = dict(self._feed_vars)
+        self.fetch_targets = [Tensor(jnp.zeros((1,), jnp.float32))
+                              for _ in range(int(n_fetch))]
+
+    def _replay(self):
+        outs = self._call(*[self._feed_vars[n]._data for n in self._names])
+        if not isinstance(outs, (tuple, list)):
+            outs = [outs]
+        for t, o in zip(self.fetch_targets, outs):
+            t._data = o
+            t._node = None
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """1.x return contract: (program, feed_names, fetch_targets)."""
+    from ..static import load_inference_model as _lim
+    prefix = os.path.join(dirname, "model") \
+        if os.path.isdir(dirname) else dirname
+    call, feed_names, n_fetch = _lim(prefix, executor)
+    prog = _LoadedInferenceProgram(call, feed_names, n_fetch)
+    return prog, feed_names, prog.fetch_targets

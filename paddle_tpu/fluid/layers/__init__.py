@@ -1,0 +1,767 @@
+"""`fluid.layers` compatibility surface.
+
+Reference: python/paddle/fluid/layers/{nn,tensor,math_op_patch,
+control_flow,loss,detection}.py. The fluid spellings and signatures
+(`input=`/`dim=`/`keep_dim=`, `elementwise_add(x, y, axis)`,
+probability-input `cross_entropy`, unreduced per-sample losses,
+`expand(expand_times)` tile semantics, indices-returning `where`) are
+mapped onto the 2.x-style TPU-native ops. Builders (fc/conv2d/...) come
+from `paddle_tpu.static.nn`; control flow from lax-backed
+`static.nn.cond/while_loop`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import tensor_ops as _T
+from ...nn import functional as _F
+from ...static import (Print, data as _static_data,  # noqa: F401
+                       create_global_var, create_parameter, py_func,
+                       accuracy, auc)
+from ...static.nn import (StaticRNN, batch_norm,  # noqa: F401
+                          bilinear_tensor_product, case, cond, conv2d,
+                          conv2d_transpose, conv3d, conv3d_transpose,
+                          crf_decoding, data_norm, deform_conv2d, embedding,
+                          group_norm, instance_norm, layer_norm,
+                          multi_box_head, nce, prelu, row_conv,
+                          sequence_concat, sequence_conv, sequence_enumerate,
+                          sequence_expand, sequence_expand_as,
+                          sequence_first_step, sequence_last_step,
+                          sequence_pad, sequence_pool, sequence_reshape,
+                          sequence_reverse, sequence_scatter, sequence_slice,
+                          sequence_softmax, sequence_unpad, spectral_norm,
+                          switch_case, while_loop)
+import paddle_tpu as _p
+
+from ...static.nn import fc as _static_fc
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """fluid fc spelling (input=/param_attr=/act=) over static.nn.fc."""
+    return _static_fc(input, size, num_flatten_dims=num_flatten_dims,
+                      weight_attr=param_attr, bias_attr=bias_attr,
+                      activation=act, name=name)
+
+
+# -- data ------------------------------------------------------------------
+
+def data(name, shape, dtype='float32', lod_level=0, append_batch_size=True):
+    """fluid.layers.data prepends a -1 batch dim unless told otherwise
+    (reference fluid/layers/io.py:data)."""
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return _static_data(name, shape, dtype)
+
+
+# -- elementwise with fluid axis broadcast ---------------------------------
+
+def _axis_bcast(x, y, axis):
+    """fluid broadcast: y's dims align to x starting at `axis`."""
+    if axis == -1 or not hasattr(y, "ndim") or not hasattr(x, "ndim"):
+        return y
+    extra = x.ndim - axis - y.ndim
+    if extra > 0:
+        y = _T.reshape(y, list(y.shape) + [1] * extra)
+    return y
+
+
+def _act(out, act):
+    if act is None:
+        return out
+    return getattr(_F, act)(out)
+
+
+def _mk_elementwise(fn):
+    def op(x, y, axis=-1, act=None, name=None):
+        return _act(fn(x, _axis_bcast(x, y, axis)), act)
+    return op
+
+
+elementwise_add = _mk_elementwise(_T.add)
+elementwise_sub = _mk_elementwise(_T.subtract)
+elementwise_mul = _mk_elementwise(_T.multiply)
+elementwise_div = _mk_elementwise(_T.divide)
+elementwise_max = _mk_elementwise(_T.maximum)
+elementwise_min = _mk_elementwise(_T.minimum)
+elementwise_pow = _mk_elementwise(_T.pow)
+elementwise_mod = _mk_elementwise(_T.remainder)
+elementwise_floordiv = _mk_elementwise(_T.floor_divide)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    """Flattening matmul (reference fluid/layers/nn.py:mul)."""
+    xs, ys = list(x.shape), list(y.shape)
+    xm = int(np.prod(xs[:x_num_col_dims])) if x_num_col_dims else 1
+    xk = int(np.prod(xs[x_num_col_dims:]))
+    yk = int(np.prod(ys[:y_num_col_dims]))
+    yn = int(np.prod(ys[y_num_col_dims:]))
+    out = _T.matmul(_T.reshape(x, [xm, xk]), _T.reshape(y, [yk, yn]))
+    return _T.reshape(out, xs[:x_num_col_dims] + ys[y_num_col_dims:])
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    out = _T.matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    if alpha != 1.0:
+        out = _T.scale(out, scale=alpha)
+    return out
+
+
+# -- reductions (dim/keep_dim spellings) -----------------------------------
+
+def _mk_reduce(fn):
+    def op(input, dim=None, keep_dim=False, name=None):
+        return fn(input, axis=dim, keepdim=keep_dim)
+    return op
+
+
+reduce_sum = _mk_reduce(_T.sum)
+reduce_mean = _mk_reduce(_T.mean)
+reduce_max = _mk_reduce(_T.max)
+reduce_min = _mk_reduce(_T.min)
+reduce_prod = _mk_reduce(_T.prod)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    return _T.all(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    return _T.any(input, axis=dim, keepdim=keep_dim)
+
+
+def mean(x, name=None):
+    return _T.mean(x)
+
+
+def sum(x):
+    """fluid.layers.sum adds a LIST of tensors (reference tensor.py:sum)."""
+    if isinstance(x, (list, tuple)):
+        return _p.add_n(list(x))
+    return _p.add_n([x])
+
+
+sums = sum
+
+
+# -- unary math ------------------------------------------------------------
+
+abs = _T.abs
+exp = _T.exp
+log = _T.log
+sqrt = _T.sqrt
+rsqrt = _T.rsqrt
+square = _T.square
+sin = _T.sin
+cos = _T.cos
+tan = _T.tan
+asin = _T.asin
+acos = _T.acos
+atan = _T.atan
+sinh = _T.sinh
+cosh = _T.cosh
+floor = _T.floor
+ceil = _T.ceil
+round = _T.round
+reciprocal = _T.reciprocal
+sign = _T.sign
+erf = _T.erf
+log2 = _T.log2
+log10 = _T.log10
+log1p = _T.log1p
+expm1 = _T.expm1
+logsumexp = _T.logsumexp
+cumsum = _T.cumsum
+increment = _T.increment
+scale = _T.scale
+clip = _T.clip
+stanh = _T.stanh if hasattr(_T, "stanh") else None
+
+
+def pow(x, factor=1.0, name=None):
+    return _T.pow(x, factor)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    import jax.numpy as jnp
+
+    from ...tensor import apply
+
+    def _cbn(v):
+        n = jnp.sqrt(jnp.sum(v.astype(jnp.float32) ** 2))
+        return (v * jnp.minimum(1.0, max_norm / jnp.maximum(n, 1e-12))
+                ).astype(v.dtype)
+
+    return apply(_cbn, x)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    return _F.normalize(x, p=2, axis=axis, epsilon=epsilon)
+
+
+# -- activations -----------------------------------------------------------
+
+relu = _F.relu
+relu6 = _F.relu6
+sigmoid = _F.sigmoid
+tanh = _F.tanh
+elu = _F.elu
+gelu = _F.gelu
+softplus = _F.softplus
+softsign = _F.softsign
+softshrink = _F.softshrink
+hard_shrink = _F.hardshrink
+swish = _F.swish
+mish = _F.mish
+maxout = _F.maxout
+log_sigmoid = _F.log_sigmoid
+logsigmoid = _F.log_sigmoid
+thresholded_relu = _F.thresholded_relu
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    """fluid default alpha is 0.02 (2.x F.leaky_relu uses 0.01)."""
+    return _F.leaky_relu(x, negative_slope=alpha)
+
+
+def softmax(input, use_cudnn=True, name=None, axis=-1):
+    return _F.softmax(input, axis=axis)
+
+
+def log_softmax(input, axis=-1, name=None):
+    return _F.log_softmax(input, axis=axis)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return _T.clip(_T.scale(x, scale=slope, bias=offset), 0.0, 1.0)
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    return _T.multiply(
+        x, _T.divide(_T.clip(_T.add(x, _full_like(x, offset)),
+                             0.0, threshold),
+                     _full_like(x, scale)))
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _T.clip(x, t_min, t_max)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return _T.log1p(_T.exp(_T.clip(x, -threshold, threshold)))
+
+
+# -- losses (fluid semantics: per-sample, probability inputs) --------------
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100,
+                  name=None):
+    """fluid cross_entropy takes PROBABILITIES and returns the per-sample
+    loss with a trailing 1 dim (reference fluid/layers/loss.py:1271)."""
+    import jax.numpy as jnp
+
+    from ...tensor import apply
+
+    if soft_label:
+        def _ce_soft(p, q):
+            return -jnp.sum(q * jnp.log(jnp.maximum(p, 1e-12)), axis=-1,
+                            keepdims=True)
+        return apply(_ce_soft, input, label)
+
+    def _ce(p, y):
+        y = y.reshape(p.shape[:-1]).astype(jnp.int32)
+        picked = jnp.take_along_axis(
+            p, jnp.maximum(y, 0)[..., None], axis=-1)[..., 0]
+        loss = -jnp.log(jnp.maximum(picked, 1e-12))
+        loss = jnp.where(y == ignore_index, 0.0, loss)
+        return loss[..., None]
+
+    return apply(_ce, input, label)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    return _F.softmax_with_cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        numeric_stable_mode=numeric_stable_mode,
+        return_softmax=return_softmax, axis=axis)
+
+
+def square_error_cost(input, label):
+    return _T.square(_T.subtract(input, label))
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      name=None, normalize=False):
+    import jax.numpy as jnp
+
+    from ...tensor import apply
+
+    def _bce(logits, lab):
+        loss = (jnp.maximum(logits, 0) - logits * lab
+                + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+        mask = lab != ignore_index
+        loss = jnp.where(mask, loss, 0.0)
+        if normalize:
+            loss = loss / jnp.maximum(jnp.sum(mask), 1)
+        return loss
+
+    return apply(_bce, x, label)
+
+
+def mse_loss(input, label):
+    return _T.mean(_T.square(_T.subtract(input, label)))
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=1.0):
+    import jax.numpy as jnp
+
+    from ...tensor import apply
+
+    def _sl1(a, b, *w):
+        wi = iter(w)
+        iw = next(wi) if inside_weight is not None else 1.0
+        ow = next(wi) if outside_weight is not None else 1.0
+        d = (a - b) * iw
+        s2 = sigma * sigma
+        loss = jnp.where(jnp.abs(d) < 1.0 / s2, 0.5 * d * d * s2,
+                         jnp.abs(d) - 0.5 / s2)
+        return (loss * ow).sum(axis=-1, keepdims=True)
+
+    extra = tuple(w for w in (inside_weight, outside_weight)
+                  if w is not None)
+    return apply(_sl1, x, y, *extra)
+
+
+def kldiv_loss(x, target, reduction='mean', name=None):
+    return _F.kl_div(x, target, reduction=reduction)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    import jax.numpy as jnp
+
+    from ...tensor import apply
+
+    def _ll(p, y):
+        return (-y * jnp.log(p + epsilon)
+                - (1 - y) * jnp.log(1 - p + epsilon))
+
+    return apply(_ll, input, label)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    return _F.label_smooth(label, prior_dist=prior_dist, epsilon=epsilon)
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    return _F.dice_loss(input, label, epsilon=epsilon)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    return _F.npair_loss(anchor, positive, labels, l2_reg=l2_reg)
+
+
+# -- tensor creation / manipulation ----------------------------------------
+
+def _full_like(x, v):
+    return _T.full_like(x, v)
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    t = _T.full(shape, value, dtype=dtype)
+    if out is not None:
+        out._data = t._data
+        return out
+    return t
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    shape = list(shape)
+    shape[output_dim_idx] = int(input.shape[input_dim_idx])
+    return _T.full(shape, value, dtype=dtype)
+
+
+def zeros(shape, dtype='float32', force_cpu=False):
+    return _T.zeros(shape, dtype=dtype)
+
+
+def ones(shape, dtype='float32', force_cpu=False):
+    return _T.ones(shape, dtype=dtype)
+
+
+zeros_like = _T.zeros_like
+ones_like = _T.ones_like
+assign = _T.assign
+cast = _T.cast
+concat = _T.concat
+stack = _T.stack
+unstack = _T.unstack
+split = _T.split
+transpose = _T.transpose
+unique = _T.unique
+shard_index = _T.shard_index if hasattr(_T, "shard_index") else None
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    return _act(_T.reshape(x, shape), act)
+
+
+def squeeze(input, axes=None, name=None):
+    return _T.squeeze(input, axis=axes)
+
+
+def unsqueeze(input, axes, name=None):
+    if isinstance(axes, (list, tuple)) and len(axes) == 1:
+        axes = axes[0]
+    return _T.unsqueeze(input, axis=axes)
+
+
+def expand(x, expand_times, name=None):
+    """fluid expand is TILE (repeat), not broadcast-expand."""
+    return _T.tile(x, expand_times)
+
+
+def expand_as(x, target_tensor, name=None):
+    return _T.expand_as(x, target_tensor)
+
+
+def flatten(x, axis=1, name=None):
+    xs = list(x.shape)
+    lead = int(np.prod(xs[:axis])) if axis else 1
+    return _T.reshape(x, [lead, int(np.prod(xs[axis:]))])
+
+
+def slice(input, axes, starts, ends):
+    return _T.slice(input, axes, starts, ends)
+
+
+def strided_slice(input, axes, starts, ends, strides):
+    return _T.strided_slice(input, axes, starts, ends, strides)
+
+
+def shape(input):
+    return _T.shape(input)
+
+
+def rank(input):
+    return _T.rank(input)
+
+
+def size(input):
+    return _T.numel(input)
+
+
+gather = _T.gather
+gather_nd = _T.gather_nd
+scatter = _T.scatter
+scatter_nd = _T.scatter_nd
+scatter_nd_add = _T.scatter_nd_add
+
+
+def where(condition):
+    """fluid.layers.where returns int64 indices of True entries
+    (reference fluid/layers/nn.py:where == 2.x paddle.nonzero)."""
+    return _T.nonzero(condition)
+
+
+def arange(start, end=None, step=1, dtype='float32'):
+    return _T.arange(start, end, step, dtype=dtype)
+
+
+range = arange
+
+
+def linspace(start, stop, num, dtype='float32'):
+    return _T.linspace(start, stop, num, dtype=dtype)
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype='float32'):
+    t = _T.eye(num_rows, num_columns, dtype=dtype)
+    if batch_shape:
+        for _ in batch_shape:
+            t = _T.unsqueeze(t, axis=0)
+        t = _T.tile(t, list(batch_shape) + [1, 1])
+    return t
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    return _T.zeros([1], dtype=dtype)
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return _F.pad(x, list(paddings), mode='constant', value=pad_value)
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode='constant', pad_value=0.0,
+          data_format="NCHW", name=None):
+    return _F.pad(input, list(paddings), mode=mode.replace('edge',
+                  'replicate'), value=pad_value, data_format=data_format)
+
+
+# -- compare / logical -----------------------------------------------------
+
+def _mk_cmp(fn):
+    def op(x, y, cond=None, name=None):
+        return fn(x, y)
+    return op
+
+
+equal = _mk_cmp(_T.equal)
+not_equal = _mk_cmp(_T.not_equal)
+less_than = _mk_cmp(_T.less_than)
+less_equal = _mk_cmp(_T.less_equal)
+greater_than = _mk_cmp(_T.greater_than)
+greater_equal = _mk_cmp(_T.greater_equal)
+logical_and = _T.logical_and
+logical_or = _T.logical_or
+logical_xor = _T.logical_xor
+logical_not = _T.logical_not
+
+
+def is_empty(x, name=None):
+    return _T.to_tensor(int(np.prod(x.shape)) == 0)
+
+
+def isfinite(x):
+    """fluid isfinite reduces to a scalar (all finite)."""
+    return _T.all(_T.isfinite(x))
+
+
+def has_inf(x):
+    return _T.any(_T.isinf(x))
+
+
+def has_nan(x):
+    return _T.any(_T.isnan(x))
+
+
+# -- search ----------------------------------------------------------------
+
+def argmax(x, axis=0, name=None):
+    return _T.argmax(x, axis=axis)
+
+
+def argmin(x, axis=0, name=None):
+    return _T.argmin(x, axis=axis)
+
+
+def argsort(input, axis=-1, descending=False, name=None):
+    """Returns (sorted_values, indices) as in fluid."""
+    idx = _T.argsort(input, axis=axis, descending=descending)
+    vals = _T.sort(input, axis=axis, descending=descending)
+    return vals, idx
+
+
+def topk(input, k, name=None):
+    return _T.topk(input, k)
+
+
+# -- random ----------------------------------------------------------------
+
+def uniform_random(shape, dtype='float32', min=-1.0, max=1.0, seed=0,
+                   name=None):
+    return _p.uniform(shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype='float32'):
+    return _T.scale(_p.randn(shape, dtype=dtype), scale=std, bias=mean)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation='downgrade_in_infer'):
+    """fluid default is downgrade_in_infer (no train-time upscale)."""
+    return _F.dropout(x, p=dropout_prob, training=not is_test,
+                      mode=dropout_implementation)
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    return _F.one_hot(_T.squeeze(input, axis=-1)
+                      if len(input.shape) > 1 and input.shape[-1] == 1
+                      else input, depth)
+
+
+# -- pooling / vision builders ---------------------------------------------
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, data_format="NCHW", name=None):
+    if global_pooling:
+        axis = [2, 3] if data_format == "NCHW" else [1, 2]
+        if pool_type == "max":
+            return _T.max(input, axis=axis, keepdim=True)
+        return _T.mean(input, axis=axis, keepdim=True)
+    if pool_type == "max":
+        return _F.max_pool2d(input, pool_size, stride=pool_stride,
+                             padding=pool_padding, ceil_mode=ceil_mode,
+                             data_format=data_format)
+    return _F.avg_pool2d(input, pool_size, stride=pool_stride,
+                         padding=pool_padding, ceil_mode=ceil_mode,
+                         exclusive=exclusive, data_format=data_format)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, data_format="NCDHW", name=None):
+    if global_pooling:
+        axis = [2, 3, 4] if data_format == "NCDHW" else [1, 2, 3]
+        if pool_type == "max":
+            return _T.max(input, axis=axis, keepdim=True)
+        return _T.mean(input, axis=axis, keepdim=True)
+    if pool_type == "max":
+        return _F.max_pool3d(input, pool_size, stride=pool_stride,
+                             padding=pool_padding, ceil_mode=ceil_mode,
+                             data_format=data_format)
+    return _F.avg_pool3d(input, pool_size, stride=pool_stride,
+                         padding=pool_padding, ceil_mode=ceil_mode,
+                         exclusive=exclusive, data_format=data_format)
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    if pool_type == "max":
+        return _F.adaptive_max_pool2d(input, pool_size)
+    return _F.adaptive_avg_pool2d(input, pool_size)
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample='BILINEAR', actual_shape=None, align_corners=True,
+                 align_mode=1, data_format='NCHW'):
+    mode = {'BILINEAR': 'bilinear', 'NEAREST': 'nearest',
+            'TRILINEAR': 'trilinear', 'BICUBIC': 'bicubic'}[resample]
+    return _F.interpolate(input, size=out_shape, scale_factor=scale,
+                          mode=mode, align_corners=align_corners,
+                          align_mode=align_mode, data_format=data_format)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1,
+                    data_format='NCHW'):
+    return image_resize(input, out_shape, scale, name, 'BILINEAR',
+                        actual_shape, align_corners, align_mode, data_format)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True,
+                   data_format='NCHW'):
+    return image_resize(input, out_shape, scale, name, 'NEAREST',
+                        actual_shape, align_corners, 1, data_format)
+
+
+def pixel_shuffle(x, upscale_factor):
+    return _F.pixel_shuffle(x, upscale_factor)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    return _F.unfold(x, kernel_sizes, strides=strides, paddings=paddings,
+                     dilations=dilations)
+
+
+def affine_grid(theta, out_shape, name=None):
+    return _F.affine_grid(theta, out_shape)
+
+
+def grid_sampler(x, grid, name=None):
+    return _F.grid_sample(x, grid)
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None,
+              name=None):
+    from ...vision.ops import roi_align as _ra
+    return _ra(input, rois, boxes_num=rois_num,
+               output_size=(pooled_height, pooled_width),
+               spatial_scale=spatial_scale, sampling_ratio=sampling_ratio)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0):
+    from ...vision.ops import yolo_box as _yb
+    return _yb(x, img_size, anchors, class_num, conf_thresh,
+               downsample_ratio, clip_bbox=clip_bbox, scale_x_y=scale_x_y)
+
+
+# -- lod / array ops (python-list TensorArray; eager + recorded programs) --
+
+def create_array(dtype='float32'):
+    return []
+
+
+def array_write(x, i, array=None):
+    idx = int(np.asarray(i._data if hasattr(i, "_data") else i))
+    if array is None:
+        array = []
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    return array[int(np.asarray(i._data if hasattr(i, "_data") else i))]
+
+
+def array_length(array):
+    return _T.to_tensor(np.int64(len(array)))
+
+
+# -- lr decay schedules (return 2.x schedulers; pass as learning_rate) -----
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    from ...optimizer.lr import NoamDecay
+    return NoamDecay(d_model, warmup_steps, learning_rate=learning_rate)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    from ...static import exponential_decay as _ed
+    return _ed(learning_rate, decay_steps, decay_rate, staircase)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    from ...optimizer.lr import NaturalExpDecay
+    return NaturalExpDecay(learning_rate, decay_rate / decay_steps
+                           if staircase is False else decay_rate)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    """lr / (1 + decay_rate * step / decay_steps) — fold decay_steps into
+    the per-step gamma (reference fluid/layers/learning_rate_scheduler.py)."""
+    from ...optimizer.lr import InverseTimeDecay
+    return InverseTimeDecay(learning_rate, decay_rate / decay_steps)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    from ...optimizer.lr import PolynomialDecay
+    return PolynomialDecay(learning_rate, decay_steps, end_learning_rate,
+                           power, cycle)
+
+
+def piecewise_decay(boundaries, values):
+    from ...optimizer.lr import PiecewiseDecay
+    return PiecewiseDecay(boundaries, values)
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    from ...optimizer.lr import CosineAnnealingDecay
+    return CosineAnnealingDecay(learning_rate, step_each_epoch * epochs)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    from ...optimizer.lr import LinearWarmup
+    base = learning_rate
+    if not hasattr(base, "get_lr"):
+        from ...optimizer.lr import LRScheduler  # noqa: F401
+    return LinearWarmup(learning_rate, warmup_steps, start_lr, end_lr)
+
+
+# deprecated aliases some 2.x-era code still touches
+sigmoid_focal_loss = _F.sigmoid_focal_loss
+sequence_mask = _F.sequence_mask
+gather_tree = _F.gather_tree
+temporal_shift = _F.temporal_shift
+diag_embed = _F.diag_embed

@@ -1,0 +1,54 @@
+"""fluid.metrics compat (reference python/paddle/fluid/metrics.py) over
+paddle_tpu.metric."""
+import numpy as np
+
+from ..metric import Accuracy as _Acc, Auc as _Auc  # noqa: F401
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *a, **k):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class Accuracy(MetricBase):
+    """Streaming accuracy fed with (value, weight) pairs as in fluid."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight=1):
+        self.value += float(np.asarray(value).sum()) * weight
+        self.weight += weight
+
+    def eval(self):
+        return self.value / max(self.weight, 1e-12)
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]

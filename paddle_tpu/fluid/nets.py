@@ -1,0 +1,74 @@
+"""fluid.nets compat (reference python/paddle/fluid/nets.py): the classic
+composite builders over fluid.layers."""
+from __future__ import annotations
+
+from . import layers
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type='max',
+                         global_pooling=False, conv_stride=1,
+                         conv_padding=0, conv_dilation=1, conv_groups=1,
+                         param_attr=None, bias_attr=None, act=None,
+                         use_cudnn=True):
+    conv_out = layers.conv2d(input, num_filters=num_filters,
+                             filter_size=filter_size, stride=conv_stride,
+                             padding=conv_padding, dilation=conv_dilation,
+                             groups=conv_groups, param_attr=param_attr,
+                             bias_attr=bias_attr, act=act)
+    return layers.pool2d(conv_out, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride,
+                         pool_padding=pool_padding,
+                         global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    tmp = input
+    for i, nf in enumerate(conv_num_filter):
+        pad = conv_padding if isinstance(conv_padding, int) \
+            else conv_padding[i]
+        fs = conv_filter_size if isinstance(conv_filter_size, int) \
+            else conv_filter_size[i]
+        tmp = layers.conv2d(tmp, num_filters=nf, filter_size=fs,
+                            padding=pad, param_attr=param_attr,
+                            act=None if conv_with_batchnorm else conv_act)
+        if conv_with_batchnorm:
+            tmp = layers.batch_norm(tmp, act=conv_act)
+            rate = conv_batchnorm_drop_rate if isinstance(
+                conv_batchnorm_drop_rate, float) \
+                else conv_batchnorm_drop_rate[i]
+            if rate > 0:
+                tmp = layers.dropout(tmp, dropout_prob=rate)
+    return layers.pool2d(tmp, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride)
+
+
+def glu(input, dim=-1):
+    a, b = layers.split(input, num_or_sections=2, axis=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    from ..nn import functional as F
+    q, k, v = queries, keys, values
+    if num_heads > 1:
+        def split_heads(x):
+            b, t, d = x.shape
+            x = layers.reshape(x, [b, t, num_heads, d // num_heads])
+            return layers.transpose(x, [0, 2, 1, 3])
+        q, k, v = map(split_heads, (q, k, v))
+    d = int(q.shape[-1])
+    scores = layers.matmul(q, k, transpose_y=True, alpha=d ** -0.5)
+    weights = F.softmax(scores)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    out = layers.matmul(weights, v)
+    if num_heads > 1:
+        out = layers.transpose(out, [0, 2, 1, 3])
+        b, t = int(out.shape[0]), int(out.shape[1])
+        out = layers.reshape(out, [b, t, -1])
+    return out

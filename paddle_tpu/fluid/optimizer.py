@@ -1,0 +1,132 @@
+"""fluid-style optimizers: `XxxOptimizer(learning_rate, parameter_list,
+regularization, grad_clip)` with `.minimize(loss)`.
+
+Reference: python/paddle/fluid/optimizer.py. Thin signature adapters over
+the 2.x optimizers (parameter_list -> parameters, regularization ->
+weight_decay); `minimize` is inherited (eager backward+step, or deferred
+to Executor.run inside a recorded static program).
+"""
+from __future__ import annotations
+
+from .. import optimizer as _opt
+from ..incubate.optimizer import LookAhead, ModelAverage  # noqa: F401
+from ..static import ExponentialMovingAverage  # noqa: F401
+
+
+def _map_kwargs(parameter_list, regularization, grad_clip, kwargs):
+    out = dict(kwargs)
+    if parameter_list is not None:
+        out["parameters"] = parameter_list
+    if regularization is not None:
+        out["weight_decay"] = regularization
+    if grad_clip is not None:
+        out["grad_clip"] = grad_clip
+    return out
+
+
+def _fluid_opt(base, extra_map=()):
+    extra_map = dict(extra_map)
+
+    class _Opt(base):
+        def __init__(self, learning_rate, parameter_list=None,
+                     regularization=None, grad_clip=None, name=None,
+                     **kwargs):
+            for old, new in extra_map.items():
+                if old in kwargs:
+                    kwargs[new] = kwargs.pop(old)
+            super().__init__(
+                learning_rate=learning_rate,
+                **_map_kwargs(parameter_list, regularization, grad_clip,
+                              kwargs))
+
+        def minimize(self, loss, startup_program=None, parameter_list=None,
+                     no_grad_set=None):
+            """fluid dygraph pattern is `loss.backward();
+            opt.minimize(loss)` — minimize only APPLIES existing grads
+            (reference fluid/optimizer.py dygraph branch collects
+            param._grad_ivar()). Falls back to backward+step when no
+            grads are populated yet."""
+            from ..static import program as _prog
+            if _prog._current_main is not None:
+                if self._parameter_list is None:
+                    # classic fluid: optimizer without a parameter list
+                    # optimizes every parameter of the current program
+                    self._parameter_list = list(
+                        _prog._current_main.all_parameters())
+                return super().minimize(loss, startup_program,
+                                        parameter_list, no_grad_set)
+            if any(p.grad is not None for p in self._all_params()):
+                self.step()
+                return None, None
+            return super().minimize(loss, startup_program, parameter_list,
+                                    no_grad_set)
+
+    _Opt.__name__ = base.__name__ + "Optimizer"
+    _Opt.__qualname__ = _Opt.__name__
+    return _Opt
+
+
+SGDOptimizer = _fluid_opt(_opt.SGD)
+MomentumOptimizer = _fluid_opt(_opt.Momentum)
+AdagradOptimizer = _fluid_opt(_opt.Adagrad)
+AdamOptimizer = _fluid_opt(_opt.Adam)
+AdamaxOptimizer = _fluid_opt(_opt.Adamax)
+AdadeltaOptimizer = _fluid_opt(_opt.Adadelta)
+RMSPropOptimizer = _fluid_opt(_opt.RMSProp)
+LambOptimizer = _fluid_opt(_opt.Lamb, {"lamb_weight_decay": "lamb_weight_decay"})
+LarsMomentumOptimizer = MomentumOptimizer  # LARS layerwise scaling n/a
+DecayedAdagradOptimizer = AdagradOptimizer
+DpsgdOptimizer = SGDOptimizer
+
+# bare aliases (fluid exports both spellings)
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Lamb = LambOptimizer
+
+
+class RecomputeOptimizer:
+    """Wrapper marking checkpoints for recompute (reference
+    fluid/optimizer.py:RecomputeOptimizer). Gradient rematerialization is
+    jax.checkpoint's job here; the wrapper preserves the API and routes
+    minimize to the inner optimizer."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._inner.minimize(loss, startup_program, parameter_list,
+                                    no_grad_set)
+
+
+class PipelineOptimizer:
+    """API shim (reference fluid/optimizer.py:PipelineOptimizer); real
+    pipeline scheduling lives in distributed.fleet (1F1B/GPipe)."""
+
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+        self._inner = optimizer
+        self._num_microbatches = num_microbatches
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+__all__ = ['SGD', 'SGDOptimizer', 'Momentum', 'MomentumOptimizer',
+           'Adagrad', 'AdagradOptimizer', 'Adam', 'AdamOptimizer',
+           'Adamax', 'AdamaxOptimizer', 'Adadelta', 'AdadeltaOptimizer',
+           'RMSProp', 'RMSPropOptimizer', 'Lamb', 'LambOptimizer',
+           'LarsMomentumOptimizer', 'DecayedAdagradOptimizer',
+           'DpsgdOptimizer', 'RecomputeOptimizer', 'PipelineOptimizer',
+           'LookAhead', 'ModelAverage', 'ExponentialMovingAverage']

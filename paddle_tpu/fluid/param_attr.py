@@ -1,0 +1,3 @@
+"""fluid.param_attr compat."""
+from ..nn.layer_base import ParamAttr  # noqa: F401
+from ..static.program import WeightNormParamAttr  # noqa: F401

@@ -1,0 +1,35 @@
+"""fluid.profiler compat (reference python/paddle/fluid/profiler.py):
+start/stop/profiler context over the jax-profiler-backed paddle profiler."""
+import contextlib
+
+from ..profiler import Profiler as _Profiler
+
+_active = None
+
+
+def start_profiler(state="All", tracer_option="Default"):
+    global _active
+    if _active is None:
+        _active = _Profiler()
+        _active.start()
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    global _active
+    if _active is not None:
+        _active.stop()
+        _active = None
+
+
+def reset_profiler():
+    pass
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path=None,
+             tracer_option="Default"):
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -1,0 +1,2 @@
+"""fluid.unique_name compat."""
+from ..utils.unique_name import generate, guard, switch  # noqa: F401

@@ -27,6 +27,12 @@ def linear(x, weight, bias=None, name=None):
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             name=None):
     if not training or p == 0.0:
+        if mode == "downgrade_in_infer" and p > 0.0:
+            # legacy fluid semantics: no train-time upscale, so inference
+            # rescales by the keep probability (fluid/layers/nn.py:dropout)
+            if isinstance(x, Tensor):
+                return apply(lambda a: (a * (1.0 - p)).astype(a.dtype), x)
+            return x * (1.0 - p)
         return apply(lambda a: a, x) if isinstance(x, Tensor) else x
     key = next_key()
     def f(a):

@@ -87,9 +87,10 @@ def _rulebook(indices, spatial_in, kernel_size, stride, padding, dilation,
     if subm:
         out_spatial = list(spatial_in)
         # output sites == input sites. Cross-correlation (paddle/torch
-        # convention): out[p] += W[off] * x[p + (off - center) * dilation].
-        # Vectorized lookup: ravel every site key, then locate each
-        # shifted neighbor with searchsorted over the sorted key table.
+        # convention): out[p] += W[off] * x[p - padding + off * dilation]
+        # (stride 1). Vectorized lookup: ravel every site key, then locate
+        # each shifted neighbor with searchsorted over the sorted key
+        # table.
         out_idx = idx
         dims = np.asarray([int(n.max()) + 1 if idx.shape[1] else 1,
                            *spatial_in], np.int64)
@@ -97,10 +98,9 @@ def _rulebook(indices, spatial_in, kernel_size, stride, padding, dilation,
             np.concatenate([n[None], coords.T]), dims)
         order = np.argsort(keys)
         sorted_keys = keys[order]
-        center = [(k - 1) // 2 for k in kernel_size]
         pairs = []
         for off in offsets:
-            rel = (off - center) * np.asarray(dilation)
+            rel = off * np.asarray(dilation) - np.asarray(padding)
             src = coords + rel  # neighbor sampled at this offset
             ok = np.all((src >= 0) & (src < np.asarray(spatial_in)), axis=1)
             rows = np.nonzero(ok)[0]
@@ -139,7 +139,11 @@ def _rulebook(indices, spatial_in, kernel_size, stride, padding, dilation,
     all_out = (np.concatenate([c for c in cand_out], axis=0)
                if cand_out else np.zeros((0, 4), np.int64))
     if all_out.shape[0] == 0:
-        raise ValueError("sparse conv produced an empty output")
+        # legitimately empty output (no active point lands on the output
+        # grid): empty COO, no pairs
+        return (np.zeros((4, 0), np.int32), out_spatial,
+                [(np.zeros(0, np.int32), np.zeros(0, np.int32))
+                 for _ in offsets])
     dims = np.asarray([int(idx[0].max()) + 1 if idx.shape[1] else 1,
                        *out_spatial], np.int64)
     flat = np.ravel_multi_index(all_out.T, dims)

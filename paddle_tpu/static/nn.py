@@ -91,7 +91,11 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     in_dim = 1
     for d in shape[num_flatten_dims:]:
         in_dim *= int(d)
-    x2 = reshape(x, (*shape[:num_flatten_dims], in_dim)) \
+    # leading dims stay symbolic (-1 batch): replay may feed a different
+    # batch size than was recorded
+    lead = tuple(-1 if i == 0 else int(s)
+                 for i, s in enumerate(shape[:num_flatten_dims]))
+    x2 = reshape(x, (*lead, in_dim)) \
         if len(shape) != num_flatten_dims + 1 else x
     w = create_parameter((in_dim, size), str(x.dtype),
                          name=name or _uniq("fc_w"), attr=weight_attr)

@@ -1,0 +1,252 @@
+"""`paddle.fluid` compatibility-namespace behavior.
+
+Reference workflows: python/paddle/fluid — 1.x/2.0-era static programs
+(data/fc/Executor), fluid.dygraph layers and guard, fluid-style
+optimizers with minimize, fluid.layers op spellings and their semantics
+where they differ from 2.x (tile-style expand, indices-returning where,
+probability-input cross_entropy, downgrade_in_infer dropout).
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def test_static_program_fc_train():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="int64")
+        hidden = layers.fc(x, size=16, act="relu")
+        logits = layers.fc(hidden, size=3)
+        loss = layers.reduce_mean(
+            layers.softmax_with_cross_entropy(logits, y))
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.5)
+        opt.minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((16, 4)).astype(np.float32)
+    ys = rng.integers(0, 3, (16, 1)).astype(np.int64)
+    losses = []
+    for _ in range(6):
+        (lv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0]
+
+
+def test_dygraph_guard_training():
+    with fluid.dygraph.guard():
+        assert fluid.in_dygraph_mode()
+        net = fluid.dygraph.Linear(4, 2, act="tanh")
+        opt = fluid.optimizer.AdamOptimizer(
+            learning_rate=0.05, parameter_list=net.parameters())
+        rng = np.random.default_rng(0)
+        x = fluid.dygraph.to_variable(
+            rng.standard_normal((8, 4)).astype(np.float32))
+        target = fluid.dygraph.to_variable(
+            rng.standard_normal((8, 2)).astype(np.float32))
+        losses = []
+        for _ in range(6):
+            loss = layers.mse_loss(net(x), target)
+            loss.backward()
+            opt.minimize(loss)
+            net.clear_gradients() if hasattr(net, "clear_gradients") \
+                else opt.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+        assert losses[-1] < losses[0]
+
+
+def test_dygraph_conv_pool_bn_stack():
+    with fluid.dygraph.guard():
+        conv = fluid.dygraph.Conv2D(3, 6, filter_size=3, padding=1,
+                                    act="relu")
+        pool = fluid.dygraph.Pool2D(pool_size=2, pool_type="max",
+                                    pool_stride=2)
+        bn = fluid.dygraph.BatchNorm(6)
+        emb = fluid.dygraph.Embedding(size=[10, 4])
+        rng = np.random.default_rng(0)
+        x = fluid.dygraph.to_variable(
+            rng.standard_normal((2, 3, 8, 8)).astype(np.float32))
+        out = bn(pool(conv(x)))
+        assert list(out.shape) == [2, 6, 4, 4]
+        ids = fluid.dygraph.to_variable(np.array([1, 2, 3], np.int64))
+        assert list(emb(ids).shape) == [3, 4]
+
+
+def test_layers_semantics_vs_2x():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    # reduce_* use dim/keep_dim spellings
+    np.testing.assert_allclose(
+        np.asarray(layers.reduce_sum(x, dim=1, keep_dim=True)._data),
+        np.asarray([[3.0], [12.0]]))
+    # expand is tile
+    t = layers.expand(paddle.to_tensor(np.array([[1, 2]], np.float32)),
+                      [2, 3])
+    assert list(t.shape) == [2, 6]
+    # where returns indices of True (2.x nonzero)
+    idx = layers.where(paddle.to_tensor(np.array([0, 1, 0, 1], bool)))
+    np.testing.assert_array_equal(np.asarray(idx._data).reshape(-1), [1, 3])
+    # elementwise axis broadcast: y aligned at axis
+    a = paddle.to_tensor(np.ones((2, 3, 4), np.float32))
+    b = paddle.to_tensor(np.arange(3, dtype=np.float32))
+    out = layers.elementwise_add(a, b, axis=1)
+    np.testing.assert_allclose(np.asarray(out._data)[0, :, 0], [1, 2, 3])
+    # fluid sum() adds a list
+    s = layers.sum([x, x])
+    np.testing.assert_allclose(np.asarray(s._data),
+                               2 * np.asarray(x._data))
+    # argsort returns (values, indices)
+    vals, idx2 = layers.argsort(paddle.to_tensor(
+        np.array([3.0, 1.0, 2.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(vals._data), [1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(idx2._data), [1, 2, 0])
+
+
+def test_fluid_cross_entropy_takes_probabilities():
+    probs = paddle.to_tensor(np.array([[0.7, 0.2, 0.1],
+                                       [0.1, 0.8, 0.1]], np.float32))
+    label = paddle.to_tensor(np.array([[0], [1]], np.int64))
+    loss = layers.cross_entropy(probs, label)
+    assert list(loss.shape) == [2, 1]
+    np.testing.assert_allclose(
+        np.asarray(loss._data).reshape(-1),
+        [-np.log(0.7), -np.log(0.8)], rtol=1e-5)
+
+
+def test_fluid_dropout_downgrade_in_infer():
+    x = paddle.to_tensor(np.ones((1000,), np.float32))
+    # train mode: mask only, no upscale -> mean ~ (1-p), values in {0, 1}
+    out = layers.dropout(x, dropout_prob=0.3)
+    arr = np.asarray(out._data)
+    assert set(np.unique(arr)).issubset({0.0, 1.0})
+    assert 0.6 < arr.mean() < 0.8
+    # test mode: downscale by (1-p)
+    out_t = layers.dropout(x, dropout_prob=0.3, is_test=True)
+    np.testing.assert_allclose(np.asarray(out_t._data), 0.7, rtol=1e-6)
+
+
+def test_save_load_dygraph_roundtrip():
+    with fluid.dygraph.guard():
+        net = fluid.dygraph.Linear(3, 2)
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "ckpt")
+            fluid.save_dygraph(net.state_dict(), p)
+            params, opt_state = fluid.load_dygraph(p)
+            assert opt_state is None
+            sd = net.state_dict()
+            wkey = [k for k in sd if k.endswith("weight")][0]
+            w0 = np.asarray(sd[wkey]._data)
+            key = [k for k in params if k.endswith("weight")][0]
+            got = params[key]
+            got = np.asarray(got._data if hasattr(got, "_data") else got)
+            np.testing.assert_allclose(got, w0)
+
+
+def test_nets_builders():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data(name="img", shape=[1, 8, 8], dtype="float32")
+        feat = fluid.nets.simple_img_conv_pool(
+            img, num_filters=4, filter_size=3, pool_size=2, pool_stride=2,
+            conv_padding=1, act="relu")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (out,) = exe.run(main, feed={
+            "img": np.ones((2, 1, 8, 8), np.float32)}, fetch_list=[feat])
+        assert out.shape == (2, 4, 4, 4)
+    # glu halves the last dim
+    g = fluid.nets.glu(paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((2, 6)).astype(np.float32)))
+    assert list(g.shape) == [2, 3]
+
+
+def test_data_feeder():
+    feeder = fluid.DataFeeder(feed_list=["a", "b"], place=fluid.CPUPlace())
+    batch = [(np.zeros(3, np.float32), 1), (np.ones(3, np.float32), 0)]
+    feed = feeder.feed(batch)
+    assert feed["a"].shape == (2, 3) and feed["b"].shape == (2,)
+
+
+def test_fluid_io_inference_model_roundtrip():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        out = layers.fc(x, size=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xs = np.random.default_rng(0).standard_normal((3, 4)).astype(
+            np.float32)
+        (want,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+        with tempfile.TemporaryDirectory() as td:
+            fluid.io.save_inference_model(td, ["x"], [out], exe,
+                                          main_program=main)
+            prog, feed_names, fetch_vars = fluid.io.load_inference_model(
+                td, exe)
+            (got,) = exe.run(prog, feed={feed_names[0]: xs},
+                             fetch_list=fetch_vars)
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_initializer_and_clip_spellings():
+    init = fluid.initializer.Xavier(uniform=True)
+    msra = fluid.initializer.MSRA(uniform=False)
+    assert init is not None and msra is not None
+    clip = fluid.clip.GradientClipByGlobalNorm(clip_norm=1.0)
+    with fluid.dygraph.guard():
+        net = fluid.dygraph.Linear(4, 2)
+        opt = fluid.optimizer.MomentumOptimizer(
+            learning_rate=0.1, momentum=0.9,
+            parameter_list=net.parameters(), grad_clip=clip)
+        x = fluid.dygraph.to_variable(np.ones((2, 4), np.float32))
+        loss = layers.reduce_mean(net(x))
+        loss.backward()
+        opt.minimize(loss)
+
+
+def test_smooth_l1_weight_combinations():
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((4, 3)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((4, 3)).astype(np.float32))
+    w = paddle.to_tensor(np.full((4, 3), 2.0, np.float32))
+    base = np.asarray(layers.smooth_l1(x, y)._data)
+    only_out = np.asarray(layers.smooth_l1(x, y, outside_weight=w)._data)
+    np.testing.assert_allclose(only_out, base * 2.0, rtol=1e-6)
+    both = layers.smooth_l1(x, y, inside_weight=w, outside_weight=w)
+    assert both.shape[0] == 4
+
+
+def test_inverse_time_decay_formula():
+    sched = layers.inverse_time_decay(0.1, decay_steps=100, decay_rate=0.5)
+    for _ in range(100):
+        sched.step()
+    np.testing.assert_allclose(sched(), 0.1 / 1.5, rtol=1e-6)
+
+
+def test_save_dygraph_param_names_with_beta():
+    with fluid.dygraph.guard():
+        net = fluid.dygraph.Linear(2, 2)
+        sd = {"beta_proj.weight": net.state_dict()[
+            list(net.state_dict())[0]]}
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "m")
+            fluid.save_dygraph(sd, p)
+            assert os.path.exists(p + ".pdparams")  # NOT .pdopt
+
+
+def test_lr_decay_objects_feed_optimizers():
+    sched = layers.piecewise_decay([100, 200], [0.1, 0.05, 0.01])
+    with fluid.dygraph.guard():
+        net = fluid.dygraph.Linear(2, 2)
+        opt = fluid.optimizer.SGDOptimizer(
+            learning_rate=sched, parameter_list=net.parameters())
+        x = fluid.dygraph.to_variable(np.ones((1, 2), np.float32))
+        loss = layers.reduce_mean(net(x))
+        loss.backward()
+        opt.minimize(loss)

@@ -145,6 +145,41 @@ def test_sparse_max_pool3d_matches_dense_on_active():
         ref[tuple(oi)], rtol=1e-6)
 
 
+def test_sparse_conv3d_empty_output_ok():
+    # a single active point at odd coords, 1x1x1 kernel, stride 2: no
+    # output site aligns -> legitimately empty result, not an error
+    x = sparse_coo_tensor(np.array([[0], [1], [1], [1]], np.int32),
+                          np.ones((1, 2), np.float32),
+                          shape=(1, 4, 4, 4, 2))
+    w = np.ones((1, 1, 1, 2, 3), np.float32)
+    out = SF.conv3d(x, paddle.to_tensor(w), stride=2, padding=0)
+    assert out.nnz == 0
+    assert out.shape == [1, 2, 2, 2, 3]
+
+
+def test_subm_conv3d_uncentered_padding():
+    """padding=0 with k=2 samples neighbors at +off (reference formula
+    x[p - padding + off]); compare against the dense oracle away from the
+    boundary."""
+    rng = np.random.default_rng(9)
+    shape = (1, 5, 5, 5, 2)
+    # active sites only in the interior so every dense output is defined
+    pts = sorted({(0, int(rng.integers(3)), int(rng.integers(3)),
+                   int(rng.integers(3))) for _ in range(15)})
+    idx = np.asarray(pts, np.int32).T
+    vals = rng.standard_normal((idx.shape[1], 2)).astype(np.float32)
+    x = sparse_coo_tensor(idx, vals, shape=shape)
+    w = rng.standard_normal((2, 2, 2, 2, 3)).astype(np.float32)
+
+    out = SF.subm_conv3d(x, paddle.to_tensor(w), padding=0)
+    dense_in = np.asarray(x.to_dense()._data)
+    ref = _dense_conv3d_oracle(dense_in, w, None, 1, 0)
+    oi = np.asarray(out.indices()._data)
+    got = np.asarray(out.to_dense()._data)
+    np.testing.assert_allclose(got[tuple(oi)], ref[tuple(oi)],
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_sparse_nn_layers_exported():
     import paddle_tpu.incubate.sparse.nn as spnn
 
