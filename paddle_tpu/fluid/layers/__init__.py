@@ -721,9 +721,17 @@ def exponential_decay(learning_rate, decay_steps, decay_rate,
 
 def natural_exp_decay(learning_rate, decay_steps, decay_rate,
                       staircase=False):
-    from ...optimizer.lr import NaturalExpDecay
-    return NaturalExpDecay(learning_rate, decay_rate / decay_steps
-                           if staircase is False else decay_rate)
+    """lr * exp(-decay_rate * (step/decay_steps)), floored per stair when
+    staircase (reference fluid/layers/learning_rate_scheduler.py)."""
+    import math
+
+    from ...optimizer.lr import LambdaDecay
+
+    def factor(step):
+        t = step // decay_steps if staircase else step / decay_steps
+        return math.exp(-decay_rate * t)
+
+    return LambdaDecay(learning_rate, factor)
 
 
 def inverse_time_decay(learning_rate, decay_steps, decay_rate,
@@ -765,3 +773,6 @@ sequence_mask = _F.sequence_mask
 gather_tree = _F.gather_tree
 temporal_shift = _F.temporal_shift
 diag_embed = _F.diag_embed
+
+
+from .tail import *  # noqa: F401,F403  (legacy long tail)

@@ -1,0 +1,410 @@
+"""fluid.layers tail: RNN/decode classes, detection aliases, distribution
+classes, and the long tail of legacy ops.
+
+Reference: python/paddle/fluid/layers/{nn.py,rnn.py,detection.py,
+distributions.py,tensor.py}. LoD-tensor machinery (dynamic_lstm/gru,
+lod_reset, py_reader, selected_rows) is intentionally absent: variable-
+length sequences ride padded-dense + length masks on TPU (see
+static.nn.sequence_* ops).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as _p
+from ... import tensor_ops as _T
+from ...nn import functional as _F
+
+
+# -- RNN cells / runners / decoding ----------------------------------------
+
+from ...nn.layer.rnn import (BiRNN, GRUCell, LSTMCell,  # noqa: F401
+                             RNNCellBase as RNNCell, SimpleRNNCell)
+from ...nn.layer.decode import (BeamSearchDecoder,  # noqa: F401
+                                dynamic_decode)
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Run a cell over a sequence (reference fluid/layers/rnn.py:rnn)."""
+    from ...nn.layer.rnn import RNN
+    runner = RNN(cell, is_reverse=is_reverse, time_major=time_major)
+    return runner(inputs, initial_states=initial_states,
+                  sequence_length=sequence_length)
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    from ...nn.layer.rnn import BiRNN as _BiRNN
+    runner = _BiRNN(cell_fw, cell_bw, time_major=time_major)
+    init = None
+    if initial_states is not None:
+        init = initial_states
+    return runner(inputs, initial_states=init,
+                  sequence_length=sequence_length)
+
+
+# -- distribution classes (reference fluid/layers/distributions.py) --------
+
+from ...distribution import Categorical, Normal, Uniform  # noqa: F401
+
+
+# -- detection (reference fluid/layers/detection.py) -----------------------
+
+from ...vision.ops import (anchor_generator, box_clip,  # noqa: F401
+                           box_coder, distribute_fpn_proposals,
+                           generate_proposals, iou_similarity, matrix_nms,
+                           multiclass_nms, prior_box, psroi_pool,
+                           roi_pool)
+from ...vision.ops import deform_conv2d as deformable_conv  # noqa: F401
+from ...vision.ops import read_file  # noqa: F401
+from ...vision.ops import yolo_loss as yolov3_loss  # noqa: F401
+
+prroi_pool = roi_pool  # precise RoI pooling approximated by RoIPool
+
+
+# -- tensor tail -----------------------------------------------------------
+
+cos_sim = _F.cosine_similarity
+crop = _T.crop
+crop_tensor = _T.crop
+diag = _T.diag
+triu = _T.triu
+unbind = _T.unbind
+multiplex = _T.multiplex
+selu = _F.selu
+lrn = _F.local_response_norm
+shuffle_channel = _F.channel_shuffle
+space_to_depth = _F.pixel_unshuffle
+warpctc = _F.ctc_loss
+margin_rank_loss = _F.margin_ranking_loss
+
+
+def reverse(x, axis):
+    return _T.flip(x, axis)
+
+
+def unique_with_counts(x, dtype='int32'):
+    """Returns (out, index, count) where index maps each element of x to
+    its position in out (fluid's inverse-index contract)."""
+    out, index, count = _T.unique(x, return_inverse=True,
+                                  return_counts=True)
+    return out, index, count
+
+
+def unique(x, dtype='int32'):
+    """fluid.layers.unique returns (out, index) with index the inverse
+    map shaped like x (unlike 2.x paddle.unique's bare tensor)."""
+    out, index = _T.unique(x, return_inverse=True)
+    return out, index
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    from ...static.program import create_parameter
+    d = int(input.shape[-1])
+    w = create_parameter((num_classes - 1, d), str(input.dtype),
+                         name=name or "hsig_w", attr=param_attr)
+    b = create_parameter((num_classes - 1,), str(input.dtype),
+                         name="hsig_b", attr=bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    return _F.hsigmoid_loss(input, label, num_classes, w, b,
+                            path_table=path_table, path_code=path_code)
+
+
+def huber_loss(input, label, delta):
+    import jax.numpy as jnp
+
+    from ...tensor import apply
+
+    def _huber(x, y):
+        d = y - x
+        ad = jnp.abs(d)
+        return jnp.where(ad <= delta, 0.5 * d * d,
+                         delta * (ad - 0.5 * delta))
+
+    return apply(_huber, input, label)
+
+
+def rank_loss(label, left, right, name=None):
+    """RankNet pairwise loss (reference fluid/layers/loss.py:rank_loss)."""
+    import jax.numpy as jnp
+
+    from ...tensor import apply
+
+    def _rank(lab, l, r):
+        d = l - r
+        return jnp.log1p(jnp.exp(d)) - lab * d
+
+    return apply(_rank, label, left, right)
+
+
+def bpr_loss(input, label, name=None):
+    """Bayesian personalized ranking loss over softmax-normalized scores
+    (reference fluid/layers/loss.py:bpr_loss)."""
+    import jax.numpy as jnp
+
+    from ...tensor import apply
+
+    def _bpr(x, y):
+        y = y.reshape(x.shape[0]).astype(jnp.int32)
+        pos = jnp.take_along_axis(x, y[:, None], axis=1)
+        diff = pos - x
+        loss = -jnp.log(jnp.maximum(jax.nn.sigmoid(diff), 1e-10))
+        # exclude the positive column itself
+        mask = jnp.ones_like(x).at[jnp.arange(x.shape[0]), y].set(0.0)
+        return (loss * mask).sum(1, keepdims=True) / jnp.maximum(
+            mask.sum(1, keepdims=True), 1.0)
+
+    import jax
+    return apply(_bpr, input, label)
+
+
+def mean_iou(input, label, num_classes):
+    """Mean IoU over a label map (reference fluid/layers/nn.py:mean_iou).
+    Returns (mean_iou, out_wrong, out_correct)."""
+    import jax.numpy as jnp
+
+    from ...tensor import apply
+
+    def _miou(pred, lab):
+        pred = pred.reshape(-1).astype(jnp.int32)
+        lab = lab.reshape(-1).astype(jnp.int32)
+        conf = jnp.zeros((num_classes, num_classes), jnp.int32).at[
+            lab, pred].add(1)
+        inter = jnp.diagonal(conf)
+        union = conf.sum(0) + conf.sum(1) - inter
+        present = union > 0
+        iou = jnp.where(present, inter / jnp.maximum(union, 1), 0.0)
+        miou = iou.sum() / jnp.maximum(present.sum(), 1)
+        wrong = conf.sum(1) - inter
+        return miou.astype(jnp.float32), wrong, inter
+
+    return apply(_miou, input, label, n_outputs=3)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    if pool_type == "max":
+        return _F.adaptive_max_pool3d(input, pool_size)
+    return _F.adaptive_avg_pool3d(input, pool_size)
+
+
+def resize_linear(input, out_shape=None, scale=None, name=None,
+                  actual_shape=None, align_corners=True, align_mode=1,
+                  data_format='NCW'):
+    return _F.interpolate(input, size=out_shape, scale_factor=scale,
+                          mode='linear', align_corners=align_corners,
+                          align_mode=align_mode, data_format=data_format)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format='NCDHW'):
+    return _F.interpolate(input, size=out_shape, scale_factor=scale,
+                          mode='trilinear', align_corners=align_corners,
+                          align_mode=align_mode, data_format=data_format)
+
+
+def image_resize_short(input, out_short_len, resample='BILINEAR'):
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short, long_ = (h, w) if h < w else (w, h)
+    ratio = out_short_len / short
+    out = ([out_short_len, int(long_ * ratio)] if h < w
+           else [int(long_ * ratio), out_short_len])
+    from . import image_resize
+    return image_resize(input, out_shape=out, resample=resample)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y up to x's shape with pad_value (trailing pads only)."""
+    pads = []
+    for sx, sy in zip(x.shape, y.shape):
+        pads.extend([0, int(sx) - int(sy)])
+    return _F.pad(y, pads, mode='constant', value=pad_value)
+
+
+def uniform_random_batch_size_like(input, shape, dtype='float32',
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    shape = list(shape)
+    shape[output_dim_idx] = int(input.shape[input_dim_idx])
+    return _p.uniform(shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype='float32'):
+    shape = list(shape)
+    shape[output_dim_idx] = int(input.shape[input_dim_idx])
+    return _T.scale(_p.randn(shape, dtype=dtype), scale=std, bias=mean)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype='float32'):
+    """Sample a category id per row of a probability matrix (reference
+    fluid/layers/nn.py:sampling_id)."""
+    return _T.squeeze(_p.multinomial(x, num_samples=1), axis=-1)
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    """x*alpha + sinusoid(position)*beta (reference fluid/layers/nn.py)."""
+    import jax.numpy as jnp
+
+    from ...tensor import apply
+
+    def _ape(x):
+        b, t, d = x.shape
+        pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+        half = d // 2
+        freq = jnp.power(10000.0, -jnp.arange(half, dtype=jnp.float32)
+                         / max(half, 1))
+        ang = pos * freq[None, :]
+        enc = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=1)
+        return alpha * x + beta * enc[None, :, :d].astype(x.dtype)
+
+    return apply(_ape, input)
+
+
+def affine_channel(x, scale=None, bias=None, data_layout='NCHW', act=None,
+                   name=None):
+    from ...tensor import apply
+
+    shape = [1, -1, 1, 1] if data_layout == 'NCHW' else [1, 1, 1, -1]
+
+    def _ac(v, *sb):
+        it = iter(sb)
+        if scale is not None:
+            v = v * next(it).reshape(shape)
+        if bias is not None:
+            v = v + next(it).reshape(shape)
+        return v
+
+    extra = tuple(t for t in (scale, bias) if t is not None)
+    out = apply(_ac, x, *extra)
+    from . import _act as _act_fn
+    return _act_fn(out, act)
+
+
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure matrix (reference fluid/layers/nn.py:
+    fsp_matrix): x [B,C1,H,W], y [B,C2,H,W] -> [B,C1,C2]."""
+    import jax.numpy as jnp
+
+    from ...tensor import apply
+
+    def _fsp(a, b):
+        bsz, c1 = a.shape[0], a.shape[1]
+        hw = a.shape[2] * a.shape[3]
+        af = a.reshape(bsz, c1, hw)
+        bf = b.reshape(bsz, b.shape[1], hw)
+        return jnp.einsum("bch,bdh->bcd", af, bf) / hw
+
+    return apply(_fsp, x, y)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Levenshtein distance per pair (host-side; data-dependent).
+    Reference: fluid/layers/nn.py:edit_distance. Returns (dist [B,1],
+    seq_num)."""
+    from ...tensor import Tensor
+
+    def _strip(seq):
+        seq = [int(t) for t in seq]
+        if ignored_tokens:
+            seq = [t for t in seq if t not in ignored_tokens]
+        return seq
+
+    a = np.asarray(input._data if hasattr(input, "_data") else input)
+    b = np.asarray(label._data if hasattr(label, "_data") else label)
+    il = (np.asarray(input_length._data).reshape(-1)
+          if input_length is not None else [a.shape[1]] * a.shape[0])
+    ll = (np.asarray(label_length._data).reshape(-1)
+          if label_length is not None else [b.shape[1]] * b.shape[0])
+    dists = []
+    for i in range(a.shape[0]):
+        s1 = _strip(a[i, :int(il[i])])
+        s2 = _strip(b[i, :int(ll[i])])
+        m, n = len(s1), len(s2)
+        dp = np.arange(n + 1, dtype=np.float32)
+        for x1 in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = x1
+            for y1 in range(1, n + 1):
+                dp[y1] = min(prev[y1] + 1, dp[y1 - 1] + 1,
+                             prev[y1 - 1] + (s1[x1 - 1] != s2[y1 - 1]))
+        d = dp[n]
+        if normalized:
+            d = d / max(n, 1)
+        dists.append([d])
+    import jax.numpy as jnp
+    return (Tensor(jnp.asarray(np.asarray(dists, np.float32))),
+            Tensor(jnp.asarray(np.int64(a.shape[0]))))
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """Greedy CTC decode: argmax -> merge repeats -> drop blanks
+    (host-side; ragged output padded with padding_value). Reference:
+    fluid/layers/nn.py:ctc_greedy_decoder."""
+    import jax.numpy as jnp
+
+    from ...tensor import Tensor
+    probs = np.asarray(input._data if hasattr(input, "_data") else input)
+    # accept [B, T, C]
+    ids = probs.argmax(-1)
+    il = (np.asarray(input_length._data if hasattr(input_length, "_data")
+                     else input_length).reshape(-1)
+          if input_length is not None else [ids.shape[1]] * ids.shape[0])
+    outs, lens = [], []
+    for bi, row in enumerate(ids):
+        row = row[:int(il[bi])]
+        merged = [int(t) for i, t in enumerate(row)
+                  if (i == 0 or t != row[i - 1]) and t != blank]
+        outs.append(merged)
+        lens.append(len(merged))
+    width = max(lens) if lens and max(lens) > 0 else 1
+    arr = np.full((len(outs), width), padding_value, np.int64)
+    for i, row in enumerate(outs):
+        arr[i, :len(row)] = row
+    return (Tensor(jnp.asarray(arr)),
+            Tensor(jnp.asarray(np.asarray(lens, np.int64))))
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False):
+    op = _T.stack if use_stack else _T.concat
+    out = op(list(input), axis=axis)
+    sizes = [int(t.shape[axis]) if not use_stack else 1 for t in input]
+    return out, _T.to_tensor(np.asarray(sizes, np.int32))
+
+
+def Assert(cond, data=None, summarize=20, name=None):
+    ok = bool(np.asarray(cond._data if hasattr(cond, "_data") else cond)
+              .all())
+    if not ok:
+        shown = [np.asarray(d._data if hasattr(d, "_data") else d)
+                 for d in (data or [])]
+        raise AssertionError(f"fluid.layers.Assert failed: {shown}")
+    return True
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Per-run step counter (reference fluid/layers/nn.py): a global var
+    incremented by `step` on every Executor.run replay."""
+    from ...static import create_global_var, default_main_program
+    from ...static.program import _current_main
+    counter = create_global_var([1], begin - step, 'int64',
+                                persistable=True,
+                                name=counter_name or "@step_counter@")
+    prog = _current_main or default_main_program()
+
+    def _tick():
+        import jax.numpy as jnp
+        counter._data = counter._data + jnp.asarray(step, jnp.int64)
+
+    if hasattr(prog, "_append_thunk"):
+        prog._append_thunk(_tick)
+    else:
+        _tick()
+    return counter

@@ -722,3 +722,259 @@ def decode_jpeg(x, mode="unchanged", name=None):
     arr = np.asarray(img)
     arr = arr[None] if arr.ndim == 2 else arr.transpose(2, 0, 1)
     return Tensor(jnp.asarray(arr))
+
+
+# -- SSD/RCNN-era detection ops (fluid.layers detection surface) -----------
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU between box sets x [N,4] and y [M,4] -> [N,M].
+    Reference: fluid/layers/detection.py:iou_similarity."""
+    def _iou(a, b):
+        off = 0.0 if box_normalized else 1.0
+        area_a = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+        area_b = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+        xi1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+        yi1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+        xi2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+        yi2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+        inter = (jnp.maximum(xi2 - xi1 + off, 0.0)
+                 * jnp.maximum(yi2 - yi1 + off, 0.0))
+        return inter / jnp.maximum(area_a[:, None] + area_b[None, :]
+                                   - inter, 1e-10)
+    return apply(_iou, x, y)
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes [..., 4] to image bounds. im_info is [H, W, scale] (or
+    [H, W]) for one image, or [B, 2..3] per-image when the boxes carry a
+    leading batch dim. Reference: fluid/layers/detection.py:box_clip."""
+    batched = len(im_info.shape) == 2
+
+    def _clip(b, info):
+        if batched:
+            # per-image bounds broadcast over each image's boxes
+            h, w = info[:, 0], info[:, 1]
+            scale = info[:, 2] if info.shape[1] > 2 else jnp.ones_like(h)
+            bshape = (-1,) + (1,) * (b.ndim - 2)
+            hmax = (h / scale - 1.0).reshape(bshape)
+            wmax = (w / scale - 1.0).reshape(bshape)
+        else:
+            info = info.reshape(-1)
+            h, w = info[0], info[1]
+            scale = info[2] if info.shape[0] > 2 else 1.0
+            hmax, wmax = h / scale - 1.0, w / scale - 1.0
+        x1 = jnp.clip(b[..., 0], 0.0, wmax)
+        y1 = jnp.clip(b[..., 1], 0.0, hmax)
+        x2 = jnp.clip(b[..., 2], 0.0, wmax)
+        y2 = jnp.clip(b[..., 3], 0.0, hmax)
+        return jnp.stack([x1, y1, x2, y2], axis=-1)
+    return apply(_clip, input, im_info)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """SSD box encode/decode (reference fluid/layers/detection.py:
+    box_coder). encode: target [N,4] x priors [M,4] -> [N,M,4] offsets;
+    decode: target [N,M,4] offsets + priors [M,4] (broadcast along
+    `axis`) -> [N,M,4] boxes."""
+    off = 0.0 if box_normalized else 1.0
+    var_is_tensor = not isinstance(prior_box_var, (list, tuple, type(None)))
+    var_const = (np.asarray(prior_box_var, np.float32)
+                 if isinstance(prior_box_var, (list, tuple)) else None)
+
+    def _prior_cwh(p):
+        pw = p[:, 2] - p[:, 0] + off
+        ph = p[:, 3] - p[:, 1] + off
+        pcx = p[:, 0] + 0.5 * pw
+        pcy = p[:, 1] + 0.5 * ph
+        return pcx, pcy, pw, ph
+
+    def _encode(p, t, *v):
+        pcx, pcy, pw, ph = _prior_cwh(p)
+        tw = t[:, 2] - t[:, 0] + off
+        th = t[:, 3] - t[:, 1] + off
+        tcx = t[:, 0] + 0.5 * tw
+        tcy = t[:, 1] + 0.5 * th
+        ex = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        ey = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ew = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
+        eh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10))
+        out = jnp.stack([ex, ey, ew, eh], axis=-1)
+        if v:
+            out = out / v[0].reshape(1, -1, 4)
+        elif var_const is not None:
+            out = out / jnp.asarray(var_const).reshape(1, 1, 4)
+        return out
+
+    def _decode(p, t, *v):
+        pcx, pcy, pw, ph = _prior_cwh(p)
+        if axis == 0:
+            shape = (1, -1)
+        else:
+            shape = (-1, 1)
+        pcx, pcy, pw, ph = (a.reshape(shape) for a in (pcx, pcy, pw, ph))
+        d = t
+        if v:
+            var = v[0].reshape(*shape, 4) if v[0].ndim == 2 \
+                else v[0].reshape(1, 1, 4)
+            d = d * var
+        elif var_const is not None:
+            d = d * jnp.asarray(var_const).reshape(1, 1, 4)
+        dcx = d[..., 0] * pw + pcx
+        dcy = d[..., 1] * ph + pcy
+        dw = jnp.exp(d[..., 2]) * pw
+        dh = jnp.exp(d[..., 3]) * ph
+        return jnp.stack([dcx - 0.5 * dw, dcy - 0.5 * dh,
+                          dcx + 0.5 * dw - off, dcy + 0.5 * dh - off],
+                         axis=-1)
+
+    fn = _encode if code_type.startswith("encode") else _decode
+    extra = (prior_box_var,) if var_is_tensor and prior_box_var is not None \
+        else ()
+    return apply(fn, prior_box, target_box, *extra)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes over a feature map (reference fluid/layers/
+    detection.py:prior_box). Returns (boxes [H,W,P,4], variances same
+    shape); the layout is a static function of the shapes, computed host-
+    side."""
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    min_sizes = [float(m) for m in np.atleast_1d(min_sizes)]
+    max_sizes = [float(m) for m in np.atleast_1d(max_sizes)] \
+        if max_sizes is not None else []
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - a) < 1e-6 for a in ars):
+            continue
+        ars.append(float(ar))
+        if flip:
+            ars.append(1.0 / float(ar))
+
+    whs = []  # per-prior (w, h) in pixels
+    for k, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                bs = np.sqrt(ms * max_sizes[k])
+                whs.append((bs, bs))
+            for ar in ars[1:]:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                bs = np.sqrt(ms * max_sizes[k])
+                whs.append((bs, bs))
+    whs = np.asarray(whs, np.float32)  # (P, 2)
+
+    cx = (np.arange(fw, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(fh, dtype=np.float32) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)  # (H, W)
+    boxes = np.empty((fh, fw, len(whs), 4), np.float32)
+    boxes[..., 0] = (cxg[..., None] - whs[None, None, :, 0] / 2) / iw
+    boxes[..., 1] = (cyg[..., None] - whs[None, None, :, 1] / 2) / ih
+    boxes[..., 2] = (cxg[..., None] + whs[None, None, :, 0] / 2) / iw
+    boxes[..., 3] = (cyg[..., None] + whs[None, None, :, 1] / 2) / ih
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          boxes.shape).copy()
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(var))
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, variances,
+                     stride, offset=0.5, name=None):
+    """RPN anchors over a feature map (reference fluid/layers/
+    detection.py:anchor_generator): per cell, for each aspect ratio and
+    size, w = sqrt(size^2 / ar), h = w * ar."""
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    whs = []
+    for ar in aspect_ratios:
+        for s in anchor_sizes:
+            w = np.sqrt(float(s) ** 2 / float(ar))
+            whs.append((w, w * float(ar)))
+    whs = np.asarray(whs, np.float32)
+    cx = (np.arange(fw, dtype=np.float32) + offset) * float(stride[0])
+    cy = (np.arange(fh, dtype=np.float32) + offset) * float(stride[1])
+    cxg, cyg = np.meshgrid(cx, cy)
+    anchors = np.empty((fh, fw, len(whs), 4), np.float32)
+    anchors[..., 0] = cxg[..., None] - 0.5 * whs[None, None, :, 0]
+    anchors[..., 1] = cyg[..., None] - 0.5 * whs[None, None, :, 1]
+    anchors[..., 2] = cxg[..., None] + 0.5 * whs[None, None, :, 0]
+    anchors[..., 3] = cyg[..., None] + 0.5 * whs[None, None, :, 1]
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          anchors.shape).copy()
+    return Tensor(jnp.asarray(anchors)), Tensor(jnp.asarray(var))
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    """Per-class NMS + cross-class top-k (reference fluid/layers/
+    detection.py:multiclass_nms). bboxes [N,M,4], scores [N,C,M];
+    data-dependent output -> host-side eager, like `nms`. Returns
+    ([total_kept, 6] (label, score, x1,y1,x2,y2), lod counts per image)."""
+    b = np.asarray(raw(bboxes))
+    s = np.asarray(raw(scores))
+    off = 0.0 if normalized else 1.0
+
+    def _nms_class(boxes, sc):
+        # greedy NMS with the normalized/pixel (+1) area convention and
+        # adaptive threshold (nms_eta) as in the reference kernel
+        order = np.argsort(-sc)
+        areas = ((boxes[:, 2] - boxes[:, 0] + off)
+                 * (boxes[:, 3] - boxes[:, 1] + off))
+        kept, thresh = [], nms_threshold
+        suppressed = np.zeros(len(boxes), bool)
+        for i in order:
+            if suppressed[i]:
+                continue
+            kept.append(i)
+            xi1 = np.maximum(boxes[i, 0], boxes[:, 0])
+            yi1 = np.maximum(boxes[i, 1], boxes[:, 1])
+            xi2 = np.minimum(boxes[i, 2], boxes[:, 2])
+            yi2 = np.minimum(boxes[i, 3], boxes[:, 3])
+            inter = (np.maximum(xi2 - xi1 + off, 0)
+                     * np.maximum(yi2 - yi1 + off, 0))
+            iou = inter / np.maximum(areas[i] + areas - inter, 1e-10)
+            suppressed |= iou > thresh
+            suppressed[i] = True  # consumed (kept), not re-visited
+            if nms_eta < 1.0 and thresh > 0.5:
+                thresh *= nms_eta
+        return kept
+
+    outs, counts = [], []
+    for n in range(b.shape[0]):
+        dets = []
+        for c in range(s.shape[1]):
+            if c == background_label:
+                continue
+            sc = s[n, c]
+            keep = sc > score_threshold
+            idxs = np.nonzero(keep)[0]
+            if idxs.size == 0:
+                continue
+            order = idxs[np.argsort(-sc[idxs])]
+            if nms_top_k > 0:
+                order = order[:nms_top_k]
+            kept = _nms_class(b[n, order], sc[order])
+            for i in kept:
+                gi = order[int(i)]
+                dets.append((float(c), float(sc[gi]), *b[n, gi]))
+        dets.sort(key=lambda d: -d[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        counts.append(len(dets))
+        outs.extend(dets)
+    out = np.asarray(outs, np.float32).reshape(-1, 6) if outs \
+        else np.zeros((0, 6), np.float32)
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(
+        np.asarray(counts, np.int32)))

@@ -1,0 +1,250 @@
+"""SSD/RCNN-era detection ops + fluid.layers tail.
+
+Reference: python/paddle/fluid/layers/detection.py (iou_similarity,
+box_coder, prior_box, anchor_generator, multiclass_nms, box_clip) and the
+fluid.layers long tail (rnn/birnn, edit_distance, ctc_greedy_decoder,
+mean_iou, huber/rank/bpr losses).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid import layers as L
+
+
+def _iou_np(a, b):
+    xi1, yi1 = max(a[0], b[0]), max(a[1], b[1])
+    xi2, yi2 = min(a[2], b[2]), min(a[3], b[3])
+    inter = max(xi2 - xi1, 0) * max(yi2 - yi1, 0)
+    ua = ((a[2] - a[0]) * (a[3] - a[1])
+          + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+    return inter / ua
+
+
+def test_iou_similarity_pairwise():
+    rng = np.random.default_rng(0)
+    x = np.sort(rng.uniform(0, 20, (5, 2, 2)), axis=-1) \
+        .transpose(0, 2, 1).reshape(5, 4).astype(np.float32)
+    y = np.sort(rng.uniform(0, 20, (3, 2, 2)), axis=-1) \
+        .transpose(0, 2, 1).reshape(3, 4).astype(np.float32)
+    got = np.asarray(L.iou_similarity(
+        paddle.to_tensor(x), paddle.to_tensor(y))._data)
+    for i in range(5):
+        for j in range(3):
+            np.testing.assert_allclose(got[i, j], _iou_np(x[i], y[j]),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.default_rng(1)
+    priors = np.sort(rng.uniform(0, 30, (4, 2, 2)), axis=-1) \
+        .transpose(0, 2, 1).reshape(4, 4).astype(np.float32)
+    targets = np.sort(rng.uniform(0, 30, (6, 2, 2)), axis=-1) \
+        .transpose(0, 2, 1).reshape(6, 4).astype(np.float32)
+    var = [0.1, 0.1, 0.2, 0.2]
+    enc = L.box_coder(paddle.to_tensor(priors), var,
+                      paddle.to_tensor(targets),
+                      code_type="encode_center_size")
+    assert list(enc.shape) == [6, 4, 4]
+    dec = L.box_coder(paddle.to_tensor(priors), var, enc,
+                      code_type="decode_center_size")
+    # decoding every (target, prior) offset against the same prior
+    # reproduces the target box
+    d = np.asarray(dec._data)
+    for j in range(4):
+        np.testing.assert_allclose(d[:, j], targets, rtol=1e-4, atol=1e-3)
+
+
+def test_prior_box_geometry():
+    feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    boxes, var = L.prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                             aspect_ratios=[2.0], flip=True, clip=True)
+    # priors per cell: min(1) + ars(2, 1/2) + max = 4
+    assert list(boxes.shape) == [4, 4, 4, 4]
+    b = np.asarray(boxes._data)
+    assert (b >= 0).all() and (b <= 1).all()
+    # first cell center is at offset 0.5 * step(8px) = (4, 4)/32 = 0.125
+    sq = b[0, 0, 0]  # min-size square, 8px wide -> ±4px around center
+    np.testing.assert_allclose(sq, [0.0, 0.0, 0.25, 0.25], atol=1e-6)
+    v = np.asarray(var._data)
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_anchor_generator_geometry():
+    feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+    anchors, var = L.anchor_generator(
+        feat, anchor_sizes=[16.0], aspect_ratios=[1.0],
+        variances=[0.1] * 4, stride=[16.0, 16.0])
+    a = np.asarray(anchors._data)
+    assert a.shape == (2, 2, 1, 4)
+    np.testing.assert_allclose(a[0, 0, 0], [0.0, 0.0, 16.0, 16.0],
+                               atol=1e-5)
+    w = a[..., 2] - a[..., 0]
+    np.testing.assert_allclose(w, 16.0, rtol=1e-6)
+
+
+def test_multiclass_nms_suppresses_and_caps():
+    # two near-identical boxes in class 1 -> one survives; class 0 is
+    # background and skipped
+    bb = np.asarray([[[0, 0, 10, 10], [0, 0, 10.5, 10.5],
+                      [20, 20, 30, 30]]], np.float32)
+    sc = np.zeros((1, 2, 3), np.float32)
+    sc[0, 1] = [0.9, 0.85, 0.7]
+    out, lod = L.multiclass_nms(paddle.to_tensor(bb),
+                                paddle.to_tensor(sc),
+                                score_threshold=0.5, nms_top_k=10,
+                                keep_top_k=10, nms_threshold=0.5)
+    o = np.asarray(out._data)
+    assert int(np.asarray(lod._data)[0]) == 2  # overlap suppressed
+    assert o.shape[1] == 6
+    assert set(o[:, 0]) == {1.0}
+    assert o[0, 1] >= o[1, 1]  # sorted by score
+
+
+def test_box_clip():
+    boxes = paddle.to_tensor(np.asarray(
+        [[-5.0, -5.0, 50.0, 50.0]], np.float32))
+    im_info = paddle.to_tensor(np.asarray([32.0, 32.0, 1.0], np.float32))
+    got = np.asarray(L.box_clip(boxes, im_info)._data)
+    np.testing.assert_allclose(got, [[0.0, 0.0, 31.0, 31.0]])
+
+
+def test_edit_distance_and_ctc_decoder():
+    d, num = L.edit_distance(
+        paddle.to_tensor(np.asarray([[1, 2, 3], [1, 1, 1]], np.int64)),
+        paddle.to_tensor(np.asarray([[1, 3, 3], [1, 1, 1]], np.int64)),
+        normalized=False)
+    np.testing.assert_allclose(np.asarray(d._data).reshape(-1), [1.0, 0.0])
+    assert int(np.asarray(num._data)) == 2
+
+    # CTC greedy: argmax path b,b,blank,a,a -> "ba"
+    probs = np.full((1, 5, 3), -5.0, np.float32)
+    path = [1, 1, 2, 0, 0]  # blank = 2
+    for t, c in enumerate(path):
+        probs[0, t, c] = 5.0
+    ids, lens = L.ctc_greedy_decoder(paddle.to_tensor(probs), blank=2)
+    np.testing.assert_array_equal(
+        np.asarray(ids._data)[0, :2], [1, 0])
+    assert int(np.asarray(lens._data)[0]) == 2
+
+
+def test_mean_iou_and_losses():
+    miou, wrong, correct = L.mean_iou(
+        paddle.to_tensor(np.asarray([0, 1, 1, 0], np.int64)),
+        paddle.to_tensor(np.asarray([0, 1, 0, 0], np.int64)), 2)
+    # class0: inter 2, union 3; class1: inter 1, union 2 -> mean 0.5833
+    np.testing.assert_allclose(float(np.asarray(miou._data)),
+                               (2 / 3 + 1 / 2) / 2, rtol=1e-5)
+
+    h = L.huber_loss(paddle.to_tensor(np.zeros(2, np.float32)),
+                     paddle.to_tensor(np.asarray([0.5, 3.0], np.float32)),
+                     delta=1.0)
+    np.testing.assert_allclose(np.asarray(h._data), [0.125, 2.5],
+                               rtol=1e-6)
+
+    r = L.rank_loss(paddle.to_tensor(np.asarray([1.0], np.float32)),
+                    paddle.to_tensor(np.asarray([2.0], np.float32)),
+                    paddle.to_tensor(np.asarray([1.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(r._data),
+                               np.log1p(np.exp(1.0)) - 1.0, rtol=1e-5)
+
+
+def test_box_clip_batched_im_info():
+    boxes = paddle.to_tensor(np.asarray(
+        [[[5, 5, 50, 50]], [[5, 5, 80, 80]]], np.float32))
+    im_info = paddle.to_tensor(np.asarray(
+        [[20, 20, 1.0], [100, 100, 1.0]], np.float32))
+    got = np.asarray(L.box_clip(boxes, im_info)._data)
+    np.testing.assert_allclose(got[0, 0], [5, 5, 19, 19])
+    np.testing.assert_allclose(got[1, 0], [5, 5, 80, 80])
+
+
+def test_ctc_decoder_honors_input_length():
+    probs = np.full((1, 4, 3), -5.0, np.float32)
+    for t, c in enumerate([1, 2, 2, 2]):  # blank=2; frames 2+ are padding
+        probs[0, t, c] = 5.0
+    # without length: path 1,2,2,2 -> [1]; with length=2 same here, so use
+    # a padding token that is NOT blank to show truncation matters
+    probs2 = np.full((1, 4, 3), -5.0, np.float32)
+    for t, c in enumerate([1, 2, 0, 0]):
+        probs2[0, t, c] = 5.0
+    ids_full, lens_full = L.ctc_greedy_decoder(
+        paddle.to_tensor(probs2), blank=2)
+    assert int(np.asarray(lens_full._data)[0]) == 2  # [1, 0]
+    ids_cut, lens_cut = L.ctc_greedy_decoder(
+        paddle.to_tensor(probs2), blank=2,
+        input_length=paddle.to_tensor(np.asarray([2], np.int64)))
+    assert int(np.asarray(lens_cut._data)[0]) == 1  # padding dropped
+
+
+def test_unique_inverse_index_contract():
+    x = paddle.to_tensor(np.asarray([2, 3, 3, 1, 5, 3], np.int64))
+    out, index = L.unique(x)
+    assert list(index.shape) == [6]
+    o, idx = np.asarray(out._data), np.asarray(index._data)
+    np.testing.assert_array_equal(o[idx], np.asarray([2, 3, 3, 1, 5, 3]))
+    out2, index2, count = L.unique_with_counts(x)
+    assert list(index2.shape) == [6]
+    assert int(count._data[list(o).index(3)]) == 3
+
+
+def test_natural_exp_decay_staircase():
+    sched = L.natural_exp_decay(1.0, decay_steps=1000, decay_rate=0.5,
+                                staircase=True)
+    for _ in range(10):
+        sched.step()
+    np.testing.assert_allclose(sched(), 1.0)  # before the first stair
+    sm = L.natural_exp_decay(1.0, decay_steps=10, decay_rate=0.5,
+                             staircase=False)
+    for _ in range(10):
+        sm.step()
+    np.testing.assert_allclose(sm(), np.exp(-0.5), rtol=1e-6)
+
+
+def test_affine_channel_defaults_and_multiclass_nms_pixel_mode():
+    x = paddle.to_tensor(np.ones((1, 2, 2, 2), np.float32))
+    out = L.affine_channel(x)  # identity when scale/bias absent
+    np.testing.assert_allclose(np.asarray(out._data),
+                               np.asarray(x._data))
+    sc_only = L.affine_channel(
+        x, scale=paddle.to_tensor(np.asarray([2.0, 3.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(sc_only._data)[0, :, 0, 0],
+                               [2.0, 3.0])
+    # pixel-coordinate (+1) IoU: 0..9 vs 5..14 -> IoU = 25/175 with +1
+    bb = np.asarray([[[0, 0, 9, 9], [5, 5, 14, 14]]], np.float32)
+    sc = np.zeros((1, 2, 2), np.float32)
+    sc[0, 1] = [0.9, 0.8]
+    out, lod = L.multiclass_nms(paddle.to_tensor(bb), paddle.to_tensor(sc),
+                                score_threshold=0.5, nms_top_k=5,
+                                keep_top_k=5, nms_threshold=0.14,
+                                normalized=False)
+    assert int(np.asarray(lod._data)[0]) == 1  # suppressed at pixel IoU
+
+
+def test_rnn_runner_and_cells():
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((2, 5, 4)).astype(np.float32))
+    out, state = L.rnn(L.GRUCell(4, 8), x)
+    assert list(out.shape) == [2, 5, 8]
+    out2, states2 = L.birnn(L.LSTMCell(4, 8), L.LSTMCell(4, 8), x)
+    assert list(out2.shape) == [2, 5, 16]
+
+
+def test_tail_aliases_present_and_sane():
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(2, 4))
+    np.testing.assert_allclose(
+        np.asarray(L.reverse(x, axis=1)._data)[0], [3, 2, 1, 0])
+    got = L.pad_constant_like(paddle.to_tensor(np.zeros((3, 5),
+                                                        np.float32)),
+                              paddle.to_tensor(np.ones((2, 4),
+                                                       np.float32)),
+                              pad_value=0.0)
+    assert list(got.shape) == [3, 5]
+    u = L.uniform_random_batch_size_like(x, [0, 7])
+    assert list(u.shape) == [2, 7]
+    out, counts = L.unique_with_counts(paddle.to_tensor(
+        np.asarray([1, 1, 2], np.int64)))[0:3:2]
+    fsp = L.fsp_matrix(paddle.to_tensor(np.ones((1, 2, 3, 3), np.float32)),
+                       paddle.to_tensor(np.ones((1, 5, 3, 3), np.float32)))
+    assert list(fsp.shape) == [1, 2, 5]
