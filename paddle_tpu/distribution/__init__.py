@@ -401,3 +401,63 @@ def _registered_kl(p, q):
             if s > score:
                 match, score = fn, s
     return match
+
+
+class MultivariateNormalDiag(Distribution):
+    """Multivariate normal with diagonal covariance (reference
+    fluid/layers/distributions.py:MultivariateNormalDiag): loc [..., D],
+    scale as the diagonal entries [..., D]."""
+
+    def __init__(self, loc, scale):
+        self.loc = loc if isinstance(loc, Tensor) else Tensor(
+            jnp.asarray(loc, jnp.float32))
+        self.scale = scale if isinstance(scale, Tensor) else Tensor(
+            jnp.asarray(scale, jnp.float32))
+        super().__init__()
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return apply(lambda s: s * s, self.scale)
+
+    def sample(self, shape=()):
+        from ..framework.random_seed import next_key
+        shape = tuple(shape)
+        key = next_key()
+
+        def _s(m, s):
+            full = shape + m.shape
+            return m + s * jax.random.normal(key, full, m.dtype)
+
+        return apply(_s, self.loc, self.scale)
+
+    def entropy(self):
+        def _e(s):
+            d = s.shape[-1]
+            return (0.5 * d * (1.0 + jnp.log(jnp.asarray(2 * jnp.pi)))
+                    + jnp.sum(jnp.log(s), axis=-1))
+        return apply(_e, self.scale)
+
+    def log_prob(self, value):
+        def _lp(v, m, s):
+            z = (v - m) / s
+            return (-0.5 * jnp.sum(z * z, axis=-1)
+                    - jnp.sum(jnp.log(s), axis=-1)
+                    - 0.5 * m.shape[-1] * jnp.log(jnp.asarray(2 * jnp.pi)))
+        return apply(_lp, value, self.loc, self.scale)
+
+    def kl_divergence(self, other):
+        def _kl(m1, s1, m2, s2):
+            var1, var2 = s1 * s1, s2 * s2
+            return 0.5 * jnp.sum(
+                var1 / var2 + ((m2 - m1) ** 2) / var2 - 1.0
+                + jnp.log(var2) - jnp.log(var1), axis=-1)
+        return apply(_kl, self.loc, self.scale, other.loc, other.scale)
+
+
+@register_kl(MultivariateNormalDiag, MultivariateNormalDiag)
+def _kl_mvndiag_mvndiag(p, q):
+    return p.kl_divergence(q)

@@ -15,6 +15,30 @@ import paddle_tpu as _p
 from ... import tensor_ops as _T
 from ...nn import functional as _F
 
+__all__ = [
+    # rnn / decode
+    'RNNCell', 'SimpleRNNCell', 'GRUCell', 'LSTMCell', 'BiRNN', 'rnn',
+    'birnn', 'BeamSearchDecoder', 'dynamic_decode',
+    # distributions
+    'Normal', 'Uniform', 'Categorical', 'MultivariateNormalDiag',
+    # detection
+    'anchor_generator', 'box_clip', 'box_coder', 'distribute_fpn_proposals',
+    'generate_proposals', 'iou_similarity', 'matrix_nms', 'multiclass_nms',
+    'prior_box', 'psroi_pool', 'roi_pool', 'prroi_pool', 'deformable_conv',
+    'read_file', 'yolov3_loss',
+    # tensor / nn tail
+    'cos_sim', 'crop', 'crop_tensor', 'diag', 'triu', 'unbind',
+    'multiplex', 'selu', 'lrn', 'shuffle_channel', 'space_to_depth',
+    'warpctc', 'margin_rank_loss', 'reverse', 'unique',
+    'unique_with_counts', 'hsigmoid', 'huber_loss', 'rank_loss',
+    'bpr_loss', 'mean_iou', 'adaptive_pool3d', 'resize_linear',
+    'resize_trilinear', 'image_resize_short', 'pad_constant_like',
+    'uniform_random_batch_size_like', 'gaussian_random_batch_size_like',
+    'sampling_id', 'add_position_encoding', 'affine_channel', 'fsp_matrix',
+    'edit_distance', 'ctc_greedy_decoder', 'tensor_array_to_tensor',
+    'Assert', 'autoincreased_step_counter',
+]
+
 
 # -- RNN cells / runners / decoding ----------------------------------------
 
@@ -46,7 +70,8 @@ def birnn(cell_fw, cell_bw, inputs, initial_states=None,
 
 # -- distribution classes (reference fluid/layers/distributions.py) --------
 
-from ...distribution import Categorical, Normal, Uniform  # noqa: F401
+from ...distribution import (Categorical,  # noqa: F401
+                             MultivariateNormalDiag, Normal, Uniform)
 
 
 # -- detection (reference fluid/layers/detection.py) -----------------------
@@ -65,7 +90,6 @@ prroi_pool = roi_pool  # precise RoI pooling approximated by RoIPool
 
 # -- tensor tail -----------------------------------------------------------
 
-cos_sim = _F.cosine_similarity
 crop = _T.crop
 crop_tensor = _T.crop
 diag = _T.diag
@@ -73,11 +97,49 @@ triu = _T.triu
 unbind = _T.unbind
 multiplex = _T.multiplex
 selu = _F.selu
-lrn = _F.local_response_norm
 shuffle_channel = _F.channel_shuffle
 space_to_depth = _F.pixel_unshuffle
-warpctc = _F.ctc_loss
-margin_rank_loss = _F.margin_ranking_loss
+
+
+def cos_sim(X, Y):
+    """fluid contract: rank-2 [N, 1] output (fluid/layers/nn.py:cos_sim)."""
+    return _T.unsqueeze(_F.cosine_similarity(X, Y, axis=-1), axis=-1)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format='NCHW'):
+    """fluid spelling: n is the window size, k the bias
+    (fluid/layers/nn.py:lrn)."""
+    return _F.local_response_norm(input, size=n, alpha=alpha, beta=beta,
+                                  k=k, data_format=data_format)
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    """fluid warpctc signature over 2.x ctc_loss; input is time-major
+    [T, B, C] as in the reference, lengths default to the full padded
+    extent (fluid/layers/loss.py:warpctc)."""
+    T, B = int(input.shape[0]), int(input.shape[1])
+    if input_length is None:
+        input_length = _T.full([B], T, dtype='int32')
+    if label_length is None:
+        label_length = _T.full([B], int(label.shape[-1]), dtype='int32')
+    return _F.ctc_loss(input, label, input_length, label_length,
+                       blank=blank, reduction='none',
+                       norm_by_times=norm_by_times)
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    """max(0, -label*(left-right) + margin) elementwise
+    (fluid/layers/loss.py:margin_rank_loss)."""
+    import jax.numpy as jnp
+
+    from ...tensor import apply
+
+    def _mrl(lab, l, r):
+        return jnp.maximum(0.0, -lab * (l - r) + margin)
+
+    return apply(_mrl, label, left, right)
 
 
 def reverse(x, axis):
@@ -256,12 +318,12 @@ def add_position_encoding(input, alpha, beta, name=None):
     def _ape(x):
         b, t, d = x.shape
         pos = jnp.arange(t, dtype=jnp.float32)[:, None]
-        half = d // 2
+        half = (d + 1) // 2  # ceil: sin part covers the extra column
         freq = jnp.power(10000.0, -jnp.arange(half, dtype=jnp.float32)
                          / max(half, 1))
         ang = pos * freq[None, :]
-        enc = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=1)
-        return alpha * x + beta * enc[None, :, :d].astype(x.dtype)
+        enc = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=1)[:, :d]
+        return alpha * x + beta * enc[None].astype(x.dtype)
 
     return apply(_ape, input)
 

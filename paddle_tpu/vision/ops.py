@@ -892,24 +892,30 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
 
 def anchor_generator(input, anchor_sizes, aspect_ratios, variances,
                      stride, offset=0.5, name=None):
-    """RPN anchors over a feature map (reference fluid/layers/
-    detection.py:anchor_generator): per cell, for each aspect ratio and
-    size, w = sqrt(size^2 / ar), h = w * ar."""
+    """RPN anchors over a feature map, matching the reference kernel
+    (paddle/fluid/operators/detection/anchor_generator_op.h): centers at
+    idx*stride + offset*(stride-1), per-ratio widths rounded Faster-RCNN
+    style (w = round(sqrt(area/ar)), h = round(w*ar)) scaled by
+    size/stride, box extents ±0.5*(w-1)."""
     fh, fw = int(input.shape[2]), int(input.shape[3])
+    sw, sh = float(stride[0]), float(stride[1])
     whs = []
     for ar in aspect_ratios:
+        area = sw * sh
+        w0 = np.round(np.sqrt(area / float(ar)))
+        h0 = np.round(w0 * float(ar))
         for s in anchor_sizes:
-            w = np.sqrt(float(s) ** 2 / float(ar))
-            whs.append((w, w * float(ar)))
+            scale_w, scale_h = float(s) / sw, float(s) / sh
+            whs.append((scale_w * w0, scale_h * h0))
     whs = np.asarray(whs, np.float32)
-    cx = (np.arange(fw, dtype=np.float32) + offset) * float(stride[0])
-    cy = (np.arange(fh, dtype=np.float32) + offset) * float(stride[1])
+    cx = np.arange(fw, dtype=np.float32) * sw + offset * (sw - 1)
+    cy = np.arange(fh, dtype=np.float32) * sh + offset * (sh - 1)
     cxg, cyg = np.meshgrid(cx, cy)
     anchors = np.empty((fh, fw, len(whs), 4), np.float32)
-    anchors[..., 0] = cxg[..., None] - 0.5 * whs[None, None, :, 0]
-    anchors[..., 1] = cyg[..., None] - 0.5 * whs[None, None, :, 1]
-    anchors[..., 2] = cxg[..., None] + 0.5 * whs[None, None, :, 0]
-    anchors[..., 3] = cyg[..., None] + 0.5 * whs[None, None, :, 1]
+    anchors[..., 0] = cxg[..., None] - 0.5 * (whs[None, None, :, 0] - 1)
+    anchors[..., 1] = cyg[..., None] - 0.5 * (whs[None, None, :, 1] - 1)
+    anchors[..., 2] = cxg[..., None] + 0.5 * (whs[None, None, :, 0] - 1)
+    anchors[..., 3] = cyg[..., None] + 0.5 * (whs[None, None, :, 1] - 1)
     var = np.broadcast_to(np.asarray(variances, np.float32),
                           anchors.shape).copy()
     return Tensor(jnp.asarray(anchors)), Tensor(jnp.asarray(var))

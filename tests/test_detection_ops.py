@@ -71,17 +71,26 @@ def test_prior_box_geometry():
     np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
 
 
-def test_anchor_generator_geometry():
+def test_anchor_generator_reference_geometry():
+    """Reference kernel parity (anchor_generator_op.h): stride 16,
+    size 16, ar 1 -> first anchor [0, 0, 15, 15] centered at 7.5."""
     feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
     anchors, var = L.anchor_generator(
         feat, anchor_sizes=[16.0], aspect_ratios=[1.0],
         variances=[0.1] * 4, stride=[16.0, 16.0])
     a = np.asarray(anchors._data)
     assert a.shape == (2, 2, 1, 4)
-    np.testing.assert_allclose(a[0, 0, 0], [0.0, 0.0, 16.0, 16.0],
+    np.testing.assert_allclose(a[0, 0, 0], [0.0, 0.0, 15.0, 15.0],
                                atol=1e-5)
-    w = a[..., 2] - a[..., 0]
-    np.testing.assert_allclose(w, 16.0, rtol=1e-6)
+    np.testing.assert_allclose(a[0, 1, 0], [16.0, 0.0, 31.0, 15.0],
+                               atol=1e-5)
+    # ar=2: w = round(sqrt(256/2)) = 11, h = round(11*2) = 22
+    a2, _ = L.anchor_generator(feat, anchor_sizes=[16.0],
+                               aspect_ratios=[2.0], variances=[0.1] * 4,
+                               stride=[16.0, 16.0])
+    a2 = np.asarray(a2._data)
+    np.testing.assert_allclose(a2[0, 0, 0, 2] - a2[0, 0, 0, 0] + 1, 11.0)
+    np.testing.assert_allclose(a2[0, 0, 0, 3] - a2[0, 0, 0, 1] + 1, 22.0)
 
 
 def test_multiclass_nms_suppresses_and_caps():
@@ -229,6 +238,38 @@ def test_rnn_runner_and_cells():
     assert list(out.shape) == [2, 5, 8]
     out2, states2 = L.birnn(L.LSTMCell(4, 8), L.LSTMCell(4, 8), x)
     assert list(out2.shape) == [2, 5, 16]
+
+
+def test_fluid_wrapper_signatures():
+    # margin_rank_loss(label, left, right, margin=0.1)
+    out = L.margin_rank_loss(
+        paddle.to_tensor(np.asarray([1.0], np.float32)),
+        paddle.to_tensor(np.asarray([0.2], np.float32)),
+        paddle.to_tensor(np.asarray([0.5], np.float32)))
+    np.testing.assert_allclose(np.asarray(out._data), [0.4], rtol=1e-6)
+    # lrn(input, n=5, k=1.0, ...): positional n and k bind correctly
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((1, 8, 4, 4)).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(L.lrn(x)._data),
+        np.asarray(L.lrn(x, 5, 1.0, 1e-4, 0.75)._data), rtol=1e-6)
+    # warpctc(input, label) works without explicit lengths (time-major
+    # [T, B, C] input as in the reference)
+    logits = paddle.to_tensor(np.random.default_rng(1)
+                              .standard_normal((6, 2, 5)).astype(np.float32))
+    labels = paddle.to_tensor(np.asarray([[1, 2], [3, 4]], np.int32))
+    loss = L.warpctc(logits, labels, blank=0)
+    assert np.isfinite(np.asarray(loss._data)).all()
+    # cos_sim keeps the fluid [N, 1] contract
+    a = paddle.to_tensor(np.ones((3, 4), np.float32))
+    assert list(L.cos_sim(a, a).shape) == [3, 1]
+    # odd hidden size position encoding
+    pe = L.add_position_encoding(
+        paddle.to_tensor(np.zeros((1, 4, 5), np.float32)), 1.0, 1.0)
+    assert list(pe.shape) == [1, 4, 5]
+    # star-import hygiene: tail's __all__ gates what layers re-exports
+    from paddle_tpu.fluid.layers import tail
+    assert "np" not in tail.__all__ and "annotations" not in tail.__all__
 
 
 def test_tail_aliases_present_and_sane():

@@ -79,3 +79,28 @@ def test_kl_divergence_normal():
     kl = float(paddle.distribution.kl_divergence(p, q).numpy())
     want = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
     np.testing.assert_allclose(kl, want, rtol=1e-5)
+
+
+def test_multivariate_normal_diag():
+    import numpy as np
+
+    from paddle_tpu.distribution import MultivariateNormalDiag
+
+    d = MultivariateNormalDiag(np.zeros(3, np.float32),
+                               np.ones(3, np.float32))
+    s = d.sample((500,))
+    assert list(s.shape) == [500, 3]
+    lp = np.asarray(d.log_prob(s)._data)
+    assert np.isfinite(lp).all()
+    d2 = MultivariateNormalDiag(np.ones(3, np.float32),
+                                2 * np.ones(3, np.float32))
+    kl = float(np.asarray(d.kl_divergence(d2)._data))
+    want = 0.5 * 3 * (0.25 + 0.25 - 1 + np.log(4.0))
+    np.testing.assert_allclose(kl, want, rtol=1e-5)
+    ent = float(np.asarray(d.entropy()._data))
+    np.testing.assert_allclose(ent, 1.5 * (1 + np.log(2 * np.pi)),
+                               rtol=1e-5)
+    # log_prob of the mean is the density peak
+    peak = float(np.asarray(d.log_prob(
+        np.zeros((1, 3), np.float32))._data)[0])
+    np.testing.assert_allclose(peak, -1.5 * np.log(2 * np.pi), rtol=1e-5)
