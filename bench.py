@@ -399,55 +399,48 @@ def _backend_or_die(timeout_s=300):
     return result["backend"]
 
 
+def _run_guarded(fn, backend, deadline_s):
+    """Run one bench on a daemon thread with a deadline: a wedged TPU
+    tunnel mid-computation must not hang the whole bench (the thread
+    leaks if stuck, but the process exits after the JSON line is
+    printed). Exceptions are recorded, distinct from stalls."""
+    import threading
+
+    box = {}
+
+    def work():
+        try:
+            box["result"] = fn(backend)
+        except Exception as e:
+            box["result"] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+            traceback.print_exc(file=sys.stderr)
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(deadline_s)
+    return box.get("result", {"error": f"timed out after {deadline_s:.0f}s "
+                                       "(TPU tunnel stall?)"})
+
+
 def main():
     backend = _backend_or_die()
 
-    import threading
-    box = {}
-
-    def _headline():
-        box["r"] = bench_llama(backend)
-
-    t = threading.Thread(target=_headline, daemon=True)
-    t.start()
-    t.join(float(os.environ.get("PADDLE_TPU_BENCH_HEADLINE_S", "900")))
-    if "r" not in box:
+    headline = _run_guarded(
+        bench_llama, backend,
+        float(os.environ.get("PADDLE_TPU_BENCH_HEADLINE_S", "900")))
+    if "error" in headline:
         print(json.dumps({
             "metric": "llama-0.5B pretrain tokens/sec/chip (bf16+flash, "
-                      "AdamW, stalled)",
+                      "AdamW, failed)",
             "value": 0.0, "unit": "tokens/sec/chip", "vs_baseline": 0.0,
-            "extra": {"error": "headline bench stalled (TPU tunnel hang "
-                               "mid-computation); no throughput recorded"},
+            "extra": {"error": headline["error"]},
         }))
         return
-    headline = box["r"]
 
     secondary = {}
     t_start = time.perf_counter()
     budget = float(os.environ.get("PADDLE_TPU_BENCH_BUDGET_S", "900"))
     if os.environ.get("PADDLE_TPU_BENCH_SECONDARY", "1") != "0":
-        def _run_guarded(name, fn, deadline_s):
-            """Run one secondary on a daemon thread with a deadline: a
-            wedged TPU tunnel mid-bench must not hang the whole bench
-            (the thread leaks if stuck, but the process exits after the
-            JSON line is printed)."""
-            box = {}
-
-            def work():
-                try:
-                    box["result"] = fn(backend)
-                except Exception as e:
-                    box["result"] = {
-                        "error": f"{type(e).__name__}: {str(e)[:200]}"}
-                    traceback.print_exc(file=sys.stderr)
-
-            t = threading.Thread(target=work, daemon=True)
-            t.start()
-            t.join(deadline_s)
-            return box.get("result",
-                           {"error": f"timed out after {deadline_s:.0f}s "
-                                     "(TPU tunnel stall?)"})
-
         for name, fn in (("resnet50", bench_resnet50),
                          ("bert_base_dp", bench_bert),
                          ("vit_b16", bench_vit),
@@ -459,7 +452,8 @@ def main():
             if remaining <= 0:
                 secondary[name] = {"skipped": "bench time budget exhausted"}
                 continue
-            secondary[name] = _run_guarded(name, fn, min(remaining, 420.0))
+            secondary[name] = _run_guarded(fn, backend,
+                                           min(remaining, 420.0))
 
     tokens_per_sec = headline["tokens_per_sec"]
     best = _best_previous()

@@ -504,7 +504,19 @@ def pad2d(input, paddings=(0, 0, 0, 0), mode='constant', pad_value=0.0,
 
 def _mk_cmp(fn):
     def op(x, y, cond=None, name=None):
-        return fn(x, y)
+        out = fn(x, y)
+        if cond is not None:
+            from ...static.program import Program
+
+            # fluid out-param: write the fresh value into `cond`, and
+            # re-sync on every static replay (the While loop condition)
+            def _sync(o=out, c=cond):
+                c._data = o._data
+                c._node = None
+
+            Program.record_mutation(_sync)
+            return cond
+        return out
     return op
 
 
@@ -776,3 +788,4 @@ diag_embed = _F.diag_embed
 
 
 from .tail import *  # noqa: F401,F403  (legacy long tail)
+from .control_flow_legacy import IfElse, Switch, While  # noqa: F401

@@ -1,0 +1,166 @@
+"""Block-style legacy control flow: While / IfElse / Switch.
+
+Reference: python/paddle/fluid/layers/control_flow.py (While:1100,
+IfElse:1751, Switch:2395). The reference appends sub-block ops to the
+program and the C++ executor loops/branches over them; here the
+define-by-run Program records each block as an op span and collapses it
+into a single thunk that re-replays the span — so data-dependent loop
+conditions work at Executor.run time (each iteration re-executes the
+recorded body eagerly).
+
+Lax-backed `cond`/`while_loop` (static/nn.py) remain the compiled,
+jit-friendly path; these classes exist for 1.x-era scripts.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ...static.program import Program, default_main_program
+
+
+def _scalar_bool(t):
+    return bool(np.asarray(t._data).reshape(-1)[0])
+
+
+@contextlib.contextmanager
+def _captured_span(prog):
+    """Record ops into prog, then pop them off as a span on exit."""
+    start = len(prog._ops)
+    holder = {}
+    try:
+        yield holder
+    finally:
+        holder["span"] = prog._ops[start:]
+        del prog._ops[start:]
+
+
+class While:
+    """``while_op = While(cond); with while_op.block(): ...`` — the body
+    must refresh ``cond`` (e.g. ``less_than(i, n, cond=cond)``).
+    Reference: fluid/layers/control_flow.py:While."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        self._cond = cond
+        self._prog = default_main_program()
+
+    @contextlib.contextmanager
+    def block(self):
+        with _captured_span(self._prog) as holder:
+            yield
+        span = holder["span"]
+        cond = self._cond
+
+        def _loop():
+            guard = 0
+            while _scalar_bool(cond):
+                Program._replay_entries(span)
+                guard += 1
+                if guard > 10_000_000:
+                    raise RuntimeError("While exceeded 1e7 iterations")
+
+        self._prog._append_thunk(_loop)
+
+
+class IfElse:
+    """Row-wise conditional (reference fluid/layers/control_flow.py:
+    IfElse): rows of the inputs where ``cond`` holds flow through the
+    true block, the rest through the false block, and ``()`` merges the
+    outputs back in row order.
+
+    TPU-dense semantics: both blocks run on the FULL batch and the merge
+    selects rows by ``cond`` — same results, no gather/scatter of
+    dynamic row subsets (which would be unshardable shapes).
+    """
+
+    def __init__(self, cond, name=None):
+        self._cond = cond
+        self._prog = default_main_program()
+        self._outputs = {True: [], False: []}
+        self._in_true = None
+
+    def input(self, x):
+        return x  # full batch; the merge applies the row mask
+
+    @contextlib.contextmanager
+    def true_block(self):
+        # block ops record (and replay) unconditionally — the merge in
+        # __call__ row-selects; the context only routes output()
+        self._in_true = True
+        try:
+            yield
+        finally:
+            self._in_true = None
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self._in_true = False
+        try:
+            yield
+        finally:
+            self._in_true = None
+
+    def output(self, *outs):
+        if self._in_true is None:
+            raise RuntimeError("IfElse.output called outside a block")
+        self._outputs[self._in_true].extend(outs)
+
+    def __call__(self):
+        from ...tensor_ops.manipulation import where as _where
+        true_outs = self._outputs[True]
+        false_outs = self._outputs[False]
+        if len(true_outs) != len(false_outs):
+            raise ValueError(
+                "IfElse true/false blocks produced different output "
+                f"counts: {len(true_outs)} vs {len(false_outs)}")
+        merged = []
+        for t, f in zip(true_outs, false_outs):
+            cond = self._cond
+            # cond is [N, 1]; broadcast over trailing dims
+            c = cond
+            while len(c.shape) < len(t.shape):
+                from ...tensor_ops.manipulation import unsqueeze
+                c = unsqueeze(c, axis=-1)
+            merged.append(_where(c.astype('bool'), t, f))
+        return merged
+
+
+class Switch:
+    """``with switch.case(cond): ...`` / ``with switch.default(): ...`` —
+    at replay, the FIRST case whose scalar condition holds runs; record-
+    time executes each block once to capture it (outputs are overwritten
+    at run time). Reference: fluid/layers/control_flow.py:Switch."""
+
+    def __init__(self, name=None):
+        self._prog = default_main_program()
+        self._cases = []  # (cond or None, span)
+        self._entered = False
+
+    @contextlib.contextmanager
+    def __wrap(self, cond):
+        with _captured_span(self._prog) as holder:
+            yield
+        self._cases.append((cond, holder["span"]))
+
+    def case(self, condition):
+        return self.__wrap(condition)
+
+    def default(self):
+        return self.__wrap(None)
+
+    def __enter__(self):
+        self._entered = True
+        return self
+
+    def __exit__(self, *exc):
+        cases = list(self._cases)
+
+        def _dispatch():
+            for cond, span in cases:
+                if cond is None or _scalar_bool(cond):
+                    Program._replay_entries(span)
+                    return
+
+        self._prog._append_thunk(_dispatch)
+        return False

@@ -71,8 +71,23 @@ class Program:
 
     # -- execution ---------------------------------------------------------
     def _replay(self):
+        self._replay_entries(self._ops)
+
+    @staticmethod
+    def record_mutation(thunk):
+        """Run an in-place mutation now AND re-run it on every static
+        replay (fluid idioms: increment, assign-into-var, cond out-
+        params). No-op registration outside program recording."""
+        thunk()
+        if _current_main is not None:
+            _current_main._append_thunk(thunk)
+
+    @staticmethod
+    def _replay_entries(entries):
+        """Replay a span of recorded ops/thunks (also used by the fluid
+        block-style control flow to re-run a body per iteration)."""
         from ..tensor import apply
-        for entry in self._ops:
+        for entry in entries:
             if entry[0] == "thunk":
                 entry[1]()
                 continue

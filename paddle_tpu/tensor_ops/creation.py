@@ -142,11 +142,16 @@ def triu_indices(row, col=None, offset=0, dtype="int64"):
 
 
 def assign(x, output=None):
-    data = raw(x)
     if output is not None:
-        output._data = jnp.asarray(data)
+        from ..static.program import Program
+
+        def _copy():
+            output._data = jnp.asarray(raw(x))
+            output._node = None
+
+        Program.record_mutation(_copy)
         return output
-    return Tensor(jnp.asarray(data))
+    return Tensor(jnp.asarray(raw(x)))
 
 
 def clone(x, name=None):

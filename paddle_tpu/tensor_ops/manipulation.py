@@ -272,8 +272,12 @@ def masked_fill(x, mask, value, name=None):
 def where(condition, x=None, y=None, name=None):
     if x is None and y is None:
         return nonzero(condition, as_tuple=True)
-    c = raw(condition)
-    return apply(lambda a, b: jnp.where(c, a, b), x, y)
+    # condition rides apply as a positional arg (NOT a baked closure
+    # constant) so static replay re-reads it; stop_gradient inside the
+    # lambda keeps the mask non-differentiable without snapshotting the
+    # tensor (a snapshot would freeze the mask across replays)
+    return apply(lambda c, a, b: jnp.where(jax.lax.stop_gradient(c), a, b),
+                 condition, x, y)
 
 
 def nonzero(x, as_tuple=False):

@@ -269,7 +269,13 @@ def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
 
 
 def increment(x, value=1.0, name=None):
-    x._data = x._data + value
+    from ..static.program import Program
+
+    def _inc():
+        x._data = x._data + value
+        x._node = None
+
+    Program.record_mutation(_inc)
     return x
 
 

@@ -1,0 +1,128 @@
+"""Block-style legacy control flow (While/IfElse/Switch) on the
+record/replay executor.
+
+Reference: python/paddle/fluid/layers/control_flow.py — While:1100
+(loop over a sub-block with an out-param condition), IfElse:1751
+(row-wise conditional), Switch:2395 (first-true-case dispatch, the 1.x
+LR-schedule idiom).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def test_while_accumulates_until_condition():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        n = layers.fill_constant([1], 'float32', 10.0)
+        i = layers.fill_constant([1], 'float32', 0.0)
+        acc = layers.fill_constant([1], 'float32', 0.0)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            layers.increment(i, value=1.0)
+            a2 = layers.elementwise_add(acc, i)
+            layers.assign(a2, acc)
+            layers.less_than(i, n, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (i_v, acc_v) = exe.run(main, feed={}, fetch_list=[i, acc])
+    assert float(np.asarray(i_v).reshape(-1)[0]) == 10.0
+    assert float(np.asarray(acc_v).reshape(-1)[0]) == 55.0  # 1+..+10
+    # replay again: same result (state is reset by the recorded creators)
+    (i_v2, acc_v2) = exe.run(main, feed={}, fetch_list=[i, acc])
+    assert float(np.asarray(acc_v2).reshape(-1)[0]) == 55.0
+
+
+def test_while_condition_depends_on_feed():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        n = layers.data(name="n", shape=[1], dtype="float32",
+                        append_batch_size=False)
+        i = layers.fill_constant([1], 'float32', 0.0)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            layers.increment(i, value=1.0)
+            layers.less_than(i, n, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    for bound in (3.0, 7.0):
+        (got,) = exe.run(main, feed={"n": np.asarray([bound], np.float32)},
+                         fetch_list=[i])
+        assert float(np.asarray(got).reshape(-1)[0]) == bound
+
+
+def test_ifelse_rowwise_merge():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[1], dtype="float32")
+        zero = layers.fill_constant([1], 'float32', 0.0)
+        cond = layers.greater_than(x, zero)
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            pos = layers.elementwise_mul(
+                x, layers.fill_constant([1], 'float32', 2.0))
+            ie.output(pos)
+        with ie.false_block():
+            neg = layers.elementwise_mul(
+                x, layers.fill_constant([1], 'float32', -1.0))
+            ie.output(neg)
+        (out,) = ie()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = np.asarray([[1.0], [-2.0], [3.0], [-4.0]], np.float32)
+    (got,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got).reshape(-1),
+                               [2.0, 2.0, 6.0, 4.0])
+
+
+def test_where_mask_fresh_across_replays_with_trainable_cond():
+    """Regression: where() must not snapshot the condition — a mask
+    derived from a non-stop-gradient tensor has to refresh per replay."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[1], dtype="float32")
+        p = paddle.static.create_parameter([1], 'float32')
+        p.stop_gradient = False
+        xp = layers.elementwise_mul(x, paddle.ones_like(p))
+        xp.stop_gradient = False
+        cond = layers.greater_than(xp, layers.fill_constant(
+            [1], 'float32', 0.0))
+        cond.stop_gradient = False  # worst case: differentiable-marked mask
+        out = paddle.where(cond, layers.elementwise_mul(
+            x, layers.fill_constant([1], 'float32', 2.0)), x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    for xs, want in ((np.asarray([[1.0]], np.float32), 2.0),
+                     (np.asarray([[-3.0]], np.float32), -3.0),
+                     (np.asarray([[4.0]], np.float32), 8.0)):
+        (got,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+        assert float(np.asarray(got).reshape(-1)[0]) == want
+
+
+def test_switch_first_true_case():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        step = layers.data(name="step", shape=[1], dtype="float32",
+                           append_batch_size=False)
+        lr = layers.fill_constant([1], 'float32', 0.0)
+        b1 = layers.fill_constant([1], 'float32', 100.0)
+        b2 = layers.fill_constant([1], 'float32', 200.0)
+        with layers.Switch() as switch:
+            with switch.case(layers.less_than(step, b1)):
+                layers.assign(layers.fill_constant([1], 'float32', 0.1), lr)
+            with switch.case(layers.less_than(step, b2)):
+                layers.assign(layers.fill_constant([1], 'float32', 0.05),
+                              lr)
+            with switch.default():
+                layers.assign(layers.fill_constant([1], 'float32', 0.01),
+                              lr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    for s, want in ((50.0, 0.1), (150.0, 0.05), (500.0, 0.01)):
+        (got,) = exe.run(main, feed={"step": np.asarray([s], np.float32)},
+                         fetch_list=[lr])
+        assert float(np.asarray(got).reshape(-1)[0]) == np.float32(want), s
