@@ -114,14 +114,19 @@ class IfElse:
             raise ValueError(
                 "IfElse true/false blocks produced different output "
                 f"counts: {len(true_outs)} vs {len(false_outs)}")
+        from ...tensor_ops.manipulation import squeeze, unsqueeze
         merged = []
         for t, f in zip(true_outs, false_outs):
-            cond = self._cond
-            # cond is [N, 1]; broadcast over trailing dims
-            c = cond
+            # align cond's rank to the output: pad with trailing 1-dims
+            # for higher-rank outputs, squeeze trailing 1-dims for
+            # lower-rank ones ([N,1] cond vs [N] output must not
+            # broadcast to [N,N])
+            c = self._cond
             while len(c.shape) < len(t.shape):
-                from ...tensor_ops.manipulation import unsqueeze
                 c = unsqueeze(c, axis=-1)
+            while (len(c.shape) > len(t.shape)
+                   and int(c.shape[-1]) == 1):
+                c = squeeze(c, axis=-1)
             merged.append(_where(c.astype('bool'), t, f))
         return merged
 

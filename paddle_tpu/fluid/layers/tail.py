@@ -37,6 +37,11 @@ __all__ = [
     'sampling_id', 'add_position_encoding', 'affine_channel', 'fsp_matrix',
     'edit_distance', 'ctc_greedy_decoder', 'tensor_array_to_tensor',
     'Assert', 'autoincreased_step_counter',
+    # recurrent builders + vision/legacy tail (second pass)
+    'lstm', 'lstm_unit', 'gru_unit', 'im2sequence', 'random_crop',
+    'center_loss', 'teacher_student_sigmoid_loss', 'hash',
+    'bipartite_match', 'density_prior_box', 'detection_output',
+    'sampled_softmax_with_cross_entropy',
 ]
 
 
@@ -470,3 +475,364 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
     else:
         _tick()
     return counter
+
+
+# -- recurrent builders (reference fluid/layers/rnn.py) --------------------
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """cuDNN-style stacked LSTM builder (reference fluid/layers/rnn.py:
+    lstm): input [B, T, D], init_h/init_c [L*dirs, B, H]. Returns
+    (out, last_h, last_c). Weights are created per call (static-program
+    idiom) via an nn.LSTM cached on the current program."""
+    from ...nn.layer.rnn import LSTM
+    from ...static.program import default_main_program
+
+    prog = default_main_program()
+    key = (id(prog), name or "fluid_lstm", int(input.shape[-1]),
+           int(hidden_size), int(num_layers), bool(is_bidirec))
+    cache = getattr(prog, "_fluid_lstm_cache", None)
+    if cache is None:
+        cache = prog._fluid_lstm_cache = {}
+    if key not in cache:
+        cache[key] = LSTM(int(input.shape[-1]), hidden_size,
+                          num_layers=num_layers,
+                          direction="bidirect" if is_bidirec else "forward",
+                          dropout=dropout_prob)
+    runner = cache[key]
+    out, (h, c) = runner(input, (init_h, init_c))
+    return out, h, c
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """Single LSTM step (reference fluid/layers/rnn.py:lstm_unit)."""
+    from ...nn.layer.rnn import LSTMCell
+    from ...static.program import default_main_program
+
+    prog = default_main_program()
+    cache = getattr(prog, "_fluid_lstmunit_cache", None)
+    if cache is None:
+        cache = prog._fluid_lstmunit_cache = {}
+    key = (id(prog), name or "fluid_lstm_unit", int(x_t.shape[-1]),
+           int(hidden_t_prev.shape[-1]))
+    if key not in cache:
+        cache[key] = LSTMCell(int(x_t.shape[-1]),
+                              int(hidden_t_prev.shape[-1]))
+    h, (h2, c2) = cache[key](x_t, (hidden_t_prev, cell_t_prev))
+    return h2, c2
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation='tanh', gate_activation='sigmoid',
+             origin_mode=False):
+    """Single GRU step (reference fluid/layers/rnn.py:gru_unit). ``size``
+    is 3*hidden_dim as in fluid. Returns (hidden, reset_hidden_prev,
+    gate) — the aux outputs are approximated by the new hidden state."""
+    from ...nn.layer.rnn import GRUCell
+    from ...static.program import default_main_program
+
+    hid = size // 3
+    prog = default_main_program()
+    cache = getattr(prog, "_fluid_gruunit_cache", None)
+    if cache is None:
+        cache = prog._fluid_gruunit_cache = {}
+    key = (id(prog), "fluid_gru_unit", int(input.shape[-1]), hid)
+    if key not in cache:
+        cache[key] = GRUCell(int(input.shape[-1]), hid)
+    h, _ = cache[key](input, hidden)
+    return h, h, h
+
+
+# -- vision/legacy tail ----------------------------------------------------
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    """Sliding-window patches to a [N*H'*W', fh*fw*C] matrix
+    (reference fluid/layers/nn.py:im2sequence)."""
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size, filter_size]
+    st = stride if isinstance(stride, (list, tuple)) else [stride, stride]
+    pd = padding if isinstance(padding, (list, tuple)) \
+        else [padding, padding]
+    pd = list(pd)
+    if len(pd) == 4:
+        # fluid [up, down, left, right] -> unfold [top, left, bottom,
+        # right]
+        pd = [pd[0], pd[2], pd[1], pd[3]]
+    cols = _F.unfold(input, list(fs), strides=list(st), paddings=pd)
+    # cols: [N, C*fh*fw, L] -> [N*L, C*fh*fw]
+    n, d, l = (int(s) for s in cols.shape)
+    return _T.reshape(_T.transpose(cols, [0, 2, 1]), [n * l, d])
+
+
+def random_crop(x, shape, seed=None):
+    """Random spatial crop to `shape` (trailing dims), re-randomized on
+    every static replay (reference fluid/layers/nn.py:random_crop
+    re-crops each iteration)."""
+    import jax.numpy as jnp
+
+    from ...static.program import Program
+    from ...tensor import Tensor
+
+    rng = np.random.default_rng(None if seed in (None, 0) else seed)
+    tgt = [int(s) for s in shape]
+    out = Tensor(jnp.zeros(tuple([int(s) for s in x.shape]
+                                 [:len(x.shape) - len(tgt)] + tgt),
+                           x._data.dtype))
+    out.stop_gradient = True
+
+    def _crop():
+        arr = np.asarray(x._data)
+        full = list(arr.shape)
+        lead = len(full) - len(tgt)
+        starts = [0] * lead + [
+            int(rng.integers(0, full[lead + i] - tgt[i] + 1))
+            for i in range(len(tgt))]
+        sl = tuple(slice(s, s + e)
+                   for s, e in zip(starts, full[:lead] + tgt))
+        out._data = jnp.asarray(arr[sl])
+        out._node = None
+
+    Program.record_mutation(_crop)
+    return out
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    """Center loss (reference fluid/layers/loss.py:center_loss): pulls
+    features toward per-class centers; centers update by EMA on the
+    host side when update_center (non-differentiable buffer)."""
+    import jax.numpy as jnp
+
+    from ...static.program import (Program, create_parameter,
+                                   default_main_program)
+    from ...tensor import apply
+
+    d = int(input.shape[-1])
+    prog = default_main_program()
+    cache = getattr(prog, "_center_loss_cache", None)
+    if cache is None:
+        cache = prog._center_loss_cache = {}
+    ckey = (num_classes, d)
+    if ckey not in cache:
+        c = create_parameter((num_classes, d), str(input.dtype),
+                             name=f"center_loss_centers_{num_classes}x{d}",
+                             attr=param_attr)
+        c.stop_gradient = True
+        cache[ckey] = c
+    centers = cache[ckey]  # persists across calls: the EMA accumulates
+
+    def _cl(x, lab, c):
+        lab = lab.reshape(x.shape[0]).astype(jnp.int32)
+        diff = x - c[lab]
+        return 0.5 * jnp.sum(diff * diff, axis=-1, keepdims=True)
+
+    loss = apply(_cl, input, label, centers)
+
+    if update_center:
+        def _update():
+            x = np.asarray(input._data)
+            lab = np.asarray(label._data).reshape(-1).astype(np.int64)
+            c = np.asarray(centers._data)
+            diff = c[lab] - x
+            counts = np.bincount(lab, minlength=num_classes)[lab] \
+                .astype(x.dtype).reshape(-1, 1)
+            upd = np.zeros_like(c)
+            np.add.at(upd, lab, alpha * diff / (1.0 + counts))
+            import jax.numpy as jnp_
+            centers._data = jnp_.asarray(c - upd)
+
+        Program.record_mutation(_update)
+    return loss
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """CTR distillation loss (reference fluid/layers/loss.py:
+    teacher_student_sigmoid_loss): label<0 -> teacher part only via
+    sigmoid CE on |label|; here the widely-used reduced form
+    log(1+exp(z)) - z*label with clipping."""
+    import jax.numpy as jnp
+
+    from ...tensor import apply
+
+    def _ts(z, y):
+        z = jnp.clip(z, soft_max_lower_bound, soft_max_up_bound)
+        return jnp.log1p(jnp.exp(z)) - z * y
+
+    return apply(_ts, input, label)
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """Deterministic multi-hash of integer ids into [0, hash_size)
+    (reference fluid/layers/nn.py:hash, xxhash-based; here splitmix64-
+    style mixing per hash seed — deterministic, well-spread)."""
+    import jax.numpy as jnp
+
+    from ...tensor import apply
+
+    def _hash(ids):
+        v = ids.astype(jnp.uint32)
+        outs = []
+        for k in range(num_hash):
+            seed_k = (0x9E3779B9 * (k + 1)) & 0xFFFFFFFF
+            h = v * jnp.uint32(2654435761) ^ jnp.uint32(seed_k)
+            h = h ^ (h >> 16)
+            h = h * jnp.uint32(0x85EBCA6B)
+            h = h ^ (h >> 13)
+            outs.append((h % jnp.uint32(hash_size)).astype(jnp.int64))
+        return jnp.stack(outs, axis=-1).reshape(
+            tuple(ids.shape[:-1]) + (num_hash * ids.shape[-1],))
+
+    return apply(_hash, input)
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """Greedy bipartite matching over a [N, M] similarity matrix
+    (reference fluid/layers/detection.py:bipartite_match). Returns
+    (match_indices [1, M], match_dist [1, M]) for one instance (batch
+    via LoD is not modeled). Host-side: data-dependent control flow."""
+    from ...tensor import Tensor
+    import jax.numpy as jnp
+
+    d = np.asarray(dist_matrix._data if hasattr(dist_matrix, "_data")
+                   else dist_matrix).copy()
+    n, m = d.shape
+    match_idx = np.full(m, -1, np.int64)
+    match_dist = np.zeros(m, np.float32)
+    work = d.copy()
+    for _ in range(min(n, m)):
+        i, j = np.unravel_index(np.argmax(work), work.shape)
+        if work[i, j] <= 0:
+            break
+        match_idx[j] = i
+        match_dist[j] = d[i, j]
+        work[i, :] = -1.0
+        work[:, j] = -1.0
+    if match_type == "per_prediction":
+        thr = dist_threshold if dist_threshold is not None else 0.5
+        for j in range(m):
+            if match_idx[j] < 0:
+                i = int(np.argmax(d[:, j]))
+                if d[i, j] >= thr:
+                    match_idx[j] = i
+                    match_dist[j] = d[i, j]
+    return (Tensor(jnp.asarray(match_idx[None, :])),
+            Tensor(jnp.asarray(match_dist[None, :])))
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    """Density prior boxes (reference fluid/layers/detection.py:
+    density_prior_box): per cell, for each (density, fixed_size) pair and
+    fixed ratio, a density x density grid of shifted boxes."""
+    from ...tensor import Tensor
+    import jax.numpy as jnp
+
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    boxes_per_cell = []
+    step_avg = 0.5 * (step_w + step_h)  # reference uses the step average
+    for dens, fs in zip(densities, fixed_sizes):
+        for ratio in (fixed_ratios or [1.0]):
+            bw = fs * np.sqrt(ratio)
+            bh = fs / np.sqrt(ratio)
+            shift = step_avg / dens  # float: never collapses to 0
+            for di in range(dens):
+                for dj in range(dens):
+                    cx_off = (dj + 0.5) * shift - step_avg / 2.0
+                    cy_off = (di + 0.5) * shift - step_avg / 2.0
+                    boxes_per_cell.append((cx_off, cy_off, bw, bh))
+    cx = (np.arange(fw, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(fh, dtype=np.float32) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)
+    P = len(boxes_per_cell)
+    out = np.empty((fh, fw, P, 4), np.float32)
+    for p, (cxo, cyo, bw, bh) in enumerate(boxes_per_cell):
+        out[..., p, 0] = (cxg + cxo - bw / 2.0) / iw
+        out[..., p, 1] = (cyg + cyo - bh / 2.0) / ih
+        out[..., p, 2] = (cxg + cxo + bw / 2.0) / iw
+        out[..., p, 3] = (cyg + cyo + bh / 2.0) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    if flatten_to_2d:
+        out = out.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """SSD head post-processing: decode loc offsets against priors, then
+    multiclass NMS (reference fluid/layers/detection.py:
+    detection_output). loc [N, M, 4], scores [N, M, C] (post-softmax),
+    prior_box [M, 4]."""
+    from ...vision.ops import box_coder as _bc, multiclass_nms as _mc
+
+    decoded = _bc(prior_box, prior_box_var, loc,
+                  code_type="decode_center_size", axis=0)
+    sc = _T.transpose(scores, [0, 2, 1])  # [N, C, M]
+    out, lod = _mc(decoded, sc, score_threshold=score_threshold,
+                   nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                   nms_threshold=nms_threshold, nms_eta=nms_eta,
+                   background_label=background_label)
+    return (out, lod) if return_index else out
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """Sampled softmax CE (reference fluid/layers/loss.py:
+    sampled_softmax_with_cross_entropy): softmax over the true class plus
+    `num_samples` uniformly sampled negatives."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...static.program import Program
+    from ...tensor import Tensor, apply
+
+    C = int(logits.shape[-1])
+    if num_samples >= C:
+        raise ValueError(
+            f"num_samples ({num_samples}) must be < number of classes "
+            f"({C}) for sampled softmax")
+    rng = np.random.default_rng(seed or None)
+
+    # negatives live in a Tensor refreshed per static replay (the
+    # reference resamples every iteration)
+    neg = Tensor(jnp.zeros((num_samples,), jnp.int32))
+    neg.stop_gradient = True
+
+    def _resample():
+        neg._data = jnp.asarray(
+            rng.choice(C, size=num_samples, replace=False)
+            .astype(np.int32))
+        neg._node = None
+
+    Program.record_mutation(_resample)
+
+    def _ssce(lg, y, ng):
+        y = y.reshape(lg.shape[0]).astype(jnp.int32)
+        true_logit = jnp.take_along_axis(lg, y[:, None], axis=1)
+        neg_logit = lg[:, ng]
+        if remove_accidental_hits:
+            hit = ng[None, :] == y[:, None]
+            neg_logit = jnp.where(hit, -1e20, neg_logit)
+        z = jnp.concatenate([true_logit, neg_logit], axis=1)
+        return -jax.nn.log_softmax(z, axis=-1)[:, :1]
+
+    return apply(_ssce, logits, label, neg)

@@ -74,7 +74,8 @@ AdamaxOptimizer = _fluid_opt(_opt.Adamax)
 AdadeltaOptimizer = _fluid_opt(_opt.Adadelta)
 RMSPropOptimizer = _fluid_opt(_opt.RMSProp)
 LambOptimizer = _fluid_opt(_opt.Lamb, {"lamb_weight_decay": "lamb_weight_decay"})
-LarsMomentumOptimizer = MomentumOptimizer  # LARS layerwise scaling n/a
+LarsMomentumOptimizer = _fluid_opt(_opt.LarsMomentum)
+LarsMomentum = LarsMomentumOptimizer
 DecayedAdagradOptimizer = AdagradOptimizer
 DpsgdOptimizer = SGDOptimizer
 

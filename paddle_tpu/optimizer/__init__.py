@@ -1,5 +1,6 @@
 from . import lr  # noqa: F401
 from .algorithms import (  # noqa: F401
-    Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, RMSProp, SGD,
+    Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, LarsMomentum, Momentum,
+    RMSProp, SGD,
 )
 from .optimizer import Optimizer  # noqa: F401

@@ -273,3 +273,54 @@ class Lamb(Optimizer):
         new_p = p32 - lr * trust * r
         return new_p.astype(p.dtype), {"moment1": m, "moment2": v,
                                        "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class LarsMomentum(Optimizer):
+    """Momentum with LARS layerwise trust ratio (reference
+    fluid/optimizer.py:1975 LarsMomentumOptimizer):
+
+        local_lr = lr * lars_coeff * ||p|| / (||g|| + wd * ||p|| + eps)
+        v = mu * v + local_lr * (g + wd * p)
+        p = p - v
+
+    Parameters whose name matches ``exclude_from_weight_decay`` skip the
+    decay term (and, like the reference, use wd=0 in the trust ratio).
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 grad_clip=None, exclude_from_weight_decay=None,
+                 epsilon=0.0, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._lars_coeff = float(lars_coeff)
+        self._lars_wd = float(lars_weight_decay)
+        self._eps = float(epsilon)
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def init_param_state(self, p):
+        return _master_init(self, p, {
+            "velocity": jnp.zeros_like(
+                p, dtype=_acc_dtype(p, self._multi_precision))})
+
+    def update_param(self, p, g, st, lr, param):
+        st = dict(st)
+        wd = self._lars_wd
+        pname = getattr(param, "name", "") or ""
+        if any(tag in pname for tag in self._exclude):
+            wd = 0.0
+        p32 = _read_master(st, p)
+        g32 = _f32(g)
+        p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        g_norm = jnp.sqrt(jnp.sum(g32 * g32))
+        denom = g_norm + wd * p_norm + self._eps
+        local_lr = jnp.where(
+            (p_norm > 0) & (denom > 0),
+            lr * self._lars_coeff * p_norm / jnp.maximum(denom, 1e-20),
+            lr)
+        v = (self._momentum * _f32(st["velocity"])
+             + local_lr * (g32 + wd * p32))
+        st["velocity"] = v.astype(st["velocity"].dtype)
+        new_p32 = p32 - v
+        return _write_master(st, new_p32, p), st

@@ -289,3 +289,210 @@ def test_tail_aliases_present_and_sane():
     fsp = L.fsp_matrix(paddle.to_tensor(np.ones((1, 2, 3, 3), np.float32)),
                        paddle.to_tensor(np.ones((1, 5, 3, 3), np.float32)))
     assert list(fsp.shape) == [1, 2, 5]
+
+
+def test_lars_momentum_trust_ratio():
+    """LARS local lr = lr * coeff * ||p|| / (||g|| + wd*||p||); one step
+    against the closed form (reference fluid/optimizer.py:1975)."""
+    import paddle_tpu.optimizer as optim
+
+    p0 = np.full((4,), 2.0, np.float32)
+    g = np.full((4,), 0.5, np.float32)
+    w = paddle.create_parameter(
+        [4], 'float32',
+        default_initializer=paddle.nn.initializer.Assign(p0.copy()))
+    opt = optim.LarsMomentum(learning_rate=0.1, momentum=0.9,
+                             lars_coeff=0.001, lars_weight_decay=0.0005,
+                             parameters=[w])
+    w.grad = paddle.to_tensor(g)
+    opt.step()
+    p_norm = np.linalg.norm(p0)
+    g_norm = np.linalg.norm(g)
+    local_lr = 0.1 * 0.001 * p_norm / (g_norm + 0.0005 * p_norm)
+    v = local_lr * (g + 0.0005 * p0)
+    np.testing.assert_allclose(np.asarray(w._data), p0 - v, rtol=1e-5)
+    # fluid spelling exists and trains
+    import paddle_tpu.fluid as fluid
+    with fluid.dygraph.guard():
+        net = fluid.dygraph.Linear(4, 2)
+        fo = fluid.optimizer.LarsMomentumOptimizer(
+            learning_rate=0.1, momentum=0.9,
+            parameter_list=net.parameters())
+        loss = L.reduce_mean(net(paddle.to_tensor(
+            np.ones((2, 4), np.float32))))
+        loss.backward()
+        fo.minimize(loss)
+
+
+def test_lstm_builder_and_units():
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((2, 5, 4)).astype(np.float32))
+    h0 = paddle.to_tensor(np.zeros((2, 2, 8), np.float32))
+    c0 = paddle.to_tensor(np.zeros((2, 2, 8), np.float32))
+    out, h, c = L.lstm(x, h0, c0, 5, 8, num_layers=2)
+    assert list(out.shape) == [2, 5, 8]
+    assert list(h.shape) == [2, 2, 8]
+    h2, c2 = L.lstm_unit(
+        paddle.to_tensor(rng.standard_normal((3, 4)).astype(np.float32)),
+        paddle.to_tensor(np.zeros((3, 8), np.float32)),
+        paddle.to_tensor(np.zeros((3, 8), np.float32)))
+    assert list(h2.shape) == [3, 8] and list(c2.shape) == [3, 8]
+    g, _, _ = L.gru_unit(
+        paddle.to_tensor(rng.standard_normal((3, 4)).astype(np.float32)),
+        paddle.to_tensor(np.zeros((3, 6), np.float32)), 18)
+    assert list(g.shape) == [3, 6]
+
+
+def test_im2sequence_matches_unfold():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1, 2, 4, 4)).astype(np.float32)
+    seq = np.asarray(L.im2sequence(paddle.to_tensor(x), 2, 2)._data)
+    assert seq.shape == (4, 8)  # 2x2 grid of patches, 2*2*2 features
+    # first patch equals the top-left 2x2 block (channel-major)
+    np.testing.assert_allclose(seq[0], x[0, :, :2, :2].reshape(-1),
+                               rtol=1e-6)
+
+
+def test_bipartite_match_greedy():
+    d = paddle.to_tensor(np.asarray(
+        [[0.9, 0.1, 0.3], [0.2, 0.8, 0.7]], np.float32))
+    idx, dist = L.bipartite_match(d)
+    np.testing.assert_array_equal(np.asarray(idx._data)[0], [0, 1, -1])
+    np.testing.assert_allclose(np.asarray(dist._data)[0], [0.9, 0.8, 0.0])
+    # per_prediction fills unmatched columns above the threshold
+    idx2, dist2 = L.bipartite_match(d, match_type="per_prediction",
+                                    dist_threshold=0.5)
+    np.testing.assert_array_equal(np.asarray(idx2._data)[0], [0, 1, 1])
+
+
+def test_detection_output_pipeline():
+    rng = np.random.default_rng(2)
+    M = 8
+    priors = np.sort(rng.uniform(0, 30, (M, 2, 2)), axis=-1) \
+        .transpose(0, 2, 1).reshape(M, 4).astype(np.float32)
+    loc = (rng.standard_normal((1, M, 4)) * 0.05).astype(np.float32)
+    scores = rng.uniform(0, 1, (1, M, 3)).astype(np.float32)
+    out = L.detection_output(paddle.to_tensor(loc),
+                             paddle.to_tensor(scores),
+                             paddle.to_tensor(priors),
+                             [0.1, 0.1, 0.2, 0.2], score_threshold=0.3)
+    o = np.asarray(out._data)
+    assert o.ndim == 2 and o.shape[1] == 6
+    assert set(np.unique(o[:, 0])).issubset({1.0, 2.0})  # background=0
+
+
+def test_sampled_softmax_and_center_loss_grads():
+    rng = np.random.default_rng(3)
+    logits = paddle.to_tensor(rng.standard_normal((4, 30))
+                              .astype(np.float32))
+    logits.stop_gradient = False
+    lab = paddle.to_tensor(rng.integers(0, 30, (4, 1)).astype(np.int64))
+    loss = L.sampled_softmax_with_cross_entropy(logits, lab, num_samples=8)
+    loss.sum().backward()
+    assert logits.grad is not None
+    assert np.isfinite(np.asarray(logits.grad._data)).all()
+
+    feats = paddle.to_tensor(rng.standard_normal((4, 6)).astype(np.float32))
+    feats.stop_gradient = False
+    cl = L.center_loss(feats, lab % 3, 3, alpha=0.1)
+    cl.sum().backward()
+    assert feats.grad is not None
+
+
+def test_hash_deterministic_and_bounded():
+    ids = paddle.to_tensor(np.asarray([[7], [7], [123456]], np.int64))
+    h1 = np.asarray(L.hash(ids, 997, num_hash=3)._data)
+    h2 = np.asarray(L.hash(ids, 997, num_hash=3)._data)
+    np.testing.assert_array_equal(h1, h2)
+    assert h1.shape == (3, 3)
+    assert (h1 >= 0).all() and (h1 < 997).all()
+    np.testing.assert_array_equal(h1[0], h1[1])  # same id, same hashes
+    assert not (h1[0] == h1[2]).all()
+
+
+def test_center_loss_centers_persist_and_ema():
+    rng = np.random.default_rng(5)
+    feats = paddle.to_tensor(rng.standard_normal((6, 4)).astype(np.float32))
+    lab = paddle.to_tensor(rng.integers(0, 3, (6, 1)).astype(np.int64))
+    L.center_loss(feats, lab, 3, alpha=0.5)
+    from paddle_tpu.static.program import default_main_program
+    c1 = np.asarray(default_main_program()
+                    ._center_loss_cache[(3, 4)]._data).copy()
+    L.center_loss(feats, lab, 3, alpha=0.5)
+    c2 = np.asarray(default_main_program()
+                    ._center_loss_cache[(3, 4)]._data)
+    # same parameter object updated again (EMA moved, not re-initialized)
+    assert not np.allclose(c1, c2)
+
+
+def test_sampled_softmax_resamples_per_replay():
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[20], dtype="float32")
+        lab = L.data(name="lab", shape=[1], dtype="int64")
+        loss = L.sampled_softmax_with_cross_entropy(x, lab, num_samples=5,
+                                                    seed=7)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = np.random.default_rng(0).standard_normal((3, 20)) \
+        .astype(np.float32)
+    labs = np.asarray([[1], [2], [3]], np.int64)
+    vals = {tuple(np.asarray(exe.run(main, feed={"x": xs, "lab": labs},
+                                     fetch_list=[loss])[0]).reshape(-1)
+                  .round(5)) for _ in range(6)}
+    assert len(vals) > 1  # different negatives -> different loss values
+    with pytest.raises(ValueError, match="num_samples"):
+        L.sampled_softmax_with_cross_entropy(
+            paddle.to_tensor(xs), paddle.to_tensor(labs), num_samples=25)
+
+
+def test_random_crop_rerandomizes_in_program():
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[1, 8, 8], dtype="float32")
+        crop = L.random_crop(x, [4, 4])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    seen = {tuple(np.asarray(exe.run(main, feed={"x": xs},
+                                     fetch_list=[crop])[0]).reshape(-1))
+            for _ in range(12)}
+    assert len(seen) > 1  # crops differ across runs
+
+
+def test_im2sequence_four_element_padding():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    # pad left/right by 1 -> width 6 -> 2x3 patches of 2x2 at stride 2
+    seq = np.asarray(L.im2sequence(paddle.to_tensor(x), 2, 2,
+                                   padding=[0, 0, 1, 1])._data)
+    assert seq.shape == (6, 4)
+    # first patch: padded col then first col
+    np.testing.assert_allclose(seq[0], [0, 0, 0, 4])
+
+
+def test_ifelse_rank1_output_merge():
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[3], dtype="float32")
+        zero = L.fill_constant([1], 'float32', 0.0)
+        cond = L.greater_than(L.reduce_sum(x, dim=1), zero)  # [N]
+        cond2 = L.unsqueeze(cond, axes=[1])  # [N, 1] fluid-style
+        ie = L.IfElse(cond2)
+        with ie.true_block():
+            ie.output(L.reduce_sum(x, dim=1))  # rank-1 [N]
+        with ie.false_block():
+            ie.output(L.reduce_sum(x, dim=1) * 0.0)
+        (out,) = ie()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = np.asarray([[1, 1, 1], [-1, -1, -1]], np.float32)
+    (got,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    got = np.asarray(got)
+    assert got.shape == (2,)
+    np.testing.assert_allclose(got, [3.0, 0.0])
