@@ -5,9 +5,9 @@ TPU-native GShard-style design: experts are ONE batched parameter tensor
 [num_experts, ...] and token routing is expressed as dense einsums with a
 capacity-bounded one-hot dispatch mask — static shapes, MXU-friendly, and
 expert parallelism is just sharding the leading expert axis over the mesh's
-"ep" axis (the all-to-all materializes as XLA collectives when the token and
-expert shardings differ). This replaces the reference's explicit
-c_alltoall + per-expert sub-programs.
+model-parallel ("tp") axis — the EP of the reference — and the all-to-all
+materializes as XLA collectives when the token and expert shardings differ.
+This replaces the reference's explicit c_alltoall + per-expert sub-programs.
 """
 from __future__ import annotations
 
@@ -15,6 +15,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ..tensor import Tensor, apply
 from .initializer import XavierUniform
@@ -117,8 +118,9 @@ class SwitchGate(TopKGate):
 class MoELayer(Layer):
     """Expert FFN bank + gate. Experts stored batched: weights [E, d, ff].
 
-    Under fleet expert-parallel the leading E axis is sharded on the mesh
-    "ep" axis; XLA turns the dispatch einsum into an all-to-all over ICI.
+    Under fleet expert-parallel the leading E axis is sharded on the mesh's
+    model-parallel ("tp") axis — the reference's EP; XLA turns the
+    dispatch einsum into an all-to-all over ICI.
     """
 
     # dense [S,E,C] einsum dispatch above this many dispatch-tensor
@@ -136,6 +138,12 @@ class MoELayer(Layer):
                                           default_initializer=XavierUniform())
         self.w_down = self.create_parameter((num_experts, d_hidden, d_model),
                                             default_initializer=XavierUniform())
+        # expert parallelism: the leading E axis shards over the mesh's
+        # model-parallel axis (the EP of the reference's c_alltoall
+        # dispatch); XLA inserts the token<->expert all-to-all where the
+        # activation and expert shardings differ. Replicated when mp=1.
+        self.w_up.pspec = P("tp", None, None)
+        self.w_down.pspec = P("tp", None, None)
         self.activation = activation
         self.dispatch_mode = dispatch_mode
         self.aux_loss = None

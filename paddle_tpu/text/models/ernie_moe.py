@@ -2,7 +2,7 @@
 
 Reference pairing: python/paddle/incubate/distributed/models/moe (c_alltoall
 dispatch). Built on paddle_tpu.nn.moe.MoELayer — the expert axis shards on
-the mesh "ep"/"tp" axis and XLA emits the all-to-all.
+the mesh model-parallel ("tp") axis — the reference's EP — and XLA emits the all-to-all.
 """
 from __future__ import annotations
 
