@@ -1,0 +1,81 @@
+"""Classic 1.x-era fluid script, running unmodified on paddle_tpu.
+
+A fluid static program (data/fc/Executor workflow) with a Switch-based
+piecewise LR schedule and an inference-model export — the shape of
+thousands of pre-2.0 Paddle training scripts.
+
+Run: python examples/fluid_legacy_mnist.py  (CPU ok; forces cpu platform)
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def build_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        feat = fluid.nets.simple_img_conv_pool(
+            img, num_filters=8, filter_size=5, pool_size=2, pool_stride=2,
+            conv_padding=2, act="relu")
+        logits = layers.fc(feat, size=10)
+        loss = layers.reduce_mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+
+        step = layers.autoincreased_step_counter()
+        lr = layers.fill_constant([1], "float32", 0.0)
+        with layers.Switch() as sw:
+            with sw.case(layers.less_than(
+                    layers.cast(step, "float32"),
+                    layers.fill_constant([1], "float32", 30.0))):
+                layers.assign(layers.fill_constant([1], "float32", 0.1), lr)
+            with sw.default():
+                layers.assign(layers.fill_constant([1], "float32", 0.02), lr)
+        fluid.optimizer.MomentumOptimizer(
+            learning_rate=0.05, momentum=0.9).minimize(loss)
+    return main, startup, img, logits, loss, acc, lr
+
+
+def main():
+    main_prog, startup, img, logits, loss, acc, lr = build_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    rng = np.random.default_rng(0)
+    # synthetic MNIST-shaped data: class k = noisy constant image k/10
+    ys = rng.integers(0, 10, (256, 1)).astype(np.int64)
+    xs = (ys[:, :, None, None] / 10.0
+          + 0.1 * rng.standard_normal((256, 1, 28, 28))).astype(np.float32)
+
+    for epoch in range(3):
+        for i in range(0, 256, 64):
+            feed = {"img": xs[i:i + 64], "label": ys[i:i + 64]}
+            lv, av, lrv = exe.run(main_prog, feed=feed,
+                                  fetch_list=[loss, acc, lr])
+            if i == 0:
+                print(f"epoch {epoch}: loss={float(np.asarray(lv).reshape(-1)[0]):.4f} "
+                      f"acc={float(np.asarray(av)):.3f} "
+                      f"lr={float(np.asarray(lrv).reshape(-1)[0]):.3f}")
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        fluid.io.save_inference_model(td, ["img"], [logits], exe,
+                                      main_program=main_prog)
+        prog, feeds, fetches = fluid.io.load_inference_model(td, exe)
+        (out,) = exe.run(prog, feed={feeds[0]: xs[:4]}, fetch_list=fetches)
+        print("inference model reloaded; logits:", out.shape)
+
+
+if __name__ == "__main__":
+    main()

@@ -94,6 +94,17 @@ def infer_param_pspec(shape, tp_spec: Optional[PartitionSpec], stage: int,
     spec = list(tp_spec) if tp_spec is not None else [None] * ndim
     while len(spec) < ndim:
         spec.append(None)
+    # drop declared axes the shape can't honor (e.g. an expert axis whose
+    # count doesn't divide the mp degree falls back to replicated)
+    for d, ax in enumerate(spec):
+        if ax is None:
+            continue
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        size = 1
+        for a in axes:
+            size *= mesh_axis_size(a)
+        if size > 1 and shape[d] % size != 0:
+            spec[d] = None
     if stage >= 3 and int(np.prod(shape)) >= min_shard_size:
         ssize = mesh_axis_size("sharding")
         if ssize > 1:

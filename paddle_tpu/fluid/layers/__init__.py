@@ -514,7 +514,7 @@ def _mk_cmp(fn):
                 c._data = o._data
                 c._node = None
 
-            Program.record_mutation(_sync)
+            Program.record_mutation(_sync, reads=(out,), writes=(cond,))
             return cond
         return out
     return op

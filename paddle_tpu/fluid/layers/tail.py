@@ -584,18 +584,30 @@ def random_crop(x, shape, seed=None):
     out.stop_gradient = True
 
     def _crop():
-        arr = np.asarray(x._data)
-        full = list(arr.shape)
+        import jax.core as _core
+
+        data = x._data
+        full = [int(s) for s in data.shape]
         lead = len(full) - len(tgt)
-        starts = [0] * lead + [
-            int(rng.integers(0, full[lead + i] - tgt[i] + 1))
-            for i in range(len(tgt))]
-        sl = tuple(slice(s, s + e)
-                   for s, e in zip(starts, full[:lead] + tgt))
-        out._data = jnp.asarray(arr[sl])
+        if isinstance(data, _core.Tracer):
+            # under export tracing: deterministic center crop (eval-time
+            # augmentation semantics)
+            starts = [0] * lead + [
+                (full[lead + i] - tgt[i]) // 2 for i in range(len(tgt))]
+            sl = tuple(slice(s, s + e)
+                       for s, e in zip(starts, full[:lead] + tgt))
+            out._data = data[sl]
+        else:
+            arr = np.asarray(data)
+            starts = [0] * lead + [
+                int(rng.integers(0, full[lead + i] - tgt[i] + 1))
+                for i in range(len(tgt))]
+            sl = tuple(slice(s, s + e)
+                       for s, e in zip(starts, full[:lead] + tgt))
+            out._data = jnp.asarray(arr[sl])
         out._node = None
 
-    Program.record_mutation(_crop)
+    Program.record_mutation(_crop, reads=(x,), writes=(out,))
     return out
 
 

@@ -17,6 +17,37 @@ import numpy as np
 from ..tensor import Tensor
 
 
+def build_symbolic_specs(shapes, dtypes, symbolize_dim0_value=None):
+    """ShapeDtypeStructs for jax.export with symbolic dynamic dims.
+
+    Dims given as None/-1 become symbolic; dim 0 shares one symbol across
+    inputs so batch-paired inputs stay unified, later dims get per-input
+    symbols (src_len/tgt_len aren't forced equal).
+
+    ``symbolize_dim0_value``: additionally treat dim 0 as dynamic when it
+    equals this concrete value (static-program export: every feed whose
+    leading dim matches the first feed's record-time batch is assumed to
+    be batch-major; a [1, d] side input with a different leading dim
+    stays static).
+    """
+    from jax import export as jax_export
+
+    scope = jax_export.SymbolicScope()
+    out = []
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        dims = []
+        for j, d in enumerate(shape):
+            dynamic = d is None or (isinstance(d, int) and d < 0)
+            if (j == 0 and symbolize_dim0_value is not None
+                    and d == symbolize_dim0_value):
+                dynamic = True
+            dims.append(("d0" if j == 0 else f"d{i}_{j}")
+                        if dynamic else str(d))
+        shp = jax_export.symbolic_shape(",".join(dims), scope=scope)
+        out.append(jax.ShapeDtypeStruct(shp, dtype))
+    return out
+
+
 def save(layer, path, input_spec=None, **configs):
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     payload = {"format": "paddle_tpu.jit", "version": 1}
@@ -31,18 +62,9 @@ def save(layer, path, input_spec=None, **configs):
     if input_spec is not None:
         try:
             from jax import export as jax_export
-            scope = jax_export.SymbolicScope()
-            shapes = []
-            for i, s in enumerate(input_spec):
-                # dynamic dims (None/-1) export as symbolic dimensions; only
-                # dim 0 (batch) shares one symbol across inputs so ids/mask
-                # pairs stay unified — later dynamic dims get per-input
-                # symbols so e.g. src_len and tgt_len aren't forced equal
-                dims = [("d0" if j == 0 else f"d{i}_{j}")
-                        if (d is None or d < 0) else str(d)
-                        for j, d in enumerate(s.shape)]
-                shp = jax_export.symbolic_shape(",".join(dims), scope=scope)
-                shapes.append(jax.ShapeDtypeStruct(shp, s.dtype))
+            shapes = build_symbolic_specs(
+                [tuple(s.shape) for s in input_spec],
+                [s.dtype for s in input_spec])
 
             def fwd(*xs):
                 out = layer(*[Tensor(x) for x in xs])

@@ -141,12 +141,29 @@ class MoELayer(Layer):
         # expert parallelism: the leading E axis shards over the mesh's
         # model-parallel axis (the EP of the reference's c_alltoall
         # dispatch); XLA inserts the token<->expert all-to-all where the
-        # activation and expert shardings differ. Replicated when mp=1.
-        self.w_up.pspec = P("tp", None, None)
-        self.w_down.pspec = P("tp", None, None)
+        # activation and expert shardings differ. Replicated when mp=1 or
+        # when the expert count doesn't divide the mp degree.
+        if self._ep_divisible(num_experts):
+            self.w_up.pspec = P("tp", None, None)
+            self.w_down.pspec = P("tp", None, None)
         self.activation = activation
         self.dispatch_mode = dispatch_mode
         self.aux_loss = None
+
+    @staticmethod
+    def _ep_divisible(num_experts):
+        try:
+            from ..distributed.mesh import mesh_axis_size
+            tp = mesh_axis_size("tp")
+        except Exception:
+            return True  # no mesh yet: pspec is inert until one exists
+        if tp > 1 and num_experts % tp != 0:
+            import warnings
+            warnings.warn(
+                f"MoE num_experts={num_experts} not divisible by "
+                f"mp_degree={tp}; experts stay replicated (no EP)")
+            return False
+        return True
 
     def _act(self):
         return {"gelu": jax.nn.gelu, "relu": jax.nn.relu,

@@ -74,13 +74,24 @@ class Program:
         self._replay_entries(self._ops)
 
     @staticmethod
-    def record_mutation(thunk):
+    def record_mutation(thunk, reads=(), writes=()):
         """Run an in-place mutation now AND re-run it on every static
         replay (fluid idioms: increment, assign-into-var, cond out-
-        params). No-op registration outside program recording."""
+        params). No-op registration outside program recording.
+
+        ``reads``/``writes`` declare the Tensors the thunk consumes and
+        produces so the inference-slice exporter can keep forward-compute
+        mutations (assign, cond syncs) and trace through them; thunks
+        registered WITHOUT metadata are training-time host control flow
+        (optimizer steps, While loops, EMA buffers) and are dropped from
+        exported graphs."""
         thunk()
         if _current_main is not None:
-            _current_main._append_thunk(thunk)
+            if reads or writes:
+                _current_main._ops.append(
+                    ("thunk", thunk, tuple(reads), tuple(writes)))
+            else:
+                _current_main._append_thunk(thunk)
 
     @staticmethod
     def _replay_entries(entries):
@@ -317,17 +328,55 @@ def serialize_program(feeds, fetches, program=None, **kwargs):
             "program has no recorded ops — pass program= explicitly or "
             "call inside the program_guard that built the graph")
 
+    fs = fetches if isinstance(fetches, (list, tuple)) else [fetches]
+    # inference slice: keep only the ops whose outputs transitively feed
+    # the fetch vars (reference prune_backward/prepends feed-fetch in
+    # save_inference_model). Mutation thunks (optimizer steps, LR
+    # switches, While loops) are training-time host control flow — they
+    # are dropped so a trainable program exports its pure forward.
+    needed = {id(f) for f in fs}
+    kept = []
+    for entry in reversed(prog._ops):
+        if entry[0] == "thunk":
+            if len(entry) >= 4:  # mutation with declared reads/writes
+                _, _thunk, reads, writes = entry
+                if any(id(w) in needed for w in writes):
+                    kept.append(entry)
+                    needed.update(id(r) for r in reads)
+            continue  # bare thunks: training-time host control flow
+        _, fn, args, kwargs, outs = entry
+        if any(id(o) in needed for o in outs):
+            kept.append(entry)
+            for a in args:
+                if isinstance(a, Tensor):
+                    needed.add(id(a))
+    kept.reverse()
+
     def fwd(*vals):
         with _no_record():
             for ph, v in zip(feeds, vals):
                 ph._data = v
                 ph._node = None
-            prog._replay()
-            fs = fetches if isinstance(fetches, (list, tuple)) else [fetches]
+            Program._replay_entries(kept)
             return tuple(f._data for f in fs)
 
-    specs = [jax.ShapeDtypeStruct(tuple(f.shape), f.dtype) for f in feeds]
-    exported = jax_export.export(jax.jit(fwd))(*specs)
+    # batch-polymorphic export: feeds sharing the first feed's record-time
+    # leading dim get one shared symbolic batch (jit.save's scheme via
+    # build_symbolic_specs); side inputs with a different leading dim
+    # (e.g. [1, d] scales) stay static so call-time shape checks hold
+    from ..jit.serialization import build_symbolic_specs
+    try:
+        batch0 = int(feeds[0].shape[0]) if feeds and feeds[0].shape else None
+        specs = build_symbolic_specs(
+            [tuple(f.shape) for f in feeds], [f.dtype for f in feeds],
+            symbolize_dim0_value=batch0)
+        exported = jax_export.export(jax.jit(fwd))(*specs)
+    except Exception:
+        # programs whose graph pins the batch (e.g. reshape to concrete
+        # sizes) fall back to the recorded static shapes
+        specs = [jax.ShapeDtypeStruct(tuple(f.shape), f.dtype)
+                 for f in feeds]
+        exported = jax_export.export(jax.jit(fwd))(*specs)
     return exported.serialize()
 
 

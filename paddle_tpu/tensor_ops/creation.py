@@ -149,7 +149,9 @@ def assign(x, output=None):
             output._data = jnp.asarray(raw(x))
             output._node = None
 
-        Program.record_mutation(_copy)
+        Program.record_mutation(
+            _copy, reads=(x,) if isinstance(x, Tensor) else (),
+            writes=(output,))
         return output
     return Tensor(jnp.asarray(raw(x)))
 

@@ -275,7 +275,7 @@ def increment(x, value=1.0, name=None):
         x._data = x._data + value
         x._node = None
 
-    Program.record_mutation(_inc)
+    Program.record_mutation(_inc, reads=(x,), writes=(x,))
     return x
 
 

@@ -126,3 +126,80 @@ def test_switch_first_true_case():
         (got,) = exe.run(main, feed={"step": np.asarray([s], np.float32)},
                          fetch_list=[lr])
         assert float(np.asarray(got).reshape(-1)[0]) == np.float32(want), s
+
+
+def test_export_keeps_forward_assign_thunks():
+    """Inference slice keeps assign-into-var mutations (declared
+    reads/writes) so exported outputs are computed, not stale."""
+    import tempfile
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[3], dtype="float32")
+        y = layers.fill_constant([1, 3], 'float32', 0.0)
+        doubled = layers.elementwise_mul(
+            x, layers.fill_constant([1], 'float32', 2.0))
+        layers.assign(doubled, y)  # forward compute through a thunk
+        out = layers.elementwise_add(y, layers.fill_constant(
+            [1], 'float32', 1.0))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with tempfile.TemporaryDirectory() as td:
+        fluid.io.save_inference_model(td, ["x"], [out], exe,
+                                      main_program=main)
+        prog, feeds, fetches = fluid.io.load_inference_model(td, exe)
+        xs = np.asarray([[1.0, 2.0, 3.0]], np.float32)
+        (got,) = exe.run(prog, feed={feeds[0]: xs}, fetch_list=fetches)
+        np.testing.assert_allclose(np.asarray(got), [[3.0, 5.0, 7.0]])
+
+
+def test_export_side_input_with_different_leading_dim():
+    """Feeds whose leading dim differs from the batch stay static in the
+    symbolic export (a [1, d] scale must not be forced to [b, d])."""
+    import tempfile
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")      # [-1,4]
+        s = fluid.layers.data(name="s", shape=[1, 4], dtype="float32",
+                              append_batch_size=False)
+        out = layers.elementwise_mul(x, s)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = np.ones((8, 4), np.float32)
+    ss = np.full((1, 4), 3.0, np.float32)
+    exe.run(main, feed={"x": xs, "s": ss}, fetch_list=[out])
+    with tempfile.TemporaryDirectory() as td:
+        fluid.io.save_inference_model(td, ["x", "s"], [out], exe,
+                                      main_program=main)
+        prog, feeds, fetches = fluid.io.load_inference_model(td, exe)
+        # batch 2 != record batch 8; scale stays [1, 4]
+        (got,) = exe.run(prog, feed={"x": np.ones((2, 4), np.float32),
+                                     "s": ss}, fetch_list=fetches)
+        np.testing.assert_allclose(np.asarray(got), np.full((2, 4), 3.0))
+
+
+def test_moe_indivisible_experts_stay_replicated():
+    import warnings
+
+    import paddle_tpu
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.nn.moe import MoELayer
+
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 8,
+                               "pp_degree": 1, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        layer = MoELayer(d_model=16, d_hidden=32, num_experts=4)
+    assert getattr(layer.w_up, "pspec", None) is None
+    assert any("not divisible" in str(x.message) for x in w)
+    # and the model still runs (replicated experts)
+    model = fleet.distributed_model(layer)
+    x = paddle_tpu.to_tensor(
+        np.random.default_rng(0).standard_normal((2, 4, 16))
+        .astype(np.float32))
+    out = model(x)
+    assert list(out.shape) == [2, 4, 16]
