@@ -369,11 +369,21 @@ def _full_like(x, v):
 
 
 def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    """Constant var whose value is RE-ESTABLISHED on every static replay:
+    a While/Switch body may mutate it (loop counters, accumulators), and
+    each Executor.run must start from the declared constant, as the
+    reference executor re-runs the fill_constant op."""
+    from ...static.program import Program
+
     t = _T.full(shape, value, dtype=dtype)
-    if out is not None:
-        out._data = t._data
-        return out
-    return t
+    target = out if out is not None else t
+
+    def _reset(tt=target):
+        tt._data = _T.full(shape, value, dtype=dtype)._data
+        tt._node = None
+
+    Program.record_mutation(_reset, reads=(), writes=(target,))
+    return target
 
 
 def fill_constant_batch_size_like(input, shape, dtype, value,
@@ -384,11 +394,11 @@ def fill_constant_batch_size_like(input, shape, dtype, value,
 
 
 def zeros(shape, dtype='float32', force_cpu=False):
-    return _T.zeros(shape, dtype=dtype)
+    return fill_constant(shape, dtype, 0.0)
 
 
 def ones(shape, dtype='float32', force_cpu=False):
-    return _T.ones(shape, dtype=dtype)
+    return fill_constant(shape, dtype, 1.0)
 
 
 zeros_like = _T.zeros_like

@@ -587,23 +587,23 @@ def random_crop(x, shape, seed=None):
         import jax.core as _core
 
         data = x._data
-        full = [int(s) for s in data.shape]
-        lead = len(full) - len(tgt)
+        lead = len(data.shape) - len(tgt)
+        # only the cropped trailing dims need concrete ints — leading
+        # dims may be symbolic under a batch-polymorphic export
+        trail = [int(data.shape[lead + i]) for i in range(len(tgt))]
         if isinstance(data, _core.Tracer):
             # under export tracing: deterministic center crop (eval-time
             # augmentation semantics)
-            starts = [0] * lead + [
-                (full[lead + i] - tgt[i]) // 2 for i in range(len(tgt))]
-            sl = tuple(slice(s, s + e)
-                       for s, e in zip(starts, full[:lead] + tgt))
+            sl = (tuple(slice(None) for _ in range(lead))
+                  + tuple(slice((t - e) // 2, (t - e) // 2 + e)
+                          for t, e in zip(trail, tgt)))
             out._data = data[sl]
         else:
             arr = np.asarray(data)
-            starts = [0] * lead + [
-                int(rng.integers(0, full[lead + i] - tgt[i] + 1))
-                for i in range(len(tgt))]
-            sl = tuple(slice(s, s + e)
-                       for s, e in zip(starts, full[:lead] + tgt))
+            starts = [int(rng.integers(0, t - e + 1))
+                      for t, e in zip(trail, tgt)]
+            sl = (tuple(slice(None) for _ in range(lead))
+                  + tuple(slice(s, s + e) for s, e in zip(starts, tgt)))
             out._data = jnp.asarray(arr[sl])
         out._node = None
 

@@ -17,18 +17,12 @@ import numpy as np
 from ..tensor import Tensor
 
 
-def build_symbolic_specs(shapes, dtypes, symbolize_dim0_value=None):
+def build_symbolic_specs(shapes, dtypes):
     """ShapeDtypeStructs for jax.export with symbolic dynamic dims.
 
     Dims given as None/-1 become symbolic; dim 0 shares one symbol across
     inputs so batch-paired inputs stay unified, later dims get per-input
     symbols (src_len/tgt_len aren't forced equal).
-
-    ``symbolize_dim0_value``: additionally treat dim 0 as dynamic when it
-    equals this concrete value (static-program export: every feed whose
-    leading dim matches the first feed's record-time batch is assumed to
-    be batch-major; a [1, d] side input with a different leading dim
-    stays static).
     """
     from jax import export as jax_export
 
@@ -38,9 +32,6 @@ def build_symbolic_specs(shapes, dtypes, symbolize_dim0_value=None):
         dims = []
         for j, d in enumerate(shape):
             dynamic = d is None or (isinstance(d, int) and d < 0)
-            if (j == 0 and symbolize_dim0_value is not None
-                    and d == symbolize_dim0_value):
-                dynamic = True
             dims.append(("d0" if j == 0 else f"d{i}_{j}")
                         if dynamic else str(d))
         shp = jax_export.symbolic_shape(",".join(dims), scope=scope)

@@ -163,6 +163,11 @@ def data(name, shape, dtype='float32', lod_level=0):
                    stop_gradient=True, name=name)
     prog._feed_vars[name] = t
     prog._vars[name] = t
+    # remember which dims were declared dynamic (None/-1): the exporter
+    # symbolizes exactly those, with no record-batch guessing
+    if not hasattr(prog, "_feed_declared"):
+        prog._feed_declared = {}
+    prog._feed_declared[name] = tuple(shape)
     return t
 
 
@@ -352,6 +357,28 @@ def serialize_program(feeds, fetches, program=None, **kwargs):
                     needed.add(id(a))
     kept.reverse()
 
+    # a fetch that is not a feed, not a registered var/parameter, and not
+    # produced by any kept entry was most likely computed by an opaque
+    # bare thunk (py_func, StaticRNN, a While body) — its exported value
+    # would be a record-time constant, so say so loudly
+    feed_ids = {id(f) for f in feeds}
+    var_ids = {id(v) for v in prog._vars.values()}
+    kept_out_ids = set()
+    for entry in kept:
+        if entry[0] == "thunk":
+            kept_out_ids.update(id(w) for w in entry[3])
+        else:
+            kept_out_ids.update(id(o) for o in entry[4])
+    for f in fs:
+        if (id(f) not in kept_out_ids and id(f) not in feed_ids
+                and id(f) not in var_ids):
+            import warnings
+            warnings.warn(
+                f"fetch var {getattr(f, 'name', None) or f!r} has no "
+                "exportable producer (likely computed by py_func / "
+                "StaticRNN / a While body, which cannot be traced) — the "
+                "exported graph will return its record-time value")
+
     def fwd(*vals):
         with _no_record():
             for ph, v in zip(feeds, vals):
@@ -360,16 +387,27 @@ def serialize_program(feeds, fetches, program=None, **kwargs):
             Program._replay_entries(kept)
             return tuple(f._data for f in fs)
 
-    # batch-polymorphic export: feeds sharing the first feed's record-time
-    # leading dim get one shared symbolic batch (jit.save's scheme via
-    # build_symbolic_specs); side inputs with a different leading dim
-    # (e.g. [1, d] scales) stay static so call-time shape checks hold
+    # batch-polymorphic export: dims the user DECLARED dynamic (None/-1
+    # in static.data / fluid.layers.data) become symbolic — dim 0 shares
+    # one symbol across feeds; anything declared concrete stays static so
+    # call-time shape checks hold. Feeds with no declared-shape record
+    # (constructed outside data()) keep their concrete shapes.
     from ..jit.serialization import build_symbolic_specs
     try:
-        batch0 = int(feeds[0].shape[0]) if feeds and feeds[0].shape else None
-        specs = build_symbolic_specs(
-            [tuple(f.shape) for f in feeds], [f.dtype for f in feeds],
-            symbolize_dim0_value=batch0)
+        declared_of = {}
+        for name, t in getattr(prog, "_feed_declared", {}).items():
+            declared_of[id(prog._feed_vars.get(name))] = t
+        shapes = []
+        for f in feeds:
+            decl = declared_of.get(id(f))
+            if decl is not None and len(decl) == len(f.shape):
+                shapes.append(tuple(
+                    -1 if (d is None or (isinstance(d, int) and d < 0))
+                    else int(c)
+                    for d, c in zip(decl, f.shape)))
+            else:
+                shapes.append(tuple(int(s) for s in f.shape))
+        specs = build_symbolic_specs(shapes, [f.dtype for f in feeds])
         exported = jax_export.export(jax.jit(fwd))(*specs)
     except Exception:
         # programs whose graph pins the batch (e.g. reshape to concrete

@@ -12,6 +12,8 @@ def try_import(name):
 
 
 from . import cpp_extension  # noqa: F401,E402
+from . import dlpack  # noqa: F401,E402
+from . import download  # noqa: F401,E402
 
 
 def deprecated(update_to="", since="", reason="", level=0):

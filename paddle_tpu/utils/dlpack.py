@@ -1,0 +1,52 @@
+"""DLPack interop (reference python/paddle/utils/dlpack.py:26,62):
+zero-copy-ish tensor exchange with torch/numpy/cupy via the DLPack
+protocol, bridged through jax.dlpack.
+"""
+from __future__ import annotations
+
+from ..tensor import Tensor
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack capsule (consumable by torch.utils.dlpack or any
+    DLPack importer; numpy users can np.from_dlpack the Tensor's
+    underlying array directly)."""
+    data = x._data if isinstance(x, Tensor) else x
+    return data.__dlpack__()
+
+
+def from_dlpack(dlpack):
+    """DLPack capsule or __dlpack__-capable object -> Tensor."""
+    import jax.dlpack
+    import jax.numpy as jnp
+
+    if hasattr(dlpack, "__dlpack__"):
+        try:
+            arr = jax.dlpack.from_dlpack(dlpack)
+        except Exception:
+            # protocol objects jax rejects (e.g. non-contiguous torch
+            # tensors) round-trip through numpy
+            import numpy as np
+
+            arr = jnp.asarray(np.from_dlpack(dlpack))
+        return Tensor(arr)
+    # raw PyCapsule (the reference API's currency): modern jax/numpy only
+    # accept protocol objects, so wrap the capsule in a one-shot protocol
+    # shim (no torch dependency)
+    import numpy as np
+
+    class _CapsuleShim:
+        def __init__(self, cap):
+            self._cap = cap
+
+        def __dlpack__(self, **kwargs):
+            return self._cap
+
+        def __dlpack_device__(self):
+            return (1, 0)  # kDLCPU; jax re-imports onto its backend
+
+    try:
+        arr = jax.dlpack.from_dlpack(_CapsuleShim(dlpack))
+    except Exception:
+        arr = jnp.asarray(np.from_dlpack(_CapsuleShim(dlpack)))
+    return Tensor(arr)

@@ -1,0 +1,41 @@
+"""Weights/file download helpers (reference python/paddle/utils/
+download.py:77,123) over the dataset download/cache machinery (md5,
+retries, offline mirror env)."""
+from __future__ import annotations
+
+import os
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """Download url into the weights cache (~/.cache/paddle_tpu/weights)
+    and return the local path."""
+    from ..dataset.common import download
+
+    return download(url, "weights", md5sum=md5sum)
+
+
+def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True,
+                      decompress=True, method="get"):
+    from ..dataset.common import download
+
+    path = download(url, root_dir or "downloads", md5sum=md5sum)
+    if decompress and path.endswith((".tar", ".tar.gz", ".tgz", ".zip")):
+        import tarfile
+        import zipfile
+
+        out_dir = path
+        for suf in (".tar.gz", ".tgz", ".tar", ".zip"):
+            if out_dir.endswith(suf):
+                out_dir = out_dir[:-len(suf)]
+                break
+        if not os.path.isdir(out_dir):
+            if path.endswith(".zip"):
+                with zipfile.ZipFile(path) as z:
+                    z.extractall(out_dir)
+            else:
+                with tarfile.open(path) as t:
+                    # filter='data' rejects path traversal / absolute
+                    # members from untrusted archives
+                    t.extractall(out_dir, filter="data")
+        return out_dir
+    return path

@@ -112,3 +112,39 @@ def test_stablehlo_export_roundtrip():
         out = rt.call(params, x)
         ref = layer(paddle.to_tensor(x)).numpy()
         np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+def test_dlpack_torch_roundtrip():
+    import numpy as np
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    import paddle_tpu as paddle
+    from paddle_tpu.utils.dlpack import from_dlpack, to_dlpack
+
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    t = torch.utils.dlpack.from_dlpack(to_dlpack(x))
+    assert tuple(t.shape) == (3, 4) and float(t.sum()) == 66.0
+    # capsule path (the reference API's currency)
+    back = from_dlpack(torch.utils.dlpack.to_dlpack(t * 2))
+    np.testing.assert_allclose(np.asarray(back._data).sum(), 132.0)
+    # protocol-object path
+    back2 = from_dlpack(t * 3)
+    np.testing.assert_allclose(np.asarray(back2._data).sum(), 198.0)
+
+
+def test_download_helpers_offline():
+    import os
+
+    from paddle_tpu.utils.download import get_weights_path_from_url
+
+    # file:// URLs exercise the cache path without network
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        src = os.path.join(td, "w.bin")
+        with open(src, "wb") as f:
+            f.write(b"weights")
+        p = get_weights_path_from_url("file://" + src)
+        with open(p, "rb") as f:
+            assert f.read() == b"weights"
