@@ -236,7 +236,7 @@ class CompiledTrainStep:
             found_inf = jnp.asarray(False)
 
         new_params, new_opt = self.optimizer.apply_gradients_functional(
-            param_vals, grads, opt_state, lr)
+            param_vals, grads, opt_state, lr, params_ref=self._params)
 
         if self._scaler_cfg:
             keep = lambda old, new: jax.tree_util.tree_map(

@@ -52,12 +52,21 @@ def _topk_gating(logits, k, capacity):
 
 
 def _topk_gating_sparse(logits, k, capacity):
-    """Scatter-based routing: returns per-assignment
-    (expert [kS], slot [kS], weight [kS], aux) without ever materializing
-    the [S, E, C] dispatch/combine tensors (round-1 verdict weak #9: at
-    pretraining scale those are 10^8-element intermediates per layer).
-    Assignment order is choice-major, matching the dense path's capacity
-    priority (all first choices claim slots before any second choice)."""
+    """Sort-based routing (reference incubate/distributed/models/moe/
+    moe_layer.py:244 does the same with explicit index ops): argsort the
+    k*S (expert, token) assignments by expert, read each assignment's
+    position inside its expert queue off the inverse permutation, and get
+    per-expert segment starts/counts by binary search on the sorted key
+    array. Everything downstream is pure gathers — no [S, E, C] one-hot,
+    no scatters (TPU scatters serialize; gathers vectorize).
+
+    Assignment order is choice-major (j = choice*S + token), so the stable
+    argsort reproduces the dense path's capacity priority exactly: all
+    first choices claim slots before any second choice, in token order.
+
+    Returns (e_flat [kS], sort_idx [kS], starts [E], counts [E],
+    slot [kS], weight [kS], keep [kS], aux).
+    """
     S, E = logits.shape
     gates = jax.nn.softmax(logits, axis=-1)
     topk_val, topk_idx = jax.lax.top_k(gates, k)  # [S, k]
@@ -67,16 +76,20 @@ def _topk_gating_sparse(logits, k, capacity):
 
     e_flat = topk_idx.T.reshape(-1)          # [kS], choice-major
     w_flat = topk_val.T.reshape(-1)          # [kS]
-    onehot = jax.nn.one_hot(e_flat, E, dtype=jnp.int32)       # [kS, E]
-    pos = (jnp.cumsum(onehot, axis=0) - onehot)               # [kS, E]
-    slot = jnp.take_along_axis(pos, e_flat[:, None], axis=1)[:, 0]
+    sort_idx = jnp.argsort(e_flat)           # stable: keeps choice priority
+    pos = jnp.argsort(sort_idx)              # inverse permutation [kS]
+    e_sorted = e_flat[sort_idx]
+    starts = jnp.searchsorted(e_sorted, jnp.arange(E), side="left")
+    counts = jnp.searchsorted(e_sorted, jnp.arange(E), side="right") - starts
+    slot = pos - starts[e_flat]              # position in own expert queue
     keep = (slot < capacity).astype(gates.dtype)
     w_flat = w_flat * keep
-    # renormalize over this token's kept choices
-    token = jnp.tile(jnp.arange(S), k)       # [kS]
-    denom = jnp.zeros((S,), gates.dtype).at[token].add(w_flat)
-    w_flat = w_flat / jnp.maximum(denom[token], 1e-9)
-    return token, e_flat, jnp.minimum(slot, capacity - 1), w_flat, keep, aux
+    # renormalize over this token's kept choices (choice-major reshape)
+    denom = w_flat.reshape(k, S).sum(axis=0)
+    w_flat = w_flat / jnp.maximum(jnp.tile(denom, k), 1e-9)
+    return (e_flat, sort_idx, starts.astype(jnp.int32),
+            counts.astype(jnp.int32), jnp.minimum(slot, capacity - 1),
+            w_flat, keep, aux)
 
 
 class TopKGate(Layer):
@@ -101,12 +114,13 @@ class TopKGate(Layer):
         return apply(f, x_flat, self.weight, n_outputs=3)
 
     def forward_sparse(self, x_flat):
-        """x_flat: [S, d] → (token, expert, slot, weight, keep, aux)."""
+        """x_flat: [S, d] → (e_flat, sort_idx, starts, counts, slot,
+        weight, keep, aux)."""
         capacity = self.capacity(x_flat.shape[0])
         def f(x, w):
             logits = (x.astype(jnp.float32) @ w.astype(jnp.float32))
             return _topk_gating_sparse(logits, self.k, capacity)
-        return apply(f, x_flat, self.weight, n_outputs=6)
+        return apply(f, x_flat, self.weight, n_outputs=8)
 
 
 class SwitchGate(TopKGate):
@@ -199,25 +213,40 @@ class MoELayer(Layer):
         return apply(f, x_flat, dispatch, combine, self.w_up, self.w_down)
 
     def _forward_sparse(self, x_flat, S, C):
-        """Scatter/gather dispatch: peak routing memory O(kS·d + E·C·d),
-        never [S,E,C] (pretraining-scale path)."""
-        token, e_idx, slot, w, keep, aux = self.gate.forward_sparse(x_flat)
+        """Sort-based dispatch/combine: peak routing memory
+        O(kS·d + E·C·d), never [S,E,C]; pure gathers on both sides.
+
+        Dispatch reads expert queue slot (e, c) straight out of the
+        expert-sorted assignment order (a gather of x rows); combine
+        gathers each assignment's expert output and reduces the k choices
+        with a reshape-sum — the choice-major assignment layout makes the
+        per-token reduction a [k, S, d] axis-0 sum, so no scatter-add is
+        ever needed (reference moe_layer.py:244 reaches the same shape
+        with explicit index_select ops)."""
+        e_flat, sort_idx, starts, counts, slot, w, keep, aux = \
+            self.gate.forward_sparse(x_flat)
         self.aux_loss = aux
         act = self._act()
         E = self.num_experts
+        k = self.gate.k
 
-        def f(xf, token, e_idx, slot, w, keep, wu, wd):
+        def f(xf, e_flat, sort_idx, starts, counts, slot, w, keep, wu, wd):
             d = xf.shape[-1]
-            dest = e_idx * C + slot                       # [kS]
-            contrib = xf[token] * keep[:, None].astype(xf.dtype)
-            expert_in = jnp.zeros((E * C, d), xf.dtype).at[dest].add(contrib)
-            expert_in = expert_in.reshape(E, C, d)
+            kS = e_flat.shape[0]
+            # dispatch: queue slot (e, c) holds sorted assignment
+            # starts[e]+c when c < counts[e]
+            gpos = starts[:, None] + jnp.arange(C)[None, :]        # [E, C]
+            valid = jnp.arange(C)[None, :] < jnp.minimum(counts, C)[:, None]
+            a_id = sort_idx[jnp.clip(gpos, 0, kS - 1)]             # [E, C]
+            tok = a_id % S                                         # choice-major
+            expert_in = xf[tok] * valid[..., None].astype(xf.dtype)
             h = act(jnp.einsum("ecd,edf->ecf", expert_in, wu))
             expert_out = jnp.einsum("ecf,efd->ecd", h, wd)
-            picked = expert_out.reshape(E * C, d)[dest]   # [kS, d]
+            # combine: gather own slot's output, weight, k-sum per token
+            flat = expert_out.reshape(E * C, d)
+            picked = flat[jnp.clip(e_flat * C + slot, 0, E * C - 1)]
             wk = (w * keep).astype(xf.dtype)
-            return jnp.zeros((S, d), xf.dtype).at[token].add(
-                picked * wk[:, None])
+            return (picked * wk[:, None]).reshape(k, S, d).sum(axis=0)
 
-        return apply(f, x_flat, token, e_idx, slot, w, keep,
-                     self.w_up, self.w_down)
+        return apply(f, x_flat, e_flat, sort_idx, starts, counts, slot,
+                     w, keep, self.w_up, self.w_down)

@@ -89,6 +89,25 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        # Sparse-row semantics (reference: adam_op lazy_mode / the PS
+        # accessors, the_one_ps.py:220): rows with all-zero gradient this
+        # step — embedding rows no id touched — keep their moments and
+        # values untouched instead of decaying toward the update. Applies
+        # only to sparse tables (is_sparse_table marker) — the reference
+        # likewise restricts lazy_mode to SelectedRows grads; dense params
+        # update normally even when their grad happens to be zero. A bare
+        # update_param(..., param=None) call treats the param as sparse.
+        self._lazy = bool(lazy_mode)
+
+    def _lazy_for(self, g, param):
+        return (self._lazy and jnp.ndim(g) >= 2
+                and (param is None
+                     or getattr(param, "is_sparse_table", False)))
+
+    @staticmethod
+    def _touched_rows(g32):
+        return jnp.any(g32 != 0, axis=tuple(range(1, g32.ndim)),
+                       keepdims=True)
 
     def init_param_state(self, p):
         dt = _acc_dtype(p, self._multi_precision)
@@ -98,23 +117,31 @@ class Adam(Optimizer):
             "beta1_pow": jnp.asarray(1.0, dtype=jnp.float32),
             "beta2_pow": jnp.asarray(1.0, dtype=jnp.float32)})
 
-    def _adam_update(self, p, g, st, lr):
+    def _adam_update(self, p, g, st, lr, param=None):
+        """Returns (step, new_state, touched_rows_or_None)."""
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
         g32 = _f32(g)
         m = b1 * st["moment1"] + (1 - b1) * g32
         v = b2 * st["moment2"] + (1 - b2) * g32 * g32
         b1p = st["beta1_pow"] * b1
         b2p = st["beta2_pow"] * b2
+        touched = None
+        if self._lazy_for(g32, param):
+            touched = self._touched_rows(g32)
+            m = jnp.where(touched, m, st["moment1"])
+            v = jnp.where(touched, v, st["moment2"])
         mhat = m / (1 - b1p)
         vhat = v / (1 - b2p)
         step = lr * mhat / (jnp.sqrt(vhat) + eps)
+        if touched is not None:
+            step = jnp.where(touched, step, 0.0)
         new_st = {"moment1": m.astype(st["moment1"].dtype),
                   "moment2": v.astype(st["moment2"].dtype),
                   "beta1_pow": b1p, "beta2_pow": b2p}
-        return step, new_st
+        return step, new_st, touched
 
     def update_param(self, p, g, st, lr, param):
-        step, new_st = self._adam_update(p, g, st, lr)
+        step, new_st, _ = self._adam_update(p, g, st, lr, param)
         if "master" in st:
             new_st["master"] = st["master"]
         new_p32 = _read_master(new_st, p) - step
@@ -135,7 +162,7 @@ class AdamW(Adam):
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def update_param(self, p, g, st, lr, param):
-        step, new_st = self._adam_update(p, g, st, lr)
+        step, new_st, touched = self._adam_update(p, g, st, lr, param)
         if "master" in st:
             new_st["master"] = st["master"]
         decay = self._wd_coeff
@@ -143,7 +170,10 @@ class AdamW(Adam):
                 and not self._apply_decay_param_fun(param.name)):
             decay = 0.0
         p32 = _read_master(new_st, p)
-        new_p32 = p32 - lr * decay * p32 - step
+        wd = lr * decay * p32
+        if touched is not None:
+            wd = jnp.where(touched, wd, 0.0)
+        new_p32 = p32 - wd - step
         return _write_master(new_st, new_p32, p), new_st
 
 

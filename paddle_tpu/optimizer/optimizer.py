@@ -75,7 +75,13 @@ class Optimizer:
 
     def _apply_decay_to_grad(self, p, g):
         # L1/L2Decay are coupled (added to grad); AdamW overrides with
-        # decoupled decay in update_param.
+        # decoupled decay in update_param. Sparse tables under lazy mode
+        # skip coupled decay entirely — it would mark every row touched and
+        # defeat the sparse-row semantics (the reference likewise skips the
+        # regularizer for SelectedRows grads with a warning).
+        if getattr(self, "_lazy", False) and \
+                getattr(p, "is_sparse_table", False):
+            return g
         reg = p.regularizer or self._weight_decay
         if isinstance(reg, (L1Decay, L2Decay)) and not getattr(self, "_decoupled", False):
             g = g + reg.grad_term(p._data)
@@ -128,15 +134,22 @@ class Optimizer:
         return {k: self.init_param_state(v) for k, v in params.items()}
 
     def apply_gradients_functional(self, params: dict, grads: dict, state: dict,
-                                   lr):
+                                   lr, params_ref: dict = None):
+        """params_ref (optional): name → eager Parameter, so per-param
+        attributes (is_sparse_table, optimize_attr) survive into the
+        functional update."""
         if self._grad_clip is not None:
             grads = self._grad_clip.apply_functional(grads)
         new_p, new_s = {}, {}
         for k, p in params.items():
             g = grads[k]
-            if self._weight_decay is not None and not getattr(self, "_decoupled", False):
+            ref = params_ref.get(k) if params_ref else None
+            skip_decay = (getattr(self, "_lazy", False) and ref is not None
+                          and getattr(ref, "is_sparse_table", False))
+            if self._weight_decay is not None and not skip_decay \
+                    and not getattr(self, "_decoupled", False):
                 g = g + self._weight_decay.grad_term(p)
-            new_p[k], new_s[k] = self.update_param(p, g, state[k], lr, None)
+            new_p[k], new_s[k] = self.update_param(p, g, state[k], lr, ref)
         return new_p, new_s
 
     # -- per-algorithm hooks (override) --------------------------------------

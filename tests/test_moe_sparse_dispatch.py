@@ -1,0 +1,160 @@
+"""Sort-based MoE dispatch (round-2 verdict #5).
+
+The sparse path must (a) match the dense [S,E,C] einsum path numerically —
+same capacity priority, same renormalized combine weights — and (b) never
+materialize an S*E*C intermediate (peak-memory assertion via the compiled
+HLO's buffer sizes).
+
+Reference: incubate/distributed/models/moe/moe_layer.py:244 (index-op
+dispatch), gate/gshard_gate.py (capacity priority).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.nn.moe import MoELayer, SwitchGate, TopKGate, _topk_gating, \
+    _topk_gating_sparse
+
+
+def _routing_dense(logits, k, C):
+    """Collapse the dense dispatch/combine to per-(token, expert) combine
+    weight for comparison."""
+    dispatch, combine, aux = _topk_gating(logits, k, C)
+    return np.asarray(combine.sum(axis=-1)), float(aux)
+
+
+def _routing_sparse(logits, k, C):
+    S, E = logits.shape
+    e_flat, sort_idx, starts, counts, slot, w, keep, aux = \
+        _topk_gating_sparse(logits, k, C)
+    out = np.zeros((S, E), np.float32)
+    token = np.tile(np.arange(S), k)
+    wk = np.asarray(w * keep)
+    for j in range(k * S):
+        out[token[j], int(e_flat[j])] += wk[j]
+    return out, float(aux)
+
+
+@pytest.mark.parametrize("k", [1, 2])
+@pytest.mark.parametrize("seed", [0, 3])
+def test_gating_parity_dense_vs_sparse(k, seed):
+    rng = np.random.default_rng(seed)
+    S, E = 64, 8
+    C = 12  # tight: forces real capacity drops
+    logits = jnp.asarray(rng.standard_normal((S, E)), jnp.float32)
+    wd, auxd = _routing_dense(logits, k, C)
+    ws, auxs = _routing_sparse(logits, k, C)
+    np.testing.assert_allclose(ws, wd, atol=1e-5)
+    assert abs(auxd - auxs) < 1e-5
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_layer_parity_dense_vs_sparse(k):
+    paddle_tpu.seed(0)
+    d, ff, E = 16, 32, 8
+    gate_cls = SwitchGate if k == 1 else TopKGate
+    kwargs = {} if k == 1 else {"k": k}
+    layer = MoELayer(d, ff, E, dispatch_mode="dense",
+                     gate=gate_cls(d, E, **kwargs))
+    rng = np.random.default_rng(0)
+    x = paddle_tpu.to_tensor(
+        rng.standard_normal((2, 32, d)).astype(np.float32))
+    layer.dispatch_mode = "dense"
+    y_dense = np.asarray(layer(x)._data)
+    aux_dense = float(np.asarray(layer.aux_loss._data))
+    layer.dispatch_mode = "sparse"
+    y_sparse = np.asarray(layer(x)._data)
+    aux_sparse = float(np.asarray(layer.aux_loss._data))
+    np.testing.assert_allclose(y_sparse, y_dense, atol=2e-5)
+    assert abs(aux_dense - aux_sparse) < 1e-5
+
+
+def test_sparse_grads_match_dense():
+    paddle_tpu.seed(1)
+    d, ff, E, k = 8, 16, 4, 2
+    S = 32
+    rng = np.random.default_rng(1)
+    gate_w = jnp.asarray(rng.standard_normal((d, E)), jnp.float32) * 0.3
+    wu = jnp.asarray(rng.standard_normal((E, d, ff)), jnp.float32) * 0.1
+    wd_ = jnp.asarray(rng.standard_normal((E, ff, d)), jnp.float32) * 0.1
+    x = jnp.asarray(rng.standard_normal((S, d)), jnp.float32)
+    C = 12
+
+    def dense_loss(wu, wd_):
+        disp, comb, aux = _topk_gating(x @ gate_w, k, C)
+        e_in = jnp.einsum("sd,sec->ecd", x, disp)
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", e_in, wu))
+        e_out = jnp.einsum("ecf,efd->ecd", h, wd_)
+        return jnp.einsum("ecd,sec->sd", e_out, comb).sum() + aux
+
+    def sparse_loss(wu, wd_):
+        e_flat, sort_idx, starts, counts, slot, w, keep, aux = \
+            _topk_gating_sparse(x @ gate_w, k, C)
+        kS = k * S
+        gpos = starts[:, None] + jnp.arange(C)[None, :]
+        valid = jnp.arange(C)[None, :] < jnp.minimum(counts, C)[:, None]
+        a_id = sort_idx[jnp.clip(gpos, 0, kS - 1)]
+        e_in = x[a_id % S] * valid[..., None].astype(x.dtype)
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", e_in, wu))
+        e_out = jnp.einsum("ecf,efd->ecd", h, wd_)
+        picked = e_out.reshape(E * C, d)[
+            jnp.clip(e_flat * C + slot, 0, E * C - 1)]
+        wk = (w * keep).astype(x.dtype)
+        return (picked * wk[:, None]).reshape(k, S, d).sum(
+            axis=0).sum() + aux
+
+    gd = jax.grad(dense_loss, argnums=(0, 1))(wu, wd_)
+    gs = jax.grad(sparse_loss, argnums=(0, 1))(wu, wd_)
+    np.testing.assert_allclose(np.asarray(gs[0]), np.asarray(gd[0]),
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gs[1]), np.asarray(gd[1]),
+                               atol=1e-4)
+
+
+def test_sparse_path_never_materializes_SEC():
+    """Compile the sparse forward at a shape where S*E*C would be ~134M
+    fp32 elements and assert no HLO buffer anywhere near that size;
+    routing buffers stay O(kS) / O(E*C*d)."""
+    S, d, ff, E, k = 4096, 64, 128, 64, 2
+    C = max(4, int(np.ceil(k * S * 1.25 / E)))        # 160
+    sec_bytes = S * E * C * 4                          # ~167 MB fp32
+
+    def fwd(x, gate_w, wu, wd_):
+        e_flat, sort_idx, starts, counts, slot, w, keep, aux = \
+            _topk_gating_sparse(x @ gate_w, k, C)
+        kS = k * S
+        gpos = starts[:, None] + jnp.arange(C)[None, :]
+        valid = jnp.arange(C)[None, :] < jnp.minimum(counts, C)[:, None]
+        a_id = sort_idx[jnp.clip(gpos, 0, kS - 1)]
+        e_in = x[a_id % S] * valid[..., None].astype(x.dtype)
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", e_in, wu))
+        e_out = jnp.einsum("ecf,efd->ecd", h, wd_)
+        picked = e_out.reshape(E * C, d)[
+            jnp.clip(e_flat * C + slot, 0, E * C - 1)]
+        wk = (w * keep).astype(x.dtype)
+        return (picked * wk[:, None]).reshape(k, S, d).sum(axis=0)
+
+    rng = np.random.default_rng(0)
+    args = (jnp.asarray(rng.standard_normal((S, d)), jnp.float32),
+            jnp.asarray(rng.standard_normal((d, E)), jnp.float32),
+            jnp.asarray(rng.standard_normal((E, d, ff)), jnp.float32) * .1,
+            jnp.asarray(rng.standard_normal((E, ff, d)), jnp.float32) * .1)
+    compiled = jax.jit(fwd).lower(*args).compile()
+    analysis = compiled.memory_analysis()
+    peak = (analysis.temp_size_in_bytes + analysis.output_size_in_bytes)
+    # the whole temp footprint must be far below one S*E*C buffer
+    assert peak < sec_bytes // 2, (
+        f"sparse path peak {peak / 1e6:.0f} MB vs S*E*C "
+        f"{sec_bytes / 1e6:.0f} MB — dense intermediate leaked in")
+
+
+def test_auto_mode_picks_sparse_at_scale():
+    layer = MoELayer(8, 16, 64, dispatch_mode="auto")
+    S = 4096
+    C = layer.gate.capacity(S)
+    assert S * 64 * C > MoELayer.DENSE_DISPATCH_LIMIT
+    small_S = 64
+    assert small_S * 64 * layer.gate.capacity(small_S) \
+        <= MoELayer.DENSE_DISPATCH_LIMIT

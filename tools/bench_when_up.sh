@@ -1,0 +1,20 @@
+#!/bin/bash
+# Probe the TPU tunnel; when it answers, run the full bench once.
+# Writes probe status to tools/bench_loop.log and the bench JSON line to
+# tools/bench_last.json (bench.py also persists BENCH_SESSION.json itself).
+cd "$(dirname "$0")/.."
+LOG=tools/bench_loop.log
+for i in $(seq 1 60); do
+  echo "$(date -u +%H:%M:%S) probe $i" >> "$LOG"
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256)); print(float((x @ x).sum()))" >> "$LOG" 2>&1; then
+    echo "$(date -u +%H:%M:%S) tunnel UP — running bench" >> "$LOG"
+    timeout 3600 python bench.py > tools/bench_last.json 2> tools/bench_err.log
+    echo "$(date -u +%H:%M:%S) bench rc=$? done" >> "$LOG"
+    exit 0
+  fi
+  sleep 540
+done
+echo "$(date -u +%H:%M:%S) gave up" >> "$LOG"
+exit 1
