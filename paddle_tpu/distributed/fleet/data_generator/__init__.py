@@ -1,0 +1,91 @@
+"""fleet.data_generator — the CTR sample-parsing protocol.
+
+Reference: python/paddle/distributed/fleet/data_generator/data_generator.py
+(DataGenerator.generate_sample yields [(slot_name, values), ...] per
+sample; MultiSlot*DataGenerator serialize them to the text protocol the
+C++ dataset pipe consumes). The TPU stack keeps the exact subclass API —
+existing user generators run unchanged — but the samples feed padded-dense
+numpy batches straight into the pjit train step instead of a pipe_command
+subprocess; the to-text methods remain for file/pipe interop.
+"""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = int(batch_size)
+
+    # -- user protocol (reference data_generator.py:153) -------------------
+    def generate_sample(self, line):
+        """Return an iterator over samples for one input line; each sample
+        is [(slot_name, list_of_values), ...]."""
+        raise NotImplementedError(
+            "subclasses must implement generate_sample(line)")
+
+    def generate_batch(self, samples):
+        """Optional batch-level hook; yields the samples by default."""
+        for s in samples:
+            yield s
+
+    # -- iteration (TPU-native: python objects, no pipe) -------------------
+    def iter_samples(self, lines):
+        batch = []
+        for line in lines:
+            it = self.generate_sample(line)
+            if it is None:
+                continue
+            for sample in it():
+                if sample is None:
+                    continue
+                batch.append(sample)
+                if len(batch) == self.batch_size_:
+                    yield from self.generate_batch(batch)
+                    batch = []
+        if batch:
+            yield from self.generate_batch(batch)
+
+    # -- text protocol compat (run under pipe_command) ---------------------
+    def _gen_str(self, line):
+        raise NotImplementedError
+
+    def run_from_memory(self, lines=None):
+        for line in (lines or []):
+            it = self.generate_sample(line)
+            if it is None:
+                continue
+            for sample in it():
+                if sample is not None:
+                    sys.stdout.write(self._gen_str(sample))
+
+    def run_from_stdin(self):
+        for line in sys.stdin:
+            it = self.generate_sample(line)
+            if it is None:
+                continue
+            for sample in it():
+                if sample is not None:
+                    sys.stdout.write(self._gen_str(sample))
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Serializes "<n> v1 .. vn" per slot (reference data_generator.py:284)."""
+
+    def _gen_str(self, sample):
+        parts = []
+        for _name, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """Same wire format with values passed through as strings (reference
+    data_generator.py:239; str(v) is a no-op on str values)."""

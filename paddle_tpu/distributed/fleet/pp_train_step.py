@@ -60,7 +60,7 @@ class Compiled1F1BTrainStep(CompiledTrainStep):
                 grads[k] = g.astype(param_vals[k].dtype)
 
         new_params, new_opt = self.optimizer.apply_gradients_functional(
-            param_vals, grads, opt_state, lr)
+            param_vals, grads, opt_state, lr, params_ref=self._params)
         return (loss, new_params, new_opt, buffer_vals, scaler_state,
                 jnp.asarray(False))
 

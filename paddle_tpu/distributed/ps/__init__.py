@@ -1,0 +1,21 @@
+"""TPU-native parameter-server analog (the recsys stack).
+
+Reference: python/paddle/distributed/ps/the_one_ps.py (SparseTable /
+DenseTable / accessors, sync-async-geo modes over brpc) and
+fleet/runtime/the_one_ps.py. The reference scales CTR training by hosting
+huge embedding tables on parameter-server daemons and pulling/pushing
+sparse rows per batch.
+
+The TPU re-design has no PS daemon: a "sparse table" is ONE giant
+jax array row-sharded over the mesh (GSPMD partitions the row gather into
+the same all-to-all id exchange + local lookup + collective combine the PS
+client performs by RPC — but over ICI), and "accessors" become sparse-row
+optimizer semantics (lazy Adam / Adagrad update only touched rows) compiled
+into the same pjit train step as the dense parameters. Sync mode is the
+only mode: every step IS globally consistent, which is the deterministic
+improvement over async/geo staleness.
+"""
+from .sharded_table import (ShardedEmbedding, SparseTableConfig,
+                            row_shard_spec)
+
+__all__ = ["ShardedEmbedding", "SparseTableConfig", "row_shard_spec"]

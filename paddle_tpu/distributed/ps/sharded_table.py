@@ -1,0 +1,99 @@
+"""Mesh-sharded embedding tables — the TPU SparseTable.
+
+Reference: python/paddle/distributed/ps/the_one_ps.py:575 (SparseTable:
+shard_num row shards over pserver processes, client-side id dedup +
+pull_sparse RPC) and fluid/layers' sparse embedding lookup.
+
+Here a table is one Parameter [num_rows, dim] whose leading axis carries a
+PartitionSpec over mesh axes (default "sharding", optionally +"tp"): each
+device holds num_rows/axis_size contiguous rows, so a table can exceed
+single-device HBM as long as mesh_size × HBM covers it. The row gather in
+forward runs under the pjit train step, where GSPMD partitions it into the
+PS wire protocol's TPU equivalent: ids broadcast/all-to-all over ICI,
+local gathers on each shard, and a collective select/psum of the hits.
+No daemon, no RPC, no staleness.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...nn.initializer import Normal, XavierUniform
+from ...nn.layer_base import Layer
+from ...tensor import apply
+
+
+def row_shard_spec(mesh_axes=("sharding",)):
+    """PartitionSpec sharding a table's row axis over the given mesh axes."""
+    axes = tuple(mesh_axes)
+    return P(axes if len(axes) > 1 else axes[0], None)
+
+
+class SparseTableConfig:
+    """Table declaration: reference the_one_ps.py SparseTable proto fields
+    that still mean something without a PS daemon (name, dims, initializer
+    range); shard_num is replaced by the mesh axes."""
+
+    def __init__(self, name, num_rows, dim, mesh_axes=("sharding",),
+                 init_std=0.01):
+        self.name = name
+        self.num_rows = int(num_rows)
+        self.dim = int(dim)
+        self.mesh_axes = tuple(mesh_axes)
+        self.init_std = float(init_std)
+
+
+class ShardedEmbedding(Layer):
+    """Row-sharded embedding table with optional bag pooling.
+
+    ids: int tensor of any shape; out-of-range ids hash (mod) into the
+    table — the PS stack's accessor hash, reference the_one_ps.py:290
+    (get_shard). With ``combiner`` set and ids of shape [..., L], the
+    trailing axis is pooled (sum/mean over non-padding positions), the
+    multi-id slot layout of CTR models (padded-dense replaces the
+    reference's LoD-sparse input; padding id = ``padding_idx``).
+    """
+
+    def __init__(self, num_embeddings, embedding_dim,
+                 mesh_axes=("sharding",), combiner=None, padding_idx=None,
+                 weight_attr=None, init_std=0.01, name=None):
+        super().__init__()
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self.combiner = combiner
+        self.padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            (self.num_embeddings, self.embedding_dim), attr=weight_attr,
+            default_initializer=Normal(std=init_std))
+        self.weight.pspec = row_shard_spec(mesh_axes)
+        self.weight.is_sparse_table = True  # lazy-row optimizer marker
+
+    @classmethod
+    def from_config(cls, cfg: SparseTableConfig, **kw):
+        return cls(cfg.num_rows, cfg.dim, mesh_axes=cfg.mesh_axes,
+                   init_std=cfg.init_std, **kw)
+
+    def forward(self, ids):
+        V = self.num_embeddings
+        combiner = self.combiner
+        pad = self.padding_idx
+
+        def f(table, ids):
+            idx = jnp.asarray(ids) % V            # accessor hash for OOV
+            rows = table[idx]                     # GSPMD-partitioned gather
+            if pad is not None:
+                live = (jnp.asarray(ids) != pad)[..., None]
+                rows = rows * live.astype(rows.dtype)
+            if combiner is None:
+                return rows
+            if combiner == "sum":
+                return rows.sum(axis=-2)
+            if combiner == "mean":
+                if pad is None:
+                    return rows.mean(axis=-2)
+                n = jnp.maximum(
+                    (jnp.asarray(ids) != pad).sum(axis=-1, keepdims=True), 1)
+                return rows.sum(axis=-2) / n.astype(rows.dtype)
+            raise ValueError(f"unknown combiner {combiner!r}")
+
+        return apply(f, self.weight, ids)

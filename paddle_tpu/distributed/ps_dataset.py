@@ -111,17 +111,35 @@ class _FileLinesDataset:
         reference's pipe_command subprocess protocol)."""
         self._parse_fn = fn
 
-    # -- iteration ---------------------------------------------------------
-    def _iter_lines(self):
+    _sample_expander = None
+
+    def set_generator(self, gen):
+        """Attach a fleet.data_generator.DataGenerator: lines are expanded
+        through gen.generate_sample (the reference's pipe_command protocol,
+        in-process). Overrides set_parse_fn."""
+        self._sample_expander = gen.iter_samples
+
+    def _iter_samples(self):
+        """Samples after generator expansion (1 line may yield many)."""
+        if self._sample_expander is not None:
+            yield from self._sample_expander(self._iter_raw_lines())
+        else:
+            yield from self._iter_lines()
+
+    def _iter_raw_lines(self):
         for path in self._files:
             with open(path, "r", encoding="utf-8", errors="ignore") as f:
                 for line in f:
-                    line = line.rstrip("\n")
-                    yield self._parse_fn(line) if self._parse_fn else line
+                    yield line.rstrip("\n")
+
+    # -- iteration ---------------------------------------------------------
+    def _iter_lines(self):
+        for line in self._iter_raw_lines():
+            yield self._parse_fn(line) if self._parse_fn else line
 
     def __iter__(self):
         batch = []
-        for sample in self._iter_lines():
+        for sample in self._iter_samples():
             batch.append(sample)
             if len(batch) == self._batch_size:
                 yield batch
@@ -139,7 +157,7 @@ class InMemoryDataset(_FileLinesDataset):
         self._samples = None
 
     def load_into_memory(self):
-        self._samples = list(self._iter_lines())
+        self._samples = list(self._iter_samples())
 
     def local_shuffle(self):
         import random

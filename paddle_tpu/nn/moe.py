@@ -243,9 +243,10 @@ class MoELayer(Layer):
             h = act(jnp.einsum("ecd,edf->ecf", expert_in, wu))
             expert_out = jnp.einsum("ecf,efd->ecd", h, wd)
             # combine: gather own slot's output, weight, k-sum per token
+            # (w is already drop-masked and renormalized by the gate)
             flat = expert_out.reshape(E * C, d)
             picked = flat[jnp.clip(e_flat * C + slot, 0, E * C - 1)]
-            wk = (w * keep).astype(xf.dtype)
+            wk = w.astype(xf.dtype)
             return (picked * wk[:, None]).reshape(k, S, d).sum(axis=0)
 
         return apply(f, x_flat, e_flat, sort_idx, starts, counts, slot,

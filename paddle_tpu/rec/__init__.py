@@ -1,0 +1,7 @@
+"""Recommender models on mesh-sharded sparse tables (the PaddleRec/CTR
+capability of the reference's PS stack; reference:
+python/paddle/distributed/ps/the_one_ps.py + PaddleRec wide_deep/deepfm
+models that drive it)."""
+from .models import DeepFM, WideDeep
+
+__all__ = ["WideDeep", "DeepFM"]

@@ -1,0 +1,116 @@
+"""CTR models over mesh-sharded embedding tables.
+
+Reference capability: the PaddleRec wide&deep / DeepFM models that drive
+the_one_ps.py's SparseTables (sparse slot ids -> pserver pull_sparse ->
+dense tower). TPU-native: the sparse tables are ShardedEmbedding rows over
+the mesh, ids arrive padded-dense [B, num_slots, ids_per_slot], and the
+whole model — gather, pooling, towers, loss — lives in one pjit program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..distributed.ps import ShardedEmbedding
+from ..tensor import apply
+
+
+def _mlp(dims, out_dim=1):
+    layers = []
+    for i in range(len(dims) - 1):
+        layers += [nn.Linear(dims[i], dims[i + 1]), nn.ReLU()]
+    layers.append(nn.Linear(dims[-1], out_dim))
+    return nn.Sequential(*layers)
+
+
+class WideDeep(nn.Layer):
+    """Wide & Deep CTR (Cheng et al.): wide = linear over dense features +
+    per-slot scalar embeddings; deep = MLP over [dense, slot embeddings].
+
+    ids: int [B, num_slots, ids_per_slot] (0 = padding), dense [B, dense_dim]
+    → logits [B]. ``labels`` adds the BCE loss (reference models emit
+    sigmoid+log_loss into the PS program).
+    """
+
+    def __init__(self, vocab_size, num_slots, embed_dim=16, dense_dim=13,
+                 hidden=(256, 128, 64), mesh_axes=("sharding",)):
+        super().__init__()
+        self.embedding = ShardedEmbedding(
+            vocab_size, embed_dim, mesh_axes=mesh_axes, combiner="sum",
+            padding_idx=0)
+        self.wide_embedding = ShardedEmbedding(
+            vocab_size, 1, mesh_axes=mesh_axes, combiner="sum",
+            padding_idx=0)
+        self.wide_dense = nn.Linear(dense_dim, 1)
+        self.deep = _mlp([dense_dim + num_slots * embed_dim, *hidden])
+
+    def forward(self, ids, dense, labels=None):
+        emb = self.embedding(ids)                       # [B, slots, d]
+        wide_sparse = self.wide_embedding(ids)          # [B, slots, 1]
+        b = emb.shape[0]
+        from ..tensor_ops.manipulation import concat, reshape
+        deep_in = concat([dense, reshape(emb, (b, -1))], axis=-1)
+        deep_out = self.deep(deep_in)                   # [B, 1]
+        wide_out = self.wide_dense(dense)               # [B, 1]
+
+        def head(deep_out, wide_out, wide_sparse):
+            return (deep_out[:, 0] + wide_out[:, 0]
+                    + wide_sparse.sum(axis=(-2, -1)))
+
+        logits = apply(head, deep_out, wide_out, wide_sparse)
+        if labels is None:
+            return logits
+        return logits, _bce(logits, labels)
+
+
+class DeepFM(nn.Layer):
+    """DeepFM (Guo et al.): first-order scalar embeddings + FM pairwise
+    interactions 0.5*((Σv)² − Σv²) + deep MLP, shared embedding table."""
+
+    def __init__(self, vocab_size, num_slots, embed_dim=16, dense_dim=13,
+                 hidden=(256, 128), mesh_axes=("sharding",)):
+        super().__init__()
+        self.embedding = ShardedEmbedding(
+            vocab_size, embed_dim, mesh_axes=mesh_axes, combiner="sum",
+            padding_idx=0)
+        self.first_order = ShardedEmbedding(
+            vocab_size, 1, mesh_axes=mesh_axes, combiner="sum",
+            padding_idx=0)
+        self.dense_proj = nn.Linear(dense_dim, embed_dim)
+        self.deep = _mlp([(num_slots + 1) * embed_dim, *hidden])
+
+    def forward(self, ids, dense, labels=None):
+        emb = self.embedding(ids)            # [B, slots, d] pooled per slot
+        first = self.first_order(ids)        # [B, slots, 1]
+        dense_f = self.dense_proj(dense)     # [B, d]
+        b = emb.shape[0]
+        from ..tensor_ops.manipulation import concat, reshape
+
+        def fm_and_head(emb, first, dense_f):
+            fields = jnp.concatenate([emb, dense_f[:, None, :]], axis=1)
+            sum_sq = fields.sum(axis=1) ** 2
+            sq_sum = (fields ** 2).sum(axis=1)
+            fm = 0.5 * (sum_sq - sq_sum).sum(axis=-1)       # [B]
+            return fm + first.sum(axis=(-2, -1))
+
+        fm_logit = apply(fm_and_head, emb, first, dense_f)
+        deep_in = concat([reshape(emb, (b, -1)), dense_f], axis=-1)
+        deep_out = self.deep(deep_in)
+
+        def head(fm_logit, deep_out):
+            return fm_logit + deep_out[:, 0]
+
+        logits = apply(head, fm_logit, deep_out)
+        if labels is None:
+            return logits
+        return logits, _bce(logits, labels)
+
+
+def _bce(logits, labels):
+    def f(z, y):
+        y = y.astype(jnp.float32)
+        z = z.astype(jnp.float32)
+        # numerically-stable BCE-with-logits
+        return jnp.mean(jnp.maximum(z, 0) - z * y + jnp.log1p(
+            jnp.exp(-jnp.abs(z))))
+    return apply(f, logits, labels)

@@ -221,7 +221,7 @@ class Parameter(Tensor):
     """Trainable tensor (reference: fluid.framework.Parameter/EagerParamBase)."""
 
     __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed",
-                 "is_mp", "split_axis", "pspec")
+                 "is_mp", "split_axis", "pspec", "is_sparse_table")
 
     def __init__(self, data, dtype=None, trainable: bool = True,
                  name: Optional[str] = None):
@@ -234,6 +234,7 @@ class Parameter(Tensor):
         self.is_mp = False
         self.split_axis = None
         self.pspec = None  # jax PartitionSpec for the distributed path
+        self.is_sparse_table = False  # lazy-row optimizer semantics marker
 
     def set_value(self, value):
         if isinstance(value, Tensor):

@@ -1,0 +1,181 @@
+"""TPU-native parameter-server analog (round-2 verdict #2).
+
+Reference: distributed/ps/the_one_ps.py (SparseTable row shards over
+pservers), fleet/data_generator. Here: mesh-row-sharded embedding tables,
+lazy sparse-row Adam, CTR models (wide&deep / DeepFM), and the
+data_generator → InMemoryDataset → padded-dense batch pipeline.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import optimizer as optim
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.data_generator import (
+    MultiSlotDataGenerator)
+from paddle_tpu.distributed.ps import ShardedEmbedding
+from paddle_tpu.rec import DeepFM, WideDeep
+from paddle_tpu.rec.data import (CriteoLineParser, CTRSchema,
+                                 iter_ctr_batches, synthetic_ctr_lines)
+
+VOCAB = 4096
+SLOTS = 26
+DENSE = 13
+
+
+def _fleet_ctr(model_cls, sharding_degree, vocab=VOCAB, steps=3,
+               lazy=True, batch=None):
+    paddle_tpu.seed(0)
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1,
+                               "sharding_degree": sharding_degree}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = fleet.distributed_model(
+        model_cls(vocab, SLOTS, embed_dim=8, dense_dim=DENSE,
+                  hidden=(32, 16)))
+    opt = fleet.distributed_optimizer(
+        optim.AdamW(learning_rate=1e-2, lazy_mode=lazy,
+                    parameters=model.parameters()),
+        strategy=strategy)
+    step = opt.make_train_step(
+        model, lambda m, ids, dense, label: m(ids, dense, labels=label)[1])
+    if batch is None:
+        schema = CTRSchema([f"C{i+1}" for i in range(SLOTS)],
+                           ids_per_slot=1, dense_dim=DENSE,
+                           vocab_size=vocab)
+        parse = CriteoLineParser()
+        samples = [parse(l) for l in synthetic_ctr_lines(64)]
+        batch = schema.assemble(samples[:16])
+    ids = paddle_tpu.to_tensor(batch["ids"])
+    dense = paddle_tpu.to_tensor(batch["dense"])
+    label = paddle_tpu.to_tensor(batch["label"])
+    losses = [float(np.asarray(step(ids, dense, label)._data))
+              for _ in range(steps)]
+    return losses, model
+
+
+def test_table_row_sharded_over_mesh():
+    """The table's rows live sharded over the mesh: each device holds
+    V/8 rows — a table 8x bigger than one device could replicate."""
+    losses, model = _fleet_ctr(WideDeep, sharding_degree=8)
+    table = model.embedding.weight._data
+    assert str(table.sharding.spec[0]) == "sharding"
+    shard_rows = {s.data.shape[0] for s in table.addressable_shards}
+    assert shard_rows == {VOCAB // 8}, shard_rows
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("model_cls", [WideDeep, DeepFM])
+def test_sharded_matches_single_device(model_cls):
+    """Row-sharding is numerically invisible: losses match a
+    single-device (replicated) run step for step."""
+    l_sharded, _ = _fleet_ctr(model_cls, sharding_degree=4)
+    l_single, _ = _fleet_ctr(model_cls, sharding_degree=1)
+    np.testing.assert_allclose(l_sharded, l_single, rtol=2e-4, atol=2e-5)
+
+
+def test_lazy_rows_untouched_in_train_step():
+    """Rows whose ids never appear in the batch keep exact initial values
+    (lazy sparse-row Adam through the compiled step)."""
+    losses, model = _fleet_ctr(WideDeep, sharding_degree=2, steps=2)
+    table = np.asarray(model.embedding.weight._data)
+    paddle_tpu.seed(0)
+    ref = WideDeep(VOCAB, SLOTS, embed_dim=8, dense_dim=DENSE,
+                   hidden=(32, 16))
+    init = np.asarray(ref.embedding.weight._data)
+    unchanged = np.all(table == init, axis=1)
+    # the 16x26 batch touches at most 416 distinct rows of 4096
+    assert unchanged.sum() >= VOCAB - 16 * SLOTS - 1
+    assert (~unchanged).sum() > 0
+
+
+def test_non_lazy_decay_touches_all_rows():
+    l, model = _fleet_ctr(WideDeep, sharding_degree=2, steps=2, lazy=False)
+    # AdamW weight decay moves every row when lazy_mode is off
+    table = np.asarray(model.embedding.weight._data)
+    paddle_tpu.seed(0)
+    ref = WideDeep(VOCAB, SLOTS, embed_dim=8, dense_dim=DENSE,
+                   hidden=(32, 16))
+    init = np.asarray(ref.embedding.weight._data)
+    changed = ~np.all(table == init, axis=1)
+    assert changed.mean() > 0.99
+
+
+def test_ctr_model_learns_signal():
+    """End-to-end: generator → dataset → batches → compiled train step;
+    the synthetic signal (dense[0] + C1 parity) is learnable."""
+    from paddle_tpu.distributed.ps_dataset import InMemoryDataset
+
+    lines = synthetic_ctr_lines(512, seed=1)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "part-0")
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+        class Gen(MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                parse = CriteoLineParser()
+
+                def g():
+                    yield parse(line)
+                return g
+
+        ds = InMemoryDataset()
+        ds.init(batch_size=64)
+        ds.set_filelist([path])
+        ds.set_generator(Gen())
+        ds.load_into_memory()
+        ds.local_shuffle()
+        samples = [s for b in ds for s in b]
+    assert len(samples) == 512
+
+    schema = CTRSchema([f"C{i+1}" for i in range(SLOTS)], ids_per_slot=1,
+                       dense_dim=DENSE, vocab_size=VOCAB)
+    paddle_tpu.seed(0)
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = fleet.distributed_model(
+        DeepFM(VOCAB, SLOTS, embed_dim=8, dense_dim=DENSE, hidden=(32,)))
+    opt = fleet.distributed_optimizer(
+        optim.AdamW(learning_rate=1e-2, lazy_mode=True,
+                    parameters=model.parameters()),
+        strategy=strategy)
+    step = opt.make_train_step(
+        model, lambda m, ids, dense, label: m(ids, dense, labels=label)[1])
+    first = last = None
+    for epoch in range(6):
+        for b in iter_ctr_batches(iter(samples), schema, 64):
+            loss = float(np.asarray(
+                step(paddle_tpu.to_tensor(b["ids"]),
+                     paddle_tpu.to_tensor(b["dense"]),
+                     paddle_tpu.to_tensor(b["label"]))._data))
+            if first is None:
+                first = loss
+            last = loss
+    assert last < first * 0.9, (first, last)
+
+
+def test_data_generator_text_protocol(capsys):
+    class Gen(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def g():
+                yield [("label", [1]), ("ids", [3, 4])]
+            return g
+
+    Gen().run_from_memory(["x"])
+    out = capsys.readouterr().out
+    assert out == "1 1 2 3 4\n"
+
+
+def test_entry_attr_configs_still_work():
+    from paddle_tpu.distributed.ps_dataset import (CountFilterEntry,
+                                                   ProbabilityEntry)
+    assert CountFilterEntry(5)._to_attr() == "count_filter_entry:5"
+    assert ProbabilityEntry(0.5)._to_attr() == "probability_entry:0.5"

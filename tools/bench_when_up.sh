@@ -10,7 +10,7 @@ for i in $(seq 1 60); do
 import jax, jax.numpy as jnp
 x = jnp.ones((256, 256)); print(float((x @ x).sum()))" >> "$LOG" 2>&1; then
     echo "$(date -u +%H:%M:%S) tunnel UP — running bench" >> "$LOG"
-    timeout 3600 python bench.py > tools/bench_last.json 2> tools/bench_err.log
+    timeout 4800 python bench.py > tools/bench_last.json 2> tools/bench_err.log
     echo "$(date -u +%H:%M:%S) bench rc=$? done" >> "$LOG"
     exit 0
   fi
