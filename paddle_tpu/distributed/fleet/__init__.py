@@ -59,10 +59,31 @@ class _FleetOptimizer:
     def __getattr__(self, name):
         return getattr(self._inner, name)
 
-    def make_train_step(self, model, loss_fn, **kw) -> CompiledTrainStep:
-        amp_level = "O1" if self._strategy.amp else kw.pop("amp_level", None)
+    def make_train_step(self, model, loss_fn, **kw):
+        s = self._strategy
+        if getattr(s, "localsgd", False) or getattr(s, "dgc", False):
+            if s.amp:
+                raise NotImplementedError(
+                    "strategy.amp is not supported together with "
+                    "localsgd/dgc — run them in full precision")
+        if getattr(s, "localsgd", False):
+            from .comm_efficient import LocalSGDTrainStep
+            cfg = s.localsgd_configs
+            return LocalSGDTrainStep(
+                model, self._inner, loss_fn, strategy=s,
+                k_steps=int(cfg.get("k_steps", 4)),
+                begin_step=int(cfg.get("begin_step", 1)))
+        if getattr(s, "dgc", False):
+            from .comm_efficient import DGCTrainStep
+            cfg = getattr(s, "dgc_configs", {})
+            return DGCTrainStep(
+                model, loss_fn, strategy=s, optimizer=self._inner,
+                momentum=cfg.get("momentum"),
+                sparsity=float(cfg.get("sparsity", 0.99)),
+                clip_norm=cfg.get("clip_norm"))
+        amp_level = kw.pop("amp_level", None) or ("O1" if s.amp else None)
         return make_train_step(model, self._inner, loss_fn,
-                               strategy=self._strategy, amp_level=amp_level,
+                               strategy=s, amp_level=amp_level,
                                **kw)
 
 

@@ -37,6 +37,9 @@ class DistributedStrategy:
         self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
         self.lamb = False
         self.dgc = False
+        self.dgc_configs = {"momentum": None, "sparsity": 0.99}
+        self.localsgd = False
+        self.localsgd_configs = {"k_steps": 4, "begin_step": 1}
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True
         self.nccl_comm_num = 1

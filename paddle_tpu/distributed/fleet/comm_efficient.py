@@ -1,0 +1,274 @@
+"""Communication-efficient data-parallel training: LocalSGD and DGC.
+
+Reference: distributed/fleet/meta_optimizers/localsgd_optimizer.py:12
+(k local updates between parameter averages) and dgc_optimizer.py:1
+(Deep Gradient Compression: top-k gradient sparsification with momentum
+correction; Lin et al.). The reference rewrites the static Program to
+insert c_allreduce every k steps / sparse allgather ops.
+
+TPU-native redesign — both are ONE compiled pjit program each:
+
+* LocalSGD: parameters carry an explicit leading replica axis [dp, ...]
+  sharded over the mesh "dp" axis, the per-replica update is a vmap (XLA
+  maps it with zero communication — each dp group touches only its own
+  slice), and every k-th step a mean over the replica axis (one ICI
+  all-reduce) re-synchronizes. The k-1 silent steps have NO gradient
+  collective at all — the exact comm saving LocalSGD exists for.
+
+* DGC: gradients are computed per-replica inside shard_map over "dp"
+  (again no automatic psum), momentum-corrected into local residuals
+  (u, v), and only each replica's top-k residual entries travel: an
+  all_gather of 2k (index, value) words replaces the full-size
+  all-reduce — N/k-fold less traffic at 99.9%% sparsity. Every replica
+  rebuilds the combined sparse gradient locally and applies the same
+  SGD update, so parameters stay bitwise replicated.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...autograd.tape import functional_mode
+from ...framework.random_seed import functional_key, next_key
+from ...jit.api import _swap_params
+from ...tensor import Tensor
+from .. import mesh as mesh_mod
+
+__all__ = ["LocalSGDTrainStep", "DGCTrainStep"]
+
+
+def _loss_of(model, params, loss_fn):
+    def f(pv, mb, mkey):
+        with functional_mode(), _swap_params(params, pv), \
+                functional_key(mkey):
+            loss = loss_fn(model, *mb)
+        raw = loss._data if isinstance(loss, Tensor) else loss
+        return raw.astype(jnp.float32)
+    return f
+
+
+def _split_batch(batch, n):
+    def split(x):
+        if jnp.ndim(x) == 0:
+            return x
+        if x.shape[0] % n:
+            raise ValueError(f"batch dim {x.shape[0]} not divisible by "
+                             f"dp={n}")
+        return x.reshape(n, x.shape[0] // n, *x.shape[1:])
+    return jax.tree_util.tree_map(split, batch)
+
+
+class LocalSGDTrainStep:
+    """Compiled LocalSGD step. ``k_steps=1`` is exact synchronous DP
+    (average every step); larger k trades staleness for k-fold fewer
+    parameter synchronizations."""
+
+    def __init__(self, model, optimizer, loss_fn: Callable, k_steps=4,
+                 begin_step=1, strategy=None):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.k_steps = max(1, int(k_steps))
+        # reference localsgd_optimizer begin_step: fully synchronous
+        # (average every step) until this step count, then go local
+        self.begin_step = max(0, int(begin_step))
+        mesh = mesh_mod.get_mesh()
+        self.dp = mesh.shape["dp"]
+        self._params = dict(model.named_parameters())
+
+        def rep(x):
+            return jnp.broadcast_to(x[None], (self.dp,) + x.shape)
+
+        pv = {k: p._data for k, p in self._params.items()}
+        self._param_vals = {k: rep(v) for k, v in pv.items()}
+        self._opt_state = jax.tree_util.tree_map(
+            rep, optimizer.init_state(pv))
+        self._count = jnp.zeros((), jnp.int32)
+
+        def shard_leading(leaf):
+            return jax.device_put(
+                leaf, NamedSharding(mesh, P(*(("dp",) +
+                                              (None,) * (leaf.ndim - 1)))))
+
+        self._param_vals = jax.tree_util.tree_map(shard_leading,
+                                                  self._param_vals)
+        self._opt_state = jax.tree_util.tree_map(shard_leading,
+                                                 self._opt_state)
+        self._mesh = mesh
+        self._compiled = jax.jit(self._step, donate_argnums=(0, 1, 2))
+
+    def _step(self, param_vals, opt_state, count, batch, key, lr):
+        loss_of = _loss_of(self.model, self._params, self.loss_fn)
+        micro = _split_batch(batch, self.dp)
+        keys = jax.random.split(key, self.dp)
+
+        def per_replica(pv, st, mb, mkey):
+            loss, grads = jax.value_and_grad(loss_of)(pv, mb, mkey)
+            newp, newst = self.optimizer.apply_gradients_functional(
+                pv, grads, st, lr, params_ref=self._params)
+            return loss, newp, newst
+
+        losses, newp, newst = jax.vmap(per_replica)(
+            param_vals, opt_state, micro, keys)
+        count = count + 1
+        do_avg = ((count % self.k_steps) == 0) | (count <= self.begin_step)
+        newp = jax.tree_util.tree_map(
+            lambda x: jnp.where(
+                do_avg,
+                jnp.broadcast_to(x.mean(axis=0, keepdims=True), x.shape),
+                x),
+            newp)
+        return losses.mean(), newp, newst, count
+
+    def __call__(self, *batch):
+        raw = jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, Tensor) else x, tuple(batch))
+        loss, self._param_vals, self._opt_state, self._count = \
+            self._compiled(self._param_vals, self._opt_state, self._count,
+                           raw, next_key(),
+                           jnp.asarray(self.optimizer.get_lr(), jnp.float32))
+        # reflect replica-0 into the eager parameters
+        for k, p in self._params.items():
+            p._data = self._param_vals[k][0]
+        sched = self.optimizer._lr_scheduler()
+        if sched is not None:
+            sched.step()
+        return Tensor(loss)
+
+
+class DGCTrainStep:
+    """Compiled DGC step (sparsity in [0, 1), e.g. 0.99 sends the top 1%%
+    of momentum-corrected residual entries per replica per step)."""
+
+    def __init__(self, model, loss_fn: Callable, optimizer=None,
+                 learning_rate=0.1, momentum=None, sparsity=0.99,
+                 clip_norm=None, strategy=None):
+        self.model = model
+        self.loss_fn = loss_fn
+        # DGC folds the momentum into the residual correction (reference
+        # DGCMomentumOptimizer wraps Momentum); the outer update is plain
+        # SGD at the optimizer's (scheduled) lr. Adam-family optimizers
+        # have no DGC formulation — reject rather than silently alter.
+        self._optimizer = optimizer
+        if optimizer is not None:
+            from ...optimizer.algorithms import SGD, Momentum
+            if not isinstance(optimizer, (SGD, Momentum)):
+                raise TypeError(
+                    f"DGC requires SGD/Momentum, got "
+                    f"{type(optimizer).__name__}")
+            if momentum is None:
+                momentum = getattr(optimizer, "_momentum", 0.0)
+        self.momentum = float(0.9 if momentum is None else momentum)
+        self.lr = float(learning_rate if optimizer is None
+                        else optimizer.get_lr())
+        # DGC paper §3.2 local gradient clipping: bound each replica's
+        # gradient norm by clip_norm/sqrt(dp) BEFORE accumulation, so the
+        # delayed lump a residual releases stays bounded.
+        self.clip_norm = None if clip_norm is None else float(clip_norm)
+        mesh = mesh_mod.get_mesh()
+        self.dp = mesh.shape["dp"]
+        self._mesh = mesh
+        self._params = dict(model.named_parameters())
+        pv = {k: p._data for k, p in self._params.items()}
+        self._shapes = {k: v.shape for k, v in pv.items()}
+        self._sizes = {k: int(np.prod(v.shape)) or 1 for k, v in pv.items()}
+        self._order = list(pv)
+        self._N = sum(self._sizes.values())
+        self.k = max(1, int(round(self._N * (1.0 - float(sparsity)))))
+        self._param_vals = pv
+        # per-replica residual state, [dp, N] sharded on dp
+        z = jnp.zeros((self.dp, self._N), jnp.float32)
+        sh = NamedSharding(mesh, P("dp", None))
+        self._u = jax.device_put(z, sh)
+        self._v = jax.device_put(z, sh)
+        self._compiled = jax.jit(self._step, donate_argnums=(1, 2))
+
+    def _flatten(self, tree):
+        return jnp.concatenate(
+            [tree[k].astype(jnp.float32).reshape(-1) for k in self._order])
+
+    def _unflatten(self, flat):
+        out, off = {}, 0
+        for k in self._order:
+            n = self._sizes[k]
+            out[k] = flat[off:off + n].reshape(self._shapes[k])
+            off += n
+        return out
+
+    def _step(self, param_vals, u, v, batch, key, lr):
+        from jax import shard_map
+
+        loss_of = _loss_of(self.model, self._params, self.loss_fn)
+        micro = _split_batch(batch, self.dp)
+        keys = jax.random.split(key, self.dp)
+        kk, mom, dp, N = self.k, self.momentum, self.dp, self._N
+
+        def per_replica(pv, u, v, mb, mkey):
+            # inside shard_map: u, v, mb, mkey are this replica's shard
+            # with the leading dp axis of size 1
+            u, v = u[0], v[0]
+            loss, grads = jax.value_and_grad(loss_of)(
+                pv, jax.tree_util.tree_map(lambda x: x[0], mb), mkey[0])
+            g = self._flatten(grads)
+            if self.clip_norm is not None:
+                bound = self.clip_norm / (dp ** 0.5)
+                norm = jnp.sqrt(jnp.sum(g * g))
+                g = g * jnp.minimum(1.0, bound / jnp.maximum(norm, 1e-12))
+            u = mom * u + g                       # momentum correction
+            v = v + u
+            _, idx = jax.lax.top_k(jnp.abs(v), kk)
+            vals = v[idx]
+            # clear sent entries from the local residuals
+            v = v.at[idx].set(0.0)
+            u = u.at[idx].set(0.0)
+            # 2k words over ICI instead of N: gather everyone's selection
+            gidx = jax.lax.all_gather(idx, "dp")     # [dp, k]
+            gval = jax.lax.all_gather(vals, "dp")    # [dp, k]
+            g_comb = jnp.zeros((N,), jnp.float32).at[
+                gidx.reshape(-1)].add(gval.reshape(-1)) / dp
+            loss = jax.lax.pmean(loss, "dp")
+            return loss[None], g_comb[None], u[None], v[None]
+
+        # Tensor is itself a registered pytree — map specs with Tensor as
+        # the leaf so the result is a (prefix) spec tree, not Tensors
+        # wrapping PartitionSpecs.
+        is_leaf = lambda t: isinstance(t, Tensor)
+        spec_rep = jax.tree_util.tree_map(lambda _: P(), param_vals,
+                                          is_leaf=is_leaf)
+        spec_dp0 = jax.tree_util.tree_map(
+            lambda x: P(*(("dp",) + (None,) * (len(x.shape) - 1))), micro,
+            is_leaf=is_leaf)
+        fn = shard_map(
+            per_replica, mesh=self._mesh,
+            in_specs=(spec_rep, P("dp", None), P("dp", None), spec_dp0,
+                      P("dp", None)),
+            out_specs=(P("dp"), P(None, None), P("dp", None),
+                       P("dp", None)),
+            axis_names=frozenset({"dp"}),
+            check_vma=False)
+        loss, g_comb, u, v = fn(param_vals, u, v, micro, keys)
+        g_tree = self._unflatten(g_comb[0])
+        newp = {k: (param_vals[k].astype(jnp.float32)
+                    - lr * g_tree[k]).astype(param_vals[k].dtype)
+                for k in param_vals}
+        return loss.mean(), newp, u, v
+
+    def __call__(self, *batch):
+        raw = jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, Tensor) else x, tuple(batch))
+        lr = (self._optimizer.get_lr() if self._optimizer is not None
+              else self.lr)
+        loss, self._param_vals, self._u, self._v = self._compiled(
+            self._param_vals, self._u, self._v, raw, next_key(),
+            jnp.asarray(lr, jnp.float32))
+        for k, p in self._params.items():
+            p._data = self._param_vals[k]
+        if self._optimizer is not None:
+            sched = self._optimizer._lr_scheduler()
+            if sched is not None:
+                sched.step()
+        return Tensor(loss)

@@ -1,7 +1,26 @@
 """fluid.contrib shim: the pieces 2.x-era code reaches for (mixed
-precision decorator) re-exported from paddle_tpu.amp/static.amp."""
+precision decorator, slim quantization) re-exported from their
+paddle_tpu homes."""
+import types as _types
+
 from ..static import amp  # noqa: F401
+from ..nn.quant.qat import (ImperativeQuantAware,  # noqa: F401
+                            PostTrainingQuantization)
 
 
 class layers:  # contrib.layers namespace stub
     pass
+
+
+# fluid.contrib.slim.quantization.* compat path (reference:
+# fluid/contrib/slim/quantization/imperative/qat.py). Registered in
+# sys.modules so `from ...contrib.slim.quantization import X` works, not
+# just attribute access.
+import sys as _sys
+
+slim = _types.ModuleType(__name__ + ".slim")
+slim.quantization = _types.ModuleType(__name__ + ".slim.quantization")
+slim.quantization.ImperativeQuantAware = ImperativeQuantAware
+slim.quantization.PostTrainingQuantization = PostTrainingQuantization
+_sys.modules[slim.__name__] = slim
+_sys.modules[slim.quantization.__name__] = slim.quantization

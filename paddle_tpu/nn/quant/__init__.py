@@ -18,7 +18,11 @@ from ..layer_base import Layer
 from ..layer.common import Linear
 
 __all__ = ["quantize_int8", "dequantize_int8", "Int8Linear",
-           "quantize_model", "quantize_int8_stochastic"]
+           "quantize_model", "quantize_int8_stochastic",
+           "FakeQuantAbsMax", "FakeQuantMovingAverageAbsMax",
+           "FakeQuantChannelWiseAbsMax", "QuantizedLinear",
+           "QuantizedConv2D", "ImperativeQuantAware",
+           "PostTrainingQuantization", "fake_quant_dequant"]
 
 
 def _quant_raw(w, axis=-1):
@@ -80,7 +84,12 @@ def quantize_int8_stochastic(w, seed: int = 0, interpret: bool = False):
 
 
 class Int8Linear(Layer):
-    """Linear with int8 weight + per-output-channel scale (weight-only)."""
+    """Linear with int8 weight + per-output-channel scale (weight-only).
+
+    ``act_scale`` (optional, set by PTQ calibration): when present, the
+    input is quantize-dequantized to the calibrated int8 grid before the
+    matmul, so the deployed model reproduces full activation-quantization
+    error, not just weight error."""
 
     def __init__(self, in_features, out_features, bias=True):
         super().__init__()
@@ -90,6 +99,7 @@ class Int8Linear(Layer):
         self.register_buffer("qweight", Tensor(jnp.asarray(qw)))
         self.register_buffer(
             "scale", Tensor(jnp.ones((1, out_features), dtype=jnp.float32)))
+        self.act_scale = None
         self.bias = self.create_parameter((out_features,), is_bias=True) \
             if bias else None
 
@@ -126,6 +136,10 @@ class Int8Linear(Layer):
                 y = x @ w
                 return y + b[0].astype(x.dtype) if b else y
 
+        if self.act_scale is not None:
+            from .qat import fake_quant_dequant
+            x = fake_quant_dequant(
+                x, jnp.asarray(self.act_scale, jnp.float32))
         args = (x, self.qweight, self.scale) + (
             (self.bias,) if self.bias is not None else ())
         return apply(f, *args)
@@ -146,3 +160,9 @@ def quantize_model(model: Layer, include=None) -> Layer:
     if isinstance(model, Linear) and not isinstance(model, Int8Linear):
         raise TypeError("pass a container Layer, not a bare Linear")
     return model
+
+
+from .qat import (FakeQuantAbsMax, FakeQuantChannelWiseAbsMax,  # noqa: E402
+                  FakeQuantMovingAverageAbsMax, ImperativeQuantAware,
+                  PostTrainingQuantization, QuantizedConv2D,
+                  QuantizedLinear, fake_quant_dequant)

@@ -1,0 +1,316 @@
+"""Quantization-aware training + post-training quantization.
+
+Reference: python/paddle/nn/quant/quant_layers.py (FakeQuantAbsMax,
+FakeQuantMovingAverageAbsMax, FakeQuantChannelWiseAbsMax, QuantizedLinear/
+QuantizedConv2D) and fluid/contrib/slim/quantization/imperative/qat.py
+(ImperativeQuantAware) + post_training_quantization.py.
+
+TPU-native: fake-quant is a quantize-dequantize in the traced graph with a
+straight-through estimator (clip carries the range gradient, the rounding
+is stop_gradient), so the whole QAT step still compiles into one XLA
+program; observers are layer buffers mutated in forward — the compiled
+train step already threads buffer updates (same mechanism as BatchNorm
+running stats). Export converts observed scales into the existing
+weight-only Int8Linear / int8 MXU kernel path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import Tensor, apply
+from ..layer.common import Linear
+from ..layer.conv import Conv2D
+from ..layer_base import Layer
+
+__all__ = ["FakeQuantAbsMax", "FakeQuantMovingAverageAbsMax",
+           "FakeQuantChannelWiseAbsMax", "QuantizedLinear",
+           "QuantizedConv2D", "ImperativeQuantAware",
+           "PostTrainingQuantization", "fake_quant_dequant"]
+
+
+def _qdq_ste(x, scale, bits):
+    """Quantize-dequantize with STE: clip carries the gradient (zero
+    outside the representable range — reference fake_quantize ops), the
+    round is a stop-gradient residual."""
+    bound = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale.astype(jnp.float32), 1e-10)
+    limit = bound * s
+    y = jnp.clip(x.astype(jnp.float32), -limit, limit)
+    qdq = jnp.round(y / s) * s
+    out = y + jax.lax.stop_gradient(qdq - y)
+    return out.astype(x.dtype)
+
+
+def fake_quant_dequant(x, scale, bits=8):
+    """Functional QDQ with STE on Tensors or raw arrays."""
+    f = lambda x, s: _qdq_ste(x, s, bits)
+    if isinstance(x, Tensor):
+        return apply(f, x, scale)
+    return f(x, jnp.asarray(scale))
+
+
+class FakeQuantAbsMax(Layer):
+    """Per-tensor abs-max scale recomputed every call (weights)."""
+
+    def __init__(self, bits=8):
+        super().__init__()
+        self.bits = bits
+
+    def forward(self, x):
+        bits = self.bits
+
+        def f(x):
+            scale = jnp.max(jnp.abs(x.astype(jnp.float32))) / (
+                2 ** (bits - 1) - 1)
+            return _qdq_ste(x, scale, bits)
+
+        return apply(f, x)
+
+    def scale_of(self, x):
+        raw = x._data if isinstance(x, Tensor) else x
+        return jnp.max(jnp.abs(raw.astype(jnp.float32))) / (
+            2 ** (self.bits - 1) - 1)
+
+
+class FakeQuantChannelWiseAbsMax(Layer):
+    """Per-output-channel abs-max (weights; channel axis configurable —
+    reference FakeQuantChannelWiseAbsMax quant_axis)."""
+
+    def __init__(self, bits=8, quant_axis=-1):
+        super().__init__()
+        self.bits = bits
+        self.quant_axis = quant_axis
+
+    def _scale(self, raw):
+        axes = tuple(a for a in range(raw.ndim)
+                     if a != self.quant_axis % raw.ndim)
+        return jnp.max(jnp.abs(raw.astype(jnp.float32)), axis=axes,
+                       keepdims=True) / (2 ** (self.bits - 1) - 1)
+
+    def forward(self, x):
+        bits = self.bits
+
+        def f(x):
+            return _qdq_ste(x, self._scale(x), bits)
+
+        return apply(f, x)
+
+    def scale_of(self, x):
+        raw = x._data if isinstance(x, Tensor) else x
+        return self._scale(raw)
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """EMA abs-max observer (activations): the scale buffer updates in
+    training forward (threaded through the compiled step like BN stats)
+    and freezes in eval."""
+
+    def __init__(self, bits=8, momentum=0.9):
+        super().__init__()
+        self.bits = bits
+        self.momentum = momentum
+        self.register_buffer("scale", Tensor(jnp.asarray(0.0, jnp.float32)))
+        self.register_buffer("initialized",
+                             Tensor(jnp.asarray(0.0, jnp.float32)))
+
+    def forward(self, x):
+        bits, mom = self.bits, self.momentum
+        raw = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        if self.training:
+            amax = jnp.max(jnp.abs(raw.astype(jnp.float32))) / (
+                2 ** (bits - 1) - 1)
+            init = self.initialized._data
+            prev = self.scale._data
+            new = jnp.where(init > 0, mom * prev + (1 - mom) * amax, amax)
+            self.scale._data = new
+            self.initialized._data = jnp.ones_like(init)
+            scale = new
+        else:
+            scale = self.scale._data
+
+        def f(x):
+            return _qdq_ste(x, scale, bits)
+
+        return apply(f, x)
+
+
+_WEIGHT_OBSERVERS = {
+    "abs_max": FakeQuantAbsMax,
+    "channel_wise_abs_max": FakeQuantChannelWiseAbsMax,
+}
+_ACT_OBSERVERS = {
+    "moving_average_abs_max": FakeQuantMovingAverageAbsMax,
+    "abs_max": FakeQuantAbsMax,
+}
+
+
+class QuantizedLinear(Layer):
+    """Linear with weight + input fake-quant (reference quant_layers.py
+    QuantizedLinear)."""
+
+    def __init__(self, layer: Linear, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        super().__init__()
+        self.inner = layer
+        wq = _WEIGHT_OBSERVERS[weight_quantize_type]
+        self.weight_fake_quant = (
+            wq(weight_bits, quant_axis=-1)
+            if wq is FakeQuantChannelWiseAbsMax else wq(weight_bits))
+        self.act_fake_quant = _ACT_OBSERVERS[activation_quantize_type](
+            activation_bits)
+
+    def forward(self, x):
+        from .. import functional as F
+        xq = self.act_fake_quant(x)
+        wq = self.weight_fake_quant(self.inner.weight)
+        return F.linear(xq, wq, self.inner.bias)
+
+
+class QuantizedConv2D(Layer):
+    """Conv2D with weight + input fake-quant. Weight layout OIHW: the
+    output-channel axis is 0."""
+
+    def __init__(self, layer: Conv2D, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        super().__init__()
+        self.inner = layer
+        wq = _WEIGHT_OBSERVERS[weight_quantize_type]
+        self.weight_fake_quant = (
+            wq(weight_bits, quant_axis=0)
+            if wq is FakeQuantChannelWiseAbsMax else wq(weight_bits))
+        self.act_fake_quant = _ACT_OBSERVERS[activation_quantize_type](
+            activation_bits)
+
+    def forward(self, x):
+        from .. import functional as F
+        xq = self.act_fake_quant(x)
+        wq = self.weight_fake_quant(self.inner.weight)
+        c = self.inner
+        return F.conv2d(xq, wq, c.bias, stride=c._stride,
+                        padding=c._padding, dilation=c._dilation,
+                        groups=c._groups)
+
+
+_QUANTIZABLE = {Linear: QuantizedLinear, Conv2D: QuantizedConv2D}
+
+
+class ImperativeQuantAware:
+    """Rewrites a dygraph model in place for QAT, and converts it back to
+    an inference model with real int8 weights (reference imperative/qat.py
+    ImperativeQuantAware.quantize / save_quantized_model)."""
+
+    def __init__(self, quantizable_layer_type=("Linear", "Conv2D"),
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8):
+        self._types = set(quantizable_layer_type)
+        self._kw = dict(weight_bits=weight_bits,
+                        activation_bits=activation_bits,
+                        weight_quantize_type=weight_quantize_type,
+                        activation_quantize_type=activation_quantize_type)
+
+    def quantize(self, model: Layer) -> Layer:
+        for _, sub in list(model.named_sublayers(include_self=True)):
+            for cname, child in list(sub._sub_layers.items()):
+                for base, qcls in _QUANTIZABLE.items():
+                    if (type(child) is base
+                            and base.__name__ in self._types):
+                        sub._sub_layers[cname] = qcls(child, **self._kw)
+                        break
+        return model
+
+    @staticmethod
+    def convert(model: Layer) -> Layer:
+        """QAT model → inference model: QuantizedLinear becomes Int8Linear
+        with the TRAINED weight snapped to its observed grid (so inference
+        matches the fake-quant forward); QuantizedConv2D folds back to a
+        plain Conv2D with QDQ weights (conv stays bf16 on MXU — the win is
+        the weight HBM halving, applied at the Linear hot spots)."""
+        from . import Int8Linear
+        for _, sub in list(model.named_sublayers(include_self=True)):
+            for cname, child in list(sub._sub_layers.items()):
+                if isinstance(child, QuantizedLinear):
+                    sub._sub_layers[cname] = Int8Linear.from_linear(
+                        child.inner)
+                elif isinstance(child, QuantizedConv2D):
+                    conv = child.inner
+                    conv.weight._data = child.weight_fake_quant(
+                        conv.weight)._data
+                    sub._sub_layers[cname] = conv
+        return model
+
+
+class PostTrainingQuantization:
+    """Calibration-based PTQ (reference slim post_training_quantization.py
+    with algo abs_max / avg): feed calibration batches through the fp
+    model while per-layer observers record activation ranges, then emit
+    the int8-weight inference model."""
+
+    def __init__(self, model: Layer, algo="abs_max", weight_bits=8,
+                 activation_bits=8):
+        if algo not in ("abs_max", "avg"):
+            raise ValueError(f"unsupported algo {algo!r}")
+        self.model = model
+        self.algo = algo
+        self._bits = activation_bits
+        self._weight_bits = weight_bits
+        self._act_ranges = {}
+        self._hooks = []
+
+    def _observe(self, name):
+        def hook(layer, inputs, output=None):
+            x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+            raw = x._data if isinstance(x, Tensor) else np.asarray(x)
+            amax = float(jnp.max(jnp.abs(raw.astype(jnp.float32))))
+            if self.algo == "abs_max":
+                self._act_ranges[name] = max(
+                    self._act_ranges.get(name, 0.0), amax)
+            else:  # avg
+                prev = self._act_ranges.get(name)
+                self._act_ranges[name] = (amax if prev is None
+                                          else 0.5 * (prev + amax))
+        return hook
+
+    def quantize(self, data_loader, max_batches=None):
+        """Run calibration then convert; returns the inference model."""
+        targets = [(n, l) for n, l in self.model.named_sublayers()
+                   if type(l) in (Linear, Conv2D)]
+        for name, layer in targets:
+            self._hooks.append(
+                layer.register_forward_pre_hook(self._observe(name)))
+        self.model.eval()
+        for i, batch in enumerate(data_loader):
+            if max_batches is not None and i >= max_batches:
+                break
+            args = batch if isinstance(batch, (tuple, list)) else (batch,)
+            self.model(*[a if isinstance(a, Tensor) else Tensor(jnp.asarray(a))
+                         for a in args])
+        for h in self._hooks:
+            h.remove()
+        self._hooks = []
+
+        from . import Int8Linear
+        for pname, sub in list(self.model.named_sublayers(include_self=True)):
+            for cname, child in list(sub._sub_layers.items()):
+                full = f"{pname}.{cname}" if pname else cname
+                if type(child) is Linear:
+                    q = Int8Linear.from_linear(child)
+                    rng_ = self._act_ranges.get(full)
+                    if rng_ is not None:
+                        # range → grid step for the layer's input QDQ
+                        q.act_scale = rng_ / (2 ** (self._bits - 1) - 1)
+                    sub._sub_layers[cname] = q
+                elif type(child) is Conv2D:
+                    # QDQ the conv weight in place (per-out-channel grid)
+                    obs = FakeQuantChannelWiseAbsMax(
+                        self._weight_bits, quant_axis=0)
+                    child.weight._data = obs(child.weight)._data
+        return self.model
+
+    @property
+    def activation_ranges(self):
+        return dict(self._act_ranges)

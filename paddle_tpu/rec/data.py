@@ -79,8 +79,9 @@ class CriteoLineParser:
         sample = [("label", label), ("dense", dense)]
         for i, v in enumerate(parts[1 + self.num_dense:
                                     1 + self.num_dense + self.num_sparse]):
-            h = int(v, 16) if v else 0
-            sample.append((f"C{i + 1}", [h]))
+            # empty field = missing feature → no ids (stays padding id 0),
+            # distinct from any real hashed value
+            sample.append((f"C{i + 1}", [int(v, 16)] if v else []))
         return sample
 
 
