@@ -33,10 +33,11 @@ def _divisible_dims(shape, size):
 class Plan:
     """One candidate sharding assignment."""
 
-    def __init__(self, name, specs, bytes_per_device):
+    def __init__(self, name, specs, bytes_per_device, seed=False):
         self.name = name
         self.specs = specs  # param name -> PartitionSpec
         self.bytes_per_device = bytes_per_device
+        self.seed = seed  # structurally distinct seed vs per-param refine
 
     def __repr__(self):
         return (f"Plan({self.name}, "
@@ -64,7 +65,11 @@ class Engine:
         # per-device HBM working budget (default 12 GiB of a 16 GiB chip,
         # leaving headroom for activations/XLA scratch)
         self.hbm_budget = hbm_budget_bytes or 12 * 2**30
+        # how many of the largest params get per-param candidate
+        # refinement plans (search breadth / compile-time knob)
+        self.refine_top_k = 4
         self._plan = None
+        self.last_costs = {}  # plan name -> compiled cost, after plan()
 
     # -- candidate generation ------------------------------------------------
 
@@ -87,6 +92,51 @@ class Engine:
             total += n * itemsize * (1 + _OPT_STATE_MULT) / shard
         return total
 
+    def param_candidates(self, name, shape, declared=None):
+        """ALL valid placements for one parameter, generated from mesh
+        divisibility (reference auto_parallel/planner.py enumerates
+        per-op dist_attrs the same way): every assignment of the >1-sized
+        model axes ("tp", "sharding", and their composite) onto divisible
+        dims, plus replicated. A declared pspec (mp_layers etc.) is kept
+        as the first candidate — it encodes operator knowledge the
+        planner should prefer at equal cost."""
+        shape = tuple(shape)
+        cands = []
+        if declared is not None:
+            cands.append(P(*declared))
+        cands.append(P())
+        axes = [a for a in ("tp", "sharding")
+                if self.mesh.shape.get(a, 1) > 1]
+        options = [(a,) for a in axes]
+        if len(axes) == 2:
+            options.append(tuple(axes))  # composite ("tp","sharding")
+        for opt in options:
+            size = 1
+            for a in opt:
+                size *= self.mesh.shape[a]
+            for d in _divisible_dims(shape, size):
+                spec = [None] * len(shape)
+                spec[d] = opt if len(opt) > 1 else opt[0]
+                cands.append(P(*spec))
+            if len(opt) == 2 and len(shape) >= 2:
+                # one axis per dim (e.g. P("tp","sharding")) — valid when
+                # each dim divides its own axis
+                for d0 in _divisible_dims(shape, self.mesh.shape[opt[0]]):
+                    for d1 in _divisible_dims(shape,
+                                              self.mesh.shape[opt[1]]):
+                        if d0 == d1:
+                            continue
+                        spec = [None] * len(shape)
+                        spec[d0], spec[d1] = opt[0], opt[1]
+                        cands.append(P(*spec))
+        seen, out = set(), []
+        for c in cands:
+            key = tuple(c)
+            if key not in seen:
+                seen.add(key)
+                out.append(c)
+        return out
+
     def _candidates(self):
         tp = self.mesh.shape.get("tp", 1)
         shd = self.mesh.shape.get("sharding", 1)
@@ -97,7 +147,8 @@ class Engine:
 
         plans = []
         base = replicated()
-        plans.append(Plan("replicated(dp-only)", base, self._bytes(base)))
+        plans.append(Plan("replicated(dp-only)", base, self._bytes(base),
+                          seed=True))
 
         if tp > 1:
             specs = {}
@@ -116,7 +167,8 @@ class Engine:
                         spec[d] = "tp"
                         flip = not flip
                 specs[k] = P(*spec)
-            plans.append(Plan("tp(megatron-alt)", specs, self._bytes(specs)))
+            plans.append(Plan("tp(megatron-alt)", specs, self._bytes(specs),
+                              seed=True))
 
         if shd > 1:
             for src in list(plans):
@@ -133,16 +185,37 @@ class Engine:
                             spec[d] = "sharding"
                     specs[k] = P(*spec)
                 plans.append(Plan(f"{src.name}+zero3", specs,
+                                  self._bytes(specs), seed=True))
+
+        # per-param refinements off the most structured seed: for the
+        # largest params, swap in each generated candidate placement —
+        # the search space the fixed seeds can't reach
+        seed = plans[-1]
+        sizes = sorted(((float(np.prod(p._data.shape)), k)
+                        for k, p in params.items()), reverse=True)
+        for _, k in sizes[:self.refine_top_k]:
+            p = params[k]
+            for cand in self.param_candidates(
+                    k, p._data.shape, declared=p.pspec)[:6]:
+                if tuple(cand) == tuple(seed.specs[k]):
+                    continue
+                specs = dict(seed.specs)
+                specs[k] = cand
+                plans.append(Plan(f"refine[{k}->{tuple(cand)}]", specs,
                                   self._bytes(specs)))
         return plans
 
     # -- plan selection ------------------------------------------------------
 
-    def plan(self, use_cost_model: bool = False, sample_batch=None) -> Plan:
+    def plan(self, use_cost_model: bool = False, sample_batch=None,
+             max_compiles: int = 8) -> Plan:
         """Pick the cheapest plan that fits the HBM budget (reference:
-        auto_parallel planner + cost model). With use_cost_model=True and a
-        sample batch, candidate forward programs are lowered and compared
-        on XLA cost_analysis bytes accessed."""
+        auto_parallel planner + cost model). With use_cost_model=True and
+        a sample batch, up to ``max_compiles`` surviving candidates are
+        compiled WITH their shardings applied and ranked on XLA
+        cost_analysis (bytes accessed covers HBM traffic + the inserted
+        collectives' buffer movement)."""
+        self.last_costs = {}
         plans = self._candidates()
         fitting = [pl for pl in plans if pl.bytes_per_device
                    <= self.hbm_budget]
@@ -153,18 +226,27 @@ class Engine:
         # tp < +zero3); memory pressure already filtered.
         chosen = pool[0]
         if use_cost_model and sample_batch is not None and len(pool) > 1:
-            chosen = min(pool, key=lambda pl: self._cost(pl, sample_batch))
+            # rank a bounded prefix: every surviving structural seed first,
+            # then the best-by-memory refinements fill the compile budget
+            seeds = [pl for pl in pool if pl.seed]
+            rest = sorted((pl for pl in pool if not pl.seed),
+                          key=lambda pl: pl.bytes_per_device)
+            ranked = (seeds + rest)[:max_compiles]
+            costs = {id(pl): self._cost(pl, sample_batch) for pl in ranked}
+            chosen = min(ranked, key=lambda pl: costs[id(pl)])
+            self.last_costs = {pl.name: costs[id(pl)] for pl in ranked}
         self._plan = chosen
         return chosen
 
     def _cost(self, plan, sample_batch):
+        """Compiled cost of one fwd+bwd step WITH the plan's shardings
+        applied as the parameters' in_shardings (GSPMD propagates from
+        there, inserting the collectives the plan implies)."""
         try:
-            from ..cost_model import CostModel  # noqa: F401
-        except Exception:
-            pass
-        try:
-            from ..jit.api import _swap_params
+            from jax.sharding import NamedSharding
+
             from ..autograd.tape import functional_mode
+            from ..jit.api import _swap_params
             from ..tensor import Tensor
 
             params = self._params()
@@ -172,18 +254,26 @@ class Engine:
             def fwd(pv, batch):
                 with functional_mode(), _swap_params(params, pv):
                     out = self.loss_fn(self.model, *batch)
-                return out._data if isinstance(out, Tensor) else out
+                raw = out._data if isinstance(out, Tensor) else out
+                return raw.astype(np.float32).sum()
+
+            def step(pv, batch):
+                loss, grads = jax.value_and_grad(fwd)(pv, batch)
+                return loss, grads
 
             pv = {k: p._data for k, p in params.items()}
             raw = tuple(b._data if isinstance(b, Tensor) else b
                         for b in sample_batch)
-            lowered = jax.jit(fwd).lower(pv, raw)
+            in_sh = ({k: NamedSharding(self.mesh,
+                                       plan.specs.get(k) or P())
+                      for k in pv}, None)
+            lowered = jax.jit(step, in_shardings=in_sh).lower(pv, raw)
             cost = lowered.compile().cost_analysis()
             if isinstance(cost, list):
                 cost = cost[0]
             return float(cost.get("bytes accessed", math.inf))
         except Exception:
-            return plan.bytes_per_device
+            return float(plan.bytes_per_device) * 1e6  # worst-ranked
 
     # -- application ---------------------------------------------------------
 

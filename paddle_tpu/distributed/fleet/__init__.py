@@ -66,6 +66,10 @@ class _FleetOptimizer:
                 raise NotImplementedError(
                     "strategy.amp is not supported together with "
                     "localsgd/dgc — run them in full precision")
+            if kw:
+                raise NotImplementedError(
+                    f"options {sorted(kw)} are not supported by the "
+                    f"localsgd/dgc train steps")
         if getattr(s, "localsgd", False):
             from .comm_efficient import LocalSGDTrainStep
             cfg = s.localsgd_configs

@@ -112,7 +112,12 @@ class LocalSGDTrainStep:
                 pv, grads, st, lr, params_ref=self._params)
             return loss, newp, newst
 
-        losses, newp, newst = jax.vmap(per_replica)(
+        # scalar batch leaves are shared across replicas, not mapped
+        is_leaf = lambda t: isinstance(t, Tensor)
+        micro_axes = jax.tree_util.tree_map(
+            lambda x: 0 if len(x.shape) else None, micro, is_leaf=is_leaf)
+        losses, newp, newst = jax.vmap(
+            per_replica, in_axes=(0, 0, micro_axes, 0))(
             param_vals, opt_state, micro, keys)
         count = count + 1
         do_avg = ((count % self.k_steps) == 0) | (count <= self.begin_step)
@@ -209,10 +214,11 @@ class DGCTrainStep:
 
         def per_replica(pv, u, v, mb, mkey):
             # inside shard_map: u, v, mb, mkey are this replica's shard
-            # with the leading dp axis of size 1
+            # with the leading dp axis of size 1 (scalars stay scalars)
             u, v = u[0], v[0]
-            loss, grads = jax.value_and_grad(loss_of)(
-                pv, jax.tree_util.tree_map(lambda x: x[0], mb), mkey[0])
+            mb = jax.tree_util.tree_map(
+                lambda x: x[0] if jnp.ndim(x) else x, mb)
+            loss, grads = jax.value_and_grad(loss_of)(pv, mb, mkey[0])
             g = self._flatten(grads)
             if self.clip_norm is not None:
                 bound = self.clip_norm / (dp ** 0.5)
@@ -240,8 +246,9 @@ class DGCTrainStep:
         spec_rep = jax.tree_util.tree_map(lambda _: P(), param_vals,
                                           is_leaf=is_leaf)
         spec_dp0 = jax.tree_util.tree_map(
-            lambda x: P(*(("dp",) + (None,) * (len(x.shape) - 1))), micro,
-            is_leaf=is_leaf)
+            lambda x: P(*(("dp",) + (None,) * (len(x.shape) - 1)))
+            if len(x.shape) else P(),
+            micro, is_leaf=is_leaf)
         fn = shard_map(
             per_replica, mesh=self._mesh,
             in_specs=(spec_rep, P("dp", None), P("dp", None), spec_dp0,

@@ -35,13 +35,25 @@ from ..mesh import data_pspec, infer_param_pspec
 
 def _opt_state_pspec(param_spec: P, leaf_shape, param_shape, stage: int):
     """Moments follow the param spec; stages 1/2 additionally shard
-    replicated moments over the sharding axis (ZeRO-1/2)."""
+    replicated moments over the sharding axis (ZeRO-1/2). Stage 3 does
+    the same for moments of params that stayed tp-sharded-only (their
+    param spec deliberately omits "sharding" — see
+    mesh.infer_param_pspec)."""
     if len(leaf_shape) == 0:
         return P()
     if tuple(leaf_shape) != tuple(param_shape):
         return P()
     spec = list(param_spec) + [None] * (len(leaf_shape) - len(param_spec))
-    if stage in (1, 2) and "sharding" not in spec:
+    import numpy as _np
+    used_axes = set()
+    for a in spec:
+        used_axes.update(a if isinstance(a, tuple) else (a,))
+    # stages 1/2 shard every matching moment (pre-existing behavior);
+    # stage 3 only bothers for >=1024-elem leaves — tiny moments aren't
+    # worth the collective the reshard costs
+    if "sharding" not in used_axes and (
+            stage in (1, 2)
+            or (stage == 3 and int(_np.prod(leaf_shape)) >= 1024)):
         ssize = mesh_mod.mesh_axis_size("sharding")
         if ssize > 1:
             for d in range(len(leaf_shape)):
@@ -235,6 +247,16 @@ class CompiledTrainStep:
         else:
             found_inf = jnp.asarray(False)
 
+        # Pin each grad to its PARAM's sharding. Without this, ZeRO-shard
+        # moment layouts (e.g. P("tp","sharding")) propagate backward into
+        # the autodiff graph and GSPMD reshards [B,S,H] activations to
+        # hidden-sharded ("[SPMD] Involuntary full rematerialization");
+        # constrained here, the moment reshard happens on the weight-sized
+        # gradient instead.
+        grads = {
+            k: jax.lax.with_sharding_constraint(
+                g, NamedSharding(self._mesh, self._param_specs[k]))
+            for k, g in grads.items()}
         new_params, new_opt = self.optimizer.apply_gradients_functional(
             param_vals, grads, opt_state, lr, params_ref=self._params)
 

@@ -95,7 +95,9 @@ def infer_param_pspec(shape, tp_spec: Optional[PartitionSpec], stage: int,
     while len(spec) < ndim:
         spec.append(None)
     # drop declared axes the shape can't honor (e.g. an expert axis whose
-    # count doesn't divide the mp degree falls back to replicated)
+    # count doesn't divide the mp degree falls back to replicated), and
+    # normalize size-1 axes to None (a "tp" annotation on a tp=1 mesh is
+    # no sharding at all — it must not block the stage-3 placement below)
     for d, ax in enumerate(spec):
         if ax is None:
             continue
@@ -103,14 +105,22 @@ def infer_param_pspec(shape, tp_spec: Optional[PartitionSpec], stage: int,
         size = 1
         for a in axes:
             size *= mesh_axis_size(a)
-        if size > 1 and shape[d] % size != 0:
+        if size == 1 or (size > 1 and shape[d] % size != 0):
             spec[d] = None
     if stage >= 3 and int(np.prod(shape)) >= min_shard_size:
         ssize = mesh_axis_size("sharding")
-        if ssize > 1:
-            # largest unsharded dim divisible by the axis
+        # Only tp-FREE params take the extra "sharding" dim. Mixing tp and
+        # sharding axes on one weight (e.g. o_proj P("tp","sharding"))
+        # forces GSPMD to reshard batch-sharded activations onto the
+        # hidden dim for the weight-grad einsum — a transition the
+        # partitioner can only do by full rematerialization ("[SPMD]
+        # Involuntary full rematerialization" in the dryrun). tp params
+        # stay tp-sharded; their fp32 moments still ZeRO-shard over
+        # "sharding" (see train_step._opt_state_pspec), which is where
+        # the memory actually is under Adam.
+        if ssize > 1 and all(a is None for a in spec):
             cands = [(d, shape[d]) for d in range(ndim)
-                     if spec[d] is None and shape[d] % ssize == 0]
+                     if shape[d] % ssize == 0]
             if cands:
                 d = max(cands, key=lambda t: t[1])[0]
                 spec[d] = "sharding"

@@ -99,7 +99,10 @@ class Int8Linear(Layer):
         self.register_buffer("qweight", Tensor(jnp.asarray(qw)))
         self.register_buffer(
             "scale", Tensor(jnp.ones((1, out_features), dtype=jnp.float32)))
-        self.act_scale = None
+        # activation QDQ grid step; 0 = disabled. A buffer so PTQ
+        # calibration survives state_dict save/load.
+        self.register_buffer("act_scale",
+                             Tensor(jnp.zeros((), dtype=jnp.float32)))
         self.bias = self.create_parameter((out_features,), is_bias=True) \
             if bias else None
 
@@ -136,10 +139,9 @@ class Int8Linear(Layer):
                 y = x @ w
                 return y + b[0].astype(x.dtype) if b else y
 
-        if self.act_scale is not None:
+        if float(np.asarray(self.act_scale._data)) > 0:
             from .qat import fake_quant_dequant
-            x = fake_quant_dequant(
-                x, jnp.asarray(self.act_scale, jnp.float32))
+            x = fake_quant_dequant(x, self.act_scale._data)
         args = (x, self.qweight, self.scale) + (
             (self.bias,) if self.bias is not None else ())
         return apply(f, *args)

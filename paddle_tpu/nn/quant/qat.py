@@ -302,7 +302,8 @@ class PostTrainingQuantization:
                     rng_ = self._act_ranges.get(full)
                     if rng_ is not None:
                         # range → grid step for the layer's input QDQ
-                        q.act_scale = rng_ / (2 ** (self._bits - 1) - 1)
+                        q.act_scale._data = jnp.asarray(
+                            rng_ / (2 ** (self._bits - 1) - 1), jnp.float32)
                     sub._sub_layers[cname] = q
                 elif type(child) is Conv2D:
                     # QDQ the conv weight in place (per-out-channel grid)

@@ -111,3 +111,74 @@ def test_engine_prepare_and_train_matches_replicated():
     opt2 = optim.Adam(learning_rate=1e-2, parameters=net2.parameters())
     loss2 = _loss(net2, paddle.to_tensor(x), paddle.to_tensor(y))
     np.testing.assert_allclose(l0, float(loss2.numpy()), rtol=1e-4)
+
+
+def test_param_candidates_generated_from_divisibility():
+    """Per-param placements are enumerated from the mesh (round-2 verdict
+    #3): every big axis and the composite land on every divisible dim."""
+    _mesh(tp=2, sharding=2)
+    try:
+        eng = ap.Engine(_Net(), _loss)
+        cands = eng.param_candidates("w", (64, 128))
+        keys = {tuple(c) for c in cands}
+        assert () in keys                                # replicated
+        assert ("tp", None) in keys and (None, "tp") in keys
+        assert ("sharding", None) in keys and (None, "sharding") in keys
+        assert (("tp", "sharding"), None) in keys        # composite
+        assert ("tp", "sharding") in keys                # one axis per dim
+        # a dim that doesn't divide gets no assignment
+        cands2 = eng.param_candidates("v", (3, 128))
+        assert all(c[0] is None for c in cands2 if len(c) > 0)
+    finally:
+        set_mesh(None)
+
+
+def test_refinement_plans_expand_the_space():
+    _mesh(tp=2, sharding=2)
+    try:
+        paddle.seed(0)
+        eng = ap.Engine(_Net(h=128), _loss)
+        plans = eng._candidates()
+        assert sum(1 for p in plans if p.name.startswith("refine[")) >= 4
+        assert len({tuple(sorted((k, tuple(s)) for k, s in p.specs.items()))
+                    for p in plans}) == len(plans), "duplicate plans"
+    finally:
+        set_mesh(None)
+
+
+def test_cost_model_applies_shardings_and_beats_naive_dp():
+    """The verdict's acceptance bar: Engine.plan(use_cost_model) on llama
+    over 8 devices must (a) produce DIFFERENT compiled costs for different
+    plans (shardings really applied) and (b) choose a plan whose compiled
+    cost is <= naive DP (fully replicated params)."""
+    import dataclasses
+
+    from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+    _mesh(tp=2, sharding=2, dp=2)
+    try:
+        paddle.seed(0)
+        cfg = dataclasses.replace(LLAMA_TINY, dtype="float32")
+        model = LlamaForCausalLM(cfg)
+        eng = ap.Engine(model, lambda m, i, l: m(i, labels=l),
+                        optim.AdamW(learning_rate=1e-3,
+                                    parameters=model.parameters()),
+                        hbm_budget_bytes=10 * 2 ** 30)
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32))
+        chosen = eng.plan(use_cost_model=True, sample_batch=(ids, ids),
+                          max_compiles=4)
+        costs = eng.last_costs
+        assert len(costs) >= 2
+        assert len(set(costs.values())) > 1, (
+            f"all plans cost the same — shardings not applied: {costs}")
+        naive = costs.get("replicated(dp-only)")
+        assert naive is not None
+        # the plan the engine actually RETURNED must be the argmin and
+        # beat (or match) naive DP
+        assert chosen.name in costs
+        assert costs[chosen.name] == min(costs.values())
+        assert costs[chosen.name] <= naive, (chosen.name, costs)
+    finally:
+        set_mesh(None)

@@ -143,11 +143,25 @@ def test_dgc_quadratic_reaches_optimum():
 
 
 def test_dgc_momentum_correction_state_shapes():
-    _, model = _run(dgc=0.99, steps=2)
-    # state is per-replica: [dp, N] with N = total param count
-    n = sum(int(np.prod(p.shape)) for p in model.parameters())
     strategy = DistributedStrategy()
-    # (shape check happens through the step object in _run's closure; here
-    # just assert the params stayed finite and replicated)
+    strategy.hybrid_configs = {"dp_degree": DP, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1}
+    strategy.dgc = True
+    strategy.dgc_configs = {"momentum": 0.9, "sparsity": 0.99}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle_tpu.seed(0)
+    model = fleet.distributed_model(_mlp())
+    opt = fleet.distributed_optimizer(
+        optim.SGD(learning_rate=0.005, parameters=model.parameters()),
+        strategy=strategy)
+    step = opt.make_train_step(model, _mse)
+    x, y = _data()
+    step(paddle_tpu.to_tensor(x), paddle_tpu.to_tensor(y))
+    # residual state is per-replica: [dp, N], N = total param count
+    n = sum(int(np.prod(p.shape)) for p in model.parameters())
+    assert step._u.shape == (DP, n)
+    assert step._v.shape == (DP, n)
+    # after the first step some residual must remain unsent (99% sparsity)
+    assert float(np.abs(np.asarray(step._v)).sum()) > 0
     for p in model.parameters():
         assert np.isfinite(np.asarray(p._data)).all()
