@@ -1,0 +1,4 @@
+"""python -m paddle_tpu.distributed.launch — reference CLI spelling
+(python -m paddle.distributed.launch) for the supervised launcher in
+launch_main.py."""
+from ..launch_main import main  # noqa: F401

@@ -30,6 +30,16 @@ def _parse(argv):
     parser.add_argument("--nproc_per_node", type=int, default=1)
     parser.add_argument("--max_restarts", type=int, default=0,
                         help="elastic-style gang relaunches on worker failure")
+    parser.add_argument("--elastic", action="store_true",
+                        help="on relaunch, workers resume from the latest "
+                             "checkpoint (PADDLE_ELASTIC_* env contract)")
+    parser.add_argument("--ckpt_dir", default=None,
+                        help="checkpoint directory exported to workers as "
+                             "PADDLE_ELASTIC_CKPT_DIR")
+    parser.add_argument("--elastic_allow_scale_in", action="store_true",
+                        help="if the SAME worker slot fails twice in a row, "
+                             "re-form the gang without it (re-ranked, "
+                             "smaller world) instead of failing the job")
     parser.add_argument("--log_dir", default=None,
                         help="per-rank stdout/stderr files instead of inherit")
     parser.add_argument("script")
@@ -40,6 +50,12 @@ def _parse(argv):
 def _run_inline(args):
     os.environ["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
     os.environ["PADDLE_TRAINER_ID"] = str(args.node_rank)
+    os.environ.setdefault("PADDLE_ELASTIC_ATTEMPT", "0")
+    if args.elastic:
+        os.environ["PADDLE_ELASTIC"] = "1"
+    if args.ckpt_dir:
+        os.environ["PADDLE_ELASTIC_CKPT_DIR"] = os.path.abspath(
+            args.ckpt_dir)
     if args.master:
         os.environ["PADDLE_MASTER"] = args.master
     sys.argv = [args.script] + args.script_args
@@ -47,19 +63,27 @@ def _run_inline(args):
     return 0
 
 
-def _spawn_gang(args):
-    """Start nproc_per_node workers; returns list of (proc, logfile)."""
-    world = args.nnodes * args.nproc_per_node
+def _spawn_gang(args, slots=None, attempt=0):
+    """Start workers for the given local slot ids (re-ranked contiguously
+    after scale-in); returns list of (slot, proc, logfile)."""
+    slots = list(range(args.nproc_per_node)) if slots is None else slots
+    world = args.nnodes * len(slots)
     procs = []
-    for local in range(args.nproc_per_node):
-        rank = args.node_rank * args.nproc_per_node + local
+    for new_local, slot in enumerate(slots):
+        rank = args.node_rank * len(slots) + new_local
         env = dict(os.environ)
         env.update({
             "PADDLE_TRAINERS_NUM": str(world),
             "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_LOCAL_RANK": str(local),
-            "PADDLE_LOCAL_SIZE": str(args.nproc_per_node),
+            "PADDLE_LOCAL_RANK": str(new_local),
+            "PADDLE_LOCAL_SIZE": str(len(slots)),
+            "PADDLE_ELASTIC_ATTEMPT": str(attempt),
+            "PADDLE_WORKER_SLOT": str(slot),
         })
+        if args.elastic:
+            env["PADDLE_ELASTIC"] = "1"
+        if args.ckpt_dir:
+            env["PADDLE_ELASTIC_CKPT_DIR"] = os.path.abspath(args.ckpt_dir)
         if args.master:
             env["PADDLE_MASTER"] = args.master
         log = None
@@ -68,39 +92,52 @@ def _spawn_gang(args):
             os.makedirs(args.log_dir, exist_ok=True)
             # append: a restarted gang must not truncate the previous
             # attempt's crash traceback
-            log = open(os.path.join(args.log_dir, f"worker.{rank}.log"), "a")
+            log = open(os.path.join(args.log_dir, f"worker.{slot}.log"), "a")
             kw = {"stdout": log, "stderr": subprocess.STDOUT}
         p = subprocess.Popen(
             [sys.executable, args.script] + args.script_args, env=env, **kw)
-        procs.append((p, log))
+        procs.append((slot, p, log))
     return procs
 
 
-def _supervise(procs):
-    """Wait for the gang; first failure terminates the rest. Returns rc."""
+def _supervise(procs, heartbeat=None):
+    """Wait for the gang; first failure terminates the rest.
+    Returns (rc, failed_slots): every slot found dead-nonzero in the SAME
+    poll tick as the first detected failure — collateral deaths of later
+    ticks (collectives failing after a peer vanished) are not blamed.
+    """
     try:
+        last_beat = 0.0
         while True:
             alive = False
-            for p, _ in procs:
+            failed = []
+            rc_first = 0
+            for slot, p, _ in procs:
                 rc = p.poll()
                 if rc is None:
                     alive = True
                 elif rc != 0:
-                    for q, _ in procs:
-                        if q.poll() is None:
-                            q.terminate()
-                    deadline = time.time() + 10
-                    for q, _ in procs:
-                        try:
-                            q.wait(timeout=max(0.1, deadline - time.time()))
-                        except subprocess.TimeoutExpired:
-                            q.kill()
-                    return rc
+                    failed.append(slot)
+                    rc_first = rc_first or rc
+            if failed:
+                for _, q, _l in procs:
+                    if q.poll() is None:
+                        q.terminate()
+                deadline = time.time() + 10
+                for _, q, _l in procs:
+                    try:
+                        q.wait(timeout=max(0.1, deadline - time.time()))
+                    except subprocess.TimeoutExpired:
+                        q.kill()
+                return rc_first, failed
             if not alive:
-                return 0
+                return 0, []
+            if heartbeat is not None and time.time() - last_beat > 5:
+                heartbeat()
+                last_beat = time.time()
             time.sleep(0.2)
     finally:
-        for _, log in procs:
+        for _, _p, log in procs:
             if log is not None:
                 log.close()
 
@@ -110,26 +147,73 @@ def main(argv=None):
     if args.nproc_per_node <= 1:
         return _run_inline(args)
 
+    # multi-node elastic: file-heartbeat membership on the (shared)
+    # checkpoint filesystem re-ranks surviving nodes between attempts —
+    # the reference elastic manager's etcd watch, without etcd. Per-slot
+    # scale-in stays single-node (cross-node slot drop would need a
+    # coordinated world size; membership handles whole-node loss instead).
+    membership = None
+    if args.elastic and args.nnodes > 1 and args.ckpt_dir:
+        from .elastic import ElasticMembership
+        membership = ElasticMembership(
+            os.path.join(os.path.abspath(args.ckpt_dir), ".membership"),
+            node_id=f"{args.node_rank:06d}", timeout=60).register()
+    if args.elastic_allow_scale_in and args.nnodes > 1:
+        print("[launch] --elastic_allow_scale_in is per-node; with "
+              "nnodes>1 node loss is handled by membership re-rank, "
+              "slot scale-in is disabled", file=sys.stderr)
+        args.elastic_allow_scale_in = False
+
     attempts = args.max_restarts + 1
     rc = 1
+    slots = list(range(args.nproc_per_node))
+    last_failed = []
+    shutting_down = {"flag": False}
     for attempt in range(attempts):
         if attempt:
-            print(f"[launch] gang failed (rc={rc}); restart "
-                  f"{attempt}/{args.max_restarts}", file=sys.stderr)
-        procs = _spawn_gang(args)
+            print(f"[launch] gang failed (rc={rc}, slots={last_failed}); "
+                  f"restart {attempt}/{args.max_restarts}"
+                  + (" (resume from checkpoint)" if args.elastic else ""),
+                  file=sys.stderr)
+        if membership is not None:
+            membership.heartbeat()
+            new_rank, new_nnodes = membership.rerank()
+            if new_rank is None:
+                print("[launch] this node is no longer in the membership; "
+                      "exiting", file=sys.stderr)
+                return rc
+            args.node_rank, args.nnodes = new_rank, new_nnodes
+        procs = _spawn_gang(args, slots=slots, attempt=attempt)
 
         def _forward(signum, frame):
-            for p, _ in procs:
+            shutting_down["flag"] = True
+            for _, p, _l in procs:
                 if p.poll() is None:
                     p.send_signal(signum)
 
         old = signal.signal(signal.SIGTERM, _forward)
         try:
-            rc = _supervise(procs)
+            rc, failed = _supervise(
+                procs, heartbeat=(membership.heartbeat
+                                  if membership is not None else None))
         finally:
             signal.signal(signal.SIGTERM, old)
         if rc == 0:
             return 0
+        if shutting_down["flag"]:
+            # operator shutdown, not a worker fault: no relaunch
+            return rc
+        # scale-in: the same single slot failing twice in a row is a bad
+        # worker (reference elastic manager drops lost nodes and re-ranks
+        # the remainder)
+        if (args.elastic_allow_scale_in and len(failed) == 1
+                and failed == last_failed and len(slots) > 1):
+            slots = [s for s in slots if s != failed[0]]
+            print(f"[launch] slot {failed[0]} failed twice; scaling in to "
+                  f"{len(slots)} workers (re-ranked)", file=sys.stderr)
+        last_failed = failed
+    if membership is not None:
+        membership.leave()
     return rc
 
 

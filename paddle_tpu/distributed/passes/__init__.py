@@ -39,11 +39,46 @@ class _Pass:
         self.attrs = dict(attrs or {})
 
     def apply(self, main_programs, startup_programs=None, context=None):
-        """XLA already performs the fusion/placement this pass names;
-        record it so strategy consumers and tests can observe intent."""
+        """Apply the pass. The reference rewrites Program IR; here the
+        'program' is whatever drives the compiled train step, so a
+        DistributedStrategy target gets the corresponding strategy
+        mutation (which make_train_step then compiles in), while the
+        fuse_* passes are genuinely XLA's fusion pipeline and only get
+        recorded. Legacy Program objects pass through untouched."""
         if context is not None:
             context._applied.append(self.name)
+        targets = (main_programs if isinstance(main_programs, (list, tuple))
+                   else [main_programs])
+        for t in targets:
+            self._apply_to_strategy(t)
         return main_programs
+
+    def _apply_to_strategy(self, s):
+        from ..fleet.base import DistributedStrategy
+        if not isinstance(s, DistributedStrategy):
+            return
+        a = self.attrs
+        if self.name in ("auto_parallel_amp", "auto_parallel_fp16"):
+            s.amp = True
+            s.amp_configs.update(a)
+            if self.name == "auto_parallel_fp16":
+                s.amp_configs["use_pure_bf16"] = True
+        elif self.name == "auto_parallel_recompute":
+            s.recompute = True
+            s.recompute_configs.update(a)
+        elif self.name == "auto_parallel_gradient_merge":
+            s.gradient_merge = True
+            s.gradient_merge_configs.update(
+                {"k_steps": a.get("k_steps", 2), **a})
+        elif self.name == "auto_parallel_sharding":
+            s.sharding = True
+            s.sharding_configs.update(a)
+        elif self.name == "pipeline":
+            s.pipeline = True
+            s.pipeline_configs.update(a)
+        elif self.name == "fuse_all_reduce":
+            s.fuse_all_reduce_ops = True
+        # remaining fuse_* passes: XLA's fusion pipeline does these
 
     def set_attr(self, key, value):
         self.attrs[key] = value

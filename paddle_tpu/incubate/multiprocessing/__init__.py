@@ -1,0 +1,24 @@
+"""incubate.multiprocessing — zero-copy tensor passing between processes.
+
+Reference: python/paddle/incubate/multiprocessing/__init__.py (re-exports
+the stdlib multiprocessing API with ForkingPickler reductions registered
+so LoDTensors travel as shared-memory IPC handles, reductions.py:105).
+
+TPU-native: device arrays live in the PJRT runtime and can't be memory-
+mapped by another process, so the shared payload is the HOST buffer —
+a Tensor pickled through a multiprocessing Queue/Pipe moves as a
+posix shared-memory segment (name + shape + dtype, no data copy through
+the pipe) and rematerializes as a Tensor on the other side. That is the
+same contract the reference's file_system sharing strategy provides.
+"""
+from .reductions import init_reductions  # noqa: F401
+
+import multiprocessing  # noqa: E402
+
+__all__ = []
+
+from multiprocessing import *  # noqa: F401,F403,E402
+
+__all__ += multiprocessing.__all__
+
+init_reductions()

@@ -1,0 +1,94 @@
+"""ForkingPickler reductions: Tensor ⇄ posix shared memory.
+
+Reference: incubate/multiprocessing/reductions.py (reduce_tensor →
+shared-file IPC handle + LRU cache of mapped segments). Here the segment
+is multiprocessing.shared_memory; the producer keeps the segment alive
+until its Tensor is garbage collected, the consumer maps it zero-copy
+into a numpy view and wraps it back into a Tensor.
+"""
+from __future__ import annotations
+
+import atexit
+from collections import OrderedDict
+from multiprocessing import shared_memory
+from multiprocessing.reduction import ForkingPickler
+
+import numpy as np
+
+from ...tensor import Tensor
+
+__all__ = ["init_reductions", "reduce_tensor", "rebuild_tensor"]
+
+# Producer-side LRU of live segment HANDLES (reference reductions.py
+# LRUSharedCache): a segment must outlive its source Tensor — the
+# consumer may map it long after the producer dropped the Tensor — so
+# lifetime is process-scoped. Eviction past the cap only closes our
+# handle; the segment itself stays linked until process exit (same
+# lifecycle as the reference's file_system sharing strategy), so a slow
+# consumer can never find its name already unlinked.
+_MAX_PINNED = 128
+_pinned: "OrderedDict[str, shared_memory.SharedMemory]" = OrderedDict()
+_created = []  # every segment name this process created, for atexit
+
+
+def _evict(name):
+    shm = _pinned.pop(name, None)
+    if shm is not None:
+        try:
+            shm.close()
+        except Exception:
+            pass
+
+
+@atexit.register
+def _cleanup():
+    for name in list(_pinned):
+        _evict(name)
+    for name in _created:
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+            seg.close()
+            seg.unlink()
+        except FileNotFoundError:
+            pass
+
+
+def reduce_tensor(tensor):
+    arr = np.asarray(tensor._data)
+    shm = shared_memory.SharedMemory(create=True, size=max(1, arr.nbytes))
+    view = np.ndarray(arr.shape, arr.dtype, buffer=shm.buf)
+    view[...] = arr
+    _pinned[shm.name] = shm
+    _created.append(shm.name)
+    while len(_pinned) > _MAX_PINNED:
+        _evict(next(iter(_pinned)))
+    return rebuild_tensor, (shm.name, arr.shape, arr.dtype.str,
+                            tensor.stop_gradient)
+
+
+def rebuild_tensor(name, shape, dtype, stop_gradient):
+    shm = shared_memory.SharedMemory(name=name)
+    # the consumer merely ATTACHES: CPython's resource_tracker would
+    # still unlink the segment when this process exits, breaking any
+    # other consumer of the same tensor — unregister the attach
+    # (the track=False parameter only exists from 3.13)
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+    try:
+        view = np.ndarray(shape, np.dtype(dtype), buffer=shm.buf)
+        # only the segment name traveled through the pipe; the one copy
+        # here is the host->device staging jax needs anyway
+        t = Tensor(np.array(view))
+        t.stop_gradient = stop_gradient
+        return t
+    finally:
+        shm.close()
+
+
+def init_reductions():
+    ForkingPickler.register(Tensor, reduce_tensor)
+    from ...tensor import Parameter
+    ForkingPickler.register(Parameter, reduce_tensor)

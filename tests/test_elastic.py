@@ -1,0 +1,161 @@
+"""Elastic membership + rejoin with checkpoint-resume (round-2 verdict #6).
+
+Reference: distributed/fleet/elastic/manager.py (etcd membership, watch,
+re-rank, restart). Here: file-heartbeat membership, supervisor gang
+re-formation with PADDLE_ELASTIC_* env, scale-in re-rank, and
+maybe_resume() restoring the last durable checkpoint.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.elastic import ElasticMembership
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_membership_register_peers_lost_rerank(tmp_path):
+    a = ElasticMembership(tmp_path, "hostA", timeout=5).register()
+    b = ElasticMembership(tmp_path, "hostB", timeout=5).register()
+    c = ElasticMembership(tmp_path, "hostC", timeout=5).register()
+    assert a.peers() == ["hostA", "hostB", "hostC"]
+    assert b.rerank() == (1, 3)
+    c.leave()
+    assert a.lost(["hostA", "hostB", "hostC"]) == ["hostC"]
+    assert a.rerank() == (0, 2)
+    # stale heartbeat = lost (etcd lease expiry analog)
+    with open(os.path.join(tmp_path, "node.hostB"), "w") as fh:
+        fh.write(str(time.time() - 100))
+    assert a.peers() == ["hostA"]
+    assert a.rerank() == (0, 1)
+
+
+def test_membership_wait_for_barrier(tmp_path):
+    a = ElasticMembership(tmp_path, "n0", timeout=5).register()
+    assert not a.wait_for(2, timeout=0.5, poll=0.1)
+    ElasticMembership(tmp_path, "n1", timeout=5).register()
+    assert a.wait_for(2, timeout=2, poll=0.1)
+
+
+_WORKER = r'''
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+from paddle_tpu.distributed.elastic import attempt_number, maybe_resume
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+attempt = attempt_number()
+out_dir = sys.argv[1]
+kill_step = int(sys.argv[2])
+mgr = CheckpointManager(os.environ["PADDLE_ELASTIC_CKPT_DIR"],
+                        max_to_keep=2)
+
+target = jnp.asarray(np.arange(8, dtype=np.float32))
+w0 = jnp.zeros(8)
+start, state = maybe_resume(mgr, template={"w": w0, "step": 0})
+w = state["w"] if state is not None else w0
+
+losses = []
+import time as _t
+
+marker = os.path.join(out_dir, "rank1_dead")
+for step in range(start, 10):
+    if attempt == 0 and rank == 1 and step == kill_step:
+        # die only after the pre-kill checkpoint is durable, so the
+        # resume point is deterministic
+        deadline = _t.time() + 120
+        while (mgr.latest_step() or -1) < kill_step - 1 \
+                and _t.time() < deadline:
+            _t.sleep(0.1)
+        open(marker, "w").close()
+        os._exit(17)  # simulated worker death mid-training
+    if attempt == 0 and rank == 0 and step >= kill_step + 2:
+        # don't outrun the crash: hold here until worker 1 has died (the
+        # supervisor will reap us right after)
+        deadline = _t.time() + 120
+        while not os.path.exists(marker) and _t.time() < deadline:
+            _t.sleep(0.1)
+        _t.sleep(5)
+        break
+    loss = float(((w - target) ** 2).sum())
+    losses.append(loss)
+    w = w - 0.2 * 2 * (w - target)
+    if rank == 0:
+        mgr.save(step, {"w": w, "step": step}, async_save=False)
+
+with open(os.path.join(out_dir, f"result.rank{rank}.attempt{attempt}.json"),
+          "w") as fh:
+    json.dump({"start": start, "losses": losses, "world": world,
+               "final_loss": float(((w - target) ** 2).sum()),
+               "slot": os.environ.get("PADDLE_WORKER_SLOT")}, fh)
+'''
+
+
+@pytest.mark.slow
+def test_worker_death_resumes_from_checkpoint(tmp_path):
+    """Kill worker 1 at step 5 of 10; the relaunched gang must resume
+    from the last checkpoint (not step 0) and keep improving the loss."""
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    out = tmp_path / "out"
+    out.mkdir()
+    ckpt = tmp_path / "ckpt"
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--max_restarts", "1", "--elastic",
+         "--ckpt_dir", str(ckpt), str(script), str(out), "5"],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "resume from checkpoint" in r.stderr
+
+    a0 = json.load(open(out / "result.rank0.attempt1.json"))
+    # worker 1 waited for ckpt-4 to be durable before dying, so the
+    # re-formed gang resumes at >= 5 (rank 0 may have checkpointed a bit
+    # further before the supervisor reaped it) — never from step 0
+    assert 5 <= a0["start"] <= 8, a0
+    # no loss regression: at resume the loss must already be at the
+    # checkpointed trajectory level (step-5 loss is ~0.3; scratch is 140)
+    assert a0["losses"][0] < 1.0, a0
+    assert a0["losses"][-1] < a0["losses"][0]
+    # both re-ranked workers completed
+    assert (out / "result.rank1.attempt1.json").exists()
+
+
+@pytest.mark.slow
+def test_persistent_slot_failure_scales_in(tmp_path):
+    """A slot that dies on every attempt gets dropped: the gang re-forms
+    smaller with contiguous re-ranked ids and finishes the job."""
+    script = tmp_path / "worker.py"
+    # kill_step 0 + attempt checked below: slot 1 dies on attempts 0 AND 1
+    script.write_text(_WORKER.replace(
+        "if attempt == 0 and rank == 1 and step == kill_step:",
+        "if os.environ.get('PADDLE_WORKER_SLOT') == '1' and step >= kill_step:"))
+    out = tmp_path / "out"
+    out.mkdir()
+    ckpt = tmp_path / "ckpt"
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--max_restarts", "3", "--elastic",
+         "--elastic_allow_scale_in", "--ckpt_dir", str(ckpt),
+         str(script), str(out), "2"],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "scaling in to 1 workers" in r.stderr
+    final = json.load(open(out / "result.rank0.attempt2.json"))
+    assert final["world"] == 1          # re-formed smaller world
+    assert final["start"] >= 1          # resumed from checkpoint, not 0
+    assert final["final_loss"] < 1e-2   # full 10-step trajectory reached
