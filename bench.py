@@ -262,11 +262,21 @@ def bench_llama_long_context(backend):
     if backend != "tpu":
         return {"skipped": "tpu only"}
     paddle_tpu.seed(0)
+    raw = os.environ.get("PADDLE_TPU_BENCH_REMAT", "selective").lower()
+    if raw in ("none", "off", "0", "false"):
+        remat, cfg_remat = "none", False
+    elif raw in ("full", "true", "1"):
+        remat, cfg_remat = "full", True
+    else:
+        if raw != "selective":
+            print(f"unknown PADDLE_TPU_BENCH_REMAT={raw!r}; using "
+                  f"'selective'", file=sys.stderr)
+        remat, cfg_remat = "selective", "selective"
     cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                       intermediate_size=5504, num_hidden_layers=8,
                       num_attention_heads=16, num_key_value_heads=16,
                       max_position_embeddings=8192, dtype="bfloat16",
-                      remat=True)
+                      remat=cfg_remat)
     batch, seqlen, n_steps = 1, 8192, 6
     fleet.init(is_collective=True, strategy=DistributedStrategy())
     model = fleet.distributed_model(LlamaForCausalLM(cfg))
@@ -283,8 +293,44 @@ def bench_llama_long_context(backend):
     from paddle_tpu.nn.functional.attention import attention_path
     return {"tokens_per_sec": round(batch * seqlen * n_steps / dt, 1),
             "ms_per_step": round(dt / n_steps * 1000, 1),
-            "batch": batch, "seqlen": seqlen,
+            "batch": batch, "seqlen": seqlen, "remat": remat,
             "attention": attention_path()}
+
+
+def bench_llama_b8_selective(backend):
+    """Headline shapes at batch 8 with SELECTIVE remat: keeps matmul
+    outputs resident, recomputes elementwise — if the larger batch lifts
+    tokens/sec past the batch-4 no-remat headline, it becomes the next
+    headline config."""
+    import paddle_tpu
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.text.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if backend != "tpu":
+        return {"skipped": "tpu only"}
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5504, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=16,
+                      max_position_embeddings=2048, dtype="bfloat16",
+                      remat="selective")
+    batch, seqlen, n_steps = 8, 2048, 10
+    fleet.init(is_collective=True, strategy=DistributedStrategy())
+    model = fleet.distributed_model(LlamaForCausalLM(cfg))
+    opt = fleet.distributed_optimizer(
+        optim.AdamW(learning_rate=1e-4, parameters=model.parameters()))
+    step = opt.make_train_step(model, lambda m, i, l: m(i, labels=l))
+    rng = np.random.default_rng(0)
+    ids = paddle_tpu.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32))
+    labels = paddle_tpu.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32))
+    dt, _ = _timed_steps(lambda: step(ids, labels), n_steps)
+    return {"tokens_per_sec": round(batch * seqlen * n_steps / dt, 1),
+            "ms_per_step": round(dt / n_steps * 1000, 1),
+            "batch": batch, "seqlen": seqlen}
 
 
 def bench_llama_decode(backend):
@@ -599,7 +645,9 @@ def main():
                          ("llama_seq8192", bench_llama_long_context),
                          ("int8_matmul", bench_int8_matmul),
                          ("llama_decode", bench_llama_decode),
-                         ("llama_fused_ce_ab", bench_llama_fused_ce)):
+                         ("llama_fused_ce_ab", bench_llama_fused_ce),
+                         ("llama_b8_selective_remat",
+                          bench_llama_b8_selective)):
             remaining = budget - (time.perf_counter() - t_start)
             if remaining <= 0:
                 secondary[name] = {"skipped": "bench time budget exhausted"}
@@ -609,6 +657,20 @@ def main():
             _record_session(headline, backend, secondary, kernels)
 
     _record_session(headline, backend, secondary, kernels)
+    # the printed artifact must carry a number for every config: fill any
+    # stalled/skipped secondary from the last good session measurement,
+    # marked as replayed (TPU runs only — the session file holds TPU data)
+    last = (_last_session() or {}) if backend == "tpu" else {}
+    for k, v in (last.get("secondary") or {}).items():
+        cur = secondary.get(k)
+        if isinstance(cur, dict) and ("error" in cur or "skipped" in cur) \
+                and isinstance(v, dict) and "error" not in v \
+                and "skipped" not in v:
+            secondary[k] = {**v, "replayed_from_session": True}
+    if isinstance(kernels, dict) and ("error" in kernels
+                                      or "skipped" in kernels) \
+            and isinstance(last.get("kernels"), dict):
+        kernels = {**last["kernels"], "replayed_from_session": True}
     tokens_per_sec = headline["tokens_per_sec"]
     best = _best_previous()
     vs = tokens_per_sec / best if best > 0 else 1.0

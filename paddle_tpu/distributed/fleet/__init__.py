@@ -114,6 +114,38 @@ def barrier_worker():
     barrier()
 
 
+# PS lifecycle at module scope, as reference CTR scripts call it
+# (`fleet.init_worker()` / `if fleet.is_server(): fleet.run_server()`).
+# No PS daemon exists here — sparse tables are mesh-sharded parameters
+# inside the collective job (distributed/ps/) — so these are no-ops /
+# the worker-role constants.
+def is_worker():
+    return True
+
+
+def is_server():
+    return False
+
+
+def init_worker(scopes=None):
+    return None
+
+
+def init_server(*args, **kwargs):
+    return None
+
+
+def run_server():
+    raise RuntimeError(
+        "paddle_tpu has no parameter-server role: sparse tables are "
+        "mesh-sharded into the collective job (see "
+        "paddle_tpu.distributed.ps). Launch every process as a worker.")
+
+
+def stop_worker():
+    return None
+
+
 from .compat import (  # noqa: F401,E402
     CommunicateTopology, MultiSlotDataGenerator,
     MultiSlotStringDataGenerator, PaddleCloudRoleMaker, Role,
@@ -151,7 +183,30 @@ class Fleet:
         return True
 
     def is_server(self):
+        # no PS daemon in the TPU stack: sparse tables are mesh-sharded
+        # parameters inside the collective job (distributed/ps/), so every
+        # process is a worker
         return False
+
+    # -- the_one_ps lifecycle compat (reference: fleet PS mode scripts
+    # call these around training; here the "server" is the row-sharded
+    # table living inside the same pjit program, so they are cheap
+    # barriers/no-ops and existing CTR scripts run unmodified) ----------
+    def init_worker(self, scopes=None):
+        return None
+
+    def init_server(self, *args, **kwargs):
+        return None
+
+    def run_server(self):
+        raise RuntimeError(
+            "paddle_tpu has no parameter-server role: sparse tables are "
+            "mesh-sharded into the collective job (see "
+            "paddle_tpu.distributed.ps). Launch every process as a "
+            "worker.")
+
+    def stop_worker(self):
+        return None
 
     def barrier_worker(self):
         return barrier_worker()

@@ -36,6 +36,9 @@ def _parse(argv):
     parser.add_argument("--ckpt_dir", default=None,
                         help="checkpoint directory exported to workers as "
                              "PADDLE_ELASTIC_CKPT_DIR")
+    parser.add_argument("--heartbeat_timeout", type=float, default=60.0,
+                        help="seconds before a silent node counts as lost "
+                             "(multi-node elastic membership)")
     parser.add_argument("--elastic_allow_scale_in", action="store_true",
                         help="if the SAME worker slot fails twice in a row, "
                              "re-form the gang without it (re-ranked, "
@@ -100,7 +103,7 @@ def _spawn_gang(args, slots=None, attempt=0):
     return procs
 
 
-def _supervise(procs, heartbeat=None):
+def _supervise(procs, heartbeat=None, beat_every=5.0):
     """Wait for the gang; first failure terminates the rest.
     Returns (rc, failed_slots): every slot found dead-nonzero in the SAME
     poll tick as the first detected failure — collateral deaths of later
@@ -132,7 +135,7 @@ def _supervise(procs, heartbeat=None):
                 return rc_first, failed
             if not alive:
                 return 0, []
-            if heartbeat is not None and time.time() - last_beat > 5:
+            if heartbeat is not None and time.time() - last_beat > beat_every:
                 heartbeat()
                 last_beat = time.time()
             time.sleep(0.2)
@@ -157,7 +160,8 @@ def main(argv=None):
         from .elastic import ElasticMembership
         membership = ElasticMembership(
             os.path.join(os.path.abspath(args.ckpt_dir), ".membership"),
-            node_id=f"{args.node_rank:06d}", timeout=60).register()
+            node_id=f"{args.node_rank:06d}",
+            timeout=args.heartbeat_timeout).register()
     if args.elastic_allow_scale_in and args.nnodes > 1:
         print("[launch] --elastic_allow_scale_in is per-node; with "
               "nnodes>1 node loss is handled by membership re-rank, "
@@ -195,7 +199,10 @@ def main(argv=None):
         try:
             rc, failed = _supervise(
                 procs, heartbeat=(membership.heartbeat
-                                  if membership is not None else None))
+                                  if membership is not None else None),
+                # refresh well inside the staleness window so a live node
+                # can never read as lost between beats
+                beat_every=max(0.5, min(5.0, args.heartbeat_timeout / 3)))
         finally:
             signal.signal(signal.SIGTERM, old)
         if rc == 0:

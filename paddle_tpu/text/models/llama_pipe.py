@@ -62,7 +62,12 @@ def _decoder_chunk(chunk, x, *, n_heads, n_kv, eps, theta, remat=False):
         return x, None
 
     if remat:
-        one = jax.checkpoint(one)
+        # "selective" keeps matmul outputs resident and recomputes only
+        # elementwise ops (same policy as llama._remat_layer); any other
+        # truthy value is full recompute
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if remat == "selective" else None)
+        one = jax.checkpoint(one, policy=policy)
     return jax.lax.scan(one, x, chunk)[0]
 
 

@@ -159,3 +159,52 @@ def test_persistent_slot_failure_scales_in(tmp_path):
     assert final["world"] == 1          # re-formed smaller world
     assert final["start"] >= 1          # resumed from checkpoint, not 0
     assert final["final_loss"] < 1e-2   # full 10-step trajectory reached
+
+
+_NODE_WORKER = r'''
+import json, os, sys, time
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+attempt = int(os.environ.get("PADDLE_ELASTIC_ATTEMPT", "0"))
+out_dir = sys.argv[1]
+with open(os.path.join(out_dir, f"env.rank{rank}.attempt{attempt}.json"),
+          "w") as fh:
+    json.dump({"world": world, "rank": rank}, fh)
+if attempt == 0 and rank == 1:
+    # simulate the peer node dying with us: stamp its heartbeat stale
+    peer = os.path.join(os.environ["PADDLE_ELASTIC_CKPT_DIR"],
+                        ".membership", "node.000001")
+    with open(peer, "w") as fh:
+        fh.write(str(time.time() - 600))
+    sys.exit(3)
+'''
+
+
+@pytest.mark.slow
+def test_membership_rerank_shrinks_world_on_node_loss(tmp_path):
+    """nnodes=2 where the peer node's heartbeat stops mid-run: after the
+    gang failure the supervisor re-ranks over the live membership and
+    respawns with the smaller world (reference elastic manager node-loss
+    path)."""
+    script = tmp_path / "worker.py"
+    script.write_text(_NODE_WORKER)
+    out = tmp_path / "out"
+    out.mkdir()
+    ckpt = tmp_path / "ckpt"
+    # peer node alive well past attempt 0 (future stamp outlives the
+    # launcher's import/startup time); the dying worker stamps it stale
+    mdir = ckpt / ".membership"
+    mdir.mkdir(parents=True)
+    (mdir / "node.000001").write_text(str(time.time() + 600))
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nnodes", "2", "--node_rank", "0", "--nproc_per_node", "2",
+         "--max_restarts", "1", "--elastic", "--ckpt_dir", str(ckpt),
+         "--heartbeat_timeout", "5", str(script), str(out)],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    a0 = json.load(open(out / "env.rank0.attempt0.json"))
+    assert a0["world"] == 4             # both nodes live at start
+    a1 = json.load(open(out / "env.rank0.attempt1.json"))
+    assert a1["world"] == 2, a1         # re-ranked over live membership

@@ -113,3 +113,35 @@ def test_llama_generation_cache():
             logits_step, caches = m(ids[:, t:t + 1], caches=caches)
     np.testing.assert_allclose(logits_step.numpy()[:, 0], full[:, -1],
                                atol=2e-4, rtol=2e-4)
+
+
+def test_llama_selective_remat_matches_no_remat():
+    """remat="selective" (checkpoint policy keeping matmul outputs) must
+    be numerically identical to no remat — only memory/recompute differ."""
+    import dataclasses
+
+    import numpy as np
+
+    import paddle_tpu
+    from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+    rng = np.random.default_rng(0)
+    ids = paddle_tpu.to_tensor(
+        rng.integers(0, LLAMA_TINY.vocab_size, (2, 16)).astype(np.int32))
+
+    outs = {}
+    for mode in (False, "selective", True):
+        paddle_tpu.seed(0)
+        cfg = dataclasses.replace(LLAMA_TINY, dtype="float32", remat=mode)
+        m = LlamaForCausalLM(cfg)
+        loss = m(ids, labels=ids)
+        loss.backward()
+        g = next(iter(m.parameters())).grad
+        outs[mode] = (float(np.asarray(loss._data)),
+                      np.asarray(g._data).copy())
+    np.testing.assert_allclose(outs["selective"][0], outs[False][0],
+                               rtol=1e-6)
+    np.testing.assert_allclose(outs["selective"][1], outs[False][1],
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(outs[True][1], outs[False][1],
+                               rtol=1e-5, atol=1e-7)
