@@ -452,6 +452,43 @@ def bench_llama_fused_ce(backend):
             os.environ["PADDLE_TPU_BENCH_FUSED_CE"] = prev
 
 
+def bench_ctr_widedeep(backend):
+    """Recsys/PS-analog throughput: wide&deep CTR over a 1M-row sharded
+    embedding table (single chip: table replicated-equivalent), lazy-row
+    AdamW, criteo-shaped batches. Reports examples/sec."""
+    import paddle_tpu
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.rec import WideDeep
+
+    if backend != "tpu":
+        return {"skipped": "tpu only"}
+    paddle_tpu.seed(0)
+    vocab, slots, dense_dim = 1 << 20, 26, 13
+    batch, n_steps = 4096, 8
+    fleet.init(is_collective=True, strategy=DistributedStrategy())
+    model = fleet.distributed_model(
+        WideDeep(vocab, slots, embed_dim=16, dense_dim=dense_dim,
+                 hidden=(256, 128, 64)))
+    opt = fleet.distributed_optimizer(
+        optim.AdamW(learning_rate=1e-3, lazy_mode=True,
+                    parameters=model.parameters()))
+    step = opt.make_train_step(
+        model, lambda m, i, d, y: m(i, d, labels=y)[1])
+    rng = np.random.default_rng(0)
+    ids = paddle_tpu.to_tensor(
+        rng.integers(1, vocab, (batch, slots, 1)).astype(np.int32))
+    dense = paddle_tpu.to_tensor(
+        rng.standard_normal((batch, dense_dim)).astype(np.float32))
+    label = paddle_tpu.to_tensor(
+        rng.integers(0, 2, (batch,)).astype(np.float32))
+    dt, _ = _timed_steps(lambda: step(ids, dense, label), n_steps)
+    return {"examples_per_sec": round(batch * n_steps / dt, 1),
+            "ms_per_step": round(dt / n_steps * 1000, 1),
+            "batch": batch, "vocab": vocab, "slots": slots}
+
+
 def bench_int8_matmul(backend):
     """Weight-only int8 MXU matmul vs bf16 at a memory-bound shape
     (small M, large KxN: weight HBM traffic dominates, int8 halves it)."""
@@ -647,7 +684,8 @@ def main():
                          ("llama_decode", bench_llama_decode),
                          ("llama_fused_ce_ab", bench_llama_fused_ce),
                          ("llama_b8_selective_remat",
-                          bench_llama_b8_selective)):
+                          bench_llama_b8_selective),
+                         ("ctr_widedeep", bench_ctr_widedeep)):
             remaining = budget - (time.perf_counter() - t_start)
             if remaining <= 0:
                 secondary[name] = {"skipped": "bench time budget exhausted"}

@@ -179,34 +179,25 @@ class Fleet:
     def is_first_worker(self):
         return is_first_worker()
 
+    # PS lifecycle: delegate to the module-level functions (same pattern
+    # as barrier_worker below)
     def is_worker(self):
-        return True
+        return is_worker()
 
     def is_server(self):
-        # no PS daemon in the TPU stack: sparse tables are mesh-sharded
-        # parameters inside the collective job (distributed/ps/), so every
-        # process is a worker
-        return False
+        return is_server()
 
-    # -- the_one_ps lifecycle compat (reference: fleet PS mode scripts
-    # call these around training; here the "server" is the row-sharded
-    # table living inside the same pjit program, so they are cheap
-    # barriers/no-ops and existing CTR scripts run unmodified) ----------
     def init_worker(self, scopes=None):
-        return None
+        return init_worker(scopes)
 
     def init_server(self, *args, **kwargs):
-        return None
+        return init_server(*args, **kwargs)
 
     def run_server(self):
-        raise RuntimeError(
-            "paddle_tpu has no parameter-server role: sparse tables are "
-            "mesh-sharded into the collective job (see "
-            "paddle_tpu.distributed.ps). Launch every process as a "
-            "worker.")
+        return run_server()
 
     def stop_worker(self):
-        return None
+        return stop_worker()
 
     def barrier_worker(self):
         return barrier_worker()

@@ -15,7 +15,10 @@ into the same pjit train step as the dense parameters. Sync mode is the
 only mode: every step IS globally consistent, which is the deterministic
 improvement over async/geo staleness.
 """
+from .coordinator import (ClientSelector, Coordinator, FLClient,
+                          FLStrategy)
 from .sharded_table import (ShardedEmbedding, SparseTableConfig,
                             row_shard_spec)
 
-__all__ = ["ShardedEmbedding", "SparseTableConfig", "row_shard_spec"]
+__all__ = ["ShardedEmbedding", "SparseTableConfig", "row_shard_spec",
+           "Coordinator", "FLClient", "ClientSelector", "FLStrategy"]

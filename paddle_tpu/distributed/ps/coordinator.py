@@ -1,0 +1,195 @@
+"""Federated-learning coordinator (the PS stack's FL mode).
+
+Reference: python/paddle/distributed/ps/coordinator.py:1 (FLClient pushes
+state over the PS RPC wire, a coordinator-side ClientSelector picks the
+round's cohort, FLStrategy strings flow back). TPU-native: no RPC — the
+exchange medium is the shared filesystem every pod slice mounts (same
+substrate as distributed.elastic membership): clients push numpy state
+dicts into a round directory, the coordinator federated-averages the
+cohort (FedAvg, weighted by example counts) and publishes the global
+round; barriers are file-existence waits.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from ...tensor import Tensor
+
+__all__ = ["ClientInfoAttr", "FLStrategy", "ClientSelector", "Coordinator",
+           "FLClient"]
+
+
+class ClientInfoAttr:
+    """Reference coordinator.py:35 field ids of the client info proto."""
+    DEVICE_TYPE = 0
+    COST_INFO = 1
+    RESOURCE_INFO = 2
+
+
+class FLStrategy:
+    """Reference coordinator.py:42 strategy kinds."""
+    JOIN = "join"
+    WAIT = "wait"
+    FINISH = "finish"
+
+
+class ClientSelector:
+    """Pick each round's cohort (reference ClientSelector.select):
+    deterministic seeded sampling of a fraction of registered clients."""
+
+    def __init__(self, fraction=1.0, seed=0):
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(f"fraction must be in (0, 1], got {fraction}")
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+
+    def select(self, client_ids, round_idx):
+        ids = sorted(client_ids)
+        if not ids:
+            return []
+        k = max(1, int(round(len(ids) * self.fraction)))
+        rng = np.random.default_rng((self.seed, round_idx))
+        picked = rng.choice(len(ids), size=k, replace=False)
+        return [ids[i] for i in sorted(picked)]
+
+
+def _save_state(path, state, meta):
+    arrays = {k: np.asarray(v._data if isinstance(v, Tensor) else v)
+              for k, v in state.items()}
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, **arrays)
+    # meta atomically first, then the npz readers gate on — a re-publish
+    # must never expose half-written JSON to a concurrent reader
+    mtmp = path + ".meta.tmp"
+    with open(mtmp, "w") as fh:
+        json.dump(meta, fh)
+    os.replace(mtmp, path + ".meta")
+    os.replace(tmp, path + ".npz")  # atomic publish
+
+
+def _load_state(path):
+    with np.load(path + ".npz") as z:
+        state = {k: z[k] for k in z.files}
+    with open(path + ".meta") as fh:
+        meta = json.load(fh)
+    return state, meta
+
+
+def _wait_for(predicate, timeout, poll=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll)
+    return False
+
+
+class Coordinator:
+    """Runs federated rounds: select cohort → wait for their pushes →
+    FedAvg → publish the next global model."""
+
+    def __init__(self, run_dir, selector: ClientSelector = None,
+                 timeout=120.0):
+        self.run_dir = os.path.abspath(run_dir)
+        self.selector = selector or ClientSelector()
+        self.timeout = float(timeout)
+        os.makedirs(self.run_dir, exist_ok=True)
+
+    def _round_dir(self, r):
+        d = os.path.join(self.run_dir, f"round-{r}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def clients(self):
+        reg = os.path.join(self.run_dir, "clients")
+        if not os.path.isdir(reg):
+            return []
+        return sorted(os.listdir(reg))
+
+    def publish_global(self, r, state, cohort=None, final=False):
+        d = self._round_dir(r)
+        _save_state(os.path.join(d, "global"), state,
+                    {"round": r, "cohort": cohort or [],
+                     "strategy": (FLStrategy.FINISH if final
+                                  else FLStrategy.JOIN)})
+
+    def wait_for_clients(self, n=1, timeout=None):
+        """Registration barrier: block until n clients are registered."""
+        timeout = self.timeout if timeout is None else timeout
+        return _wait_for(lambda: len(self.clients()) >= n, timeout)
+
+    def run_round(self, r, global_state):
+        """One federated round; returns the averaged new global state."""
+        if not self.clients() and not self.wait_for_clients(1):
+            raise TimeoutError(
+                f"round {r}: no clients registered under "
+                f"{self.run_dir}/clients after {self.timeout}s")
+        cohort = self.selector.select(self.clients(), r)
+        self.publish_global(r, global_state, cohort)
+        d = self._round_dir(r)
+
+        def all_pushed():
+            return all(os.path.exists(os.path.join(d, f"push-{c}.npz"))
+                       for c in cohort)
+
+        if not _wait_for(all_pushed, self.timeout):
+            missing = [c for c in cohort if not os.path.exists(
+                os.path.join(d, f"push-{c}.npz"))]
+            raise TimeoutError(f"round {r}: no push from {missing}")
+        states, weights = [], []
+        for c in cohort:
+            st, meta = _load_state(os.path.join(d, f"push-{c}"))
+            states.append(st)
+            weights.append(float(meta.get("examples", 1)))
+        total = sum(weights)
+        if total <= 0:  # all-empty cohort: fall back to unweighted mean
+            weights = [1.0] * len(weights)
+            total = float(len(weights))
+        return {k: sum(w / total * st[k].astype(np.float64)
+                       for st, w in zip(states, weights)).astype(
+                           states[0][k].dtype)
+                for k in states[0]}
+
+
+class FLClient:
+    """Client loop: register, then per round pull the global model (if
+    selected), run ``train_fn`` locally, push the result (reference
+    FLClient.train_loop/push_fl_client_info_sync)."""
+
+    def __init__(self, run_dir, client_id, train_fn, timeout=120.0):
+        self.run_dir = os.path.abspath(run_dir)
+        self.client_id = str(client_id)
+        self.train_fn = train_fn  # (round, state) -> (state, n_examples)
+        self.timeout = float(timeout)
+        reg = os.path.join(self.run_dir, "clients")
+        os.makedirs(reg, exist_ok=True)
+        with open(os.path.join(reg, self.client_id), "w") as fh:
+            fh.write(str(time.time()))
+
+    def _round_dir(self, r):
+        return os.path.join(self.run_dir, f"round-{r}")
+
+    def pull_global(self, r):
+        path = os.path.join(self._round_dir(r), "global")
+        if not _wait_for(lambda: os.path.exists(path + ".npz"),
+                         self.timeout):
+            raise TimeoutError(f"round {r}: global model never published")
+        return _load_state(path)
+
+    def run_round(self, r):
+        """Returns FLStrategy for this client this round."""
+        state, meta = self.pull_global(r)
+        if meta.get("strategy") == FLStrategy.FINISH:
+            return FLStrategy.FINISH
+        if self.client_id not in meta.get("cohort", []):
+            return FLStrategy.WAIT
+        new_state, n_examples = self.train_fn(r, state)
+        _save_state(os.path.join(self._round_dir(r),
+                                 f"push-{self.client_id}"),
+                    new_state, {"examples": int(n_examples),
+                                "client": self.client_id})
+        return FLStrategy.JOIN
