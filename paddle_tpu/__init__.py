@@ -140,6 +140,8 @@ def enable_static(place=None):
     return None
 
 
+from . import compat  # noqa: E402,F401
+
 # 2.3-era `paddle.fluid` compat namespace — imported last: it aliases the
 # packages above.
 from . import fluid  # noqa: E402,F401

@@ -1,0 +1,73 @@
+"""paddle.compat — py2/3-era text/number helpers some legacy scripts call.
+
+Reference: python/paddle/compat.py:25 (six-based to_text/to_bytes/round/
+floor_division/get_exception_message). Python-3-only here; the container
+recursion semantics (inplace for list/set, new dict) match the reference.
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = []
+
+
+def _map_container(obj, fn, inplace):
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [fn(x) for x in obj]
+            return obj
+        return [fn(x) for x in obj]
+    if isinstance(obj, set):
+        new = {fn(x) for x in obj}
+        if inplace:
+            obj.clear()
+            obj.update(new)
+            return obj
+        return new
+    if isinstance(obj, dict):
+        return {fn(k): fn(v) for k, v in obj.items()}
+    return None
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """bytes → str, recursively through list/set/dict; others untouched."""
+    if obj is None:
+        return obj
+    mapped = _map_container(obj, lambda x: to_text(x, encoding), inplace)
+    if mapped is not None:
+        return mapped
+    if isinstance(obj, bytes):
+        return obj.decode(encoding)
+    return obj
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """str → bytes, recursively through list/set/dict; others untouched."""
+    if obj is None:
+        return obj
+    mapped = _map_container(obj, lambda x: to_bytes(x, encoding), inplace)
+    if mapped is not None:
+        return mapped
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    return obj
+
+
+def round(x, d=0):
+    """Python-2-style round (half away from zero), reference compat.py:206."""
+    if x > 0.0:
+        p = 10 ** d
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    if x < 0.0:
+        p = 10 ** d
+        return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+    return math.copysign(0.0, x)
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    assert exc is not None
+    return str(exc)

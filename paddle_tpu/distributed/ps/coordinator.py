@@ -58,24 +58,20 @@ class ClientSelector:
 
 
 def _save_state(path, state, meta):
+    """ONE atomically-replaced npz carries both arrays and meta — a
+    re-publish can never hand a concurrent reader new meta with old
+    weights (or vice versa)."""
     arrays = {k: np.asarray(v._data if isinstance(v, Tensor) else v)
               for k, v in state.items()}
     tmp = path + ".tmp.npz"
-    np.savez(tmp, **arrays)
-    # meta atomically first, then the npz readers gate on — a re-publish
-    # must never expose half-written JSON to a concurrent reader
-    mtmp = path + ".meta.tmp"
-    with open(mtmp, "w") as fh:
-        json.dump(meta, fh)
-    os.replace(mtmp, path + ".meta")
+    np.savez(tmp, __meta__=np.asarray(json.dumps(meta)), **arrays)
     os.replace(tmp, path + ".npz")  # atomic publish
 
 
 def _load_state(path):
     with np.load(path + ".npz") as z:
-        state = {k: z[k] for k in z.files}
-    with open(path + ".meta") as fh:
-        meta = json.load(fh)
+        meta = json.loads(str(z["__meta__"]))
+        state = {k: z[k] for k in z.files if k != "__meta__"}
     return state, meta
 
 
@@ -93,10 +89,18 @@ class Coordinator:
     FedAvg → publish the next global model."""
 
     def __init__(self, run_dir, selector: ClientSelector = None,
-                 timeout=120.0):
+                 timeout=120.0, client_ttl=300.0):
         self.run_dir = os.path.abspath(run_dir)
         self.selector = selector or ClientSelector()
         self.timeout = float(timeout)
+        # liveness via the elastic membership substrate: a crashed client
+        # drops out of clients() after client_ttl and is never selected
+        # again (reference: stale clients age out of the coordinator's
+        # etcd-backed info map)
+        from ..elastic import ElasticMembership
+        self._members = ElasticMembership(
+            os.path.join(self.run_dir, "clients"), "__coordinator__",
+            timeout=client_ttl)
         os.makedirs(self.run_dir, exist_ok=True)
 
     def _round_dir(self, r):
@@ -105,10 +109,8 @@ class Coordinator:
         return d
 
     def clients(self):
-        reg = os.path.join(self.run_dir, "clients")
-        if not os.path.isdir(reg):
-            return []
-        return sorted(os.listdir(reg))
+        # the coordinator never register()s, so peers() is clients only
+        return self._members.peers()
 
     def publish_global(self, r, state, cohort=None, final=False):
         d = self._round_dir(r)
@@ -160,15 +162,16 @@ class FLClient:
     selected), run ``train_fn`` locally, push the result (reference
     FLClient.train_loop/push_fl_client_info_sync)."""
 
-    def __init__(self, run_dir, client_id, train_fn, timeout=120.0):
+    def __init__(self, run_dir, client_id, train_fn, timeout=120.0,
+                 ttl=300.0):
         self.run_dir = os.path.abspath(run_dir)
         self.client_id = str(client_id)
         self.train_fn = train_fn  # (round, state) -> (state, n_examples)
         self.timeout = float(timeout)
-        reg = os.path.join(self.run_dir, "clients")
-        os.makedirs(reg, exist_ok=True)
-        with open(os.path.join(reg, self.client_id), "w") as fh:
-            fh.write(str(time.time()))
+        from ..elastic import ElasticMembership
+        self._member = ElasticMembership(
+            os.path.join(self.run_dir, "clients"), self.client_id,
+            timeout=ttl).register()
 
     def _round_dir(self, r):
         return os.path.join(self.run_dir, f"round-{r}")
@@ -182,6 +185,7 @@ class FLClient:
 
     def run_round(self, r):
         """Returns FLStrategy for this client this round."""
+        self._member.heartbeat()
         state, meta = self.pull_global(r)
         if meta.get("strategy") == FLStrategy.FINISH:
             return FLStrategy.FINISH
