@@ -126,11 +126,16 @@ class Coordinator:
 
     def run_round(self, r, global_state):
         """One federated round; returns the averaged new global state."""
-        if not self.clients() and not self.wait_for_clients(1):
+        ids = self.clients()
+        if not ids:
+            self.wait_for_clients(1)
+            ids = self.clients()  # snapshot ONCE: TTL filtering must not
+            # race between the wait and the select
+        if not ids:
             raise TimeoutError(
-                f"round {r}: no clients registered under "
+                f"round {r}: no live clients under "
                 f"{self.run_dir}/clients after {self.timeout}s")
-        cohort = self.selector.select(self.clients(), r)
+        cohort = self.selector.select(ids, r)
         self.publish_global(r, global_state, cohort)
         d = self._round_dir(r)
 
@@ -162,16 +167,17 @@ class FLClient:
     selected), run ``train_fn`` locally, push the result (reference
     FLClient.train_loop/push_fl_client_info_sync)."""
 
-    def __init__(self, run_dir, client_id, train_fn, timeout=120.0,
-                 ttl=300.0):
+    def __init__(self, run_dir, client_id, train_fn, timeout=120.0):
         self.run_dir = os.path.abspath(run_dir)
         self.client_id = str(client_id)
         self.train_fn = train_fn  # (round, state) -> (state, n_examples)
         self.timeout = float(timeout)
+        # staleness is judged by the Coordinator's client_ttl; the
+        # membership object here only writes heartbeats
         from ..elastic import ElasticMembership
         self._member = ElasticMembership(
-            os.path.join(self.run_dir, "clients"), self.client_id,
-            timeout=ttl).register()
+            os.path.join(self.run_dir, "clients"),
+            self.client_id).register()
 
     def _round_dir(self, r):
         return os.path.join(self.run_dir, f"round-{r}")
@@ -190,8 +196,13 @@ class FLClient:
         if meta.get("strategy") == FLStrategy.FINISH:
             return FLStrategy.FINISH
         if self.client_id not in meta.get("cohort", []):
+            self._member.heartbeat()
             return FLStrategy.WAIT
         new_state, n_examples = self.train_fn(r, state)
+        # heartbeat AFTER local training too: liveness tracks the
+        # process, not the round length (a slow train_fn must not make
+        # an active client read as stale)
+        self._member.heartbeat()
         _save_state(os.path.join(self._round_dir(r),
                                  f"push-{self.client_id}"),
                     new_state, {"examples": int(n_examples),
