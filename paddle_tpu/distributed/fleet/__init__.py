@@ -61,15 +61,17 @@ class _FleetOptimizer:
 
     def make_train_step(self, model, loss_fn, **kw):
         s = self._strategy
-        if getattr(s, "localsgd", False) or getattr(s, "dgc", False):
+        if getattr(s, "localsgd", False) or getattr(s, "dgc", False) \
+                or getattr(s, "fp16_allreduce", False):
             if s.amp:
                 raise NotImplementedError(
                     "strategy.amp is not supported together with "
-                    "localsgd/dgc — run them in full precision")
+                    "localsgd/dgc/fp16_allreduce — run them in full "
+                    "precision")
             if kw:
                 raise NotImplementedError(
                     f"options {sorted(kw)} are not supported by the "
-                    f"localsgd/dgc train steps")
+                    f"localsgd/dgc/fp16_allreduce train steps")
         if getattr(s, "localsgd", False):
             from .comm_efficient import LocalSGDTrainStep
             cfg = s.localsgd_configs
@@ -85,6 +87,12 @@ class _FleetOptimizer:
                 momentum=cfg.get("momentum"),
                 sparsity=float(cfg.get("sparsity", 0.99)),
                 clip_norm=cfg.get("clip_norm"))
+        if getattr(s, "fp16_allreduce", False):
+            from .comm_efficient import CompressedAllreduceTrainStep
+            cfg = getattr(s, "fp16_allreduce_configs", {})
+            return CompressedAllreduceTrainStep(
+                model, self._inner, loss_fn, strategy=s,
+                dtype=cfg.get("dtype", "bfloat16"))
         amp_level = kw.pop("amp_level", None) or ("O1" if s.amp else None)
         return make_train_step(model, self._inner, loss_fn,
                                strategy=s, amp_level=amp_level,

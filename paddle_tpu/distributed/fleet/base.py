@@ -40,6 +40,10 @@ class DistributedStrategy:
         self.dgc_configs = {"momentum": None, "sparsity": 0.99}
         self.localsgd = False
         self.localsgd_configs = {"k_steps": 4, "begin_step": 1}
+        self.fp16_allreduce = False
+        # dtype: "bfloat16" (half the psum bytes) or "int8" (EQuARX-style
+        # two-phase quantized allreduce, ~4x fewer bytes)
+        self.fp16_allreduce_configs = {"dtype": "bfloat16"}
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True
         self.nccl_comm_num = 1

@@ -38,7 +38,8 @@ from ...jit.api import _swap_params
 from ...tensor import Tensor
 from .. import mesh as mesh_mod
 
-__all__ = ["LocalSGDTrainStep", "DGCTrainStep"]
+__all__ = ["LocalSGDTrainStep", "DGCTrainStep",
+           "CompressedAllreduceTrainStep"]
 
 
 def _loss_of(model, params, loss_fn):
@@ -60,6 +61,45 @@ def _split_batch(batch, n):
                              f"dp={n}")
         return x.reshape(n, x.shape[0] // n, *x.shape[1:])
     return jax.tree_util.tree_map(split, batch)
+
+
+# shared flatten/unflatten + spec plumbing for the shard_map-based steps
+
+def _tree_layout(pv):
+    shapes = {k: v.shape for k, v in pv.items()}
+    sizes = {k: int(np.prod(v.shape)) or 1 for k, v in pv.items()}
+    return list(pv), shapes, sizes
+
+
+def _flatten_by(tree, order, pad=0):
+    flat = jnp.concatenate(
+        [tree[k].astype(jnp.float32).reshape(-1) for k in order])
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    return flat
+
+
+def _unflatten_by(flat, order, shapes, sizes):
+    out, off = {}, 0
+    for k in order:
+        n = sizes[k]
+        out[k] = flat[off:off + n].reshape(shapes[k])
+        off += n
+    return out
+
+
+def _shardmap_specs(param_vals, micro):
+    """(replicated-params spec tree, dp-leading batch spec tree). Tensor
+    is itself a registered pytree — map with Tensor as the leaf so the
+    result is a (prefix) spec tree, not Tensors wrapping specs."""
+    is_leaf = lambda t: isinstance(t, Tensor)
+    spec_rep = jax.tree_util.tree_map(lambda _: P(), param_vals,
+                                      is_leaf=is_leaf)
+    spec_dp0 = jax.tree_util.tree_map(
+        lambda x: P(*(("dp",) + (None,) * (len(x.shape) - 1)))
+        if len(x.shape) else P(),
+        micro, is_leaf=is_leaf)
+    return spec_rep, spec_dp0
 
 
 class LocalSGDTrainStep:
@@ -179,9 +219,7 @@ class DGCTrainStep:
         self._mesh = mesh
         self._params = dict(model.named_parameters())
         pv = {k: p._data for k, p in self._params.items()}
-        self._shapes = {k: v.shape for k, v in pv.items()}
-        self._sizes = {k: int(np.prod(v.shape)) or 1 for k, v in pv.items()}
-        self._order = list(pv)
+        self._order, self._shapes, self._sizes = _tree_layout(pv)
         self._N = sum(self._sizes.values())
         self.k = max(1, int(round(self._N * (1.0 - float(sparsity)))))
         self._param_vals = pv
@@ -193,16 +231,10 @@ class DGCTrainStep:
         self._compiled = jax.jit(self._step, donate_argnums=(1, 2))
 
     def _flatten(self, tree):
-        return jnp.concatenate(
-            [tree[k].astype(jnp.float32).reshape(-1) for k in self._order])
+        return _flatten_by(tree, self._order)
 
     def _unflatten(self, flat):
-        out, off = {}, 0
-        for k in self._order:
-            n = self._sizes[k]
-            out[k] = flat[off:off + n].reshape(self._shapes[k])
-            off += n
-        return out
+        return _unflatten_by(flat, self._order, self._shapes, self._sizes)
 
     def _step(self, param_vals, u, v, batch, key, lr):
         from jax import shard_map
@@ -239,16 +271,7 @@ class DGCTrainStep:
             loss = jax.lax.pmean(loss, "dp")
             return loss[None], g_comb[None], u[None], v[None]
 
-        # Tensor is itself a registered pytree — map specs with Tensor as
-        # the leaf so the result is a (prefix) spec tree, not Tensors
-        # wrapping PartitionSpecs.
-        is_leaf = lambda t: isinstance(t, Tensor)
-        spec_rep = jax.tree_util.tree_map(lambda _: P(), param_vals,
-                                          is_leaf=is_leaf)
-        spec_dp0 = jax.tree_util.tree_map(
-            lambda x: P(*(("dp",) + (None,) * (len(x.shape) - 1)))
-            if len(x.shape) else P(),
-            micro, is_leaf=is_leaf)
+        spec_rep, spec_dp0 = _shardmap_specs(param_vals, micro)
         fn = shard_map(
             per_replica, mesh=self._mesh,
             in_specs=(spec_rep, P("dp", None), P("dp", None), spec_dp0,
@@ -278,4 +301,141 @@ class DGCTrainStep:
             sched = self._optimizer._lr_scheduler()
             if sched is not None:
                 sched.step()
+        return Tensor(loss)
+
+
+class CompressedAllreduceTrainStep:
+    """Data-parallel step whose gradient all-reduce runs compressed.
+
+    Reference: fleet/meta_optimizers/fp16_allreduce_optimizer.py:1 (cast
+    grads to fp16 for the NCCL allreduce, cast back for the update).
+    Both modes run the SAME two-phase reduce — an explicit
+    reduce-scatter (all_to_all of per-destination chunks) + local mean +
+    all_gather — with the wire payload compressed:
+
+    * dtype="bfloat16": chunks travel as bf16 — half the ICI bytes of
+      fp32.
+    * dtype="int8": EQuARX-style quantized allreduce (arxiv 2506.17615):
+      chunks are quantized BLOCKWISE (one scale per _QBLOCK elements, so
+      a single outlier can't crush its whole chunk's resolution), int8 +
+      scales travel, replicas dequantize/average/re-quantize — ~4x
+      fewer wire bytes than fp32.
+
+    The optimizer itself is unrestricted (grads arrive averaged and
+    full-precision at the update), unlike DGC's SGD-only formulation.
+    """
+
+    _QBLOCK = 1024  # int8 quantization block (elements per scale)
+
+    def __init__(self, model, optimizer, loss_fn: Callable,
+                 dtype="bfloat16", strategy=None):
+        if dtype not in ("bfloat16", "int8"):
+            raise ValueError(f"unsupported compression dtype {dtype!r}")
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.dtype = dtype
+        mesh = mesh_mod.get_mesh()
+        self.dp = mesh.shape["dp"]
+        self._mesh = mesh
+        self._params = dict(model.named_parameters())
+        pv = {k: p._data for k, p in self._params.items()}
+        self._order, self._shapes, self._sizes = _tree_layout(pv)
+        n = sum(self._sizes.values())
+        self._N = n
+        # pad so each replica's chunk is whole int8 blocks
+        self._pad = (-n) % (self.dp * self._QBLOCK)
+        self._param_vals = pv
+        self._opt_state = optimizer.init_state(pv)
+        # donate only the optimizer state: params are the model's live
+        # buffers (donating them would invalidate any pre-step alias)
+        self._compiled = jax.jit(self._step, donate_argnums=(1,))
+
+    def _flatten(self, tree):
+        return _flatten_by(tree, self._order, pad=self._pad)
+
+    def _unflatten(self, flat):
+        return _unflatten_by(flat, self._order, self._shapes, self._sizes)
+
+    def _step(self, param_vals, opt_state, batch, key, lr):
+        from jax import shard_map
+
+        loss_of = _loss_of(self.model, self._params, self.loss_fn)
+        micro = _split_batch(batch, self.dp)
+        keys = jax.random.split(key, self.dp)
+        dp, mode = self.dp, self.dtype
+        chunk = (self._N + self._pad) // dp
+        nblk = max(1, chunk // self._QBLOCK)
+
+        def quant_blocks(x):
+            """x [..., chunk] → (int8 [..., chunk], scales [..., nblk])."""
+            xb = x.reshape(*x.shape[:-1], nblk, -1)
+            s = jnp.max(jnp.abs(xb), axis=-1, keepdims=True) / 127.0
+            s = jnp.maximum(s, 1e-30)
+            q = jnp.clip(jnp.round(xb / s), -127, 127).astype(jnp.int8)
+            return q.reshape(*x.shape), s[..., 0]
+
+        def dequant_blocks(q, s):
+            qb = q.astype(jnp.float32).reshape(*q.shape[:-1], nblk, -1)
+            return (qb * s[..., None]).reshape(*q.shape)
+
+        def per_replica(pv, mb, mkey):
+            mb = jax.tree_util.tree_map(
+                lambda x: x[0] if jnp.ndim(x) else x, mb)
+            loss, grads = jax.value_and_grad(loss_of)(pv, mb, mkey[0])
+            g = self._flatten(grads)
+            # phase 1: compress per destination chunk, all_to_all.
+            # [dp, chunk]: row d is the chunk destined for replica d;
+            # after the tiled all_to_all, row j is MY chunk as computed
+            # by replica j.
+            gc = g.reshape(dp, chunk)
+            if mode == "bfloat16":
+                q1t = jax.lax.all_to_all(gc.astype(jnp.bfloat16), "dp",
+                                         split_axis=0, concat_axis=0,
+                                         tiled=True)
+                mine = jnp.mean(q1t.astype(jnp.float32), axis=0)
+                q2g = jax.lax.all_gather(mine.astype(jnp.bfloat16), "dp")
+                g_avg = q2g.astype(jnp.float32).reshape(-1)
+            else:
+                q1, s1 = quant_blocks(gc)
+                q1t = jax.lax.all_to_all(q1, "dp", split_axis=0,
+                                         concat_axis=0, tiled=True)
+                s1t = jax.lax.all_to_all(s1, "dp", split_axis=0,
+                                         concat_axis=0, tiled=True)
+                # local dequant + average of my chunk
+                mine = jnp.mean(dequant_blocks(q1t, s1t), axis=0)
+                # phase 2: re-quantize the averaged chunk, all_gather
+                q2, s2 = quant_blocks(mine)
+                q2g = jax.lax.all_gather(q2, "dp")       # [dp, chunk]
+                s2g = jax.lax.all_gather(s2, "dp")       # [dp, nblk]
+                g_avg = dequant_blocks(q2g, s2g).reshape(-1)
+            loss = jax.lax.pmean(loss, "dp")
+            return loss[None], g_avg[None]
+
+        spec_rep, spec_dp0 = _shardmap_specs(param_vals, micro)
+        fn = shard_map(
+            per_replica, mesh=self._mesh,
+            in_specs=(spec_rep, spec_dp0, P("dp", None)),
+            out_specs=(P("dp"), P(None, None)),
+            axis_names=frozenset({"dp"}),
+            check_vma=False)
+        loss, g_avg = fn(param_vals, micro, keys)
+        g_tree = self._unflatten(g_avg[0])
+        grads = {k: g_tree[k].astype(param_vals[k].dtype)
+                 for k in param_vals}
+        new_p, new_s = self.optimizer.apply_gradients_functional(
+            param_vals, grads, opt_state, lr, params_ref=self._params)
+        return loss.mean(), new_p, new_s
+
+    def __call__(self, *batch):
+        raw = jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, Tensor) else x, tuple(batch))
+        loss, self._param_vals, self._opt_state = self._compiled(
+            self._param_vals, self._opt_state, raw, next_key(),
+            jnp.asarray(self.optimizer.get_lr(), jnp.float32))
+        for k, p in self._params.items():
+            p._data = self._param_vals[k]
+        sched = self.optimizer._lr_scheduler()
+        if sched is not None:
+            sched.step()
         return Tensor(loss)
