@@ -61,8 +61,12 @@ class _FleetOptimizer:
 
     def make_train_step(self, model, loss_fn, **kw):
         s = self._strategy
-        if getattr(s, "localsgd", False) or getattr(s, "dgc", False) \
-                or getattr(s, "fp16_allreduce", False):
+        modes = [m for m in ("localsgd", "dgc", "fp16_allreduce")
+                 if getattr(s, m, False)]
+        if len(modes) > 1:
+            raise NotImplementedError(
+                f"strategies {modes} are mutually exclusive — enable one")
+        if modes:
             if s.amp:
                 raise NotImplementedError(
                     "strategy.amp is not supported together with "

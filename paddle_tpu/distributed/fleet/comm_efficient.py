@@ -343,8 +343,11 @@ class CompressedAllreduceTrainStep:
         self._order, self._shapes, self._sizes = _tree_layout(pv)
         n = sum(self._sizes.values())
         self._N = n
-        # pad so each replica's chunk is whole int8 blocks
-        self._pad = (-n) % (self.dp * self._QBLOCK)
+        # int8 needs whole quantization blocks per chunk; bf16 only needs
+        # dp-divisibility (padding to blocks would ship >10x extra zeros
+        # for small models)
+        self._pad = (-n) % (self.dp * self._QBLOCK if dtype == "int8"
+                            else self.dp)
         self._param_vals = pv
         self._opt_state = optimizer.init_state(pv)
         # donate only the optimizer state: params are the model's live

@@ -1,0 +1,13 @@
+"""distributed.metric — PS-era global metric calculators.
+
+Reference: python/paddle/distributed/metric/metrics.py (init_metric
+parses a yaml monitor config and registers AUC calculators on the C++
+metric object; print_auc reads the globally-aggregated result). No PS
+daemon here: calculators are in-process paddle_tpu.metric.Auc instances
+keyed by name on a plain registry object; under a mesh the predictions
+each process feeds are its own shard, matching the reference's
+per-worker feed + global read.
+"""
+from .metrics import Metric, init_metric, print_auc, print_metric
+
+__all__ = ["Metric", "init_metric", "print_metric", "print_auc"]

@@ -1,0 +1,93 @@
+"""In-process analog of the PS metric object (reference
+distributed/metric/metrics.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...metric import Auc
+
+__all__ = ["Metric", "init_metric", "print_metric", "print_auc"]
+
+
+class Metric:
+    """The ``metric_ptr`` analog: named AUC calculators fed by update()."""
+
+    def __init__(self):
+        self._calculators = {}
+        self._configs = {}
+
+    def init_metric(self, method, name, label_var, target_var, *args,
+                    **kwargs):
+        if method not in ("AucCalculator", "MultiTaskAucCalculator",
+                          "CmatchRankAucCalculator", "MaskAucCalculator",
+                          "WuAucCalculator"):
+            raise ValueError(f"unknown metric method {method!r}")
+        self._calculators[name] = Auc()
+        self._configs[name] = {"method": method, "label": label_var,
+                               "target": target_var, **kwargs}
+
+    def update(self, name, preds, labels):
+        """Feed one batch: preds [N] probabilities (or [N, 2]), labels."""
+        preds = np.asarray(preds)
+        if preds.ndim == 1:
+            preds = np.stack([1 - preds, preds], axis=1)
+        self._calculators[name].update(preds, np.asarray(labels))
+
+    def get_metric(self, name):
+        return float(self._calculators[name].accumulate())
+
+    def flush_metric(self, name):
+        self._calculators[name].reset()
+
+    def names(self):
+        return sorted(self._calculators)
+
+
+def init_metric(metric_ptr, metric_yaml_path, cmatch_rank_var="",
+                mask_var="", uid_var="", phase=-1, cmatch_rank_group="",
+                ignore_rank=False, bucket_size=1000000):
+    """Parse the monitor yaml and register its calculators (reference
+    metrics.py:26). Accepts the reference yaml schema:
+    monitors: [{method, name, label, target, phase}, ...]."""
+    try:
+        import yaml
+        with open(metric_yaml_path) as fh:
+            content = yaml.safe_load(fh)
+    except ImportError:  # tiny fallback parser for the flat schema
+        content = _parse_monitors_yaml(metric_yaml_path)
+    for runner in content.get("monitors") or []:
+        metric_ptr.init_metric(
+            runner["method"], runner["name"], runner.get("label", ""),
+            runner.get("target", ""), cmatch_rank_var, mask_var, uid_var,
+            1 if runner.get("phase") == "JOINING" else 0,
+            cmatch_rank_group, ignore_rank, bucket_size)
+
+
+def _parse_monitors_yaml(path):
+    monitors, cur = [], None
+    with open(path) as fh:
+        for line in fh:
+            s = line.strip()
+            if s.startswith("- "):
+                cur = {}
+                monitors.append(cur)
+                s = s[2:]
+            if cur is not None and ":" in s:
+                k, v = s.split(":", 1)
+                cur[k.strip()] = v.strip().strip("'\"")
+    return {"monitors": monitors}
+
+
+def print_metric(metric_ptr, name):
+    """Reference metrics.py:102."""
+    if "@" in name:  # day-level spelling "name@day"
+        name = name.split("@", 1)[0]
+    out = f"{name}: AUC={metric_ptr.get_metric(name):.6f}"
+    print(out)
+    return out
+
+
+def print_auc(metric_ptr, is_day, phase="all"):
+    """Reference metrics.py:120: print every registered AUC."""
+    outs = [print_metric(metric_ptr, n) for n in metric_ptr.names()]
+    return outs

@@ -1,0 +1,12 @@
+"""distributed.models.moe — expert-parallel routing primitives.
+
+Reference: python/paddle/distributed/models/moe/utils.py (the custom-op
+wrappers number_count/assign_pos/limit_by_capacity/prune_gate_by_capacity
+the MoE layers build dispatch from). Here they are jnp programs — the
+same primitives the sort-based dispatch in nn/moe.py composes.
+"""
+from .utils import (_assign_pos, _limit_by_capacity, _number_count,
+                    _prune_gate_by_capacity, _random_routing)
+
+__all__ = ["_number_count", "_assign_pos", "_random_routing",
+           "_limit_by_capacity", "_prune_gate_by_capacity"]

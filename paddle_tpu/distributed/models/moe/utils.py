@@ -1,0 +1,95 @@
+"""MoE routing primitives (reference distributed/models/moe/utils.py —
+there each is a CUDA custom op; here plain jnp, jit-able, same
+semantics)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....tensor import Tensor
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap_like(val, ref):
+    return Tensor(val) if isinstance(ref, Tensor) else val
+
+
+def _number_count(numbers, upper_range):
+    """How many routed ids fall on each expert: bincount over the
+    flattened gate indices (reference utils.py:22 number_count op)."""
+    raw = _raw(numbers).reshape(-1)
+    out = jnp.bincount(raw, length=int(upper_range)).astype(jnp.int64)
+    return _wrap_like(out, numbers)
+
+
+def _assign_pos(x, cum_count):
+    """Slot each routed id into its expert's contiguous region
+    (reference utils.py:61 assign_pos op): for ids x (flattened in
+    routing order) and inclusive cumulative expert counts ``cum_count``,
+    returns pos such that pos[j] = the routing-order index of the j-th
+    token when tokens are grouped by expert (stable within an expert).
+
+    Matches the reference example: number_count=[2,0,2,0],
+    numbers=[[0,2],[0,2]] → pos=[2,0,3,1] — i.e. the op fills each
+    expert's region back-to-front over the reversed scan order.
+    """
+    ids = _raw(x).reshape(-1)
+    cum = _raw(cum_count).astype(jnp.int32)
+    # reference kernel: iterate tokens, pos[--cum[e]] = token_index;
+    # equivalently a stable sort by expert with within-expert order
+    # REVERSED (the kernel decrements from the region end)
+    n = ids.shape[0]
+    rev = ids[::-1]
+    order = jnp.argsort(rev, stable=True)        # group reversed ids
+    pos = (n - 1) - order                        # back to original idx
+    out = pos.astype(cum.dtype)
+    return _wrap_like(out, x)
+
+
+def _random_routing(topk_idx, topk_value, prob, topk=2):
+    """Stochastically drop second-choice experts (reference utils.py:111):
+    keep choice 2 only where prob < 2 * gate_value, else route to -1
+    (dropped)."""
+    if topk != 2:
+        raise ValueError("only topk=2 supported (reference parity)")
+    idx = _raw(topk_idx)
+    val = _raw(topk_value)
+    p = _raw(prob)
+    keep = p < 2.0 * val[:, 1]
+    new_second = jnp.where(keep, idx[:, 1], -1)
+    out = jnp.stack([idx[:, 0], new_second], axis=1)
+    return _wrap_like(out, topk_idx)
+
+
+def _limit_by_capacity(expert_count, capacity, n_worker):
+    """Clamp per-(worker, expert) counts so each expert's global total
+    stays under its capacity (reference utils.py:136): workers take
+    capacity greedily in worker order."""
+    ec = _raw(expert_count).reshape(int(n_worker), -1)  # [W, E]
+    cap = _raw(capacity).astype(ec.dtype)               # [E]
+
+    def body(remaining, row):
+        take = jnp.minimum(row, remaining)
+        return remaining - take, take
+
+    _, taken = jax.lax.scan(body, cap, ec)
+    out = taken.reshape(-1)
+    return _wrap_like(out, expert_count)
+
+
+def _prune_gate_by_capacity(gate_idx, expert_count, n_expert, n_worker):
+    """Re-route tokens of over-capacity experts to -1 (reference
+    utils.py:180): the first ``expert_count[e]`` tokens routed to expert
+    e keep their assignment, later ones are dropped."""
+    idx = _raw(gate_idx).reshape(-1)
+    ec = _raw(expert_count).reshape(-1)
+    E = int(n_expert) * int(n_worker)
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)
+    pos_in_e = jnp.cumsum(onehot, axis=0) - onehot      # [N, E]
+    my_pos = jnp.take_along_axis(pos_in_e, idx[:, None], axis=1)[:, 0]
+    keep = my_pos < ec[idx]
+    out = jnp.where(keep, idx, -1).astype(_raw(gate_idx).dtype)
+    return _wrap_like(out.reshape(_raw(gate_idx).shape), gate_idx)

@@ -1,0 +1,83 @@
+"""distributed.metric + distributed.models.moe.utils parity (reference
+distributed/metric/metrics.py, distributed/models/moe/utils.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.distributed.metric import Metric, init_metric, print_auc
+from paddle_tpu.distributed.models.moe import (_assign_pos,
+                                               _limit_by_capacity,
+                                               _number_count,
+                                               _prune_gate_by_capacity,
+                                               _random_routing)
+
+
+def test_number_count():
+    out = np.asarray(_number_count(np.array([[0, 2], [0, 2]]), 4))
+    np.testing.assert_array_equal(out, [2, 0, 2, 0])
+
+
+def test_assign_pos_matches_reference_example():
+    # reference utils.py:61 docstring example
+    number_count = np.array([2, 0, 2, 0])
+    numbers = np.array([[0, 2], [0, 2]], np.int32)
+    cum = np.cumsum(number_count)
+    pos = np.asarray(_assign_pos(numbers, cum))
+    np.testing.assert_array_equal(pos, [2, 0, 3, 1])
+
+
+def test_assign_pos_groups_by_expert():
+    ids = np.array([1, 0, 1, 2, 0], np.int32)
+    cum = np.cumsum(np.bincount(ids, minlength=3))
+    pos = np.asarray(_assign_pos(ids, cum))
+    # grouped positions point at token indices whose ids are sorted
+    np.testing.assert_array_equal(np.sort(ids[pos[:2]]), [0, 0])
+    np.testing.assert_array_equal(np.sort(ids[pos[2:4]]), [1, 1])
+    assert ids[pos[4]] == 2
+
+
+def test_random_routing():
+    idx = np.array([[0, 1], [2, 3], [4, 5]])
+    val = np.array([[0.9, 0.4], [0.8, 0.01], [0.7, 0.3]], np.float32)
+    prob = np.array([0.5, 0.5, 0.5], np.float32)
+    out = np.asarray(_random_routing(idx, val, prob))
+    # 0.5 < 2*0.4 keep; 0.5 >= 2*0.01 drop; 0.5 < 2*0.3 keep
+    np.testing.assert_array_equal(out, [[0, 1], [2, -1], [4, 5]])
+
+
+def test_limit_by_capacity_greedy_in_worker_order():
+    # 2 workers x 3 experts; capacity per expert
+    ec = np.array([3, 1, 2,   4, 2, 0])
+    cap = np.array([5, 2, 1])
+    out = np.asarray(_limit_by_capacity(ec, cap, 2))
+    np.testing.assert_array_equal(out, [3, 1, 1, 2, 1, 0])
+
+
+def test_prune_gate_by_capacity():
+    gate = np.array([0, 1, 0, 0, 1], np.int32)
+    ec = np.array([2, 1])  # expert 0 keeps 2, expert 1 keeps 1
+    out = np.asarray(_prune_gate_by_capacity(gate, ec, 2, 1))
+    np.testing.assert_array_equal(out, [0, 1, 0, -1, -1])
+
+
+def test_metric_auc_and_yaml(tmp_path):
+    m = Metric()
+    yml = tmp_path / "monitors.yaml"
+    yml.write_text(
+        "monitors:\n"
+        "  - method: AucCalculator\n"
+        "    name: click_auc\n"
+        "    label: label\n"
+        "    target: ctr_prob\n"
+        "    phase: JOINING\n")
+    init_metric(m, str(yml))
+    assert m.names() == ["click_auc"]
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 2, 512)
+    preds = np.clip(labels * 0.6 + rng.random(512) * 0.4, 0, 1)
+    m.update("click_auc", preds, labels)
+    auc = m.get_metric("click_auc")
+    assert 0.8 < auc <= 1.0, auc
+    outs = print_auc(m, is_day=False)
+    assert "click_auc" in outs[0]
+    m.flush_metric("click_auc")
