@@ -12,8 +12,12 @@ from ..geometric import (  # noqa: F401  (incubate/tensor/math.py)
     segment_max, segment_mean, segment_min, segment_sum,
 )
 from . import autotune  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import nn  # noqa: F401
+from . import operators  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import passes  # noqa: F401
+from . import tensor  # noqa: F401
 from .graph_ops import (  # noqa: F401
     graph_khop_sampler, graph_reindex, graph_sample_neighbors,
     graph_send_recv, identity_loss, softmax_mask_fuse,

@@ -1,0 +1,7 @@
+"""incubate.checkpoint — reference spelling (reference
+python/paddle/incubate/checkpoint/__init__.py exposes auto_checkpoint).
+The TPU stack's checkpointing lives in distributed.checkpoint (orbax
+sharded async) and utils.watchdog; re-exported here."""
+from .. import auto_checkpoint  # noqa: F401
+from ...distributed.checkpoint import (CheckpointManager,  # noqa: F401
+                                       load_distributed, save_distributed)

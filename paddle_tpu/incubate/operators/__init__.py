@@ -1,0 +1,8 @@
+"""incubate.operators — reference package spelling for the fused/graph
+ops (reference python/paddle/incubate/operators/: graph_send_recv.py,
+graph_sample_neighbors.py, graph_reindex.py, graph_khop_sampler.py,
+softmax_mask_fuse*.py). Implementations live in incubate/graph_ops.py."""
+from ..graph_ops import (graph_khop_sampler, graph_reindex,  # noqa: F401
+                         graph_sample_neighbors, graph_send_recv,
+                         identity_loss, softmax_mask_fuse,
+                         softmax_mask_fuse_upper_triangle)

@@ -1,0 +1,5 @@
+"""incubate.passes — reference spelling (reference
+python/paddle/incubate/passes/ip.py IR pass helpers). The TPU stack's
+pass surface is distributed.passes (strategy-mutating passes; graph
+rewriting is XLA's job), re-exported here."""
+from ...distributed.passes import PassContext, PassManager, new_pass  # noqa: F401

@@ -1,0 +1,3 @@
+"""incubate.tensor — reference spelling for the segment ops
+(reference python/paddle/incubate/tensor/math.py)."""
+from . import math  # noqa: F401

@@ -1,0 +1,4 @@
+"""Segment reductions (reference incubate/tensor/math.py:23-204); the
+implementations are the geometric module's segment ops."""
+from ...geometric import (segment_max, segment_mean,  # noqa: F401
+                          segment_min, segment_sum)
