@@ -110,6 +110,11 @@ from .utils.unique_name import guard as unique_name_guard  # noqa: F401
 linalg = None
 from . import tensor_ops as _ops  # noqa: E402
 from .tensor_ops import linalg as _linalg_mod  # noqa: E402
+import sys as _sys  # noqa: E402
+
+# make `import paddle_tpu.linalg` work like the reference's real
+# submodule, not just attribute access
+_sys.modules[__name__ + ".linalg"] = _linalg_mod
 
 linalg = _linalg_mod
 

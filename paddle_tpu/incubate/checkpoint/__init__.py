@@ -2,6 +2,12 @@
 python/paddle/incubate/checkpoint/__init__.py exposes auto_checkpoint).
 The TPU stack's checkpointing lives in distributed.checkpoint (orbax
 sharded async) and utils.watchdog; re-exported here."""
+import sys as _sys
+
 from .. import auto_checkpoint  # noqa: F401
 from ...distributed.checkpoint import (CheckpointManager,  # noqa: F401
                                        load_distributed, save_distributed)
+
+# reference-path submodule import compat:
+# `import paddle.incubate.checkpoint.auto_checkpoint`
+_sys.modules[__name__ + ".auto_checkpoint"] = auto_checkpoint

@@ -2,7 +2,17 @@
 ops (reference python/paddle/incubate/operators/: graph_send_recv.py,
 graph_sample_neighbors.py, graph_reindex.py, graph_khop_sampler.py,
 softmax_mask_fuse*.py). Implementations live in incubate/graph_ops.py."""
+import sys as _sys
+
+from .. import graph_ops as _impl
 from ..graph_ops import (graph_khop_sampler, graph_reindex,  # noqa: F401
                          graph_sample_neighbors, graph_send_recv,
                          identity_loss, softmax_mask_fuse,
                          softmax_mask_fuse_upper_triangle)
+
+# reference-path submodule import compat (each reference file becomes an
+# alias of the one implementation module):
+for _name in ("graph_send_recv", "graph_sample_neighbors", "graph_reindex",
+              "graph_khop_sampler", "softmax_mask_fuse",
+              "softmax_mask_fuse_upper_triangle"):
+    _sys.modules[f"{__name__}.{_name}"] = _impl
