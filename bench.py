@@ -58,7 +58,11 @@ def bench_llama(backend):
     # ~0.5B params: 7B's hidden/head shapes halved, 8 layers; bf16 + flash
     # attention; activations fit without remat at batch 4 (remat costs ~30%
     # extra forward FLOPs — measured round 2).
+    # 0 disables; 1 means "on at the default chunk"; larger values pin the
+    # vocab chunk size directly (chunk=1 would be a 32000-step scan)
     fused_ce = int(os.environ.get("PADDLE_TPU_BENCH_FUSED_CE", "0"))
+    if fused_ce == 1:
+        fused_ce = 8192
     cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                       intermediate_size=5504, num_hidden_layers=8,
                       num_attention_heads=16, num_key_value_heads=16,
@@ -100,6 +104,7 @@ def bench_llama(backend):
         "params": n_params, "mfu_est_v5e": round(mfu, 4),
         "loss": round(loss, 4), "batch": batch, "seqlen": seqlen,
         "steps": n_steps, "attention": attention_path(),
+        "fused_ce_chunk": fused_ce,
     }
 
 
@@ -442,9 +447,7 @@ def bench_llama_fused_ce(backend):
     flip = "1" if (prev or "0") == "0" else "0"
     os.environ["PADDLE_TPU_BENCH_FUSED_CE"] = flip
     try:
-        r = bench_llama(backend)
-        r["fused_ce_chunk"] = int(flip)
-        return r
+        return bench_llama(backend)  # records the resolved fused_ce_chunk
     finally:
         if prev is None:
             os.environ.pop("PADDLE_TPU_BENCH_FUSED_CE", None)

@@ -62,10 +62,15 @@ def quantize_int8_stochastic(w, seed: int = 0, interpret: bool = False):
         scale = jnp.maximum(amax / 127.0, 1e-10)
         s_ref[0, 0] = scale
         scaled = x_ref[:] / scale
+        # Mosaic's stochastic_round primitive only targets float dtypes
+        # (bf16/fp8); integer stochastic rounding is floor(x + u) with
+        # u ~ U[0,1): E[q] == x. Top 24 bits of the PRNG word give a
+        # uniform that fp32 represents exactly.
         bits = pltpu.bitcast(pltpu.prng_random_bits(scaled.shape),
                              jnp.uint32)
-        q_ref[:] = pltpu.stochastic_round(scaled, bits,
-                                          target_dtype=jnp.int8)
+        u = (bits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+        q = jnp.floor(scaled + u)
+        q_ref[:] = jnp.clip(q, -127.0, 127.0).astype(jnp.int8)
 
     rows, cols = w.shape
     q, s = pl.pallas_call(
