@@ -104,7 +104,7 @@ def bench_llama(backend):
         "params": n_params, "mfu_est_v5e": round(mfu, 4),
         "loss": round(loss, 4), "batch": batch, "seqlen": seqlen,
         "steps": n_steps, "attention": attention_path(),
-        "fused_ce_chunk": fused_ce,
+        "fused_ce_chunk": cfg.fused_ce_chunk,
     }
 
 
@@ -665,6 +665,16 @@ def main():
         # env vars alone can't override it (see tests/conftest.py)
         import jax
         jax.config.update("jax_platforms", "cpu")
+    try:
+        # persistent compile cache: retry/harvest runs against a flaky
+        # tunnel skip recompiles, so a short availability window is
+        # enough to land a measurement
+        import jax
+        jax.config.update("jax_compilation_cache_dir", os.path.join(
+            os.path.dirname(__file__) or ".", ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass
     # global deadline: the JSON line must print before any plausible
     # driver timeout, whatever the tunnel does; skipped secondaries are
     # replayed from the last full session below
@@ -676,13 +686,35 @@ def main():
 
     backend = _backend_or_die()
 
-    headline = _run_guarded(
-        bench_llama, backend,
-        left(float(os.environ.get("PADDLE_TPU_BENCH_HEADLINE_S", "900"))))
+    # PADDLE_TPU_BENCH_ONLY="bert_base_dp,vit_b16" runs just those
+    # secondaries (plus "kernels"/"headline" pseudo-names) — used by the
+    # harvest loop to grab missing measurements one at a time while the
+    # TPU tunnel's availability window lasts. Untouched configs keep
+    # their last session values.
+    only = set(s.strip() for s in
+               os.environ.get("PADDLE_TPU_BENCH_ONLY", "").split(",")
+               if s.strip())
+
+    headline = None
+    if only and "headline" not in only and backend == "tpu":
+        prev = _last_session() or {}  # session holds TPU measurements only
+        if prev.get("tokens_per_sec"):
+            headline = {k: v for k, v in prev.items()
+                        if k not in ("secondary", "kernels", "measured_utc")}
+            headline["replayed_from_session"] = True
+            headline.setdefault("headline_measured_utc",
+                                prev.get("measured_utc"))
+    if headline is None:
+        headline = _run_guarded(
+            bench_llama, backend,
+            left(float(os.environ.get("PADDLE_TPU_BENCH_HEADLINE_S", "900"))))
     if "error" in headline:
         _fallback_exit(f"headline bench failed: {headline['error']}")
 
-    kernels = _run_guarded(bench_kernels, backend, left(420.0))
+    if only and "kernels" not in only:
+        kernels = {"skipped": "not in PADDLE_TPU_BENCH_ONLY"}
+    else:
+        kernels = _run_guarded(bench_kernels, backend, left(420.0))
     secondary = {}
     t_start = time.perf_counter()
     budget = min(
@@ -700,6 +732,11 @@ def main():
                          ("llama_b8_selective_remat",
                           bench_llama_b8_selective),
                          ("ctr_widedeep", bench_ctr_widedeep)):
+            if only and name not in only:
+                # marker (not omission) so the artifact fill-loop below
+                # replays the last session value for untouched configs
+                secondary[name] = {"skipped": "not in PADDLE_TPU_BENCH_ONLY"}
+                continue
             remaining = budget - (time.perf_counter() - t_start)
             if remaining <= 0:
                 secondary[name] = {"skipped": "bench time budget exhausted"}
