@@ -15,69 +15,15 @@ from .collective import (  # noqa: F401
 )
 from . import cloud_utils, sharding, utils  # noqa: F401
 from .parallel import DataParallel, ParallelEnv  # noqa: F401
+from .parallel_with_gloo import (  # noqa: F401
+    gloo_barrier, gloo_init_parallel_env, gloo_release,
+)
+from .spawn import spawn  # noqa: F401
 from .ps_dataset import BoxPSDataset  # noqa: F401
 from .ps_dataset import (  # noqa: F401
     CountFilterEntry, InMemoryDataset, ParallelMode, ProbabilityEntry,
     QueueDataset, ShowClickEntry,
 )
-
-
-def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
-    """Reference: parallel.py::gloo_init_parallel_env (CPU barrier infra).
-    Single-controller XLA runtime needs no gloo ring — recorded as a
-    no-op init."""
-    init_parallel_env()
-
-
-def gloo_barrier():
-    barrier()
-
-
-def gloo_release():
-    return None
-
-
-def spawn(func, args=(), nprocs=-1, join=True, **kwargs):
-    """Reference: distributed/spawn.py — run ``func`` in worker processes.
-
-    nprocs <= 1 runs inline (the usual TPU case: one process per host, XLA
-    owns every local device). nprocs > 1 starts real spawn processes with
-    the PADDLE_* env contract; workers are pinned to the CPU platform (a
-    tunneled single TPU cannot be shared between processes)."""
-    if nprocs is None or nprocs <= 1:
-        func(*args)
-        return
-
-    import multiprocessing
-    import os
-
-    ctx = multiprocessing.get_context("spawn")
-    saved = {k: os.environ.get(k)
-             for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS",
-                       "PADDLE_TRAINERS_NUM", "PADDLE_TRAINER_ID")}
-    procs = []
-    try:
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
-        for rank in range(nprocs):
-            os.environ["PADDLE_TRAINER_ID"] = str(rank)
-            p = ctx.Process(target=func, args=args, daemon=True)
-            p.start()
-            procs.append(p)
-    finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
-    if join:
-        for p in procs:
-            p.join()
-        bad = [p.exitcode for p in procs if p.exitcode != 0]
-        if bad:
-            raise RuntimeError(f"spawn workers failed: exitcodes {bad}")
-    return procs
 
 
 def launch():

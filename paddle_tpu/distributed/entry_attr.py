@@ -1,0 +1,9 @@
+"""Reference spelling: python/paddle/distributed/entry_attr.py — sparse
+embedding entry policies (which rows a sparse table admits/retires).
+Implementations live in ps_dataset.py; the TPU-native sharded tables
+(distributed/ps/sharded_table.py) accept them as SparseTableConfig entry
+metadata.
+"""
+from .ps_dataset import CountFilterEntry, ProbabilityEntry, ShowClickEntry
+
+__all__ = ["ProbabilityEntry", "CountFilterEntry", "ShowClickEntry"]

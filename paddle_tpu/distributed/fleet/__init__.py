@@ -36,6 +36,16 @@ def get_strategy() -> DistributedStrategy:
     return _strategy
 
 
+def _ensure_strategy() -> DistributedStrategy:
+    """The active strategy, creating (but NOT fleet.init-ing — no mesh
+    build) a default one pre-init. Meta-optimizer wrappers use this so
+    constructing one doesn't force device initialization."""
+    global _strategy
+    if _strategy is None:
+        _strategy = DistributedStrategy()
+    return _strategy
+
+
 def distributed_model(model):
     """Annotate parameter shardings per the active strategy (the reference
     wraps with DataParallel/TensorParallel/PipelineParallel engines; here
@@ -217,3 +227,19 @@ class Fleet:
     @property
     def util(self):
         return util
+
+
+def distributed_scaler(scaler):
+    """Reference fleet/scaler.py distributed_scaler: wraps GradScaler so
+    found_inf is agreed across data-parallel ranks. Single-controller pjit
+    computes gradients (and therefore found_inf) globally in one program,
+    so the scaler is already globally consistent — returned as is."""
+    return scaler
+
+
+from . import meta_optimizers  # noqa: F401,E402
+from . import ref_paths as _ref_paths  # noqa: E402
+import sys as _sys  # noqa: E402
+
+_ref_paths.register(_sys.modules[__name__])
+del _ref_paths, _sys

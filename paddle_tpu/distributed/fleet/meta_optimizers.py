@@ -1,0 +1,193 @@
+"""Meta-optimizer spellings (reference:
+python/paddle/distributed/fleet/meta_optimizers/*.py).
+
+The reference composes graph-rewriting meta optimizers picked by
+DistributedStrategy flags (meta_optimizer_factory.py). Here the compiled
+train step (fleet/train_step.py, comm_efficient.py) reads the SAME strategy
+flags, so each class below is the reference spelling of "wrap an optimizer
+and switch the corresponding strategy feature on": constructing one returns
+an optimizer whose `make_train_step` compiles with that feature active.
+Attribute access (step/minimize/state_dict/...) delegates to the inner
+optimizer, matching MetaOptimizerBase's decorator pattern
+(meta_optimizer_base.py:30).
+"""
+from __future__ import annotations
+
+from . import _ensure_strategy
+
+
+class MetaOptimizerBase:
+    """Delegating wrapper (reference meta_optimizer_base.py).
+
+    Without an explicit ``strategy`` the wrapper flips its flag on the
+    process-global fleet strategy — the same object the compiled train
+    step reads; that global composition IS the reference semantics
+    (fleet's strategy is a process singleton). Pass a strategy explicitly
+    to scope the toggle.
+    """
+
+    def __init__(self, optimizer, strategy=None):
+        self._inner = optimizer
+        self._strategy = (strategy if strategy is not None
+                          else _ensure_strategy())
+        self._apply(self._strategy)
+
+    def _apply(self, strategy):  # subclasses flip their strategy switch
+        pass
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    @property
+    def inner_opt(self):
+        return self._inner
+
+
+class LocalSGDOptimizer(MetaOptimizerBase):
+    """k-step local updates + periodic averaging (localsgd_optimizer.py:12)."""
+
+    def _apply(self, strategy):
+        strategy.localsgd = True
+
+
+class AdaptiveLocalSGDOptimizer(LocalSGDOptimizer):
+    """Reference adaptive variant shares the LocalSGD step machinery."""
+
+
+class DGCMomentumOptimizer(MetaOptimizerBase):
+    """Top-k sparsified allreduce w/ momentum correction (dgc_optimizer.py:1)."""
+
+    def _apply(self, strategy):
+        strategy.dgc = True
+
+
+class FP16AllReduceOptimizer(MetaOptimizerBase):
+    """Compressed-payload allreduce (fp16_allreduce_optimizer.py:1);
+    wire dtype from strategy.fp16_allreduce_configs."""
+
+    def _apply(self, strategy):
+        strategy.fp16_allreduce = True
+
+
+class GradientMergeOptimizer(MetaOptimizerBase):
+    """Micro-batch gradient accumulation (gradient_merge_optimizer.py)."""
+
+    def _apply(self, strategy):
+        strategy.gradient_merge = True
+
+
+class RecomputeOptimizer(MetaOptimizerBase):
+    """Activation rematerialization (recompute_optimizer.py)."""
+
+    def _apply(self, strategy):
+        strategy.recompute = True
+
+
+class AMPOptimizer(MetaOptimizerBase):
+    """Mixed precision + dynamic loss scaling (amp_optimizer.py)."""
+
+    def _apply(self, strategy):
+        strategy.amp = True
+
+
+class ShardingOptimizer(MetaOptimizerBase):
+    """ZeRO param/grad/opt-state partitioning (sharding_optimizer.py)."""
+
+    def _apply(self, strategy):
+        strategy.sharding = True
+
+
+class PipelineOptimizer(MetaOptimizerBase):
+    """Pipeline-parallel schedule (pipeline_optimizer.py)."""
+
+    def _apply(self, strategy):
+        strategy.pipeline = True
+
+
+class TensorParallelOptimizer(MetaOptimizerBase):
+    """Megatron tensor parallel (tensor_parallel_optimizer.py); degree
+    comes from strategy.hybrid_configs["mp_degree"]."""
+
+
+class RawProgramOptimizer(MetaOptimizerBase):
+    """Plain data parallel allreduce (raw_program_optimizer.py) — the
+    compiled step's default; nothing to switch."""
+
+
+class GraphExecutionOptimizer(MetaOptimizerBase):
+    """Whole-graph compilation (graph_execution_optimizer.py) — XLA always
+    compiles the whole step; nothing to switch."""
+
+
+def _carried_hyperparams(inner, names):
+    """Hyperparams the inner optimizer actually carries, by the private
+    attribute convention of optimizer/algorithms.py (_lr-style names)."""
+    out = {}
+    for kwarg, attrs in names.items():
+        for attr in attrs:
+            if hasattr(inner, attr):
+                val = getattr(inner, attr)
+                if "weight_decay" in kwarg and val is not None \
+                        and not isinstance(val, (int, float)):
+                    val = getattr(val, "coeff",
+                                  getattr(val, "_coeff", None))
+                if val is not None:
+                    out[kwarg] = val
+                break
+    return out
+
+
+class LambOptimizer(MetaOptimizerBase):
+    """Layerwise adaptive large-batch optimizer (lamb_optimizer.py):
+    swaps the inner optimizer for Lamb, carrying lr / betas / epsilon /
+    weight decay / grad clip where the inner optimizer defines them."""
+
+    def _apply(self, strategy):
+        strategy.lamb = True
+        from ...optimizer import Lamb
+
+        inner = self._inner
+        params = getattr(inner, "_parameter_list", None)
+        if params is not None:
+            kw = _carried_hyperparams(inner, {
+                "learning_rate": ("_learning_rate",),
+                "beta1": ("_beta1",), "beta2": ("_beta2",),
+                "epsilon": ("_epsilon",),
+                "lamb_weight_decay": ("_wd_coeff", "_lamb_wd",
+                                      "_weight_decay"),
+                "grad_clip": ("_grad_clip",),
+            })
+            kw.setdefault("learning_rate", 1e-3)
+            self._inner = Lamb(parameters=params, **kw)
+
+
+class LarsOptimizer(MetaOptimizerBase):
+    """Layerwise trust-ratio SGD (lars_optimizer.py): swaps the inner
+    optimizer for LarsMomentum, carrying lr / momentum / weight decay /
+    grad clip where the inner optimizer defines them."""
+
+    def _apply(self, strategy):
+        from ...optimizer import LarsMomentum
+
+        inner = self._inner
+        params = getattr(inner, "_parameter_list", None)
+        if params is not None:
+            kw = _carried_hyperparams(inner, {
+                "learning_rate": ("_learning_rate",),
+                "momentum": ("_momentum",),
+                "lars_weight_decay": ("_lars_wd", "_weight_decay"),
+                "grad_clip": ("_grad_clip",),
+            })
+            kw.setdefault("learning_rate", 1e-3)
+            kw.setdefault("momentum", 0.9)
+            self._inner = LarsMomentum(parameters=params, **kw)
+
+
+class ASPOptimizer(MetaOptimizerBase):
+    """2:4 structured sparsity masking (asp_optimizer.py): decorates the
+    inner optimizer with the incubate.asp mask pass."""
+
+    def _apply(self, strategy):
+        from ...incubate import asp
+
+        self._inner = asp.decorate(self._inner)

@@ -1,0 +1,180 @@
+"""Reference-path module spellings under ``paddle.distributed.fleet``.
+
+Real Paddle user code imports fleet internals by file path —
+``from paddle.distributed.fleet.base import role_maker``,
+``import paddle.distributed.fleet.launch`` — paths that in the reference
+are separate files (fleet/base/*.py, fleet/{model,optimizer,scaler,
+dataset,metrics,launch,launch_utils,cloud_utils}.py, fleet/elastic/,
+fleet/runtime/). Here the implementations live in consolidated modules;
+this file registers module objects for the reference spellings resolving
+to the same objects. Alias modules are LAZY (PEP 562-style __getattr__):
+the PS/elastic/metrics/launcher stacks load on first attribute access,
+not at ``import fleet`` time.
+
+``fleet.base`` is NOT synthesized: the real base.py module is augmented
+with the extra reference names so existing ``from ..fleet.base import X``
+imports keep resolving to one module object.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+
+class _LazyModule(types.ModuleType):
+    """Module whose attributes come from a loader() dict on first access."""
+
+    def __init__(self, name, doc, loader):
+        super().__init__(name, doc)
+        self.__dict__["_loader"] = loader
+
+    def __getattr__(self, item):
+        attrs = self.__dict__.get("_attrs")
+        if attrs is None:
+            attrs = self.__dict__["_attrs"] = self.__dict__["_loader"]()
+        try:
+            value = attrs[item]
+        except KeyError:
+            raise AttributeError(
+                f"module {self.__name__!r} has no attribute {item!r}")
+        self.__dict__[item] = value
+        return value
+
+    def __dir__(self):
+        attrs = self.__dict__.get("_attrs")
+        if attrs is None:
+            attrs = self.__dict__["_attrs"] = self.__dict__["_loader"]()
+        return sorted(set(list(self.__dict__) + list(attrs)))
+
+
+def _lazy(name, doc, loader):
+    m = _LazyModule(name, doc, loader)
+    sys.modules[name] = m
+    return m
+
+
+def register(fleet_mod):
+    base = fleet_mod.__name__          # "paddle_tpu.distributed.fleet"
+    from .base import DistributedStrategy
+    from .compat import (CommunicateTopology, PaddleCloudRoleMaker, Role,
+                         UserDefinedRoleMaker, UtilBase)
+
+    # ---- fleet/base/ package (reference fleet/base/*.py) ----
+    # base.py is a real imported module: augment it rather than shadowing
+    # it in sys.modules (lazy `from ..fleet.base import X` elsewhere must
+    # keep seeing one module object).
+    base_mod = sys.modules[base + ".base"]
+    rm = _lazy(base + ".base.role_maker",
+               "reference fleet/base/role_maker.py",
+               lambda: {"Role": Role,
+                        "PaddleCloudRoleMaker": PaddleCloudRoleMaker,
+                        "UserDefinedRoleMaker": UserDefinedRoleMaker})
+    topo = _lazy(base + ".base.topology",
+                 "reference fleet/base/topology.py",
+                 lambda: {"CommunicateTopology": CommunicateTopology,
+                          "HybridCommunicateGroup":
+                          fleet_mod.HybridCommunicateGroup})
+    ds = _lazy(base + ".base.distributed_strategy",
+               "reference fleet/base/distributed_strategy.py",
+               lambda: {"DistributedStrategy": DistributedStrategy})
+    uf = _lazy(base + ".base.util_factory",
+               "reference fleet/base/util_factory.py",
+               lambda: {"UtilBase": UtilBase})
+    fb = _lazy(base + ".base.fleet_base",
+               "reference fleet/base/fleet_base.py",
+               lambda: {"Fleet": fleet_mod.Fleet})
+    for name, mod in (("role_maker", rm), ("topology", topo),
+                      ("distributed_strategy", ds), ("util_factory", uf),
+                      ("fleet_base", fb)):
+        setattr(base_mod, name, mod)
+    for attr, val in (("CommunicateTopology", CommunicateTopology),
+                      ("Role", Role),
+                      ("PaddleCloudRoleMaker", PaddleCloudRoleMaker),
+                      ("UserDefinedRoleMaker", UserDefinedRoleMaker),
+                      ("UtilBase", UtilBase)):
+        if not hasattr(base_mod, attr):
+            setattr(base_mod, attr, val)
+
+    # ---- single-file spellings (reference fleet/<name>.py) ----
+    def _ps_dataset():
+        from ..ps_dataset import InMemoryDataset, QueueDataset
+        return {"InMemoryDataset": InMemoryDataset,
+                "QueueDataset": QueueDataset}
+
+    def _metrics():
+        from .. import metric
+        return {"metric": metric, "Metric": metric.Metric,
+                "init_metric": metric.init_metric,
+                "print_auc": metric.print_auc,
+                "print_metric": metric.print_metric}
+
+    def _launch():
+        from ..launch_main import main
+        return {"launch": main, "main": main}
+
+    def _launch_utils():
+        from ..utils import find_free_ports, get_cluster_from_args
+        return {"find_free_ports": find_free_ports,
+                "get_cluster_from_args": get_cluster_from_args}
+
+    def _elastic():
+        from .. import elastic
+        return {"ElasticManager": elastic.ElasticMembership,
+                "ElasticMembership": elastic.ElasticMembership,
+                "maybe_resume": elastic.maybe_resume,
+                "manager": sys.modules[base + ".elastic.manager"]}
+
+    def _elastic_manager():
+        from .. import elastic
+        return {"ElasticManager": elastic.ElasticMembership,
+                "LauncherInterface": elastic.ElasticMembership}
+
+    def _runtime():
+        from .. import ps
+        return {"ps": ps,
+                "the_one_ps": sys.modules[base + ".runtime.the_one_ps"]}
+
+    def _the_one_ps():
+        from .. import ps
+        return {"ShardedEmbedding": ps.ShardedEmbedding,
+                "SparseTableConfig": ps.SparseTableConfig}
+
+    def _cloud_utils():
+        from .. import cloud_utils
+        return dict(cloud_utils.__dict__)
+
+    _lazy(base + ".fleet", "reference fleet/fleet.py",
+          lambda: {"Fleet": fleet_mod.Fleet, "init": fleet_mod.init,
+                   "distributed_model": fleet_mod.distributed_model,
+                   "distributed_optimizer":
+                   fleet_mod.distributed_optimizer})
+    _lazy(base + ".model", "reference fleet/model.py",
+          lambda: {"distributed_model": fleet_mod.distributed_model})
+    _lazy(base + ".optimizer", "reference fleet/optimizer.py",
+          lambda: {"distributed_optimizer":
+                   fleet_mod.distributed_optimizer})
+    _lazy(base + ".scaler", "reference fleet/scaler.py",
+          lambda: {"distributed_scaler": fleet_mod.distributed_scaler})
+    _lazy(base + ".dataset", "reference fleet/dataset/", _ps_dataset)
+    _lazy(base + ".metrics",
+          "reference fleet/metrics/ (global metric calculators)", _metrics)
+    _lazy(base + ".launch", "reference fleet/launch.py (launcher CLI)",
+          _launch)
+    _lazy(base + ".launch_utils", "reference fleet/launch_utils.py",
+          _launch_utils)
+    _lazy(base + ".cloud_utils", "reference fleet/cloud_utils.py",
+          _cloud_utils)
+    _lazy(base + ".elastic", "reference fleet/elastic/__init__.py",
+          _elastic)
+    _lazy(base + ".elastic.manager", "reference fleet/elastic/manager.py",
+          _elastic_manager)
+    _lazy(base + ".runtime", "reference fleet/runtime/__init__.py",
+          _runtime)
+    _lazy(base + ".runtime.the_one_ps",
+          "reference fleet/runtime/the_one_ps.py — see distributed/ps "
+          "for the TPU-native re-design", _the_one_ps)
+
+    for name in ("fleet", "model", "optimizer", "scaler", "dataset",
+                 "metrics", "launch", "launch_utils", "cloud_utils",
+                 "elastic", "runtime"):
+        setattr(fleet_mod, name, sys.modules[base + "." + name])

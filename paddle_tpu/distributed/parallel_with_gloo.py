@@ -1,0 +1,22 @@
+"""Reference spelling: python/paddle/distributed/parallel_with_gloo.py
+(gloo CPU-barrier infra). The single-controller XLA runtime needs no
+gloo ring; init is recorded and barrier rides the collective path."""
+from .collective import barrier, init_parallel_env
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Reference: parallel.py::gloo_init_parallel_env (CPU barrier infra).
+    Single-controller XLA runtime needs no gloo ring — recorded as a
+    no-op init."""
+    init_parallel_env()
+
+
+def gloo_barrier():
+    barrier()
+
+
+def gloo_release():
+    return None
+
+
+__all__ = ["gloo_init_parallel_env", "gloo_barrier", "gloo_release"]

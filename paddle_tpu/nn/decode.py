@@ -1,0 +1,9 @@
+"""Reference spelling: python/paddle/nn/decode.py (seq2seq decoding API).
+
+Implementations live in nn/layer/decode.py (lax.while_loop-based
+dynamic_decode with a static-shape step state — see that module's
+docstring for the TPU design).
+"""
+from .layer.decode import BeamSearchDecoder, Decoder, dynamic_decode
+
+__all__ = ["BeamSearchDecoder", "Decoder", "dynamic_decode"]

@@ -1,0 +1,43 @@
+"""Reference: python/paddle/utils/deprecated.py — the @deprecated decorator
+used across the paddle API to warn once per call site and annotate the
+docstring."""
+from __future__ import annotations
+
+import functools
+import warnings
+
+__all__ = ["deprecated"]
+
+
+def deprecated(update_to="", since="", reason="", level=1):
+    """Mark an API deprecated (same signature as the reference).
+
+    level 0 logs nothing, 1 warns (DeprecationWarning), 2 raises
+    RuntimeError on call.
+    """
+
+    def decorator(func):
+        msg = f"API \"{func.__module__}.{func.__name__}\" is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use \"{update_to}\" instead"
+        if reason:
+            msg += f". Reason: {reason}"
+        doc = f"\n\n.. warning:: {msg}\n"
+        if func.__doc__:
+            func.__doc__ = func.__doc__ + doc
+        else:
+            func.__doc__ = doc
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(msg)
+            if level == 1:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator

@@ -1,0 +1,9 @@
+"""Reference spelling: python/paddle/utils/install_check.py (run_check).
+
+The implementation (a tiny matmul on the default backend plus an 8-virtual
+-device sharded matmul when multiple devices are visible) lives in
+utils/__init__.py.
+"""
+from . import run_check
+
+__all__ = ["run_check"]
