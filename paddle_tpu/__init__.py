@@ -150,3 +150,7 @@ from . import compat  # noqa: E402,F401
 # 2.3-era `paddle.fluid` compat namespace — imported last: it aliases the
 # packages above.
 from . import fluid  # noqa: E402,F401
+
+# Reference-path submodule spellings (paddle.tensor.creation,
+# paddle.distribution.normal, device.cuda.streams, ...) — lazy aliases.
+from . import ref_alias  # noqa: E402,F401

@@ -1,0 +1,91 @@
+"""Image preprocessing for the legacy dataset readers.
+
+Reference: python/paddle/dataset/image.py (cv2-backed load/resize/crop/
+flip/simple_transform in CHW layout). Here the pixel work rides the same
+numpy/PIL implementations as paddle_tpu.vision.transforms; cv2 is not
+required.
+"""
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+from ..vision import transforms as _T
+
+__all__ = ["load_image_bytes", "load_image", "resize_short", "to_chw",
+           "center_crop", "random_crop", "left_right_flip",
+           "simple_transform", "load_and_transform"]
+
+
+def _decode(data):
+    from PIL import Image
+
+    return np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+
+
+def load_image_bytes(bytes, is_color=True):  # noqa: A002 (reference name)
+    im = _decode(bytes)
+    if not is_color:
+        im = im.mean(axis=2).astype(im.dtype)
+    return im
+
+
+def load_image(file, is_color=True):
+    with open(file, "rb") as fh:
+        return load_image_bytes(fh.read(), is_color)
+
+
+def resize_short(im, size):
+    """Resize so the SHORT edge is `size`, keeping aspect ratio."""
+    h, w = im.shape[:2]
+    if h < w:
+        new_h, new_w = size, int(round(w * size / h))
+    else:
+        new_h, new_w = int(round(h * size / w)), size
+    return np.asarray(_T.resize(im, (new_h, new_w)))
+
+
+def to_chw(im, order=(2, 0, 1)):
+    if im.ndim == 2:
+        im = im[:, :, None]
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    return np.asarray(_T.center_crop(im, size))
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    top = np.random.randint(0, h - size + 1)
+    left = np.random.randint(0, w - size + 1)
+    return im[top:top + size, left:left + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1].copy()
+
+
+def simple_transform(im, resize_size, crop_size, is_train,
+                     is_color=True, mean=None):
+    """resize-short -> (random crop + random flip | center crop)
+    -> CHW float32 [-mean] (the reference's standard train/test path)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size)
+        if np.random.randint(2):
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, dtype=np.float32)
+        im -= mean if mean.ndim >= 2 else mean[:, None, None]
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

@@ -119,3 +119,25 @@ def stream_guard(stream):
     """No-op guard: one implicit execution stream per device under
     PJRT."""
     yield
+
+
+class CUDAGraph:
+    """CUDA-graph capture shim (reference device/cuda/graphs.py
+    CUDAGraph): XLA compiles the whole jitted program ahead of time, so
+    capture/replay is inherent to jit — these calls record intent only."""
+
+    def __init__(self, place=None, mode="thread_local"):
+        self._captured = False
+
+    def capture_begin(self):
+        self._captured = False
+
+    def capture_end(self):
+        self._captured = True
+
+    def replay(self):
+        if not self._captured:
+            raise RuntimeError("CUDAGraph.replay() before capture_end()")
+
+    def reset(self):
+        self._captured = False

@@ -15,6 +15,11 @@ _hcg: Optional[HybridCommunicateGroup] = None
 
 def init(role_maker=None, is_collective=True, strategy=None):
     global _strategy, _hcg
+    if strategy is None and _strategy is not None and _hcg is None:
+        # keep a strategy created before init (meta-optimizer wrappers
+        # via _ensure_strategy) — its toggles must reach the compiled
+        # step; an explicit strategy or a re-init still replaces it
+        strategy = _strategy
     _strategy = strategy or DistributedStrategy()
     _hcg = HybridCommunicateGroup(_strategy)
     from ..collective import init_parallel_env

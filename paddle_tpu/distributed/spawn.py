@@ -26,7 +26,9 @@ def spawn(func, args=(), nprocs=-1, join=True, **kwargs):
         os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
         for rank in range(nprocs):
             os.environ["PADDLE_TRAINER_ID"] = str(rank)
-            p = ctx.Process(target=func, args=args, daemon=True)
+            # non-daemon (reference behavior): workers may start their own
+            # children (multiprocess DataLoader) and survive join=False
+            p = ctx.Process(target=func, args=args, daemon=False)
             p.start()
             procs.append(p)
     finally:

@@ -1,0 +1,143 @@
+"""Reference-path submodule spellings at the paddle_tpu top level.
+
+The reference splits several namespaces across per-concept files
+(python/paddle/tensor/creation.py, distribution/normal.py,
+device/cuda/streams.py, ...) that here live in consolidated modules. User
+code imports those file paths directly (``from paddle.tensor.creation
+import to_tensor``, ``from paddle.distribution.normal import Normal``);
+this module registers lazy alias modules for them (PEP 562-style: the
+backing module loads on first attribute access).
+
+``paddle_tpu.tensor`` / ``paddle_tpu.distribution`` etc. stay the real
+modules — aliases are only added for the reference's SUBmodule paths that
+have no file here.
+"""
+from __future__ import annotations
+
+import importlib
+import sys
+import types
+
+_PKG = __name__.rsplit(".", 1)[0]  # "paddle_tpu"
+
+
+class _LazyAlias(types.ModuleType):
+    """Alias module forwarding attribute access to a backing module."""
+
+    def __init__(self, name, backing, doc, names=None):
+        super().__init__(name, doc)
+        self.__dict__["_backing"] = backing
+        self.__dict__["_names"] = names
+
+    def _load(self):
+        backing = self.__dict__["_backing"]
+        mods = backing if isinstance(backing, (list, tuple)) else [backing]
+        return [importlib.import_module(m) for m in mods]
+
+    def __getattr__(self, item):
+        names = self.__dict__["_names"]
+        if names is not None and item not in names:
+            raise AttributeError(
+                f"module {self.__name__!r} has no attribute {item!r}")
+        for mod in self._load():
+            if hasattr(mod, item):
+                value = getattr(mod, item)
+                self.__dict__[item] = value
+                return value
+        raise AttributeError(
+            f"module {self.__name__!r} has no attribute {item!r}")
+
+    def __dir__(self):
+        names = self.__dict__["_names"]
+        if names is not None:
+            return sorted(names)
+        out = set()
+        for mod in self._load():
+            out.update(dir(mod))
+        return sorted(out)
+
+
+def _alias(ref_path, backing, doc, names=None):
+    full = _PKG + "." + ref_path
+    if full in sys.modules:
+        return
+    if isinstance(backing, str):
+        backing = [backing]
+    mod = _LazyAlias(full, [_PKG + "." + b for b in backing], doc, names)
+    sys.modules[full] = mod
+    # bind the submodule attribute on the parent too: Python skips the
+    # parent binding when an import resolves from sys.modules, and the
+    # dotted spelling (paddle.tensor.creation.to_tensor) needs it
+    parent_name, _, leaf = full.rpartition(".")
+    try:
+        parent = importlib.import_module(parent_name)
+        # never clobber a name the parent already binds (e.g. a module
+        # that did `import math` would break internally)
+        if not hasattr(parent, leaf):
+            setattr(parent, leaf, mod)
+    except Exception:
+        pass
+
+
+# ---- paddle.tensor.* (reference python/paddle/tensor/*.py) ----
+for _sub in ("creation", "manipulation", "math", "logic", "search", "stat",
+             "random", "einsum"):
+    _alias(f"tensor.{_sub}", f"tensor_ops.{_sub}",
+           f"reference python/paddle/tensor/{_sub}.py — implementation in "
+           f"tensor_ops/{_sub}.py")
+_alias("tensor.linalg", ["tensor_ops.linalg", "tensor_ops.math"],
+       "reference python/paddle/tensor/linalg.py (decompositions here, "
+       "matmul/dot family in tensor_ops/math.py)")
+_alias("tensor.attribute", "tensor_ops.extras",
+       "reference python/paddle/tensor/attribute.py (shape/rank/real/imag)")
+_alias("tensor.ops", "tensor_ops.math",
+       "reference python/paddle/tensor/ops.py (unary elementwise aliases)")
+_alias("tensor.to_string", "tensor_ops.extras",
+       "reference python/paddle/tensor/to_string.py",
+       names={"set_printoptions"})
+_alias("tensor.array", "fluid.layers",
+       "reference python/paddle/tensor/array.py (TensorArray ops)",
+       names={"array_length", "array_read", "array_write", "create_array"})
+
+# ---- paddle.distribution.* (reference distribution/<name>.py) ----
+for _sub, _names in (
+        ("distribution", {"Distribution"}),
+        ("normal", {"Normal"}),
+        ("uniform", {"Uniform"}),
+        ("categorical", {"Categorical"}),
+        ("beta", {"Beta"}),
+        ("dirichlet", {"Dirichlet"}),
+        ("multinomial", {"Multinomial"}),
+        ("independent", {"Independent"}),
+        ("transformed_distribution", {"TransformedDistribution"}),
+        ("exponential_family", {"ExponentialFamily"}),
+        ("kl", {"kl_divergence", "register_kl"}),
+        ("transform", None),  # Transform/AffineTransform/... full surface
+        ("variable", None),
+        ("constraint", None)):
+    _alias(f"distribution.{_sub}", "distribution",
+           f"reference python/paddle/distribution/{_sub}.py",
+           names=_names)
+
+# ---- device.cuda submodules (absence-reporting, like device/cuda.py) ----
+_alias("device.cuda.streams", "device.cuda",
+       "reference device/cuda/streams.py — Stream/Event report cuda "
+       "absence on the TPU build", names={"Stream", "Event"})
+_alias("device.cuda.graphs", "device.cuda",
+       "reference device/cuda/graphs.py", names={"CUDAGraph"})
+
+# ---- utils.* ----
+_alias("utils.profiler", "profiler",
+       "reference utils/profiler.py (legacy profiler entry points)")
+_alias("utils.cpp_extension.cpp_extension", "utils.cpp_extension",
+       "reference utils/cpp_extension/cpp_extension.py")
+_alias("utils.cpp_extension.extension_utils", "utils.cpp_extension",
+       "reference utils/cpp_extension/extension_utils.py")
+
+# ---- misc single-file spellings ----
+_alias("cost_model.cost_model", "cost_model",
+       "reference cost_model/cost_model.py")
+_alias("geometric.message_passing.send_recv", "geometric.message_passing",
+       "reference geometric/message_passing/send_recv.py")
+_alias("geometric.message_passing.utils", "geometric.message_passing",
+       "reference geometric/message_passing/utils.py")

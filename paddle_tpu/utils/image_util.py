@@ -1,0 +1,67 @@
+"""Reference: python/paddle/utils/image_util.py (the pre-2.0 image
+helpers: resize_image/flip/crop_img/oversample in CHW float layout).
+Pixel work delegates to dataset/image.py (numpy/PIL, no cv2)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..dataset import image as _img
+
+__all__ = ["resize_image", "flip", "crop_img", "load_image", "oversample"]
+
+
+def resize_image(img, target_size):
+    """Resize short edge to target_size (HWC uint8/float numpy in,
+    same layout out)."""
+    return _img.resize_short(np.asarray(img), target_size)
+
+
+def flip(im):
+    """Horizontal flip of a CHW image (reference operates on CHW)."""
+    im = np.asarray(im)
+    return im[:, :, ::-1] if im.ndim == 3 else im[:, ::-1]
+
+
+def crop_img(im, inner_size, color=True, test=True):
+    """Center (test) or random (train) crop of an HWC image."""
+    if test:
+        return _img.center_crop(im, inner_size, color)
+    return _img.random_crop(im, inner_size, color)
+
+
+def load_image(img_path, is_color=True):
+    return _img.load_image(img_path, is_color)
+
+
+def oversample(img, crop_dims):
+    """10-crop oversampling (4 corners + center, mirrored) of HWC images.
+
+    img: list/array of HWC images; returns stacked crops
+    (reference image_util.py:146).
+    """
+    imgs = [np.asarray(i) for i in img]
+    im_shape = imgs[0].shape
+    crop_dims = np.asarray(crop_dims)
+    im_center = np.asarray(im_shape[:2]) / 2.0
+
+    h_indices = (0, im_shape[0] - crop_dims[0])
+    w_indices = (0, im_shape[1] - crop_dims[1])
+    crops_ix = np.empty((5, 4), dtype=int)
+    curr = 0
+    for i in h_indices:
+        for j in w_indices:
+            crops_ix[curr] = (i, j, i + crop_dims[0], j + crop_dims[1])
+            curr += 1
+    crops_ix[4] = np.tile(im_center, (1, 2)) + np.concatenate(
+        [-crop_dims / 2.0, crop_dims / 2.0])
+    crops_ix = np.tile(crops_ix, (2, 1))
+
+    crops = np.empty((10 * len(imgs), crop_dims[0], crop_dims[1],
+                      im_shape[-1]), dtype=imgs[0].dtype)
+    ix = 0
+    for im in imgs:
+        for crop in crops_ix:
+            crops[ix] = im[crop[0]:crop[2], crop[1]:crop[3], :]
+            ix += 1
+        crops[ix - 5:ix] = crops[ix - 5:ix, :, ::-1, :]  # mirror last 5
+    return crops

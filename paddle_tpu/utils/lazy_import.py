@@ -1,0 +1,5 @@
+"""Reference spelling: python/paddle/utils/lazy_import.py (try_import of
+optional dependencies). Implementation in utils/__init__.py."""
+from . import try_import
+
+__all__ = ["try_import"]

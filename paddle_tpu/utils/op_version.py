@@ -1,0 +1,44 @@
+"""Reference: python/paddle/utils/op_version.py — op-version checkpoint
+introspection. The reference reads the C++ op registry's version table;
+here ops lower to StableHLO (no per-op version registry), so the checker
+runs over an in-python table that custom-op authors (utils/cpp_extension)
+may populate."""
+from __future__ import annotations
+
+__all__ = ["OpLastCheckpointChecker", "OpUpdateInfoHelper", "Singleton"]
+
+_OP_VERSIONS: dict = {}
+
+
+def Singleton(cls):
+    instances = {}
+
+    def get(*args, **kwargs):
+        if cls not in instances:
+            instances[cls] = cls(*args, **kwargs)
+        return instances[cls]
+
+    return get
+
+
+class OpUpdateInfoHelper:
+    def __init__(self, info):
+        self._info = info
+
+    def verify_key_value(self, name=""):
+        return name in getattr(self._info, "keys", lambda: [])() \
+            if callable(getattr(self._info, "keys", None)) \
+            else name in (self._info or {})
+
+
+@Singleton
+class OpLastCheckpointChecker:
+    def __init__(self):
+        self.checkpoints = _OP_VERSIONS
+
+    def filter_updates(self, op_name, type=None, key=""):  # noqa: A002
+        updates = self.checkpoints.get(op_name, [])
+        if key:
+            updates = [u for u in updates
+                       if OpUpdateInfoHelper(u).verify_key_value(key)]
+        return updates

@@ -44,6 +44,74 @@ def test_reference_path_resolves(mod, attr):
     assert hasattr(m, attr), f"{mod}.{attr} missing"
 
 
+@pytest.mark.parametrize("mod,attr", [
+    ("paddle_tpu.tensor.creation", "to_tensor"),
+    ("paddle_tpu.tensor.manipulation", "reshape"),
+    ("paddle_tpu.tensor.math", "add"),
+    ("paddle_tpu.tensor.linalg", "matmul"),
+    ("paddle_tpu.tensor.linalg", "qr"),
+    ("paddle_tpu.tensor.random", "rand"),
+    ("paddle_tpu.tensor.search", "argmax"),
+    ("paddle_tpu.tensor.to_string", "set_printoptions"),
+    ("paddle_tpu.tensor.array", "array_write"),
+    ("paddle_tpu.distribution.normal", "Normal"),
+    ("paddle_tpu.distribution.categorical", "Categorical"),
+    ("paddle_tpu.distribution.kl", "kl_divergence"),
+    ("paddle_tpu.distribution.transform", "Transform"),
+    ("paddle_tpu.device.cuda.streams", "Stream"),
+    ("paddle_tpu.device.cuda.graphs", "CUDAGraph"),
+    ("paddle_tpu.utils.lazy_import", "try_import"),
+    ("paddle_tpu.utils.op_version", "OpLastCheckpointChecker"),
+    ("paddle_tpu.utils.image_util", "oversample"),
+    ("paddle_tpu.dataset.image", "simple_transform"),
+    ("paddle_tpu.geometric.message_passing.send_recv", None),
+    ("paddle_tpu.cost_model.cost_model", None),
+])
+def test_top_level_alias_resolves(mod, attr):
+    m = importlib.import_module(mod)
+    if attr is not None:
+        assert hasattr(m, attr), f"{mod}.{attr} missing"
+
+
+def test_alias_functions_work():
+    from paddle_tpu.tensor.linalg import matmul
+    from paddle_tpu.distribution.normal import Normal
+
+    r = matmul(paddle.to_tensor(np.eye(3, dtype=np.float32)),
+               paddle.to_tensor(np.ones((3, 3), np.float32)))
+    assert float(r.numpy().sum()) == 9.0
+    n = Normal(0.0, 1.0)
+    assert n.sample([4]).shape[0] == 4
+
+
+def test_dataset_image_pipeline():
+    from paddle_tpu.dataset import image as di
+
+    rng = np.random.default_rng(0)
+    im = (rng.random((40, 60, 3)) * 255).astype("uint8")
+    out = di.simple_transform(im, 32, 24, is_train=True,
+                              mean=[1.0, 1.0, 1.0])
+    assert out.shape == (3, 24, 24) and out.dtype == np.float32
+    out = di.simple_transform(im, 32, 24, is_train=False)
+    assert out.shape == (3, 24, 24)
+    assert di.resize_short(im, 20).shape[0] == 20  # short edge is h
+
+    from paddle_tpu.utils.image_util import oversample
+    crops = oversample([im[:32, :32]], (24, 24))
+    assert crops.shape == (10, 24, 24, 3)
+
+
+def test_cuda_graph_shim():
+    from paddle_tpu.device.cuda.graphs import CUDAGraph
+
+    g = CUDAGraph()
+    with pytest.raises(RuntimeError):
+        g.replay()
+    g.capture_begin()
+    g.capture_end()
+    g.replay()
+
+
 def test_submodule_imports_do_not_clobber_functions():
     # `import paddle.distributed.spawn` in user code must leave
     # paddle.distributed.spawn(...) callable (reference behavior: the
