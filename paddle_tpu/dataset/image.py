@@ -18,17 +18,15 @@ __all__ = ["load_image_bytes", "load_image", "resize_short", "to_chw",
            "simple_transform", "load_and_transform"]
 
 
-def _decode(data):
+def _decode(data, mode="RGB"):
     from PIL import Image
 
-    return np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+    return np.asarray(Image.open(io.BytesIO(data)).convert(mode))
 
 
 def load_image_bytes(bytes, is_color=True):  # noqa: A002 (reference name)
-    im = _decode(bytes)
-    if not is_color:
-        im = im.mean(axis=2).astype(im.dtype)
-    return im
+    # "L" is ITU-R 601 luma — matches the reference's cv2 grayscale
+    return _decode(bytes, "RGB" if is_color else "L")
 
 
 def load_image(file, is_color=True):
@@ -81,7 +79,7 @@ def simple_transform(im, resize_size, crop_size, is_train,
     im = to_chw(im).astype(np.float32)
     if mean is not None:
         mean = np.asarray(mean, dtype=np.float32)
-        im -= mean if mean.ndim >= 2 else mean[:, None, None]
+        im -= mean[:, None, None] if mean.ndim == 1 else mean
     return im
 
 

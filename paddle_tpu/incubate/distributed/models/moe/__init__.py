@@ -16,10 +16,12 @@ import math
 import jax
 import jax.numpy as jnp
 
+from .....nn.clip import ClipGradByGlobalNorm
 from .....nn.layer_base import Layer
 from .....tensor import Tensor, apply
 
-__all__ = ["MoELayer", "BaseGate", "NaiveGate", "GShardGate",
+__all__ = ["ClipGradForMOEByGlobalNorm",
+           "MoELayer", "BaseGate", "NaiveGate", "GShardGate",
            "SwitchGate"]
 
 
@@ -187,3 +189,19 @@ class MoELayer(Layer):
             return jnp.einsum("ecs,ecd->sd", c_, stacked)
         out = apply(combine, comb, *outs)
         return reshape(out, shape)
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    """Reference incubate/distributed/models/moe/grad_clip.py: global-norm
+    clipping where expert-parallel parameters' norm is summed across the
+    moe group (each worker holds distinct experts) while regular
+    parameters contribute once. Single-controller pjit computes gradients
+    globally — every expert's gradient is already in this process — so
+    the combined global norm equals ClipGradByGlobalNorm over all params;
+    the is_expert_param split is kept for API parity."""
+
+    def __init__(self, clip_norm, is_expert_param_func=None,
+                 moe_group=None, group_name="default_moe_group"):
+        super().__init__(clip_norm, group_name)
+        self.is_expert_param_func = is_expert_param_func
+        self.moe_group = moe_group

@@ -134,6 +134,64 @@ _alias("utils.cpp_extension.cpp_extension", "utils.cpp_extension",
 _alias("utils.cpp_extension.extension_utils", "utils.cpp_extension",
        "reference utils/cpp_extension/extension_utils.py")
 
+# ---- incubate.sparse per-concept files (reference incubate/sparse/nn) ----
+for _leaf, _names in (("pooling", {"max_pool3d"}),
+                      ("conv", {"conv3d", "subm_conv3d"}),
+                      ("activation", {"relu", "relu6", "leaky_relu",
+                                      "softmax"}),
+                      ("transformer", {"attention"})):
+    _alias(f"incubate.sparse.nn.functional.{_leaf}", "sparse.nn.functional",
+           f"reference incubate/sparse/nn/functional/{_leaf}.py",
+           names=_names)
+for _leaf, _names in (("norm", {"BatchNorm", "SyncBatchNorm"}),
+                      ("pooling", {"MaxPool3D"}),
+                      ("conv", {"Conv3D", "SubmConv3D"}),
+                      ("activation", {"ReLU", "ReLU6", "LeakyReLU",
+                                      "Softmax"})):
+    _alias(f"incubate.sparse.nn.layer.{_leaf}", "sparse.nn.layer",
+           f"reference incubate/sparse/nn/layer/{_leaf}.py", names=_names)
+
+# ---- incubate.autograd (reference primapi/functional; jax IS the prim
+# machinery — primrules/primx/primreg compiler internals are excluded) ----
+_alias("incubate.autograd.primapi", "incubate.autograd",
+       "reference incubate/autograd/primapi.py",
+       names={"forward_grad", "grad"})
+_alias("incubate.autograd.functional", "incubate.autograd",
+       "reference incubate/autograd/functional.py",
+       names={"vjp", "jvp", "Jacobian", "Hessian", "jacobian", "hessian"})
+_alias("incubate.autograd.utils", "incubate.autograd",
+       "reference incubate/autograd/utils.py",
+       names={"prim_enabled", "enable_prim", "disable_prim"})
+
+# ---- incubate.optimizer.functional (bfgs/lbfgs files) ----
+_alias("incubate.optimizer.functional.bfgs", "incubate.optimizer.functional",
+       "reference incubate/optimizer/functional/bfgs.py",
+       names={"minimize_bfgs"})
+_alias("incubate.optimizer.functional.lbfgs",
+       "incubate.optimizer.functional",
+       "reference incubate/optimizer/functional/lbfgs.py",
+       names={"minimize_lbfgs"})
+
+# ---- incubate.distributed.models.moe per-file spellings ----
+_alias("incubate.distributed.models.moe.moe_layer",
+       "incubate.distributed.models.moe",
+       "reference incubate/distributed/models/moe/moe_layer.py",
+       names={"MoELayer"})
+_alias("incubate.distributed.models.moe.utils",
+       "distributed.models.moe",
+       "reference incubate/distributed/models/moe/utils.py")
+_alias("incubate.distributed.models.moe.gate",
+       "incubate.distributed.models.moe",
+       "reference incubate/distributed/models/moe/gate/__init__.py",
+       names={"BaseGate", "NaiveGate", "GShardGate", "SwitchGate"})
+for _leaf, _cls in (("base_gate", "BaseGate"), ("naive_gate", "NaiveGate"),
+                    ("gshard_gate", "GShardGate"),
+                    ("switch_gate", "SwitchGate")):
+    _alias(f"incubate.distributed.models.moe.gate.{_leaf}",
+           "incubate.distributed.models.moe",
+           f"reference incubate/distributed/models/moe/gate/{_leaf}.py",
+           names={_cls})
+
 # ---- misc single-file spellings ----
 _alias("cost_model.cost_model", "cost_model",
        "reference cost_model/cost_model.py")

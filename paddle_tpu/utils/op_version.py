@@ -26,9 +26,7 @@ class OpUpdateInfoHelper:
         self._info = info
 
     def verify_key_value(self, name=""):
-        return name in getattr(self._info, "keys", lambda: [])() \
-            if callable(getattr(self._info, "keys", None)) \
-            else name in (self._info or {})
+        return name in (self._info or {})
 
 
 @Singleton

@@ -66,6 +66,19 @@ def test_reference_path_resolves(mod, attr):
     ("paddle_tpu.dataset.image", "simple_transform"),
     ("paddle_tpu.geometric.message_passing.send_recv", None),
     ("paddle_tpu.cost_model.cost_model", None),
+    ("paddle_tpu.incubate.sparse.nn.functional.pooling", "max_pool3d"),
+    ("paddle_tpu.incubate.sparse.nn.functional.conv", "conv3d"),
+    ("paddle_tpu.incubate.sparse.nn.layer.conv", "Conv3D"),
+    ("paddle_tpu.incubate.sparse.nn.layer.norm", "BatchNorm"),
+    ("paddle_tpu.incubate.autograd.primapi", "forward_grad"),
+    ("paddle_tpu.incubate.autograd.functional", "Hessian"),
+    ("paddle_tpu.incubate.optimizer.functional.bfgs", "minimize_bfgs"),
+    ("paddle_tpu.incubate.optimizer.functional.lbfgs", "minimize_lbfgs"),
+    ("paddle_tpu.incubate.distributed.models.moe.moe_layer", "MoELayer"),
+    ("paddle_tpu.incubate.distributed.models.moe.gate.gshard_gate",
+     "GShardGate"),
+    ("paddle_tpu.incubate.distributed.models.moe",
+     "ClipGradForMOEByGlobalNorm"),
 ])
 def test_top_level_alias_resolves(mod, attr):
     m = importlib.import_module(mod)
