@@ -436,6 +436,47 @@ def bench_kernels(backend):
     return out
 
 
+def bench_flash_blocks(backend):
+    """Sweep flash-attention block sizes at the headline shapes
+    ([4, 2048, 16, 128] bf16, causal, fwd+bwd) and report ms per config.
+    If a tiling beats the 256x512 default, pin it via
+    PADDLE_TPU_FLASH_BLOCK_Q/K in the headline."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    if backend != "tpu":
+        return {"skipped": "tpu only"}
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((4, 2048, 16, 128)),
+                    dtype=jnp.bfloat16)
+
+    out = {}
+    best = None
+    for bq, bk in ((256, 512), (512, 512), (256, 1024), (512, 1024),
+                   (1024, 512), (512, 256)):
+        def loss(q, bq=bq, bk=bk):
+            return flash_attention(q, q, q, causal=True, block_q=bq,
+                                   block_k=bk).astype(jnp.float32).sum()
+
+        try:
+            f = jax.jit(jax.value_and_grad(loss))
+            _sync(f(q)[0])  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(10):
+                v, g = f(q)
+            _sync(v)
+            ms = (time.perf_counter() - t0) / 10 * 1e3
+            out[f"{bq}x{bk}"] = round(ms, 2)
+            if best is None or ms < best[1]:
+                best = (f"{bq}x{bk}", ms)
+        except Exception as e:
+            out[f"{bq}x{bk}"] = f"FAIL: {type(e).__name__}: {str(e)[:80]}"
+    if best:
+        out["best"] = best[0]
+    return out
+
+
 def bench_llama_fused_ce(backend):
     """A/B the chunked fused vocab-projection CE against the headline
     (which uses PADDLE_TPU_BENCH_FUSED_CE). Same model/shapes as the
@@ -731,7 +772,8 @@ def main():
                          ("llama_fused_ce_ab", bench_llama_fused_ce),
                          ("llama_b8_selective_remat",
                           bench_llama_b8_selective),
-                         ("ctr_widedeep", bench_ctr_widedeep)):
+                         ("ctr_widedeep", bench_ctr_widedeep),
+                         ("flash_blocks", bench_flash_blocks)):
             if only and name not in only:
                 # marker (not omission) so the artifact fill-loop below
                 # replays the last session value for untouched configs

@@ -180,6 +180,10 @@ _alias("incubate.distributed.models.moe.moe_layer",
 _alias("incubate.distributed.models.moe.utils",
        "distributed.models.moe",
        "reference incubate/distributed/models/moe/utils.py")
+_alias("incubate.distributed.models.moe.grad_clip",
+       "incubate.distributed.models.moe",
+       "reference incubate/distributed/models/moe/grad_clip.py",
+       names={"ClipGradForMOEByGlobalNorm"})
 _alias("incubate.distributed.models.moe.gate",
        "incubate.distributed.models.moe",
        "reference incubate/distributed/models/moe/gate/__init__.py",

@@ -24,7 +24,7 @@ SESSION = os.path.join(ROOT, "BENCH_SESSION.json")
 CONFIGS = ["kernels", "bert_base_dp", "vit_b16", "ernie_moe_ep",
            "llama_seq8192", "int8_matmul", "llama_decode",
            "llama_fused_ce_ab", "llama_b8_selective_remat", "ctr_widedeep",
-           "resnet50"]
+           "flash_blocks", "resnet50"]
 
 
 def _session():
