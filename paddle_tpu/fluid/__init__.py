@@ -122,3 +122,16 @@ __all__ = [
     'is_compiled_with_xpu', 'get_flags', 'set_flags', 'BuildStrategy',
     'ExecutionStrategy',
 ]
+
+# 1.x feeding / helper surface (real files; imported so the attribute is
+# the function/class, reference-style)
+from .data import data  # noqa: E402,F401
+from .average import WeightedAverage  # noqa: E402,F401
+from .lod_tensor import (  # noqa: E402,F401
+    create_lod_tensor, create_random_int_lodtensor,
+)
+from .layer_helper import LayerHelper  # noqa: E402,F401
+from . import reader  # noqa: E402,F401
+
+__all__ += ["data", "WeightedAverage", "create_lod_tensor",
+            "create_random_int_lodtensor", "LayerHelper", "reader"]

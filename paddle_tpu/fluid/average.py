@@ -1,0 +1,41 @@
+"""Reference: python/paddle/fluid/average.py — WeightedAverage, the 1.x
+host-side running average used around training loops."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+def _is_number_or_matrix(var):
+    return isinstance(var, (int, float, complex, np.number, np.ndarray)) \
+        and not isinstance(var, bool)
+
+
+class WeightedAverage:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = None
+        self.denominator = None
+
+    def add(self, value, weight):
+        if not _is_number_or_matrix(value):
+            raise ValueError(
+                "The 'value' must be a number or a numpy ndarray.")
+        if not _is_number_or_matrix(weight):
+            raise ValueError(
+                "The 'weight' must be a number or a numpy ndarray.")
+        if self.numerator is None or self.denominator is None:
+            self.numerator = value * weight
+            self.denominator = weight
+        else:
+            self.numerator += value * weight
+            self.denominator += weight
+
+    def eval(self):
+        if self.numerator is None or self.denominator is None:
+            raise ValueError(
+                "There is no data to be averaged in WeightedAverage.")
+        return self.numerator / self.denominator

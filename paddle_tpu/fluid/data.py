@@ -1,0 +1,7 @@
+"""Reference: python/paddle/fluid/data.py — `fluid.data(name, shape,
+dtype)` feed placeholder (no implicit batch dim, unlike
+fluid.layers.data). Backed by the record/replay executor's placeholder
+(static/program.py::data)."""
+from ..static.program import data
+
+__all__ = ["data"]

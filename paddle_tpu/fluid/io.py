@@ -90,3 +90,5 @@ def load_inference_model(dirname, executor, model_filename=None,
     call, feed_names, n_fetch = _lim(prefix, executor)
     prog = _LoadedInferenceProgram(call, feed_names, n_fetch)
     return prog, feed_names, prog.fetch_targets
+
+from .reader import PyReader  # noqa: E402,F401 (1.x feeding API)

@@ -1,0 +1,110 @@
+"""Reference: python/paddle/fluid/layer_helper.py — the helper custom
+1.x layers are written against (create_parameter / activation / bias
+plumbing over the current program).
+
+The reference LayerHelper appends ops to the static graph; here ops
+execute eagerly (and are captured by the record/replay executor when a
+program is being built), so the helper's surface reduces to parameter
+creation, dtype bookkeeping, and act/bias application — the parts user
+layer code actually calls.
+"""
+from __future__ import annotations
+
+from ..tensor import Tensor
+
+__all__ = ["LayerHelper", "LayerHelperBase"]
+
+
+class LayerHelperBase:
+    def __init__(self, name=None, layer_type=""):
+        self._name = name
+        self._layer_type = layer_type
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def layer_type(self):
+        return self._layer_type
+
+    def create_parameter(self, attr=None, shape=None, dtype="float32",
+                         is_bias=False, default_initializer=None,
+                         stop_gradient=False):
+        from ..static.program import create_parameter as _cp
+        from ..utils import unique_name
+
+        name = getattr(attr, "name", None) if attr is not None else None
+        name = name or unique_name.generate(
+            f"{self._layer_type or 'layer'}_{'b' if is_bias else 'w'}")
+        p = _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+                default_initializer=default_initializer)
+        p.stop_gradient = stop_gradient
+        return p
+
+    def create_variable_for_type_inference(self, dtype, stop_gradient=False):
+        import jax.numpy as jnp
+
+        from ..framework.dtype import convert_dtype
+
+        t = Tensor(jnp.zeros((), dtype=convert_dtype(dtype)),
+                   stop_gradient=stop_gradient)
+        return t
+
+    def to_variable(self, value, name=None):
+        import jax.numpy as jnp
+        import numpy as np
+
+        return Tensor(jnp.asarray(np.asarray(value)), name=name)
+
+
+class LayerHelper(LayerHelperBase):
+    def __init__(self, layer_type, **kwargs):
+        super().__init__(name=kwargs.get("name"), layer_type=layer_type)
+        self.kwargs = kwargs
+
+    @property
+    def param_attr(self):
+        return self.kwargs.get("param_attr")
+
+    @property
+    def bias_attr(self):
+        return self.kwargs.get("bias_attr")
+
+    def multiple_input(self, input_param_name="input"):
+        inputs = self.kwargs.get(input_param_name, [])
+        if isinstance(inputs, (list, tuple)):
+            return list(inputs)
+        return [inputs]
+
+    def input(self, input_param_name="input"):
+        inputs = self.multiple_input(input_param_name)
+        if len(inputs) != 1:
+            raise ValueError(
+                f"{self.layer_type} layer needs exactly one input")
+        return inputs[0]
+
+    def input_dtype(self, input_param_name="input"):
+        return str(self.input(input_param_name).dtype)
+
+    def append_bias_op(self, input_var, dim_start=1, dim_end=None):
+        bias_attr = self.bias_attr
+        if bias_attr is False:
+            return input_var
+        size = list(input_var.shape)[dim_start:dim_end]
+        b = self.create_parameter(attr=bias_attr, shape=size,
+                                  dtype=str(input_var.dtype), is_bias=True)
+        return input_var + b
+
+    def append_activation(self, input_var):
+        act = self.kwargs.get("act")
+        if act is None:
+            return input_var
+        if isinstance(act, dict):
+            act = act.get("type")
+        from ..nn import functional as F
+
+        fn = getattr(F, act, None)
+        if fn is None:
+            raise ValueError(f"unknown activation {act!r}")
+        return fn(input_var)

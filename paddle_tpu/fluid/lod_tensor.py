@@ -1,0 +1,79 @@
+"""Reference: python/paddle/fluid/lod_tensor.py (create_lod_tensor,
+create_random_int_lodtensor).
+
+LoD (level-of-detail) variable-length machinery is deliberately replaced
+in this framework by padded-dense + masks (see fluid/layers/tail.py) —
+TPU/XLA wants static shapes. These constructors therefore build the
+padded-dense carrier: a Tensor whose rows are the concatenated sequence
+data, plus `recursive_sequence_lengths()` metadata preserved on the
+object, which is exactly the information a LoDTensor carried.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["LoDTensor", "create_lod_tensor", "create_random_int_lodtensor"]
+
+
+class LoDTensor(Tensor):
+    """Tensor carrying sequence-length metadata (the padded-dense stand-in
+    for the reference's LoDTensor)."""
+
+    __slots__ = ("_recursive_sequence_lengths",)
+
+    def recursive_sequence_lengths(self):
+        return self._recursive_sequence_lengths
+
+    def lod(self):
+        # offsets form: [[0, l0, l0+l1, ...]] per level
+        out = []
+        for level in self._recursive_sequence_lengths:
+            offs = [0]
+            for n in level:
+                offs.append(offs[-1] + n)
+            out.append(offs)
+        return out
+
+    def has_valid_recursive_sequence_lengths(self):
+        lengths = self._recursive_sequence_lengths
+        total = sum(lengths[-1]) if lengths else self.shape[0]
+        return total == self.shape[0]
+
+
+def _lod_to_lengths(recursive_seq_lens):
+    if not recursive_seq_lens:
+        return []
+    return [list(map(int, level)) for level in recursive_seq_lens]
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """Rows of `data` are the concatenated sequences; lengths metadata is
+    kept on the returned Tensor (reference lod_tensor.py:28)."""
+    if isinstance(data, Tensor):
+        arr = np.asarray(data._data)
+    elif isinstance(data, list):
+        # list-of-lists form: each sublist one sequence; flatten
+        flat = [np.asarray(x).reshape(-1, 1) for x in data]
+        arr = np.concatenate(flat, axis=0)
+        recursive_seq_lens = [[len(np.asarray(x).reshape(-1)) for x in data]]
+    else:
+        arr = np.asarray(data)
+    lengths = _lod_to_lengths(recursive_seq_lens)
+    total = sum(lengths[-1]) if lengths else arr.shape[0]
+    if arr.shape[0] != total:
+        raise ValueError(
+            f"sum of sequence lengths {total} != rows {arr.shape[0]}")
+    t = LoDTensor(arr)
+    t._recursive_sequence_lengths = lengths
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=1):
+    lengths = _lod_to_lengths(recursive_seq_lens)
+    total = sum(lengths[-1])
+    shape = (total,) + tuple(base_shape)
+    data = np.random.randint(low, high + 1, size=shape).astype("int64")
+    return create_lod_tensor(data, recursive_seq_lens, place)

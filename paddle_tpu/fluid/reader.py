@@ -1,0 +1,126 @@
+"""Reference: python/paddle/fluid/reader.py — the 1.x data feeding API:
+``fluid.io.DataLoader.from_generator(...)`` and ``PyReader``.
+
+The reference pushes batches through a C++ queue into the executor. Here
+feeding is host-side (the compiled step takes arrays directly), so
+from_generator builds an iterable that adapts the user's generator into
+feed dicts / Tensor tuples; `capacity` maps onto the C++ prefetch ring
+in io/dataloader.py when a Dataset-backed path is used.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["DataLoader", "PyReader"]
+
+
+def _to_array(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x)
+
+
+class _GeneratorLoader:
+    """Iterable over a sample/batch generator, yielding feed dicts keyed
+    by the feed_list names (static workflow) or plain tuples."""
+
+    def __init__(self, feed_list=None, capacity=None, iterable=True,
+                 return_list=False):
+        self._feed_list = feed_list or []
+        self._names = [getattr(v, "name", None) or f"x{i}"
+                       for i, v in enumerate(self._feed_list)]
+        self._return_list = return_list or not self._feed_list
+        self._gen = None
+        self._batched = True
+
+    # -- reference decoration API --------------------------------------
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        def batched():
+            buf = []
+            for sample in reader():
+                if not isinstance(sample, (tuple, list)):
+                    sample = (sample,)
+                buf.append(sample)
+                if len(buf) == batch_size:
+                    yield tuple(np.stack([_to_array(s[i]) for s in buf])
+                                for i in range(len(buf[0])))
+                    buf = []
+            if buf and not drop_last:
+                yield tuple(np.stack([_to_array(s[i]) for s in buf])
+                            for i in range(len(buf[0])))
+
+        self._gen = batched
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        def batched():
+            for batch in reader():
+                yield tuple(np.stack([_to_array(s[i]) for s in batch])
+                            for i in range(len(batch[0])))
+
+        self._gen = batched
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        self._gen = reader
+        return self
+
+    # -- iteration ------------------------------------------------------
+    def __iter__(self):
+        if self._gen is None:
+            raise RuntimeError(
+                "no generator set: call set_sample_generator / "
+                "set_sample_list_generator / set_batch_generator first")
+        for batch in self._gen():
+            if not isinstance(batch, (tuple, list)):
+                batch = (batch,)
+            if self._return_list:
+                yield [Tensor(_to_array(b)) for b in batch]
+            else:
+                yield {name: Tensor(_to_array(b))
+                       for name, b in zip(self._names, batch)}
+
+    # reference's non-iterable start/reset protocol degenerates: feeding
+    # is host-side, nothing to start
+    def start(self):
+        return None
+
+    def reset(self):
+        return None
+
+
+class DataLoader:
+    """Namespace mirroring fluid.reader.DataLoader's constructors."""
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=16, use_double_buffer=True,
+                       iterable=True, return_list=False,
+                       use_multiprocess=False, drop_last=True):
+        return _GeneratorLoader(feed_list, capacity, iterable, return_list)
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        from ..io import DataLoader as _IoLoader
+
+        return _IoLoader(dataset, batch_size=None, drop_last=drop_last)
+
+
+class PyReader(_GeneratorLoader):
+    """Reference fluid/reader.py::PyReader — same decoration surface;
+    decorate_* spellings alias the set_* methods."""
+
+    def __init__(self, feed_list=None, capacity=16, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        super().__init__(feed_list, capacity, iterable, return_list)
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        return self.set_sample_generator(sample_generator, batch_size,
+                                         drop_last, places)
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        return self.set_sample_list_generator(reader, places)
+
+    def decorate_batch_generator(self, reader, places=None):
+        return self.set_batch_generator(reader, places)

@@ -1,0 +1,27 @@
+"""Reference: python/paddle/fluid/wrapped_decorator.py — decorator
+helpers imported by ecosystem libraries (`wrap_decorator`,
+`signature_safe_contextmanager`)."""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["wrap_decorator", "signature_safe_contextmanager"]
+
+
+def wrap_decorator(decorator_func):
+    """Return a decorator that preserves the wrapped function's
+    signature (the reference uses the `decorator` package; functools
+    keeps __wrapped__ which is enough for inspect.signature)."""
+    import functools
+
+    @functools.wraps(decorator_func)
+    def __impl__(func):
+        decorated = decorator_func(func)
+        functools.update_wrapper(decorated, func)
+        return decorated
+
+    return __impl__
+
+
+def signature_safe_contextmanager(func):
+    return contextlib.contextmanager(func)

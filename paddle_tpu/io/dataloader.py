@@ -111,6 +111,26 @@ def default_collate_fn(batch):
 
 
 class DataLoader:
+    @staticmethod
+    def from_generator(feed_list=None, capacity=16, use_double_buffer=True,
+                       iterable=True, return_list=False,
+                       use_multiprocess=False, drop_last=True):
+        """1.x generator-feeding constructor (reference fluid/reader.py
+        DataLoader.from_generator, kept on paddle.io.DataLoader for
+        compat). Returns an iterable adapting set_*_generator feeds."""
+        from ..fluid.reader import DataLoader as _FluidLoader
+
+        return _FluidLoader.from_generator(feed_list, capacity,
+                                           use_double_buffer, iterable,
+                                           return_list, use_multiprocess,
+                                           drop_last)
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        from ..fluid.reader import DataLoader as _FluidLoader
+
+        return _FluidLoader.from_dataset(dataset, places, drop_last)
+
     def __init__(self, dataset: Dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,

@@ -1,0 +1,134 @@
+"""1.x feeding/helper surface: fluid.data, DataLoader.from_generator,
+PyReader, WeightedAverage, LoDTensor carrier, LayerHelper,
+wrapped_decorator, log_helper (reference python/paddle/fluid/{data,
+reader,average,lod_tensor,layer_helper,wrapped_decorator,log_helper}.py).
+"""
+import logging
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.layers as layers
+
+
+def test_fluid_data_placeholder_replay():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[None, 4], dtype="float32")
+        y = layers.fc(x, size=3)
+    exe = fluid.Executor()
+    out = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                  fetch_list=[y])
+    assert out[0].shape == (2, 3)
+
+
+def test_dataloader_from_generator_sample():
+    from paddle_tpu.io import DataLoader
+
+    loader = DataLoader.from_generator(capacity=4, return_list=True)
+    loader.set_sample_generator(
+        lambda: iter([(np.full(3, i, np.float32),) for i in range(5)]),
+        batch_size=2)
+    batches = list(loader)
+    assert len(batches) == 2  # drop_last
+    assert batches[0][0].shape == [2, 3]
+    np.testing.assert_allclose(batches[0][0].numpy()[1], 1.0)
+
+
+def test_dataloader_from_generator_feed_dict():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[None, 3], dtype="float32")
+    loader = fluid.reader.DataLoader.from_generator(feed_list=[x])
+    loader.set_batch_generator(
+        lambda: iter([(np.ones((2, 3), np.float32),)]))
+    feeds = list(loader)
+    assert set(feeds[0].keys()) == {"x"}
+    assert feeds[0]["x"].shape == [2, 3]
+
+
+def test_pyreader_decorate_spellings():
+    from paddle_tpu.fluid.io import PyReader
+
+    r = PyReader(return_list=True)
+    r.decorate_sample_list_generator(
+        lambda: iter([[(np.zeros(2),), (np.ones(2),)]]))
+    (batch,) = list(r)
+    assert batch[0].shape == [2, 2]
+    r2 = PyReader(return_list=True)
+    r2.decorate_batch_generator(lambda: iter([(np.zeros((4, 2)),)]))
+    assert list(r2)[0][0].shape == [4, 2]
+    r2.start()
+    r2.reset()
+
+
+def test_weighted_average():
+    wa = fluid.WeightedAverage()
+    wa.add(2.0, 1)
+    wa.add(4.0, 3)
+    assert abs(wa.eval() - 3.5) < 1e-12
+    wa.reset()
+    with pytest.raises(ValueError):
+        wa.eval()
+    with pytest.raises(ValueError):
+        wa.add("x", 1)
+
+
+def test_lod_tensor_carrier():
+    t = fluid.create_lod_tensor(np.arange(6).reshape(6, 1), [[2, 4]])
+    assert t.recursive_sequence_lengths() == [[2, 4]]
+    assert t.lod() == [[0, 2, 6]]
+    assert t.has_valid_recursive_sequence_lengths()
+    # list-of-sequences form infers lengths
+    t2 = fluid.create_lod_tensor([[1, 2], [3, 4, 5]], None)
+    assert t2.recursive_sequence_lengths() == [[2, 3]]
+    with pytest.raises(ValueError):
+        fluid.create_lod_tensor(np.zeros((5, 1)), [[2, 4]])
+    r = fluid.create_random_int_lodtensor([[2, 3]], [4], low=0, high=9)
+    assert tuple(r.shape) == (5, 4)
+    assert int(np.asarray(r._data).max()) <= 9
+
+
+def test_layer_helper_custom_layer_pattern():
+    from paddle_tpu.fluid.layer_helper import LayerHelper
+
+    paddle.seed(0)
+    inp = paddle.to_tensor(np.ones((2, 4), np.float32))
+    helper = LayerHelper("my_op", input=inp, act="relu")
+    w = helper.create_parameter(shape=[4, 3], dtype="float32")
+    out = helper.append_activation(helper.append_bias_op(inp.matmul(w)))
+    assert out.shape == [2, 3]
+    assert float(out.numpy().min()) >= 0.0  # relu applied
+    assert helper.input("input") is inp
+    assert helper.input_dtype() == "float32"
+    # bias_attr=False skips the bias
+    h2 = LayerHelper("no_bias", input=inp, bias_attr=False)
+    assert h2.append_bias_op(inp) is inp
+
+
+def test_wrapped_decorator_and_log_helper():
+    from paddle_tpu.fluid.log_helper import get_logger
+    from paddle_tpu.fluid.wrapped_decorator import (
+        signature_safe_contextmanager, wrap_decorator)
+
+    @signature_safe_contextmanager
+    def ctx():
+        yield 5
+
+    with ctx() as v:
+        assert v == 5
+
+    def deco(fn):
+        def inner(*a, **k):
+            return fn(*a, **k) + 1
+        return inner
+
+    @wrap_decorator(deco)
+    def f(x):
+        return x
+
+    assert f(1) == 2
+    lg = get_logger("paddle_tpu_test_logger", logging.INFO)
+    assert get_logger("paddle_tpu_test_logger", logging.INFO) is lg

@@ -49,6 +49,8 @@ def missing():
         v = sec.get(name)
         if not isinstance(v, dict) or "error" in v or "skipped" in v:
             out.append(name)
+        elif name == "flash_blocks" and "best" not in v:
+            out.append(name)  # every block config FAILed — not a result
     if not s.get("tokens_per_sec"):
         out.insert(0, "headline")
     return out
