@@ -29,7 +29,9 @@ class WeightedAverage:
                 "The 'weight' must be a number or a numpy ndarray.")
         if self.numerator is None or self.denominator is None:
             self.numerator = value * weight
-            self.denominator = weight
+            # copy: += below must not mutate the caller's array in place
+            self.denominator = np.array(weight) \
+                if isinstance(weight, np.ndarray) else weight
         else:
             self.numerator += value * weight
             self.denominator += weight

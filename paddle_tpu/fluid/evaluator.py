@@ -1,0 +1,8 @@
+"""Reference: python/paddle/fluid/evaluator.py — the pre-metrics
+Evaluator spellings; delegates to fluid.metrics implementations."""
+from .metrics import (Accuracy, ChunkEvaluator,  # noqa: F401
+                      EditDistance)
+
+Evaluator = object  # base marker (reference evaluator.py Evaluator)
+
+__all__ = ["Accuracy", "ChunkEvaluator", "EditDistance", "Evaluator"]

@@ -1,0 +1,6 @@
+"""Reference spelling: python/paddle/fluid/install_check.py (run_check).
+Implementation in utils/__init__.py (tiny matmul on the default backend
++ sharded matmul when multiple devices are visible)."""
+from ..utils import run_check
+
+__all__ = ["run_check"]

@@ -1,0 +1,4 @@
+"""Reference spelling: python/paddle/fluid/layer_helper_base.py."""
+from .layer_helper import LayerHelperBase
+
+__all__ = ["LayerHelperBase"]

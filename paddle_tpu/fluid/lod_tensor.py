@@ -57,7 +57,13 @@ def create_lod_tensor(data, recursive_seq_lens, place=None):
         # list-of-lists form: each sublist one sequence; flatten
         flat = [np.asarray(x).reshape(-1, 1) for x in data]
         arr = np.concatenate(flat, axis=0)
-        recursive_seq_lens = [[len(np.asarray(x).reshape(-1)) for x in data]]
+        inferred = [[len(np.asarray(x).reshape(-1)) for x in data]]
+        if recursive_seq_lens and \
+                _lod_to_lengths(recursive_seq_lens)[-1] != inferred[-1]:
+            raise ValueError(
+                f"recursive_seq_lens {recursive_seq_lens} does not match "
+                f"the sequence lengths {inferred} of the data list")
+        recursive_seq_lens = recursive_seq_lens or inferred
     else:
         arr = np.asarray(data)
     lengths = _lod_to_lengths(recursive_seq_lens)

@@ -5,6 +5,10 @@ import numpy as np
 from ..metric import Accuracy as _Acc, Auc as _Auc  # noqa: F401
 
 
+def _to_np(x):
+    return np.asarray(x._data if hasattr(x, "_data") else x)
+
+
 class MetricBase:
     def __init__(self, name=None):
         self._name = name
@@ -52,3 +56,138 @@ class CompositeMetric(MetricBase):
 
     def eval(self):
         return [m.eval() for m in self._metrics]
+
+
+class Precision(MetricBase):
+    """Binary precision over 0/1 predictions (reference metrics.py)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0.0
+        self.fp = 0.0
+
+    def update(self, preds, labels):
+        preds = np.rint(_to_np(preds)).reshape(-1)
+        labels = _to_np(labels).reshape(-1)
+        self.tp += float(np.sum((preds == 1) & (labels == 1)))
+        self.fp += float(np.sum((preds == 1) & (labels == 0)))
+
+    def eval(self):
+        ap = self.tp + self.fp
+        return self.tp / ap if ap != 0 else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0.0
+        self.fn = 0.0
+
+    def update(self, preds, labels):
+        preds = np.rint(_to_np(preds)).reshape(-1)
+        labels = _to_np(labels).reshape(-1)
+        self.tp += float(np.sum((preds == 1) & (labels == 1)))
+        self.fn += float(np.sum((preds == 0) & (labels == 1)))
+
+    def eval(self):
+        rel = self.tp + self.fn
+        return self.tp / rel if rel != 0 else 0.0
+
+
+class ChunkEvaluator(MetricBase):
+    """Chunk-level precision/recall/F1 for sequence labeling (reference
+    metrics.py ChunkEvaluator, fed by chunk_eval-style counts)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        def s(x):
+            return int(np.sum(_to_np(x)))
+
+        self.num_infer_chunks += s(num_infer_chunks)
+        self.num_label_chunks += s(num_label_chunks)
+        self.num_correct_chunks += s(num_correct_chunks)
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+
+def _levenshtein(a, b):
+    """Edit distance between two token sequences (numpy DP rows)."""
+    la, lb = len(a), len(b)
+    if la == 0:
+        return lb
+    if lb == 0:
+        return la
+    prev = list(range(lb + 1))
+    for i in range(1, la + 1):
+        cur = [i] + [0] * lb
+        for j in range(1, lb + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+        prev = cur
+    return int(prev[lb])
+
+
+class EditDistance(MetricBase):
+    """Average edit distance + instance error rate (reference
+    metrics.py EditDistance). update() accepts precomputed
+    (distances, seq_num) like the reference, or a (hypotheses,
+    references) pair of sequence lists scored with the built-in
+    Levenshtein (no C++ edit-distance op here)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num=None):
+        if seq_num is None:
+            if not (isinstance(distances, (tuple, list))
+                    and len(distances) == 2
+                    and not np.isscalar(distances[0])):
+                raise ValueError(
+                    "update() without seq_num expects a (hypotheses, "
+                    "references) pair of sequence lists; for precomputed "
+                    "distances pass update(distances, seq_num)")
+            hyps, refs = distances
+            dists = [_levenshtein(list(h), list(r))
+                     for h, r in zip(hyps, refs)]
+            distances = np.asarray(dists, np.float64)
+            seq_num = len(dists)
+        else:
+            distances = _to_np(distances).astype(np.float64).reshape(-1)
+            seq_num = int(_to_np(seq_num))
+        self.total_distance += float(np.sum(distances))
+        self.seq_num += seq_num
+        self.instance_error += int(np.sum(distances > 0))
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("There is no data in EditDistance Metric.")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / float(self.seq_num))

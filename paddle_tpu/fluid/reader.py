@@ -4,8 +4,10 @@
 The reference pushes batches through a C++ queue into the executor. Here
 feeding is host-side (the compiled step takes arrays directly), so
 from_generator builds an iterable that adapts the user's generator into
-feed dicts / Tensor tuples; `capacity` maps onto the C++ prefetch ring
-in io/dataloader.py when a Dataset-backed path is used.
+feed dicts / Tensor tuples. `capacity`/`use_double_buffer` are accepted
+for signature compatibility but inert: there is no device-side queue to
+fill, and the Dataset-backed path (from_dataset) does its prefetching
+inside io/dataloader.py.
 """
 from __future__ import annotations
 
@@ -25,17 +27,20 @@ class _GeneratorLoader:
     by the feed_list names (static workflow) or plain tuples."""
 
     def __init__(self, feed_list=None, capacity=None, iterable=True,
-                 return_list=False):
+                 return_list=False, drop_last=True):
         self._feed_list = feed_list or []
         self._names = [getattr(v, "name", None) or f"x{i}"
                        for i, v in enumerate(self._feed_list)]
         self._return_list = return_list or not self._feed_list
         self._gen = None
-        self._batched = True
+        self._drop_last = drop_last
 
     # -- reference decoration API --------------------------------------
-    def set_sample_generator(self, reader, batch_size, drop_last=True,
+    def set_sample_generator(self, reader, batch_size, drop_last=None,
                              places=None):
+        if drop_last is None:
+            drop_last = self._drop_last
+
         def batched():
             buf = []
             for sample in reader():
@@ -97,13 +102,19 @@ class DataLoader:
     def from_generator(feed_list=None, capacity=16, use_double_buffer=True,
                        iterable=True, return_list=False,
                        use_multiprocess=False, drop_last=True):
-        return _GeneratorLoader(feed_list, capacity, iterable, return_list)
+        return _GeneratorLoader(feed_list, capacity, iterable, return_list,
+                                drop_last)
 
     @staticmethod
     def from_dataset(dataset, places=None, drop_last=True):
         from ..io import DataLoader as _IoLoader
 
-        return _IoLoader(dataset, batch_size=None, drop_last=drop_last)
+        # fluid datasets carry their own batch size where set; plain
+        # map/iterable datasets batch one sample at a time like the
+        # reference's DatasetLoader default
+        batch_size = getattr(dataset, "batch_size", None) or 1
+        return _IoLoader(dataset, batch_size=batch_size,
+                         drop_last=drop_last)
 
 
 class PyReader(_GeneratorLoader):

@@ -108,6 +108,59 @@ def test_layer_helper_custom_layer_pattern():
     assert h2.append_bias_op(inp) is inp
 
 
+def test_fluid_metrics_chunk_evaluator():
+    from paddle_tpu.fluid.metrics import ChunkEvaluator
+
+    ce = ChunkEvaluator()
+    ce.update(10, 8, 6)
+    p, r, f1 = ce.eval()
+    assert abs(p - 0.6) < 1e-12 and abs(r - 0.75) < 1e-12
+    assert abs(f1 - 2 * p * r / (p + r)) < 1e-12
+    ce.reset()
+    assert ce.eval() == (0.0, 0.0, 0.0)
+
+
+def test_fluid_metrics_edit_distance():
+    from paddle_tpu.fluid.metrics import EditDistance, _levenshtein
+
+    assert _levenshtein("kitten", "sitting") == 3
+    assert _levenshtein("", "abc") == 3
+    assert _levenshtein("abc", "abc") == 0
+    ed = EditDistance()
+    ed.update((["kitten", "abc"], ["sitting", "abc"]))
+    avg, err = ed.eval()
+    assert avg == 1.5 and err == 0.5
+    # reference-style precomputed form
+    ed2 = EditDistance()
+    ed2.update(np.array([2.0, 0.0, 1.0]), 3)
+    avg2, err2 = ed2.eval()
+    assert avg2 == 1.0 and abs(err2 - 2 / 3) < 1e-12
+    with pytest.raises(ValueError):
+        EditDistance().eval()
+
+
+def test_fluid_metrics_precision_recall():
+    from paddle_tpu.fluid.metrics import Precision, Recall
+
+    preds = np.array([1, 1, 0, 1, 0])
+    labels = np.array([1, 0, 0, 1, 1])
+    p = Precision()
+    p.update(preds, labels)
+    assert abs(p.eval() - 2 / 3) < 1e-12
+    r = Recall()
+    r.update(preds, labels)
+    assert abs(r.eval() - 2 / 3) < 1e-12
+
+
+def test_fluid_evaluator_and_install_check_spellings():
+    from paddle_tpu.fluid.evaluator import ChunkEvaluator
+    from paddle_tpu.fluid.install_check import run_check
+    from paddle_tpu.fluid.layer_helper_base import LayerHelperBase
+
+    assert callable(run_check)
+    assert ChunkEvaluator is not None and LayerHelperBase is not None
+
+
 def test_wrapped_decorator_and_log_helper():
     from paddle_tpu.fluid.log_helper import get_logger
     from paddle_tpu.fluid.wrapped_decorator import (
