@@ -18,7 +18,7 @@ from ...nn import functional as _F
 __all__ = [
     # rnn / decode
     'RNNCell', 'SimpleRNNCell', 'GRUCell', 'LSTMCell', 'BiRNN', 'rnn',
-    'birnn', 'BeamSearchDecoder', 'dynamic_decode',
+    'birnn', 'BeamSearchDecoder', 'dynamic_decode', 'chunk_eval',
     # distributions
     'Normal', 'Uniform', 'Categorical', 'MultivariateNormalDiag',
     # detection
@@ -848,3 +848,111 @@ def sampled_softmax_with_cross_entropy(logits, label, num_samples,
         return -jax.nn.log_softmax(z, axis=-1)[:, :1]
 
     return apply(_ssce, logits, label, neg)
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """Chunk detection metrics for sequence labeling (host-side;
+    data-dependent, eval-only like edit_distance above).
+
+    Reference: fluid/layers/nn.py:1192 chunk_eval over the C++
+    ChunkEvalOp. Tags are encoded tag = chunk_type * num_tag_types +
+    tag_type with the scheme fixing num_tag_types (IOB: B,I / IOE: I,E /
+    IOBES: B,I,E,S / plain: single); any tag outside the encoded range
+    (conventionally the last id) is "outside". Chunk boundaries follow
+    conlleval semantics. Returns (precision, recall, f1, num_infer,
+    num_label, num_correct) as 0-d Tensors.
+    """
+    from ...tensor import Tensor
+
+    schemes = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}
+    if chunk_scheme not in schemes:
+        raise ValueError(f"unknown chunk_scheme {chunk_scheme!r}; "
+                         f"expected one of {sorted(schemes)}")
+    n_tag = schemes[chunk_scheme]
+    excluded = set(excluded_chunk_types or [])
+
+    def decode(t):
+        """tag id -> (chunk_type, tag_kind) or None for outside."""
+        t = int(t)
+        if t < 0 or t >= num_chunk_types * n_tag:
+            return None
+        return t // n_tag, t % n_tag
+
+    def extract(seq):
+        """conlleval chunk extraction -> set of (type, start, end)."""
+        chunks = []
+        start = None  # (type, begin_index) of the open chunk
+
+        def close(end):
+            if start is not None and start[0] not in excluded:
+                chunks.append((start[0], start[1], end))
+
+        for i, t in enumerate(list(seq) + [None]):  # sentinel flush
+            cur = decode(t) if t is not None else None
+
+            if chunk_scheme == "plain":
+                close(i - 1)
+                start = (cur[0], i) if cur is not None else None
+            elif chunk_scheme == "IOB":
+                # kind 0 = B, 1 = I
+                if cur is None or cur[1] == 0 or \
+                        (start is not None and cur[0] != start[0]):
+                    close(i - 1)
+                    start = None
+                if cur is not None and start is None:
+                    start = (cur[0], i)  # B, or lenient I after break
+            elif chunk_scheme == "IOE":
+                # kind 0 = I, 1 = E: E closes the chunk it belongs to
+                if cur is None or (start is not None and cur[0] != start[0]):
+                    close(i - 1)
+                    start = None
+                if cur is not None and start is None:
+                    start = (cur[0], i)
+                if cur is not None and cur[1] == 1:
+                    close(i)
+                    start = None
+            else:  # IOBES: 0=B 1=I 2=E 3=S
+                if cur is None or cur[1] in (0, 3) or \
+                        (start is not None and cur[0] != start[0]):
+                    close(i - 1)
+                    start = None
+                if cur is not None and start is None:
+                    start = (cur[0], i)
+                if cur is not None and cur[1] in (2, 3):
+                    close(i)
+                    start = None
+        return set(chunks)
+
+    inf = np.asarray(input._data if hasattr(input, "_data") else input)
+    lab = np.asarray(label._data if hasattr(label, "_data") else label)
+    if inf.ndim == 1:
+        inf, lab = inf[None, :], lab[None, :]
+    if inf.ndim == 3:  # [B, T, 1] form
+        inf, lab = inf[..., 0], lab[..., 0]
+    lens = (np.asarray(seq_length._data if hasattr(seq_length, "_data")
+                       else seq_length).reshape(-1)
+            if seq_length is not None else [inf.shape[1]] * inf.shape[0])
+
+    num_infer = num_label = num_correct = 0
+    for b in range(inf.shape[0]):
+        L = int(lens[b])
+        ic = extract(inf[b, :L])
+        lc = extract(lab[b, :L])
+        num_infer += len(ic)
+        num_label += len(lc)
+        num_correct += len(ic & lc)
+
+    import jax.numpy as jnp
+
+    precision = num_correct / num_infer if num_infer else 0.0
+    recall = num_correct / num_label if num_label else 0.0
+    f1 = (2 * precision * recall / (precision + recall)
+          if num_correct else 0.0)
+
+    def mk(v, dt):
+        return Tensor(jnp.asarray(v, dtype=dt))
+
+    return (mk(precision, jnp.float32), mk(recall, jnp.float32),
+            mk(f1, jnp.float32), mk(num_infer, jnp.int32),
+            mk(num_label, jnp.int32), mk(num_correct, jnp.int32))

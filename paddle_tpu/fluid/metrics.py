@@ -175,6 +175,10 @@ class EditDistance(MetricBase):
                     "references) pair of sequence lists; for precomputed "
                     "distances pass update(distances, seq_num)")
             hyps, refs = distances
+            if len(hyps) != len(refs):
+                raise ValueError(
+                    f"hypotheses ({len(hyps)}) and references "
+                    f"({len(refs)}) must have the same length")
             dists = [_levenshtein(list(h), list(r))
                      for h, r in zip(hyps, refs)]
             distances = np.asarray(dists, np.float64)

@@ -109,10 +109,12 @@ class DataLoader:
     def from_dataset(dataset, places=None, drop_last=True):
         from ..io import DataLoader as _IoLoader
 
-        # fluid datasets carry their own batch size where set; plain
+        # fluid datasets carry their own batch size where set (stored as
+        # _batch_size by InMemoryDataset.init/set_batch_size); plain
         # map/iterable datasets batch one sample at a time like the
         # reference's DatasetLoader default
-        batch_size = getattr(dataset, "batch_size", None) or 1
+        batch_size = (getattr(dataset, "batch_size", None)
+                      or getattr(dataset, "_batch_size", None) or 1)
         return _IoLoader(dataset, batch_size=batch_size,
                          drop_last=drop_last)
 

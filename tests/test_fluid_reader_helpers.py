@@ -139,6 +139,52 @@ def test_fluid_metrics_edit_distance():
         EditDistance().eval()
 
 
+def test_chunk_eval_iob():
+    # tags: B-0=0 I-0=1 B-1=2 I-1=3 O=4
+    label = np.array([[0, 1, 4, 2, 3, 4]])     # chunks (0,0,1) (1,3,4)
+    infer = np.array([[0, 1, 4, 2, 4, 4]])     # chunks (0,0,1) (1,3,3)
+    p, r, f1, ni, nl, nc = layers.chunk_eval(infer, label, "IOB", 2)
+    assert (int(ni.numpy()), int(nl.numpy()), int(nc.numpy())) == (2, 2, 1)
+    assert abs(float(p.numpy()) - 0.5) < 1e-6
+    assert abs(float(r.numpy()) - 0.5) < 1e-6
+    assert abs(float(f1.numpy()) - 0.5) < 1e-6
+
+
+def test_chunk_eval_iobes_plain_and_options():
+    # IOBES (1 type): B=0 I=1 E=2 S=3, O=4
+    p, r, f1, ni, nl, nc = layers.chunk_eval(
+        np.array([[0, 1, 2, 4, 4]]), np.array([[0, 1, 2, 4, 3]]),
+        "IOBES", 1)
+    assert (int(ni.numpy()), int(nl.numpy()), int(nc.numpy())) == (1, 2, 1)
+    # plain: every in-range tag is a one-token chunk
+    p, r, f1, ni, nl, nc = layers.chunk_eval(
+        np.array([[0, 0, 0]]), np.array([[0, 1, 0]]), "plain", 2)
+    assert (int(ni.numpy()), int(nl.numpy()), int(nc.numpy())) == (3, 3, 2)
+    # seq_length masks the tail; perfect match on the visible prefix
+    p, r, f1, ni, nl, nc = layers.chunk_eval(
+        np.array([[0, 1, 4, 0, 1, 1]]), np.array([[0, 1, 4, 0, 1, 4]]),
+        "IOB", 2, seq_length=np.array([5]))
+    assert float(f1.numpy()) == 1.0
+    # excluded chunk types don't count
+    p, r, f1, ni, nl, nc = layers.chunk_eval(
+        np.array([[0, 1, 2, 3]]), np.array([[0, 1, 2, 3]]), "IOB", 2,
+        excluded_chunk_types=[1])
+    assert (int(ni.numpy()), int(nl.numpy()), int(nc.numpy())) == (1, 1, 1)
+    with pytest.raises(ValueError):
+        layers.chunk_eval(np.array([[0]]), np.array([[0]]), "XYZ", 1)
+
+
+def test_chunk_eval_feeds_chunk_evaluator():
+    from paddle_tpu.fluid.metrics import ChunkEvaluator
+
+    ce = ChunkEvaluator()
+    _, _, _, ni, nl, nc = layers.chunk_eval(
+        np.array([[0, 1, 4, 2, 3, 4]]), np.array([[0, 1, 4, 2, 3, 4]]),
+        "IOB", 2)
+    ce.update(ni, nl, nc)
+    assert ce.eval() == (1.0, 1.0, 1.0)
+
+
 def test_fluid_metrics_precision_recall():
     from paddle_tpu.fluid.metrics import Precision, Recall
 
