@@ -12,7 +12,7 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["CTRSchema", "iter_ctr_batches", "synthetic_ctr_lines",
-           "CriteoLineParser"]
+           "CriteoLineParser", "parse_criteo_batch"]
 
 
 class CTRSchema:
@@ -41,11 +41,14 @@ class CTRSchema:
                 elif name == self.dense_slot:
                     dense[b, :len(values)] = np.asarray(values, np.float32)
                 elif name in slot_pos:
-                    vals = np.asarray(values, np.int64)[:L]
+                    vals = list(values)[:L]
                     if self.vocab_size:
-                        # hash into 1..V-1; 0 stays the padding id
-                        vals = vals % (self.vocab_size - 1) + 1
-                    ids[b, slot_pos[name], :len(vals)] = vals.astype(np.int32)
+                        # hash into 1..V-1 with python ints (hex fields
+                        # can exceed 64 bits); 0 stays the padding id
+                        vals = [v % (self.vocab_size - 1) + 1
+                                for v in vals]
+                    ids[b, slot_pos[name], :len(vals)] = np.asarray(
+                        vals, np.int64).astype(np.int32)
         return {"ids": ids, "dense": dense, "label": label}
 
 
@@ -100,3 +103,29 @@ def synthetic_ctr_lines(n, num_dense=13, num_sparse=26, seed=0):
         cols += [f"{v:x}" for v in sparse]
         lines.append("\t".join(cols))
     return lines
+
+
+def parse_criteo_batch(lines, schema: CTRSchema, parser=None):
+    """Parse criteo-format lines straight into an assembled batch dict.
+
+    Fast path: the native C++ parser (runtime/cpp/ctr_parser.cc — GIL
+    released, thread-pooled, parse+assemble fused), taken only for the
+    default criteo layout: no caller-supplied parser (a custom parser's
+    behavior can't be replicated natively) and slots named C1..CN (the
+    names CriteoLineParser emits). Falls back to the python
+    CriteoLineParser + CTRSchema.assemble pipeline otherwise; both
+    produce identical arrays (tests/test_native_ctr_parser.py)."""
+    default_slots = [f"C{i + 1}" for i in range(len(schema.sparse_slots))]
+    if parser is None and schema.sparse_slots == default_slots:
+        try:
+            from ..runtime.native import parse_ctr_batch
+
+            ids, dense, label = parse_ctr_batch(
+                list(lines), schema.dense_dim, len(schema.sparse_slots),
+                schema.ids_per_slot, schema.vocab_size or 0)
+            return {"ids": ids, "dense": dense, "label": label}
+        except ImportError:
+            pass
+    parser = parser or CriteoLineParser(schema.dense_dim,
+                                        len(schema.sparse_slots))
+    return schema.assemble([parser(l) for l in lines])

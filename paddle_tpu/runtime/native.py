@@ -89,6 +89,27 @@ def gather_stack(arrays):
     return out
 
 
+def _load_shared(so_path, make_target):
+    """Build (make -C cpp <target>) if missing, then CDLL; raises
+    ImportError on any failure (shared by all three native loaders)."""
+    if not os.path.exists(so_path):
+        try:
+            subprocess.run(
+                ["make", "-C", os.path.dirname(so_path), make_target],
+                check=True, capture_output=True, timeout=120)
+        except subprocess.CalledProcessError as e:
+            raise ImportError(
+                f"native {make_target} build failed: "
+                f"{e.stderr.decode(errors='replace')[-500:]}") from e
+        except (OSError, subprocess.SubprocessError) as e:
+            raise ImportError(f"native {make_target} build failed: {e}") \
+                from e
+    try:
+        return ctypes.CDLL(so_path)
+    except OSError as e:
+        raise ImportError(f"native {make_target} unloadable: {e}") from e
+
+
 _BPE_SO = os.path.join(_HERE, "cpp", "libptpu_bpe.so")
 _bpe_lib = None
 
@@ -100,22 +121,7 @@ def load_bpe_library():
     with _LOCK:
         if _bpe_lib is not None:
             return _bpe_lib
-        if not os.path.exists(_BPE_SO):
-            try:
-                subprocess.run(
-                    ["make", "-C", os.path.dirname(_BPE_SO),
-                     "libptpu_bpe.so"], check=True,
-                    capture_output=True, timeout=120)
-            except subprocess.CalledProcessError as e:
-                raise ImportError(
-                    "native BPE build failed: "
-                    f"{e.stderr.decode(errors='replace')[-500:]}") from e
-            except (OSError, subprocess.SubprocessError) as e:
-                raise ImportError(f"native BPE build failed: {e}") from e
-        try:
-            lib = ctypes.CDLL(_BPE_SO)
-        except OSError as e:
-            raise ImportError(f"native BPE unloadable: {e}") from e
+        lib = _load_shared(_BPE_SO, "libptpu_bpe.so")
         lib.ptpu_bpe_create.restype = ctypes.c_void_p
         lib.ptpu_bpe_create.argtypes = [ctypes.c_char_p, ctypes.c_long,
                                         ctypes.c_char_p, ctypes.c_long]
@@ -132,3 +138,57 @@ def load_bpe_library():
             ctypes.POINTER(ctypes.c_long)]
         _bpe_lib = lib
         return lib
+
+
+_CTR_SO = os.path.join(_HERE, "cpp", "libptpu_ctr.so")
+_ctr_lib = None
+
+
+def load_ctr_library():
+    """Load (building if needed) the native criteo CTR parser library;
+    raises ImportError (same contract/locking as load_lib)."""
+    global _ctr_lib
+    with _LOCK:
+        if _ctr_lib is not None:
+            return _ctr_lib
+        lib = _load_shared(_CTR_SO, "libptpu_ctr.so")
+        lib.ptpu_ctr_parse_batch.restype = ctypes.c_long
+        lib.ptpu_ctr_parse_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_long), ctypes.c_long,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float)]
+        _ctr_lib = lib
+        return lib
+
+
+def parse_ctr_batch(lines, num_dense, num_sparse, ids_per_slot,
+                    vocab_size):
+    """Parse criteo-format lines into the padded-dense CTR batch layout
+    via the native parser (GIL released, thread-pooled). Returns
+    (ids [B,S,L] int32, dense [B,D] float32, label [B] float32); raises
+    ImportError when the native library is unavailable and ValueError on
+    a malformed line."""
+    lib = load_ctr_library()
+    n = len(lines)
+    encs = [ln.encode("utf-8") for ln in lines]
+    blob = b"\n".join(encs) + b"\n"
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    pos = 0
+    for i, e in enumerate(encs):
+        offsets[i] = pos
+        pos += len(e) + 1
+    offsets[n] = pos
+    ids = np.zeros((n, num_sparse, ids_per_slot), dtype=np.int32)
+    dense = np.zeros((n, num_dense), dtype=np.float32)
+    label = np.zeros((n,), dtype=np.float32)
+    rc = lib.ptpu_ctr_parse_batch(
+        blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)), n,
+        num_dense, num_sparse, ids_per_slot, vocab_size or 0,
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        dense.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    if rc < 0:
+        raise ValueError(f"malformed criteo line at row {-rc - 1}: "
+                         f"{lines[-rc - 1][:80]!r}")
+    return ids, dense, label

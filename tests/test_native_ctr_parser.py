@@ -1,0 +1,128 @@
+"""Native C++ criteo CTR parser (runtime/cpp/ctr_parser.cc): exact
+parity with the python CriteoLineParser + CTRSchema.assemble pipeline,
+including hashing, missing-field, raw-id and malformed-line behavior.
+Reference analog: paddle/fluid/framework/data_feed.cc MultiSlotDataFeed.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.rec.data import (CTRSchema, CriteoLineParser,
+                                 parse_criteo_batch, synthetic_ctr_lines)
+
+try:
+    from paddle_tpu.runtime.native import load_ctr_library
+
+    load_ctr_library()
+    HAVE_NATIVE = True
+except ImportError:
+    HAVE_NATIVE = False
+
+needs_native = pytest.mark.skipif(not HAVE_NATIVE,
+                                  reason="no C++ toolchain")
+
+
+def _schema(vocab=1 << 20):
+    return CTRSchema([f"C{i + 1}" for i in range(26)], ids_per_slot=1,
+                     dense_dim=13, vocab_size=vocab)
+
+
+def _python_parse(lines, schema):
+    parse = CriteoLineParser(schema.dense_dim, len(schema.sparse_slots))
+    return schema.assemble([parse(l) for l in lines])
+
+
+@needs_native
+def test_native_parity_hashed():
+    lines = synthetic_ctr_lines(512, seed=3)
+    # edge cases: empty dense field and empty categorical field
+    parts = lines[0].split("\t")
+    parts[1] = ""       # dense d1 missing -> 0.0
+    parts[20] = ""      # categorical C7 missing -> padding id 0
+    lines[0] = "\t".join(parts)
+    schema = _schema()
+    ref = _python_parse(lines, schema)
+    fast = parse_criteo_batch(lines, schema)
+    for k in ("ids", "dense", "label"):
+        np.testing.assert_array_equal(ref[k], fast[k], err_msg=k)
+    assert fast["ids"].dtype == np.int32
+    assert fast["dense"].dtype == np.float32
+
+
+@needs_native
+def test_native_parity_raw_ids_and_long_hex():
+    # vocab None -> raw ids (int32 truncation parity with numpy astype);
+    # plus a >64-bit hex string must match python big-int modulo when
+    # hashing IS enabled
+    lines = synthetic_ctr_lines(64, seed=5)
+    schema0 = _schema(vocab=None)
+    np.testing.assert_array_equal(
+        _python_parse(lines, schema0)["ids"],
+        parse_criteo_batch(lines, schema0)["ids"])
+
+    parts = lines[0].split("\t")
+    parts[14] = "ffffffffffffffffffff"  # 80-bit hex
+    lines[0] = "\t".join(parts)
+    schema = _schema()
+    np.testing.assert_array_equal(
+        _python_parse(lines, schema)["ids"],
+        parse_criteo_batch(lines, schema)["ids"])
+
+
+@needs_native
+def test_native_threaded_large_batch():
+    # n >= 256 takes the thread-pool path
+    lines = synthetic_ctr_lines(2048, seed=7)
+    schema = _schema()
+    ref = _python_parse(lines, schema)
+    fast = parse_criteo_batch(lines, schema)
+    for k in ("ids", "dense", "label"):
+        np.testing.assert_array_equal(ref[k], fast[k], err_msg=k)
+
+
+@needs_native
+def test_native_malformed_line_raises():
+    schema = _schema()
+    with pytest.raises(ValueError, match="malformed"):
+        parse_criteo_batch(["not a criteo line"], schema)
+    # empty line / empty label must NOT steal the next line's label
+    good = synthetic_ctr_lines(1, seed=0)[0]
+    with pytest.raises(ValueError, match="row 0"):
+        parse_criteo_batch(["", good], schema)
+    with pytest.raises(ValueError, match="row 0"):
+        parse_criteo_batch(["\t" + good.split("\t", 1)[1], good], schema)
+
+
+@needs_native
+def test_native_raw_mode_rejects_int64_overflow():
+    # python fallback raises OverflowError at >= 2^63; native must error
+    # too (not saturate)
+    lines = synthetic_ctr_lines(1, seed=0)
+    parts = lines[0].split("\t")
+    parts[14] = "ffffffffffffffffffff"
+    schema = CTRSchema([f"C{i + 1}" for i in range(26)], dense_dim=13,
+                       vocab_size=None)
+    with pytest.raises(ValueError, match="malformed"):
+        parse_criteo_batch(["\t".join(parts)], schema)
+
+
+@needs_native
+def test_custom_slot_names_use_python_path():
+    # non-C1..CN slot names: native path must NOT be taken (it fills
+    # positionally; python matches names) — both paths through the
+    # public function must agree, i.e. all-zero ids here
+    lines = synthetic_ctr_lines(4, seed=2)
+    schema = CTRSchema([f"user_{i}" for i in range(26)], dense_dim=13,
+                       vocab_size=1 << 20)
+    out = parse_criteo_batch(lines, schema)
+    assert not out["ids"].any()
+
+
+def test_python_fallback_identical():
+    # parse_criteo_batch with a mismatched parser config skips the
+    # native path and still produces the assembled dict
+    lines = synthetic_ctr_lines(16, seed=1)
+    schema = _schema()
+    custom = CriteoLineParser(13, 26)
+    out = parse_criteo_batch(lines, schema, parser=custom)
+    assert out["ids"].shape == (16, 26, 1)
+    assert out["dense"].shape == (16, 13)
