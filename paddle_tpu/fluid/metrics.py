@@ -195,3 +195,124 @@ class EditDistance(MetricBase):
             raise ValueError("There is no data in EditDistance Metric.")
         return (self.total_distance / self.seq_num,
                 self.instance_error / float(self.seq_num))
+
+
+def _iou_xyxy(box, boxes):
+    """IoU of one [4] box against [N,4] boxes (xmin,ymin,xmax,ymax)."""
+    ix1 = np.maximum(box[0], boxes[:, 0])
+    iy1 = np.maximum(box[1], boxes[:, 1])
+    ix2 = np.minimum(box[2], boxes[:, 2])
+    iy2 = np.minimum(box[3], boxes[:, 3])
+    iw = np.maximum(ix2 - ix1, 0.0)
+    ih = np.maximum(iy2 - iy1, 0.0)
+    inter = iw * ih
+    a = (box[2] - box[0]) * (box[3] - box[1])
+    b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    union = a + b - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+class DetectionMAP(MetricBase):
+    """Streaming VOC mean-average-precision (reference metrics.py:805
+    DetectionMAP over the detection_map op; here the matching and AP
+    integration run host-side on numpy, like the other fluid metrics).
+
+    update() takes ONE image's results: detections [M, 6] rows of
+    (label, confidence, xmin, ymin, xmax, ymax), ground-truth boxes
+    [N, 4], labels [N], and optional difficult flags [N]. eval() returns
+    mAP over classes (background excluded) with '11point' or 'integral'
+    averaging.
+
+    ``class_num`` is accepted for reference-signature familiarity only:
+    the mean runs over classes observed in updates, which is identical
+    (a class never seen has no positives and is excluded either way).
+    """
+
+    def __init__(self, class_num=None, background_label=0,
+                 overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_version="integral", name=None):
+        super().__init__(name)
+        if ap_version not in ("integral", "11point"):
+            raise ValueError("ap_version must be 'integral' or '11point'")
+        self._class_num = class_num
+        self._background = background_label
+        self._thr = float(overlap_threshold)
+        self._eval_difficult = bool(evaluate_difficult)
+        self._ap_version = ap_version
+        self.reset()
+
+    def reset(self):
+        self._scored = {}   # class -> list of (score, is_tp)
+        self._npos = {}     # class -> number of positives
+
+    def update(self, detections, gt_boxes, gt_labels, difficult=None):
+        dets = _to_np(detections).reshape(-1, 6).astype(np.float64)
+        boxes = _to_np(gt_boxes).reshape(-1, 4).astype(np.float64)
+        labels = _to_np(gt_labels).reshape(-1).astype(np.int64)
+        diff = (np.zeros(len(labels), bool) if difficult is None
+                else _to_np(difficult).reshape(-1).astype(bool))
+
+        for c in np.unique(np.concatenate(
+                [labels, dets[:, 0].astype(np.int64)])):
+            c = int(c)
+            if c == self._background:
+                continue
+            gt_mask = labels == c
+            gt_c = boxes[gt_mask]
+            diff_c = diff[gt_mask]
+            if self._eval_difficult:
+                self._npos[c] = self._npos.get(c, 0) + len(gt_c)
+            else:
+                self._npos[c] = self._npos.get(c, 0) + int(
+                    np.sum(~diff_c))
+            d_c = dets[dets[:, 0].astype(np.int64) == c]
+            order = np.argsort(-d_c[:, 1], kind="stable")
+            matched = np.zeros(len(gt_c), bool)
+            rec = self._scored.setdefault(c, [])
+            for i in order:
+                score, box = float(d_c[i, 1]), d_c[i, 2:6]
+                if len(gt_c) == 0:
+                    rec.append((score, False))
+                    continue
+                ious = _iou_xyxy(box, gt_c)
+                j = int(np.argmax(ious))
+                if ious[j] >= self._thr and diff_c[j] \
+                        and not self._eval_difficult:
+                    # VOC semantics: detections on difficult gts are
+                    # ignored entirely (never tp/fp, gt never consumed)
+                    continue
+                if ious[j] >= self._thr and not matched[j]:
+                    matched[j] = True
+                    rec.append((score, True))
+                else:
+                    rec.append((score, False))
+
+    def _ap(self, scored, npos):
+        if npos == 0:
+            return None
+        if not scored:
+            return 0.0
+        arr = sorted(scored, key=lambda s: -s[0])
+        tp = np.cumsum([1.0 if t else 0.0 for _, t in arr])
+        fp = np.cumsum([0.0 if t else 1.0 for _, t in arr])
+        recall = tp / npos
+        precision = tp / np.maximum(tp + fp, 1e-12)
+        if self._ap_version == "11point":
+            return float(np.mean([
+                float(np.max(precision[recall >= t], initial=0.0))
+                for t in np.linspace(0, 1, 11)]))
+        # natural integral of the PR curve
+        prev_r = 0.0
+        ap = 0.0
+        for p, r in zip(precision, recall):
+            ap += p * (r - prev_r)
+            prev_r = r
+        return float(ap)
+
+    def eval(self):
+        aps = [self._ap(self._scored.get(c, []), n)
+               for c, n in self._npos.items()]
+        aps = [a for a in aps if a is not None]
+        if not aps:
+            raise ValueError("There is no data in DetectionMAP Metrics.")
+        return float(np.mean(aps))

@@ -116,7 +116,9 @@ def parse_criteo_batch(lines, schema: CTRSchema, parser=None):
     CriteoLineParser + CTRSchema.assemble pipeline otherwise; both
     produce identical arrays (tests/test_native_ctr_parser.py)."""
     default_slots = [f"C{i + 1}" for i in range(len(schema.sparse_slots))]
-    if parser is None and schema.sparse_slots == default_slots:
+    if parser is None and schema.sparse_slots == default_slots \
+            and schema.label_slot == "label" \
+            and schema.dense_slot == "dense":
         try:
             from ..runtime.native import parse_ctr_batch
 

@@ -53,6 +53,7 @@ int parse_line(const char* p, const char* end, int num_dense,
       dense_row[d] = 0.0f;  // empty field
       continue;
     }
+    if (isspace(static_cast<unsigned char>(*p))) return 1;  // ' ' field
     dense_row[d] = strtof(p, &next);
     if (next == p) return 1;
     p = next;

@@ -174,11 +174,8 @@ def parse_ctr_batch(lines, num_dense, num_sparse, ids_per_slot,
     encs = [ln.encode("utf-8") for ln in lines]
     blob = b"\n".join(encs) + b"\n"
     offsets = np.zeros(n + 1, dtype=np.int64)
-    pos = 0
-    for i, e in enumerate(encs):
-        offsets[i] = pos
-        pos += len(e) + 1
-    offsets[n] = pos
+    np.cumsum(np.fromiter((len(e) + 1 for e in encs), np.int64, count=n),
+              out=offsets[1:])
     ids = np.zeros((n, num_sparse, ids_per_slot), dtype=np.int32)
     dense = np.zeros((n, num_dense), dtype=np.float32)
     label = np.zeros((n,), dtype=np.float32)

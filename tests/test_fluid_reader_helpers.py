@@ -198,6 +198,55 @@ def test_fluid_metrics_precision_recall():
     assert abs(r.eval() - 2 / 3) < 1e-12
 
 
+def test_detection_map_integral_and_11point():
+    from paddle_tpu.fluid.metrics import DetectionMAP
+
+    dets = np.array([
+        [1, 0.9, 0, 0, 10, 10],     # matches gt0 -> tp
+        [1, 0.8, 1, 1, 10, 10],     # gt0 already matched -> fp
+        [1, 0.7, 20, 20, 30, 30],   # matches gt1 -> tp
+    ])
+    gts = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], dtype=float)
+    labels = np.array([1, 1])
+
+    m = DetectionMAP()
+    m.update(dets, gts, labels)
+    # ranked tp/fp/tp: precisions 1, 1/2, 2/3; recalls .5, .5, 1.0
+    assert abs(m.eval() - (1.0 * 0.5 + (2 / 3) * 0.5)) < 1e-12
+
+    m11 = DetectionMAP(ap_version="11point")
+    m11.update(dets, gts, labels)
+    expected = (6 * 1.0 + 5 * (2 / 3)) / 11
+    assert abs(m11.eval() - expected) < 1e-12
+
+
+def test_detection_map_difficult_and_multiclass():
+    from paddle_tpu.fluid.metrics import DetectionMAP
+
+    dets = np.array([
+        [1, 0.9, 0, 0, 10, 10],
+        [1, 0.8, 1, 1, 10, 10],
+        [1, 0.7, 20, 20, 30, 30],   # matches a difficult gt
+        [2, 0.9, 0, 0, 5, 5],       # class 2 det, no class-2 gt -> fp
+    ])
+    gts = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], dtype=float)
+    labels = np.array([1, 1])
+
+    # difficult gt ignored: npos=1, matching det ignored entirely
+    m = DetectionMAP(evaluate_difficult=False)
+    m.update(dets, gts, labels, difficult=np.array([0, 1]))
+    assert abs(m.eval() - 1.0) < 1e-12  # class-2 has npos=0 -> excluded
+
+    # background label excluded from classes
+    m = DetectionMAP(background_label=1)
+    m.update(dets, gts, labels)
+    with pytest.raises(ValueError):
+        m.eval()  # only class-1 gts exist and they're "background" now
+
+    with pytest.raises(ValueError):
+        DetectionMAP(ap_version="7point")
+
+
 def test_fluid_evaluator_and_install_check_spellings():
     from paddle_tpu.fluid.evaluator import ChunkEvaluator
     from paddle_tpu.fluid.install_check import run_check
