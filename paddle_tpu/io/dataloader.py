@@ -324,6 +324,23 @@ class DataLoader:
         return gen()
 
     def __iter__(self):
+        # benchmark() reader-cost hooks (reference fluid/reader.py calls
+        # these inside the C++ reader loop; see profiler/timer.py)
+        from ..profiler.timer import benchmark as _benchmark
+
+        bm = _benchmark()
+        it = self._iter_batches()
+        while True:
+            bm.before_reader()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            finally:
+                bm.after_reader()
+            yield batch
+
+    def _iter_batches(self):
         def to_tensors(b):
             if isinstance(b, tuple):
                 return tuple(to_tensors(x) for x in b)

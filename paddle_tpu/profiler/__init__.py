@@ -18,7 +18,10 @@ from typing import Callable, Iterable, Optional
 
 import jax
 
+from .timer import Benchmark, benchmark  # noqa: F401
+
 __all__ = [
+    "Benchmark", "benchmark",
     "ProfilerState", "ProfilerTarget", "make_scheduler",
     "export_chrome_tracing", "export_protobuf", "Profiler", "RecordEvent",
     "RecordInstantEvent", "load_profiler_result", "SortedKeys",
@@ -116,6 +119,9 @@ class Profiler:
         self.current_state = self.scheduler(self._step)
         self._maybe_toggle()
         self._t0 = time.perf_counter()
+        from .timer import benchmark
+
+        benchmark().begin()  # reader_cost/ips collection (timer.py)
         return self
 
     def stop(self):
@@ -123,6 +129,9 @@ class Profiler:
             jax.profiler.stop_trace()
             self._tracing = False
         self.current_state = ProfilerState.CLOSED
+        from .timer import benchmark
+
+        benchmark().end()
         if self.on_trace_ready:
             self.on_trace_ready(self)
 
@@ -132,6 +141,9 @@ class Profiler:
             self._step_times.append(now - self._t0)
         self._t0 = now
         self._step += 1
+        from .timer import benchmark
+
+        benchmark().step(num_samples)  # reference Profiler.step drives it
         self.current_state = self.scheduler(self._step)
         self._maybe_toggle()
 
@@ -157,12 +169,18 @@ class Profiler:
     # -- reporting -----------------------------------------------------------
 
     def step_info(self, unit=None) -> str:
+        """Step-time stats plus the Benchmark's reader_cost/batch_cost/
+        ips line (reference profiler.py Profiler.step_info)."""
         if not self._step_times:
             return "no steps recorded"
         import numpy as np
         t = np.asarray(self._step_times)
+        from .timer import benchmark
+
+        bench = benchmark().step_info(unit or "samples")
         return (f"steps: {len(t)}  avg: {t.mean()*1e3:.2f} ms  "
-                f"min: {t.min()*1e3:.2f} ms  max: {t.max()*1e3:.2f} ms")
+                f"min: {t.min()*1e3:.2f} ms  max: {t.max()*1e3:.2f} ms"
+                + (f" |{bench}" if bench else ""))
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):

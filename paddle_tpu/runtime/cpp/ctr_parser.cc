@@ -34,37 +34,50 @@ namespace {
 int parse_line(const char* p, const char* end, int num_dense,
                int num_sparse, int ids_per_slot, long vocab_size,
                int32_t* ids_row, float* dense_row, float* label_out) {
-  // field 0: label. strtof would skip leading whitespace INCLUDING the
-  // '\t'/'\n' separators (stealing the next field or line), so an
-  // empty/whitespace-led label is malformed, like the python parser.
-  if (p >= end || *p == '\t' || *p == '\n' || *p == '\r' ||
-      isspace(static_cast<unsigned char>(*p))) {
-    return 1;
-  }
+  // Numeric fields strip leading/trailing SPACES like python float()/
+  // int(); but strtof's own whitespace skipping would also cross
+  // '\t'/'\n' separators (stealing the next field or line), so spaces
+  // are consumed explicitly and a whitespace-only field is malformed
+  // (python: float(' ') raises).
+  auto skip_spaces = [&]() {
+    while (p < end && *p == ' ') ++p;
+  };
+  auto at_separator = [&]() {
+    return p >= end || *p == '\t' || *p == '\n' || *p == '\r';
+  };
+
+  // field 0: label
+  skip_spaces();
+  if (at_separator()) return 1;
   char* next = nullptr;
   *label_out = strtof(p, &next);
   if (next == p) return 1;
   p = next;
+  skip_spaces();
 
   // dense fields
   for (int d = 0; d < num_dense; ++d) {
     if (p < end && *p == '\t') ++p;
-    if (p >= end || *p == '\t' || *p == '\n' || *p == '\r') {
+    if (at_separator()) {
       dense_row[d] = 0.0f;  // empty field
       continue;
     }
-    if (isspace(static_cast<unsigned char>(*p))) return 1;  // ' ' field
+    skip_spaces();
+    if (at_separator()) return 1;  // whitespace-only field
     dense_row[d] = strtof(p, &next);
     if (next == p) return 1;
     p = next;
+    skip_spaces();
   }
 
   // sparse (hex) fields: one id per field, into slot s position 0
   for (int s = 0; s < num_sparse; ++s) {
     if (p < end && *p == '\t') ++p;
-    if (p >= end || *p == '\t' || *p == '\n' || *p == '\r') {
+    if (at_separator()) {
       continue;  // missing feature: stays padding id 0
     }
+    skip_spaces();
+    if (at_separator()) return 1;  // whitespace-only field
     if (vocab_size > 1) {
       // incremental modulo: matches python int(v, 16) % (V-1) + 1 for
       // hex strings of any length
@@ -79,6 +92,7 @@ int parse_line(const char* p, const char* end, int num_dense,
         ++p;
       }
       if (!any) return 1;
+      skip_spaces();
       ids_row[s * ids_per_slot] = static_cast<int32_t>(acc + 1);
     } else {
       // raw mode: reject values the python fallback's int64 conversion
@@ -94,6 +108,7 @@ int parse_line(const char* p, const char* end, int num_dense,
         ++p;
       }
       if (!any || v > static_cast<uint64_t>(INT64_MAX)) return 1;
+      skip_spaces();
       ids_row[s * ids_per_slot] = static_cast<int32_t>(v);  // numpy astype
     }
   }

@@ -80,6 +80,25 @@ def test_native_threaded_large_batch():
 
 
 @needs_native
+def test_native_space_stripping_parity():
+    # python float('` 1.5`')/int('` a3 `', 16) strip spaces; native must too
+    lines = synthetic_ctr_lines(4, seed=1)
+    parts = lines[0].split("\t")
+    parts[2] = " 1.5"
+    parts[15] = " a3 "
+    lines[0] = "\t".join(parts)
+    schema = _schema()
+    ref = _python_parse(lines, schema)
+    fast = parse_criteo_batch(lines, schema)
+    for k in ("ids", "dense", "label"):
+        np.testing.assert_array_equal(ref[k], fast[k], err_msg=k)
+    # whitespace-ONLY field still errors (python float(' ') raises)
+    parts[2] = " "
+    with pytest.raises(ValueError, match="malformed"):
+        parse_criteo_batch(["\t".join(parts)], schema)
+
+
+@needs_native
 def test_native_malformed_line_raises():
     schema = _schema()
     with pytest.raises(ValueError, match="malformed"):

@@ -1,0 +1,50 @@
+"""paddle.profiler.benchmark() timer API (reference profiler/timer.py):
+reader_cost/batch_cost/ips statistics hooked into the DataLoader.
+"""
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.profiler import Benchmark, benchmark
+
+
+def test_benchmark_singleton():
+    assert benchmark() is benchmark()
+    assert isinstance(benchmark(), Benchmark)
+
+
+def test_benchmark_step_info_over_dataloader():
+    ds = TensorDataset(
+        [paddle.to_tensor(np.arange(32, dtype=np.float32).reshape(32, 1))])
+    loader = DataLoader(ds, batch_size=8, num_workers=0)
+    bm = benchmark()
+    bm.begin()
+    steps = 0
+    for _ in loader:
+        time.sleep(0.005)
+        bm.step(num_samples=8)
+        steps += 1
+    info = bm.step_info("samples")
+    bm.end()
+    assert steps == 4
+    assert "reader_cost" in info
+    assert "batch_cost" in info
+    assert "ips" in info and "samples/s" in info
+    # step_info resets the running stats
+    assert bm.step_info("samples") == ""
+
+
+def test_benchmark_steps_per_sec_mode():
+    bm = Benchmark()
+    bm.begin()
+    for _ in range(3):
+        time.sleep(0.002)
+        bm.step()  # no num_samples -> steps/s
+    info = bm.step_info()
+    assert "steps/s" in info
+    bm.end()
+    # after end(), step() records nothing
+    bm.step(num_samples=8)
+    assert bm.step_info() == ""
