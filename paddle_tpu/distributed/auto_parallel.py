@@ -16,8 +16,57 @@ from . import mesh as mesh_mod
 from .auto_engine import Engine, Plan  # noqa: F401 (engine.py analog)
 
 
+class ProcessMesh:
+    """N-D array of process ranks with named dims (reference
+    auto_parallel/process_mesh.py). Converts to a jax.sharding.Mesh over
+    the visible devices, so it can be passed wherever shard_tensor /
+    shard_op take a process_mesh."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None,
+                 process_ids=None):
+        import numpy as np
+
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        elif shape is not None and process_ids is not None:
+            arr = np.asarray(process_ids).reshape(shape)
+        else:
+            raise ValueError("ProcessMesh needs `mesh` or "
+                             "(`shape`, `process_ids`)")
+        self._ranks = arr
+        self.shape = list(arr.shape)
+        self.process_ids = [int(r) for r in arr.reshape(-1)]
+        self.dim_names = (list(dim_names) if dim_names
+                          else [f"d{i}" for i in range(arr.ndim)])
+        if len(self.dim_names) != arr.ndim:
+            raise ValueError(
+                f"{len(self.dim_names)} dim_names for {arr.ndim}-d mesh")
+
+    @property
+    def ndim(self):
+        return self._ranks.ndim
+
+    def get_jax_mesh(self):
+        import numpy as np
+
+        devs = np.asarray(jax.devices(), dtype=object)[self._ranks]
+        return jax.sharding.Mesh(devs, tuple(self.dim_names))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self.dim_names})")
+
+
+def _as_mesh(process_mesh):
+    if process_mesh is None:
+        return mesh_mod.get_mesh()
+    if isinstance(process_mesh, ProcessMesh):
+        return process_mesh.get_jax_mesh()
+    return process_mesh
+
+
 def shard_tensor(x, process_mesh=None, shard_spec=None, dist_attr=None):
-    mesh = process_mesh or mesh_mod.get_mesh()
+    mesh = _as_mesh(process_mesh)
     if shard_spec is None:
         spec = PartitionSpec()
     else:
@@ -47,7 +96,7 @@ def shard_op(op, process_mesh=None, in_shard_specs=None, out_shard_specs=None):
     dist_attr; here the constraint is real — under jit it becomes
     lax.with_sharding_constraint, so GSPMD must produce that layout, and
     eagerly it device_puts)."""
-    mesh = process_mesh or mesh_mod.get_mesh()
+    mesh = _as_mesh(process_mesh)
 
     def _place_raw(data, spec):
         import jax.core as jcore

@@ -191,3 +191,48 @@ class ASPOptimizer(MetaOptimizerBase):
         from ...incubate import asp
 
         self._inner = asp.decorate(self._inner)
+
+
+class HybridParallelOptimizer(MetaOptimizerBase):
+    """dygraph_optimizer/hybrid_parallel_optimizer.py spelling: the
+    hybrid-parallel wrapping (grad sync by mesh axis, hybrid clip) is
+    what fleet.distributed_optimizer's compiled step already does; this
+    wrapper carries the (optimizer, hcg, strategy) reference signature
+    and delegates."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        super().__init__(optimizer, strategy)
+        self._hcg = hcg
+
+
+class DygraphShardingOptimizer(ShardingOptimizer):
+    """dygraph_optimizer/dygraph_sharding_optimizer.py spelling."""
+
+    def __init__(self, hcg=None, user_defined_strategy=None,
+                 params=None, inner_optimizer_class=None, **inner_kw):
+        if inner_optimizer_class is not None:
+            inner = inner_optimizer_class(parameters=params, **inner_kw)
+        elif hasattr(hcg, "step"):
+            # Paddle >= 2.5 spelling: (optimizer, hcg) positional-first
+            inner = hcg
+        else:
+            inner = params  # already-built optimizer passed positionally
+        if not hasattr(inner, "step"):
+            raise TypeError(
+                "DygraphShardingOptimizer needs an optimizer: pass "
+                "(optimizer, hcg) or (hcg, strategy, params, "
+                "inner_optimizer_class, **kwargs)")
+        super().__init__(inner, user_defined_strategy)
+
+
+class HybridParallelGradScaler:
+    """dygraph_optimizer/hybrid_parallel_gradscaler.py: found_inf is
+    globally consistent under single-controller pjit, so this delegates
+    to the wrapped scaler unchanged."""
+
+    def __init__(self, scaler, hcg=None):
+        self._scaler = scaler
+        self._hcg = hcg
+
+    def __getattr__(self, name):
+        return getattr(self._scaler, name)

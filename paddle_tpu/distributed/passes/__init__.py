@@ -116,3 +116,49 @@ class PassManager:
     @property
     def context(self):
         return self._context
+
+
+class PassBase:
+    """Reference passes/pass_base.py PassBase: subclasses implement
+    _check_self/_check_conflict/_apply_single_impl. Registered passes
+    (new_pass) in this framework mutate the DistributedStrategy the
+    compiled train step reads; PassBase is the extension hook for
+    custom passes following the same protocol."""
+
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    def _check_self(self):
+        return True
+
+    def _check_conflict(self, other_pass):
+        return True
+
+    def apply(self, main_programs, startup_programs, context=None):
+        if not self._check_self():
+            raise ValueError(f"pass {type(self).__name__} misconfigured")
+        if len(main_programs) != len(startup_programs):
+            raise ValueError(
+                f"{len(main_programs)} main programs vs "
+                f"{len(startup_programs)} startup programs")
+        for prev in getattr(context, "passes", []) or []:
+            if not self._check_conflict(prev):
+                raise ValueError(
+                    f"pass {type(self).__name__} conflicts with "
+                    f"{type(prev).__name__}")
+        for main, startup in zip(main_programs, startup_programs):
+            self._apply_single_impl(main, startup, context)
+        if context is not None:
+            getattr(context, "passes", []).append(self) \
+                if hasattr(context, "passes") else None
+        return context
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        raise NotImplementedError

@@ -329,16 +329,20 @@ class DataLoader:
         from ..profiler.timer import benchmark as _benchmark
 
         bm = _benchmark()
+        bm.check_if_need_record(self)  # first active loader owns timing
         it = self._iter_batches()
-        while True:
-            bm.before_reader()
-            try:
-                batch = next(it)
-            except StopIteration:
-                return
-            finally:
-                bm.after_reader()
-            yield batch
+        try:
+            while True:
+                bm.before_reader(owner=id(self))
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+                finally:
+                    bm.after_reader(owner=id(self))
+                yield batch
+        finally:
+            bm.release_reader(self)
 
     def _iter_batches(self):
         def to_tensors(b):

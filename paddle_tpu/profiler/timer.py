@@ -46,6 +46,7 @@ class Benchmark:
         self._reader_t0 = None
         self._step_t0 = None
         self._recording = False
+        self._reader_owner = None  # id() of the loader whose fetches count
 
     # -- lifecycle -------------------------------------------------------
     def begin(self):
@@ -71,17 +72,31 @@ class Benchmark:
         self._recording = False
 
     # -- DataLoader integration -----------------------------------------
-    def before_reader(self):
+    def before_reader(self, owner=None):
+        if self._reader_owner is not None and owner is not None \
+                and owner != self._reader_owner:
+            return  # a nested/other loader (e.g. eval inside train)
         self._reader_t0 = timeit.default_timer()
 
-    def after_reader(self):
+    def after_reader(self, owner=None):
+        if self._reader_owner is not None and owner is not None \
+                and owner != self._reader_owner:
+            return
         if self._recording and self._reader_t0 is not None:
             self._stats.reader_total += \
                 timeit.default_timer() - self._reader_t0
         self._reader_t0 = None
 
     def check_if_need_record(self, reader):
-        return None  # single-task timing; kept for API parity
+        """First loader to iterate while recording owns reader timing
+        (reference Benchmark.check_if_need_record pauses the timer when
+        a different task's loader starts, e.g. eval inside train)."""
+        if self._recording and self._reader_owner is None:
+            self._reader_owner = id(reader)
+
+    def release_reader(self, reader):
+        if self._reader_owner == id(reader):
+            self._reader_owner = None
 
     # -- reporting -------------------------------------------------------
     def step_info(self, unit="samples"):

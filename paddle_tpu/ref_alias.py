@@ -196,6 +196,155 @@ for _leaf, _cls in (("base_gate", "BaseGate"), ("naive_gate", "NaiveGate"),
            f"reference incubate/distributed/models/moe/gate/{_leaf}.py",
            names={_cls})
 
+# ---- nn.initializer per-concept files ----
+for _leaf, _names in (("assign", {"Assign", "NumpyArrayInitializer"}),
+                      ("constant", {"Constant", "ConstantInitializer"}),
+                      ("dirac", {"Dirac"}),
+                      ("kaiming", {"KaimingNormal", "KaimingUniform",
+                                   "MSRAInitializer"}),
+                      ("normal", {"Normal", "TruncatedNormal",
+                                  "NormalInitializer"}),
+                      ("orthogonal", {"Orthogonal"}),
+                      ("uniform", {"Uniform", "UniformInitializer"}),
+                      ("xavier", {"XavierNormal", "XavierUniform",
+                                  "XavierInitializer"})):
+    # legacy *Initializer spellings live in fluid.initializer
+    _alias(f"nn.initializer.{_leaf}",
+           ["nn.initializer", "fluid.initializer"],
+           f"reference python/paddle/nn/initializer/{_leaf}.py",
+           names=_names)
+
+# ---- fluid.layers per-concept files (all resolve against the merged
+# fluid.layers namespace; transformer/codegen internals excluded) ----
+for _leaf in ("nn", "tensor", "control_flow", "io", "ops", "loss",
+              "detection", "learning_rate_scheduler", "rnn",
+              "sequence_lod", "distributions", "metric_op", "utils",
+              "collective", "device"):
+    _alias(f"fluid.layers.{_leaf}", "fluid.layers",
+           f"reference python/paddle/fluid/layers/{_leaf}.py")
+
+# ---- fluid.dygraph per-concept files (dygraph_to_static transformer
+# internals excluded — jit/dy2static.py is the conversion here) ----
+for _leaf in ("base", "layers", "nn", "container", "parallel", "jit",
+              "io", "checkpoint", "learning_rate_scheduler", "tracer"):
+    _alias(f"fluid.dygraph.{_leaf}", "fluid.dygraph",
+           f"reference python/paddle/fluid/dygraph/{_leaf}.py")
+_alias("fluid.dygraph.amp.auto_cast", "amp",
+       "reference fluid/dygraph/amp/auto_cast.py")
+_alias("fluid.dygraph.amp.loss_scaler", "amp",
+       "reference fluid/dygraph/amp/loss_scaler.py")
+
+# ---- text.datasets per-dataset files ----
+for _leaf in ("conll05", "imdb", "imikolov", "movielens", "uci_housing",
+              "wmt14", "wmt16"):
+    _alias(f"text.datasets.{_leaf}", "text.datasets",
+           f"reference python/paddle/text/datasets/{_leaf}.py")
+
+# ---- fluid.dataloader per-concept files -> io implementations ----
+for _leaf, _backing in (("dataset", "io"), ("batch_sampler", "io"),
+                        ("sampler", "io"), ("collate", "io"),
+                        ("worker", "io"), ("fetcher", "io"),
+                        ("flat", "io"), ("dataloader_iter", "io")):
+    _alias(f"fluid.dataloader.{_leaf}", _backing,
+           f"reference python/paddle/fluid/dataloader/{_leaf}.py")
+
+# ---- distributed.fleet per-file spellings ----
+for _leaf in ("amp_optimizer", "asp_optimizer", "common", "dgc_optimizer",
+              "fp16_allreduce_optimizer", "gradient_merge_optimizer",
+              "graph_execution_optimizer", "lamb_optimizer",
+              "lars_optimizer", "localsgd_optimizer",
+              "meta_optimizer_base", "pipeline_optimizer",
+              "raw_program_optimizer", "recompute_optimizer",
+              "sharding_optimizer", "tensor_parallel_optimizer",
+              "parameter_server_optimizer",
+              "parameter_server_graph_optimizer", "ps_optimizer"):
+    _alias(f"distributed.fleet.meta_optimizers.{_leaf}",
+           "distributed.fleet.meta_optimizers",
+           f"reference fleet/meta_optimizers/{_leaf}.py")
+_alias("distributed.fleet.meta_optimizers.dygraph_optimizer",
+       "distributed.fleet.meta_optimizers",
+       "reference fleet/meta_optimizers/dygraph_optimizer/__init__.py")
+for _leaf in ("dygraph_sharding_optimizer", "heter_parallel_optimizer",
+              "hybrid_parallel_gradscaler", "hybrid_parallel_optimizer",
+              "sharding_optimizer_stage2"):
+    _alias(f"distributed.fleet.meta_optimizers.dygraph_optimizer.{_leaf}",
+           "distributed.fleet.meta_optimizers",
+           f"reference fleet/meta_optimizers/dygraph_optimizer/{_leaf}.py")
+_alias("distributed.fleet.base.meta_optimizer_factory",
+       "distributed.fleet.meta_optimizers",
+       "reference fleet/base/meta_optimizer_factory.py")
+_alias("distributed.fleet.data_generator.data_generator",
+       "distributed.fleet.data_generator",
+       "reference fleet/data_generator/data_generator.py")
+_alias("distributed.fleet.dataset.dataset", "distributed.ps_dataset",
+       "reference fleet/dataset/dataset.py")
+_alias("distributed.fleet.elastic.collective", "distributed.elastic",
+       "reference fleet/elastic/collective.py")
+
+# ---- distributed.passes per-file spellings ----
+for _leaf in ("pass_base", "pass_utils", "fuse_all_reduce", "cpp_pass",
+              "auto_parallel_amp", "auto_parallel_fp16",
+              "auto_parallel_gradient_merge", "auto_parallel_recompute",
+              "auto_parallel_sharding",
+              "auto_parallel_data_parallel_optimization",
+              "ps_server_pass", "ps_trainer_pass"):
+    _alias(f"distributed.passes.{_leaf}", "distributed.passes",
+           f"reference distributed/passes/{_leaf}.py")
+
+# ---- distributed.auto_parallel user-facing files (the planner/
+# partitioner/reshard machinery itself is replaced by GSPMD) ----
+_alias("distributed.auto_parallel.interface", "distributed.auto_parallel",
+       "reference auto_parallel/interface.py",
+       names={"shard_tensor", "shard_op", "ProcessMesh"})
+_alias("distributed.auto_parallel.process_mesh",
+       "distributed.auto_parallel",
+       "reference auto_parallel/process_mesh.py", names={"ProcessMesh"})
+_alias("distributed.auto_parallel.engine", "distributed.auto_engine",
+       "reference auto_parallel/engine.py", names={"Engine"})
+_alias("distributed.auto_parallel.planner", "distributed.auto_engine",
+       "reference auto_parallel/planner.py")
+
+# ---- fluid.contrib per-file spellings ----
+_alias("fluid.contrib.sparsity", "static.sparsity",
+       "reference fluid/contrib/sparsity/__init__.py")
+for _leaf in ("asp", "utils", "supported_layer_list"):
+    _alias(f"fluid.contrib.sparsity.{_leaf}", "static.sparsity",
+           f"reference fluid/contrib/sparsity/{_leaf}.py")
+_alias("fluid.contrib.optimizer", "optimizer",
+       "reference fluid/contrib/optimizer.py")
+_alias("fluid.contrib.extend_optimizer", "optimizer",
+       "reference fluid/contrib/extend_optimizer/__init__.py")
+_alias("fluid.contrib.slim.quantization.post_training_quantization",
+       "nn.quant.qat",
+       "reference slim/quantization/post_training_quantization.py",
+       names={"PostTrainingQuantization"})
+_alias("fluid.contrib.slim.quantization.imperative.qat", "nn.quant.qat",
+       "reference slim/quantization/imperative/qat.py",
+       names={"ImperativeQuantAware"})
+_alias("fluid.contrib.slim.quantization.imperative.ptq", "nn.quant.qat",
+       "reference slim/quantization/imperative/ptq.py")
+
+# ---- fluid.incubate.fleet (pre-2.0 fleet spellings) ----
+_alias("fluid.incubate.fleet.base.role_maker",
+       "distributed.fleet.compat",
+       "reference fluid/incubate/fleet/base/role_maker.py",
+       names={"Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker"})
+_alias("fluid.incubate.fleet.base.fleet_base", "distributed.fleet",
+       "reference fluid/incubate/fleet/base/fleet_base.py",
+       names={"Fleet"})
+_alias("fluid.incubate.fleet.utils.fleet_util",
+       "distributed.fleet.compat",
+       "reference fluid/incubate/fleet/utils/fleet_util.py",
+       names={"UtilBase"})
+_alias("fluid.incubate.fleet.utils.hdfs", "distributed.fleet.utils",
+       "reference fluid/incubate/fleet/utils/hdfs.py")
+_alias("fluid.incubate.checkpoint.auto_checkpoint",
+       "incubate.auto_checkpoint",
+       "reference fluid/incubate/checkpoint/auto_checkpoint.py")
+_alias("fluid.incubate.checkpoint.checkpoint_saver",
+       "distributed.checkpoint",
+       "reference fluid/incubate/checkpoint/checkpoint_saver.py")
+
 # ---- misc single-file spellings ----
 _alias("cost_model.cost_model", "cost_model",
        "reference cost_model/cost_model.py")

@@ -54,6 +54,7 @@ int parse_line(const char* p, const char* end, int num_dense,
   if (next == p) return 1;
   p = next;
   skip_spaces();
+  if (!at_separator()) return 1;  // trailing junk in the field
 
   // dense fields
   for (int d = 0; d < num_dense; ++d) {
@@ -68,6 +69,7 @@ int parse_line(const char* p, const char* end, int num_dense,
     if (next == p) return 1;
     p = next;
     skip_spaces();
+    if (!at_separator()) return 1;  // e.g. "1.5 2.5" in one field
   }
 
   // sparse (hex) fields: one id per field, into slot s position 0
@@ -93,6 +95,7 @@ int parse_line(const char* p, const char* end, int num_dense,
       }
       if (!any) return 1;
       skip_spaces();
+      if (!at_separator()) return 1;  // e.g. "a3 b4" in one field
       ids_row[s * ids_per_slot] = static_cast<int32_t>(acc + 1);
     } else {
       // raw mode: reject values the python fallback's int64 conversion
@@ -109,6 +112,7 @@ int parse_line(const char* p, const char* end, int num_dense,
       }
       if (!any || v > static_cast<uint64_t>(INT64_MAX)) return 1;
       skip_spaces();
+      if (!at_separator()) return 1;
       ids_row[s * ids_per_slot] = static_cast<int32_t>(v);  // numpy astype
     }
   }

@@ -86,6 +86,99 @@ def test_top_level_alias_resolves(mod, attr):
         assert hasattr(m, attr), f"{mod}.{attr} missing"
 
 
+@pytest.mark.parametrize("mod,attr", [
+    ("paddle_tpu.nn.initializer.xavier", "XavierNormal"),
+    ("paddle_tpu.nn.initializer.kaiming", "KaimingUniform"),
+    ("paddle_tpu.nn.initializer.constant", "Constant"),
+    ("paddle_tpu.fluid.layers.nn", "fc"),
+    ("paddle_tpu.fluid.layers.control_flow", "While"),
+    ("paddle_tpu.fluid.layers.tensor", "create_tensor"),
+    ("paddle_tpu.fluid.layers.loss", "cross_entropy"),
+    ("paddle_tpu.fluid.dygraph.base", "to_variable"),
+    ("paddle_tpu.fluid.dygraph.nn", "Linear"),
+    ("paddle_tpu.fluid.dygraph.amp.auto_cast", "auto_cast"),
+    ("paddle_tpu.text.datasets.imdb", "Imdb"),
+    ("paddle_tpu.text.datasets.uci_housing", "UCIHousing"),
+    ("paddle_tpu.fluid.dataloader.batch_sampler", "BatchSampler"),
+    ("paddle_tpu.fluid.dataloader.worker", "get_worker_info"),
+    ("paddle_tpu.distributed.fleet.meta_optimizers.localsgd_optimizer",
+     "LocalSGDOptimizer"),
+    ("paddle_tpu.distributed.fleet.meta_optimizers.dygraph_optimizer"
+     ".hybrid_parallel_optimizer", "HybridParallelOptimizer"),
+    ("paddle_tpu.distributed.fleet.data_generator.data_generator",
+     "MultiSlotDataGenerator"),
+    ("paddle_tpu.distributed.passes.pass_base", "PassBase"),
+    ("paddle_tpu.distributed.auto_parallel.interface", "shard_tensor"),
+    ("paddle_tpu.distributed.auto_parallel.process_mesh", "ProcessMesh"),
+    ("paddle_tpu.distributed.auto_parallel.engine", "Engine"),
+    ("paddle_tpu.fluid.contrib.sparsity.asp", None),
+    ("paddle_tpu.fluid.contrib.slim.quantization.imperative.qat",
+     "ImperativeQuantAware"),
+    ("paddle_tpu.fluid.incubate.fleet.base.role_maker",
+     "PaddleCloudRoleMaker"),
+])
+def test_batch_alias_resolves(mod, attr):
+    m = importlib.import_module(mod)
+    if attr is not None:
+        assert hasattr(m, attr), f"{mod}.{attr} missing"
+
+
+def test_process_mesh_to_jax_mesh():
+    from paddle_tpu.distributed.auto_parallel import (ProcessMesh,
+                                                      shard_tensor)
+
+    pm = ProcessMesh(mesh=[[0, 1, 2, 3], [4, 5, 6, 7]],
+                     dim_names=["x", "y"])
+    assert pm.ndim == 2 and pm.shape == [2, 4]
+    assert pm.process_ids == list(range(8))
+    jm = pm.get_jax_mesh()
+    assert jm.axis_names == ("x", "y")
+    t = shard_tensor(paddle.to_tensor(np.zeros((8, 4), np.float32)),
+                     process_mesh=pm, shard_spec=["x", "y"])
+    assert "x" in str(t._data.sharding.spec)
+    with pytest.raises(ValueError):
+        ProcessMesh(mesh=[[0, 1]], dim_names=["a", "b", "c"])
+    with pytest.raises(ValueError):
+        ProcessMesh()
+
+
+def test_pass_base_protocol():
+    from paddle_tpu.distributed.passes.pass_base import PassBase
+
+    applied = []
+
+    class MyPass(PassBase):
+        def _apply_single_impl(self, main, startup, context):
+            applied.append((main, startup))
+
+    p = MyPass().set_attr("k", 1)
+    assert p.get_attr("k") == 1
+    p.apply(["m1", "m2"], ["s1", "s2"])
+    assert applied == [("m1", "s1"), ("m2", "s2")]
+
+
+def test_hybrid_parallel_optimizer_spelling():
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.fleet.base import DistributedStrategy
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        DygraphShardingOptimizer, HybridParallelGradScaler,
+        HybridParallelOptimizer)
+
+    layer = nn.Linear(4, 4)
+    inner = optimizer.SGD(learning_rate=0.1, parameters=layer.parameters())
+    w = HybridParallelOptimizer(inner, hcg=None,
+                                strategy=DistributedStrategy())
+    assert w.inner_opt is inner
+    s = DygraphShardingOptimizer(
+        hcg=None, user_defined_strategy=DistributedStrategy(),
+        params=layer.parameters(), inner_optimizer_class=optimizer.SGD,
+        learning_rate=0.1)
+    assert s._strategy.sharding is True
+    from paddle_tpu.amp import GradScaler
+    gs = HybridParallelGradScaler(GradScaler())
+    assert callable(gs.scale)
+
+
 def test_alias_functions_work():
     from paddle_tpu.tensor.linalg import matmul
     from paddle_tpu.distribution.normal import Normal
