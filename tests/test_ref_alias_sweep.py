@@ -1,0 +1,77 @@
+"""Exhaustive sweep of the registered reference-path alias modules:
+every _LazyAlias in sys.modules must import, and every name it declares
+(its `names` restriction set) must resolve against its backing modules.
+This pins the alias table so a backing-module rename breaks loudly.
+"""
+import importlib
+import sys
+
+import paddle_tpu  # noqa: F401 (registers all aliases)
+
+
+def _alias_modules():
+    from paddle_tpu.ref_alias import _LazyAlias
+
+    return {name: mod for name, mod in list(sys.modules.items())
+            if isinstance(mod, _LazyAlias)}
+
+
+def test_every_alias_backing_imports():
+    mods = _alias_modules()
+    assert len(mods) > 80, f"expected a large alias table, got {len(mods)}"
+    failures = []
+    for name, mod in mods.items():
+        try:
+            mod._load()  # actually imports the backing module(s)
+        except Exception as e:
+            failures.append(f"{name}: {type(e).__name__}: {e}")
+    assert not failures, f"alias backing modules broken: {failures}"
+
+
+def test_every_declared_name_resolves():
+    failures = []
+    for name, mod in _alias_modules().items():
+        declared = mod.__dict__.get("_names")
+        if not declared:
+            continue
+        for attr in declared:
+            try:
+                getattr(mod, attr)
+            except AttributeError:
+                failures.append(f"{name}.{attr}")
+    assert not failures, f"declared alias names missing: {failures}"
+
+
+def test_unrestricted_aliases_have_live_backing():
+    # names=None aliases forward everything; their backing modules must
+    # at least import and expose a public surface
+    empties = []
+    for name, mod in _alias_modules().items():
+        if mod.__dict__.get("_names"):
+            continue
+        try:
+            backs = mod._load()
+        except Exception as e:
+            empties.append(f"{name}: backing import failed ({e})")
+            continue
+        if not any(len([a for a in dir(b) if not a.startswith("_")])
+                   for b in backs):
+            empties.append(f"{name}: backing exposes nothing")
+    assert not empties, empties
+
+
+def test_fleet_ref_paths_lazy_modules_resolve():
+    from paddle_tpu.distributed.fleet.ref_paths import _LazyModule
+
+    lazies = {name: mod for name, mod in list(sys.modules.items())
+              if isinstance(mod, _LazyModule)}
+    assert len(lazies) >= 10
+    failures = []
+    for name, mod in lazies.items():
+        try:
+            attrs = mod.__dict__.get("_attrs")
+            if attrs is None:
+                mod.__dir__()  # forces the loader
+        except Exception as e:
+            failures.append(f"{name}: {e}")
+    assert not failures, failures
