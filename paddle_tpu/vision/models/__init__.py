@@ -25,3 +25,51 @@ from .shufflenetv2 import (  # noqa: F401
     shufflenet_v2_x1_5, shufflenet_v2_x2_0,
 )
 from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401
+
+# pretrained=True handling for every factory (reference downloads from
+# the paddle CDN; here file-gated — see _pretrained.py): intercept the
+# flag centrally so no factory can silently return random init.
+import functools as _functools
+import inspect as _inspect
+
+
+def _with_pretrained(fn):
+    sig = _inspect.signature(fn)
+
+    @_functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        bound = sig.bind_partial(*args, **kwargs)
+        pretrained = bound.arguments.get("pretrained", False)
+        bound.arguments["pretrained"] = False
+        model = fn(*bound.args, **bound.kwargs)
+        if pretrained:
+            from ._pretrained import load_pretrained
+
+            load_pretrained(model, fn.__name__)
+        return model
+
+    return wrapper
+
+
+def _wrap_factories():
+    g = globals()
+    for name, obj in list(g.items()):
+        if name.startswith("_") or not callable(obj) \
+                or _inspect.isclass(obj):
+            continue
+        try:
+            params = _inspect.signature(obj).parameters
+        except (TypeError, ValueError):
+            continue
+        if "pretrained" in params:
+            wrapped = _with_pretrained(obj)
+            g[name] = wrapped
+            # rebind on the defining submodule too, so the
+            # `from ...models.resnet import resnet18` spelling is also
+            # intercepted
+            src_mod = _inspect.getmodule(obj)
+            if src_mod is not None and getattr(src_mod, name, None) is obj:
+                setattr(src_mod, name, wrapped)
+
+
+_wrap_factories()

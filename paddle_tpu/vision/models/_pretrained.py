@@ -1,0 +1,66 @@
+"""Pretrained-weight loading for the vision model zoo.
+
+Reference behavior (vision/models/resnet.py etc.): pretrained=True
+downloads a .pdparams from the paddle CDN via paddle.utils.download and
+load_dict's it. This environment has no egress, so weights are
+file-gated like the vision datasets: looked up in
+$PADDLE_TPU_PRETRAINED_DIR (default ~/.cache/paddle_tpu/models) as
+<arch>.pdparams — paddle-format state dicts, including ones converted
+from torch/HF checkpoints with text/models/convert.py-style tooling.
+Missing weights raise instead of silently returning random init.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["load_pretrained"]
+
+
+def _search_dirs():
+    """PADDLE_TPU_PRETRAINED_DIR first, then the shared offline weights
+    cache used by utils/download.get_weights_path_from_url."""
+    dirs = []
+    env = os.environ.get("PADDLE_TPU_PRETRAINED_DIR")
+    if env:
+        dirs.append(env)
+    home = os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu")
+    dirs += [os.path.join(home, "models"), os.path.join(home, "weights")]
+    return dirs
+
+
+def load_pretrained(model, arch: str):
+    """Load <arch>.pdparams from the offline weight dirs into model, or
+    raise with a clear explanation. Returns the model."""
+    candidates = [os.path.join(d, arch + ".pdparams")
+                  for d in _search_dirs()]
+    path = next((c for c in candidates if os.path.exists(c)), None)
+    if path is None:
+        raise RuntimeError(
+            f"pretrained=True for {arch!r}: no weights found at any of "
+            f"{candidates}. This build runs without network egress — "
+            "place a paddle-format state dict there (set "
+            "PADDLE_TPU_PRETRAINED_DIR to override), e.g. converted "
+            "from a torch/HF checkpoint. Refusing to silently return "
+            "randomly-initialized weights.")
+    import paddle_tpu
+
+    state = paddle_tpu.load(path)
+    try:
+        result = model.set_state_dict(state)
+    except ValueError as e:
+        raise RuntimeError(
+            f"weights at {path} do not fit this {arch!r} architecture "
+            f"variant (check batch_norm/scale/num_classes kwargs): {e}"
+        ) from e
+    missing, unexpected = (result if isinstance(result, tuple)
+                           else (None, None))
+    if missing or unexpected:
+        raise RuntimeError(
+            f"weights at {path} do not match {arch!r}: "
+            f"missing={missing[:5]}{'...' if len(missing) > 5 else ''}, "
+            f"unexpected={unexpected[:5]}"
+            f"{'...' if len(unexpected) > 5 else ''} — likely a "
+            "different architecture variant or an unconverted torch "
+            "checkpoint. Refusing to return partially-initialized "
+            "weights.")
+    return model

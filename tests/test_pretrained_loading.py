@@ -1,0 +1,58 @@
+"""pretrained=True across the vision zoo: file-gated loading (reference
+downloads from the CDN; offline build loads from
+PADDLE_TPU_PRETRAINED_DIR) — never a silent random-init return.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def test_pretrained_true_without_weights_raises():
+    with pytest.raises(RuntimeError, match="PADDLE_TPU_PRETRAINED_DIR"):
+        models.resnet18(pretrained=True)
+    with pytest.raises(RuntimeError):
+        models.vgg11(True)  # positional spelling
+    with pytest.raises(RuntimeError):
+        models.mobilenet_v2(pretrained=True)
+
+
+def test_pretrained_false_still_works():
+    m = models.resnet18(num_classes=7)
+    assert m(paddle.to_tensor(
+        np.zeros((1, 3, 32, 32), np.float32))).shape == [1, 7]
+
+
+def test_pretrained_loads_from_weights_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_PRETRAINED_DIR", str(tmp_path))
+    paddle.seed(7)
+    ref = models.resnet18(num_classes=10)
+    paddle.save(ref.state_dict(),
+                os.path.join(str(tmp_path), "resnet18.pdparams"))
+    paddle.seed(123)  # different init; loaded weights must win
+    got = models.resnet18(pretrained=True, num_classes=10)
+    np.testing.assert_allclose(ref.parameters()[0].numpy(),
+                               got.parameters()[0].numpy())
+
+
+def test_every_factory_intercepts_pretrained():
+    import inspect
+
+    wrapped = 0
+    for name in dir(models):
+        obj = getattr(models, name)
+        if name.startswith("_") or not callable(obj) \
+                or inspect.isclass(obj):
+            continue
+        try:
+            params = inspect.signature(obj).parameters
+        except (TypeError, ValueError):
+            continue
+        if "pretrained" in params:
+            wrapped += 1
+            assert getattr(obj, "__wrapped__", None) is not None, \
+                f"{name} not wrapped"
+    assert wrapped >= 35, f"only {wrapped} factories wrapped"
