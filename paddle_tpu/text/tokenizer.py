@@ -181,3 +181,209 @@ class NativeBpeTokenizer:
 
     def decode(self, ids) -> str:
         return "".join(self.decoder.get(int(i), "") for i in ids)
+
+
+class BasicTokenizer:
+    """BERT basic tokenization (PaddleNLP/HF BasicTokenizer): clean
+    control chars, optional lowercase + accent stripping, split on
+    whitespace and punctuation, isolate CJK codepoints."""
+
+    def __init__(self, do_lower_case=True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text: str) -> List[str]:
+        import unicodedata
+
+        def is_punct(ch):
+            cp = ord(ch)
+            if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
+                    or 123 <= cp <= 126):
+                return True
+            return unicodedata.category(ch).startswith("P")
+
+        def is_cjk(cp):
+            # HF BasicTokenizer._is_chinese_char's 8 ranges
+            return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+                    or 0x20000 <= cp <= 0x2A6DF
+                    or 0x2A700 <= cp <= 0x2B73F
+                    or 0x2B740 <= cp <= 0x2B81F
+                    or 0x2B820 <= cp <= 0x2CEAF
+                    or 0xF900 <= cp <= 0xFAFF
+                    or 0x2F800 <= cp <= 0x2FA1F)
+
+        out = []
+        for ch in text:
+            cp = ord(ch)
+            if cp == 0 or cp == 0xFFFD or unicodedata.category(ch) in (
+                    "Cc", "Cf"):
+                if ch not in ("\t", "\n", "\r"):
+                    continue
+            if is_cjk(cp):
+                out.append(f" {ch} ")
+            else:
+                out.append(ch)
+        text = "".join(out)
+
+        tokens = []
+        for tok in text.split():
+            if self.do_lower_case:
+                tok = tok.lower()
+                tok = "".join(c for c in unicodedata.normalize("NFD", tok)
+                              if unicodedata.category(c) != "Mn")
+            cur = []
+            for ch in tok:
+                if is_punct(ch):
+                    if cur:
+                        tokens.append("".join(cur))
+                        cur = []
+                    tokens.append(ch)
+                else:
+                    cur.append(ch)
+            if cur:
+                tokens.append("".join(cur))
+        return tokens
+
+
+class WordpieceTokenizer:
+    """Greedy longest-match-first wordpiece (PaddleNLP/HF semantics):
+    continuation pieces carry the ## prefix; words that cannot be fully
+    segmented become unk_token."""
+
+    def __init__(self, vocab: Dict[str, int], unk_token="[UNK]",
+                 max_input_chars_per_word=100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_input_chars_per_word = max_input_chars_per_word
+
+    def tokenize(self, word: str) -> List[str]:
+        if len(word) > self.max_input_chars_per_word:
+            return [self.unk_token]
+        pieces = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return [self.unk_token]
+            pieces.append(piece)
+            start = end
+        return pieces
+
+
+class BertTokenizer:
+    """BERT tokenizer: BasicTokenizer + WordpieceTokenizer over a
+    one-token-per-line vocab file (PaddleNLP BertTokenizer /
+    bert-base-uncased format). File-gated like the other tokenizers; a
+    vocab dict can also be passed directly."""
+
+    def __init__(self, vocab_file=None, vocab=None, do_lower_case=True,
+                 unk_token="[UNK]", cls_token="[CLS]", sep_token="[SEP]",
+                 pad_token="[PAD]", mask_token="[MASK]"):
+        if vocab is not None:
+            self.vocab = dict(vocab)
+        elif vocab_file is not None:
+            with open(vocab_file, encoding="utf-8") as fh:
+                self.vocab = {line.rstrip("\n"): i
+                              for i, line in enumerate(fh)}
+        else:
+            raise ValueError("BertTokenizer needs vocab_file or vocab")
+        self.inv = {v: k for k, v in self.vocab.items()}
+        self.basic = BasicTokenizer(do_lower_case)
+        self.wordpiece = WordpieceTokenizer(self.vocab, unk_token)
+        self.unk_token, self.cls_token = unk_token, cls_token
+        self.sep_token, self.pad_token = sep_token, pad_token
+        self.mask_token = mask_token
+
+    @property
+    def vocab_size(self):
+        return len(self.vocab)
+
+    def tokenize(self, text: str) -> List[str]:
+        out = []
+        for word in self.basic.tokenize(text):
+            out.extend(self.wordpiece.tokenize(word))
+        return out
+
+    def convert_tokens_to_ids(self, tokens) -> List[int]:
+        unk = self.vocab.get(self.unk_token, 0)
+        return [self.vocab.get(t, unk) for t in tokens]
+
+    def convert_ids_to_tokens(self, ids) -> List[str]:
+        return [self.inv.get(int(i), self.unk_token) for i in ids]
+
+    def _special_id(self, token):
+        if token not in self.vocab:
+            raise KeyError(
+                f"special token {token!r} is not in the vocabulary — "
+                "BERT encoding needs it in the vocab file")
+        return self.vocab[token]
+
+    def encode(self, text: str, text_pair: Optional[str] = None,
+               add_special_tokens=True) -> List[int]:
+        ids = self.convert_tokens_to_ids(self.tokenize(text))
+        pair_ids = (self.convert_tokens_to_ids(self.tokenize(text_pair))
+                    if text_pair is not None else None)
+        if not add_special_tokens:
+            return ids + (pair_ids or [])
+        cls_id = self._special_id(self.cls_token)
+        sep_id = self._special_id(self.sep_token)
+        out = [cls_id] + ids + [sep_id]
+        if pair_ids is not None:
+            out += pair_ids + [sep_id]
+        return out
+
+    def decode(self, ids, skip_special_tokens=True) -> str:
+        special = {self.cls_token, self.sep_token, self.pad_token,
+                   self.mask_token}
+        toks = []
+        for t in self.convert_ids_to_tokens(ids):
+            if skip_special_tokens and t in special:
+                continue
+            if t.startswith("##") and toks:
+                toks[-1] += t[2:]
+            else:
+                toks.append(t)
+        return " ".join(toks)
+
+    def __call__(self, text, text_pair=None, max_length=None,
+                 padding=False, truncation=False):
+        ids_a = self.convert_tokens_to_ids(self.tokenize(text))
+        ids_b = (self.convert_tokens_to_ids(self.tokenize(text_pair))
+                 if text_pair is not None else None)
+        n_special = 2 + (1 if ids_b is not None else 0)
+        if truncation and max_length:
+            # HF longest_first: pop content tokens from the longer
+            # segment until the assembled sequence fits; [CLS]/[SEP]
+            # survive
+            budget = max(0, max_length - n_special)
+            while len(ids_a) + len(ids_b or []) > budget:
+                if ids_b and len(ids_b) >= len(ids_a):
+                    ids_b.pop()
+                elif ids_a:
+                    ids_a.pop()
+                else:
+                    break
+        cls_id = self._special_id(self.cls_token)
+        sep_id = self._special_id(self.sep_token)
+        ids = [cls_id] + ids_a + [sep_id]
+        token_type = [0] * len(ids)
+        if ids_b is not None:
+            ids += ids_b + [sep_id]
+            token_type += [1] * (len(ids_b) + 1)
+        attn = [1] * len(ids)
+        if padding and max_length and len(ids) < max_length:
+            pad_id = self.vocab.get(self.pad_token, 0)
+            n = max_length - len(ids)
+            ids += [pad_id] * n
+            token_type += [0] * n
+            attn += [0] * n
+        return {"input_ids": ids, "token_type_ids": token_type,
+                "attention_mask": attn}

@@ -23,8 +23,14 @@ def _search_dirs():
     env = os.environ.get("PADDLE_TPU_PRETRAINED_DIR")
     if env:
         dirs.append(env)
+    from ...dataset.common import DATA_HOME
+
     home = os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu")
-    dirs += [os.path.join(home, "models"), os.path.join(home, "weights")]
+    # DATA_HOME/weights is where utils/download.get_weights_path_from_url
+    # caches (honors PADDLE_TPU_DATA_HOME); ~/.cache/paddle_tpu/models is
+    # the hand-provisioned location
+    dirs += [os.path.join(home, "models"),
+             os.path.join(DATA_HOME, "weights")]
     return dirs
 
 

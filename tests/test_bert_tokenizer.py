@@ -1,0 +1,110 @@
+"""BERT tokenizer (BasicTokenizer + WordpieceTokenizer + BertTokenizer,
+PaddleNLP/HF semantics): greedy longest-match wordpiece, lowercasing +
+accent stripping, CJK isolation, special tokens, pair encoding. Parity
+is pinned against transformers' BertTokenizer when available.
+"""
+import os
+
+import pytest
+
+from paddle_tpu.text.tokenizer import (BasicTokenizer, BertTokenizer,
+                                       WordpieceTokenizer)
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+         "the", "quick", "brown", "fox", "jump", "##ed", "##s", "over",
+         "lazy", "dog", ",", "!", "un", "##want", "##ed", "run",
+         "##ning", "hello", "world", "a", "##b", "##c", "no", "##n",
+         "##sen", "##se"]
+
+
+def _tok(**kw):
+    return BertTokenizer(vocab={t: i for i, t in enumerate(VOCAB)}, **kw)
+
+
+def test_wordpiece_greedy_longest_match():
+    wp = WordpieceTokenizer({t: i for i, t in enumerate(VOCAB)})
+    assert wp.tokenize("unwanted") == ["un", "##want", "##ed"]
+    assert wp.tokenize("running") == ["run", "##ning"]
+    assert wp.tokenize("zebra") == ["[UNK]"]
+    assert wp.tokenize("x" * 200) == ["[UNK]"]
+
+
+def test_basic_tokenizer_unicode():
+    b = BasicTokenizer(do_lower_case=True)
+    assert b.tokenize("Héllo, 你好!") == ["hello", ",", "你", "好", "!"]
+    assert b.tokenize("ah博推zz") == ["ah", "博", "推",
+                                              "zz"]
+    b2 = BasicTokenizer(do_lower_case=False)
+    assert b2.tokenize("HeLLo!") == ["HeLLo", "!"]
+
+
+def test_bert_tokenize_encode_decode():
+    tok = _tok()
+    assert tok.tokenize("The quick brown fox jumped!") == \
+        ["the", "quick", "brown", "fox", "jump", "##ed", "!"]
+    ids = tok.encode("The quick brown fox jumped!")
+    assert ids[0] == tok.vocab["[CLS]"] and ids[-1] == tok.vocab["[SEP]"]
+    assert tok.decode(ids) == "the quick brown fox jumped !"
+
+
+def test_bert_call_padding_truncation_pairs():
+    tok = _tok()
+    enc = tok("The quick fox", "lazy dog", max_length=12, padding=True)
+    assert len(enc["input_ids"]) == 12
+    assert enc["attention_mask"][-1] == 0
+    first_len = len(tok.encode("The quick fox"))
+    assert enc["token_type_ids"][first_len] == 1
+    enc2 = tok("The quick brown fox jumped over the lazy dog",
+               max_length=5, truncation=True)
+    assert len(enc2["input_ids"]) == 5
+
+
+def test_vocab_file_loading(tmp_path):
+    vf = os.path.join(str(tmp_path), "vocab.txt")
+    with open(vf, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(VOCAB) + "\n")
+    tok = BertTokenizer(vocab_file=vf)
+    assert tok.vocab_size == len(set(VOCAB))  # "##ed" appears twice
+    assert tok.tokenize("hello world") == ["hello", "world"]
+    with pytest.raises(ValueError):
+        BertTokenizer()
+
+
+def test_hf_transformers_parity(tmp_path):
+    transformers = pytest.importorskip("transformers")
+    vf = os.path.join(str(tmp_path), "vocab.txt")
+    with open(vf, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(VOCAB) + "\n")
+    hf = transformers.BertTokenizer(vf, do_lower_case=True)
+    ours = BertTokenizer(vocab_file=vf)
+    cases = ["The quick brown fox jumped!", "unwanted running",
+             "Héllo, World!", "nonsense abc", "  a  b ,, c  ",
+             "UNWANTED, running", "zebra xyz !"]
+    for c in cases:
+        assert hf.tokenize(c) == ours.tokenize(c), c
+        assert hf.encode(c) == ours.encode(c), c
+    h = hf("The quick fox", "lazy dog")
+    o = ours("The quick fox", "lazy dog")
+    assert h["input_ids"] == o["input_ids"]
+    assert h["token_type_ids"] == o["token_type_ids"]
+    # longest_first truncation parity (single + pair, several budgets)
+    for ml in range(4, 12):
+        for a, b in [("the quick brown fox", "over the lazy dog"),
+                     ("the quick", "over the lazy dog jumped"),
+                     ("the quick brown fox jumped", None)]:
+            h = hf(a, b, max_length=ml, truncation=True) if b else \
+                hf(a, max_length=ml, truncation=True)
+            o = ours(a, b, max_length=ml, truncation=True) if b else \
+                ours(a, max_length=ml, truncation=True)
+            assert h["input_ids"] == o["input_ids"], (ml, a, b)
+    # pair without special tokens returns both segments
+    assert hf.encode("the fox", "lazy dog", add_special_tokens=False) == \
+        ours.encode("the fox", "lazy dog", add_special_tokens=False)
+
+
+def test_missing_special_token_raises():
+    tok = BertTokenizer(vocab={"the": 0, "fox": 1, "[UNK]": 2})
+    with pytest.raises(KeyError, match="CLS"):
+        tok.encode("the fox")
+    # no-special encoding still fine without [CLS]/[SEP] in vocab
+    assert tok.encode("the fox", add_special_tokens=False) == [0, 1]
