@@ -183,42 +183,53 @@ class NativeBpeTokenizer:
         return "".join(self.decoder.get(int(i), "") for i in ids)
 
 
+import unicodedata as _ud
+
+
+def _is_punct(ch):
+    cp = ord(ch)
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
+            or 123 <= cp <= 126):
+        return True
+    return _ud.category(ch).startswith("P")
+
+
+def _is_cjk(cp):
+    # HF BasicTokenizer._is_chinese_char's 8 ranges
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF
+            or 0x2A700 <= cp <= 0x2B73F
+            or 0x2B740 <= cp <= 0x2B81F
+            or 0x2B820 <= cp <= 0x2CEAF
+            or 0xF900 <= cp <= 0xFAFF
+            or 0x2F800 <= cp <= 0x2FA1F)
+
+
+def _is_control(ch):
+    # HF _is_control: every C* category except the whitespace trio
+    if ch in ("\t", "\n", "\r"):
+        return False
+    return _ud.category(ch).startswith("C")
+
+
 class BasicTokenizer:
     """BERT basic tokenization (PaddleNLP/HF BasicTokenizer): clean
     control chars, optional lowercase + accent stripping, split on
-    whitespace and punctuation, isolate CJK codepoints."""
+    whitespace and punctuation, isolate CJK codepoints. Tokens in
+    ``never_split`` (e.g. [MASK]) pass through unsplit."""
 
-    def __init__(self, do_lower_case=True):
+    def __init__(self, do_lower_case=True, never_split=None):
         self.do_lower_case = do_lower_case
+        self.never_split = set(never_split or [])
 
-    def tokenize(self, text: str) -> List[str]:
-        import unicodedata
-
-        def is_punct(ch):
-            cp = ord(ch)
-            if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
-                    or 123 <= cp <= 126):
-                return True
-            return unicodedata.category(ch).startswith("P")
-
-        def is_cjk(cp):
-            # HF BasicTokenizer._is_chinese_char's 8 ranges
-            return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
-                    or 0x20000 <= cp <= 0x2A6DF
-                    or 0x2A700 <= cp <= 0x2B73F
-                    or 0x2B740 <= cp <= 0x2B81F
-                    or 0x2B820 <= cp <= 0x2CEAF
-                    or 0xF900 <= cp <= 0xFAFF
-                    or 0x2F800 <= cp <= 0x2FA1F)
-
+    def tokenize(self, text: str, never_split=None) -> List[str]:
+        never = self.never_split | set(never_split or [])
         out = []
         for ch in text:
             cp = ord(ch)
-            if cp == 0 or cp == 0xFFFD or unicodedata.category(ch) in (
-                    "Cc", "Cf"):
-                if ch not in ("\t", "\n", "\r"):
-                    continue
-            if is_cjk(cp):
+            if cp == 0 or cp == 0xFFFD or _is_control(ch):
+                continue
+            if _is_cjk(cp):
                 out.append(f" {ch} ")
             else:
                 out.append(ch)
@@ -226,13 +237,16 @@ class BasicTokenizer:
 
         tokens = []
         for tok in text.split():
+            if tok in never:
+                tokens.append(tok)
+                continue
             if self.do_lower_case:
                 tok = tok.lower()
-                tok = "".join(c for c in unicodedata.normalize("NFD", tok)
-                              if unicodedata.category(c) != "Mn")
+                tok = "".join(c for c in _ud.normalize("NFD", tok)
+                              if _ud.category(c) != "Mn")
             cur = []
             for ch in tok:
-                if is_punct(ch):
+                if _is_punct(ch):
                     if cur:
                         tokens.append("".join(cur))
                         cur = []
@@ -296,11 +310,37 @@ class BertTokenizer:
         else:
             raise ValueError("BertTokenizer needs vocab_file or vocab")
         self.inv = {v: k for k, v in self.vocab.items()}
-        self.basic = BasicTokenizer(do_lower_case)
-        self.wordpiece = WordpieceTokenizer(self.vocab, unk_token)
         self.unk_token, self.cls_token = unk_token, cls_token
         self.sep_token, self.pad_token = sep_token, pad_token
         self.mask_token = mask_token
+        self.all_special_tokens = [unk_token, cls_token, sep_token,
+                                   pad_token, mask_token]
+        self.basic = BasicTokenizer(do_lower_case,
+                                    never_split=self.all_special_tokens)
+        self.wordpiece = WordpieceTokenizer(self.vocab, unk_token)
+
+    @classmethod
+    def from_pretrained(cls, name_or_path, **kwargs):
+        """File-gated from_pretrained (PaddleNLP spelling): accepts a
+        directory containing vocab.txt, a vocab.txt path, or a model
+        name resolved under DATA_HOME/tokenizers/<name>/vocab.txt."""
+        candidates = []
+        if os.path.isdir(name_or_path):
+            candidates.append(os.path.join(name_or_path, "vocab.txt"))
+        elif os.path.isfile(name_or_path):
+            candidates.append(name_or_path)
+        else:
+            from ..dataset.common import DATA_HOME
+
+            candidates.append(os.path.join(
+                DATA_HOME, "tokenizers", str(name_or_path), "vocab.txt"))
+        path = next((c for c in candidates if os.path.exists(c)), None)
+        if path is None:
+            raise RuntimeError(
+                f"BertTokenizer.from_pretrained({name_or_path!r}): no "
+                f"vocab.txt at {candidates}. This build has no network "
+                "egress — place the vocab file there.")
+        return cls(vocab_file=path, **kwargs)
 
     @property
     def vocab_size(self):
@@ -309,7 +349,10 @@ class BertTokenizer:
     def tokenize(self, text: str) -> List[str]:
         out = []
         for word in self.basic.tokenize(text):
-            out.extend(self.wordpiece.tokenize(word))
+            if word in self.basic.never_split:
+                out.append(word)  # special tokens stay whole
+            else:
+                out.extend(self.wordpiece.tokenize(word))
         return out
 
     def convert_tokens_to_ids(self, tokens) -> List[int]:

@@ -30,7 +30,8 @@ def _search_dirs():
     # caches (honors PADDLE_TPU_DATA_HOME); ~/.cache/paddle_tpu/models is
     # the hand-provisioned location
     dirs += [os.path.join(home, "models"),
-             os.path.join(DATA_HOME, "weights")]
+             os.path.join(DATA_HOME, "weights"),
+             os.path.join(home, "weights")]
     return dirs
 
 

@@ -102,6 +102,32 @@ def test_hf_transformers_parity(tmp_path):
         ours.encode("the fox", "lazy dog", add_special_tokens=False)
 
 
+def test_special_tokens_never_split():
+    tok = _tok()
+    assert tok.tokenize("the [MASK] fox") == ["the", "[MASK]", "fox"]
+    ids = tok.encode("the [MASK] fox")
+    assert tok.vocab["[MASK]"] in ids
+
+
+def test_control_chars_stripped_like_hf():
+    b = BasicTokenizer()
+    # private-use (Co) char inside a word is removed, not kept
+    assert b.tokenize("ab" + chr(0xE000) + "c") == ["abc"]
+    assert b.tokenize("a​b") == ["ab"]  # Cf zero-width space
+
+
+def test_from_pretrained_file_gated(tmp_path):
+    vf = os.path.join(str(tmp_path), "vocab.txt")
+    with open(vf, "w", encoding="utf-8") as fh:
+        fh.write("[CLS]\n[SEP]\n[UNK]\nhello\nworld\n")
+    tok = BertTokenizer.from_pretrained(str(tmp_path))   # directory
+    assert tok.encode("hello world") == [0, 3, 4, 1]
+    tok2 = BertTokenizer.from_pretrained(vf)             # file path
+    assert tok2.vocab_size == 5
+    with pytest.raises(RuntimeError, match="no network egress"):
+        BertTokenizer.from_pretrained("bert-base-uncased")
+
+
 def test_missing_special_token_raises():
     tok = BertTokenizer(vocab={"the": 0, "fox": 1, "[UNK]": 2})
     with pytest.raises(KeyError, match="CLS"):
