@@ -135,3 +135,10 @@ from . import reader  # noqa: E402,F401
 
 __all__ += ["data", "WeightedAverage", "create_lod_tensor",
             "create_random_int_lodtensor", "LayerHelper", "reader"]
+from . import transpiler  # noqa: E402,F401
+from .transpiler import (DistributeTranspiler,  # noqa: E402,F401
+                         DistributeTranspilerConfig, memory_optimize,
+                         release_memory)
+__all__ += ["transpiler", "DistributeTranspiler",
+            "DistributeTranspilerConfig", "memory_optimize",
+            "release_memory"]

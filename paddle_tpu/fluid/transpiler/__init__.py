@@ -1,0 +1,130 @@
+"""Reference: python/paddle/fluid/transpiler/ — the 1.x distributed
+program transpilers (DistributeTranspiler rewrote a program into
+trainer/pserver halves for the parameter-server runtime).
+
+Single-controller adaptation: there are no pserver processes to emit —
+every parameter lives mesh-sharded inside the one compiled program (see
+distributed/ps for the TPU-native PS analog). transpile() therefore
+validates and records the request, get_trainer_program() returns the
+program itself (training is collective), and get_pserver_program()
+raises with guidance rather than emitting a program that could never
+run here.
+"""
+from __future__ import annotations
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "PSDispatcher", "HashName", "RoundRobin",
+           "memory_optimize", "release_memory"]
+
+
+class DistributeTranspilerConfig:
+    """Reference transpiler/distribute_transpiler.py config: field names
+    kept; slice_var_up/min_block_size shaped the pserver var split,
+    which GSPMD handles via shardings here."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = RoundRobin
+        self.min_block_size = 8192
+        self.enable_dc_asgd = False
+        self.mode = "pserver"
+        self.print_log = False
+        self.wait_port = True
+        self.sync_mode = True
+        self.runtime_split_send_recv = False
+        self.geo_sgd_mode = False
+        self.geo_sgd_need_push_nums = 100
+
+
+class PSDispatcher:
+    """Reference transpiler/ps_dispatcher.py PSDispatcher base: custom
+    split_method implementations subclass this and override dispatch."""
+
+    def __init__(self, pserver_endpoints=None):
+        self._eps = list(pserver_endpoints or [])
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+    def reset(self):
+        self._i = 0
+
+
+class HashName(PSDispatcher):
+    """Reference transpiler/ps_dispatcher.py HashName."""
+
+    def dispatch(self, varlist):
+        if not self._eps:
+            return []
+        return [self._eps[hash(v.name if hasattr(v, "name") else str(v))
+                          % len(self._eps)] for v in varlist]
+
+
+class RoundRobin(PSDispatcher):
+    """Reference transpiler/ps_dispatcher.py RoundRobin."""
+
+    def __init__(self, pserver_endpoints=None):
+        super().__init__(pserver_endpoints)
+        self._i = 0
+
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            if not self._eps:
+                break
+            out.append(self._eps[self._i % len(self._eps)])
+            self._i += 1
+        return out
+
+
+class DistributeTranspiler:
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._transpiled = False
+        self._program = None
+        self._startup = None
+
+    def transpile(self, trainer_id, program=None, pservers="",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint=""):
+        from ..framework import default_main_program
+
+        self.trainer_id = int(trainer_id)
+        self.trainer_num = int(trainers)
+        self.pserver_endpoints = [p for p in pservers.split(",") if p]
+        self._program = (program if program is not None
+                         else default_main_program())
+        self._startup = startup_program
+        self._transpiled = True
+
+    def get_trainer_program(self, wait_port=True):
+        if not self._transpiled:
+            raise RuntimeError("call transpile() first")
+        # collective single-controller: the trainer program IS the
+        # program — parameters are mesh-sharded, not pserver-hosted
+        return self._program
+
+    def get_pserver_program(self, endpoint):
+        raise RuntimeError(
+            "No pserver program exists on the TPU build: parameter "
+            "serving is replaced by mesh-sharded tables inside the "
+            "compiled step (paddle.distributed.ps / rec.ShardedEmbedding)."
+            " Run the trainer program on every host instead.")
+
+    def get_pserver_programs(self, endpoint):
+        return self.get_pserver_program(endpoint)
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        return self._startup
+
+
+def memory_optimize(input_program=None, skip_opt_set=None,
+                    print_log=False, level=0, skip_grads=True):
+    """Reference transpiler/memory_optimization_transpiler.py: a no-op
+    since XLA owns buffer reuse/liveness on TPU."""
+    return None
+
+
+def release_memory(input_program=None, skip_opt_set=None):
+    return None

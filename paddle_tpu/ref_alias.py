@@ -345,6 +345,19 @@ _alias("fluid.incubate.checkpoint.checkpoint_saver",
        "distributed.checkpoint",
        "reference fluid/incubate/checkpoint/checkpoint_saver.py")
 
+# ---- fluid.transpiler per-file spellings ----
+for _leaf, _names in (("distribute_transpiler",
+                       {"DistributeTranspiler",
+                        "DistributeTranspilerConfig"}),
+                      ("ps_dispatcher", {"PSDispatcher", "HashName",
+                                  "RoundRobin"}),
+                      ("memory_optimization_transpiler",
+                       {"memory_optimize", "release_memory"}),
+                      ("geo_sgd_transpiler", None),
+                      ("collective", None)):
+    _alias(f"fluid.transpiler.{_leaf}", "fluid.transpiler",
+           f"reference fluid/transpiler/{_leaf}.py", names=_names)
+
 # ---- misc single-file spellings ----
 _alias("cost_model.cost_model", "cost_model",
        "reference cost_model/cost_model.py")

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import json
 import os
+import unicodedata as _ud
 from typing import Dict, List, Optional
 
 
@@ -181,9 +182,6 @@ class NativeBpeTokenizer:
 
     def decode(self, ids) -> str:
         return "".join(self.decoder.get(int(i), "") for i in ids)
-
-
-import unicodedata as _ud
 
 
 def _is_punct(ch):

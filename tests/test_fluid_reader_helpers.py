@@ -247,6 +247,43 @@ def test_detection_map_difficult_and_multiclass():
         DetectionMAP(ap_version="7point")
 
 
+def test_distribute_transpiler_compat():
+    from paddle_tpu.fluid.transpiler import (DistributeTranspiler,
+                                             DistributeTranspilerConfig)
+    from paddle_tpu.fluid.transpiler.ps_dispatcher import (HashName,
+                                                           RoundRobin)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 4])
+        y = layers.fc(x, size=2)
+    t = DistributeTranspiler(DistributeTranspilerConfig())
+    t.transpile(trainer_id=0, program=main,
+                pservers="h1:6000,h2:6000", trainers=2)
+    assert t.get_trainer_program() is main
+    assert t.pserver_endpoints == ["h1:6000", "h2:6000"]
+    with pytest.raises(RuntimeError, match="mesh-sharded"):
+        t.get_pserver_program("h1:6000")
+    with pytest.raises(RuntimeError):
+        DistributeTranspiler().get_trainer_program()
+
+    rr = RoundRobin(["a", "b"])
+
+    class V:
+        name = "w1"
+
+    assert rr.dispatch([V(), V(), V()]) == ["a", "b", "a"]
+    hn = HashName(["a", "b"])
+    assert hn.dispatch([V()])[0] in ("a", "b")
+    assert fluid.memory_optimize() is None
+    assert fluid.release_memory() is None
+    # the transpiled trainer program still executes
+    exe = fluid.Executor()
+    out = exe.run(t.get_trainer_program(),
+                  feed={"x": np.ones((2, 4), np.float32)}, fetch_list=[y])
+    assert out[0].shape == (2, 2)
+
+
 def test_fluid_evaluator_and_install_check_spellings():
     from paddle_tpu.fluid.evaluator import ChunkEvaluator
     from paddle_tpu.fluid.install_check import run_check
