@@ -116,7 +116,16 @@ class DistributeTranspiler:
 
     def get_startup_program(self, endpoint=None, pserver_program=None,
                             startup_program=None):
-        return self._startup
+        if not self._transpiled:
+            raise RuntimeError("call transpile() first")
+        if self._startup is not None:
+            return self._startup
+        # transpile() was called without startup_program: hand back the
+        # ambient startup program rather than None (Executor.run(None)
+        # would execute the MAIN program)
+        from ..framework import default_startup_program
+
+        return default_startup_program()
 
 
 def memory_optimize(input_program=None, skip_opt_set=None,
