@@ -389,6 +389,34 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
           if input_length is not None else [a.shape[1]] * a.shape[0])
     ll = (np.asarray(label_length._data).reshape(-1)
           if label_length is not None else [b.shape[1]] * b.shape[0])
+
+    # native batch DP (runtime/cpp/edit_distance.cc, GIL released,
+    # thread-pooled) — ignored_tokens are stripped host-side first
+    try:
+        from ...runtime.native import edit_distance_batch
+        import jax.numpy as jnp
+
+        n_rows = a.shape[0]
+        hyp = np.zeros((n_rows, a.shape[1]), np.int32)
+        ref = np.zeros((n_rows, b.shape[1]), np.int32)
+        hl = np.zeros(n_rows, np.int64)
+        rl = np.zeros(n_rows, np.int64)
+        for i in range(n_rows):
+            if ignored_tokens:
+                s1 = np.asarray(_strip(a[i, :int(il[i])]), np.int32)
+                s2 = np.asarray(_strip(b[i, :int(ll[i])]), np.int32)
+            else:  # no stripping: keep it vectorized
+                s1 = a[i, :int(il[i])].astype(np.int32)
+                s2 = b[i, :int(ll[i])].astype(np.int32)
+            hyp[i, :len(s1)] = s1
+            ref[i, :len(s2)] = s2
+            hl[i], rl[i] = len(s1), len(s2)
+        d = edit_distance_batch(hyp, hl, ref, rl, normalized=normalized)
+        return (Tensor(jnp.asarray(d.reshape(-1, 1))),
+                Tensor(jnp.asarray(np.int64(n_rows))))
+    except ImportError:
+        pass
+
     dists = []
     for i in range(a.shape[0]):
         s1 = _strip(a[i, :int(il[i])])

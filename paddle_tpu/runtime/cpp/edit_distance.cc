@@ -1,0 +1,75 @@
+// Native batch Levenshtein distance for the eval pipeline.
+//
+// TPU-side analog of the reference's EditDistanceOp
+// (paddle/fluid/operators/edit_distance_op.cu): distances are a
+// host-side eval computation here, so the batch DP runs in C++ with the
+// GIL released and a thread pool across pairs. Semantics mirror
+// fluid/layers/tail.py::edit_distance and fluid/metrics.py::_levenshtein
+// exactly (tests/test_native_edit_distance.py pins parity):
+// sequences are int32 id arrays with explicit lengths; `normalized`
+// divides by the reference length (0 length -> distance stays raw,
+// matching the python guard).
+//
+// Build: make -C paddle_tpu/runtime/cpp libptpu_editdist.so
+
+#include <algorithm>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+namespace {
+
+float pair_distance(const int32_t* a, long la, const int32_t* b, long lb) {
+  if (la == 0) return static_cast<float>(lb);
+  if (lb == 0) return static_cast<float>(la);
+  std::vector<int32_t> prev(lb + 1), cur(lb + 1);
+  for (long j = 0; j <= lb; ++j) prev[j] = static_cast<int32_t>(j);
+  for (long i = 1; i <= la; ++i) {
+    cur[0] = static_cast<int32_t>(i);
+    const int32_t ai = a[i - 1];
+    for (long j = 1; j <= lb; ++j) {
+      int32_t cost = (ai == b[j - 1]) ? 0 : 1;
+      cur[j] = std::min({prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost});
+    }
+    std::swap(prev, cur);
+  }
+  return static_cast<float>(prev[lb]);
+}
+
+}  // namespace
+
+extern "C" {
+
+// hyp: [n, max_hyp] int32 (row i valid to hyp_len[i]); ref likewise.
+// out: [n] float32. normalized: divide by ref length when > 0.
+void ptpu_edit_distance_batch(const int32_t* hyp, const long* hyp_len,
+                              long max_hyp, const int32_t* ref,
+                              const long* ref_len, long max_ref, long n,
+                              int normalized, float* out) {
+  auto work = [&](long lo, long hi) {
+    for (long i = lo; i < hi; ++i) {
+      float d = pair_distance(hyp + i * max_hyp, hyp_len[i],
+                              ref + i * max_ref, ref_len[i]);
+      if (normalized && ref_len[i] > 0) {
+        d /= static_cast<float>(ref_len[i]);
+      }
+      out[i] = d;
+    }
+  };
+  unsigned hw = std::thread::hardware_concurrency();
+  long n_threads = std::min<long>(hw ? hw : 1, 8);
+  if (n < 16 || n_threads <= 1) {
+    work(0, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  long chunk = (n + n_threads - 1) / n_threads;
+  for (long t = 0; t < n_threads; ++t) {
+    long lo = t * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    pool.emplace_back(work, lo, hi);
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // extern "C"

@@ -189,3 +189,68 @@ def parse_ctr_batch(lines, num_dense, num_sparse, ids_per_slot,
         raise ValueError(f"malformed criteo line at row {-rc - 1}: "
                          f"{lines[-rc - 1][:80]!r}")
     return ids, dense, label
+
+
+_EDITDIST_SO = os.path.join(_HERE, "cpp", "libptpu_editdist.so")
+_editdist_lib = None
+
+
+def load_editdist_library():
+    """Load (building if needed) the native batch edit-distance library;
+    raises ImportError (same contract/locking as load_lib). A build
+    failure is cached so per-batch eval calls don't re-spawn make."""
+    global _editdist_lib
+    with _LOCK:
+        if _editdist_lib is False:
+            raise ImportError("native edit-distance build failed earlier")
+        if _editdist_lib is not None:
+            return _editdist_lib
+        try:
+            lib = _load_shared(_EDITDIST_SO, "libptpu_editdist.so")
+        except ImportError:
+            _editdist_lib = False
+            raise
+        lib.ptpu_edit_distance_batch.restype = None
+        lib.ptpu_edit_distance_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_long),
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_long),
+            ctypes.c_long, ctypes.c_long, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float)]
+        _editdist_lib = lib
+        return _editdist_lib
+
+
+def edit_distance_batch(hyp, hyp_len, ref, ref_len, normalized=False):
+    """Batch Levenshtein over padded int32 id arrays via the native
+    library (GIL released, thread-pooled). hyp [n, max_hyp], ref
+    [n, max_ref], lengths [n]. Returns float32 [n]; raises ImportError
+    when the native library is unavailable."""
+    lib = load_editdist_library()
+    hyp = np.ascontiguousarray(hyp, dtype=np.int32)
+    ref = np.ascontiguousarray(ref, dtype=np.int32)
+    hyp_len = np.ascontiguousarray(hyp_len, dtype=np.int64)
+    ref_len = np.ascontiguousarray(ref_len, dtype=np.int64)
+    if hyp.ndim != 2 or ref.ndim != 2:
+        raise ValueError(
+            f"hyp/ref must be 2-D padded arrays, got {hyp.ndim}-D/"
+            f"{ref.ndim}-D")
+    n = hyp.shape[0]
+    if ref.shape[0] != n or hyp_len.shape[0] != n or ref_len.shape[0] != n:
+        raise ValueError("batch dims of hyp/ref/lengths disagree")
+    if (hyp_len.min(initial=0) < 0 or ref_len.min(initial=0) < 0
+            or hyp_len.max(initial=0) > hyp.shape[1]
+            or ref_len.max(initial=0) > ref.shape[1]):
+        raise ValueError("sequence lengths out of bounds for the padded "
+                         "arrays (native code would read past the row)")
+    out = np.zeros(n, dtype=np.float32)
+    lib.ptpu_edit_distance_batch(
+        hyp.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        hyp_len.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        hyp.shape[1],
+        ref.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ref_len.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        ref.shape[1],
+        n, 1 if normalized else 0,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return out

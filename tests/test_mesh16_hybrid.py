@@ -9,8 +9,6 @@ import subprocess
 import sys
 import textwrap
 
-import pytest
-
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 SCRIPT = textwrap.dedent("""
@@ -57,7 +55,6 @@ SCRIPT = textwrap.dedent("""
 """)
 
 
-@pytest.mark.timeout(900)
 def test_4d_hybrid_on_16_devices():
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")

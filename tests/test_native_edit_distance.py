@@ -1,0 +1,120 @@
+"""Native batch edit distance (runtime/cpp/edit_distance.cc): exact
+parity with the python DP in fluid.layers.edit_distance and
+fluid.metrics._levenshtein, including lengths, ignored_tokens and
+normalization. Reference analog: paddle/fluid/operators/edit_distance_op.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.fluid.layers as layers
+import paddle_tpu.runtime.native as nat
+from paddle_tpu.fluid.metrics import _levenshtein
+
+try:
+    nat.load_editdist_library()
+    HAVE_NATIVE = True
+except ImportError:
+    HAVE_NATIVE = False
+
+needs_native = pytest.mark.skipif(not HAVE_NATIVE,
+                                  reason="no C++ toolchain")
+
+
+def _python_fallback(*args, **kwargs):
+    real = nat.load_editdist_library
+
+    def boom():
+        raise ImportError("forced fallback")
+
+    nat.load_editdist_library = boom
+    try:
+        return layers.edit_distance(*args, **kwargs)
+    finally:
+        nat.load_editdist_library = real
+
+
+@needs_native
+def test_native_matches_python_oracle():
+    rng = np.random.default_rng(0)
+    B, L = 32, 80
+    a = rng.integers(0, 12, (B, L)).astype(np.int32)
+    b = rng.integers(0, 12, (B, L)).astype(np.int32)
+    il = rng.integers(10, L + 1, B)
+    ll = rng.integers(10, L + 1, B)
+    d, n = layers.edit_distance(
+        a, b, normalized=False,
+        input_length=paddle_tpu.to_tensor(il),
+        label_length=paddle_tpu.to_tensor(ll))
+    assert int(np.asarray(n._data)) == B
+    dn = np.asarray(d._data).reshape(-1)
+    for i in range(0, B, 5):
+        exp = _levenshtein(list(a[i, :il[i]]), list(b[i, :ll[i]]))
+        assert dn[i] == exp
+
+
+@needs_native
+@pytest.mark.parametrize("normalized", [False, True])
+@pytest.mark.parametrize("ignored", [None, [3, 7]])
+def test_native_equals_python_path(normalized, ignored):
+    rng = np.random.default_rng(1)
+    B, L = 12, 40
+    a = rng.integers(0, 10, (B, L)).astype(np.int32)
+    b = rng.integers(0, 10, (B, L)).astype(np.int32)
+    il = rng.integers(5, L + 1, B)
+    ll = rng.integers(5, L + 1, B)
+    kw = dict(normalized=normalized, ignored_tokens=ignored,
+              input_length=paddle_tpu.to_tensor(il),
+              label_length=paddle_tpu.to_tensor(ll))
+    d_native, _ = layers.edit_distance(a, b, **kw)
+    d_python, _ = _python_fallback(a, b, **kw)
+    np.testing.assert_allclose(np.asarray(d_native._data),
+                               np.asarray(d_python._data), rtol=1e-6)
+
+
+@needs_native
+def test_native_edge_cases():
+    from paddle_tpu.runtime.native import edit_distance_batch
+
+    # empty vs non-empty, identical, completely different
+    hyp = np.array([[0, 0, 0], [1, 2, 3], [1, 2, 3]], np.int32)
+    ref = np.array([[5, 6, 0], [1, 2, 3], [7, 8, 9]], np.int32)
+    d = edit_distance_batch(hyp, np.array([0, 3, 3]), ref,
+                            np.array([2, 3, 3]))
+    np.testing.assert_allclose(d, [2.0, 0.0, 3.0])
+    # normalized divides by ref length
+    dn = edit_distance_batch(hyp, np.array([0, 3, 3]), ref,
+                             np.array([2, 3, 3]), normalized=True)
+    np.testing.assert_allclose(dn, [1.0, 0.0, 1.0])
+    # zero-length ref: raw distance (python max(n,1) guard parity)
+    d0 = edit_distance_batch(np.array([[1, 2]], np.int32), np.array([2]),
+                             np.array([[0, 0]], np.int32), np.array([0]),
+                             normalized=True)
+    np.testing.assert_allclose(d0, [2.0])
+
+
+@needs_native
+def test_bounds_validation():
+    from paddle_tpu.runtime.native import edit_distance_batch
+
+    h = np.zeros((1, 3), np.int32)
+    r = np.zeros((1, 3), np.int32)
+    with pytest.raises(ValueError, match="out of bounds"):
+        edit_distance_batch(h, np.array([5]), r, np.array([3]))
+    with pytest.raises(ValueError, match="2-D"):
+        edit_distance_batch(np.zeros(3, np.int32), np.array([3]),
+                            r, np.array([3]))
+    with pytest.raises(ValueError, match="disagree"):
+        edit_distance_batch(h, np.array([3, 3]), r, np.array([3]))
+
+
+@needs_native
+def test_large_batch_threaded():
+    rng = np.random.default_rng(2)
+    B, L = 256, 64
+    a = rng.integers(0, 8, (B, L)).astype(np.int32)
+    b = rng.integers(0, 8, (B, L)).astype(np.int32)
+    d, _ = layers.edit_distance(a, b, normalized=False)
+    dn = np.asarray(d._data).reshape(-1)
+    for i in (0, 100, 255):
+        assert dn[i] == _levenshtein(list(a[i]), list(b[i]))
