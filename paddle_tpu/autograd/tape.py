@@ -154,9 +154,9 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
             "inputs have stop_gradient=True"
         )
     if grad_tensor is None:
-        if tensor.size != 1:
-            raise RuntimeError(
-                "backward() on a non-scalar requires grad_tensor")
+        # paddle semantics (varbase_patch_methods.py backward): a None
+        # grad_tensor seeds ones_like for ANY shape, scalar or not
+        # (unlike torch, which rejects non-scalar roots)
         seed_ct = jnp.ones_like(tensor._data)
     else:
         seed_ct = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)

@@ -346,3 +346,34 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
         # match paddle: python ints -> int64 (jax x64-off folds to int32)
         data = np.asarray(data)
     return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def __getattr__(name):
+    """Module-level fallback (PEP 562): the reference's ``paddle.tensor``
+    package re-exports every tensor op (``paddle.tensor.triu`` etc.);
+    here the ops live in tensor_ops — forward unknown attributes there
+    so both spellings work while this module keeps owning the Tensor
+    class."""
+    import sys
+    import types
+
+    if name.startswith("_"):
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    pkg = __name__.rsplit(".", 1)[0]
+    # stat precedes math: stat.mean/std/var win (the documented
+    # tensor_ops precedence), matching paddle_tpu.tensor_ops resolution
+    for sub in ("stat", "creation", "manipulation", "logic", "search",
+                "math", "linalg", "random", "einsum", "extras"):
+        # sys.modules only: all tensor_ops submodules are loaded with the
+        # package; a missing entry means we're mid-package-init and must
+        # not trigger circular imports for a speculative probe
+        mod = sys.modules.get(pkg + ".tensor_ops." + sub)
+        if mod is None or not hasattr(mod, name):
+            continue
+        value = getattr(mod, name)
+        if isinstance(value, types.ModuleType):
+            continue  # don't leak jnp/np module imports
+        globals()[name] = value  # cache: next access skips the scan
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -24,12 +24,35 @@ def binary(jfn, differentiable=True):
     return op
 
 
-def reduction(jfn):
-    """paddle reductions: (x, axis=None, keepdim=False)."""
-    def op(x, axis=None, keepdim=False, name=None):
-        if isinstance(axis, (list, tuple)):
-            axis = tuple(axis)
-        return apply(lambda a: jfn(a, axis=axis, keepdims=keepdim), x)
+def _reduce_impl(jfn, x, axis, keepdim, dtype):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+
+    def f(a):
+        if dtype is not None:
+            from ..framework.dtype import convert_dtype
+
+            a = a.astype(convert_dtype(dtype))
+        return jfn(a, axis=axis, keepdims=keepdim)
+
+    return apply(f, x)
+
+
+def reduction(jfn, dtype_slot=None):
+    """paddle reductions. The positional slot of ``dtype`` matches the
+    reference signature exactly — paddle.sum/nansum: (x, axis, dtype,
+    keepdim); paddle.prod: (x, axis, keepdim, dtype); everything else
+    (mean/max/min/amax/amin/logsumexp/all/any) has NO dtype parameter,
+    so positional keepdim keeps working."""
+    if dtype_slot == "before_keepdim":
+        def op(x, axis=None, dtype=None, keepdim=False, name=None):
+            return _reduce_impl(jfn, x, axis, keepdim, dtype)
+    elif dtype_slot == "after_keepdim":
+        def op(x, axis=None, keepdim=False, dtype=None, name=None):
+            return _reduce_impl(jfn, x, axis, keepdim, dtype)
+    else:
+        def op(x, axis=None, keepdim=False, name=None):
+            return _reduce_impl(jfn, x, axis, keepdim, None)
     op.__name__ = getattr(jfn, "__name__", "reduce")
     return op
 

@@ -63,13 +63,23 @@ def empty_like(x, dtype=None, name=None):
 
 
 def arange(start=0, end=None, step=1, dtype=None, name=None):
-    start, end, step = raw(start), raw(end), raw(step)
+    def _scalar(v):
+        v = raw(v)
+        # reference accepts 1-element Tensors for start/end/step
+        return v.reshape(()) if hasattr(v, "reshape") and getattr(
+            v, "size", 1) == 1 and getattr(v, "ndim", 0) > 0 else v
+
+    start, end, step = _scalar(start), _scalar(end), _scalar(step)
     if end is None:
         start, end = 0, start
+    def _floaty(v):
+        return isinstance(v, float) or (
+            hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating))
+
     dt = dtype_mod.convert_dtype(dtype)
     if dt is None:
         dt = (dtype_mod.get_default_dtype()
-              if any(isinstance(v, float) for v in (start, end, step))
+              if any(_floaty(v) for v in (start, end, step))
               else np.dtype(np.int64))
     return Tensor(jnp.arange(start, end, step, dtype=dt))
 

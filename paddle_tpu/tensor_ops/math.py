@@ -155,9 +155,9 @@ def cross(x, y, axis=9, name=None):
 
 
 # -- reductions ----------------------------------------------------------
-sum = reduction(jnp.sum)
+sum = reduction(jnp.sum, dtype_slot="before_keepdim")
 mean = reduction(jnp.mean)
-prod = reduction(jnp.prod)
+prod = reduction(jnp.prod, dtype_slot="after_keepdim")
 max = reduction(jnp.max)
 min = reduction(jnp.min)
 amax = reduction(jnp.max)
@@ -165,10 +165,7 @@ amin = reduction(jnp.min)
 logsumexp = reduction(jax.scipy.special.logsumexp)
 all = reduction(jnp.all)
 any = reduction(jnp.any)
-
-
-def nansum(x, axis=None, keepdim=False, name=None):
-    return apply(lambda a: jnp.nansum(a, axis=axis, keepdims=keepdim), x)
+nansum = reduction(jnp.nansum, dtype_slot="before_keepdim")
 
 
 def nanmean(x, axis=None, keepdim=False, name=None):
