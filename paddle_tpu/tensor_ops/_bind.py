@@ -27,6 +27,12 @@ def _make_inplace(fn):
 def bind():
     T = Tensor
 
+    T.fill_diagonal_ = _make_inplace(manipulation.fill_diagonal)
+    T.fill_diagonal = manipulation.fill_diagonal
+    T.fill_diagonal_tensor = manipulation.fill_diagonal_tensor
+    T.fill_diagonal_tensor_ = _make_inplace(
+        manipulation.fill_diagonal_tensor)
+
     # arithmetic dunders
     T.__add__ = lambda s, o: m.add(s, o)
     T.__radd__ = lambda s, o: m.add(s, o)

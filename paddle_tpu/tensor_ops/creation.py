@@ -31,6 +31,8 @@ def ones(shape, dtype=None, name=None):
 
 
 def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, str):
+        fill_value = float(fill_value)  # reference accepts "0.5" etc.
     fill_value = raw(fill_value)
     if dtype is None:
         out = jnp.full(_shape(shape), fill_value)

@@ -16,10 +16,33 @@ from ._factory import raw
 builtins_slice = slice  # captured before the paddle-style `slice` op shadows it
 
 
+def _as_int(v):
+    """int() for python ints, 0-d and 1-element Tensors/arrays (the
+    reference accepts Tensor scalars in shape/axis/index lists)."""
+    if isinstance(v, Tensor):
+        v = v._data
+    arr = np.asarray(v)
+    if arr.ndim > 0:
+        if arr.size != 1:
+            raise TypeError(f"expected a scalar, got shape {arr.shape}")
+        arr = arr.reshape(())
+    return int(arr)
+
+
 def reshape(x, shape, name=None):
-    shape = tuple(int(s) if not isinstance(s, Tensor) else int(s.item())
-                  for s in (shape if isinstance(shape, (list, tuple)) else [shape]))
-    return apply(lambda a: jnp.reshape(a, shape), x)
+    if isinstance(shape, Tensor):
+        shape = [int(v) for v in np.asarray(shape._data).reshape(-1)]
+    shape = tuple(_as_int(s)
+                  for s in (shape if isinstance(shape, (list, tuple))
+                            else [shape]))
+
+    def f(a):
+        # paddle semantics: 0 in shape copies the input dim at that index
+        resolved = tuple(a.shape[i] if s == 0 and i < a.ndim else s
+                         for i, s in enumerate(shape))
+        return jnp.reshape(a, resolved)
+
+    return apply(f, x)
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
@@ -53,7 +76,7 @@ def swapaxes(x, axis1, axis2, name=None):
 
 
 def concat(x, axis=0, name=None):
-    axis = int(raw(axis)) if isinstance(axis, Tensor) else axis
+    axis = _as_int(axis) if isinstance(axis, Tensor) else axis
     return apply(lambda *xs: jnp.concatenate(xs, axis=axis), *x)
 
 
@@ -111,7 +134,7 @@ def unsqueeze(x, axis, name=None):
     def f(a):
         axes = axis if isinstance(axis, (list, tuple)) else [axis]
         out = a
-        for ax in builtins_sorted(int(raw(v)) if isinstance(v, Tensor) else int(v) for v in axes):
+        for ax in builtins_sorted(_as_int(v) for v in axes):
             out = jnp.expand_dims(out, ax)
         return out
     return apply(f, x)
@@ -121,8 +144,13 @@ builtins_sorted = sorted
 
 
 def tile(x, repeat_times, name=None):
-    reps = tuple(int(raw(r)) if isinstance(r, Tensor) else int(r)
-                 for r in (repeat_times if isinstance(repeat_times, (list, tuple)) else [repeat_times]))
+    if isinstance(repeat_times, Tensor):
+        repeat_times = [int(v)
+                        for v in np.asarray(repeat_times._data).reshape(-1)]
+    reps = tuple(_as_int(r)
+                 for r in (repeat_times
+                           if isinstance(repeat_times, (list, tuple))
+                           else [repeat_times]))
     return apply(lambda a: jnp.tile(a, reps), x)
 
 
@@ -147,7 +175,9 @@ def broadcast_to(x, shape, name=None):
     return apply(lambda a: jnp.broadcast_to(a, tuple(shape)), x)
 
 
-def broadcast_tensors(inputs, name=None):
+def broadcast_tensors(input=None, name=None, inputs=None):
+    # reference spells the parameter `input`; accept both
+    inputs = input if input is not None else inputs
     shapes = [tuple(raw(i).shape) for i in inputs]
     tgt = np.broadcast_shapes(*shapes)
     return [apply(lambda a: jnp.broadcast_to(a, tgt), i) for i in inputs]
@@ -325,8 +355,9 @@ def slice(x, axes, starts, ends, name=None):
     def f(a):
         sl = [builtins_slice(None)] * a.ndim
         for ax, s, e in zip(axes, starts, ends):
-            sl[ax] = builtins_slice(int(raw(s)) if isinstance(s, Tensor) else s,
-                                    int(raw(e)) if isinstance(e, Tensor) else e)
+            sl[ax] = builtins_slice(
+                _as_int(s) if isinstance(s, Tensor) else s,
+                _as_int(e) if isinstance(e, Tensor) else e)
         return a[tuple(sl)]
     return apply(f, x)
 
@@ -337,7 +368,10 @@ def strided_slice(x, axes, starts, ends, strides, name=None):
     def f(a):
         sl = [builtins_slice(None)] * a.ndim
         for ax, s, e, st in zip(axes, starts, ends, strides):
-            sl[ax] = builtins_slice(s, e, st)
+            sl[ax] = builtins_slice(
+                _as_int(s) if isinstance(s, Tensor) else s,
+                _as_int(e) if isinstance(e, Tensor) else e,
+                _as_int(st) if isinstance(st, Tensor) else st)
         return a[tuple(sl)]
     return apply(f, x)
 
@@ -369,10 +403,28 @@ def crop(x, shape=None, offsets=None, name=None):
     return apply(f, x)
 
 
+def _all_int(seq):
+    return builtins_all(isinstance(v, (int, np.integer)) for v in seq)
+
+
+builtins_all = all
+
+
 def tensordot(x, y, axes=2, name=None):
     ax = axes
+    if isinstance(ax, Tensor):
+        ax = [int(v) for v in np.asarray(ax._data).reshape(-1)]
     if isinstance(ax, (list, tuple)):
-        ax = tuple(tuple(v) if isinstance(v, (list, tuple)) else v for v in ax)
+        if _all_int(ax):
+            # paddle: a flat int list means BOTH operands contract those
+            # same dims (numpy axes=(list, list)), unlike jnp's pairing
+            ax = (tuple(int(v) for v in ax), tuple(int(v) for v in ax))
+        else:
+            ax = tuple(tuple(v) if isinstance(v, (list, tuple)) else v
+                       for v in ax)
+            if len(ax) == 1:
+                # paddle: one sublist applies to both operands
+                ax = (ax[0], ax[0])
     return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)
 
 
@@ -407,3 +459,63 @@ def atleast_2d(*inputs, name=None):
 def atleast_3d(*inputs, name=None):
     outs = [apply(jnp.atleast_3d, x) for x in inputs]
     return outs[0] if len(outs) == 1 else outs
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """Reference tensor/manipulation.py fill_diagonal_: set the main
+    diagonal (2-D, or all-equal-dims N-D) to ``value``. ``wrap``
+    continues the diagonal in blocks for tall 2-D matrices."""
+    def f(a):
+        if a.ndim < 2:
+            raise ValueError("fill_diagonal expects ndim >= 2")
+        if a.ndim == 2:
+            rows, cols = a.shape
+            ii = jnp.arange(rows)
+            if wrap and rows > cols:
+                # restart the diagonal every (cols + 1) rows like numpy
+                jj = (ii % (cols + 1)) + offset
+            else:
+                jj = ii + offset
+            valid = (jj >= 0) & (jj < cols)
+            ii, jj = ii[valid], jj[valid]
+            return a.at[ii, jj].set(value)
+        if len(set(a.shape)) != 1:
+            raise ValueError(
+                "N-D fill_diagonal requires all dimensions equal")
+        if offset != 0:
+            raise ValueError(
+                "N-D fill_diagonal supports offset=0 only")
+        idx = jnp.arange(a.shape[0])
+        return a.at[tuple([idx] * a.ndim)].set(value)
+    return apply(f, x)
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Reference fill_diagonal_tensor: write tensor ``y`` onto the
+    (dim1, dim2) diagonal of ``x``; y's last dim runs along the
+    diagonal, its leading dims cover the remaining axes of x."""
+    def f(a, b):
+        d1 = dim1 % a.ndim
+        d2 = dim2 % a.ndim
+        if d1 == d2:
+            raise ValueError("dim1 and dim2 must differ")
+        n1, n2 = a.shape[d1], a.shape[d2]
+        k = offset
+        diag_len = builtins_min(n1, n2 - k) if k >= 0 else \
+            builtins_min(n1 + k, n2)
+        ii = jnp.arange(diag_len) + (0 if k >= 0 else -k)
+        jj = jnp.arange(diag_len) + (k if k >= 0 else 0)
+        # move diag axes to the back: a_perm[..., i, j]
+        perm = [ax for ax in range(a.ndim) if ax not in (d1, d2)]
+        a_perm = jnp.transpose(a, perm + [d1, d2])
+        expected = tuple(a.shape[ax] for ax in perm) + (diag_len,)
+        if tuple(b.shape) != expected:
+            raise ValueError(
+                f"the y shape should be {expected}, got {tuple(b.shape)}")
+        updated = a_perm.at[..., ii, jj].set(b)
+        inv = np.argsort(perm + [d1, d2])
+        return jnp.transpose(updated, inv)
+    return apply(f, x, y)
+
+
+builtins_min = min

@@ -25,20 +25,32 @@ def var(x, axis=None, unbiased=True, keepdim=False, name=None):
 
 
 def median(x, axis=None, keepdim=False, name=None):
+    if isinstance(axis, list):
+        axis = tuple(axis)
     return apply(lambda a: jnp.median(a, axis=axis, keepdims=keepdim), x)
 
 
 def nanmedian(x, axis=None, keepdim=False, name=None):
+    if isinstance(axis, list):
+        axis = tuple(axis)
     return apply(lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim), x)
 
 
 def quantile(x, q, axis=None, keepdim=False, name=None):
     qq = raw(q)
+    if isinstance(qq, (list, tuple)):
+        qq = jnp.asarray(qq)
+    if isinstance(axis, list):
+        axis = tuple(axis)
     return apply(lambda a: jnp.quantile(a, qq, axis=axis, keepdims=keepdim), x)
 
 
 def nanquantile(x, q, axis=None, keepdim=False, name=None):
     qq = raw(q)
+    if isinstance(qq, (list, tuple)):
+        qq = jnp.asarray(qq)
+    if isinstance(axis, list):
+        axis = tuple(axis)
     return apply(lambda a: jnp.nanquantile(a, qq, axis=axis, keepdims=keepdim), x)
 
 
