@@ -400,3 +400,14 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
         return _reduce(loss, reduction), jnp.exp(logp)
     out, sm = apply(f, logits, label, n_outputs=2)
     return (out, sm) if return_softmax else out
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Reference nn/functional/loss.py edit_distance — same contract as
+    fluid.layers.edit_distance (native C++ batch DP when available);
+    returns (distance [B, 1], sequence_num)."""
+    from ...fluid.layers.tail import edit_distance as _impl
+
+    return _impl(input, label, normalized, ignored_tokens,
+                 input_length, label_length)

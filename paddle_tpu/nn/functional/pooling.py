@@ -156,15 +156,97 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
     return _adaptive_pool(x, 3, output_size, "avg", data_format)
 
 
+builtins_all = all
+
+
+def _adaptive_max_pool_with_mask(x, n, output_size):
+    """Adaptive max pool returning (out, flat indices over the input
+    spatial dims) — the reference's return_mask contract. Evenly
+    divisible sizes take a fully vectorized reshape+argmax path; uneven
+    bins assemble per-cell regions at trace time (output sizes small)."""
+    import itertools
+
+    os_ = _norm_tuple(output_size, n)
+
+    def f(a):
+        spatial = a.shape[2:]
+        if builtins_all(spatial[d] % os_[d] == 0 for d in range(n)):
+            ks = tuple(spatial[d] // os_[d] for d in range(n))
+            # reshape each spatial dim into (out, k), move the k axes to
+            # the back, flatten them, then one argmax/max
+            shape = a.shape[:2]
+            for d in range(n):
+                shape += (os_[d], ks[d])
+            r = a.reshape(shape)
+            # axes: [N, C, o0, k0, o1, k1, ...] -> ks to the back
+            perm = [0, 1] + [2 + 2 * d for d in range(n)] + \
+                [3 + 2 * d for d in range(n)]
+            r = jnp.transpose(r, perm)
+            flat = r.reshape(r.shape[:2 + n] + (-1,))
+            arg = jnp.argmax(flat, axis=-1)
+            out = jnp.max(flat, axis=-1)
+            local = jnp.unravel_index(arg, ks)
+            # global coord per dim: o_d * k_d + local_d, then flatten
+            gflat = None
+            for d in range(n):
+                o_idx = jnp.arange(os_[d]).reshape(
+                    (1, 1) + tuple(os_[d] if dd == d else 1
+                                   for dd in range(n)))
+                g = o_idx * ks[d] + local[d]
+                gflat = g if gflat is None else gflat * spatial[d] + g
+            return out, gflat.astype(jnp.int32)
+        bounds = []
+        for d in range(n):
+            in_sz, out_sz = spatial[d], os_[d]
+            bounds.append([(int(np.floor(i * in_sz / out_sz)),
+                            int(np.ceil((i + 1) * in_sz / out_sz)))
+                           for i in range(out_sz)])
+        vals = np.empty(tuple(os_), dtype=object)
+        idxs = np.empty(tuple(os_), dtype=object)
+        for cell in itertools.product(*[range(s) for s in os_]):
+            sl = [slice(None), slice(None)]
+            sl += [slice(bounds[d][cell[d]][0], bounds[d][cell[d]][1])
+                   for d in range(n)]
+            region = a[tuple(sl)]
+            rshape = region.shape[2:]
+            flat = region.reshape(region.shape[:2] + (-1,))
+            arg = jnp.argmax(flat, axis=-1)
+            vals[cell] = jnp.max(flat, axis=-1)
+            # local multi-index -> global flat index over input spatial
+            local = jnp.unravel_index(arg, rshape)
+            glob = [local[d] + bounds[d][cell[d]][0] for d in range(n)]
+            gflat = glob[0]
+            for d in range(1, n):
+                gflat = gflat * spatial[d] + glob[d]
+            idxs[cell] = gflat
+        def assemble(grid):
+            stacked = jnp.stack([grid[c] for c in
+                                 itertools.product(*[range(s) for s in os_])],
+                                axis=-1)
+            return stacked.reshape(stacked.shape[:2] + tuple(os_))
+        out, ind = assemble(vals), assemble(idxs)
+        return out, ind.astype(jnp.int32)
+
+    from ...tensor import apply
+
+    return apply(f, x)
+
+
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_pool_with_mask(x, 1, output_size)
     return _adaptive_pool(x, 1, output_size, "max", "NCW")
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_pool_with_mask(x, 2, output_size)
     return _adaptive_pool(x, 2, output_size, "max", "NCHW")
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_pool_with_mask(x, 3, output_size)
     return _adaptive_pool(x, 3, output_size, "max", "NCDHW")
 
 

@@ -19,7 +19,8 @@ def _shape(shape):
         shape = shape.tolist()
     if isinstance(shape, (int, np.integer)):
         return (int(shape),)
-    return tuple(int(s) for s in shape)
+    from .manipulation import _as_int
+    return tuple(_as_int(s) for s in shape)
 
 
 def zeros(shape, dtype=None, name=None):

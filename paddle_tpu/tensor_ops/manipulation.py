@@ -474,9 +474,14 @@ def fill_diagonal(x, value, offset=0, wrap=False, name=None):
             if wrap and rows > cols:
                 # restart the diagonal every (cols + 1) rows like numpy
                 jj = (ii % (cols + 1)) + offset
+                valid = (jj >= 0) & (jj < cols)
             else:
                 jj = ii + offset
-            valid = (jj >= 0) & (jj < cols)
+                # reference kernel stops at flat position cols*cols
+                # (phi FillDiagonalKernel size = min(numel, cols*cols)),
+                # so tall matrices don't keep filling below that block
+                valid = ((jj >= 0) & (jj < cols)
+                         & (ii * cols + jj < cols * cols))
             ii, jj = ii[valid], jj[valid]
             return a.at[ii, jj].set(value)
         if len(set(a.shape)) != 1:

@@ -26,7 +26,8 @@ def _shape(shape):
         shape = shape.tolist()
     if isinstance(shape, int):
         return (shape,)
-    return tuple(int(raw(s)) if isinstance(s, Tensor) else int(s) for s in shape)
+    from .manipulation import _as_int
+    return tuple(_as_int(s) for s in shape)
 
 
 def rand(shape, dtype=None, name=None):

@@ -35,6 +35,16 @@ TARGETS = {
     "nn/functional/activation.py": 0.95,
     "nn/layer/loss.py": 0.95,
     "nn/functional/common.py": 0.80,
+    "tensor/linalg.py": 0.95,
+    "tensor/random.py": 0.90,
+    "tensor/attribute.py": 0.95,
+    "nn/layer/conv.py": 0.95,
+    "nn/layer/norm.py": 0.95,
+    "nn/layer/pooling.py": 0.90,
+    "nn/functional/loss.py": 0.92,
+    "nn/layer/rnn.py": 0.95,
+    "nn/layer/transformer.py": 0.95,
+    "nn/layer/activation.py": 0.95,
 }
 
 
