@@ -95,3 +95,60 @@ class GradScaler:
         self._scale = state["scale"]
         self._good_steps = state["good_steps"]
         self._bad_steps = state["bad_steps"]
+
+
+def _add_accessors():
+    """Reference GradScaler get/set accessor surface
+    (amp/grad_scaler.py): trivial state getters/setters used throughout
+    the reference examples and checkpoint flows."""
+
+    def g(attr):
+        return lambda self: getattr(self, attr)
+
+    def s(attr, cast):
+        def setter(self, value):
+            setattr(self, attr, cast(value))
+        return setter
+
+    GradScaler.get_init_loss_scaling = g("_scale")
+    GradScaler.set_init_loss_scaling = s("_scale", float)
+    GradScaler.get_incr_ratio = g("_incr_ratio")
+    GradScaler.set_incr_ratio = s("_incr_ratio", float)
+    GradScaler.get_decr_ratio = g("_decr_ratio")
+    GradScaler.set_decr_ratio = s("_decr_ratio", float)
+    GradScaler.get_incr_every_n_steps = g("_incr_every")
+    GradScaler.set_incr_every_n_steps = s("_incr_every", int)
+    GradScaler.get_decr_every_n_nan_or_inf = g("_decr_every")
+    GradScaler.set_decr_every_n_nan_or_inf = s("_decr_every", int)
+
+
+_add_accessors()
+
+
+def _scaler_state_dict(self):
+    return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every,
+            "decr_every_n_nan_or_inf": self._decr_every,
+            "good_steps": self._good_steps, "bad_steps": self._bad_steps,
+            "use_dynamic_loss_scaling": self._dynamic}
+
+
+def _scaler_load_state_dict(self, state):
+    self._scale = float(state.get("scale", self._scale))
+    self._incr_ratio = float(state.get("incr_ratio", self._incr_ratio))
+    self._decr_ratio = float(state.get("decr_ratio", self._decr_ratio))
+    self._incr_every = int(state.get("incr_every_n_steps",
+                                     self._incr_every))
+    self._decr_every = int(state.get("decr_every_n_nan_or_inf",
+                                     self._decr_every))
+    self._good_steps = int(state.get("good_steps", self._good_steps))
+    self._bad_steps = int(state.get("bad_steps", self._bad_steps))
+    self._dynamic = bool(state.get("use_dynamic_loss_scaling",
+                                   self._dynamic))
+
+
+# replaces the class's minimal {scale, good_steps, bad_steps} dict with
+# the reference's full field set; load is tolerant of either format
+GradScaler.state_dict = _scaler_state_dict
+GradScaler.load_state_dict = _scaler_load_state_dict

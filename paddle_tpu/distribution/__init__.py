@@ -28,8 +28,14 @@ class Distribution:
 
 class Normal(Distribution):
     def __init__(self, loc, scale, name=None):
-        self.loc = loc if isinstance(loc, Tensor) else Tensor(jnp.asarray(float(loc)))
-        self.scale = scale if isinstance(scale, Tensor) else Tensor(jnp.asarray(float(scale)))
+        def _coerce(v):
+            if isinstance(v, Tensor):
+                return v
+            # reference accepts scalars, lists/tuples and ndarrays
+            return Tensor(jnp.asarray(v, dtype=jnp.float32))
+
+        self.loc = _coerce(loc)
+        self.scale = _coerce(scale)
 
     @property
     def mean(self):
@@ -91,21 +97,39 @@ class Categorical(Distribution):
         return Tensor(jax.random.categorical(
             next_key(), raw(self.logits), shape=tuple(shape) + raw(self.logits).shape[:-1] if shape else None))
 
-    def log_prob(self, value):
+    def _gather(self, scores, value):
         idx = raw(value).astype(jnp.int32)
-        return apply(lambda lg: jnp.take_along_axis(
-            jax.nn.log_softmax(lg, -1), idx[..., None], -1)[..., 0], self.logits)
+
+        def f(sc):
+            if sc.ndim == 1:
+                # one distribution, many queried categories
+                return jnp.take(sc, idx)
+            return jnp.take_along_axis(sc, idx[..., None], -1)[..., 0]
+
+        return apply(f, scores)
+
+    def log_prob(self, value):
+        return self._gather(apply(
+            lambda lg: jax.nn.log_softmax(lg, -1), self.logits), value)
 
     def probs(self, value):
-        idx = raw(value).astype(jnp.int32)
-        return apply(lambda lg: jnp.take_along_axis(
-            jax.nn.softmax(lg, -1), idx[..., None], -1)[..., 0], self.logits)
+        return self._gather(apply(
+            lambda lg: jax.nn.softmax(lg, -1), self.logits), value)
 
     def entropy(self):
         def f(lg):
             p = jax.nn.softmax(lg, -1)
             return -jnp.sum(p * jax.nn.log_softmax(lg, -1), axis=-1)
         return apply(f, self.logits)
+
+    def kl_divergence(self, other):
+        """KL(self || other) over the category axis (reference
+        distribution/categorical.py kl_divergence)."""
+        def f(lg, lg2):
+            p = jax.nn.softmax(lg, -1)
+            return jnp.sum(p * (jax.nn.log_softmax(lg, -1)
+                                - jax.nn.log_softmax(lg2, -1)), axis=-1)
+        return apply(f, self.logits, other.logits)
 
 
 class Bernoulli(Distribution):

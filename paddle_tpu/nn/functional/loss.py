@@ -75,6 +75,8 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
              name=None):
     def f(logp, lbl, *wargs):
         w = wargs[0] if wargs else None
+        if lbl.ndim == logp.ndim:  # [N, 1]-shaped int labels
+            lbl = lbl.squeeze(-1)
         li = lbl.astype(jnp.int32)
         valid = li != ignore_index
         safe = jnp.where(valid, li, 0)

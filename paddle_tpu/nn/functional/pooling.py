@@ -156,9 +156,6 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
     return _adaptive_pool(x, 3, output_size, "avg", data_format)
 
 
-builtins_all = all
-
-
 def _adaptive_max_pool_with_mask(x, n, output_size):
     """Adaptive max pool returning (out, flat indices over the input
     spatial dims) — the reference's return_mask contract. Evenly
@@ -170,7 +167,7 @@ def _adaptive_max_pool_with_mask(x, n, output_size):
 
     def f(a):
         spatial = a.shape[2:]
-        if builtins_all(spatial[d] % os_[d] == 0 for d in range(n)):
+        if all(spatial[d] % os_[d] == 0 for d in range(n)):
             ks = tuple(spatial[d] // os_[d] for d in range(n))
             # reshape each spatial dim into (out, k), move the k axes to
             # the back, flatten them, then one argmax/max

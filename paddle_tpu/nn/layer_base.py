@@ -37,7 +37,9 @@ class ParamAttr:
 
     @staticmethod
     def _to_attr(attr):
-        if attr is None:
+        if attr is None or attr is True:
+            # reference ParamAttr._to_attr: True means "use defaults"
+            # (bias_attr=True is the common spelling for "yes, a bias")
             return ParamAttr()
         if isinstance(attr, ParamAttr):
             return attr

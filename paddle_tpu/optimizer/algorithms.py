@@ -17,6 +17,18 @@ def _acc_dtype(p_raw, multi_precision):
     return jnp.float32 if (multi_precision and p_raw.dtype == jnp.bfloat16) else p_raw.dtype
 
 
+def _scalar_hyper(v):
+    """Hyperparameters may be python floats or (reference-style)
+    1-element Tensors; collapse to a jnp scalar."""
+    from ..tensor import Tensor
+
+    if isinstance(v, Tensor):
+        v = v._data
+    if hasattr(v, "reshape") and getattr(v, "ndim", 0) > 0:
+        v = v.reshape(())
+    return v
+
+
 def _f32(x):
     return x.astype(jnp.float32)
 
@@ -119,7 +131,9 @@ class Adam(Optimizer):
 
     def _adam_update(self, p, g, st, lr, param=None):
         """Returns (step, new_state, touched_rows_or_None)."""
-        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1 = _scalar_hyper(self._beta1)
+        b2 = _scalar_hyper(self._beta2)
+        eps = _scalar_hyper(self._epsilon)
         g32 = _f32(g)
         m = b1 * st["moment1"] + (1 - b1) * g32
         v = b2 * st["moment2"] + (1 - b2) * g32 * g32

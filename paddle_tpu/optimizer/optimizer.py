@@ -26,7 +26,36 @@ class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay=None, grad_clip=None, name=None,
                  multi_precision=True):
-        self._parameter_list = list(parameters) if parameters is not None else None
+        if parameters is not None:
+            parameters = list(parameters)
+            if any(isinstance(p, dict) for p in parameters):
+                # parameter groups (reference Optimizer._update_param_group):
+                # each dict carries 'params' plus per-group overrides —
+                # 'learning_rate' is a scale on the global lr (stored in
+                # optimize_attr, read by the step loop), 'weight_decay'
+                # becomes the params' regularizer
+                flat = []
+                for group in parameters:
+                    if not isinstance(group, dict):
+                        flat.append(group)
+                        continue
+                    gparams = list(group["params"])
+                    lr_scale = group.get("learning_rate")
+                    wd = group.get("weight_decay")
+                    if isinstance(wd, (int, float)) \
+                            and not isinstance(wd, bool):
+                        # incl. 0: an explicit no-decay group must mask
+                        # any global weight_decay
+                        wd = L2Decay(float(wd))
+                    for p in gparams:
+                        if lr_scale is not None:
+                            p.optimize_attr["learning_rate"] = float(
+                                lr_scale)
+                        if wd is not None:
+                            p.regularizer = wd
+                    flat.extend(gparams)
+                parameters = flat
+        self._parameter_list = parameters
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
         self._name = name
@@ -97,6 +126,13 @@ class Optimizer:
                  no_grad_set=None):
         from ..static.program import _current_main
         if _current_main is not None:
+            if self._parameter_list is None:
+                # static graph: an optimizer built without parameters
+                # optimizes every parameter of the current program
+                # (reference optimizer.py minimize collects them from
+                # the program's block)
+                self._parameter_list = list(
+                    _current_main.all_parameters())
             # static-graph recording: defer backward+update to each
             # Executor.run replay (reference: optimizer ops appended to the
             # program, run by the executor)
@@ -106,12 +142,27 @@ class Optimizer:
                 self.clear_grad()
             _current_main._append_thunk(thunk)
             return None, None
-        loss.backward()
+        ran_backward = all(p.grad is None for p in self._all_params())
+        if ran_backward:
+            loss.backward()
+        # else: grads already populated (reference dygraph minimize only
+        # applies existing grads — backward twice would retain-error)
         self.step()
+        if ran_backward:
+            # we produced these grads; clear them so a minimize-only
+            # training loop backprops fresh each iteration instead of
+            # silently re-applying stale gradients (explicit-backward
+            # callers keep paddle's accumulate semantics)
+            self.clear_grad()
         return None, None
 
-    def backward(self, loss, **kwargs):
+    def backward(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None, callbacks=None):
+        """Reference Optimizer.backward: run autodiff and return the
+        (param, grad) pairs for apply_gradients."""
         loss.backward()
+        return [(p, p.grad) for p in self._all_params()
+                if p.grad is not None and p.trainable]
 
     def apply_gradients(self, params_grads):
         lr = self.get_lr()

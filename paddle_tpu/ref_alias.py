@@ -358,6 +358,12 @@ for _leaf, _names in (("distribute_transpiler",
     _alias(f"fluid.transpiler.{_leaf}", "fluid.transpiler",
            f"reference fluid/transpiler/{_leaf}.py", names=_names)
 
+# ---- vision.transforms per-file spellings ----
+_alias("vision.transforms.transforms", "vision.transforms",
+       "reference vision/transforms/transforms.py")
+_alias("vision.transforms.functional", "vision.transforms",
+       "reference vision/transforms/functional.py")
+
 # ---- misc single-file spellings ----
 _alias("cost_model.cost_model", "cost_model",
        "reference cost_model/cost_model.py")
