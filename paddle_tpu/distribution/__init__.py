@@ -25,15 +25,21 @@ class Distribution:
         from ..tensor_ops.math import exp
         return exp(self.log_prob(value))
 
+    # reference distribution/distribution.py defines prob on the base;
+    # subclasses with a direct density can override
+    prob = probs
+
+
+def _coerce(v):
+    """Scalars, lists/tuples and ndarrays -> float32 Tensor (the
+    reference's broadcastable-parameter contract)."""
+    if isinstance(v, Tensor):
+        return v
+    return Tensor(jnp.asarray(v, dtype=jnp.float32))
+
 
 class Normal(Distribution):
     def __init__(self, loc, scale, name=None):
-        def _coerce(v):
-            if isinstance(v, Tensor):
-                return v
-            # reference accepts scalars, lists/tuples and ndarrays
-            return Tensor(jnp.asarray(v, dtype=jnp.float32))
-
         self.loc = _coerce(loc)
         self.scale = _coerce(scale)
 
@@ -71,8 +77,8 @@ class Normal(Distribution):
 
 class Uniform(Distribution):
     def __init__(self, low, high, name=None):
-        self.low = low if isinstance(low, Tensor) else Tensor(jnp.asarray(float(low)))
-        self.high = high if isinstance(high, Tensor) else Tensor(jnp.asarray(float(high)))
+        self.low = _coerce(low)
+        self.high = _coerce(high)
 
     def sample(self, shape=(), seed=0):
         shp = tuple(shape) + tuple(jnp.broadcast_shapes(
@@ -155,8 +161,27 @@ class Bernoulli(Distribution):
 class Beta(Distribution):
     def __init__(self, alpha, concentration1=None, name=None, beta=None):
         b = beta if beta is not None else concentration1
-        self.alpha = alpha if isinstance(alpha, Tensor) else Tensor(jnp.asarray(float(alpha)))
-        self.beta = b if isinstance(b, Tensor) else Tensor(jnp.asarray(float(b)))
+        self.alpha = _coerce(alpha)
+        self.beta = _coerce(b)
+
+    @property
+    def mean(self):
+        return apply(lambda a, b: a / (a + b), self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        return apply(lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+                     self.alpha, self.beta)
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+
+        def f(a, b):
+            return (betaln(a, b) - (a - 1) * digamma(a)
+                    - (b - 1) * digamma(b)
+                    + (a + b - 2) * digamma(a + b))
+
+        return apply(f, self.alpha, self.beta)
 
     def sample(self, shape=()):
         return Tensor(jax.random.beta(next_key(), raw(self.alpha),
@@ -172,11 +197,44 @@ class Beta(Distribution):
 class Dirichlet(Distribution):
     def __init__(self, concentration, name=None):
         self.concentration = concentration if isinstance(concentration, Tensor) \
-            else Tensor(jnp.asarray(concentration))
+            else Tensor(jnp.asarray(concentration, dtype=jnp.float32))
 
     def sample(self, shape=()):
         return Tensor(jax.random.dirichlet(next_key(), raw(self.concentration),
                                            tuple(shape) or ()))
+
+    @property
+    def mean(self):
+        return apply(lambda c: c / jnp.sum(c, -1, keepdims=True),
+                     self.concentration)
+
+    @property
+    def variance(self):
+        def f(c):
+            a0 = jnp.sum(c, -1, keepdims=True)
+            return c * (a0 - c) / (a0 * a0 * (a0 + 1))
+        return apply(f, self.concentration)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        def f(v, c):
+            return (jnp.sum((c - 1) * jnp.log(v), -1)
+                    + gammaln(jnp.sum(c, -1)) - jnp.sum(gammaln(c), -1))
+
+        return apply(f, value, self.concentration)
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+
+        def f(c):
+            a0 = jnp.sum(c, -1)
+            k = c.shape[-1]
+            lnB = jnp.sum(gammaln(c), -1) - gammaln(a0)
+            return (lnB + (a0 - k) * digamma(a0)
+                    - jnp.sum((c - 1) * digamma(c), -1))
+
+        return apply(f, self.concentration)
 
 
 class Gumbel(Distribution):

@@ -55,6 +55,16 @@ TARGETS = {
     "metric/metrics.py": 0.95,
     "vision/transforms/transforms.py": 0.80,
     "framework/random.py": 0.95,
+    "nn/functional/conv.py": 0.95,
+    "nn/functional/norm.py": 0.95,
+    "nn/functional/pooling.py": 0.95,
+    "fft.py": 0.95,
+    "signal.py": 0.95,
+    "nn/functional/extension.py": 0.95,
+    "regularizer.py": 0.95,
+    "distribution/uniform.py": 0.95,
+    "distribution/beta.py": 0.95,
+    "distribution/dirichlet.py": 0.95,
 }
 
 
