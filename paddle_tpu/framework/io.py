@@ -40,6 +40,10 @@ def _from_serializable(obj):
 
 
 def save(obj, path, protocol=4, **configs):
+    if hasattr(path, "write"):  # file-like (BytesIO etc., reference
+        pickle.dump(_to_serializable(obj), path,  # io.py save supports it)
+                    protocol=protocol)
+        return
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -48,5 +52,7 @@ def save(obj, path, protocol=4, **configs):
 
 
 def load(path, **configs):
+    if hasattr(path, "read"):  # file-like
+        return _from_serializable(pickle.load(path))
     with open(path, "rb") as f:
         return _from_serializable(pickle.load(f))

@@ -52,6 +52,44 @@ class Program:
         from ..tensor import Parameter
         return [v for v in self._vars.values() if isinstance(v, Parameter)]
 
+    def state_dict(self, mode="all", scope=None):
+        """name -> Tensor of the program's persistable vars (reference
+        framework.Program.state_dict; mode selects param/opt/all —
+        optimizer state lives inside the optimizer here, so 'opt'
+        returns the non-Parameter persistables). Feed placeholders are
+        NOT state and are excluded."""
+        from ..tensor import Parameter
+        out = {}
+        for name, v in self._vars.items():
+            if name in self._feed_vars:
+                continue
+            is_param = isinstance(v, Parameter)
+            if mode == "param" and not is_param:
+                continue
+            if mode == "opt" and is_param:
+                continue
+            out[name] = v
+        return out
+
+    def set_state_dict(self, state_dict, scope=None):
+        missing = []
+        for name, value in state_dict.items():
+            var = self._vars.get(name)
+            if var is None:
+                missing.append(name)
+                continue
+            arr = value._data if hasattr(value, "_data") else \
+                jnp.asarray(np.asarray(value))
+            arr = arr.astype(var._data.dtype)
+            if tuple(arr.shape) != tuple(var._data.shape):
+                raise ValueError(
+                    f"set_state_dict: {name!r} has shape "
+                    f"{tuple(arr.shape)}, program var expects "
+                    f"{tuple(var._data.shape)}")
+            var._data = arr
+            var._node = None
+        return missing
+
     def global_block(self):
         return self
 
@@ -300,9 +338,7 @@ def load_program_state(model_prefix, var_list=None):
 
 def set_program_state(program, state_dict):
     with _no_record():
-        for k, v in state_dict.items():
-            if k in program._vars:
-                program._vars[k]._data = jnp.asarray(v)
+        program.set_state_dict(state_dict)
 
 
 def save_to_file(path, content):

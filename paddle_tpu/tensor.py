@@ -139,6 +139,24 @@ class Tensor:
     def clear_grad(self):
         self.grad = None
 
+    def get_value(self):
+        """The tensor's value as a detached Tensor (reference
+        varbase_patch_methods get_value — paired with set_value for
+        checkpoint flows)."""
+        return Tensor(self._data)
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        value = jnp.asarray(value, dtype=self._data.dtype)
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {tuple(value.shape)} vs "
+                f"{tuple(self._data.shape)} (the reference rejects "
+                f"mismatched shapes too)")
+        self._data = value
+        self._node = None
+
     def clear_gradient(self):
         self.grad = None
 
@@ -235,11 +253,6 @@ class Parameter(Tensor):
         self.split_axis = None
         self.pspec = None  # jax PartitionSpec for the distributed path
         self.is_sparse_table = False  # lazy-row optimizer semantics marker
-
-    def set_value(self, value):
-        if isinstance(value, Tensor):
-            value = value._data
-        self._data = jnp.asarray(value, dtype=self._data.dtype)
 
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()

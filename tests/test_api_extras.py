@@ -307,3 +307,44 @@ class TestWorkerInfo:
         list(dl)
         assert any(s == 2 for s in seen)
         assert paddle.io.get_worker_info() is None
+
+
+def test_get_value_set_value_roundtrip():
+    import numpy as np
+
+    x = paddle.to_tensor([1.0, 2.0])
+    v = x.get_value()
+    assert np.allclose(v.numpy(), x.numpy())
+    x.set_value(np.array([3.0, 4.0], np.float32))
+    assert float(x.numpy()[0]) == 3.0
+    p = paddle.nn.Linear(2, 2).weight
+    p.set_value(p.get_value())
+
+
+def test_save_load_file_like():
+    import io as _io
+
+    import numpy as np
+
+    buf = _io.BytesIO()
+    paddle.save(paddle.to_tensor([1.0, 2.0]), buf)
+    buf.seek(0)
+    t = paddle.load(buf)
+    assert np.allclose(t.numpy(), [1.0, 2.0])
+
+
+def test_program_state_dict_roundtrip():
+    import numpy as np
+
+    import paddle_tpu.static as static
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4])
+        w = static.create_parameter([4, 2], "float32", name="w0")
+    sd = main.state_dict("param")
+    assert "w0" in sd
+    new_w = np.ones((4, 2), np.float32)
+    missing = main.set_state_dict({"w0": new_w, "nope": new_w})
+    assert missing == ["nope"]
+    assert np.allclose(np.asarray(main.var("w0")._data), 1.0)

@@ -65,6 +65,7 @@ TARGETS = {
     "distribution/uniform.py": 0.95,
     "distribution/beta.py": 0.95,
     "distribution/dirichlet.py": 0.95,
+    "framework/io.py": 0.95,
 }
 
 
