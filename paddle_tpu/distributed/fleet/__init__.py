@@ -19,6 +19,17 @@ def init(role_maker=None, is_collective=True, strategy=None):
         # keep a strategy created before init (meta-optimizer wrappers
         # via _ensure_strategy) — its toggles must reach the compiled
         # step; an explicit strategy or a re-init still replaces it
+        import warnings
+        toggled = [f for f in ("localsgd", "dgc", "fp16_allreduce",
+                               "gradient_merge", "recompute", "amp",
+                               "sharding", "pipeline", "lamb")
+                   if getattr(_strategy, f, False)]
+        if toggled:
+            warnings.warn(
+                "fleet.init() is inheriting a strategy created before "
+                f"init with flags {toggled} toggled (by meta-optimizer "
+                "wrapper construction); pass strategy= explicitly to "
+                "override", stacklevel=2)
         strategy = _strategy
     _strategy = strategy or DistributedStrategy()
     _hcg = HybridCommunicateGroup(_strategy)

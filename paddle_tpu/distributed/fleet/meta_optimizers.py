@@ -210,13 +210,26 @@ class DygraphShardingOptimizer(ShardingOptimizer):
 
     def __init__(self, hcg=None, user_defined_strategy=None,
                  params=None, inner_optimizer_class=None, **inner_kw):
+        from .base import DistributedStrategy
+
+        self._hcg = None
         if inner_optimizer_class is not None:
             inner = inner_optimizer_class(parameters=params, **inner_kw)
+            self._hcg = hcg
         elif hasattr(hcg, "step"):
-            # Paddle >= 2.5 spelling: (optimizer, hcg) positional-first
+            # Paddle >= 2.5 spelling: (optimizer, hcg) positional-first.
+            # The second positional is the HCG, not a strategy — passing
+            # it through as the strategy would set .sharding on the HCG
+            # object and leave the real DistributedStrategy untouched.
             inner = hcg
+            if isinstance(user_defined_strategy, DistributedStrategy):
+                pass  # explicit strategy in second slot: honor it
+            else:
+                self._hcg = user_defined_strategy
+                user_defined_strategy = None
         else:
             inner = params  # already-built optimizer passed positionally
+            self._hcg = hcg
         if not hasattr(inner, "step"):
             raise TypeError(
                 "DygraphShardingOptimizer needs an optimizer: pass "

@@ -64,6 +64,21 @@ def iter_ctr_batches(sample_iter, schema: CTRSchema, batch_size,
         yield schema.assemble(batch)
 
 
+def _parse_label(field):
+    """Label grammar shared with the native parser (ctr_parser.cc):
+    optional sign + ASCII digits, space padding allowed, int32 range.
+    int() alone would also accept '1_0' and non-ASCII digits that the
+    native path rejects — the two paths must accept identical rows."""
+    t = field.strip(" ")
+    body = t[1:] if t[:1] in "+-" else t
+    if not body or not body.isascii() or not body.isdigit():
+        raise ValueError(f"invalid label field {field!r}")
+    val = int(t)
+    if not -2**31 <= val < 2**31:
+        raise ValueError(f"label out of int32 range: {field!r}")
+    return val
+
+
 class CriteoLineParser:
     """Parses criteo-format lines "label\\td1..d13\\tc1..c26" into the
     sample protocol (the parse the reference ships as a user
@@ -75,7 +90,7 @@ class CriteoLineParser:
 
     def __call__(self, line):
         parts = line.rstrip("\n").split("\t")
-        label = [int(parts[0])]
+        label = [_parse_label(parts[0])]
         dense = []
         for v in parts[1:1 + self.num_dense]:
             dense.append(float(v) if v else 0.0)

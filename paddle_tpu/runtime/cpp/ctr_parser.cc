@@ -21,6 +21,7 @@
 // Build: make -C paddle_tpu/runtime/cpp libptpu_ctr.so
 
 #include <cctype>
+#include <cerrno>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -46,15 +47,21 @@ int parse_line(const char* p, const char* end, int num_dense,
     return p >= end || *p == '\t' || *p == '\n' || *p == '\r';
   };
 
-  // field 0: label
+  // field 0: label — plain int32 only ([+-]?digits), the grammar the
+  // python path enforces (rec/data.py _parse_label): '1.5', '1e3',
+  // '1_0' and out-of-int32-range values are malformed on BOTH paths so
+  // the two accept exactly the same rows
   skip_spaces();
   if (at_separator()) return 1;
   char* next = nullptr;
-  *label_out = strtof(p, &next);
-  if (next == p) return 1;
+  errno = 0;
+  long lab = strtol(p, &next, 10);
+  if (next == p || errno == ERANGE) return 1;
+  if (lab < INT32_MIN || lab > INT32_MAX) return 1;
   p = next;
   skip_spaces();
-  if (!at_separator()) return 1;  // trailing junk in the field
+  if (!at_separator()) return 1;  // trailing junk (e.g. '.', 'e', '_')
+  *label_out = static_cast<float>(lab);
 
   // dense fields
   for (int d = 0; d < num_dense; ++d) {

@@ -36,8 +36,11 @@ def load_lib():
     with _LOCK:
         if _LIB is not None:
             return _LIB
-        if not os.path.exists(_SO):
-            _build()
+        try:
+            _build()  # no-op when up to date; rebuilds a stale cached .so
+        except ImportError:
+            if not os.path.exists(_SO):
+                raise
         try:
             lib = ctypes.CDLL(_SO)
         except OSError as e:  # corrupt / wrong-arch .so: fall back cleanly
@@ -90,18 +93,21 @@ def gather_stack(arrays):
 
 
 def _load_shared(so_path, make_target):
-    """Build (make -C cpp <target>) if missing, then CDLL; raises
-    ImportError on any failure (shared by all three native loaders)."""
-    if not os.path.exists(so_path):
-        try:
-            subprocess.run(
-                ["make", "-C", os.path.dirname(so_path), make_target],
-                check=True, capture_output=True, timeout=120)
-        except subprocess.CalledProcessError as e:
-            raise ImportError(
-                f"native {make_target} build failed: "
-                f"{e.stderr.decode(errors='replace')[-500:]}") from e
-        except (OSError, subprocess.SubprocessError) as e:
+    """Build (make -C cpp <target>), then CDLL; raises ImportError on any
+    failure (shared by all three native loaders). make always runs so a
+    stale cached .so is rebuilt when its .cc changed (the Makefile makes
+    it a no-op when up to date); if make itself is unavailable an
+    existing .so is still loaded."""
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.dirname(so_path), make_target],
+            check=True, capture_output=True, timeout=120)
+    except subprocess.CalledProcessError as e:
+        raise ImportError(
+            f"native {make_target} build failed: "
+            f"{e.stderr.decode(errors='replace')[-500:]}") from e
+    except (OSError, subprocess.SubprocessError) as e:
+        if not os.path.exists(so_path):
             raise ImportError(f"native {make_target} build failed: {e}") \
                 from e
     try:

@@ -70,15 +70,9 @@ def test_mp_worker_info_ids():
     assert -1 not in wids, "get_worker_info() was None inside a worker"
 
 
-@pytest.mark.slow
-@pytest.mark.skipif(bool(os.environ.get("PYTEST_XDIST_WORKER")),
-                    reason="wall-clock scaling assertion needs an "
-                           "uncontended CPU (xdist saturates all cores)")
-def test_mp_gil_transform_scales():
-    """~linear scaling: after the first batch lands (startup excluded),
-    4 workers must finish a 30ms/sample GIL workload much faster than one
-    process could."""
-    n, ms, workers = 48, 30.0, 4
+def _measure_mp_scaling(n, ms, workers):
+    """One scaling measurement: wall time for the post-warmup batches.
+    Returns (dt_seconds, serial_floor_seconds)."""
     dl = DataLoader(SlowDataset(n=n, ms=ms), batch_size=1,
                     num_workers=workers)
     it = iter(dl)
@@ -93,11 +87,37 @@ def test_mp_gil_transform_scales():
     t0 = time.perf_counter()
     rest = sum(1 for _ in it)
     dt = time.perf_counter() - t0
-    serial_floor = (n - warm) * ms / 1000.0
     assert rest == n - warm
-    # allow generous overhead: still requires >~2x parallelism
-    assert dt < serial_floor / 2, (
-        f"{workers} workers took {dt:.2f}s; serial floor {serial_floor:.2f}s")
+    return dt, (n - warm) * ms / 1000.0
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(bool(os.environ.get("PYTEST_XDIST_WORKER")),
+                    reason="wall-clock scaling assertion needs an "
+                           "uncontended CPU (xdist saturates all cores)")
+def test_mp_gil_transform_scales():
+    """~linear scaling: after the first batch lands (startup excluded),
+    4 workers must finish a 30ms/sample GIL workload much faster than one
+    process could.
+
+    A wall-clock assertion is inherently load-sensitive (a saturated CI
+    box starves the workers between samples), so the measurement retries
+    up to 3 times and passes on the best attempt — a GIL-serialized
+    implementation fails all three deterministically, while transient
+    host contention only fails the unlucky attempts.
+    """
+    n, ms, workers = 48, 30.0, 4
+    attempts = []
+    for attempt in range(3):
+        dt, serial_floor = _measure_mp_scaling(n, ms, workers)
+        attempts.append(dt)
+        # allow generous overhead: still requires >~2x parallelism
+        if dt < serial_floor / 2:
+            return
+    raise AssertionError(
+        f"{workers} workers took {min(attempts):.2f}s at best over "
+        f"{len(attempts)} attempts ({['%.2f' % a for a in attempts]}); "
+        f"serial floor {serial_floor:.2f}s")
 
 
 def test_mp_fallback_unpicklable_collate():

@@ -112,6 +112,32 @@ def test_native_malformed_line_raises():
 
 
 @needs_native
+def test_native_label_integer_only_parity():
+    # the python path reads the label with int(parts[0]) — '1.5'/'1e3'
+    # raise there, so the native path must reject them identically
+    # rather than silently accepting a float label
+    schema = _schema()
+    good = synthetic_ctr_lines(1, seed=0)[0]
+    for bad_label in ("1.5", "1e3", "0x1", "nan", "2.0", "1_0",
+                      "99999999999999999999", "2147483648"):
+        parts = good.split("\t")
+        parts[0] = bad_label
+        bad = "\t".join(parts)
+        with pytest.raises(ValueError):
+            _python_parse([bad], schema)
+        with pytest.raises(ValueError, match="malformed"):
+            parse_criteo_batch([bad], schema)
+    # integer labels with sign/space padding stay accepted on both paths
+    for ok_label in ("1", " 0 ", "-1", "+1"):
+        parts = good.split("\t")
+        parts[0] = ok_label
+        line = "\t".join(parts)
+        np.testing.assert_array_equal(
+            _python_parse([line], schema)["label"],
+            parse_criteo_batch([line], schema)["label"])
+
+
+@needs_native
 def test_native_raw_mode_rejects_int64_overflow():
     # python fallback raises OverflowError at >= 2^63; native must error
     # too (not saturate)

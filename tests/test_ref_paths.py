@@ -260,6 +260,45 @@ def test_meta_optimizer_wrappers_toggle_strategy():
         assert callable(wrapped.step)
 
 
+def test_dygraph_sharding_optimizer_hcg_not_strategy():
+    # Paddle>=2.5 spelling (optimizer, hcg): the HCG in the second slot
+    # must NOT be treated as the strategy — sharding has to land on the
+    # real global DistributedStrategy, not as an attribute on the HCG
+    from paddle_tpu.distributed import fleet as fleet_pkg
+    from paddle_tpu.distributed.fleet import meta_optimizers as mo
+    from paddle_tpu.distributed.fleet.base import DistributedStrategy
+    from paddle_tpu import nn, optimizer
+
+    layer = nn.Linear(4, 4)
+    inner = optimizer.SGD(learning_rate=0.1,
+                          parameters=layer.parameters())
+
+    class FakeHCG:  # quacks like an HCG, carries no .step
+        def get_model_parallel_world_size(self):
+            return 1
+
+    hcg = FakeHCG()
+    saved = fleet_pkg._strategy
+    fleet_pkg._strategy = None
+    try:
+        w = mo.DygraphShardingOptimizer(inner, hcg)
+        assert w.inner_opt is inner
+        assert w._hcg is hcg
+        # the flag landed on the (auto-created) global strategy...
+        assert fleet_pkg._strategy is not None
+        assert fleet_pkg._strategy.sharding is True
+        # ...and never on the HCG object
+        assert not getattr(hcg, "sharding", False)
+        # explicit strategy in the second slot still honored
+        s = DistributedStrategy()
+        inner2 = optimizer.SGD(learning_rate=0.1,
+                               parameters=layer.parameters())
+        w2 = mo.DygraphShardingOptimizer(inner2, s)
+        assert w2._strategy is s and s.sharding is True
+    finally:
+        fleet_pkg._strategy = saved
+
+
 def test_lars_lamb_meta_optimizers_swap_inner():
     from paddle_tpu.distributed.fleet import meta_optimizers as mo
     from paddle_tpu.distributed.fleet.base import DistributedStrategy
