@@ -1,0 +1,32 @@
+"""paddle._C_ops compat (reference: the pybind-generated raw-op
+namespace, paddle/fluid/pybind/op_function_generator.cc).
+
+The reference exposes every registered C++ kernel as a raw callable
+(``_C_ops.final_state_zeros(...)``); a handful of unittests and user
+scripts call them directly. There is no kernel registry here — XLA is
+the kernel registry — so each spelling resolves to the public eager API
+with the ``final_state_`` prefix stripped. Ops whose raw calling
+convention diverges from the public API raise AttributeError, which the
+conformance harness reports honestly as a failing case.
+"""
+from __future__ import annotations
+
+_SEARCH_MODULES = ("paddle_tpu", "paddle_tpu.tensor_ops",
+                   "paddle_tpu.nn.functional")
+
+
+def __getattr__(name):
+    import importlib
+
+    base = name
+    for prefix in ("final_state_", "legacy_"):
+        if base.startswith(prefix):
+            base = base[len(prefix):]
+    for modname in _SEARCH_MODULES:
+        mod = importlib.import_module(modname)
+        fn = getattr(mod, base, None)
+        if callable(fn):
+            return fn
+    raise AttributeError(
+        f"_C_ops.{name}: no public-API equivalent registered "
+        f"(searched {base!r} in {_SEARCH_MODULES})")

@@ -31,7 +31,9 @@ from ..static.program import WeightNormParamAttr  # noqa: F401
 LoDTensor = Tensor
 LoDTensorArray = list
 
+from . import compiler  # noqa: E402,F401
 from . import core  # noqa: E402,F401
+from . import op  # noqa: E402,F401
 from . import framework  # noqa: E402,F401
 from . import executor  # noqa: E402,F401
 from . import backward  # noqa: E402,F401

@@ -1,0 +1,11 @@
+"""fluid.compiler compat (reference python/paddle/fluid/compiler.py).
+
+The reference's CompiledProgram applies graph passes and multi-device
+build strategies before Executor.run; here every program already runs
+through XLA, so CompiledProgram is the thin marker the static Executor
+accepts (static/program.py).
+"""
+from ..static.program import CompiledProgram  # noqa: F401
+from ..static import BuildStrategy, ExecutionStrategy  # noqa: F401
+
+__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]

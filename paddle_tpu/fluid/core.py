@@ -12,6 +12,8 @@ from ..tensor import Tensor  # noqa: F401
 from ..framework import dtype as _dtype_mod
 
 LoDTensor = Tensor
+VarBase = Tensor  # legacy dygraph tensor class (reference core.VarBase)
+eager = type("eager", (), {"Tensor": Tensor})  # core.eager.Tensor spelling
 LoDTensorArray = list
 _Scope = Scope
 
@@ -32,6 +34,10 @@ class VarDesc:
         COMPLEX128 = "complex128"
         LOD_TENSOR = "lod_tensor"
         SELECTED_ROWS = "selected_rows"
+
+
+def supports_bfloat16():
+    return True  # XLA:TPU/CPU both run bf16
 
 
 def is_compiled_with_cuda():

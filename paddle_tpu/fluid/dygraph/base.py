@@ -16,6 +16,18 @@ from ...tensor import Tensor
 _dygraph_on = True
 
 
+def switch_to_static_graph(func):
+    """Decorator running func in static-graph mode (reference
+    dygraph/base.py:switch_to_static_graph); record/replay programs
+    don't need a VM switch, so this just calls through."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        return func(*args, **kwargs)
+    return wrapped
+
+
 def enable_dygraph(place=None):
     global _dygraph_on
     _dygraph_on = True
@@ -53,6 +65,13 @@ def to_variable(value, name=None, zero_copy=None, dtype=None):
     """ndarray/list -> Tensor (reference dygraph/base.py:to_variable)."""
     if isinstance(value, Tensor):
         return value.astype(dtype) if dtype else value
+    import jax
+
+    if isinstance(value, (jax.Array, jax.core.Tracer)):
+        # traced values (inside jit / dy2static) must not round-trip
+        # through numpy
+        t = Tensor(value, name=name)
+        return t.astype(dtype) if dtype else t
     arr = np.asarray(value)
     if dtype is not None:
         from ...framework import dtype as dtype_mod

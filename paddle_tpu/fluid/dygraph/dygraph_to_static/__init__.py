@@ -1,0 +1,13 @@
+"""fluid.dygraph.dygraph_to_static compat (reference:
+python/paddle/fluid/dygraph/dygraph_to_static/) — the legacy import
+location of the dy2static machinery that now lives in
+paddle_tpu.jit.{api,dy2static}."""
+from . import program_translator  # noqa: F401
+from . import utils  # noqa: F401
+from .program_translator import ProgramTranslator  # noqa: F401
+from .utils import Dygraph2StaticException  # noqa: F401
+
+from ....jit.dy2static import (  # noqa: F401
+    convert_control_flow, convert_ifelse, convert_while,
+    convert_logical_and, convert_logical_or, convert_logical_not,
+    convert_ternary, convert_assert, convert_print)

@@ -1,0 +1,4 @@
+"""Legacy import path (reference dygraph_to_static/program_translator.py)."""
+from ....jit.compat import ProgramTranslator  # noqa: F401
+
+__all__ = ["ProgramTranslator"]

@@ -1,0 +1,15 @@
+"""dygraph_to_static utility surface (reference
+dygraph_to_static/utils.py). Dygraph2StaticException is what the
+reference raises for unconvertible constructs; the jit fallback here
+warns-and-runs-eager instead, so the class exists for except-clauses and
+conformance tests."""
+
+
+class Dygraph2StaticException(Exception):
+    pass
+
+
+UNDEFINED_VAR = "__undefined_var"
+
+
+__all__ = ["Dygraph2StaticException"]

@@ -25,6 +25,37 @@ class Block:
         self.program = program
 
 
+import contextlib
+
+
+def _enable_legacy_dygraph():
+    """Reference switch to the pre-eager dygraph VM — eager is the only
+    dygraph mode here; kept for unittest-conformance imports."""
+
+
+def _disable_legacy_dygraph():
+    pass
+
+
+def convert_np_dtype_to_dtype_(np_dtype):
+    """numpy dtype -> framework dtype (reference framework.py:
+    convert_np_dtype_to_dtype_)."""
+    import numpy as np
+
+    from ..framework.dtype import convert_dtype
+
+    return convert_dtype(np.dtype(np_dtype).name)
+
+
+@contextlib.contextmanager
+def _test_eager_guard(place=None):
+    """Reference test helper (fluid/framework.py _test_eager_guard):
+    switches the legacy test into eager mode. Eager IS the only dygraph
+    mode here, so this is a no-op guard kept for the reference unittest
+    conformance harness."""
+    yield
+
+
 def get_flags(flags):
     import paddle_tpu as _p
     return _p.get_flags(flags)

@@ -1,0 +1,24 @@
+"""fluid.op compat (reference python/paddle/fluid/op.py).
+
+The reference's Operator builds a raw C++ OpDesc and runs it directly on
+a Scope — the lowest-level kernel-registry escape hatch, used by a
+handful of legacy unittests. There is no kernel registry here (XLA is
+the kernel registry), so constructing an Operator works for import
+compatibility but running one raises with a pointer to the public API.
+"""
+from __future__ import annotations
+
+
+class Operator:
+    def __init__(self, type=None, **inputs_outputs_attrs):
+        self.type = type
+        self.config = inputs_outputs_attrs
+
+    def run(self, scope=None, place=None):
+        raise NotImplementedError(
+            f"raw Operator({self.type!r}).run: there is no C++ OpDesc "
+            "registry in paddle_tpu — use the public paddle.* API, which "
+            "lowers to XLA")
+
+
+__all__ = ["Operator"]

@@ -8,3 +8,23 @@ from .device import (  # noqa: F401
     CPUPlace, CUDAPlace, TPUPlace, device_count, get_device, set_device,
 )
 from .random_seed import seed  # noqa: F401
+
+
+def _non_static_mode():
+    """True in dygraph (reference paddle.framework._non_static_mode)."""
+    from ..fluid.dygraph.base import in_dygraph_mode
+
+    return in_dygraph_mode()
+
+
+in_dynamic_mode = _non_static_mode
+
+
+def __getattr__(name):
+    # paddle.framework.core is the fluid.core alias surface
+    # (reference framework/__init__.py re-exports core)
+    if name == "core":
+        from ..fluid import core
+
+        return core
+    raise AttributeError(f"module 'paddle.framework' has no {name!r}")

@@ -64,13 +64,15 @@ class StaticFunction:
     def __init__(self, fn: Callable, input_spec=None, jit_kwargs=None,
                  convert_control_flow: bool = True):
         self._orig_fn = fn
+        self._fallback_keys = set()
         if convert_control_flow:
             from .dy2static import convert_control_flow as _ccf
             fn = _ccf(fn)
         self._fn = fn
         self._layer = getattr(fn, "__self__", None)
         self._input_spec = input_spec
-        self._jit = jax.jit(self._traced, **(jit_kwargs or {}))
+        self._jit = jax.jit(self._run_split, static_argnums=(1,),
+                            **(jit_kwargs or {}))
         functools.update_wrapper(self, fn, updated=())
 
     def _traced(self, raw_params, args, kwargs):
@@ -78,12 +80,102 @@ class StaticFunction:
         with _static_ctx(), functional_mode(), _swap_params(params, raw_params):
             return self._fn(*args, **kwargs)
 
+    @staticmethod
+    def _split_static(tree):
+        """Flatten (raw_params, args, kwargs), separating array leaves
+        (traced) from everything else (baked as compile-time constants —
+        the reference Program likewise freezes non-tensor arguments).
+        Raises TypeError for unhashable static leaves."""
+        import numpy as np
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        dyn, static_items = {}, []
+        for i, leaf in enumerate(leaves):
+            if isinstance(leaf, (jax.Array, jax.core.Tracer,
+                                 np.ndarray, np.generic)):
+                dyn[str(i)] = leaf
+            else:
+                hash(leaf)
+                static_items.append((i, leaf))
+        return dyn, (treedef, tuple(static_items), len(leaves))
+
+    def _run_split(self, dyn, static_spec):
+        treedef, static_items, n = static_spec
+        leaves = [None] * n
+        for i, v in static_items:
+            leaves[i] = v
+        for k, v in dyn.items():
+            leaves[int(k)] = v
+        raw_params, args, kwargs = jax.tree_util.tree_unflatten(
+            treedef, leaves)
+        return self._traced(raw_params, args, kwargs)
+
+    @staticmethod
+    def _sig_key(tree):
+        def leaf(x):
+            if hasattr(x, "shape") and hasattr(x, "dtype"):
+                return ("arr", tuple(x.shape), str(x.dtype))
+            try:
+                hash(x)
+                return x
+            except TypeError:
+                return type(x).__name__
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        return (tuple(leaf(x) for x in leaves), str(treedef))
+
     def __call__(self, *args, **kwargs):
         if not StaticFunction.global_enable:
             return self._orig_fn(*args, **kwargs)
         params = _collect_params(self._layer) if self._layer is not None else {}
         raw_params = {k: p._data for k, p in params.items()}
-        return self._jit(raw_params, args, kwargs)
+        # fallback is cached per input signature: one untraceable call
+        # pattern must not disable signatures that already compiled
+        key = None
+        if self._fallback_keys:
+            key = self._sig_key((raw_params, args, kwargs))
+            if key in self._fallback_keys:
+                return self._orig_fn(*args, **kwargs)
+        try:
+            dyn, static_spec = self._split_static(
+                (raw_params, args, kwargs))
+        except TypeError:  # unhashable non-array argument
+            return self._orig_fn(*args, **kwargs)
+        try:
+            return self._jit(dyn, static_spec)
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.UnexpectedTracerError) as e:
+            # the reference's escape hatch (program_translator.py:
+            # trace failure -> run dygraph with a warning). Typical
+            # causes: data-dependent python control flow the converter
+            # could not rewrite, or container mutation under trace.
+            import warnings
+            warnings.warn(
+                f"to_static: tracing {getattr(self._fn, '__name__', '?')} "
+                f"failed ({type(e).__name__}); falling back to eager "
+                f"execution. First cause: {str(e).splitlines()[0][:160]}",
+                stacklevel=2)
+            if key is None:
+                key = self._sig_key((raw_params, args, kwargs))
+            self._fallback_keys.add(key)
+            return self._orig_fn(*args, **kwargs)
+
+    def __get__(self, instance, owner=None):
+        """Descriptor protocol: ``@to_static``-decorated methods bind to
+        their instance like plain functions (the reference StaticFunction
+        is likewise a descriptor, program_translator.py)."""
+        if instance is None:
+            return self
+        cache = instance.__dict__.setdefault("_pt_static_methods", {})
+        key = id(self)
+        bound = cache.get(key)
+        if bound is None:
+            bound = StaticFunction(
+                self._orig_fn.__get__(instance, owner),
+                self._input_spec)
+            cache[key] = bound
+        return bound
 
     @property
     def concrete_program(self):
@@ -92,7 +184,8 @@ class StaticFunction:
     def lower(self, *args, **kwargs):
         params = _collect_params(self._layer) if self._layer is not None else {}
         raw_params = {k: p._data for k, p in params.items()}
-        return self._jit.lower(raw_params, args, kwargs)
+        dyn, static_spec = self._split_static((raw_params, args, kwargs))
+        return self._jit.lower(dyn, static_spec)
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,

@@ -8,15 +8,37 @@ function's AST so python ``if``/``while`` over tensors become graph ops
 (non-traced) value the original python control flow runs unchanged, so the
 same converted function works eagerly and under jit.
 
-Conversion contract (the "common cases" shim):
-* ``if``/``elif``/``else`` and ``while`` statements are converted when their
-  bodies contain no ``return``/``break``/``continue``/``yield`` — those fall
-  back to python control flow (fine eagerly; under jit a tensor predicate
-  will raise jax's concretization error, pointing here).
-* names assigned inside a branch/loop body are threaded through the
-  lax primitive as carried state; reads of enclosing locals happen via
-  closure. Both branches of a converted ``if`` must produce matching
-  shapes/dtypes for threaded names (lax.cond's contract).
+Conversion pipeline (mirrors the reference's transformer stack,
+dygraph_to_static/{return,break_continue,logical,ifelse}_transformer.py):
+1. ``return`` desugaring — returns inside control flow become a
+   (flag, value) pair threaded like any assigned name; loops exit via a
+   synthesized ``break``; statements after a potential return are
+   guarded (ReturnTransformer analog).
+2. ``for x in range(...)`` desugars to a while with the bump BEFORE the
+   body (continue-safe), tensor bounds supported.
+3. ``break``/``continue`` become loop-local flags: the loop condition
+   gains ``not break_flag``, statements after a taken break/continue
+   are guarded (BreakContinueTransformer analog).
+4. expression conversion — ternary ``a if c else b`` →
+   ``convert_ternary`` (lax.cond under trace), ``and``/``or``/``not``
+   → short-circuit-preserving ``convert_logical_*``, ``assert`` →
+   ``convert_assert`` (no-op under trace), ``print`` →
+   ``convert_print`` (jax.debug.print under trace).
+5. ``if``/``while`` over tensor predicates → ``lax.cond``/
+   ``lax.while_loop`` with assigned names threaded as carried state
+   (convert_ifelse/convert_while_loop analog). Concrete predicates run
+   plain python, so one converted function serves eager and jit.
+
+Contract:
+* both branches of a traced ``if`` (and every ``return`` path) must
+  produce matching shapes/dtypes for threaded names — lax.cond's
+  contract, same as the reference's requirement that cond branch
+  outputs unify.
+* bodies that mutate python containers (``xs.append(...)``,
+  ``d[k] = v``) are NOT converted — they run python control flow,
+  which jit unrolls when the bounds are concrete; with a traced bound
+  the jit call falls back to eager with a warning
+  (program_translator.py fallback analog).
 * conversion is source-based (inspect.getsource); functions without
   retrievable source (REPL lambdas, C extensions) run unconverted.
 """
@@ -70,6 +92,16 @@ def _is_traced(x):
     return isinstance(_raw(x), jax.core.Tracer)
 
 
+def _scalar_bool(p):
+    """Predicate → scalar bool array. Shape-[1] predicates (paddle's
+    fill_constant([1], ...) idiom) squeeze to rank 0; size>1 raises the
+    same ambiguous-truth-value error python would."""
+    b = jnp.asarray(p, bool)
+    if b.ndim:
+        b = b.reshape(())
+    return b
+
+
 def _to_carry(vals):
     """Tensors -> raw arrays; python scalars -> arrays (stable carry
     dtypes); returns (raw_leaves, rewrap) where rewrap restores Tensors."""
@@ -79,8 +111,10 @@ def _to_carry(vals):
     raws = []
     for v in vals:
         r = _raw(v)
-        if isinstance(r, _Undefined):
-            r = jnp.int32(0)  # dummy; branches must assign before use
+        if isinstance(r, _Undefined) or r is None:
+            # None enters for names like the return-value slot that a
+            # branch/loop body must assign before the value is used
+            r = jnp.int32(0)
         elif isinstance(r, (bool, int, float)):
             r = jnp.asarray(r)
         raws.append(r)
@@ -94,7 +128,14 @@ def _to_carry(vals):
 
 
 def convert_ifelse(pred, true_fn, false_fn, vals):
-    """``if pred: ... else: ...`` with assigned names threaded via vals."""
+    """``if pred: ... else: ...`` with assigned names threaded via vals.
+
+    Branch outputs are unified before lax.cond: same-shape outputs with
+    differing dtypes are cast to the promoted dtype, and a branch that
+    leaves an initially-unbound name (return-value slot, name first
+    assigned in the other branch) at its dummy takes zeros shaped like
+    the assigning branch's output — the reference's branch-output
+    unification (convert_operators.py select_input_with_buildin_type)."""
     from ..tensor import Tensor
 
     p = _raw(pred)
@@ -102,19 +143,68 @@ def convert_ifelse(pred, true_fn, false_fn, vals):
         return true_fn(*vals) if bool(p) else false_fn(*vals)
 
     raws, rewrap = _to_carry(vals)
-    out_kinds = []  # is-Tensor per output, recorded while tracing branches
+    dummies = [_raw(v) is None or isinstance(_raw(v), _Undefined)
+               for v in vals]
+    # is-Tensor per output, OR-ed across the two branch traces (a name
+    # may be a Tensor in one arm and a dummy/python value in the other —
+    # the result must keep its Tensor wrapper if EITHER arm makes one)
+    out_kinds = []
 
     def _branch(fn):
         def run(raw_ops):
             outs = fn(*rewrap(raw_ops))
             if not isinstance(outs, tuple):
                 outs = (outs,)
-            out_kinds[:] = [isinstance(o, Tensor) for o in outs]
+            kinds = [isinstance(o, Tensor) for o in outs]
+            if len(out_kinds) != len(kinds):
+                out_kinds[:] = kinds
+            else:
+                out_kinds[:] = [a or b for a, b in zip(out_kinds, kinds)]
             return tuple(jnp.asarray(_raw(o)) for o in outs)
         return run
 
-    out = jax.lax.cond(jnp.asarray(p, bool), _branch(true_fn),
-                       _branch(false_fn), raws)
+    tb, fb = _branch(true_fn), _branch(false_fn)
+    try:
+        ta = jax.eval_shape(tb, raws)
+        fa = jax.eval_shape(fb, raws)
+    except Exception:
+        ta = fa = None
+
+    if ta is not None and any(
+            a.shape != b.shape or a.dtype != b.dtype
+            for a, b in zip(ta, fa)):
+        def _is_dummy_passthrough(i, aval):
+            r = jnp.asarray(raws[i])
+            return (i < len(dummies) and dummies[i]
+                    and tuple(aval.shape) == tuple(r.shape)
+                    and aval.dtype == r.dtype)
+
+        def adapt(branch, self_avals, other_avals):
+            def run(raw_ops):
+                outs = branch(raw_ops)
+                fixed = []
+                for i, o in enumerate(outs):
+                    sa, oa = self_avals[i], other_avals[i]
+                    if sa.shape == oa.shape and sa.dtype == oa.dtype:
+                        fixed.append(o)
+                    elif sa.shape == oa.shape:
+                        dt = jnp.promote_types(sa.dtype, oa.dtype)
+                        fixed.append(o.astype(dt))
+                    elif _is_dummy_passthrough(i, sa):
+                        # this branch never assigned the name: take the
+                        # other branch's shape (value is dead unless the
+                        # user reads an unassigned name - same contract
+                        # as the reference's undefined-var placeholder)
+                        fixed.append(jnp.zeros(oa.shape, oa.dtype))
+                    else:
+                        fixed.append(o)  # genuine mismatch: let lax.cond
+                        # raise its structured error
+                return tuple(fixed)
+            return run
+
+        tb, fb = adapt(tb, ta, fa), adapt(fb, fa, ta)
+
+    out = jax.lax.cond(_scalar_bool(p), tb, fb, raws)
     return tuple(Tensor(o, stop_gradient=False) if t else o
                  for o, t in zip(out, out_kinds))
 
@@ -136,7 +226,7 @@ def convert_while(cond_fn, body_fn, vals):
     out_kinds = []
 
     def cond(raw_ops):
-        return jnp.asarray(_raw(cond_fn(*rewrap(raw_ops))), bool)
+        return _scalar_bool(_raw(cond_fn(*rewrap(raw_ops))))
 
     def body(raw_ops):
         outs = body_fn(*rewrap(raw_ops))
@@ -175,6 +265,129 @@ def convert_bool(x):
     return x
 
 
+def convert_ternary(pred, true_thunk, false_thunk):
+    """``a if pred else b`` (reference convert_operators.convert_ifelse
+    for IfExp): python semantics for concrete predicates, lax.cond when
+    the predicate is traced. Both arms must produce matching
+    shapes/dtypes under trace (lax.cond's contract)."""
+    from ..tensor import Tensor
+
+    p = _raw(pred)
+    if not isinstance(p, jax.core.Tracer):
+        return true_thunk() if bool(p) else false_thunk()
+    kinds = []  # OR-ed across arms: Tensor wrapper survives if either
+    # arm produces a Tensor
+
+    def wrap(fn):
+        def run(_):
+            o = fn()
+            kinds.append(isinstance(o, Tensor))
+            return jnp.asarray(_raw(o))
+        return run
+
+    out = jax.lax.cond(_scalar_bool(p), wrap(true_thunk),
+                       wrap(false_thunk), ())
+    return Tensor(out, stop_gradient=False) if any(kinds) else out
+
+
+def _tensor_logical(op, a, b):
+    from ..tensor import Tensor
+
+    out = op(jnp.asarray(_raw(a), bool), jnp.asarray(_raw(b), bool))
+    if isinstance(a, Tensor) or isinstance(b, Tensor):
+        return Tensor(out)
+    return out
+
+
+def convert_logical_and(*thunks):
+    """Short-circuiting ``and`` (reference convert_logical_and): python
+    value semantics while operands are concrete; once a traced operand
+    appears, remaining operands are evaluated and combined with
+    jnp.logical_and (the reference likewise evaluates both sides of a
+    converted logical op)."""
+    val = thunks[0]()
+    for t in thunks[1:]:
+        if not _is_traced(val):
+            if not bool(val):
+                return val  # python: `a and b` returns falsy a
+            val = t()
+        else:
+            val = _tensor_logical(jnp.logical_and, val, t())
+    return val
+
+
+def convert_logical_or(*thunks):
+    """Short-circuiting ``or`` — mirror of convert_logical_and."""
+    val = thunks[0]()
+    for t in thunks[1:]:
+        if not _is_traced(val):
+            if bool(val):
+                return val  # python: `a or b` returns truthy a
+            val = t()
+        else:
+            val = _tensor_logical(jnp.logical_or, val, t())
+    return val
+
+
+def convert_logical_not(x):
+    """``not x`` (reference convert_logical_not): python bool for
+    concrete values, jnp.logical_not for traced ones."""
+    from ..tensor import Tensor
+
+    r = _raw(x)
+    if not isinstance(r, jax.core.Tracer):
+        return not bool(r)
+    out = jnp.logical_not(jnp.asarray(r, bool))
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+_CAST_MAP = {"bool": "bool", "int": "int32", "float": "float32"}
+
+
+def convert_var_dtype(x, kind):
+    """``bool(x)``/``int(x)``/``float(x)`` on a Tensor → elementwise
+    cast (reference convert_operators.convert_var_dtype:576 with the
+    same bool/int32/float32 mapping); plain python values keep python
+    builtin semantics."""
+    from ..tensor import Tensor
+
+    r = _raw(x)
+    if isinstance(x, Tensor) or isinstance(r, jax.core.Tracer):
+        out = jnp.asarray(r).astype(_CAST_MAP[kind])
+        return Tensor(out) if isinstance(x, Tensor) else out
+    return {"bool": bool, "int": int, "float": float}[kind](x)
+
+
+def convert_assert(pred, msg=None):
+    """``assert`` (reference convert_assert → fluid Assert op): enforced
+    eagerly; under trace the check runs at execution time via a host
+    callback — the analog of the reference's runtime Assert kernel."""
+    if _is_traced(pred):
+        def _check(ok):
+            import numpy as np
+
+            if not np.all(ok):
+                raise AssertionError(msg if msg is not None
+                                     else "Assert failed")
+        jax.debug.callback(_check, jnp.asarray(_raw(pred), bool))
+        return
+    if msg is None:
+        assert bool(_raw(pred))
+    else:
+        assert bool(_raw(pred)), msg
+
+
+def convert_print(*args, **kwargs):
+    """``print`` (reference convert_print): plain print for concrete
+    values; jax.debug.print when any argument is traced so the value
+    prints at run time, not trace time."""
+    if any(_is_traced(a) for a in args):
+        fmt = " ".join("{}" for _ in args)
+        jax.debug.print(fmt, *[_raw(a) for a in args])
+    else:
+        print(*args, **kwargs)
+
+
 def loop_cond(i, stop, step):
     """`for i in range(start, stop, step)` desugars to a while with this
     condition; handles tensor bounds (negative tensor steps assume the
@@ -201,10 +414,18 @@ class _AssignCollector(ast.NodeVisitor):
             self.names.add(node.id)
 
     def visit_FunctionDef(self, node):
-        self.names.add(node.name)  # def binds the name; don't descend
+        # a def binds its name (threaded so the eager path keeps
+        # python scoping; selecting a function by a TRACED predicate is
+        # impossible and errors at lax.cond). The converter's own
+        # __pt_true_N/__pt_body_N helpers emitted by an inner conversion
+        # stay out of the carry. Don't descend (nested defs own their
+        # assignments).
+        if not node.name.startswith("__pt_"):
+            self.names.add(node.name)
 
     def visit_AsyncFunctionDef(self, node):
-        self.names.add(node.name)
+        if not node.name.startswith("__pt_"):
+            self.names.add(node.name)
 
     def visit_Lambda(self, node):
         pass
@@ -217,7 +438,24 @@ def _assigned(stmts) -> set:
     return c.names
 
 
+_CONTAINER_MUTATORS = {
+    # only the unambiguous list-accumulation spellings: names like
+    # .update/.add/.pop are also common non-container APIs (Metric.
+    # update, set-like user objects) and flagging them would cost
+    # conversions. A missed mutation under trace degrades gracefully —
+    # UnexpectedTracerError → the jit fallback runs the function eagerly
+    # with a warning.
+    "append", "extend", "insert",
+}
+
+
 class _Disallowed(ast.NodeVisitor):
+    """Statements that keep an if/while python-level: control transfers
+    the earlier phases didn't desugar, plus python-container mutation
+    (``xs.append(...)``, ``d[k] = v``) — a mutated closure container
+    inside lax.cond/while_loop would leak tracers, so those bodies stay
+    python (jit unrolls them when the bounds are concrete)."""
+
     def __init__(self):
         self.found = False
 
@@ -235,6 +473,17 @@ class _Disallowed(ast.NodeVisitor):
 
     def visit_YieldFrom(self, node):
         self.found = True
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _CONTAINER_MUTATORS:
+            self.found = True
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.found = True
+        self.generic_visit(node)
 
     def visit_FunctionDef(self, node):
         pass  # nested defs own their returns
@@ -255,6 +504,360 @@ def _has_disallowed(stmts) -> bool:
 
 def _name(id_, ctx=None):
     return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _assign(name, value):
+    return ast.Assign(targets=[_name(name, ast.Store())], value=value)
+
+
+def _jst_call(attr, args):
+    return ast.Call(
+        func=ast.Attribute(value=_name(_JST), attr=attr, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _thunk(expr):
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                           kw_defaults=[], defaults=[]),
+        body=expr)
+
+
+def _walk_no_funcs(node):
+    """ast.walk, but skipping nested function/lambda bodies."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            stack.append(c)
+
+
+def _stmt_may_set(stmt, flag_name):
+    for n in _walk_no_funcs(stmt):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Name) and t.id == flag_name:
+                    return True
+    return False
+
+
+class _SkipNestedFunctions(ast.NodeTransformer):
+    def visit_FunctionDef(self, node):
+        return node
+
+    def visit_AsyncFunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+
+# ---------------------------------------------------------------------------
+# Phase 1: return desugaring (reference return_transformer.py)
+# ---------------------------------------------------------------------------
+
+_RET_FLAG, _RET_VAL = "__pt_ret_flag", "__pt_ret_val"
+
+
+def _scan_returns(stmts, in_compound, in_try, res):
+    """res = [has_nested_return, has_return_in_try]."""
+    for s in stmts:
+        if isinstance(s, ast.Return):
+            if in_compound:
+                res[0] = True
+            if in_try:
+                res[1] = True
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        elif isinstance(s, ast.Try):
+            blocks = [s.body, s.orelse, s.finalbody]
+            blocks += [h.body for h in s.handlers]
+            for blk in blocks:
+                _scan_returns(blk, True, True, res)
+        elif isinstance(s, ast.Match):
+            # match statements are not desugared; a return inside one
+            # disables the transform (res[1]) like try/except does
+            for case in s.cases:
+                _scan_returns(case.body, True, True, res)
+        elif isinstance(s, (ast.If, ast.While, ast.For, ast.With)):
+            for blk in (getattr(s, "body", []), getattr(s, "orelse", [])):
+                _scan_returns(blk, True, in_try, res)
+    return res
+
+
+class _ReturnTransformer(_SkipNestedFunctions):
+    """``return X`` inside control flow → set (__pt_ret_flag,
+    __pt_ret_val); inside a loop additionally ``break`` (the
+    BreakContinue phase then threads the exit through the loop flags).
+    The reference's ReturnTransformer does the same with
+    RETURN_VALUE/RETURN_FLAG variables."""
+
+    def __init__(self):
+        self.loop_depth = 0
+        self.count = 0
+
+    def _loop(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+        return node
+
+    def visit_While(self, node):
+        return self._loop(node)
+
+    def visit_For(self, node):
+        return self._loop(node)
+
+    def visit_Return(self, node):
+        self.count += 1
+        stmts = [
+            _assign(_RET_VAL, node.value or ast.Constant(None)),
+            _assign(_RET_FLAG, ast.Constant(True)),
+        ]
+        if self.loop_depth > 0:
+            stmts.append(ast.Break())
+        return stmts
+
+
+def _guard_ret_block(stmts, in_loop):
+    """Once __pt_ret_flag is set, no later statement in the list runs;
+    inside a loop a set flag also breaks out (for returns escaping
+    nested loops)."""
+    out = []
+    for i, s in enumerate(stmts):
+        _guard_ret_children(s, in_loop)
+        out.append(s)
+        if _stmt_may_set(s, _RET_FLAG):
+            if in_loop:
+                out.append(ast.If(test=_name(_RET_FLAG),
+                                  body=[ast.Break()], orelse=[]))
+            else:
+                rest = stmts[i + 1:]
+                if rest:
+                    out.append(ast.If(
+                        test=ast.UnaryOp(op=ast.Not(),
+                                         operand=_name(_RET_FLAG)),
+                        body=_guard_ret_block(rest, in_loop), orelse=[]))
+                return out
+    return out
+
+
+def _guard_ret_children(s, in_loop):
+    if isinstance(s, ast.If):
+        s.body = _guard_ret_block(s.body, in_loop)
+        s.orelse = _guard_ret_block(s.orelse, in_loop)
+    elif isinstance(s, (ast.While, ast.For)):
+        s.body = _guard_ret_block(s.body, True)
+    elif isinstance(s, ast.With):
+        s.body = _guard_ret_block(s.body, in_loop)
+    elif isinstance(s, ast.Match):
+        for case in s.cases:
+            case.body = _guard_ret_block(case.body, in_loop)
+
+
+def _apply_return_transform(fdef):
+    """Desugar returns if any sits inside control flow (returns inside
+    try/except are left alone — the whole transform is skipped, and
+    if/while bodies containing them stay python via _has_disallowed)."""
+    res = _scan_returns(fdef.body, False, False, [False, False])
+    if not res[0] or res[1]:
+        return
+    rt = _ReturnTransformer()
+    rt.generic_visit(fdef)
+    if not rt.count:
+        return
+    body = _guard_ret_block(fdef.body, False)
+    fdef.body = (
+        [_assign(_RET_FLAG, ast.Constant(False)),
+         _assign(_RET_VAL, ast.Constant(None))]
+        + body + [ast.Return(value=_name(_RET_VAL))])
+
+
+# ---------------------------------------------------------------------------
+# Phase 2: for-range desugaring (continue-safe: bump BEFORE the body)
+# ---------------------------------------------------------------------------
+
+
+def _has_yield(stmts):
+    for s in stmts:
+        for n in _walk_no_funcs(s):
+            if isinstance(n, (ast.Yield, ast.YieldFrom)):
+                return True
+    return False
+
+
+class _ForRangeDesugar(_SkipNestedFunctions):
+    """``for i in range(...)`` → init + while. The bump runs at the TOP
+    of the body (loop var copied from a private counter), so ``break``/
+    ``continue`` in the body never skip the increment, and body code may
+    freely reassign the loop variable — both python-for semantics."""
+
+    def __init__(self):
+        self.n = 0
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if (node.orelse
+                or not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or node.iter.keywords
+                or _has_yield(node.body)):
+            return node
+        args = node.iter.args
+        if len(args) == 1:
+            start, stop, step = ast.Constant(0), args[0], ast.Constant(1)
+        elif len(args) == 2:
+            start, stop, step = args[0], args[1], ast.Constant(1)
+        elif len(args) == 3:
+            start, stop, step = args
+        else:
+            return node
+        self.n += 1
+        it = f"__pt_it_{self.n}"
+        sv, tv = f"__pt_rstop_{self.n}", f"__pt_rstep_{self.n}"
+        tgt = node.target.id
+        inits = [_assign(sv, stop), _assign(tv, step), _assign(it, start)]
+        body = [
+            _assign(tgt, _name(it)),
+            _assign(it, ast.BinOp(left=_name(it), op=ast.Add(),
+                                  right=_name(tv))),
+        ] + node.body
+        test = _jst_call("loop_cond", [_name(it), _name(sv), _name(tv)])
+        return inits + [ast.While(test=test, body=body, orelse=[])]
+
+
+# ---------------------------------------------------------------------------
+# Phase 3: break/continue desugaring (reference break_continue_transformer)
+# ---------------------------------------------------------------------------
+
+
+class _ReplaceBreakContinue(_SkipNestedFunctions):
+    """Replace break/continue belonging to ONE loop level (nested loops
+    keep their own)."""
+
+    def __init__(self, brk, cont):
+        self.brk, self.cont = brk, cont
+        self.used_break = False
+        self.used_continue = False
+
+    def visit_While(self, node):
+        return node  # inner loop owns its breaks
+
+    def visit_For(self, node):
+        return node
+
+    def visit_Break(self, node):
+        self.used_break = True
+        return _assign(self.brk, ast.Constant(True))
+
+    def visit_Continue(self, node):
+        self.used_continue = True
+        return _assign(self.cont, ast.Constant(True))
+
+
+def _guard_flags_block(stmts, flags):
+    out = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.If):
+            s.body = _guard_flags_block(s.body, flags)
+            s.orelse = _guard_flags_block(s.orelse, flags)
+        elif isinstance(s, ast.With):
+            s.body = _guard_flags_block(s.body, flags)
+        elif isinstance(s, ast.Try):
+            s.body = _guard_flags_block(s.body, flags)
+            s.orelse = _guard_flags_block(s.orelse, flags)
+            s.finalbody = _guard_flags_block(s.finalbody, flags)
+            for h in s.handlers:
+                h.body = _guard_flags_block(h.body, flags)
+        elif isinstance(s, ast.Match):
+            for case in s.cases:
+                case.body = _guard_flags_block(case.body, flags)
+        out.append(s)
+        if any(_stmt_may_set(s, f) for f in flags):
+            rest = stmts[i + 1:]
+            if rest:
+                cond = ast.UnaryOp(
+                    op=ast.Not(),
+                    operand=ast.BoolOp(op=ast.Or(),
+                                       values=[_name(f) for f in flags]))
+                out.append(ast.If(test=cond,
+                                  body=_guard_flags_block(rest, flags),
+                                  orelse=[]))
+            return out
+    return out
+
+
+class _BreakContinueTransformer(_SkipNestedFunctions):
+    def __init__(self):
+        self.n = 0
+
+    def visit_While(self, node):
+        self.generic_visit(node)  # inner loops first (post-order)
+        self.n += 1
+        brk, cont = f"__pt_brk_{self.n}", f"__pt_cont_{self.n}"
+        rep = _ReplaceBreakContinue(brk, cont)
+        body = []
+        for s in node.body:
+            r = rep.visit(s)
+            body.extend(r if isinstance(r, list) else [r])
+        if not (rep.used_break or rep.used_continue):
+            self.n -= 1
+            return node
+        body = _guard_flags_block(body, (brk, cont))
+        new_body = [_assign(cont, ast.Constant(False))] + body
+        test = ast.BoolOp(op=ast.And(), values=[
+            ast.UnaryOp(op=ast.Not(), operand=_name(brk)), node.test])
+        new_while = ast.While(test=test, body=new_body, orelse=[])
+        return [_assign(brk, ast.Constant(False)), new_while]
+
+
+# ---------------------------------------------------------------------------
+# Phase 4: expression conversion (ternary / and / or / not / assert / print)
+# ---------------------------------------------------------------------------
+
+
+class _ExprTransformer(_SkipNestedFunctions):
+    def visit_IfExp(self, node):
+        self.generic_visit(node)
+        return _jst_call("convert_ternary",
+                         [node.test, _thunk(node.body),
+                          _thunk(node.orelse)])
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        attr = ("convert_logical_and" if isinstance(node.op, ast.And)
+                else "convert_logical_or")
+        return _jst_call(attr, [_thunk(v) for v in node.values])
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst_call("convert_logical_not", [node.operand])
+        return node
+
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        return ast.Expr(value=_jst_call(
+            "convert_assert", [node.test, node.msg or ast.Constant(None)]))
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if (isinstance(node.func, ast.Name) and not node.keywords
+                and not any(isinstance(a, ast.Starred) for a in node.args)):
+            if node.func.id == "print":
+                return _jst_call("convert_print", list(node.args))
+            if node.func.id in ("bool", "int", "float") \
+                    and len(node.args) == 1:
+                return _jst_call(
+                    "convert_var_dtype",
+                    [node.args[0], ast.Constant(node.func.id)])
+        return node
 
 
 def _tuple_of(names, ctx=None):
@@ -315,46 +918,6 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             assign = ast.Expr(value=call)
         return [true_def, false_def, assign]
 
-    def visit_For(self, node):
-        """``for i in range(...)`` → init + while (then converted like any
-        while). Other iterables stay python (reference converts range and
-        enumerate; range covers the tensor-bound cases)."""
-        self.generic_visit(node)
-        if (_has_disallowed(node.body) or node.orelse
-                or not isinstance(node.target, ast.Name)
-                or not isinstance(node.iter, ast.Call)
-                or not isinstance(node.iter.func, ast.Name)
-                or node.iter.func.id != "range"
-                or node.iter.keywords):
-            return node
-        args = node.iter.args
-        if len(args) == 1:
-            start, stop, step = ast.Constant(0), args[0], ast.Constant(1)
-        elif len(args) == 2:
-            start, stop, step = args[0], args[1], ast.Constant(1)
-        elif len(args) == 3:
-            start, stop, step = args
-        else:
-            return node
-        i = self._next()
-        ev, tv = f"__pt_rstop_{i}", f"__pt_rstep_{i}"
-        tgt = node.target.id
-        inits = [
-            ast.Assign(targets=[_name(ev, ast.Store())], value=stop),
-            ast.Assign(targets=[_name(tv, ast.Store())], value=step),
-            ast.Assign(targets=[_name(tgt, ast.Store())], value=start),
-        ]
-        bump = ast.Assign(
-            targets=[_name(tgt, ast.Store())],
-            value=ast.BinOp(left=_name(tgt), op=ast.Add(), right=_name(tv)))
-        test = ast.Call(
-            func=ast.Attribute(value=_name(_JST), attr="loop_cond",
-                               ctx=ast.Load()),
-            args=[_name(tgt), _name(ev), _name(tv)], keywords=[])
-        wh = ast.While(test=test, body=list(node.body) + [bump], orelse=[])
-        out = self.visit_While(wh)
-        return inits + (out if isinstance(out, list) else [out])
-
     def visit_While(self, node):
         self.generic_visit(node)
         if (_has_disallowed(node.body) or node.orelse):
@@ -400,7 +963,9 @@ def convert_control_flow(fn: Callable) -> Callable:
     except (OSError, TypeError, SyntaxError):
         return fn
     fdef = tree.body[0]
-    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+    if not isinstance(fdef, ast.FunctionDef):
+        # async functions are not converted: the transformer stack does
+        # not model AsyncFor/AsyncWith control flow
         return fn
     for dec in fdef.decorator_list:
         # only the to_static decorator itself may be stripped; any other
@@ -408,10 +973,17 @@ def convert_control_flow(fn: Callable) -> Callable:
         d = dec.func if isinstance(dec, ast.Call) else dec
         name = d.attr if isinstance(d, ast.Attribute) else getattr(d, "id",
                                                                    "")
-        if name not in ("to_static", "not_to_static"):
+        if name not in ("to_static", "not_to_static", "declarative"):
             return fn
     fdef.decorator_list = []
-    new_tree = _ControlFlowTransformer().visit(tree)
+    try:
+        _apply_return_transform(fdef)           # 1. returns → flag/value
+        _ForRangeDesugar().generic_visit(fdef)  # 2. for-range → while
+        _BreakContinueTransformer().generic_visit(fdef)  # 3. break/cont
+        _ExprTransformer().generic_visit(fdef)  # 4. ternary/and/or/not/...
+        new_tree = _ControlFlowTransformer().visit(tree)  # 5. if/while
+    except Exception:
+        return fn
     ast.fix_missing_locations(new_tree)
 
     import paddle_tpu.jit.dy2static as _self

@@ -53,6 +53,11 @@ int parse_line(const char* p, const char* end, int num_dense,
   // the two accept exactly the same rows
   skip_spaces();
   if (at_separator()) return 1;
+  // strtol would itself skip \v/\f/\t whitespace the python grammar
+  // rejects — require an explicit sign/digit first
+  if (!(*p == '+' || *p == '-' ||
+        isdigit(static_cast<unsigned char>(*p))))
+    return 1;
   char* next = nullptr;
   errno = 0;
   long lab = strtol(p, &next, 10);

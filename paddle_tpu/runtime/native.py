@@ -103,9 +103,20 @@ def _load_shared(so_path, make_target):
             ["make", "-C", os.path.dirname(so_path), make_target],
             check=True, capture_output=True, timeout=120)
     except subprocess.CalledProcessError as e:
-        raise ImportError(
-            f"native {make_target} build failed: "
-            f"{e.stderr.decode(errors='replace')[-500:]}") from e
+        if not os.path.exists(so_path):
+            raise ImportError(
+                f"native {make_target} build failed: "
+                f"{e.stderr.decode(errors='replace')[-500:]}") from e
+        # an existing .so with a broken toolchain (e.g. read-only
+        # checkout, missing g++) still loads — but make failing exactly
+        # when a rebuild was needed means the binary may be STALE, so
+        # say so instead of silently shipping old behavior
+        import warnings
+        warnings.warn(
+            f"native {make_target}: rebuild failed "
+            f"({e.stderr.decode(errors='replace')[-120:]!r}); loading "
+            f"the existing possibly-stale {os.path.basename(so_path)}",
+            stacklevel=3)
     except (OSError, subprocess.SubprocessError) as e:
         if not os.path.exists(so_path):
             raise ImportError(f"native {make_target} build failed: {e}") \

@@ -197,17 +197,25 @@ class Tensor:
         for i in range(len(self)):
             yield self[i]
 
+    def _scalar(self):
+        """paddle converts any size-1 tensor to a python scalar (shape
+        [1] or [1,1] included); jax only converts rank-0 — squeeze."""
+        d = self._data
+        if getattr(d, "ndim", 0) and getattr(d, "size", 1) == 1:
+            d = d.reshape(())
+        return d
+
     def __bool__(self):
-        return bool(self._data)
+        return bool(self._scalar())
 
     def __int__(self):
-        return int(self._data)
+        return int(self._scalar())
 
     def __float__(self):
-        return float(self._data)
+        return float(self._scalar())
 
     def __index__(self):
-        return int(self._data)
+        return int(self._scalar())
 
     def __format__(self, spec):
         if self.size == 1:

@@ -24,9 +24,16 @@ def binary(jfn, differentiable=True):
     return op
 
 
-def _reduce_impl(jfn, x, axis, keepdim, dtype):
+def reduce_axis(axis):
+    """paddle reduction axis: list/tuple normalized to tuple, [] means
+    ALL axes (reference reduce ops: axis=[] -> reduce_all=True)."""
     if isinstance(axis, (list, tuple)):
-        axis = tuple(axis)
+        return tuple(axis) or None
+    return axis
+
+
+def _reduce_impl(jfn, x, axis, keepdim, dtype):
+    axis = reduce_axis(axis)
 
     def f(a):
         if dtype is not None:

@@ -154,8 +154,17 @@ def tile(x, repeat_times, name=None):
     return apply(lambda a: jnp.tile(a, reps), x)
 
 
+def _shape_ints(shape):
+    """Normalize a paddle shape argument: a python sequence, a 1-D
+    Tensor, or a sequence mixing ints with 0-D Tensors (the reference
+    accepts all three for expand/broadcast_to/tile)."""
+    if hasattr(shape, "_data"):
+        return tuple(int(v) for v in np.asarray(raw(shape)).reshape(-1))
+    return tuple(_as_int(s) for s in shape)
+
+
 def expand(x, shape, name=None):
-    shape = tuple(int(s) for s in shape)
+    shape = _shape_ints(shape)
     def f(a):
         tgt = list(shape)
         off = len(tgt) - a.ndim
@@ -172,7 +181,8 @@ def expand_as(x, y, name=None):
 
 
 def broadcast_to(x, shape, name=None):
-    return apply(lambda a: jnp.broadcast_to(a, tuple(shape)), x)
+    tgt = _shape_ints(shape)
+    return apply(lambda a: jnp.broadcast_to(a, tgt), x)
 
 
 def broadcast_tensors(input=None, name=None, inputs=None):

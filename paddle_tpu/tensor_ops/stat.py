@@ -4,22 +4,22 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..tensor import Tensor, apply, nondiff
-from ._factory import raw
+from ._factory import raw, reduce_axis as _axis_arg
 
 
 def mean(x, axis=None, keepdim=False, name=None):
-    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    ax = _axis_arg(axis)
     return apply(lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x)
 
 
 def std(x, axis=None, unbiased=True, keepdim=False, name=None):
-    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    ax = _axis_arg(axis)
     return apply(lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0,
                                    keepdims=keepdim), x)
 
 
 def var(x, axis=None, unbiased=True, keepdim=False, name=None):
-    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    ax = _axis_arg(axis)
     return apply(lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0,
                                    keepdims=keepdim), x)
 

@@ -1,0 +1,292 @@
+"""Dy2static language breadth: break/continue, early return, ternary,
+logical short-circuit, container mutation, fallback-to-eager.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/
+{return,break_continue,logical,ifelse}_transformer.py and
+program_translator.py (fallback). Each case runs the SAME function
+eagerly-converted and under jit.to_static with tensor-valued
+bounds/predicates, asserting no eager fallback happened (conversion must
+produce a traceable program, not lean on the escape hatch).
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(v, dtype=np.float32):
+    return paddle.to_tensor(np.asarray(v, dtype=dtype))
+
+
+def _static_no_fallback(fn):
+    """to_static, asserting the traced path is used (no fallback warning)."""
+    sf = paddle.jit.to_static(fn)
+
+    def call(*args):
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            return sf(*args)
+    return call
+
+
+def test_break_and_continue_in_while():
+    def f(x, n):
+        s = x * 0
+        i = 0
+        while i < n:
+            i = i + 1
+            if i == 3:
+                continue
+            if i > 6:
+                break
+            s = s + x * i
+        return s
+
+    # 1+2+4+5+6 = 18
+    out = _static_no_fallback(f)(_t(1.0), _t(10, np.int32))
+    assert float(out) == 18.0
+
+
+def test_continue_in_for_range_tensor_bound():
+    def f(x, n):
+        s = x * 0
+        for i in range(n):
+            if i == 2:
+                continue
+            s = s + i
+        return s
+
+    out = _static_no_fallback(f)(_t(1.0), _t(5, np.int32))
+    assert float(out) == 8.0  # 0+1+3+4
+
+
+def test_break_in_for_range():
+    def f(x, n):
+        s = x * 0
+        for i in range(n):
+            if i >= 4:
+                break
+            s = s + i
+        return s
+
+    out = _static_no_fallback(f)(_t(1.0), _t(100, np.int32))
+    assert float(out) == 6.0  # 0+1+2+3
+
+
+def test_early_return_in_if():
+    def f(x):
+        if (x > 0).all():
+            return x * 2
+        return x - 1
+
+    g = _static_no_fallback(f)
+    assert float(g(_t(3.0))) == 6.0
+    assert float(g(_t(-3.0))) == -4.0
+
+
+def test_return_escapes_loop():
+    def f(x, n):
+        i = 0
+        acc = x * 0
+        while i < n:
+            acc = acc + x
+            if (acc > 4).all():
+                return acc * 10
+            i = i + 1
+        return acc
+
+    out = _static_no_fallback(f)(_t(2.0), _t(100, np.int32))
+    assert float(out) == 60.0  # 2,4,6 -> 6*10
+
+
+def test_return_escapes_nested_loops():
+    def f(x, n):
+        total = x * 0
+        for i in range(n):
+            for j in range(n):
+                total = total + 1
+                if (total > 5).all():
+                    return total
+        return total
+
+    out = _static_no_fallback(f)(_t(0.0), _t(10, np.int32))
+    assert float(out) == 6.0
+
+
+def test_ternary_tensor_pred():
+    def f(x):
+        y = x * 2 if (x > 0).all() else x * -1
+        return y
+
+    g = _static_no_fallback(f)
+    assert float(g(_t(5.0))) == 10.0
+    assert float(g(_t(-5.0))) == 5.0
+
+
+def test_logical_short_circuit_preserved_eagerly():
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    calls = []
+
+    def side(v):
+        calls.append(v)
+        return v
+
+    def f(a, b):
+        return side(a) and side(b)
+
+    g = convert_control_flow(f)
+    assert g(0, "never") == 0
+    assert calls == [0], "rhs must not evaluate when lhs is falsy"
+    calls.clear()
+    assert g(1, "rhs") == "rhs"
+    assert calls == [1, "rhs"]
+    # `or` mirror
+    def h(a, b):
+        return side(a) or side(b)
+
+    calls.clear()
+    assert convert_control_flow(h)(7, "never") == 7
+    assert calls == [7]
+
+
+def test_logical_ops_traced():
+    def f(x):
+        m = (x > 0) and (x < 10)
+        return paddle.cast(m, "float32")
+
+    out = _static_no_fallback(f)(_t(5.0))
+    assert float(out) == 1.0
+
+
+def test_container_append_concrete_unroll():
+    def f(x):
+        acc = []
+        for i in range(3):
+            acc.append(x * i)
+        return acc[0] + acc[1] + acc[2]
+
+    # concrete bound: the loop stays python and jit unrolls it
+    sf = paddle.jit.to_static(f)
+    assert float(sf(_t(2.0))) == 6.0
+
+
+def test_fallback_to_eager_on_untraceable():
+    def f(x, n):
+        acc = []
+        i = 0
+        # traced bound + container mutation: not convertible -> the
+        # reference's escape hatch applies (warn + run dygraph)
+        while len(acc) < int(n):
+            acc.append(x * i)
+            i += 1
+        return acc[-1]
+
+    sf = paddle.jit.to_static(f)
+    with pytest.warns(UserWarning, match="falling back to eager"):
+        out = sf(_t(2.0), _t(3, np.int32))
+    assert float(out) == 4.0
+    # subsequent calls skip the broken trace entirely
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert float(sf(_t(2.0), _t(3, np.int32))) == 4.0
+
+
+def test_assert_and_print_convert():
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    def f(x):
+        assert x is not None, "x required"
+        print("value ok")
+        return x
+
+    g = convert_control_flow(f)
+    assert g(5) == 5
+
+    def bad(x):
+        assert x > 10, "too small"
+        return x
+
+    with pytest.raises(AssertionError, match="too small"):
+        convert_control_flow(bad)(3)
+
+
+def test_break_inside_try_guards_rest_of_try_body():
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    def f(x):
+        s = 0
+        while s < 10:
+            try:
+                if s >= 2:
+                    break
+                s = s + 1
+            finally:
+                x = x + 1
+        return s, x
+
+    g = convert_control_flow(f)
+    assert f(0) == g(0) == (2, 3)
+
+
+def test_return_inside_match_not_miscompiled():
+    from paddle_tpu.jit.dy2static import convert_control_flow
+
+    side = []
+
+    def f(k, c):
+        if c:
+            return -1
+        match k:
+            case 1:
+                if k == 1:
+                    return 10
+                side.append("never")
+            case _:
+                pass
+        side.append("after-match")
+        return 0
+
+    g = convert_control_flow(f)
+    assert g(1, False) == 10
+    assert side == []  # the statement after the taken return must not run
+    assert g(2, False) == 0
+    assert side == ["after-match"]
+
+
+def test_fallback_is_per_signature():
+    calls = {"n": 0}
+
+    def f(x, flag):
+        if flag == "trace-breaker":
+            # container mutation under traced bound -> untraceable
+            acc = []
+            while len(acc) < int(x):
+                acc.append(1)
+            return _t(float(len(acc)))
+        return x * 2
+
+    sf = paddle.jit.to_static(f)
+    # good signature compiles and runs
+    assert float(sf(_t(3.0), "ok")) == 6.0
+    # bad signature falls back with a warning...
+    with pytest.warns(UserWarning, match="falling back"):
+        assert float(sf(_t(3.0), "trace-breaker")) == 3.0
+    # ...but the good signature still uses the compiled path silently
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert float(sf(_t(4.0), "ok")) == 8.0
+
+
+def test_loop_var_reassignment_in_for_body():
+    def f(x, n):
+        s = x * 0
+        for i in range(n):
+            i = i * 0  # python-for semantics: overwritten next iter
+            s = s + 1
+        return s
+
+    out = _static_no_fallback(f)(_t(0.0), _t(4, np.int32))
+    assert float(out) == 4.0

@@ -1,0 +1,72 @@
+"""Measure pass rates of reference unittest files under the conformance
+harness (tests/test_reference_unittests.py) to set per-file floors.
+
+Each file runs in its own subprocess with a timeout so one pathological
+file can't wedge the sweep. Usage:
+    python tools/measure_ref_unittests.py [file.py ...]
+"""
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import os, sys, json
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.join(%(root)r, "tests"))
+from test_reference_unittests import run_reference_test_file
+r = run_reference_test_file(%(relpath)r)
+out = {
+    "run": r.testsRun, "skip": len(r.skipped),
+    "fail": len(r.failures), "err": len(r.errors),
+    "failing": [t.id().split(".", 1)[1] for t, _ in r.failures + r.errors],
+    "skip_reasons": sorted({m[:60] for _, m in r.skipped}),
+}
+print("RESULT " + json.dumps(out))
+"""
+
+
+def measure(relpath, timeout=600):
+    code = CHILD % {"root": ROOT, "relpath": relpath}
+    env = dict(os.environ, PYTHONPATH=ROOT)
+    try:
+        p = subprocess.run([sys.executable, "-c", code], cwd=ROOT, env=env,
+                           capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout {timeout}s"}
+    for line in p.stdout.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    return {"error": (p.stderr or p.stdout)[-400:]}
+
+
+def main():
+    files = sys.argv[1:]
+    if not files:
+        sys.path.insert(0, os.path.join(ROOT, "tests"))
+        from test_reference_unittests import TARGETS
+        files = sorted(TARGETS)
+    results = {}
+    for f in files:
+        r = measure(f)
+        results[f] = r
+        if "error" in r:
+            print(f"{f:45s} ERROR {r['error'][:120]}", flush=True)
+        else:
+            counted = r["run"] - r["skip"]
+            passed = counted - r["fail"] - r["err"]
+            rate = passed / counted if counted else 0.0
+            print(f"{f:45s} run={r['run']:3d} skip={r['skip']:3d} "
+                  f"pass={passed:3d}/{counted:3d} = {rate:.2f}  "
+                  f"failing={r['failing'][:4]}", flush=True)
+    with open(os.path.join(ROOT, "tools", "ref_ut_measure.json"), "w") as fh:
+        json.dump(results, fh, indent=1)
+
+
+if __name__ == "__main__":
+    main()
