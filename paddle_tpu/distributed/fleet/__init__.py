@@ -87,7 +87,7 @@ class _FleetOptimizer:
 
     def make_train_step(self, model, loss_fn, **kw):
         s = self._strategy
-        modes = [m for m in ("localsgd", "dgc", "fp16_allreduce")
+        modes = [m for m in ("localsgd", "dgc", "fp16_allreduce", "a_sync")
                  if getattr(s, m, False)]
         if len(modes) > 1:
             raise NotImplementedError(
@@ -101,7 +101,15 @@ class _FleetOptimizer:
             if kw:
                 raise NotImplementedError(
                     f"options {sorted(kw)} are not supported by the "
-                    f"localsgd/dgc/fp16_allreduce train steps")
+                    f"{modes[0]} train step")
+        if getattr(s, "a_sync", False):
+            # PS-era geo mode (reference a_sync_configs k_steps>0 → geo
+            # sparse tables, the_one_ps.py:655)
+            from .comm_efficient import GeoSGDTrainStep
+            cfg = getattr(s, "a_sync_configs", {}) or {}
+            return GeoSGDTrainStep(
+                model, self._inner, loss_fn, strategy=s,
+                k_steps=int(cfg.get("k_steps", 0)))
         if getattr(s, "localsgd", False):
             from .comm_efficient import LocalSGDTrainStep
             cfg = s.localsgd_configs

@@ -40,6 +40,13 @@ class DistributedStrategy:
         self.dgc_configs = {"momentum": None, "sparsity": 0.99}
         self.localsgd = False
         self.localsgd_configs = {"k_steps": 4, "begin_step": 1}
+        # PS-era geo/async switch (reference DistributedStrategy.a_sync +
+        # a_sync_configs; the_one_ps.py:655 builds geo sparse tables when
+        # k_steps > 0): workers update tables locally and merge summed
+        # deltas every k_steps. k_steps == 0 (pure async) has no
+        # single-controller analog and raises at make_train_step.
+        self.a_sync = False
+        self.a_sync_configs = {"k_steps": 0}
         self.fp16_allreduce = False
         # dtype: "bfloat16" (half the psum bytes) or "int8" (EQuARX-style
         # two-phase quantized allreduce, ~4x fewer bytes)

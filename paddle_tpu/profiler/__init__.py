@@ -185,9 +185,17 @@ class Profiler:
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
         print(self.step_info())
-        if not self.timer_only:
-            print(f"trace dir: {self._export_dir} "
-                  f"(tensorboard --logdir or perfetto)")
+        if self.timer_only:
+            return
+        try:
+            from .statistic import build_summary, load_profiler_result
+            result = load_profiler_result(self._export_dir)
+            print(build_summary(result, sorted_by=sorted_by,
+                                time_unit=time_unit))
+        except FileNotFoundError:
+            pass  # no recorded steps; nothing to tabulate
+        print(f"trace dir: {self._export_dir} "
+              f"(tensorboard --logdir or perfetto)")
 
     def export(self, path: str, format: str = "json"):
         print(f"trace already exported to {self._export_dir}")
@@ -201,7 +209,11 @@ class RecordEvent:
         self._ann = None
 
     def begin(self):
-        self._ann = jax.profiler.TraceAnnotation(self.name)
+        # the UserDefined:: prefix is how the statistic parser routes
+        # these into the user-event table (reference groups RecordEvents
+        # under TracerEventType.UserDefined) instead of the op summary
+        self._ann = jax.profiler.TraceAnnotation(
+            f"UserDefined::{self.name}")
         self._ann.__enter__()
 
     def end(self):
@@ -230,9 +242,8 @@ class RecordInstantEvent(RecordEvent):
     pass
 
 
-def load_profiler_result(filename: str):
-    raise NotImplementedError(
-        "jax traces are viewed with tensorboard/perfetto, not reloaded here")
+from .statistic import (ProfilerResult, build_summary,  # noqa: E402
+                        load_profiler_result)
 
 
 class SortedKeys(Enum):

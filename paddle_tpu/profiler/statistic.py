@@ -1,0 +1,237 @@
+"""Profiler statistics: per-op summary tables parsed from the exported
+trace (reference: python/paddle/profiler/profiler_statistic.py — the
+1,648-line statistic builder over the reference's host/device event
+tree).
+
+The jax/XLA profiler already records what the reference's tracer
+records — python annotations, runtime infrastructure, and the actual
+device computations (XLA thunks: fusions, dot_general, reductions,
+collectives). This module parses the chrome-trace JSON the profiler
+exports (``<host>.trace.json.gz`` under
+``<dir>/plugins/profile/<run>/``) and aggregates it into the
+reference's summary shapes: overview, operator summary (calls /
+total / avg / max / min per op), and a user-annotation (RecordEvent)
+summary. ``load_profiler_result`` returns a ``ProfilerResult`` whose
+tables ``Profiler.summary()`` prints.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from typing import Dict, List, Optional
+
+# runtime plumbing, not computation: filtered out of the operator table
+_INFRA_PREFIXES = (
+    "PjRt", "PjitFunction", "PythonRefManager", "ParseArguments",
+    "Handle inputs", "ThreadpoolListener", "CommonPjRt", "Wait for",
+    "ThunkExecutor", "CollectGarbage", "process_", "thread_",
+    "BufferFromHostBuffer", "CopyToDevice", "TransferTo", "XlaComputation",
+    "end: ",
+)
+
+
+class EventRecord:
+    __slots__ = ("name", "pid", "tid", "start_us", "dur_us", "process",
+                 "kind")
+
+    def __init__(self, name, pid, tid, start_us, dur_us, process, kind):
+        self.name = name
+        self.pid = pid
+        self.tid = tid
+        self.start_us = start_us
+        self.dur_us = dur_us
+        self.process = process  # e.g. "/host:CPU", "/device:TPU:0"
+        self.kind = kind        # "op" | "annotation" | "infra"
+
+    def __repr__(self):
+        return (f"EventRecord({self.name!r}, {self.process}, "
+                f"{self.dur_us:.1f}us)")
+
+
+def _classify(name: str) -> str:
+    if name.startswith("$") or name.startswith("UserDefined::"):
+        return "annotation"  # python-level ranges / RecordEvent
+    for p in _INFRA_PREFIXES:
+        if name.startswith(p):
+            return "infra"
+    return "op"
+
+
+class _Agg:
+    __slots__ = ("calls", "total", "mx", "mn")
+
+    def __init__(self):
+        self.calls = 0
+        self.total = 0.0
+        self.mx = 0.0
+        self.mn = float("inf")
+
+    def add(self, dur):
+        self.calls += 1
+        self.total += dur
+        self.mx = max(self.mx, dur)
+        self.mn = min(self.mn, dur)
+
+    @property
+    def avg(self):
+        return self.total / self.calls if self.calls else 0.0
+
+
+class ProfilerResult:
+    """Parsed trace: events plus the reference's aggregate views."""
+
+    def __init__(self, events: List[EventRecord], source: str = ""):
+        self.events = events
+        self.source = source
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_chrome_trace(cls, path: str) -> "ProfilerResult":
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rt") as fh:
+            doc = json.load(fh)
+        processes: Dict[int, str] = {}
+        for e in doc.get("traceEvents", []):
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                processes[e["pid"]] = e.get("args", {}).get("name", "")
+        events = []
+        for e in doc.get("traceEvents", []):
+            if e.get("ph") != "X":
+                continue
+            name = e.get("name", "")
+            events.append(EventRecord(
+                name=name, pid=e.get("pid"), tid=e.get("tid"),
+                start_us=float(e.get("ts", 0.0)),
+                dur_us=float(e.get("dur", 0.0)),
+                process=processes.get(e.get("pid"), ""),
+                kind=_classify(name)))
+        return cls(events, source=path)
+
+    @classmethod
+    def from_trace_dir(cls, dir_name: str) -> "ProfilerResult":
+        pats = [os.path.join(dir_name, "plugins", "profile", "*",
+                             "*.trace.json.gz"),
+                os.path.join(dir_name, "plugins", "profile", "*",
+                             "*.trace.json"),
+                os.path.join(dir_name, "*.trace.json.gz"),
+                os.path.join(dir_name, "*.json.gz"),
+                os.path.join(dir_name, "*.json")]
+        for pat in pats:
+            hits = sorted(glob.glob(pat))
+            if hits:
+                return cls.from_chrome_trace(hits[-1])  # latest run
+        raise FileNotFoundError(
+            f"no chrome trace found under {dir_name!r} (expected "
+            "plugins/profile/<run>/<host>.trace.json.gz — did the "
+            "profiler record at least one step?)")
+
+    # -- aggregate views ----------------------------------------------------
+
+    def _aggregate(self, kind: str) -> Dict[str, _Agg]:
+        out: Dict[str, _Agg] = {}
+        for ev in self.events:
+            if ev.kind != kind:
+                continue
+            out.setdefault(ev.name, _Agg()).add(ev.dur_us)
+        return out
+
+    def op_summary(self) -> Dict[str, dict]:
+        """name -> {calls,total,avg,max,min} (microseconds) for device /
+        XLA computation events — the reference's Operator Summary."""
+        return {k: {"calls": a.calls, "total": a.total, "avg": a.avg,
+                    "max": a.mx, "min": a.mn}
+                for k, a in self._aggregate("op").items()}
+
+    def annotation_summary(self) -> Dict[str, dict]:
+        """User RecordEvent / python ranges — reference's
+        UserDefined/Forward/... event-type rollup."""
+        return {k: {"calls": a.calls, "total": a.total, "avg": a.avg,
+                    "max": a.mx, "min": a.mn}
+                for k, a in self._aggregate("annotation").items()}
+
+    def device_summary(self) -> Dict[str, float]:
+        """process name -> busy microseconds of op events."""
+        out: Dict[str, float] = {}
+        for ev in self.events:
+            if ev.kind == "op":
+                out[ev.process] = out.get(ev.process, 0.0) + ev.dur_us
+        return out
+
+    def time_range(self) -> float:
+        xs = [e for e in self.events if e.dur_us > 0]
+        if not xs:
+            return 0.0
+        lo = min(e.start_us for e in xs)
+        hi = max(e.start_us + e.dur_us for e in xs)
+        return hi - lo
+
+
+_UNIT_DIV = {"s": 1e6, "ms": 1e3, "us": 1.0, "ns": 1e-3}
+
+_SORT_FIELD = {  # SortedKeys -> aggregate field
+    "CPUTotal": "total", "CPUAvg": "avg", "CPUMax": "max", "CPUMin": "min",
+    "GPUTotal": "total", "GPUAvg": "avg", "GPUMax": "max", "GPUMin": "min",
+}
+
+
+def _fmt_table(title: str, rows: List[tuple], unit: str) -> str:
+    div = _UNIT_DIV.get(unit, 1e3)
+    header = (f"{'Name':<44} {'Calls':>6} {f'Total({unit})':>12} "
+              f"{f'Avg({unit})':>10} {f'Max({unit})':>10} "
+              f"{f'Min({unit})':>10}")
+    bar = "-" * len(header)
+    lines = [bar, title, bar, header, bar]
+    for name, st in rows:
+        nm = name if len(name) <= 43 else name[:40] + "..."
+        lines.append(
+            f"{nm:<44} {st['calls']:>6} {st['total'] / div:>12.3f} "
+            f"{st['avg'] / div:>10.3f} {st['max'] / div:>10.3f} "
+            f"{st['min'] / div:>10.3f}")
+    lines.append(bar)
+    return "\n".join(lines)
+
+
+def build_summary(result: ProfilerResult, sorted_by=None,
+                  time_unit: str = "ms") -> str:
+    """Format the reference's summary tables from a parsed trace
+    (profiler_statistic.py _build_table analog)."""
+    field = _SORT_FIELD.get(
+        getattr(sorted_by, "name", str(sorted_by)), "total")
+    parts = []
+    dev = result.device_summary()
+    if dev:
+        div = _UNIT_DIV.get(time_unit, 1e3)
+        span = result.time_range() / div
+        lines = ["Device Summary:"]
+        for proc, busy in sorted(dev.items()):
+            lines.append(f"  {proc or '<unknown>'}: busy "
+                         f"{busy / div:.3f}{time_unit} over a "
+                         f"{span:.3f}{time_unit} span")
+        parts.append("\n".join(lines))
+    ops = sorted(result.op_summary().items(),
+                 key=lambda kv: kv[1][field], reverse=True)
+    if ops:
+        parts.append(_fmt_table("Operator Summary "
+                                f"(sorted by {field})", ops, time_unit))
+    anns = sorted(result.annotation_summary().items(),
+                  key=lambda kv: kv[1]["total"], reverse=True)
+    if anns:
+        parts.append(_fmt_table("UserDefined / Python Summary",
+                                anns[:20], time_unit))
+    return "\n\n".join(parts) if parts else "no events parsed"
+
+
+def load_profiler_result(filename: str) -> ProfilerResult:
+    """Load an exported trace — a profiler output dir, a
+    plugins/profile run dir, or a chrome-trace json(.gz) file
+    (reference profiler.py:load_profiler_result)."""
+    if os.path.isdir(filename):
+        return ProfilerResult.from_trace_dir(filename)
+    if not os.path.exists(filename):
+        raise FileNotFoundError(
+            f"no chrome trace at {filename!r} (pass the profiler's "
+            "output dir or a *.trace.json[.gz] file)")
+    return ProfilerResult.from_chrome_trace(filename)

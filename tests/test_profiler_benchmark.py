@@ -48,3 +48,90 @@ def test_benchmark_steps_per_sec_mode():
     # after end(), step() records nothing
     bm.step(num_samples=8)
     assert bm.step_info() == ""
+
+
+# ---------------------------------------------------------------------------
+# op-level statistics from the exported trace
+# (reference: python/paddle/profiler/profiler_statistic.py)
+# ---------------------------------------------------------------------------
+
+
+def test_profiler_op_statistics_from_trace(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import profiler as prof
+
+    @jax.jit
+    def step(x):
+        return jnp.tanh(x @ x) + x.sum()
+
+    x = jnp.ones((128, 128), jnp.float32)
+    step(x).block_until_ready()  # compile outside the trace
+
+    p = prof.Profiler()
+    p._export_dir = str(tmp_path / "trace")
+    p.start()
+    for _ in range(3):
+        with prof.RecordEvent("train_step"):
+            step(x).block_until_ready()
+        p.step()
+    p.stop()
+
+    result = prof.load_profiler_result(p._export_dir)
+    ops = result.op_summary()
+    assert ops, "no op events parsed from the trace"
+    # the matmul thunk must appear as a real measured op, called once
+    # per recorded step
+    dot = [k for k in ops if "dot" in k.lower() or "gemm" in k.lower()]
+    assert dot, f"no matmul op in {sorted(ops)[:12]}"
+    st = ops[dot[0]]
+    assert st["calls"] >= 3
+    assert st["total"] >= st["max"] >= st["min"] > 0
+    assert abs(st["total"] / st["calls"] - st["avg"]) < 1e-6
+    # infra plumbing must NOT pollute the operator table
+    assert not any(k.startswith(("PjRt", "ThreadpoolListener", "end: "))
+                   for k in ops)
+    # the RecordEvent annotation shows up in the python/user rollup
+    anns = result.annotation_summary()
+    assert any("train_step" in k for k in anns), sorted(anns)[:12]
+
+    # the formatted tables render with the op and sane columns
+    from paddle_tpu.profiler.statistic import build_summary
+    text = build_summary(result, time_unit="ms")
+    assert "Operator Summary" in text
+    assert any(d.split(".")[0][:20] in text for d in dot)
+    assert "Device Summary" in text
+
+
+def test_profiler_summary_prints_tables(tmp_path, capsys):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import profiler as prof
+
+    @jax.jit
+    def step(x):
+        return (x * x).sum()
+
+    x = jnp.ones((64, 64), jnp.float32)
+    step(x).block_until_ready()
+    p = prof.Profiler()
+    p._export_dir = str(tmp_path / "t2")
+    p.start()
+    step(x).block_until_ready()
+    p.step()
+    p.stop()
+    p.summary(sorted_by=prof.SortedKeys.CPUTotal)
+    out = capsys.readouterr().out
+    assert "Operator Summary" in out
+    assert "trace dir:" in out
+
+
+def test_load_profiler_result_missing_dir(tmp_path):
+    import pytest
+
+    from paddle_tpu import profiler as prof
+
+    with pytest.raises(FileNotFoundError, match="no chrome trace"):
+        prof.load_profiler_result(str(tmp_path / "empty"))
