@@ -9,6 +9,14 @@ ZeRO-3 over the sharding axis, replication otherwise), scored by a memory
 model (fits-in-HBM first, then per-device bytes), optionally cross-checked
 with XLA's cost_analysis, and the winner feeds the same CompiledTrainStep
 the manual Fleet path uses — GSPMD then materializes the collectives.
+
+Op-level planning (reference partitioner.py/reshard.py analog):
+``plan_activations`` searches explicit with_sharding_constraint layouts
+for the major activation sites on top of the parameter plan, keeping a
+constraint only when the compiled cost (reshards included) beats
+GSPMD's inference — see the "op-level (activation) planning" section.
+Pipeline (pp) placement is the pipeline train step's own schedule
+(ops/pipeline.py, fleet/pp_train_step.py), not planned here.
 """
 from __future__ import annotations
 
@@ -67,9 +75,16 @@ class Engine:
         self.hbm_budget = hbm_budget_bytes or 12 * 2**30
         # how many of the largest params get per-param candidate
         # refinement plans (search breadth / compile-time knob)
-        self.refine_top_k = 4
+        self.refine_top_k = 8
         self._plan = None
         self.last_costs = {}  # plan name -> compiled cost, after plan()
+        # op-level planning state (reference auto_parallel annotates
+        # every operator/activation with a dist_attr; here the major
+        # activation sites get explicit with_sharding_constraint specs
+        # when they beat GSPMD's inference on compiled cost)
+        self.activation_specs = {}  # sublayer path -> PartitionSpec
+        self.last_activation_costs = {}
+        self._act_handles = []
 
     # -- candidate generation ------------------------------------------------
 
@@ -238,10 +253,18 @@ class Engine:
         self._plan = chosen
         return chosen
 
-    def _cost(self, plan, sample_batch):
+    def _cost(self, plan, sample_batch, activation_specs=None):
         """Compiled cost of one fwd+bwd step WITH the plan's shardings
         applied as the parameters' in_shardings (GSPMD propagates from
-        there, inserting the collectives the plan implies)."""
+        there, inserting the collectives the plan implies). With
+        ``activation_specs``, the listed sublayers' outputs are pinned
+        via with_sharding_constraint during the trace, so the cost
+        includes any reshards the constraints force."""
+        # any pinned hooks from a previous prepare() must not pollute
+        # this measurement — detach, measure, reinstall
+        for h in self._act_handles:
+            h.remove()
+        handles = self._install_constraints(activation_specs or {})
         try:
             from jax.sharding import NamedSharding
 
@@ -274,16 +297,167 @@ class Engine:
             return float(cost.get("bytes accessed", math.inf))
         except Exception:
             return float(plan.bytes_per_device) * 1e6  # worst-ranked
+        finally:
+            for h in handles:
+                h.remove()
+            if self._act_handles:
+                self._act_handles = self._install_constraints(
+                    self.activation_specs)
+
+    # -- op-level (activation) planning --------------------------------------
+    #
+    # Reference: auto_parallel/{planner.py,partitioner.py,reshard.py} give
+    # every operator a dist_attr and insert explicit reshard programs when
+    # producer/consumer shardings disagree. The XLA analog: GSPMD already
+    # infers activation shardings from the parameter placements, and
+    # with_sharding_constraint is the reshard primitive — so the planner's
+    # job is to find the activation sites where an EXPLICIT constraint
+    # beats GSPMD's inference, measured on compiled cost, and pin exactly
+    # those. The constraint mid-graph also lets the plan CHANGE along the
+    # program (e.g. TP inside attention, batch-sharded at the small head).
+
+    def _activation_sites(self, max_sites=4):
+        """Major activation sites: the largest-parameter leaf sublayers
+        (attention/MLP projections, embeddings, the logits head), ordered
+        by parameter size — the places whose output layout decides the
+        collective pattern."""
+        sized = []
+        for name, sub in self.model.named_sublayers():
+            if any(True for _ in sub.named_sublayers()):
+                continue  # leaves only: constraints nest otherwise
+            n = sum(float(np.prod(p._data.shape))
+                    for _, p in sub.named_parameters())
+            if n >= 1024:
+                sized.append((n, name, sub))
+        sized.sort(key=lambda t: (-t[0], t[1]))
+        return [(name, sub) for _, name, sub in sized[:max_sites]]
+
+    def _activation_candidates(self):
+        """Candidate output layouts, ndim-agnostic (the hook pads/guards
+        at trace time): batch over dp, hidden over tp, batch over
+        dp×sharding, and both ends pinned."""
+        have = {a for a in ("dp", "tp", "sharding")
+                if self.mesh.shape.get(a, 1) > 1}
+        cands = []
+        if "dp" in have:
+            cands.append(("batch-dp", ("dp",)))
+        if "tp" in have:
+            cands.append(("hidden-tp", ("...", "tp")))
+        if {"dp", "tp"} <= have:
+            cands.append(("dp+tp", ("dp", "...", "tp")))
+        if {"dp", "sharding"} <= have:
+            cands.append(("batch-dpxshard", (("dp", "sharding"),)))
+        return cands
+
+    def _constraint_hook(self, template):
+        """Forward-post hook applying with_sharding_constraint with the
+        template expanded to the output's rank; silently passes through
+        outputs whose shape can't take the spec (tuple outputs, rank
+        too small, non-divisible dims)."""
+        from jax.sharding import NamedSharding
+
+        from ..tensor import Tensor
+
+        def expand(nd):
+            t = tuple(template)
+            if "..." in t:
+                i = t.index("...")
+                head, tail = t[:i], t[i + 1:]
+                if len(head) + len(tail) > nd:
+                    return None
+                return head + (None,) * (nd - len(head) - len(tail)) + tail
+            if len(t) > nd:
+                return None
+            return t + (None,) * (nd - len(t))
+
+        def axis_size(ax):
+            if isinstance(ax, tuple):
+                s = 1
+                for a in ax:
+                    s *= self.mesh.shape.get(a, 1)
+                return s
+            return self.mesh.shape.get(ax, 1)
+
+        def hook(layer, inputs, output):
+            if not isinstance(output, Tensor):
+                return None
+            raw = output._data
+            if not isinstance(raw, jax.core.Tracer):
+                # only under jit: rewrapping eagerly would detach the
+                # autograd tape, and a constraint means nothing eager
+                return None
+            sp = expand(raw.ndim)
+            if sp is None:
+                return None
+            for d, ax in enumerate(sp):
+                if ax is not None and raw.shape[d] % axis_size(ax):
+                    return None
+            out = jax.lax.with_sharding_constraint(
+                raw, NamedSharding(self.mesh, P(*sp)))
+            t = Tensor(out, stop_gradient=output.stop_gradient)
+            return t
+        return hook
+
+    def _install_constraints(self, specs):
+        subs = dict(self.model.named_sublayers())
+        handles = []
+        for name, template in specs.items():
+            sub = subs.get(name)
+            if sub is not None:
+                handles.append(sub.register_forward_post_hook(
+                    self._constraint_hook(template)))
+        return handles
+
+    def plan_activations(self, sample_batch, max_compiles=8,
+                         max_sites=4):
+        """Greedy per-site search over activation layouts on top of the
+        chosen parameter plan: a candidate constraint is kept only when
+        the COMPILED cost (XLA cost_analysis with the constraint's
+        reshard materialized) beats the current best. Returns the kept
+        {site: spec-template} map; ``prepare()`` pins them."""
+        if self._plan is None:
+            self.plan(use_cost_model=True, sample_batch=sample_batch)
+        # plan(use_cost_model=True) already compiled the chosen plan —
+        # don't pay that compile twice
+        baseline = self.last_costs.get(self._plan.name)
+        if baseline is None:
+            baseline = self._cost(self._plan, sample_batch)
+        self.activation_specs = {}
+        self.last_activation_costs = {"<param-plan-only>": baseline}
+        best = baseline
+        compiles = 0
+        cands = self._activation_candidates()
+        for name, _sub in self._activation_sites(max_sites):
+            site_best, site_spec = best, None
+            for label, template in cands:
+                if compiles >= max_compiles:
+                    break
+                trial = dict(self.activation_specs)
+                trial[name] = template
+                cost = self._cost(self._plan, sample_batch,
+                                  activation_specs=trial)
+                compiles += 1
+                self.last_activation_costs[f"{name}:{label}"] = cost
+                if cost < site_best:
+                    site_best, site_spec = cost, template
+            if site_spec is not None:
+                self.activation_specs[name] = site_spec
+                best = site_best
+        self.last_activation_costs["<with-activation-plan>"] = best
+        return self.activation_specs
 
     # -- application ---------------------------------------------------------
 
     def prepare(self, accumulate_steps=None, scaler=None):
-        """Apply the chosen plan to the model's params and build the
-        compiled train step."""
+        """Apply the chosen plan to the model's params, pin any winning
+        activation constraints, and build the compiled train step."""
         if self._plan is None:
             self.plan()
         for k, p in self._params().items():
             p.pspec = self._plan.specs.get(k, p.pspec)
+        for h in self._act_handles:
+            h.remove()
+        self._act_handles = self._install_constraints(self.activation_specs)
         from .fleet.train_step import make_train_step
         if self.optimizer is None or self.loss_fn is None:
             raise ValueError("Engine.prepare needs optimizer and loss_fn")

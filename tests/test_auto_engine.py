@@ -182,3 +182,116 @@ def test_cost_model_applies_shardings_and_beats_naive_dp():
         assert costs[chosen.name] <= naive, (chosen.name, costs)
     finally:
         set_mesh(None)
+
+
+def test_activation_planner_emits_constraints_and_improves_cost():
+    """VERDICT r3 task 5 acceptance: op-level planning — activation
+    sites get candidate specs, costed against GSPMD's inference;
+    winning constraints are pinned and the planned program's compiled
+    cost is <= the param-only plan on llama at dp2×tp2(+sharding2)."""
+    import dataclasses
+
+    from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+    _mesh(tp=2, sharding=2, dp=2)
+    try:
+        paddle.seed(0)
+        cfg = dataclasses.replace(LLAMA_TINY, dtype="float32")
+        model = LlamaForCausalLM(cfg)
+        eng = ap.Engine(model, lambda m, i, l: m(i, labels=l),
+                        optim.AdamW(learning_rate=1e-3,
+                                    parameters=model.parameters()))
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32))
+        eng.plan(use_cost_model=True, sample_batch=(ids, ids),
+                 max_compiles=3)
+        specs = eng.plan_activations((ids, ids), max_compiles=6,
+                                     max_sites=2)
+        costs = eng.last_activation_costs
+        baseline = costs["<param-plan-only>"]
+        final = costs["<with-activation-plan>"]
+        # candidates were actually costed (not just the baseline)
+        assert len(costs) >= 3, costs
+        # greedy keeps only improvements — final can never be worse
+        assert final <= baseline, costs
+        # on dp2×tp2×sharding2, batch-sharding the embedding output
+        # beats GSPMD's inferred layout (it avoids the involuntary
+        # full-remat reshard after the gather) — the planner must find
+        # and keep a constraint, and it must lower the compiled cost
+        assert specs, costs
+        assert final < baseline, costs
+    finally:
+        set_mesh(None)
+
+
+def test_activation_constraint_changes_compiled_program():
+    """A pinned activation constraint must materially change the chosen
+    program: the lowered HLO differs from the unconstrained lowering
+    and carries the site's sharding annotation."""
+    import jax
+    from paddle_tpu.distributed.mesh import get_mesh
+
+    _mesh(tp=2, sharding=1, dp=2)
+    try:
+        paddle.seed(0)
+        model = _Net(h=64)
+        eng = ap.Engine(model, _loss,
+                        optim.SGD(learning_rate=0.1,
+                                  parameters=model.parameters()))
+        eng.plan()
+        x = paddle.to_tensor(np.random.rand(8, 16).astype(np.float32))
+        y = paddle.to_tensor(np.random.rand(8, 8).astype(np.float32))
+
+        def lower_text():
+            from jax.sharding import NamedSharding
+            from paddle_tpu.autograd.tape import functional_mode
+            from paddle_tpu.jit.api import _swap_params
+
+            params = dict(model.named_parameters())
+
+            def fwd(pv, bx, by):
+                with functional_mode(), _swap_params(params, pv):
+                    return _loss(model, bx, by)._data.sum()
+
+            pv = {k: p._data for k, p in params.items()}
+            return jax.jit(fwd).lower(pv, x._data, y._data).as_text()
+
+        plain = lower_text()
+        handles = eng._install_constraints({"up": ("dp", "...", "tp")})
+        try:
+            constrained = lower_text()
+        finally:
+            for h in handles:
+                h.remove()
+        assert plain != constrained
+        assert ("sharding_constraint" in constrained
+                or "Sharding" in constrained), constrained[:400]
+    finally:
+        set_mesh(None)
+
+
+def test_activation_hook_noop_outside_jit_and_bad_shapes():
+    """The constraint hook must pass through eager outputs (tape safety)
+    and outputs whose rank/divisibility can't take the spec."""
+    _mesh(tp=2, sharding=1, dp=2)
+    try:
+        model = _Net(h=64)
+        eng = ap.Engine(model, _loss,
+                        optim.SGD(learning_rate=0.1,
+                                  parameters=model.parameters()))
+        handles = eng._install_constraints({"up": ("dp", "...", "tp")})
+        try:
+            x = paddle.to_tensor(np.random.rand(8, 16).astype(np.float32))
+            out = model(x)  # eager: hook must not rewrap
+            assert out.shape == [8, 8]
+            # odd batch: divisibility guard passes through under jit too
+            x3 = paddle.to_tensor(
+                np.random.rand(3, 16).astype(np.float32))
+            out3 = model(x3)
+            assert out3.shape == [3, 8]
+        finally:
+            for h in handles:
+                h.remove()
+    finally:
+        set_mesh(None)
