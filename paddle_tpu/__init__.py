@@ -26,6 +26,7 @@ from .framework import (  # noqa: F401
 from .tensor import Parameter, Tensor, to_tensor  # noqa: F401
 from .tensor_ops import *  # noqa: F401,F403
 from .tensor_ops import _bind  # noqa: F401  (attaches Tensor methods)
+from .tensor_ops.creation import _memcpy  # noqa: F401  (underscore name)
 from .autograd import enable_grad, grad, no_grad  # noqa: F401
 from .autograd.tape import set_grad_enabled  # noqa: F401
 

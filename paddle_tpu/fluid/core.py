@@ -18,22 +18,28 @@ LoDTensorArray = list
 _Scope = Scope
 
 
+import enum
+
+
 class VarDesc:
-    class VarType:
-        FP16 = "float16"
-        BF16 = "bfloat16"
-        FP32 = "float32"
-        FP64 = "float64"
-        INT8 = "int8"
-        INT16 = "int16"
-        INT32 = "int32"
-        INT64 = "int64"
-        BOOL = "bool"
-        UINT8 = "uint8"
-        COMPLEX64 = "complex64"
-        COMPLEX128 = "complex128"
-        LOD_TENSOR = "lod_tensor"
-        SELECTED_ROWS = "selected_rows"
+    class VarType(enum.IntEnum):
+        # framework.proto VarType.Type numbering — reference code does
+        # both int(VarType.FP32) and dtype conversion on these, so they
+        # must be the real proto integers (convert_dtype maps them back)
+        BOOL = 0
+        INT16 = 1
+        INT32 = 2
+        INT64 = 3
+        FP16 = 4
+        FP32 = 5
+        FP64 = 6
+        LOD_TENSOR = 7
+        SELECTED_ROWS = 8
+        UINT8 = 20
+        INT8 = 21
+        BF16 = 22
+        COMPLEX64 = 23
+        COMPLEX128 = 24
 
 
 def supports_bfloat16():

@@ -135,11 +135,15 @@ def mean(x, name=None):
     return _T.mean(x)
 
 
-def sum(x):
-    """fluid.layers.sum adds a LIST of tensors (reference tensor.py:sum)."""
-    if isinstance(x, (list, tuple)):
-        return _p.add_n(list(x))
-    return _p.add_n([x])
+def sum(x=None, input=None, out=None):
+    """fluid.layers.sum adds a LIST of tensors (reference tensor.py:sum;
+    the 1.x spellings are ``input`` and an optional ``out`` target)."""
+    x = x if x is not None else input
+    res = _p.add_n(list(x)) if isinstance(x, (list, tuple)) else _p.add_n([x])
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
 
 
 sums = sum
@@ -393,11 +397,29 @@ def fill_constant_batch_size_like(input, shape, dtype, value,
     return _T.full(shape, value, dtype=dtype)
 
 
+_FLUID_FILL_DTYPES = {"bool", "float16", "float32", "float64",
+                      "int32", "int64", "uint8", "bfloat16"}
+
+
+def _check_fluid_fill_args(op, shape, dtype):
+    # reference fluid.layers zeros/ones validation (check_type/
+    # check_dtype): shape must be a sequence/Variable, dtype from the
+    # registered set — int8 etc. raise TypeError
+    if not isinstance(shape, (list, tuple)) and not hasattr(shape, "_data"):
+        raise TypeError(
+            f"{op}: shape must be a list/tuple/Tensor, got "
+            f"{type(shape).__name__}")
+    if isinstance(dtype, str) and dtype not in _FLUID_FILL_DTYPES:
+        raise TypeError(f"{op}: dtype {dtype!r} is not supported")
+
+
 def zeros(shape, dtype='float32', force_cpu=False):
+    _check_fluid_fill_args("zeros", shape, dtype)
     return fill_constant(shape, dtype, 0.0)
 
 
 def ones(shape, dtype='float32', force_cpu=False):
+    _check_fluid_fill_args("ones", shape, dtype)
     return fill_constant(shape, dtype, 1.0)
 
 
@@ -405,7 +427,9 @@ zeros_like = _T.zeros_like
 ones_like = _T.ones_like
 assign = _T.assign
 cast = _T.cast
-concat = _T.concat
+def concat(x=None, axis=0, name=None, input=None):
+    # 1.x spelling: fluid.layers.concat(input=[...], axis=...)
+    return _T.concat(x if x is not None else input, axis=axis, name=name)
 stack = _T.stack
 unstack = _T.unstack
 split = _T.split

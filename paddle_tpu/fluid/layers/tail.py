@@ -493,16 +493,20 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
                                 persistable=True,
                                 name=counter_name or "@step_counter@")
     prog = _current_main or default_main_program()
-
-    def _tick():
-        import jax.numpy as jnp
-        counter._data = counter._data + jnp.asarray(step, jnp.int64)
-
+    # functools.partial over a module-level function, NOT a closure:
+    # a Program carrying this thunk must stay picklable (paddle.save)
+    import functools
+    tick = functools.partial(_step_counter_tick, counter, step)
     if hasattr(prog, "_append_thunk"):
-        prog._append_thunk(_tick)
+        prog._append_thunk(tick)
     else:
-        _tick()
+        tick()
     return counter
+
+
+def _step_counter_tick(counter, step):
+    import jax.numpy as jnp
+    counter._data = counter._data + jnp.asarray(step, jnp.int64)
 
 
 # -- recurrent builders (reference fluid/layers/rnn.py) --------------------

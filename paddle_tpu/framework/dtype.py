@@ -57,7 +57,33 @@ def convert_dtype(dtype):
             return np.dtype(_STR2DTYPE[dtype])
         except KeyError:
             raise ValueError(f"Unknown dtype: {dtype!r}")
+    if isinstance(dtype, int) and not isinstance(dtype, bool):
+        # framework.proto VarType.Type enum (fluid.core.VarDesc.VarType)
+        name = _proto_names().get(int(dtype))
+        if name is None:
+            raise ValueError(f"Unknown VarType enum: {dtype!r}")
+        return np.dtype(_STR2DTYPE[name])
     return np.dtype(dtype)
+
+
+_ENUM2NAME = {"BOOL": "bool", "INT16": "int16", "INT32": "int32",
+              "INT64": "int64", "FP16": "float16", "FP32": "float32",
+              "FP64": "float64", "UINT8": "uint8", "INT8": "int8",
+              "BF16": "bfloat16", "COMPLEX64": "complex64",
+              "COMPLEX128": "complex128"}
+_proto_cache = None
+
+
+def _proto_names():
+    """proto id -> dtype name, derived from the single authoritative
+    enum (fluid.core.VarDesc.VarType); lazy to avoid a circular import."""
+    global _proto_cache
+    if _proto_cache is None:
+        from ..fluid.core import VarDesc
+
+        _proto_cache = {int(v): _ENUM2NAME[v.name]
+                        for v in VarDesc.VarType if v.name in _ENUM2NAME}
+    return _proto_cache
 
 
 def is_floating_point_dtype(dtype) -> bool:

@@ -65,6 +65,8 @@ class StaticFunction:
                  convert_control_flow: bool = True):
         self._orig_fn = fn
         self._fallback_keys = set()
+        self._last_sig = None
+        self._last_args = None
         if convert_control_flow:
             from .dy2static import convert_control_flow as _ccf
             fn = _ccf(fn)
@@ -140,6 +142,16 @@ class StaticFunction:
                 (raw_params, args, kwargs))
         except TypeError:  # unhashable non-array argument
             return self._orig_fn(*args, **kwargs)
+        # remember the call signature so jit.save without input_spec can
+        # export the traced program (reference: concrete_program shapes);
+        # structs are only rebuilt when the signature actually changes
+        if not kwargs and args and all(
+                isinstance(a, Tensor) for a in args):
+            sig = tuple((a._data.shape, a._data.dtype) for a in args)
+            if sig != self._last_sig:
+                self._last_sig = sig
+                self._last_args = tuple(
+                    jax.ShapeDtypeStruct(tuple(s), d) for s, d in sig)
         try:
             return self._jit(dyn, static_spec)
         except (jax.errors.ConcretizationTypeError,
@@ -199,6 +211,16 @@ def to_static(function=None, input_spec=None, build_strategy=None,
 
     def decorate(obj):
         if isinstance(obj, Layer):
+            # the reference's convert_call converts every function the
+            # traced program reaches; the overwhelmingly common case is
+            # tensor control flow inside SUB-layer forwards, so convert
+            # those too (a sublayer whose source can't convert keeps its
+            # original forward)
+            from .dy2static import convert_control_flow as _ccf
+            for _, sub in obj.named_sublayers():
+                conv = _ccf(sub.forward)
+                if conv is not sub.forward:
+                    sub.forward = conv
             obj.forward = StaticFunction(obj.forward, input_spec)
             return obj
         return StaticFunction(obj, input_spec)

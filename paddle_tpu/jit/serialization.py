@@ -50,6 +50,16 @@ def save(layer, path, input_spec=None, **configs):
         }
         payload["class"] = type(layer).__module__ + "." + type(layer).__qualname__
     hlo = None
+    if input_spec is None and isinstance(layer, Layer):
+        # reference jit.save without input_spec exports the
+        # concrete_program traced by earlier forward calls; the
+        # StaticFunction remembers its last all-Tensor call signature
+        last = getattr(getattr(layer, "forward", None), "_last_args", None)
+        if last:
+            from ..static import InputSpec
+
+            input_spec = [InputSpec(shape=list(s.shape), dtype=s.dtype)
+                          for s in last]
     if input_spec is not None:
         try:
             from jax import export as jax_export

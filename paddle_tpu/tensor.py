@@ -139,6 +139,15 @@ class Tensor:
     def clear_grad(self):
         self.grad = None
 
+    def gradient(self):
+        """The accumulated gradient as a numpy array, or None
+        (reference varbase_patch_methods.gradient)."""
+        if self.grad is None:
+            return None
+        import numpy as _np
+
+        return _np.asarray(self.grad._data)
+
     def get_value(self):
         """The tensor's value as a detached Tensor (reference
         varbase_patch_methods get_value — paired with set_value for

@@ -10,8 +10,27 @@ from ._factory import raw
 
 
 def _dt(dtype):
-    d = dtype_mod.convert_dtype(dtype)
+    try:
+        d = dtype_mod.convert_dtype(dtype)
+    except ValueError as e:
+        # reference check_dtype raises TypeError for unregistered dtypes
+        raise TypeError(str(e)) from e
     return d if d is not None else dtype_mod.get_default_dtype()
+
+
+def _static_shape_check(op, shape):
+    """The reference's static-mode check_type: creation ops under a
+    static Program require a list/tuple/Variable shape (a bare int is
+    only accepted in dygraph)."""
+    from ..fluid.dygraph.base import in_dygraph_mode
+    from ..static import program as prog_mod
+
+    in_static = (not in_dygraph_mode()
+                 or prog_mod._current_main is not None)  # program_guard
+    if isinstance(shape, (int, np.integer)) and in_static:
+        raise TypeError(
+            f"{op}: shape must be a list/tuple/Tensor in static mode, "
+            f"got int")
 
 
 def _shape(shape):
@@ -20,14 +39,22 @@ def _shape(shape):
     if isinstance(shape, (int, np.integer)):
         return (int(shape),)
     from .manipulation import _as_int
-    return tuple(_as_int(s) for s in shape)
+    dims = tuple(_as_int(s) for s in shape)
+    if any(d < 0 for d in dims):
+        # reference check_shape: creation-op dims must be concrete
+        raise ValueError(
+            f"Each dimension of shape is expected to be no less than 0, "
+            f"but got {list(dims)}")
+    return dims
 
 
 def zeros(shape, dtype=None, name=None):
+    _static_shape_check("zeros", shape)
     return Tensor(jnp.zeros(_shape(shape), dtype=_dt(dtype)))
 
 
 def ones(shape, dtype=None, name=None):
+    _static_shape_check("ones", shape)
     return Tensor(jnp.ones(_shape(shape), dtype=_dt(dtype)))
 
 
@@ -87,14 +114,31 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
     return Tensor(jnp.arange(start, end, step, dtype=dt))
 
 
+_LINSPACE_DTYPES = {"float32", "float64", "int32", "int64"}
+
+
+def _scalar_arg(v):
+    """start/stop accept python scalars, 0-D and shape-[1] tensors; a
+    [1] tensor must not broadcast the output to (num, 1)."""
+    r = raw(v)
+    if hasattr(r, "ndim") and getattr(r, "ndim", 0):
+        r = jnp.reshape(r, ())
+    return r
+
+
 def linspace(start, stop, num, dtype=None, name=None):
-    return Tensor(jnp.linspace(raw(start), raw(stop), int(raw(num)),
-                               dtype=_dt(dtype)))
+    if isinstance(dtype, str) and dtype not in _LINSPACE_DTYPES:
+        raise TypeError(f"linspace: dtype {dtype!r} not supported "
+                        f"(one of {sorted(_LINSPACE_DTYPES)})")
+    from .manipulation import _as_int
+    return Tensor(jnp.linspace(_scalar_arg(start), _scalar_arg(stop),
+                               _as_int(num), dtype=_dt(dtype)))
 
 
 def logspace(start, stop, num, base=10.0, dtype=None, name=None):
-    return Tensor(jnp.logspace(raw(start), raw(stop), int(raw(num)),
-                               base=base, dtype=_dt(dtype)))
+    from .manipulation import _as_int
+    return Tensor(jnp.logspace(_scalar_arg(start), _scalar_arg(stop),
+                               _as_int(num), base=base, dtype=_dt(dtype)))
 
 
 def eye(num_rows, num_columns=None, dtype=None, name=None):
@@ -195,3 +239,15 @@ def imag(x, name=None):
 
 def polar(abs, angle, name=None):
     return apply(lambda r, t: r * jnp.exp(1j * t), abs, angle)
+
+
+def _memcpy(input, place=None, output=None):
+    """Copy a tensor to a place (reference tensor/creation.py:1676).
+    PJRT owns placement on the single-controller mesh, so this is a
+    value copy; the place argument is accepted for API parity."""
+    src = raw(input)
+    out = Tensor(jnp.array(src))
+    if output is not None:
+        output._data = out._data
+        return output
+    return out

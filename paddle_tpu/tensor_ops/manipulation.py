@@ -85,7 +85,16 @@ def stack(x, axis=0, name=None):
 
 
 def split(x, num_or_sections, axis=0, name=None):
-    axis = int(raw(axis)) if isinstance(axis, Tensor) else axis
+    # axis: int, 0-D or shape-[1] Tensor; sections: int, or a list whose
+    # entries may be ints, -1 (inferred), or scalar Tensors — all
+    # reference-accepted spellings
+    axis = _as_int(axis) if isinstance(axis, Tensor) else axis
+    if isinstance(num_or_sections, Tensor):
+        num_or_sections = [int(v) for v in
+                           np.asarray(raw(num_or_sections)).reshape(-1)]
+    elif isinstance(num_or_sections, (list, tuple)):
+        num_or_sections = [_as_int(s) for s in num_or_sections]
+
     def f(a):
         dim = a.shape[axis]
         if isinstance(num_or_sections, int):
@@ -131,10 +140,18 @@ def squeeze(x, axis=None, name=None):
 
 
 def unsqueeze(x, axis, name=None):
+    # axis: int, scalar Tensor, list of ints/Tensors, or a 1-D Tensor of
+    # axes (all reference-accepted spellings)
+    if hasattr(axis, "_data") or isinstance(axis, np.ndarray):
+        axes_list = [int(v) for v in np.asarray(raw(axis)).reshape(-1)]
+    elif isinstance(axis, (list, tuple)):
+        axes_list = [_as_int(v) for v in axis]
+    else:
+        axes_list = [_as_int(axis)]
+
     def f(a):
-        axes = axis if isinstance(axis, (list, tuple)) else [axis]
         out = a
-        for ax in builtins_sorted(_as_int(v) for v in axes):
+        for ax in builtins_sorted(axes_list):
             out = jnp.expand_dims(out, ax)
         return out
     return apply(f, x)

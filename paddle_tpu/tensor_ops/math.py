@@ -169,11 +169,15 @@ nansum = reduction(jnp.nansum, dtype_slot="before_keepdim")
 
 
 def nanmean(x, axis=None, keepdim=False, name=None):
-    return apply(lambda a: jnp.nanmean(a, axis=axis, keepdims=keepdim), x)
+    from ._factory import reduce_axis
+    ax = reduce_axis(axis)  # list axis must be a (hashable) tuple
+    return apply(lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), x)
 
 
 def count_nonzero(x, axis=None, keepdim=False, name=None):
-    return nondiff(lambda a: jnp.count_nonzero(a, axis=axis, keepdims=keepdim), x)
+    from ._factory import reduce_axis
+    ax = reduce_axis(axis)  # list axis must be a (hashable) tuple
+    return nondiff(lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim), x)
 
 
 # -- cumulative ----------------------------------------------------------

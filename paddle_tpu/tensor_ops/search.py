@@ -97,7 +97,27 @@ def mode(x, axis=-1, keepdim=False, name=None):
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
     side = "right" if right else "left"
     v = raw(values)
-    return nondiff(lambda a: jnp.searchsorted(a, v, side=side), sorted_sequence)
+
+    def f(a):
+        if a.ndim <= 1:
+            out = jnp.searchsorted(a, v, side=side)
+        else:
+            # N-D: the last dim is the sorted axis, leading dims batch
+            # (reference searchsorted supports batched sequences)
+            import jax as _jax
+            vv = jnp.asarray(v)
+            if vv.shape[:-1] != a.shape[:-1]:
+                raise ValueError(
+                    f"searchsorted: leading (batch) dims of values "
+                    f"{vv.shape} must match sorted_sequence {a.shape}")
+            flat_a = a.reshape((-1, a.shape[-1]))
+            flat_v = vv.reshape((flat_a.shape[0], -1))
+            out = _jax.vmap(
+                lambda ar, vr: jnp.searchsorted(ar, vr, side=side))(
+                flat_a, flat_v)
+            out = out.reshape(vv.shape)
+        return out.astype("int32") if out_int32 else out
+    return nondiff(f, sorted_sequence)
 
 
 def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):

@@ -33,25 +33,49 @@ import pytest
 
 REF = "/root/reference/python/paddle"
 
-# measured pass floors (conservative: a few points under current rates)
+# measured pass floors (conservative: a few points under current rates).
+#
+# EXCLUSION MANIFEST — every file below 0.95 has its failing examples
+# itemized here (audited round 4); categories:
+#   [malformed]   the reference example itself doesn't parse/run
+#                 (upstream doc bug)
+#   [multi-rank]  paddle.distributed examples needing >1 real process
+#   [static-edge] 1.x static-Program idioms outside the record/replay
+#                 executor's contract (LoD feeds, fetch-by-name corner)
+#   [legacy-gap]  1.x fluid.layers names deliberately not carried
+#   [order-dep]   passes alone, fails under residual module state from a
+#                 prior example in the same file
+#
+# nn/functional/common.py  (14/16): [malformed] indented first line;
+#     [multi-rank] class_center_sample dist example
+# optimizer/lr.py          (15/16): [static-edge] ReduceOnPlateau
+#     static-mode fetch_list example
+# tensor/manipulation.py   (43/44): tensordot free-form axes spec
+#     (unequal-length axes lists) — unsupported corner
+# vision/transforms/...    (6/7):   [order-dep] ToTensor after the
+#     functional-module example
+# fluid/layers/nn.py       (~0.62): [legacy-gap] LoD/sequence ops and
+#     1.x-only layer names (itemized exclusion, tracked as a class)
+# fluid/layers/tensor.py   (23/26): [legacy-gap] create_parameter w/
+#     LayerHelper idioms; flip-on-list corner
 TARGETS = {
-    "tensor/math.py": 0.92,
-    "tensor/creation.py": 0.84,
-    "tensor/manipulation.py": 0.90,
+    "tensor/math.py": 0.95,
+    "tensor/creation.py": 0.95,
+    "tensor/manipulation.py": 0.95,
     "tensor/logic.py": 0.95,
-    "tensor/search.py": 0.90,
-    "tensor/stat.py": 0.85,
+    "tensor/search.py": 0.95,
+    "tensor/stat.py": 0.95,
     "nn/layer/common.py": 0.95,
     "nn/functional/activation.py": 0.95,
     "nn/layer/loss.py": 0.95,
-    "nn/functional/common.py": 0.80,
+    "nn/functional/common.py": 0.90,
     "tensor/linalg.py": 0.95,
-    "tensor/random.py": 0.90,
+    "tensor/random.py": 0.95,
     "tensor/attribute.py": 0.95,
     "nn/layer/conv.py": 0.95,
     "nn/layer/norm.py": 0.95,
-    "nn/layer/pooling.py": 0.90,
-    "nn/functional/loss.py": 0.92,
+    "nn/layer/pooling.py": 0.95,
+    "nn/functional/loss.py": 0.95,
     "nn/layer/rnn.py": 0.95,
     "nn/layer/transformer.py": 0.95,
     "nn/layer/activation.py": 0.95,
@@ -63,7 +87,7 @@ TARGETS = {
     "distribution/normal.py": 0.95,
     "distribution/categorical.py": 0.95,
     "metric/metrics.py": 0.95,
-    "vision/transforms/transforms.py": 0.80,
+    "vision/transforms/transforms.py": 0.85,
     "framework/random.py": 0.95,
     "nn/functional/conv.py": 0.95,
     "nn/functional/norm.py": 0.95,
@@ -76,6 +100,18 @@ TARGETS = {
     "distribution/beta.py": 0.95,
     "distribution/dirichlet.py": 0.95,
     "framework/io.py": 0.95,
+    # round-4 additions (VERDICT r3 task 8: fluid.layers, static,
+    # incubate breadth)
+    "incubate/nn/layer/fused_transformer.py": 0.95,
+    "tensor/ops.py": 0.95,
+    "tensor/to_string.py": 0.95,
+    "vision/models/resnet.py": 0.95,
+    "vision/ops.py": 0.90,
+    "nn/layer/vision.py": 0.95,
+    "nn/layer/distance.py": 0.95,
+    "nn/utils/weight_norm_hook.py": 0.95,
+    "fluid/layers/tensor.py": 0.85,
+    "fluid/layers/nn.py": 0.60,
 }
 
 
@@ -136,9 +172,41 @@ def _extract_examples(path):
     return out
 
 
+def _reset_global_modes():
+    """Examples flip process-global switches (enable_static,
+    ProgramTranslator().enable(False), default dtype); reset them so
+    pass rates don't depend on pytest-randomly's file order."""
+    import paddle_tpu
+
+    paddle_tpu.disable_static()
+    try:
+        from paddle_tpu.jit.api import StaticFunction
+
+        StaticFunction.global_enable = True
+    except Exception:
+        pass
+    try:
+        paddle_tpu.set_default_dtype("float32")
+    except Exception:
+        pass
+    try:
+        # the process-global default Program accumulates recorded ops
+        # from every static example; start each file from a fresh one
+        # (paddle.save of the default program must only see this file's)
+        from paddle_tpu.static import program as _prog_mod
+
+        _prog_mod._default_main = _prog_mod.Program()
+        _prog_mod._default_startup = _prog_mod.Program()
+        _prog_mod._current_main = None
+        _prog_mod._current_startup = None
+    except Exception:
+        pass
+
+
 @pytest.mark.parametrize("relpath,floor", sorted(TARGETS.items()))
 def test_reference_examples_pass_rate(relpath, floor):
     _alias_paddle()
+    _reset_global_modes()
     path = os.path.join(REF, relpath)
     if not os.path.exists(path):
         pytest.skip(f"reference file missing: {relpath}")
@@ -154,6 +222,10 @@ def test_reference_examples_pass_rate(relpath, floor):
             for code in _extract_examples(path):
                 if "import paddle" not in code or ">>>" in code:
                     continue
+                try:
+                    compile(code, "<example>", "exec")
+                except SyntaxError:
+                    continue  # [malformed]: not a runnable example
                 total += 1
                 # deterministic per example: outcomes must not depend on
                 # RNG state left behind by earlier tests/examples (numpy,
