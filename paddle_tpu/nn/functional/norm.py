@@ -17,10 +17,16 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.9, epsilon=1e-05,
-               data_format="NCHW", use_global_stats=None, name=None):
+               data_format="NCHW", use_global_stats=None, name=None,
+               axis_name=None):
     """Functional BN. In training mode, updates running stats in-place on the
     provided buffer Tensors (tracer-safe: train-step builders capture the
-    mutated values as outputs)."""
+    mutated values as outputs).
+
+    ``axis_name``: mapped axis to pmean the batch statistics over —
+    SyncBatchNorm's cross-replica reduction inside shard_map/vmap bodies
+    (under plain pjit the sharded batch axis already yields global
+    stats, no axis name needed)."""
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     use_batch = training and not use_global_stats
 
@@ -28,6 +34,17 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         if channel_last:
             return tuple(range(a.ndim - 1))
         return (0,) + tuple(range(2, a.ndim))
+
+    def batch_stats(a):
+        ax = stats_axes(a)
+        m = jnp.mean(a, axis=ax)
+        if axis_name is not None:
+            m = jax.lax.pmean(m, axis_name)
+            v = jax.lax.pmean(
+                jnp.mean(jnp.square(a), axis=ax), axis_name) - m * m
+        else:
+            v = jnp.var(a, axis=ax)
+        return m, v
 
     def ch_shape(a, c):
         s = [1] * a.ndim
@@ -40,19 +57,19 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         # recomputed inside f so backprop flows through them (XLA CSEs the
         # duplicate under jit)
         xa = raw(x)
-        ax = stats_axes(xa)
-        m_ = jnp.mean(xa, axis=ax)
-        v_ = jnp.var(xa, axis=ax)
+        m_, v_ = batch_stats(xa)
         n = xa.size // m_.size
-        unbiased = v_ * n / max(n - 1, 1)
+        if axis_name is not None:
+            n = n * jax.lax.psum(jnp.ones(()), axis_name)
+            unbiased = v_ * n / jnp.maximum(n - 1, 1)
+        else:
+            unbiased = v_ * n / max(n - 1, 1)
         running_mean._data = momentum * rm + (1 - momentum) * m_
         running_var._data = momentum * rv + (1 - momentum) * unbiased
 
     def f(a, mr, vr, *wb):
         if use_batch:
-            ax = stats_axes(a)
-            m = jnp.mean(a, axis=ax)
-            v = jnp.var(a, axis=ax)
+            m, v = batch_stats(a)
         else:
             # eval stats flow through apply so recorders/replay see the
             # buffers' CURRENT values, not record-time snapshots

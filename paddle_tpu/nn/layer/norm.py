@@ -57,23 +57,54 @@ class BatchNorm3D(_BatchNormBase):
 
 
 class SyncBatchNorm(_BatchNormBase):
-    """Cross-replica BN. On TPU, per-device batch stats are combined by XLA
-    when the batch axis is sharded under pjit (global-batch semantics of the
-    compiled mean/var); in eager single-process mode it equals BatchNorm.
-    Reference: nn/layer/norm.py:SyncBatchNorm (NCCL allreduce of stats)."""
+    """Cross-replica BN (reference nn/layer/norm.py:SyncBatchNorm — NCCL
+    allreduce of batch stats).
+
+    Synchronization model, by execution context:
+
+    * **pjit / compiled train step (the normal path)**: the batch axis
+      is sharded over the mesh, so the compiled mean/var ARE the
+      global-batch statistics — XLA inserts the cross-replica reduction;
+      nothing more is needed.
+    * **eager single process**: equals BatchNorm (one replica).
+    * **explicitly per-replica code (shard_map / vmap bodies, e.g. the
+      LocalSGD/DGC/geo steps in fleet/comm_efficient.py)**: pjit's
+      global-batch semantics do NOT apply; set ``axis_name`` to the
+      mapped mesh axis and the layer pmean-reduces mean/var over it.
+      Without ``axis_name`` stats stay replica-local there — the same
+      silent-local behavior the reference has outside a process group.
+    """
+
+    def __init__(self, *args, axis_name=None, **kw):
+        super().__init__(*args, **kw)
+        self._axis_name = axis_name
+
+    def forward(self, x):
+        # one BN implementation: F.batch_norm carries the cross-replica
+        # pmean (gradients flow through the synced stats, running_var
+        # stays unbiased, use_global_stats honored)
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, training=self.training,
+                            momentum=self._momentum, epsilon=self._epsilon,
+                            data_format=self._data_format,
+                            use_global_stats=self._use_global_stats,
+                            axis_name=self._axis_name)
 
     @classmethod
-    def convert_sync_batchnorm(cls, layer):
+    def convert_sync_batchnorm(cls, layer, axis_name=None):
         if isinstance(layer, _BatchNormBase) and not isinstance(layer, cls):
             new = cls(layer._num_features, layer._momentum, layer._epsilon,
-                      data_format=layer._data_format)
+                      data_format=layer._data_format,
+                      use_global_stats=layer._use_global_stats,
+                      axis_name=axis_name)
             new.weight = layer.weight
             new.bias = layer.bias
             new._mean = layer._mean
             new._variance = layer._variance
             return new
         for name, sub in list(layer._sub_layers.items()):
-            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(
+                sub, axis_name=axis_name)
         return layer
 
 

@@ -1,0 +1,8 @@
+"""Reference import-path spelling (python/paddle/profiler/
+profiler_statistic.py) for the statistic machinery in statistic.py."""
+from . import SortedKeys  # noqa: F401
+from .statistic import (ProfilerResult, build_summary,  # noqa: F401
+                        load_profiler_result)
+
+__all__ = ["SortedKeys", "ProfilerResult", "build_summary",
+           "load_profiler_result"]

@@ -1,0 +1,4 @@
+"""Reference import-path spelling (python/paddle/profiler/utils.py)."""
+from . import RecordEvent, RecordInstantEvent  # noqa: F401
+
+__all__ = ["RecordEvent", "RecordInstantEvent"]

@@ -224,7 +224,7 @@ def roll(x, shifts, axis=None, name=None):
 
 
 def gather(x, index, axis=0, name=None):
-    axis_v = int(raw(axis)) if isinstance(axis, Tensor) else axis
+    axis_v = _as_int(axis) if isinstance(axis, Tensor) else axis
     idx = raw(index)
     if idx.ndim > 1:
         idx = idx.reshape(-1)
