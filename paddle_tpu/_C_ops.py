@@ -12,7 +12,10 @@ conformance harness reports honestly as a failing case.
 from __future__ import annotations
 
 _SEARCH_MODULES = ("paddle_tpu", "paddle_tpu.tensor_ops",
-                   "paddle_tpu.nn.functional")
+                   "paddle_tpu.nn.functional",
+                   # internal ops that are _C_ops-only in the reference
+                   # (not public paddle.* names) live in extras
+                   "paddle_tpu.tensor_ops.extras")
 
 
 def __getattr__(name):

@@ -18,7 +18,7 @@ from . import checkpoint  # noqa: F401
 from .base import (enable_dygraph, disable_dygraph, enabled,  # noqa: F401
                    guard, no_grad, to_variable, grad)
 from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
-from .nn import (BatchNorm, BilinearTensorProduct, Conv2D,  # noqa: F401
+from .nn import (BatchNorm, BilinearTensorProduct, Conv2D, InstanceNorm,  # noqa: F401
                  Conv2DTranspose, Dropout, Embedding, GroupNorm, LayerNorm,
                  Linear, NCE, Pool2D, PRelu, SpectralNorm)
 from .learning_rate_scheduler import (CosineDecay,  # noqa: F401

@@ -282,3 +282,26 @@ class Dropout(Layer):
         training = self.training and not self._is_test
         return _F.dropout(input, p=self._p, training=training,
                           mode=self._mode)
+
+
+class InstanceNorm(Layer):
+    """1.x dygraph InstanceNorm(num_channels) — rank-agnostic instance
+    normalization (reference fluid/dygraph/nn.py:InstanceNorm accepts
+    2-D through 5-D inputs)."""
+
+    def __init__(self, num_channels, epsilon=1e-05, param_attr=None,
+                 bias_attr=None, dtype="float32"):
+        super().__init__()
+        from ...nn.initializer import Constant
+
+        self._epsilon = epsilon
+        # create_parameter returns None for attr=False
+        self.scale = self.create_parameter(
+            (num_channels,), attr=param_attr,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter(
+            (num_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, input):
+        return _F.instance_norm(input, weight=self.scale, bias=self.bias,
+                                eps=self._epsilon)

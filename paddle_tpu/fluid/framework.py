@@ -14,6 +14,11 @@ def _non_static_mode():
     return in_dygraph_mode()
 
 
+def grad_var_name(var_name):
+    """Reference framework.py:grad_var_name — the @GRAD suffix naming."""
+    return var_name + "@GRAD"
+
+
 in_dynamic_mode = in_dygraph_mode
 
 

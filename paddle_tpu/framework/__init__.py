@@ -12,9 +12,14 @@ from .random_seed import seed  # noqa: F401
 
 def _non_static_mode():
     """True in dygraph (reference paddle.framework._non_static_mode)."""
-    from ..fluid.dygraph.base import in_dygraph_mode
+    from ..fluid.dygraph.base import in_dygraph_mode as _idm
 
-    return in_dygraph_mode()
+    return _idm()
+
+
+def in_dygraph_mode():
+    """Reference paddle.framework.in_dygraph_mode."""
+    return _non_static_mode()
 
 
 in_dynamic_mode = _non_static_mode

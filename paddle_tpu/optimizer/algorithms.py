@@ -70,6 +70,8 @@ class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
                  multi_precision=True, name=None):
+        if momentum is None:
+            raise ValueError("momentum is not set")
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self._momentum = momentum
@@ -96,6 +98,12 @@ class Adam(Optimizer):
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=True,
                  name=None):
+        for nm, b in (("beta1", beta1), ("beta2", beta2)):
+            if isinstance(b, (int, float)) and not 0 <= b < 1:
+                raise ValueError(
+                    f"Invalid value of {nm}, expect {nm} in [0, 1).")
+        if isinstance(epsilon, (int, float)) and epsilon < 0:
+            raise ValueError("Invalid value of epsilon, expect epsilon >= 0.")
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self._beta1 = beta1
@@ -255,6 +263,10 @@ class RMSProp(Optimizer):
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
                  centered=False, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
+        for nm, v in (("rho", rho), ("epsilon", epsilon),
+                      ("momentum", momentum)):
+            if v is None:
+                raise ValueError(f"{nm} is not set.")
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._rho, self._epsilon = rho, epsilon
         self._momentum, self._centered = momentum, centered

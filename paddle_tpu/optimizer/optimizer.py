@@ -26,6 +26,8 @@ class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay=None, grad_clip=None, name=None,
                  multi_precision=True):
+        if learning_rate is None:
+            raise ValueError("learning_rate is not set")
         if parameters is not None:
             parameters = list(parameters)
             if any(isinstance(p, dict) for p in parameters):
@@ -72,6 +74,10 @@ class Optimizer:
         return float(self._learning_rate)
 
     def set_lr(self, value):
+        if not isinstance(value, (int, float)):
+            raise TypeError(
+                "set_lr expects a python float/int (reference raises for "
+                f"Variable learning rates), got {type(value).__name__}")
         if isinstance(self._learning_rate, LRScheduler):
             raise RuntimeError("cannot set_lr when using an LRScheduler")
         self._learning_rate = float(value)

@@ -176,6 +176,12 @@ def erfinv(x, name=None):
 # tensor/__init__.py): attach the extras ops as Tensor methods and add
 # the missing in-place variants -----------------------------------------
 
+def squared_l2_norm(x, name=None):
+    """sum(x*x) as a 1-element tensor (reference squared_l2_norm op,
+    the grad-clip building block; exposed via _C_ops)."""
+    return apply(lambda a: jnp.sum(jnp.square(a)).reshape((1,)), x)
+
+
 def _bind_extras():
     from ..framework.random_seed import next_key
     from ._bind import _make_inplace as _inplace_of

@@ -35,7 +35,7 @@ def _session():
         return {}
 
 
-def missing():
+def missing(headline_cutoff=None):
     s = _session()
     sec = s.get("secondary") or {}
     out = []
@@ -51,7 +51,14 @@ def missing():
             out.append(name)
         elif name == "flash_blocks" and "best" not in v:
             out.append(name)  # every block config FAILed — not a result
-    if not s.get("tokens_per_sec"):
+    # a headline carried over from a previous session is a REPLAY, not
+    # this round's measurement — recapture when it predates the cutoff.
+    # measured_utc gets re-stamped by replay-only runs, so prefer the
+    # headline's own stamp and treat an explicit replay marker as stale.
+    when = s.get("headline_measured_utc") or s.get("measured_utc") or ""
+    stale = headline_cutoff is not None and (
+        when < headline_cutoff or s.get("replayed_from_session"))
+    if not s.get("tokens_per_sec") or stale:
         out.insert(0, "headline")
     return out
 
@@ -72,11 +79,20 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-hours", type=float, default=8.0)
     ap.add_argument("--probe-interval", type=float, default=120.0)
+    ap.add_argument("--refresh-headline-before", default=None,
+                    help="ISO timestamp; a session headline older than "
+                         "this is re-measured (default: harvest start)")
     args = ap.parse_args()
+    cutoff = (args.refresh_headline_before
+              or time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+    # the comparison is lexicographic — an off-format timestamp would
+    # silently always/never match, so fail fast
+    import datetime
+    datetime.datetime.strptime(cutoff, "%Y-%m-%dT%H:%M:%SZ")
     deadline = time.time() + args.max_hours * 3600
 
     while time.time() < deadline:
-        todo = missing()
+        todo = missing(headline_cutoff=cutoff)
         if not todo:
             print("harvest complete: all configs have real measurements")
             return 0
@@ -98,7 +114,8 @@ def main():
                            env=env, cwd=ROOT, timeout=3900)
         except subprocess.TimeoutExpired:
             print("bench run exceeded 3900s; re-probing", flush=True)
-    print(f"harvest deadline reached; still missing: {missing()}")
+    print("harvest deadline reached; still missing: "
+          f"{missing(headline_cutoff=cutoff)}")
     return 1
 
 
