@@ -28,6 +28,11 @@ class Optimizer:
                  multi_precision=True):
         if learning_rate is None:
             raise ValueError("learning_rate is not set")
+        if isinstance(learning_rate, Tensor):
+            raise TypeError(
+                "learning_rate should be a float or an LRScheduler, got a "
+                "Tensor (the reference rejects Variable learning rates in "
+                "the 2.x optimizer API)")
         if parameters is not None:
             parameters = list(parameters)
             if any(isinstance(p, dict) for p in parameters):
@@ -217,29 +222,39 @@ class Optimizer:
         raise NotImplementedError
 
     # -- serialization -------------------------------------------------------
+    def _named_param_states(self):
+        """(state-dict key, param, accumulator-or-None) per parameter —
+        the single source of the key scheme used by state_dict /
+        set_state_dict / get_opti_var_name_list."""
+        if self._parameter_list is None:
+            return
+        for i, p in enumerate(self._all_params()):
+            yield p.name or f"param_{i}", p, self._accumulators.get(id(p))
+
     def state_dict(self):
         out = {"_lr": self._learning_rate if not isinstance(self._learning_rate, LRScheduler) else None}
         sched = self._lr_scheduler()
         if sched is not None:
             out["_lr_scheduler"] = sched.state_dict()
-        if self._parameter_list is not None:
-            for i, p in enumerate(self._all_params()):
-                st = self._accumulators.get(id(p))
-                if st:
-                    out[p.name or f"param_{i}"] = {k: Tensor(v) for k, v in st.items()}
+        for key, _p, st in self._named_param_states():
+            if st:
+                out[key] = {k: Tensor(v) for k, v in st.items()}
         return out
 
     def set_state_dict(self, state):
         sched = self._lr_scheduler()
         if sched is not None and "_lr_scheduler" in state:
             sched.set_state_dict(state["_lr_scheduler"])
-        if self._parameter_list is None:
-            return
-        for i, p in enumerate(self._all_params()):
-            key = p.name or f"param_{i}"
+        for key, p, _st in self._named_param_states():
             if key in state:
                 self._accumulators[id(p)] = {
                     k: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
                     for k, v in state[key].items()}
 
     load_state_dict = set_state_dict
+
+    def get_opti_var_name_list(self):
+        """Names of the optimizer's accumulator variables (reference
+        Optimizer.get_opti_var_name_list)."""
+        return [f"{key}_{k}" for key, _p, st in self._named_param_states()
+                for k in (st or {})]
