@@ -134,15 +134,33 @@ def set_flags(*a, **k):
 
 
 def in_dynamic_mode():
+    from .fluid.dygraph.base import in_dygraph_mode as _idm
     from .jit.api import in_to_static
-    return not in_to_static()
+    return _idm() and not in_to_static()
 
 
 def disable_static(place=None):
+    from .fluid.dygraph.base import enable_dygraph
+    from .static import program as _prog_mod
+    from .tensor import set_op_recorder
+
+    enable_dygraph()
+    if _prog_mod._current_main is None:  # keep an active program_guard
+        set_op_recorder(None)
     return None
 
 
 def enable_static(place=None):
+    """Reference enable_static: 1.x code then builds onto the DEFAULT
+    main program (fluid.data + ops + Executor.run(default_main_program)
+    without an explicit program_guard), so recording starts here."""
+    from .fluid.dygraph.base import disable_dygraph
+    from .static import program as _prog_mod
+    from .tensor import set_op_recorder
+
+    disable_dygraph()
+    if _prog_mod._current_main is None:
+        set_op_recorder(_prog_mod.default_main_program()._recorder)
     return None
 
 

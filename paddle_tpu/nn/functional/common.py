@@ -132,6 +132,32 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):
     mode = mode.lower()
+    if mode == "area":
+        # reference: area interpolation IS adaptive average pooling
+        from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d,
+                              adaptive_avg_pool3d)
+        nd = len(x.shape) - 2
+        channel_last = not data_format.startswith("NC")
+        if size is not None:
+            out_size = size
+        else:
+            spatial = (tuple(x.shape[1:-1]) if channel_last
+                       else tuple(x.shape[2:]))
+            sf = (scale_factor
+                  if isinstance(scale_factor, (list, tuple))
+                  else [scale_factor] * nd)
+            out_size = [int(d * s) for d, s in zip(spatial, sf)]
+        pool = {1: adaptive_avg_pool1d, 2: adaptive_avg_pool2d,
+                3: adaptive_avg_pool3d}[nd]
+        if nd == 1:
+            if channel_last:  # pool1d is NCW-only
+                from ...tensor import apply as _apply
+                t = _apply(lambda a: jnp.moveaxis(a, -1, 1), x)
+                out = pool(t, out_size)
+                return _apply(lambda a: jnp.moveaxis(a, 1, -1), out)
+            return pool(x, out_size)
+        return pool(x, out_size, data_format=data_format)
+
     def f(a):
         nchw = data_format.startswith("NC")
         spatial = a.shape[2:] if nchw else a.shape[1:-1]
@@ -147,7 +173,7 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
             tgt_shape = (a.shape[0],) + out_size + (a.shape[-1],)
         method = {"nearest": "nearest", "bilinear": "bilinear",
                   "trilinear": "trilinear", "bicubic": "bicubic",
-                  "linear": "linear", "area": "linear"}[mode]
+                  "linear": "linear"}[mode]  # "area" returned above
         if align_corners and method in ("linear", "bilinear", "trilinear"):
             # jax.image.resize implements half-pixel (align_corners=False)
             # sampling only; align_corners uses scale (in-1)/(out-1) —

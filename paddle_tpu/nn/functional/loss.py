@@ -103,8 +103,12 @@ def l1_loss(input, label, reduction="mean", name=None):
 
 def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
     def f(a, b):
+        # reference huber_loss kernel: 0.5 d^2 inside delta,
+        # delta*(|d| - 0.5 delta) outside — NOT the delta-normalized
+        # variant (they only coincide at delta=1)
         d = jnp.abs(a - b)
-        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        loss = jnp.where(d <= delta, 0.5 * d * d,
+                         delta * (d - 0.5 * delta))
         return _reduce(loss, reduction)
     return apply(f, input, label)
 
@@ -143,9 +147,14 @@ def binary_cross_entropy_with_logits(logit, label, weight=None,
 
 def kl_div(input, label, reduction="mean", name=None):
     def f(logp, t):
-        loss = t * (jnp.log(jnp.clip(t, 1e-12, None)) - logp)
+        # reference kldiv_loss kernel: contributions are ZERO where the
+        # target is non-positive (xlogy semantics), not log(clip(t))
+        loss = jnp.where(t > 0,
+                         t * (jnp.log(jnp.clip(t, 1e-12, None)) - logp),
+                         jnp.zeros_like(logp))
         if reduction == "batchmean":
-            return jnp.sum(loss) / logp.shape[0]
+            return (jnp.sum(loss) / logp.shape[0] if logp.ndim
+                    else jnp.sum(loss))
         return _reduce(loss, reduction)
     return apply(f, input, label)
 

@@ -105,14 +105,28 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                     ceil_mode, exclusive, data_format)
 
 
+def _adaptive_sizes(output_size, n, spatial):
+    """Adaptive output_size: int, sequence, or sequence with None
+    entries meaning 'keep that input dim' (reference
+    adaptive_*_poolNd contract)."""
+    if output_size is None:
+        return tuple(int(s) for s in spatial)
+    if isinstance(output_size, (list, tuple)):
+        vs = (list(output_size) if len(output_size) == n
+              else [output_size[0]] * n)
+        return tuple(int(spatial[d]) if vs[d] is None else int(vs[d])
+                     for d in range(n))
+    return tuple(int(output_size) for _ in range(n))
+
+
 def _adaptive_pool(x, n, output_size, kind, data_format="NCHW"):
     channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
-    os_ = _norm_tuple(output_size, n)
 
     def f(a):
         if channel_last:
             a = jnp.moveaxis(a, -1, 1)
         spatial = a.shape[2:]
+        os_ = _adaptive_sizes(output_size, n, spatial)
         out = a
         # adaptive pooling: split each spatial dim into output_size bins
         for d in range(n):
@@ -163,10 +177,9 @@ def _adaptive_max_pool_with_mask(x, n, output_size):
     bins assemble per-cell regions at trace time (output sizes small)."""
     import itertools
 
-    os_ = _norm_tuple(output_size, n)
-
     def f(a):
         spatial = a.shape[2:]
+        os_ = _adaptive_sizes(output_size, n, spatial)
         if all(spatial[d] % os_[d] == 0 for d in range(n)):
             ks = tuple(spatial[d] // os_[d] for d in range(n))
             # reshape each spatial dim into (out, k), move the k axes to

@@ -118,6 +118,16 @@ TARGETS = {
     "test_cosine_similarity_api.py": (0.95, 4),
     "test_pairwise_distance.py": (0.60, 2),
     "test_nn_sigmoid_op.py": (0.45, 1),
+    "test_reduce_op.py": (0.50, 10),
+    "test_pool2d_op.py": (0.75, 22),
+    "test_adaptive_avg_pool2d.py": (0.95, 4),
+    "test_adaptive_max_pool2d.py": (0.75, 4),
+    "test_nll_loss.py": (0.85, 25),
+    "test_bce_loss.py": (0.60, 2),
+    "test_smooth_l1_loss.py": (0.95, 4),
+    "test_kldiv_loss_op.py": (0.70, 10),
+    "test_pad3d_op.py": (0.45, 4),
+    "test_lookup_table_v2_op.py": (0.15, 2),
     # dy2static conformance (VERDICT r3 task 4): the reference's own
     # dygraph_to_static unittests running against jit/dy2static.py.
     # The misses are cases asserting the REFERENCE's limitations
