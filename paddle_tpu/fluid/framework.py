@@ -11,7 +11,9 @@ from .dygraph.base import in_dygraph_mode  # noqa: F401
 
 
 def _non_static_mode():
-    return in_dygraph_mode()
+    from ..framework import _non_static_mode as _nsm
+
+    return _nsm()  # single definition: dygraph AND not to_static-tracing
 
 
 def grad_var_name(var_name):
@@ -19,7 +21,8 @@ def grad_var_name(var_name):
     return var_name + "@GRAD"
 
 
-in_dynamic_mode = in_dygraph_mode
+def in_dynamic_mode():
+    return _non_static_mode()
 
 
 class Block:

@@ -11,10 +11,12 @@ from .random_seed import seed  # noqa: F401
 
 
 def _non_static_mode():
-    """True in dygraph (reference paddle.framework._non_static_mode)."""
+    """True in dygraph (reference paddle.framework._non_static_mode) —
+    False both under enable_static and while to_static traces."""
     from ..fluid.dygraph.base import in_dygraph_mode as _idm
+    from ..jit.api import in_to_static
 
-    return _idm()
+    return _idm() and not in_to_static()
 
 
 def in_dygraph_mode():

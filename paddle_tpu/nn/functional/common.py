@@ -134,29 +134,22 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
     mode = mode.lower()
     if mode == "area":
         # reference: area interpolation IS adaptive average pooling
-        from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d,
-                              adaptive_avg_pool3d)
+        from .pooling import _adaptive_pool
         nd = len(x.shape) - 2
-        channel_last = not data_format.startswith("NC")
         if size is not None:
             out_size = size
         else:
-            spatial = (tuple(x.shape[1:-1]) if channel_last
-                       else tuple(x.shape[2:]))
             sf = (scale_factor
                   if isinstance(scale_factor, (list, tuple))
                   else [scale_factor] * nd)
-            out_size = [int(d * s) for d, s in zip(spatial, sf)]
-        pool = {1: adaptive_avg_pool1d, 2: adaptive_avg_pool2d,
-                3: adaptive_avg_pool3d}[nd]
-        if nd == 1:
-            if channel_last:  # pool1d is NCW-only
-                from ...tensor import apply as _apply
-                t = _apply(lambda a: jnp.moveaxis(a, -1, 1), x)
-                out = pool(t, out_size)
-                return _apply(lambda a: jnp.moveaxis(a, 1, -1), out)
-            return pool(x, out_size)
-        return pool(x, out_size, data_format=data_format)
+            # callable: resolved against the TRACED spatial dims inside
+            # the pool, so static replay sees fed shapes, not the
+            # record-time placeholder's
+            out_size = lambda spatial: [  # noqa: E731
+                int(d * s) for d, s in zip(spatial, sf)]
+        if nd == 1 and not data_format.startswith("NC"):
+            data_format = "NWC"  # _adaptive_pool's channel-last 1-D
+        return _adaptive_pool(x, nd, out_size, "avg", data_format)
 
     def f(a):
         nchw = data_format.startswith("NC")

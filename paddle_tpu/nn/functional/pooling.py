@@ -106,9 +106,12 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 
 def _adaptive_sizes(output_size, n, spatial):
-    """Adaptive output_size: int, sequence, or sequence with None
-    entries meaning 'keep that input dim' (reference
-    adaptive_*_poolNd contract)."""
+    """Adaptive output_size: int, sequence, sequence with None entries
+    meaning 'keep that input dim' (reference adaptive_*_poolNd
+    contract), or a callable(spatial) -> sizes — resolved HERE, inside
+    the traced function, so static record/replay sees fresh shapes."""
+    if callable(output_size):
+        return tuple(int(v) for v in output_size(spatial))
     if output_size is None:
         return tuple(int(s) for s in spatial)
     if isinstance(output_size, (list, tuple)):

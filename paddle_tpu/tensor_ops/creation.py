@@ -146,9 +146,13 @@ def eye(num_rows, num_columns=None, dtype=None, name=None):
 
 
 def meshgrid(*args, **kwargs):
-    arrs = [raw(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
-    outs = jnp.meshgrid(*arrs, indexing="ij")
-    return [Tensor(o) for o in outs]
+    ins = (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple))
+           else args)
+    # through apply() so static programs record/replay it and gradients
+    # flow (the reference meshgrid is differentiable)
+    out = apply(lambda *as_: tuple(jnp.meshgrid(*as_, indexing="ij")),
+                *ins)
+    return list(out) if isinstance(out, tuple) else [out]
 
 
 def diag(x, offset=0, padding_value=0, name=None):

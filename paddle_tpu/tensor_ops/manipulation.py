@@ -316,8 +316,12 @@ def scatter_nd(index, updates, shape, name=None):
 
 
 def masked_select(x, mask, name=None):
+    # eager-only (data-dependent output shape), but DIFFERENTIABLE: the
+    # mask is concrete here, so the gather has a well-defined vjp
+    # (scatter back to the selected positions) — the reference's
+    # masked_select_grad kernel
     m = np.asarray(raw(mask))
-    return nondiff(lambda a: a[m], x)
+    return apply(lambda a: a[m], x)
 
 
 def masked_fill(x, mask, value, name=None):

@@ -128,6 +128,24 @@ TARGETS = {
     "test_kldiv_loss_op.py": (0.70, 10),
     "test_pad3d_op.py": (0.45, 4),
     "test_lookup_table_v2_op.py": (0.15, 2),
+    "test_transpose_op.py": (0.60, 6),
+    "test_reshape_op.py": (0.55, 10),
+    "test_slice_op.py": (0.40, 4),
+    "test_scatter_op.py": (0.80, 11),
+    "test_index_sample_op.py": (0.95, 11),
+    "test_one_hot_v2_op.py": (0.35, 2),
+    "test_label_smooth_op.py": (0.95, 7),
+    "test_meshgrid_op.py": (0.60, 6),
+    "test_histogram_op.py": (0.50, 3),
+    "test_masked_select_op.py": (0.70, 6),
+    "test_top_k_v2_op.py": (0.80, 9),
+    "test_scale_op.py": (0.55, 6),
+    "test_cast_op.py": (0.45, 1),
+    "test_lerp_op.py": (0.90, 16),
+    "test_erf_op.py": (0.45, 1),
+    "test_elementwise_max_op.py": (0.95, 15),
+    "test_elementwise_mod_op.py": (0.45, 1),
+    "test_elementwise_pow_op.py": (0.85, 13),
     # dy2static conformance (VERDICT r3 task 4): the reference's own
     # dygraph_to_static unittests running against jit/dy2static.py.
     # The misses are cases asserting the REFERENCE's limitations
