@@ -146,6 +146,16 @@ TARGETS = {
     "test_elementwise_max_op.py": (0.95, 15),
     "test_elementwise_mod_op.py": (0.45, 1),
     "test_elementwise_pow_op.py": (0.85, 13),
+    "test_gather_nd_op.py": (0.70, 14),
+    "test_scatter_nd_op.py": (0.65, 12),
+    "test_tril_indices_op.py": (0.75, 4),
+    "test_frac_api.py": (0.90, 16),
+    "test_clip_by_norm_op.py": (0.85, 7),
+    "test_unique.py": (0.55, 4),
+    "test_multinomial_op.py": (0.55, 7),
+    "test_take_along_axis_op.py": (0.45, 2),
+    "test_prelu_op.py": (0.50, 4),
+    "test_gelu_op.py": (0.95, 3),
     # dy2static conformance (VERDICT r3 task 4): the reference's own
     # dygraph_to_static unittests running against jit/dy2static.py.
     # The misses are cases asserting the REFERENCE's limitations
