@@ -36,6 +36,20 @@ class Program:
         self._vars = {}         # name -> Tensor (parameters/globals/fetch)
         self.random_seed = None
 
+    def __getstate__(self):
+        """paddle.save(program) serializes the reference's ProgramDesc —
+        structure + persistable values, NOT executable kernels. The
+        recorded op thunks here are python closures (unpicklable by
+        nature), so serialization keeps vars/feeds and drops the op
+        list; a re-loaded Program supports state_dict/var access but
+        must be rebuilt to replay (the reference likewise re-runs the
+        python that built the program, load only restores the desc)."""
+        d = dict(self.__dict__)
+        d["_ops"] = []
+        # normalize_program's fetch Tensors carry autograd-node closures
+        d.pop("_normalized", None)
+        return d
+
     # -- recording ---------------------------------------------------------
     def _recorder(self, fn, args, kwargs, outs):
         outs_t = outs if isinstance(outs, tuple) else (outs,)
