@@ -36,6 +36,16 @@ class Block:
 import contextlib
 
 
+def _in_legacy_dygraph():
+    """Reference eager/legacy VM probe — eager is the only dygraph
+    mode here."""
+    return False
+
+
+def _in_eager_without_dygraph_check():
+    return in_dygraph_mode()
+
+
 def _enable_legacy_dygraph():
     """Reference switch to the pre-eager dygraph VM — eager is the only
     dygraph mode here; kept for unittest-conformance imports."""

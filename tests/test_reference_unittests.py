@@ -156,6 +156,8 @@ TARGETS = {
     "test_take_along_axis_op.py": (0.45, 2),
     "test_prelu_op.py": (0.50, 4),
     "test_gelu_op.py": (0.95, 3),
+    "test_matmul_v2_op.py": (0.95, 5),
+    "test_norm_all.py": (0.55, 4),
     # dy2static conformance (VERDICT r3 task 4): the reference's own
     # dygraph_to_static unittests running against jit/dy2static.py.
     # The misses are cases asserting the REFERENCE's limitations
@@ -220,7 +222,13 @@ def _ensure_paths():
     sys.modules.setdefault("op_test", shim)
     import types
     for pkg in ("paddle.fluid.tests", "paddle.fluid.tests.unittests"):
-        sys.modules.setdefault(pkg, types.ModuleType(pkg))
+        if pkg not in sys.modules:
+            mod = types.ModuleType(pkg)
+            # a real __path__ makes it a package, so sibling helpers
+            # (testsuite.py, ...) import from the reference tree; our
+            # op_test preload below still wins over the reference's
+            mod.__path__ = [UT]
+            sys.modules[pkg] = mod
     sys.modules.setdefault("paddle.fluid.tests.unittests.op_test", shim)
     sys.modules["paddle.fluid.tests"].unittests = \
         sys.modules["paddle.fluid.tests.unittests"]
