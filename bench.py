@@ -752,10 +752,13 @@ def main():
     if "error" in headline:
         _fallback_exit(f"headline bench failed: {headline['error']}")
 
+    # per-config deadline: 420s default proved too short for first-compile
+    # of BERT/ViT/MoE over a slow tunnel; the harvest loop raises it
+    per_cap = float(os.environ.get("PADDLE_TPU_BENCH_PER_CONFIG_S", "420"))
     if only and "kernels" not in only:
         kernels = {"skipped": "not in PADDLE_TPU_BENCH_ONLY"}
     else:
-        kernels = _run_guarded(bench_kernels, backend, left(420.0))
+        kernels = _run_guarded(bench_kernels, backend, left(per_cap))
     secondary = {}
     t_start = time.perf_counter()
     budget = min(
@@ -784,7 +787,7 @@ def main():
                 secondary[name] = {"skipped": "bench time budget exhausted"}
                 continue
             secondary[name] = _run_guarded(fn, backend,
-                                           min(remaining, 420.0))
+                                           min(remaining, per_cap))
             _record_session(headline, backend, secondary, kernels)
 
     _record_session(headline, backend, secondary, kernels)

@@ -264,6 +264,7 @@ class Engine:
         # this measurement — detach, measure, reinstall
         for h in self._act_handles:
             h.remove()
+        self._act_handles = []
         handles = self._install_constraints(activation_specs or {})
         try:
             from jax.sharding import NamedSharding
@@ -300,7 +301,11 @@ class Engine:
         finally:
             for h in handles:
                 h.remove()
-            if self._act_handles:
+            # reinstall whatever plan_activations() chose — keyed on the
+            # chosen specs, not on the (now cleared) old handle list, so
+            # a _cost() between plan_activations() and prepare() still
+            # measures under the chosen constraints
+            if self.activation_specs:
                 self._act_handles = self._install_constraints(
                     self.activation_specs)
 

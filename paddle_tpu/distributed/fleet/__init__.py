@@ -79,7 +79,9 @@ class _FleetOptimizer:
     plus make_train_step for the compiled hybrid-parallel path."""
 
     def __init__(self, optimizer, strategy):
-        self._inner = optimizer
+        from .meta_optimizers import apply_strategy_optimizers
+
+        self._inner = apply_strategy_optimizers(optimizer, strategy)
         self._strategy = strategy
 
     def __getattr__(self, name):
@@ -132,9 +134,40 @@ class _FleetOptimizer:
                 model, self._inner, loss_fn, strategy=s,
                 dtype=cfg.get("dtype", "bfloat16"))
         amp_level = kw.pop("amp_level", None) or ("O1" if s.amp else None)
-        return make_train_step(model, self._inner, loss_fn,
+        step = make_train_step(model, self._inner, loss_fn,
                                strategy=s, amp_level=amp_level,
                                **kw)
+        if getattr(s, "asp", False):
+            step = _ASPMaskedStep(step)
+        return step
+
+
+class _ASPMaskedStep:
+    """strategy.asp on the compiled path (reference asp_optimizer.py:1):
+    after every compiled update, re-apply the recorded n:m masks to the
+    updated parameters and push the masked values back into the step's
+    donated buffers, so the sparsity pattern survives optimizer steps."""
+
+    def __init__(self, step):
+        self._step = step
+
+    def __getattr__(self, name):
+        return getattr(self._step, name)
+
+    def __call__(self, *args, **kwargs):
+        out = self._step(*args, **kwargs)
+        from ...static.sparsity import _reapply_masks
+
+        params = getattr(self._step, "_params", None)
+        # scope to THIS step's parameters — another pruned model in the
+        # process may be dense-finetuning (same scoping as asp.decorate)
+        own = {id(p) for p in (params or {}).values()}
+        _reapply_masks(own or None)
+        vals = getattr(self._step, "_param_vals", None)
+        if vals is not None and params is not None:
+            for k, p in params.items():
+                vals[k] = p._data
+        return out
 
 
 def distributed_optimizer(optimizer, strategy=None):

@@ -36,6 +36,17 @@ class DistributedStrategy:
         self.pipeline = False
         self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
         self.lamb = False
+        self.lamb_configs = {"lamb_weight_decay": 0.01,
+                             "exclude_from_weight_decay": []}
+        # layerwise trust-ratio SGD (reference distributed_strategy.py
+        # lars property → meta_optimizers/lars_optimizer.py)
+        self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001,
+                             "lars_weight_decay": 0.0005, "epsilon": 0.0,
+                             "exclude_from_weight_decay": []}
+        # n:m structured sparsity pass (reference asp property →
+        # meta_optimizers/asp_optimizer.py; masks from static.sparsity)
+        self.asp = False
         self.dgc = False
         self.dgc_configs = {"momentum": None, "sparsity": 0.99}
         self.localsgd = False

@@ -137,6 +137,63 @@ def _carried_hyperparams(inner, names):
     return out
 
 
+def _swap_to_lamb(inner, cfg=None):
+    """Build a Lamb from ``inner``'s carried hyperparams; ``cfg``
+    (strategy.lamb_configs) overrides weight decay / exclusions. Single
+    source of truth for LambOptimizer and apply_strategy_optimizers."""
+    from ...optimizer import Lamb
+
+    base = getattr(inner, "inner_opt", inner)
+    params = getattr(inner, "_parameter_list", None)
+    if isinstance(base, Lamb) or params is None:
+        return inner  # already swapped (possibly inside a wrapper)
+    kw = _carried_hyperparams(inner, {
+        "learning_rate": ("_learning_rate",),
+        "beta1": ("_beta1",), "beta2": ("_beta2",),
+        "epsilon": ("_epsilon",),
+        "lamb_weight_decay": ("_wd_coeff", "_lamb_wd", "_weight_decay"),
+        "grad_clip": ("_grad_clip",),
+    })
+    kw.setdefault("learning_rate", 1e-3)
+    if cfg:
+        if "lamb_weight_decay" in cfg:
+            kw["lamb_weight_decay"] = float(cfg["lamb_weight_decay"])
+        exclude = list(cfg.get("exclude_from_weight_decay") or [])
+        if exclude:
+            kw["exclude_from_weight_decay_fn"] = lambda p: any(
+                tag in (getattr(p, "name", "") or "") for tag in exclude)
+    return Lamb(parameters=params, **kw)
+
+
+def _swap_to_lars(inner, cfg=None):
+    """Build a LarsMomentum from ``inner``'s carried hyperparams; ``cfg``
+    (strategy.lars_configs) overrides the LARS coefficients."""
+    from ...optimizer import LarsMomentum
+
+    base = getattr(inner, "inner_opt", inner)
+    params = getattr(inner, "_parameter_list", None)
+    if isinstance(base, LarsMomentum) or params is None:
+        return inner
+    kw = _carried_hyperparams(inner, {
+        "learning_rate": ("_learning_rate",),
+        "momentum": ("_momentum",),
+        "lars_weight_decay": ("_lars_wd", "_weight_decay"),
+        "grad_clip": ("_grad_clip",),
+    })
+    kw.setdefault("learning_rate", 1e-3)
+    kw.setdefault("momentum", 0.9)
+    if cfg:
+        for name, key in (("lars_coeff", "lars_coeff"),
+                          ("lars_weight_decay", "lars_weight_decay"),
+                          ("epsilon", "epsilon")):
+            if key in cfg:
+                kw[name] = float(cfg[key])
+        exclude = list(cfg.get("exclude_from_weight_decay") or [])
+        if exclude:
+            kw["exclude_from_weight_decay"] = exclude
+    return LarsMomentum(parameters=params, **kw)
+
+
 class LambOptimizer(MetaOptimizerBase):
     """Layerwise adaptive large-batch optimizer (lamb_optimizer.py):
     swaps the inner optimizer for Lamb, carrying lr / betas / epsilon /
@@ -144,21 +201,7 @@ class LambOptimizer(MetaOptimizerBase):
 
     def _apply(self, strategy):
         strategy.lamb = True
-        from ...optimizer import Lamb
-
-        inner = self._inner
-        params = getattr(inner, "_parameter_list", None)
-        if params is not None:
-            kw = _carried_hyperparams(inner, {
-                "learning_rate": ("_learning_rate",),
-                "beta1": ("_beta1",), "beta2": ("_beta2",),
-                "epsilon": ("_epsilon",),
-                "lamb_weight_decay": ("_wd_coeff", "_lamb_wd",
-                                      "_weight_decay"),
-                "grad_clip": ("_grad_clip",),
-            })
-            kw.setdefault("learning_rate", 1e-3)
-            self._inner = Lamb(parameters=params, **kw)
+        self._inner = _swap_to_lamb(self._inner)
 
 
 class LarsOptimizer(MetaOptimizerBase):
@@ -167,20 +210,8 @@ class LarsOptimizer(MetaOptimizerBase):
     grad clip where the inner optimizer defines them."""
 
     def _apply(self, strategy):
-        from ...optimizer import LarsMomentum
-
-        inner = self._inner
-        params = getattr(inner, "_parameter_list", None)
-        if params is not None:
-            kw = _carried_hyperparams(inner, {
-                "learning_rate": ("_learning_rate",),
-                "momentum": ("_momentum",),
-                "lars_weight_decay": ("_lars_wd", "_weight_decay"),
-                "grad_clip": ("_grad_clip",),
-            })
-            kw.setdefault("learning_rate", 1e-3)
-            kw.setdefault("momentum", 0.9)
-            self._inner = LarsMomentum(parameters=params, **kw)
+        strategy.lars = True
+        self._inner = _swap_to_lars(self._inner)
 
 
 class ASPOptimizer(MetaOptimizerBase):
@@ -191,6 +222,28 @@ class ASPOptimizer(MetaOptimizerBase):
         from ...incubate import asp
 
         self._inner = asp.decorate(self._inner)
+
+
+def apply_strategy_optimizers(optimizer, strategy):
+    """Strategy-flag optimizer selection (reference
+    meta_optimizer_factory.py + lars_optimizer.py:1 / lamb_optimizer.py:1
+    / asp_optimizer.py:1): ``strategy.lars``/``strategy.lamb`` swap the
+    inner optimizer, ``strategy.asp`` decorates it with the n:m mask
+    re-apply pass. Called by fleet.distributed_optimizer. Already-swapped
+    optimizers (including ones inside MetaOptimizerBase wrappers) are
+    left untouched."""
+    inner = optimizer
+    if getattr(strategy, "lars", False):
+        inner = _swap_to_lars(inner, getattr(strategy, "lars_configs",
+                                             None))
+    elif getattr(strategy, "lamb", False):
+        inner = _swap_to_lamb(inner, getattr(strategy, "lamb_configs",
+                                             None))
+    if getattr(strategy, "asp", False):
+        from ...static import sparsity
+
+        inner = sparsity.decorate(inner)
+    return inner
 
 
 class HybridParallelOptimizer(MetaOptimizerBase):

@@ -1,5 +1,6 @@
 """paddle.distributed.utils (reference: distributed/utils/__init__.py —
-host/endpoint helpers used by launch scripts)."""
+host/endpoint helpers used by launch scripts; distributed/utils.py:57,180
+global_scatter/global_gather, the MoE token-dispatch collectives)."""
 from __future__ import annotations
 
 import os
@@ -39,3 +40,183 @@ def add_arguments(argname, dtype, default, help, argparser, **kwargs):
     """Reference utils.add_arguments (fluid style argparse helper)."""
     argparser.add_argument("--" + argname, default=default, type=dtype,
                            help=help, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# global_scatter / global_gather — MoE token dispatch collectives
+# (reference: python/paddle/distributed/utils.py:57,180, backed by the
+# global_scatter/global_gather NCCL kernels).
+#
+# Layout contract (identical to the reference):
+# * counts index i enumerates (card, expert) pairs card-major:
+#   card = i // n_expert, expert = i % n_expert.
+# * global_scatter input rows are grouped in local_count order (card-major);
+#   its output rows are grouped expert-major: for each local expert e, the
+#   rows from card 0..W-1 in order, global_count[r*E + e] rows each.
+# * global_gather is the inverse permutation (expert-major in, card-major
+#   local_count order out) — global_gather(global_scatter(x)) returns the
+#   tokens to their senders in original order.
+#
+# TPU-native design: the reference kernel does variable-length NCCL
+# send/recv; XLA requires static shapes, so the SPMD path pads each
+# (card, expert) bucket to a static ``capacity`` (default: the local row
+# count, a safe upper bound) and moves everything in ONE lax.all_to_all
+# over the group's mesh axis. Rows past the valid counts are zero; the
+# first sum(counts) output rows match the reference exactly. Eager
+# single-controller (world_size 1) keeps exact dynamic shapes. This API
+# exists for parity/migration — the perf MoE dispatch is the sort-based
+# path in ``paddle_tpu/nn/moe.py`` (no padded [E,C] buckets at all).
+# ---------------------------------------------------------------------------
+
+_X_DTYPES = ("float16", "bfloat16", "float32", "float64", "int32", "int64")
+
+
+def _check_dispatch_args(x, local_count, global_count, name):
+    for t, nm, ok in ((x, "x", _X_DTYPES),
+                      (local_count, "local_count", ("int32", "int64")),
+                      (global_count, "global_count", ("int32", "int64"))):
+        dt = str(getattr(t, "dtype", ""))
+        dt = dt.replace("paddle.", "").replace("jax.numpy.", "")
+        if dt not in ok:  # exact match: 'uint32' must not pass as 'int32'
+            raise TypeError(
+                f"The data type of '{nm}' in {name} must be one of {ok}, "
+                f"but received {dt}.")
+
+
+def _axis_size(ax):
+    import jax
+
+    return int(jax.lax.psum(1, ax))  # constant-folds to the axis size
+
+
+def _bucket_rows(xd, counts, capacity):
+    """Gather each count-delimited bucket of ``xd`` into a padded
+    [n_buckets, capacity, ...] array (invalid slots zero)."""
+    import jax.numpy as jnp
+
+    counts = counts.astype(jnp.int32)
+    off = jnp.cumsum(counts) - counts
+    slot = jnp.arange(capacity, dtype=jnp.int32)
+    idx = off[:, None] + slot[None, :]
+    valid = slot[None, :] < counts[:, None]
+    rows = jnp.take(xd, jnp.clip(idx, 0, xd.shape[0] - 1).reshape(-1),
+                    axis=0).reshape((counts.shape[0], capacity)
+                                    + xd.shape[1:])
+    pad = (slice(None),) * 2 + (None,) * (xd.ndim - 1)
+    return jnp.where(valid[pad], rows, 0), valid
+
+
+def _compact_buckets(buckets, counts, capacity):
+    """Inverse of _bucket_rows: pack padded buckets contiguously in
+    ``counts`` order. Output is static-shape [n*capacity, ...]; rows past
+    sum(counts) are zero."""
+    import jax.numpy as jnp
+
+    counts = counts.astype(jnp.int32)
+    n = counts.shape[0]
+    out_rows = n * capacity
+    off = jnp.cumsum(counts) - counts
+    slot = jnp.arange(capacity, dtype=jnp.int32)
+    dest = off[:, None] + slot[None, :]
+    dest = jnp.where(slot[None, :] < counts[:, None], dest, out_rows)
+    out = jnp.zeros((out_rows + 1,) + buckets.shape[2:], buckets.dtype)
+    out = out.at[dest.reshape(-1)].set(
+        buckets.reshape((-1,) + buckets.shape[2:]))
+    return out[:out_rows]
+
+
+def _global_scatter_raw(xd, lc, gc, ax, capacity):
+    """Per-device SPMD body (call under shard_map over axis ``ax``)."""
+    import jax
+    import jax.numpy as jnp
+
+    world = _axis_size(ax)
+    n_expert = lc.shape[0] // world
+    send, _ = _bucket_rows(xd, lc, capacity)          # [W*E, C, ...]
+    send = send.reshape((world, n_expert, capacity) + xd.shape[1:])
+    recv = jax.lax.all_to_all(send, ax, split_axis=0, concat_axis=0,
+                              tiled=False)            # recv[r, e]
+    # output order is expert-major: bucket (e, r) holds gc[r*E+e] rows
+    gc_em = gc.astype(jnp.int32).reshape(world, n_expert).T.reshape(-1)
+    buckets = jnp.swapaxes(recv, 0, 1).reshape(
+        (n_expert * world, capacity) + xd.shape[1:])
+    return _compact_buckets(buckets, gc_em, capacity)
+
+
+def _global_gather_raw(xd, lc, gc, ax, capacity):
+    """Per-device SPMD body: inverse of _global_scatter_raw."""
+    import jax
+    import jax.numpy as jnp
+
+    world = _axis_size(ax)
+    n_expert = lc.shape[0] // world
+    gc_em = gc.astype(jnp.int32).reshape(world, n_expert).T.reshape(-1)
+    buckets, _ = _bucket_rows(xd, gc_em, capacity)    # [(e,r), C, ...]
+    send = buckets.reshape((n_expert, world, capacity) + xd.shape[1:])
+    send = jnp.swapaxes(send, 0, 1)                   # send[r, e]
+    recv = jax.lax.all_to_all(send, ax, split_axis=0, concat_axis=0,
+                              tiled=False)            # recv[r, e]
+    buckets = recv.reshape((world * n_expert, capacity) + xd.shape[1:])
+    return _compact_buckets(buckets, lc, capacity)
+
+
+def _dispatch(x, local_count, global_count, group, name, raw_fn,
+              out_counts_first, capacity):
+    from ..tensor import Tensor, apply
+    from .collective import _axes, _in_shard_map
+
+    _check_dispatch_args(x, local_count, global_count, name)
+    axes = _axes(group)
+    lc = local_count._data if isinstance(local_count, Tensor) \
+        else local_count
+    gc = global_count._data if isinstance(global_count, Tensor) \
+        else global_count
+    if _in_shard_map(axes):
+        ax = axes[0] if len(axes) == 1 else axes
+        cap = int(capacity) if capacity else int(x.shape[0])
+        # a bucket count above capacity would silently drop rows AND
+        # misalign the compaction offsets — reject when the counts are
+        # concrete (traced counts can't be checked; contract documented)
+        import jax
+        import numpy as np
+        for nm, c in (("local_count", lc), ("global_count", gc)):
+            if not isinstance(c, jax.core.Tracer) \
+                    and np.asarray(c).size \
+                    and int(np.asarray(c).max()) > cap:
+                raise ValueError(
+                    f"{name}: max {nm} {int(np.asarray(c).max())} exceeds "
+                    f"capacity {cap}; pass capacity= >= the largest "
+                    "(card, expert) bucket")
+        return apply(lambda a: raw_fn(a, lc, gc, ax, cap), x)
+    # eager single controller: world_size 1 — card-major and expert-major
+    # coincide, so the dispatch is the identity on the first sum(counts)
+    # rows (exact dynamic shape, like the reference kernel)
+    import numpy as np
+    total = int(np.asarray(out_counts_first(lc, gc)).sum())
+    return apply(lambda a: a[:total], x)
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True, capacity=None):
+    """Distribute rows of ``x`` to n_expert * world_size expert buckets
+    (reference: distributed/utils.py:57). See the layout contract above;
+    under jit/shard_map the result is capacity-padded (first
+    sum(global_count) rows valid)."""
+    if group is not None and hasattr(group, "is_member") \
+            and not group.is_member():
+        return None
+    return _dispatch(x, local_count, global_count, group, "global_scatter",
+                     _global_scatter_raw, lambda lc, gc: gc, capacity)
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True, capacity=None):
+    """Gather expert outputs back to the cards that sent the tokens
+    (reference: distributed/utils.py:180). Inverse of global_scatter;
+    under jit/shard_map the result is capacity-padded (first
+    sum(local_count) rows valid)."""
+    if group is not None and hasattr(group, "is_member") \
+            and not group.is_member():
+        return None
+    return _dispatch(x, local_count, global_count, group, "global_gather",
+                     _global_gather_raw, lambda lc, gc: lc, capacity)

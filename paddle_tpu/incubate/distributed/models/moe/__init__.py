@@ -8,6 +8,15 @@ each expert Layer runs on its gathered [capacity, d_model] slice —
 static shapes throughout, with expert parallelism coming from sharding
 the stacked expert tensors over the mesh "ep" axis (see
 paddle_tpu.nn.moe for the batched-parameter fast path).
+
+The reference's cross-card token movement primitives
+``global_scatter``/``global_gather`` (moe_layer.py:29 imports them from
+paddle.distributed.utils) are available here too —
+``paddle_tpu.distributed.utils.global_scatter/global_gather`` move
+count-delimited token buckets over the mesh axis in one lax.all_to_all
+(capacity-padded under jit). They are the documented migration path for
+code that dispatched tokens manually; MoELayer itself uses the
+sort-based dispatch.
 """
 from __future__ import annotations
 

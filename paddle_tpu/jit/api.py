@@ -57,6 +57,9 @@ def _swap_params(params: dict, raw_tree: dict):
             p._data = olds[name]
 
 
+_DESC_TOKEN = 0
+
+
 class StaticFunction:
     # ProgramTranslator().enable(False) drops back to eager execution
     global_enable = True
@@ -67,6 +70,14 @@ class StaticFunction:
         self._fallback_keys = set()
         self._last_sig = None
         self._last_args = None
+        self._jit_kwargs = dict(jit_kwargs or {})
+        self._convert_control_flow = convert_control_flow
+        # unique per-descriptor token for the per-instance bound-method
+        # cache: two descriptors can share __name__ (an override calling
+        # super().forward), and id() can be reused after gc
+        global _DESC_TOKEN
+        _DESC_TOKEN += 1
+        self._desc_token = _DESC_TOKEN
         if convert_control_flow:
             from .dy2static import convert_control_flow as _ccf
             fn = _ccf(fn)
@@ -180,12 +191,14 @@ class StaticFunction:
         if instance is None:
             return self
         cache = instance.__dict__.setdefault("_pt_static_methods", {})
-        key = id(self)
+        key = (getattr(self._orig_fn, "__name__", ""), self._desc_token)
         bound = cache.get(key)
         if bound is None:
             bound = StaticFunction(
                 self._orig_fn.__get__(instance, owner),
-                self._input_spec)
+                self._input_spec,
+                jit_kwargs=self._jit_kwargs,
+                convert_control_flow=self._convert_control_flow)
             cache[key] = bound
         return bound
 

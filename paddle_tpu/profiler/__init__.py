@@ -192,8 +192,10 @@ class Profiler:
             result = load_profiler_result(self._export_dir)
             print(build_summary(result, sorted_by=sorted_by,
                                 time_unit=time_unit))
-        except FileNotFoundError:
-            pass  # no recorded steps; nothing to tabulate
+        except (FileNotFoundError, ValueError, OSError, EOFError):
+            # no recorded steps, or a truncated/corrupt exported trace
+            # (json/gzip errors): degrade to the trace-dir message
+            pass
         print(f"trace dir: {self._export_dir} "
               f"(tensorboard --logdir or perfetto)")
 

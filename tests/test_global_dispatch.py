@@ -1,0 +1,120 @@
+"""global_scatter / global_gather (reference distributed/utils.py:57,180):
+the MoE token-dispatch collectives, reproduced bit-for-bit from the
+reference docstring's 2-card example under shard_map on the virtual CPU
+mesh, plus the eager single-controller path and dtype validation."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.utils import (
+    _global_gather_raw, _global_scatter_raw, global_gather, global_scatter)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs >=2 devices")
+
+
+# the reference docstring example: world_size 2, n_expert 2, d_model 2
+X_ROWS = np.array([[1, 2], [3, 4], [5, 6], [7, 8], [9, 10]], np.float32)
+LC = np.array([[2, 1, 1, 1], [1, 1, 2, 1]], np.int64)  # per-rank counts
+GC = np.array([[2, 1, 1, 1], [1, 1, 2, 1]], np.int64)
+SCATTER_EXPECTED = [
+    np.array([[1, 2], [3, 4], [1, 2], [5, 6], [3, 4]], np.float32),
+    np.array([[7, 8], [5, 6], [7, 8], [9, 10], [9, 10]], np.float32),
+]
+GATHER_EXPECTED = [
+    np.array([[1, 2], [3, 4], [7, 8], [1, 2], [7, 8]], np.float32),
+    np.array([[5, 6], [9, 10], [3, 4], [5, 6], [9, 10]], np.float32),
+]
+
+
+def _mesh2():
+    return Mesh(np.asarray(jax.devices()[:2]), ("x",))
+
+
+def _run(raw_fn, x, capacity=5):
+    f = shard_map(
+        lambda xs, lc, gc: raw_fn(xs[0], lc[0], gc[0], "x", capacity)[None],
+        mesh=_mesh2(), in_specs=(P("x"), P("x"), P("x")),
+        out_specs=P("x"))
+    return np.asarray(f(jnp.asarray(x), jnp.asarray(LC), jnp.asarray(GC)))
+
+
+def test_global_scatter_matches_reference_example():
+    x = np.stack([X_ROWS, X_ROWS])
+    out = _run(_global_scatter_raw, x)
+    for rank in range(2):
+        np.testing.assert_array_equal(out[rank][:5], SCATTER_EXPECTED[rank])
+        # capacity padding past the valid rows is zero
+        assert np.all(out[rank][5:] == 0)
+
+
+def test_global_gather_matches_reference_example():
+    x = np.stack([X_ROWS, X_ROWS])
+    out = _run(_global_gather_raw, x)
+    for rank in range(2):
+        np.testing.assert_array_equal(out[rank][:5], GATHER_EXPECTED[rank])
+        assert np.all(out[rank][5:] == 0)
+
+
+def test_gather_inverts_scatter():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 5, 3)).astype(np.float32)
+
+    def round_trip(xs, lc, gc):
+        mid = _global_scatter_raw(xs[0], lc[0], gc[0], "x", 5)
+        back = _global_gather_raw(mid, lc[0], gc[0], "x", 5)
+        return back[None]
+
+    f = shard_map(round_trip, mesh=_mesh2(),
+                  in_specs=(P("x"), P("x"), P("x")), out_specs=P("x"))
+    out = np.asarray(f(jnp.asarray(x), jnp.asarray(LC), jnp.asarray(GC)))
+    for rank in range(2):
+        np.testing.assert_allclose(out[rank][:5], x[rank], rtol=1e-6)
+
+
+def test_global_scatter_grad_is_identity_permutation():
+    """Each row is sent exactly once, so d(sum(out^2))/dx == 2x —
+    the gradient printed in the reference docstring example."""
+    x = np.stack([X_ROWS, X_ROWS])
+
+    def loss(xs):
+        f = shard_map(
+            lambda s, lc, gc: _global_scatter_raw(
+                s[0], lc[0], gc[0], "x", 5)[None],
+            mesh=_mesh2(), in_specs=(P("x"), P("x"), P("x")),
+            out_specs=P("x"))
+        out = f(xs, jnp.asarray(LC), jnp.asarray(GC))
+        return (out * out).sum()
+
+    g = np.asarray(jax.grad(loss)(jnp.asarray(x)))
+    np.testing.assert_allclose(g, 2.0 * x, rtol=1e-6)
+
+
+def test_eager_single_controller_path():
+    """world_size 1: card-major == expert-major, so dispatch is the
+    identity on the first sum(counts) rows, with exact dynamic shape."""
+    x = paddle.to_tensor(X_ROWS)
+    x.stop_gradient = False
+    lc = paddle.to_tensor(np.array([3, 2], np.int64))
+    out = global_scatter(x, lc, lc)
+    np.testing.assert_array_equal(out.numpy(), X_ROWS)
+    back = global_gather(out, lc, lc)
+    np.testing.assert_array_equal(back.numpy(), X_ROWS)
+    (out * out).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2.0 * X_ROWS)
+
+
+def test_dispatch_dtype_validation():
+    x = paddle.to_tensor(X_ROWS)
+    bad_counts = paddle.to_tensor(np.array([3.0, 2.0], np.float32))
+    with pytest.raises(TypeError):
+        global_scatter(x, bad_counts, bad_counts)
+    with pytest.raises(TypeError):
+        global_gather(paddle.to_tensor(X_ROWS.astype(bool)),
+                      paddle.to_tensor(np.array([3, 2], np.int64)),
+                      paddle.to_tensor(np.array([3, 2], np.int64)))

@@ -36,6 +36,104 @@ def test_lamb_converges():
     np.testing.assert_allclose(w, target, atol=0.3)
 
 
+def test_lars_momentum_converges():
+    """LarsMomentum (reference fluid/optimizer.py:1975): trust-ratio
+    scaled momentum must still reach the quadratic-bowl optimum."""
+    w, target = _fit_quadratic(optimizer.LarsMomentum, lr=2.0, steps=400,
+                               lars_weight_decay=0.0)
+    np.testing.assert_allclose(w, target, atol=0.3)
+
+
+def test_fleet_strategy_lars_asp_routing():
+    """strategy.lars swaps the optimizer for LarsMomentum and
+    strategy.asp decorates it with the n:m mask pass (reference
+    meta_optimizers/{lars,asp}_optimizer.py routing)."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.incubate import asp
+
+    paddle.seed(0)
+    model = nn.Linear(8, 8)
+    asp.prune_model(model)
+    mask_density = np.mean(model.weight.numpy() != 0)
+    assert abs(mask_density - 0.5) < 0.05
+
+    strategy = DistributedStrategy()
+    strategy.lars = True
+    strategy.lars_configs = {"lars_coeff": 0.002,
+                             "lars_weight_decay": 0.0}
+    strategy.asp = True
+    fleet.init(is_collective=True, strategy=strategy)
+    opt = fleet.distributed_optimizer(
+        optimizer.Momentum(learning_rate=0.1, momentum=0.8,
+                           parameters=model.parameters()),
+        strategy=strategy)
+    inner = opt._inner._inner  # ASP decorator wraps the swapped Lars
+    assert type(inner).__name__ == "LarsMomentum"
+    assert inner._lars_coeff == 0.002
+    assert inner._momentum == 0.8  # carried from the wrapped Momentum
+
+    x = paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(4, 8)).astype(np.float32))
+    for _ in range(3):
+        loss = (model(x) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # the eager step re-applies masks: sparsity pattern survives updates
+    assert abs(np.mean(model.weight.numpy() != 0) - mask_density) < 1e-6
+
+
+def test_fleet_strategy_lamb_routing():
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+
+    model = nn.Linear(4, 4)
+    strategy = DistributedStrategy()
+    strategy.lamb = True
+    strategy.lamb_configs = {"lamb_weight_decay": 0.05}
+    fleet.init(is_collective=True, strategy=strategy)
+    opt = fleet.distributed_optimizer(
+        optimizer.Adam(learning_rate=3e-4,
+                       parameters=model.parameters()),
+        strategy=strategy)
+    assert type(opt._inner).__name__ == "Lamb"
+    assert opt._inner._lamb_wd == 0.05
+    assert opt._inner._learning_rate == 3e-4
+
+
+def test_asp_masks_survive_compiled_train_step():
+    """strategy.asp on the compiled path: after make_train_step updates,
+    the n:m zeros are still zero (fleet._ASPMaskedStep)."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.incubate import asp
+
+    paddle.seed(0)
+    model = nn.Linear(8, 4)
+    masks = asp.prune_model(model)
+    assert masks
+    strategy = DistributedStrategy()
+    strategy.asp = True
+    fleet.init(is_collective=True, strategy=strategy)
+    model = fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(
+        optimizer.AdamW(learning_rate=1e-2,
+                        parameters=model.parameters()),
+        strategy=strategy)
+    step = opt.make_train_step(model, lambda m, x: (m(x) ** 2).sum())
+    x = paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(4, 8)).astype(np.float32))
+    for _ in range(2):
+        loss = step(x)
+    assert np.isfinite(float(np.asarray(loss._data)))
+    w = model.weight.numpy()
+    assert abs(np.mean(w != 0) - 0.5) < 0.05
+    # the masked positions are exactly the pruned ones
+    mask = list(masks.values())[0]
+    assert np.all(w[~np.asarray(mask)] == 0)
+
+
 def test_adam_matches_torch():
     torch = pytest.importorskip("torch")
     w0 = np.random.default_rng(0).normal(size=(3,)).astype(np.float32)

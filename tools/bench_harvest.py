@@ -109,6 +109,9 @@ def main():
         env["PADDLE_TPU_BENCH_TOTAL_S"] = "3600"
         env["PADDLE_TPU_BENCH_BUDGET_S"] = "3300"
         env["PADDLE_TPU_BENCH_INIT_RETRIES"] = "1"
+        # 420s killed BERT/ViT/MoE during first compile; give each config
+        # room — the persistent compile cache makes retries cheap anyway
+        env.setdefault("PADDLE_TPU_BENCH_PER_CONFIG_S", "900")
         try:
             subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
                            env=env, cwd=ROOT, timeout=3900)

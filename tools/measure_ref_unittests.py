@@ -64,8 +64,18 @@ def main():
             print(f"{f:45s} run={r['run']:3d} skip={r['skip']:3d} "
                   f"pass={passed:3d}/{counted:3d} = {rate:.2f}  "
                   f"failing={r['failing'][:4]}", flush=True)
-    with open(os.path.join(ROOT, "tools", "ref_ut_measure.json"), "w") as fh:
-        json.dump(results, fh, indent=1)
+    # merge into the existing sweep record: a partial re-measurement must
+    # not destroy the provenance of floors measured in earlier sweeps
+    path = os.path.join(ROOT, "tools", "ref_ut_measure.json")
+    merged = {}
+    try:
+        with open(path) as fh:
+            merged = json.load(fh)
+    except Exception:
+        pass
+    merged.update(results)
+    with open(path, "w") as fh:
+        json.dump(merged, fh, indent=1, sort_keys=True)
 
 
 if __name__ == "__main__":
