@@ -33,6 +33,8 @@ from ...static.nn import (StaticRNN, batch_norm,  # noqa: F401
                           switch_case, while_loop)
 import paddle_tpu as _p
 
+from . import utils  # noqa: F401  (fluid.layers.utils.* attribute access)
+
 from ...static.nn import fc as _static_fc
 
 

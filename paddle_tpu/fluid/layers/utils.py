@@ -1,0 +1,245 @@
+"""fluid.layers.utils — nest/structure helpers (reference
+python/paddle/fluid/layers/utils.py: flatten/pack_sequence_as/
+map_structure and the conv arg normalizers). TPU-native: the nest
+walkers mirror the reference's semantics (dicts iterate in sorted-key
+order) rather than jax.tree_util, because reference callers rely on
+that exact flatten order for RNN states and dy2static carries."""
+from __future__ import annotations
+
+import collections
+import copy
+import numbers
+
+import numpy as np
+
+
+def convert_to_list(value, n, name, dtype=int):
+    """Normalize an int-or-sequence arg to an n-list (reference
+    utils.convert_to_list)."""
+    if isinstance(value, dtype):
+        return [value] * n
+    try:
+        value_list = list(value)
+    except TypeError:
+        raise ValueError(
+            f"The {name}'s type must be {dtype} or list/tuple of "
+            f"{dtype}, but received: {value}")
+    if len(value_list) != n:
+        raise ValueError(f"The {name}'s length must be {n}, "
+                         f"but received: {value}")
+    for single_value in value_list:
+        try:
+            dtype(single_value)
+        except (ValueError, TypeError):
+            raise ValueError(
+                f"The {name}'s type must be a list or tuple of {n} "
+                f"{dtype}, but received: {value_list}")
+    return value_list
+
+
+def is_sequence(seq):
+    """True for list/tuple/dict nests, excluding str/ndarray (reference
+    utils.is_sequence)."""
+    if isinstance(seq, dict):
+        return True
+    return isinstance(seq, (list, tuple)) \
+        and not isinstance(seq, str)
+
+
+def _sorted(dict_):
+    try:
+        return sorted(dict_.keys())
+    except TypeError:
+        raise TypeError("nest only supports dicts with sortable keys.")
+
+
+def _yield_value(iterable):
+    if isinstance(iterable, dict):
+        for key in _sorted(iterable):
+            yield iterable[key]
+    else:
+        for value in iterable:
+            yield value
+
+
+def _yield_flat_nest(nest):
+    for n in _yield_value(nest):
+        if is_sequence(n):
+            for ni in _yield_flat_nest(n):
+                yield ni
+        else:
+            yield n
+
+
+def to_sequence(nest):
+    if is_sequence(nest):
+        return nest
+    return [nest]
+
+
+def flatten(nest):
+    """Depth-first flatten of a possibly-nested structure (reference
+    utils.flatten; dicts in sorted-key order)."""
+    if is_sequence(nest):
+        return list(_yield_flat_nest(nest))
+    return [nest]
+
+
+def _sequence_like(instance, args):
+    if isinstance(instance, dict):
+        result = dict(zip(_sorted(instance), args))
+        return type(instance)(
+            (key, result[key]) for key in instance.keys())
+    elif (isinstance(instance, tuple) and hasattr(instance, "_fields")
+          and isinstance(getattr(instance, "_fields", None), tuple)):
+        return type(instance)(*args)
+    else:
+        return type(instance)(args)
+
+
+def _packed_nest_with_indices(structure, flat, index):
+    packed = []
+    for s in _yield_value(structure):
+        if is_sequence(s):
+            new_index, child = _packed_nest_with_indices(s, flat, index)
+            packed.append(_sequence_like(s, child))
+            index = new_index
+        else:
+            packed.append(flat[index])
+            index += 1
+    return index, packed
+
+
+def pack_sequence_as(structure, flat_sequence):
+    """Inverse of flatten (reference utils.pack_sequence_as)."""
+    if not is_sequence(flat_sequence):
+        raise TypeError("flat_sequence must be a sequence")
+    if not is_sequence(structure):
+        if len(flat_sequence) != 1:
+            raise ValueError(
+                "Structure is a scalar but "
+                f"len(flat_sequence) == {len(flat_sequence)} > 1")
+        return flat_sequence[0]
+    flat_structure = flatten(structure)
+    if len(flat_structure) != len(flat_sequence):
+        raise ValueError(
+            "Could not pack sequence. Structure had "
+            f"{len(flat_structure)} elements, but flat_sequence had "
+            f"{len(flat_sequence)} elements.")
+    _, packed = _packed_nest_with_indices(structure, flat_sequence, 0)
+    return _sequence_like(structure, packed)
+
+
+def map_structure(func, *structure):
+    """Apply ``func`` leafwise, preserving structure (reference
+    utils.map_structure)."""
+    flat_structure = [flatten(s) for s in structure]
+    entries = zip(*flat_structure)
+    return pack_sequence_as(structure[0],
+                            [func(*x) for x in entries])
+
+
+def hold_mutable_vars(structure):
+    """True when any TOP-LEVEL element of the structure is itself a
+    sequence (reference utils.hold_mutable_vars — it does not recurse
+    and does not test the outer container)."""
+    for s in structure:
+        if is_sequence(s):
+            return True
+    return False
+
+
+def copy_mutable_vars(structure):
+    """Shallow-copy the mutable containers in a nest (reference
+    utils.copy_mutable_vars)."""
+    flat_structure = copy.copy(flatten(structure))
+    return pack_sequence_as(structure, flat_structure)
+
+
+def assert_same_structure(nest1, nest2, check_types=True):
+    """Raise ValueError when two nests differ in structure (reference
+    utils.assert_same_structure)."""
+    len1 = len(flatten(nest1))
+    len2 = len(flatten(nest2))
+    if len1 != len2:
+        raise ValueError(
+            "The two structures don't have the same number of elements: "
+            f"{len1} vs {len2}.")
+    _recursive_assert_same_structure(nest1, nest2, check_types)
+
+
+def _recursive_assert_same_structure(nest1, nest2, check_types):
+    is_sequence_nest1 = is_sequence(nest1)
+    if is_sequence_nest1 != is_sequence(nest2):
+        raise ValueError(
+            "The two structures don't have the same nested structure: "
+            f"{nest1} vs {nest2}")
+    if not is_sequence_nest1:
+        return
+    if check_types:
+        type_nest1 = type(nest1)
+        type_nest2 = type(nest2)
+        if type_nest1 != type_nest2:
+            raise TypeError(
+                "The two structures don't have the same sequence type: "
+                f"{type_nest1} vs {type_nest2}")
+        if isinstance(nest1, dict):
+            keys1 = set(nest1.keys())
+            keys2 = set(nest2.keys())
+            if keys1 != keys2:
+                raise ValueError(
+                    "The two dictionaries don't have the same set of "
+                    f"keys: {keys1} vs {keys2}")
+    for n1, n2 in zip(_yield_value(nest1), _yield_value(nest2)):
+        _recursive_assert_same_structure(n1, n2, check_types)
+
+
+def _is_symmetric_padding(padding, data_dim):
+    """True when an explicit per-edge padding list is symmetric
+    (reference utils._is_symmetric_padding)."""
+    assert len(padding) == data_dim * 2 or len(padding) == data_dim
+    is_sym = True
+    if len(padding) == data_dim * 2:
+        for i in range(data_dim):
+            if padding[i * 2] != padding[i * 2 + 1]:
+                is_sym = False
+    return is_sym
+
+
+def _contain_var(list_or_tuple):
+    """True when any element is a Tensor (reference utils._contain_var)."""
+    from ...tensor import Tensor
+
+    return any(isinstance(item, Tensor) for item in list_or_tuple)
+
+
+def convert_shape_to_list(shape):
+    """Normalize a shape (ints / Tensors / ndarray) to a python list
+    (reference utils.convert_shape_to_list)."""
+    from ...tensor import Tensor
+
+    if isinstance(shape, (list, tuple)):
+        return [int(s._data) if isinstance(s, Tensor)
+                else int(s) for s in shape]
+    if isinstance(shape, Tensor):
+        return [int(v) for v in np.asarray(shape._data).reshape(-1)]
+    return list(np.asarray(shape).reshape(-1).astype(int))
+
+
+def check_shape(shape):
+    """Validate a creation-op shape argument (reference
+    utils.check_shape)."""
+    from ...tensor import Tensor
+
+    if isinstance(shape, Tensor):
+        return
+    for ele in shape:
+        if not isinstance(ele, Tensor):
+            if ele < 0:
+                raise ValueError(
+                    "All elements in ``shape`` must be positive when "
+                    "it's a list or tuple")
+            if not isinstance(ele, numbers.Integral):
+                raise TypeError(
+                    "All elements in ``shape`` must be integers when "
+                    "it's a list or tuple")

@@ -203,6 +203,20 @@ class StaticFunction:
         return bound
 
     @property
+    def code(self):
+        """Transformed source of the converted function (reference
+        StaticFunction.code, program_translator.py)."""
+        code = getattr(self._fn, "__converted_code__", None)
+        if code is not None:
+            return code
+        import inspect
+        import textwrap
+        try:
+            return textwrap.dedent(inspect.getsource(self._orig_fn))
+        except (OSError, TypeError):
+            return f"<source unavailable for {self._orig_fn!r}>"
+
+    @property
     def concrete_program(self):
         return self._jit
 

@@ -35,10 +35,15 @@ Contract:
   contract, same as the reference's requirement that cond branch
   outputs unify.
 * bodies that mutate python containers (``xs.append(...)``,
-  ``d[k] = v``) are NOT converted — they run python control flow,
-  which jit unrolls when the bounds are concrete; with a traced bound
-  the jit call falls back to eager with a warning
-  (program_translator.py fallback analog).
+  ``d[k] = v``) are NOT converted to lax ops — they run python control
+  flow, which jit unrolls when the bounds are trace-concrete (the
+  reference ListTransformer's fill_constant / paddle.shape idioms ARE
+  trace-concrete here, so those loops compile; see
+  dygraph_to_static/test_list.py in the conformance TARGETS). A
+  genuinely data-dependent trip count appending to a list cannot be one
+  XLA program without a length bound — the reference's LoDTensorArray
+  grows at runtime, XLA shapes cannot — so that corner falls back to
+  eager with a warning (program_translator.py fallback analog).
 * conversion is source-based (inspect.getsource); functions without
   retrievable source (REPL lambdas, C extensions) run unconverted.
 """
@@ -999,6 +1004,12 @@ def convert_control_flow(fn: Callable) -> Callable:
         return fn
     functools.update_wrapper(converted, inner, updated=())
     converted.__wrapped_original__ = inner
+    try:
+        # the transformed source, like the reference's
+        # StaticFunction.code (program_translator.py code property)
+        converted.__converted_code__ = ast.unparse(new_tree)
+    except Exception:
+        converted.__converted_code__ = src
     if inspect.ismethod(fn):
         return converted.__get__(fn.__self__, type(fn.__self__))
     return converted

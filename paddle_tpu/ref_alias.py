@@ -218,10 +218,13 @@ for _leaf, _names in (("assign", {"Assign", "NumpyArrayInitializer"}),
 # fluid.layers namespace; transformer/codegen internals excluded) ----
 for _leaf in ("nn", "tensor", "control_flow", "io", "ops", "loss",
               "detection", "learning_rate_scheduler", "rnn",
-              "sequence_lod", "distributions", "metric_op", "utils",
+              "sequence_lod", "distributions", "metric_op",
               "collective", "device"):
     _alias(f"fluid.layers.{_leaf}", "fluid.layers",
            f"reference python/paddle/fluid/layers/{_leaf}.py")
+# fluid.layers.utils is a REAL module (fluid/layers/utils.py: the nest
+# walkers with reference flatten order) — no alias, so the import
+# machinery resolves the file
 
 # ---- fluid.dygraph per-concept files (dygraph_to_static transformer
 # internals excluded — jit/dy2static.py is the conversion here) ----

@@ -35,6 +35,7 @@ class Program:
         self._feed_vars = {}    # name -> placeholder Tensor
         self._vars = {}         # name -> Tensor (parameters/globals/fetch)
         self.random_seed = None
+        self._jit_cache = {}    # (n_ops, feed_sig, fetch_key) -> callable|None
 
     def __getstate__(self):
         """paddle.save(program) serializes the reference's ProgramDesc —
@@ -46,9 +47,14 @@ class Program:
         python that built the program, load only restores the desc)."""
         d = dict(self.__dict__)
         d["_ops"] = []
+        d["_jit_cache"] = {}
         # normalize_program's fetch Tensors carry autograd-node closures
         d.pop("_normalized", None)
         return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.__dict__.setdefault("_jit_cache", {})
 
     # -- recording ---------------------------------------------------------
     def _recorder(self, fn, args, kwargs, outs):
@@ -235,11 +241,17 @@ class Executor:
         prog = program if program is not None else default_main_program()
         if isinstance(prog, CompiledProgram):
             prog = prog._program
+        feed = feed or {}
+        for name in feed:
+            if name not in prog._feed_vars:
+                raise KeyError(f"no feed placeholder named {name!r}")
+        got = _jit_replay_run(prog, feed, fetch_list or [])
+        if got is not None:
+            return [np.asarray(t._data) if return_numpy else t
+                    for t in got]
         with _no_record():
-            for name, val in (feed or {}).items():
-                ph = prog._feed_vars.get(name)
-                if ph is None:
-                    raise KeyError(f"no feed placeholder named {name!r}")
+            for name, val in feed.items():
+                ph = prog._feed_vars[name]
                 ph._data = jnp.asarray(
                     val._data if isinstance(val, Tensor) else val)
                 ph._node = None
@@ -252,6 +264,154 @@ class Executor:
 
     def close(self):
         return None
+
+
+# -- compiled replay -------------------------------------------------------
+#
+# Reference: fluid/executor.py — the C++ executor IS the static-graph perf
+# path (op fusion, no per-op python). TPU-native analog: trace the
+# recorded op list ONCE per (program, feed shapes/dtypes, fetch set) into
+# a single jax.jit program, so a 1.x-style `exe.run(feed, fetch_list)`
+# loop gets whole-graph XLA instead of op-by-op eager replay. Programs
+# with thunks (append_backward / optimizer minimize / While blocks /
+# py_func host calls) keep the eager replay — those closures need the
+# live tape. Replay randomness is identical in both paths: PRNG keys are
+# baked into the recorded closures at build time.
+
+def _jit_replay_run(prog, feed, fetch_list):
+    """Run one Executor.run via the cached jitted replay. Returns the
+    fetched Tensors, or None when this program/feed must use the eager
+    path."""
+    if os.environ.get("PADDLE_TPU_STATIC_JIT", "1") == "0":
+        return None
+    ops = getattr(prog, "_ops", None)
+    if not ops or any(e[0] != "op" for e in ops) \
+            or getattr(prog, "_jit_cache", None) is None:
+        return None
+    feed_names = sorted(feed)
+    raw_feed = {}
+    for n in feed_names:
+        v = feed[n]
+        raw_feed[n] = jnp.asarray(v._data if isinstance(v, Tensor) else v)
+    try:
+        fetch_key = tuple(f if isinstance(f, str) else id(f)
+                          for f in fetch_list)
+        key = (len(prog._ops),
+               tuple((n, tuple(raw_feed[n].shape), str(raw_feed[n].dtype))
+                     for n in feed_names),
+               fetch_key)
+    except Exception:
+        return None
+    entry = prog._jit_cache.get(key)
+    if entry is None and key not in prog._jit_cache:
+        entry = _build_jit_replay(prog, feed_names, fetch_list, raw_feed)
+        prog._jit_cache[key] = entry  # None = not jittable, stay eager
+    if entry is None:
+        return None
+    compiled, ext_inputs, out_tensors, n_fetch = entry
+    vals = [raw_feed[n] if isinstance(n, str) else n._data
+            for n in ext_inputs]
+    try:
+        results = compiled(vals)
+    except Exception as e:  # pragma: no cover - transient runtime error
+        # do NOT poison the cache: a transient failure (device hiccup,
+        # one-off OOM) must not silently disable the fast path forever
+        import warnings
+        warnings.warn(
+            f"static jit replay failed ({type(e).__name__}: "
+            f"{str(e)[:120]}); running this step eagerly", stacklevel=3)
+        return None
+    with _no_record():
+        for name in feed_names:  # keep var() reads consistent with eager
+            ph = prog._feed_vars[name]
+            ph._data = raw_feed[name]
+            ph._node = None
+        # out_tensors = fetches + every NAMED program var the ops
+        # produce, so prog.var()/scope reads match the eager replay
+        for t, r in zip(out_tensors, results):
+            t._data = r
+            t._node = None
+    return out_tensors[:n_fetch]
+
+
+def _build_jit_replay(prog, feed_names, fetch_list, raw_feed):
+    """Trace the program's op list into one AOT-compiled callable.
+    Returns (compiled, ext_inputs, out_tensors, n_fetch) or None when
+    not jittable. ``ext_inputs`` entries are feed names (str) or live
+    Tensors whose CURRENT value is read each run (parameters keep
+    updating). ``out_tensors`` is fetches followed by every named
+    program var the ops produce — refreshed so ``prog.var()`` reads
+    stay consistent with the eager replay."""
+    import jax
+
+    def _is_t(x):
+        return isinstance(x, Tensor)
+
+    entries = prog._ops
+    produced = set()
+    ext, ext_order = {}, []
+    try:
+        fetch_tensors = [prog.var(f) if isinstance(f, str) else f
+                         for f in fetch_list]
+    except KeyError:
+        return None
+    feed_ids = {id(prog._feed_vars[n]): n for n in feed_names}
+    for e in entries:
+        _, fn, args, kwargs, outs = e
+        if any(_is_t(leaf) for leaf in jax.tree_util.tree_leaves(
+                kwargs, is_leaf=_is_t)):
+            return None  # Tensor-valued kwarg: apply bakes it — unsafe
+        for a in args:
+            if _is_t(a):
+                if id(a) not in produced and id(a) not in ext:
+                    ext[id(a)] = len(ext_order)
+                    ext_order.append(a)
+            elif isinstance(a, (list, tuple, dict)):
+                if any(_is_t(leaf) for leaf in
+                       jax.tree_util.tree_leaves(a, is_leaf=_is_t)):
+                    return None  # Tensor nested in a container arg
+        for o in outs:
+            produced.add(id(o))
+    # fetches must be produced by ops or be externals/feeds
+    for t in fetch_tensors:
+        if id(t) not in produced and id(t) not in ext:
+            ext[id(t)] = len(ext_order)
+            ext_order.append(t)
+    # named vars the ops produce: refresh them too (fluid debugging /
+    # metric idioms read prog.var(name) without fetching)
+    out_tensors = list(fetch_tensors)
+    out_ids = {id(t) for t in fetch_tensors}
+    for t in prog._vars.values():
+        if id(t) in produced and id(t) not in out_ids:
+            out_tensors.append(t)
+            out_ids.add(id(t))
+
+    def replay(vals):
+        env = dict(zip([id(t) for t in ext_order], vals))
+        for e in entries:
+            _, fn, args, kwargs, outs = e
+            a = [env[id(x)] if _is_t(x) else x for x in args]
+            res = fn(*a, **kwargs)
+            new = tuple(res) if isinstance(res, (tuple, list)) else (res,)
+            for o, r in zip(outs, new):
+                if r is not None:
+                    env[id(o)] = r
+        return tuple(env[id(t)] if id(t) in env else vals[ext[id(t)]]
+                     for t in out_tensors)
+
+    # probe with the ACTUAL fed shapes (placeholders were recorded with
+    # 1 for dynamic dims) so unjittable programs — data-dependent
+    # shapes, host callbacks — are detected at build time, not per run.
+    # AOT-compile the lowering: the cache key already pins shapes, and
+    # reusing the lowered module avoids a second full trace on first run.
+    probe = [raw_feed[feed_ids[id(t)]] if id(t) in feed_ids else t._data
+             for t in ext_order]
+    try:
+        executable = jax.jit(replay).lower(probe).compile()
+    except Exception:
+        return None
+    ext_inputs = [feed_ids.get(id(t), t) for t in ext_order]
+    return executable, ext_inputs, out_tensors, len(fetch_tensors)
 
 
 # -- gradients ------------------------------------------------------------

@@ -171,6 +171,10 @@ TARGETS = {
     "dygraph_to_static/test_assert.py": (0.90, 3),
     "dygraph_to_static/test_dict.py": (0.60, 4),
     "dygraph_to_static/test_container.py": (0.95, 2),
+    # 7/8: list-append loops convert (bounds are trace-concrete, so the
+    # loop unrolls under jit; ListTransformer analog). The one failure
+    # indexes res[0] on a 0-d result — 2.3-era "no 0-d tensors" slicing.
+    "dygraph_to_static/test_list.py": (0.80, 6),
 }
 # Curated out (would pass 0 cases, all excluded-by-design classes):
 #  test_glu.py / test_subtract_op.py / test_minimum_op.py —

@@ -28,6 +28,36 @@ class TestStaticProgram:
         np.testing.assert_allclose(out2, feed_x2 @ np.asarray(w._data),
                                    atol=1e-5)
 
+    def test_jitted_replay_matches_eager_replay(self):
+        """Pure-op programs run via one compiled XLA program
+        (static/program.py _jit_replay_run); results must match the
+        op-by-op eager replay exactly, parameters must be re-read each
+        run (not baked), and thunk programs must stay eager."""
+        paddle.seed(0)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [None, 8], 'float32')
+            layer = nn.Linear(8, 8)
+            h = paddle.nn.functional.relu(layer(x))
+            y = (h * h).sum(axis=1)
+        exe = static.Executor()
+        feed_x = np.random.default_rng(0).normal(size=(5, 8)) \
+            .astype(np.float32)
+        out_jit, = exe.run(main, feed={'x': feed_x}, fetch_list=[y])
+        assert main._jit_cache and any(
+            v is not None for v in main._jit_cache.values()), \
+            "pure-op program should take the jitted path"
+        os.environ['PADDLE_TPU_STATIC_JIT'] = '0'
+        try:
+            out_eager, = exe.run(main, feed={'x': feed_x}, fetch_list=[y])
+        finally:
+            del os.environ['PADDLE_TPU_STATIC_JIT']
+        np.testing.assert_allclose(out_jit, out_eager, rtol=1e-6)
+        # parameter updates between runs flow into the compiled replay
+        layer.weight._data = layer.weight._data * 2.0
+        out2, = exe.run(main, feed={'x': feed_x}, fetch_list=[y])
+        assert not np.allclose(out2, out_jit)
+
     def test_static_training_loop_converges(self):
         paddle.seed(0)
         main = static.Program()

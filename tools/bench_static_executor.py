@@ -1,0 +1,62 @@
+"""Microbench: jitted Executor replay vs op-by-op eager replay
+(static/program.py _jit_replay_run; reference fluid/executor.py is the
+C++ fused executor). Run on CPU:
+
+    env JAX_PLATFORMS=cpu python tools/bench_static_executor.py
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn, static  # noqa: E402
+
+
+def build(depth=12, width=256):
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, width], "float32")
+        h = x
+        layers = []
+        for _ in range(depth):
+            layer = nn.Linear(width, width)
+            layers.append(layer)
+            h = paddle.nn.functional.relu(layer(h))
+        y = h.mean()
+    return main, y
+
+
+def time_loop(main, y, iters=50):
+    exe = static.Executor()
+    feed = np.random.default_rng(0).normal(size=(64, 256)).astype(np.float32)
+    exe.run(main, feed={"x": feed}, fetch_list=[y])  # warm (compile)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out, = exe.run(main, feed={"x": feed}, fetch_list=[y])
+    return (time.perf_counter() - t0) / iters * 1e3, float(out)
+
+
+def main():
+    prog, y = build()
+    jit_ms, jit_val = time_loop(prog, y)
+    os.environ["PADDLE_TPU_STATIC_JIT"] = "0"
+    eager_ms, eager_val = time_loop(prog, y)
+    del os.environ["PADDLE_TPU_STATIC_JIT"]
+    assert abs(jit_val - eager_val) < 1e-5, (jit_val, eager_val)
+    print(f"eager op-by-op replay: {eager_ms:8.3f} ms/run")
+    print(f"jitted whole-graph  : {jit_ms:8.3f} ms/run")
+    print(f"speedup             : {eager_ms / jit_ms:8.1f}x")
+
+
+if __name__ == "__main__":
+    main()
