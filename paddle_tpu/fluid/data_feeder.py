@@ -1,6 +1,52 @@
 """fluid.data_feeder compat (reference python/paddle/fluid/data_feeder.py):
-DataFeeder converts minibatch rows into the Executor feed dict."""
+DataFeeder converts minibatch rows into the Executor feed dict, plus the
+check_variable_and_dtype / check_type / check_dtype validators the
+reference's public APIs raise TypeError through."""
 import numpy as np
+
+
+def _dtype_str(x):
+    dt = getattr(x, "dtype", None)
+    if dt is None:
+        return None
+    return str(dt).replace("paddle.", "")
+
+
+def check_type(input, input_name, expected_type, op_name,
+               extra_message=""):
+    """TypeError unless ``input`` is an instance of ``expected_type``
+    (reference data_feeder.check_type)."""
+    if not isinstance(input, expected_type):
+        raise TypeError(
+            f"The type of '{input_name}' in {op_name} must be "
+            f"{expected_type}, but received {type(input)}. "
+            f"{extra_message}")
+
+
+def check_dtype(input_dtype, input_name, expected_dtype, op_name,
+                extra_message=""):
+    """TypeError unless the dtype name is in ``expected_dtype``
+    (reference data_feeder.check_dtype). Accepts dtype objects or
+    names."""
+    name = str(np.dtype(input_dtype) if not isinstance(input_dtype, str)
+               else input_dtype)
+    name = name.replace("paddle.", "")
+    if name not in tuple(expected_dtype):
+        raise TypeError(
+            f"The data type of '{input_name}' in {op_name} must be one "
+            f"of {tuple(expected_dtype)}, but received {name}. "
+            f"{extra_message}")
+
+
+def check_variable_and_dtype(input, input_name, expected_dtype, op_name,
+                             extra_message=""):
+    """TypeError unless ``input`` is a Tensor of an allowed dtype
+    (reference data_feeder.check_variable_and_dtype)."""
+    from ..tensor import Tensor
+
+    check_type(input, input_name, Tensor, op_name, extra_message)
+    check_dtype(_dtype_str(input), input_name, expected_dtype, op_name,
+                extra_message)
 
 
 class DataFeeder:

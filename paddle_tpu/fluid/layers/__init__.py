@@ -181,7 +181,13 @@ logsumexp = _T.logsumexp
 cumsum = _T.cumsum
 increment = _T.increment
 scale = _T.scale
-clip = _T.clip
+def clip(x, min, max, name=None):
+    """Legacy fluid clip (reference fluid/layers/nn.py:clip): Tensor
+    input of FLOAT dtype only — ndarrays and int tensors TypeError."""
+    from ..data_feeder import check_variable_and_dtype
+    check_variable_and_dtype(
+        x, "x", ("float16", "bfloat16", "float32", "float64"), "clip")
+    return _T.clip(x, min, max)
 stanh = _T.stanh if hasattr(_T, "stanh") else None
 
 

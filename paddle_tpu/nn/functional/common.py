@@ -24,8 +24,74 @@ def linear(x, weight, bias=None, name=None):
     return apply(f, x, weight, bias)
 
 
+_DROPOUT_DTYPES = ("float16", "bfloat16", "float32", "float64")
+
+
+def _check_dropout_args(x, p, op_name):
+    """Reference error contract (nn/functional/common.py dropout:
+    check_variable_and_dtype + the p checks): Tensor input of float
+    dtype, p numeric in [0, 1] or a Tensor (VarType p is supported)."""
+    from ...fluid.data_feeder import check_variable_and_dtype
+
+    check_variable_and_dtype(x, "x", _DROPOUT_DTYPES, op_name)
+    if isinstance(p, Tensor):
+        return
+    if not isinstance(p, (int, float)) or isinstance(p, bool):
+        raise TypeError(f"{op_name}: p argument should be a number")
+    if not 0 <= p <= 1:
+        raise ValueError(
+            f"{op_name}: p argument should between 0 and 1, got {p}")
+
+
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             name=None):
+    _check_dropout_args(x, p, "dropout")
+    if mode not in ("upscale_in_train", "downscale_in_infer",
+                    "downgrade_in_infer"):
+        raise ValueError(
+            "dropout: mode should be 'upscale_in_train' or "
+            f"'downscale_in_infer', got {mode!r}")
+    if mode == "downscale_in_infer":
+        mode = "downgrade_in_infer"  # 2.x spelling of the fluid mode
+    if axis is not None:
+        if not isinstance(axis, (int, list, tuple)) \
+                or isinstance(axis, bool):
+            raise TypeError("dropout: axis should be int or list")
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        nd = getattr(raw(x), "ndim", None)
+        if nd is not None:
+            if len(axes) > nd:
+                raise ValueError(
+                    "dropout: length of axis should not be greater than "
+                    "dimensions of x")
+            if any(not isinstance(a, (int,)) or a < 0 or a >= nd
+                   for a in axes):
+                raise ValueError(
+                    f"dropout: axis entries must be ints in [0, {nd}), "
+                    f"got {axes}")
+    def _mask_shape(a):
+        if axis is None:
+            return tuple(a.shape)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        return tuple(s if i in axes else 1 for i, s in enumerate(a.shape))
+
+    def _drop(a, pp, key):
+        # one mask builder for scalar and Tensor p (pp is a 0-d array)
+        keep = jax.random.uniform(key, _mask_shape(a)) >= pp
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - pp), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    if isinstance(p, Tensor):
+        # reference supports a Variable p (dropout prob fed at run time)
+        if not training:
+            if mode == "downgrade_in_infer":
+                return apply(lambda a, pp: (
+                    a * (1.0 - pp.reshape(()))).astype(a.dtype), x, p)
+            return apply(lambda a: a, x)
+        key = next_key()
+        return apply(lambda a, pp: _drop(
+            a, pp.reshape(()).astype(jnp.float32), key), x, p)
     if not training or p == 0.0:
         if mode == "downgrade_in_infer" and p > 0.0:
             # legacy fluid semantics: no train-time upscale, so inference
@@ -35,31 +101,41 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             return x * (1.0 - p)
         return apply(lambda a: a, x) if isinstance(x, Tensor) else x
     key = next_key()
-    def f(a):
-        shape = list(a.shape)
-        if axis is not None:
-            axes = axis if isinstance(axis, (list, tuple)) else [axis]
-            shape = [s if i in axes else 1 for i, s in enumerate(a.shape)]
-        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
-        if mode == "upscale_in_train":
-            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
-        return jnp.where(keep, a, 0.0).astype(a.dtype)
-    return apply(f, x)
+    return apply(lambda a: _drop(a, jnp.float32(p), key), x)
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(
+            f"dropout2d: data_format should be 'NCHW' or 'NHWC', got "
+            f"{data_format!r}")
+    if getattr(raw(x), "ndim", 4) != 4:
+        raise ValueError(
+            f"dropout2d: dimensions of x should be 4, got "
+            f"{raw(x).ndim}")
     ax = [0, 1] if data_format == "NCHW" else [0, 3]
     return dropout(x, p, axis=ax, training=training)
 
 
 def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    if data_format not in ("NCDHW", "NDHWC"):
+        raise ValueError(
+            f"dropout3d: data_format should be 'NCDHW' or 'NDHWC', got "
+            f"{data_format!r}")
+    if getattr(raw(x), "ndim", 5) != 5:
+        raise ValueError(
+            f"dropout3d: dimensions of x should be 5, got "
+            f"{raw(x).ndim}")
     ax = [0, 1] if data_format == "NCDHW" else [0, 4]
     return dropout(x, p, axis=ax, training=training)
 
 
 def alpha_dropout(x, p=0.5, training=True, name=None):
+    _check_dropout_args(x, p, "alpha_dropout")
     if not training or p == 0.0:
         return x
+    if p == 1.0:  # q == 0 makes the scale formula singular; out is 0
+        return apply(lambda a: jnp.zeros_like(a), x)
     key = next_key()
     alpha = 1.6732632423543772
     scale = 1.0507009873554805

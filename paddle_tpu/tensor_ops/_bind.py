@@ -16,6 +16,12 @@ def _swap(fn):
 # do when the var is a leaf)
 def _make_inplace(fn):
     def inplace(self, *args, **kwargs):
+        from .. import tensor as tensor_mod
+        if tensor_mod._op_recorder is not None:
+            # static recording: inplace APIs degrade to out-of-place
+            # (reference semantics — each recorded op reads the ORIGINAL
+            # var, and fetches of successive x.op_() calls stay distinct)
+            return fn(self, *args, **kwargs)
         out = fn(self, *args, **kwargs)
         self._data = out._data
         self._node = out._node

@@ -58,7 +58,40 @@ def ones(shape, dtype=None, name=None):
     return Tensor(jnp.ones(_shape(shape), dtype=_dt(dtype)))
 
 
+_FULL_DTYPES = ("bool", "float16", "bfloat16", "float32", "float64",
+                "uint8", "uint16", "int16", "int32", "int64",
+                "complex64", "complex128")
+
+
+def _check_shape_entries(op, shape):
+    """Reference fill_constant shape contract: a shape Tensor (or Tensor
+    entries in a shape list) must be int32/int64; an empty static-mode
+    shape is rejected (AssertionError, matching the reference's
+    ``assert len(shape) > 0``)."""
+    entries = [shape] if isinstance(shape, Tensor) else [
+        s for s in (shape if isinstance(shape, (list, tuple)) else [])
+        if isinstance(s, Tensor)]
+    for t in entries:
+        from ..fluid.data_feeder import _dtype_str
+        if _dtype_str(t) not in ("int32", "int64"):
+            raise TypeError(
+                f"{op}: shape Tensor entries must be int32/int64, got "
+                f"{t.dtype}")
+    from .. import tensor as tensor_mod
+    if isinstance(shape, (list, tuple)) and len(shape) == 0 \
+            and tensor_mod._op_recorder is not None:
+        raise AssertionError(
+            f"{op}: the size of shape must not be 0 in static mode")
+
+
 def full(shape, fill_value, dtype=None, name=None):
+    _static_shape_check("full", shape)
+    _check_shape_entries("full", shape)
+    if dtype is not None:
+        from ..fluid.data_feeder import check_dtype
+        check_dtype(dtype_mod.convert_dtype(dtype)
+                    if not isinstance(dtype, str) else dtype,
+                    "dtype", _FULL_DTYPES, "full")
     if isinstance(fill_value, str):
         fill_value = float(fill_value)  # reference accepts "0.5" etc.
     fill_value = raw(fill_value)
@@ -75,15 +108,33 @@ def empty(shape, dtype=None, name=None):
     return zeros(shape, dtype)
 
 
+_LIKE_DTYPES = ("bool", "float16", "bfloat16", "float32", "float64",
+                "uint16", "int16", "int32", "int64")
+
+
+def _check_like_dtype(dtype, op):
+    """Reference zeros_like/full_like dtype whitelist (creation.py
+    check_dtype: int8/uint8 raise TypeError)."""
+    if dtype is None:
+        return
+    from ..fluid.data_feeder import check_dtype
+    check_dtype(dtype if isinstance(dtype, str)
+                else dtype_mod.convert_dtype(dtype),
+                "dtype", _LIKE_DTYPES, op)
+
+
 def zeros_like(x, dtype=None, name=None):
+    _check_like_dtype(dtype, "zeros_like")
     return Tensor(jnp.zeros_like(raw(x), dtype=dtype_mod.convert_dtype(dtype)))
 
 
 def ones_like(x, dtype=None, name=None):
+    _check_like_dtype(dtype, "ones_like")
     return Tensor(jnp.ones_like(raw(x), dtype=dtype_mod.convert_dtype(dtype)))
 
 
 def full_like(x, fill_value, dtype=None, name=None):
+    _check_like_dtype(dtype, "full_like")
     return Tensor(jnp.full_like(raw(x), raw(fill_value),
                                 dtype=dtype_mod.convert_dtype(dtype)))
 

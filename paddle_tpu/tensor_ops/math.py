@@ -181,13 +181,29 @@ def count_nonzero(x, axis=None, keepdim=False, name=None):
 
 
 # -- cumulative ----------------------------------------------------------
+def _name_out(out, name):
+    """Propagate an explicit ``name=`` to the result and register it
+    with the active static Program so fetch-by-name works (reference
+    LayerHelper: unique_name.generate(name) names the output var)."""
+    if name:
+        from ..utils import unique_name
+        out.name = unique_name.generate(name)
+        from .. import tensor as tensor_mod
+        from ..static import program as prog_mod
+        if tensor_mod._op_recorder is not None:
+            # default_main_program() covers both program_guard and the
+            # enable_static()-without-guard recording path
+            prog_mod.default_main_program()._vars[out.name] = out
+    return out
+
+
 def cumsum(x, axis=None, dtype=None, name=None):
     def f(a):
         if axis is None:
             a = a.reshape(-1)
             return jnp.cumsum(a, dtype=dtype)
         return jnp.cumsum(a, axis=axis, dtype=dtype)
-    return apply(f, x)
+    return _name_out(apply(f, x), name)
 
 
 def cumprod(x, dim=None, dtype=None, name=None):
@@ -234,6 +250,20 @@ def cummin(x, axis=None, dtype="int64", name=None):
 
 # -- clip / misc ---------------------------------------------------------
 def clip(x, min=None, max=None, name=None):
+    if isinstance(x, Tensor):
+        # reference tensor/math.py clip: int16/int8 etc. are a TypeError
+        from ..fluid.data_feeder import _dtype_str, check_dtype
+        check_dtype(_dtype_str(x), "x",
+                    ("float16", "bfloat16", "float32", "float64",
+                     "int32", "int64"), "clip")
+    # Tensor min/max thread as real op inputs (reference ClipOp Min/Max
+    # tensor inputs) so static replay substitutes fresh fed values
+    if isinstance(min, Tensor) and isinstance(max, Tensor):
+        return apply(lambda a, mn, mx: jnp.clip(a, mn, mx), x, min, max)
+    if isinstance(min, Tensor):
+        return apply(lambda a, mn: jnp.clip(a, mn, max), x, min)
+    if isinstance(max, Tensor):
+        return apply(lambda a, mx: jnp.clip(a, min, mx), x, max)
     return apply(lambda a: jnp.clip(a, min, max), x)
 
 

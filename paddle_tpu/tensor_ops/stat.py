@@ -56,7 +56,19 @@ def nanquantile(x, q, axis=None, keepdim=False, name=None):
 
 def numel(x, name=None):
     import numpy as np
-    return Tensor(jnp.asarray(int(np.prod(raw(x).shape)) if raw(x).shape else 1))
+    if not isinstance(x, Tensor):
+        # reference tensor/stat.py numel: check_variable_and_dtype —
+        # raw ndarrays/lists are a TypeError, eager and static alike
+        raise TypeError(
+            f"The type of 'x' in numel must be Tensor, but received "
+            f"{type(x)}")
+    n = int(np.prod(raw(x).shape)) if raw(x).shape else 1
+    from .. import tensor as tensor_mod
+    if tensor_mod._op_recorder is not None:
+        # static numel/size op emits shape [1] (2.3-era static graphs
+        # have no 0-d tensors); eager keeps the modern 0-d result
+        return Tensor(jnp.asarray([n]))
+    return Tensor(jnp.asarray(n))
 
 
 def corrcoef(x, rowvar=True, name=None):

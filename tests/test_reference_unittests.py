@@ -62,7 +62,7 @@ TARGETS = {
     "test_arange.py": (0.60, 2),
     "test_zeros_op.py": (0.95, 7),
     "test_ones_op.py": (0.95, 3),
-    "test_clip_op.py": (0.35, 9),
+    "test_clip_op.py": (0.85, 19),
     "test_where_op.py": (0.70, 20),
     "test_concat_op.py": (0.60, 20),
     "test_stack_op.py": (0.60, 8),
@@ -70,18 +70,18 @@ TARGETS = {
     "test_tile_op.py": (0.60, 2),
     "test_flatten_contiguous_range_op.py": (0.75, 15),
     "test_adamax_api.py": (0.95, 4),
-    "test_cumsum_op.py": (0.45, 2),
+    "test_cumsum_op.py": (0.70, 3),
     "test_cross_entropy_loss.py": (0.55, 17),
     "test_split_op.py": (0.50, 6),
-    "test_dropout_op.py": (0.35, 10),
+    "test_dropout_op.py": (0.65, 17),
     "test_expand_v2_op.py": (0.70, 10),
-    "test_zeros_like_op.py": (0.40, 3),
-    "test_ones_like.py": (0.45, 2),
-    "test_full_op.py": (0.30, 1),
-    "test_full_like_op.py": (0.70, 3),
+    "test_zeros_like_op.py": (0.65, 4),
+    "test_ones_like.py": (0.70, 3),
+    "test_full_op.py": (0.60, 2),
+    "test_full_like_op.py": (0.95, 4),
     "test_linspace.py": (0.75, 7),
     "test_isfinite_v2_op.py": (0.95, 6),
-    "test_numel_op.py": (0.30, 1),
+    "test_numel_op.py": (0.95, 3),
     "test_max_op.py": (0.65, 4),
     "test_min_op.py": (0.55, 3),
     "test_diagonal_op.py": (0.95, 10),
