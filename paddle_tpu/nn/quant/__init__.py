@@ -112,12 +112,25 @@ class Int8Linear(Layer):
             if bias else None
 
     @classmethod
-    def from_linear(cls, linear: Linear) -> "Int8Linear":
+    def from_linear(cls, linear: Linear, scale=None) -> "Int8Linear":
         m = cls(linear.in_features, linear.out_features,
                 bias=linear.bias is not None)
-        q, s = quantize_int8(linear.weight, axis=0)  # per out-channel
-        m.qweight._data = q._data
-        m.scale._data = s._data
+        # an explicit scale (or one pinned by AdaRound) must be honored:
+        # recomputing abs-max from an adarounded weight can SHIFT the
+        # grid (a channel max rounded down), silently destroying the
+        # learned rounding for that channel
+        if scale is None:
+            scale = getattr(linear, "_adaround_scale", None)
+        if scale is not None:
+            s = jnp.asarray(scale, jnp.float32).reshape(1, -1)
+            q = jnp.clip(jnp.round(linear.weight._data.astype(jnp.float32)
+                                   / s), -127, 127).astype(jnp.int8)
+            m.qweight._data = q
+            m.scale._data = s
+        else:
+            q, s = quantize_int8(linear.weight, axis=0)  # per out-channel
+            m.qweight._data = q._data
+            m.scale._data = s._data
         if linear.bias is not None:
             m.bias._data = linear.bias._data
         return m
@@ -173,3 +186,4 @@ from .qat import (FakeQuantAbsMax, FakeQuantChannelWiseAbsMax,  # noqa: E402
                   FakeQuantMovingAverageAbsMax, ImperativeQuantAware,
                   PostTrainingQuantization, QuantizedConv2D,
                   QuantizedLinear, fake_quant_dequant)
+from .adaround import adaround_weight, run_adaround  # noqa: E402

@@ -1,0 +1,143 @@
+"""AdaRound: learned up-vs-down weight rounding for PTQ.
+
+Reference: fluid/contrib/slim/quantization/adaround.py:1 (run_adaround —
+per-layer alpha optimization with a rectified-sigmoid soft rounding
+h(alpha) = clip(sigmoid(alpha)(ZETA-GAMMA)+GAMMA, 0, 1), reconstruction
+MSE against the fp layer output, and an annealed regularizer
+reg * sum(1 - |2h-1|^beta) that pushes h to {0,1}; 20% warm start).
+TPU-native: the whole optimization is ONE jitted Adam loop over alpha
+via lax.fori_loop — no per-iteration python, the MXU does the layer
+matmuls — and the learned rounding is baked back into the float weight
+exactly on the int8 grid, so the existing nearest-rounding
+Int8Linear.from_linear reproduces it bit-for-bit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+GAMMA, ZETA = -0.1, 1.1
+
+
+def _soft_rounding(alpha):
+    """Rectified sigmoid h(alpha) in [0, 1] (adaround.py:33)."""
+    return jnp.clip(jax.nn.sigmoid(alpha) * (ZETA - GAMMA) + GAMMA,
+                    0.0, 1.0)
+
+
+def adaround_weight(weight, inputs, scale, bits=8, num_iterations=500,
+                    reg_param=0.01, beta_range=(20.0, 2.0),
+                    warm_start=0.2, lr=1e-2):
+    """Learn per-element rounding for one Linear weight.
+
+    weight: [in, out] float array; inputs: [N, in] calibration rows;
+    scale: [1, out] (or scalar) symmetric int8 grid step. Returns the
+    adarounded weight, whose values sit EXACTLY on the int8 grid.
+    """
+    w = jnp.asarray(weight, jnp.float32)
+    x = jnp.asarray(inputs, jnp.float32)
+    s = jnp.asarray(scale, jnp.float32)
+    qmax = 2.0 ** (bits - 1) - 1
+    wf = w / s
+    floor_w = jnp.floor(wf)
+    frac = jnp.clip(wf - floor_w, 1e-4, 1.0 - 1e-4)
+    # init so h(alpha0) == frac: soft rounding starts at the fp weight
+    alpha0 = -jnp.log((ZETA - GAMMA) / (frac - GAMMA) - 1.0)
+    orig_out = x @ w
+    warm_end = warm_start * num_iterations
+    start_beta, end_beta = beta_range
+
+    def qdq(alpha):
+        return jnp.clip(floor_w + _soft_rounding(alpha), -qmax, qmax) * s
+
+    def loss_fn(alpha, beta, warm):
+        recon = jnp.mean(jnp.sum((x @ qdq(alpha) - orig_out) ** 2, -1))
+        h = _soft_rounding(alpha)
+        reg = reg_param * jnp.sum(1.0 - jnp.abs(2.0 * h - 1.0) ** beta)
+        return recon + jnp.where(warm, 0.0, reg)
+
+    def body(i, carry):
+        alpha, m, v = carry
+        it = i.astype(jnp.float32)
+        warm = it < warm_end
+        rel = jnp.clip((it - warm_end)
+                       / max(num_iterations - warm_end, 1.0), 0.0, 1.0)
+        beta = end_beta + 0.5 * (start_beta - end_beta) * (
+            1.0 + jnp.cos(rel * jnp.pi))  # cosine anneal (adaround.py:82)
+        g = jax.grad(loss_fn)(alpha, beta, warm)
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mh = m / (1.0 - 0.9 ** (it + 1.0))
+        vh = v / (1.0 - 0.999 ** (it + 1.0))
+        return (alpha - lr * mh / (jnp.sqrt(vh) + 1e-8), m, v)
+
+    alpha, _, _ = jax.jit(
+        lambda a0: jax.lax.fori_loop(
+            0, num_iterations, body,
+            (a0, jnp.zeros_like(a0), jnp.zeros_like(a0))))(alpha0)
+    # hard rounding: h >= 0.5 rounds up (alpha's sign decides)
+    rounded = jnp.clip(floor_w + (_soft_rounding(alpha) >= 0.5),
+                       -qmax, qmax) * s
+    return rounded.astype(jnp.asarray(weight).dtype)
+
+
+def run_adaround(data_loader, model, max_batches=8, num_iterations=500,
+                 reg_param=0.01, beta_range=(20.0, 2.0), warm_start=0.2,
+                 lr=1e-2, max_rows=1024):
+    """Apply AdaRound to every Linear in ``model`` (reference
+    adaround.py:201 run_adaround): collect each layer's calibration
+    inputs with forward hooks (capped at ``max_rows`` rows per layer so
+    peak host memory stays bounded), learn its rounding, bake the
+    result into the float weight on the int8 grid, and PIN the grid on
+    the layer (``_adaround_scale``) so Int8Linear.from_linear converts
+    on the same scale the rounding was learned on. Conv2D layers are
+    not adarounded (the reference covers them; here they keep nearest
+    rounding) — a warning is emitted when the model has any."""
+    from ..layer.common import Linear
+    from ..layer.conv import Conv2D
+    from ...tensor import Tensor
+    from .qat import calibration_pass
+
+    captured = {}
+    targets = [(n, l) for n, l
+               in model.named_sublayers(include_self=True)
+               if type(l) is Linear]
+    if any(type(l) is Conv2D
+           for _, l in model.named_sublayers(include_self=True)):
+        import warnings
+        warnings.warn(
+            "run_adaround: Conv2D layers keep nearest rounding "
+            "(AdaRound here optimizes Linear weights only)",
+            stacklevel=2)
+
+    def observe(name):
+        def hook(layer, inputs, output=None):
+            got = sum(r.shape[0] for r in captured.get(name, ()))
+            if got >= max_rows:
+                return
+            xin = inputs[0] if isinstance(inputs, (tuple, list)) \
+                else inputs
+            raw = xin._data if isinstance(xin, Tensor) else jnp.asarray(xin)
+            rows = np.asarray(raw, np.float32).reshape(-1, raw.shape[-1])
+            captured.setdefault(name, []).append(rows[:max_rows - got])
+        return hook
+
+    calibration_pass(model, data_loader,
+                     [(layer, observe(name)) for name, layer in targets],
+                     max_batches=max_batches)
+
+    from . import quantize_int8
+    for name, layer in targets:
+        rows = captured.pop(name, None)
+        if not rows:
+            continue
+        x = np.concatenate(rows, axis=0)
+        _, s = quantize_int8(layer.weight._data, axis=0)  # [1, out]
+        s = s._data if hasattr(s, "_data") else s
+        layer.weight._data = adaround_weight(
+            layer.weight._data, x, s,
+            num_iterations=num_iterations, reg_param=reg_param,
+            beta_range=beta_range, warm_start=warm_start, lr=lr)
+        layer._adaround_scale = np.asarray(s)  # pin the learned grid
+    return model

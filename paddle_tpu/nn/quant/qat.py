@@ -244,6 +244,26 @@ class ImperativeQuantAware:
         return model
 
 
+def calibration_pass(model, data_loader, hook_factories, max_batches=None):
+    """Shared calibration scaffolding (PTQ observers AND AdaRound input
+    capture use this): register the given forward-pre-hook factories,
+    feed up to ``max_batches`` batches through the eval-mode model,
+    remove the hooks. ``hook_factories``: [(layer, factory())]."""
+    hooks = [layer.register_forward_pre_hook(factory)
+             for layer, factory in hook_factories]
+    model.eval()
+    try:
+        for i, batch in enumerate(data_loader):
+            if max_batches is not None and i >= max_batches:
+                break
+            args = batch if isinstance(batch, (tuple, list)) else (batch,)
+            model(*[a if isinstance(a, Tensor)
+                    else Tensor(jnp.asarray(a)) for a in args])
+    finally:
+        for h in hooks:
+            h.remove()
+
+
 class PostTrainingQuantization:
     """Calibration-based PTQ (reference slim post_training_quantization.py
     with algo abs_max / avg): feed calibration batches through the fp
@@ -251,11 +271,14 @@ class PostTrainingQuantization:
     the int8-weight inference model."""
 
     def __init__(self, model: Layer, algo="abs_max", weight_bits=8,
-                 activation_bits=8):
+                 activation_bits=8, round_type="round"):
         if algo not in ("abs_max", "avg"):
             raise ValueError(f"unsupported algo {algo!r}")
+        if round_type not in ("round", "adaround"):
+            raise ValueError(f"unsupported round_type {round_type!r}")
         self.model = model
         self.algo = algo
+        self.round_type = round_type
         self._bits = activation_bits
         self._weight_bits = weight_bits
         self._act_ranges = {}
@@ -277,21 +300,29 @@ class PostTrainingQuantization:
 
     def quantize(self, data_loader, max_batches=None):
         """Run calibration then convert; returns the inference model."""
+        if self.round_type == "adaround":
+            # learn the weight rounding FIRST (reference slim
+            # post_training_quantization round_type='adaround' →
+            # adaround.py run_adaround), baked onto the int8 grid so
+            # the conversion below reproduces it on the SAME scale.
+            # Materialize the batches: the loader may be a one-shot
+            # generator and both passes must see the same data.
+            from .adaround import run_adaround
+            cap = max_batches if max_batches is not None else 8
+            batches = []
+            for i, b in enumerate(data_loader):
+                if i >= cap:
+                    break
+                batches.append(b)
+            run_adaround(batches, self.model, max_batches=cap)
+            data_loader = batches
+            max_batches = cap
         targets = [(n, l) for n, l in self.model.named_sublayers()
                    if type(l) in (Linear, Conv2D)]
-        for name, layer in targets:
-            self._hooks.append(
-                layer.register_forward_pre_hook(self._observe(name)))
-        self.model.eval()
-        for i, batch in enumerate(data_loader):
-            if max_batches is not None and i >= max_batches:
-                break
-            args = batch if isinstance(batch, (tuple, list)) else (batch,)
-            self.model(*[a if isinstance(a, Tensor) else Tensor(jnp.asarray(a))
-                         for a in args])
-        for h in self._hooks:
-            h.remove()
-        self._hooks = []
+        calibration_pass(
+            self.model, data_loader,
+            [(layer, self._observe(name)) for name, layer in targets],
+            max_batches=max_batches)
 
         from . import Int8Linear
         for pname, sub in list(self.model.named_sublayers(include_self=True)):

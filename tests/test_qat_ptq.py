@@ -198,3 +198,74 @@ def test_ptq_avg_algo_and_conv():
     assert (np.abs(w_after - w_before) <= s / 2 + 1e-7).all()
     y = q(paddle.to_tensor(calib[0]))
     assert np.isfinite(np.asarray(y._data)).all()
+
+
+def test_adaround_beats_nearest_rounding():
+    """AdaRound (reference slim/quantization/adaround.py): the learned
+    rounding must give LOWER layer-output reconstruction error than
+    round-to-nearest on the same int8 grid, and land exactly on grid."""
+    import jax.numpy as jnp
+    from paddle_tpu.nn.quant import quantize_int8
+    from paddle_tpu.nn.quant.adaround import adaround_weight
+
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(32, 16)).astype(np.float32)
+    x = rng.normal(size=(256, 32)).astype(np.float32)
+    _, s = quantize_int8(jnp.asarray(w), axis=0)
+    s = np.asarray(s._data if hasattr(s, "_data") else s)
+
+    nearest = np.clip(np.round(w / s), -127, 127) * s
+    ada = np.asarray(adaround_weight(w, x, s, num_iterations=400))
+
+    # exactly on the int8 grid (so Int8Linear.from_linear reproduces it)
+    ints = ada / s
+    assert np.abs(ints - np.round(ints)).max() < 1e-4
+    assert np.abs(np.round(ints)).max() <= 127
+
+    err_nearest = float(np.mean((x @ nearest - x @ w) ** 2))
+    err_ada = float(np.mean((x @ ada - x @ w) ** 2))
+    assert err_ada < err_nearest, (err_ada, err_nearest)
+    # rounding moved at least one weight off nearest
+    assert (np.round(ada / s) != np.round(w / s)).any()
+
+
+def test_ptq_round_type_adaround_end_to_end():
+    """PostTrainingQuantization(round_type='adaround') chains the
+    learned rounding into the Int8Linear conversion."""
+    from paddle_tpu.nn.quant import Int8Linear, PostTrainingQuantization
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    rng = np.random.default_rng(1)
+    calib = [paddle.to_tensor(rng.normal(size=(8, 16)).astype(np.float32))
+             for _ in range(4)]
+    ref_out = model(calib[0]).numpy()
+    ptq = PostTrainingQuantization(model, round_type="adaround")
+    q = ptq.quantize(calib, max_batches=4)
+    assert any(isinstance(m, Int8Linear) for m in q.sublayers())
+    out = q(calib[0]).numpy()
+    # int8 model stays close to fp reference on calibration data
+    assert np.isfinite(out).all()
+    rel = np.abs(out - ref_out).mean() / (np.abs(ref_out).mean() + 1e-6)
+    assert rel < 0.25, rel
+
+
+def test_adaround_scale_pinned_through_from_linear():
+    """from_linear must convert on the SAME grid the rounding was
+    learned on (a recomputed abs-max scale could shift if a channel max
+    rounded down) — the dequantized int8 weight reproduces the
+    adarounded float weight exactly."""
+    from paddle_tpu.nn.quant import Int8Linear, run_adaround
+
+    paddle.seed(0)
+    lin = nn.Linear(16, 8)
+    rng = np.random.default_rng(2)
+    calib = [paddle.to_tensor(rng.normal(size=(32, 16)).astype(np.float32))]
+    run_adaround(calib, lin, num_iterations=200)
+    assert hasattr(lin, "_adaround_scale")
+    q = Int8Linear.from_linear(lin)
+    np.testing.assert_allclose(np.asarray(q.scale._data).ravel(),
+                               np.asarray(lin._adaround_scale).ravel())
+    deq = np.asarray(q.qweight._data, np.float32) * np.asarray(q.scale._data)
+    np.testing.assert_allclose(deq, np.asarray(lin.weight._data),
+                               atol=1e-6)
