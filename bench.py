@@ -109,40 +109,59 @@ def bench_llama(backend):
 
 
 def bench_resnet50(backend):
+    """Batch-size sweep on TPU: bs 64 leaves the MXU underfed on v5e
+    (round-4 measured ≈20% MFU); larger batches amortize BN/elementwise
+    HBM traffic over more conv FLOPs. Reports the best config plus the
+    whole sweep so BENCH records the before/after."""
     import paddle_tpu
     from paddle_tpu import optimizer as optim
     from paddle_tpu.distributed import fleet
     from paddle_tpu.vision.models import resnet50, resnet18
 
-    paddle_tpu.seed(0)
-    if backend == "tpu":
-        model_fn, batch, size, n_steps = resnet50, 64, 224, 6
-    else:
-        model_fn, batch, size, n_steps = resnet18, 2, 32, 1
-    model = fleet.distributed_model(model_fn(num_classes=1000))
-    if backend == "tpu":
-        model.to(dtype="bfloat16")
-    opt = fleet.distributed_optimizer(
-        optim.Momentum(learning_rate=0.1, momentum=0.9,
-                       parameters=model.parameters()))
+    def run_one(model_fn, batch, size, n_steps):
+        paddle_tpu.seed(0)
+        model = fleet.distributed_model(model_fn(num_classes=1000))
+        if backend == "tpu":
+            model.to(dtype="bfloat16")
+        opt = fleet.distributed_optimizer(
+            optim.Momentum(learning_rate=0.1, momentum=0.9,
+                           parameters=model.parameters()))
 
-    def loss_fn(m, x, y):
-        logits = m(x)
-        from paddle_tpu.nn import functional as F
-        return F.cross_entropy(logits.astype("float32"), y)
+        def loss_fn(m, x, y):
+            logits = m(x)
+            from paddle_tpu.nn import functional as F
+            return F.cross_entropy(logits.astype("float32"), y)
 
-    step = opt.make_train_step(model, loss_fn)
-    rng = np.random.default_rng(0)
-    dtype = np.float32
-    x = paddle_tpu.to_tensor(
-        rng.standard_normal((batch, 3, size, size)).astype(dtype))
-    if backend == "tpu":
-        x = x.astype("bfloat16")
-    y = paddle_tpu.to_tensor(
-        rng.integers(0, 1000, (batch,)).astype(np.int64))
-    dt, _ = _timed_steps(lambda: step(x, y), n_steps)
-    return {"images_per_sec": round(batch * n_steps / dt, 1),
-            "ms_per_step": round(dt / n_steps * 1000, 1), "batch": batch}
+        step = opt.make_train_step(model, loss_fn)
+        rng = np.random.default_rng(0)
+        x = paddle_tpu.to_tensor(
+            rng.standard_normal((batch, 3, size, size)).astype(np.float32))
+        if backend == "tpu":
+            x = x.astype("bfloat16")
+        y = paddle_tpu.to_tensor(
+            rng.integers(0, 1000, (batch,)).astype(np.int64))
+        dt, _ = _timed_steps(lambda: step(x, y), n_steps)
+        return {"images_per_sec": round(batch * n_steps / dt, 1),
+                "ms_per_step": round(dt / n_steps * 1000, 1),
+                "batch": batch}
+
+    if backend != "tpu":
+        return run_one(resnet18, 2, 32, 1)
+    sweep = {}
+    best = None
+    for batch in (64, 128, 256):
+        try:
+            r = run_one(resnet50, batch, 224, 6)
+        except Exception as e:  # e.g. HBM OOM at the largest batch
+            sweep[f"bs{batch}"] = f"FAIL: {type(e).__name__}"
+            continue
+        sweep[f"bs{batch}"] = r["images_per_sec"]
+        if best is None or r["images_per_sec"] > best["images_per_sec"]:
+            best = r
+    if best is None:
+        raise RuntimeError(f"all resnet50 configs failed: {sweep}")
+    best["sweep"] = sweep
+    return best
 
 
 def bench_bert(backend):

@@ -2,6 +2,19 @@
 dtype)` feed placeholder (no implicit batch dim, unlike
 fluid.layers.data). Backed by the record/replay executor's placeholder
 (static/program.py::data)."""
-from ..static.program import data
+from ..static.program import data as _static_data
 
 __all__ = ["data"]
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    # 1.x fluid IS graph mode: a fluid.data placeholder means the
+    # caller is building a Program even without an explicit
+    # enable_static() (reference scripts routinely omit it) — switch
+    # recording on so downstream fluid.layers calls are captured and
+    # fetch-by-name works
+    from .. import tensor as tensor_mod
+    if tensor_mod._op_recorder is None:
+        import paddle_tpu
+        paddle_tpu.enable_static()
+    return _static_data(name, shape, dtype, lod_level)

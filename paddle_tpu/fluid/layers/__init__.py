@@ -440,7 +440,11 @@ def concat(x=None, axis=0, name=None, input=None):
     return _T.concat(x if x is not None else input, axis=axis, name=name)
 stack = _T.stack
 unstack = _T.unstack
-split = _T.split
+def split(input, num_or_sections, dim=None, axis=None, name=None):
+    """fluid spelling: the axis argument is ``dim`` (2.x code passes
+    ``axis``; both accepted — fluid/layers/nn.py:split)."""
+    ax = axis if axis is not None else (dim if dim is not None else -1)
+    return _T.split(input, num_or_sections, axis=ax, name=name)
 transpose = _T.transpose
 unique = _T.unique
 shard_index = _T.shard_index if hasattr(_T, "shard_index") else None

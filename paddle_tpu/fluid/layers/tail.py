@@ -42,6 +42,8 @@ __all__ = [
     'center_loss', 'teacher_student_sigmoid_loss', 'hash',
     'bipartite_match', 'density_prior_box', 'detection_output',
     'sampled_softmax_with_cross_entropy',
+    # CRF sequence labeling
+    'linear_chain_crf', 'crf_decoding',
 ]
 
 
@@ -988,3 +990,181 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
     return (mk(precision, jnp.float32), mk(recall, jnp.float32),
             mk(f1, jnp.float32), mk(num_infer, jnp.int32),
             mk(num_label, jnp.int32), mk(num_correct, jnp.int32))
+
+
+# -- linear-chain CRF -------------------------------------------------------
+#
+# Reference: fluid/layers/nn.py linear_chain_crf / crf_decoding over the
+# C++ LinearChainCRFOp + CRFDecodingOp. The shared 'crfw' parameter is
+# [num_tags + 2, num_tags]: row 0 start scores, row 1 stop scores, rows
+# 2.. the tag->tag transition matrix. TPU-native: the forward algorithm
+# is a lax.scan of logsumexp steps (padded-dense with length masks
+# instead of LoD), fully differentiable; decoding reuses the in-tree
+# viterbi_decode scan.
+
+def _crf_param(param_attr, num_tags, dtype):
+    """Create-or-share the transition parameter by name (two calls with
+    ParamAttr(name='crfw') must see the SAME parameter, like the
+    reference LayerHelper does)."""
+    from ...static import program as _prog_mod
+    from ...static.program import create_parameter
+
+    name = getattr(param_attr, "name", None) if param_attr is not None \
+        else None
+    if name:
+        prog = _prog_mod.default_main_program()
+        existing = prog._vars.get(name)
+        if existing is not None:
+            return existing
+    return create_parameter((num_tags + 2, num_tags), dtype,
+                            name=name, attr=param_attr)
+
+
+def _crf_shapes(emission, label=None, length=None):
+    """Normalize LoD-style 2D [T, D] / padded 3D [N, T, D] emissions to
+    [N, T, D] (+ labels [N, T], lengths [N])."""
+    import jax.numpy as jnp
+    from ...tensor import Tensor
+
+    e = emission._data if isinstance(emission, Tensor) else jnp.asarray(
+        emission)
+    if e.ndim == 2:
+        e = e[None]
+    lab = None
+    if label is not None:
+        lab = label._data if isinstance(label, Tensor) \
+            else jnp.asarray(label)
+        lab = lab.reshape(lab.shape[0], -1) if lab.ndim == 2 \
+            else lab.reshape(lab.shape[0], lab.shape[1])
+        if lab.shape[0] != e.shape[0]:  # LoD style [T, 1] → [1, T]
+            lab = lab.reshape(1, -1)
+    if length is not None:
+        ln = length._data if isinstance(length, Tensor) \
+            else jnp.asarray(length)
+    else:
+        ln = jnp.full((e.shape[0],), e.shape[1], jnp.int32)
+    return e, lab, ln
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """Negative log-likelihood of the labeled path under a linear-chain
+    CRF (reference fluid/layers/nn.py:1646). Returns [N, 1]. Label and
+    length thread as real op inputs so static replay sees fresh feeds."""
+    import jax
+    import jax.numpy as jnp
+    from ...tensor import Tensor, apply
+
+    num_tags = int(input.shape[-1])
+    w = _crf_param(param_attr, num_tags, "float32")
+    e_raw, _, _ = _crf_shapes(input, label, length)
+    n_seq, t_len = e_raw.shape[0], e_raw.shape[1]
+
+    def nll(e, w, lab, ln):
+        lab = lab.reshape(n_seq, t_len)
+        ln = jnp.asarray(ln).reshape(n_seq).astype(jnp.int32)
+        e = e.astype(jnp.float32)
+        start, stop, trans = w[0], w[1], w[2:]
+        T = e.shape[1]
+        t_idx = jnp.arange(T)
+        mask = (t_idx[None, :] < ln[:, None]).astype(jnp.float32)  # [N,T]
+        lab_i = lab.astype(jnp.int32)
+
+        # path score
+        emit = jnp.take_along_axis(e, lab_i[..., None], -1)[..., 0]
+        score = (emit * mask).sum(-1)
+        score = score + start[lab_i[:, 0]]
+        pair = trans[lab_i[:, :-1], lab_i[:, 1:]]          # [N, T-1]
+        score = score + (pair * mask[:, 1:]).sum(-1)
+        last = jnp.maximum(ln - 1, 0)
+        last_tag = jnp.take_along_axis(lab_i, last[:, None], 1)[:, 0]
+        score = score + stop[last_tag]
+
+        # log partition via forward algorithm
+        alpha0 = start[None, :] + e[:, 0]                   # [N, D]
+
+        def step(alpha, inputs):
+            e_t, m_t = inputs                               # [N,D], [N]
+            nxt = jax.nn.logsumexp(
+                alpha[:, :, None] + trans[None], axis=1) + e_t
+            return jnp.where(m_t[:, None] > 0, nxt, alpha), None
+
+        alpha, _ = jax.lax.scan(
+            step, alpha0,
+            (jnp.swapaxes(e[:, 1:], 0, 1),
+             jnp.swapaxes(mask[:, 1:], 0, 1)))
+        logz = jax.nn.logsumexp(alpha + stop[None, :], axis=-1)
+        return (logz - score)[:, None]
+
+    e3 = _as3d(input) if isinstance(input, Tensor) else Tensor(e_raw)
+    lab_t = label if isinstance(label, Tensor) else Tensor(
+        _crf_shapes(input, label, None)[1])
+    if isinstance(length, Tensor):
+        return apply(nll, e3, w, lab_t, length)
+    ln_const = _crf_shapes(input, None, length)[2]
+    return apply(lambda e, ww, lb: nll(e, ww, lb, ln_const),
+                 e3, w, lab_t)
+
+
+def _as3d(t):
+    from ...tensor import Tensor
+    from ... import tensor_ops as _ops
+    if t._data.ndim == 2:
+        return _ops.reshape(t, (1,) + tuple(t._data.shape))
+    return t
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None):
+    """Viterbi-decode the best tag path under the shared 'crfw'
+    parameter (reference fluid/layers/nn.py:1755 crf_decoding). Returns
+    int64 tags shaped like the input's sequence layout ([T, 1] for
+    LoD-style 2D input, else [N, T]); with ``label`` given, returns 0/1
+    correctness indicators shaped like label (crf_decoding_op.cc
+    semantics). A real recorded op: static replay decodes fresh feeds
+    and the trained crfw, never a record-time constant."""
+    import jax.numpy as jnp
+    from ...tensor import Tensor
+    from ...text.viterbi_decode import _viterbi
+
+    num_tags = int(input.shape[-1])
+    w = _crf_param(param_attr, num_tags, "float32")
+    e0, _, _ = _crf_shapes(input, None, length)
+    n_seq, t_len = e0.shape[0], e0.shape[1]
+    was_2d = (input._data if isinstance(input, Tensor)
+              else jnp.asarray(input)).ndim == 2
+
+    def dec(e, w, *rest):
+        e = e.reshape(n_seq, t_len, num_tags).astype(jnp.float32)
+        i = 0
+        if isinstance(length, Tensor):
+            ln = rest[i].reshape(n_seq).astype(jnp.int32)
+            i += 1
+        else:
+            ln = _crf_shapes_len
+        lab = rest[i].reshape(n_seq, t_len) if label is not None else None
+        start, stop, trans = w[0], w[1], w[2:]
+        # fold start scores into t=0 and stop scores into each row's
+        # last valid step, then run the plain viterbi scan
+        pot = e.at[:, 0].add(start[None, :])
+        last = jnp.maximum(ln - 1, 0)
+        onehot_last = (jnp.arange(t_len)[None, :] == last[:, None])
+        pot = pot + onehot_last[..., None] * stop[None, None, :]
+        _, path = _viterbi(pot, trans, ln, False)
+        path = path.astype(jnp.int64)
+        if lab is not None:  # 0/1 correctness mask, label-shaped
+            return (path == lab.astype(path.dtype)).astype(jnp.int64) \
+                .reshape(-1, 1) if was_2d else \
+                (path == lab.astype(path.dtype)).astype(jnp.int64)
+        return path.reshape(-1, 1) if was_2d else path
+
+    from ...tensor import apply
+    _crf_shapes_len = _crf_shapes(input, None, length)[2]
+    e3 = _as3d(input) if isinstance(input, Tensor) else Tensor(e0)
+    args = [e3, w]
+    if isinstance(length, Tensor):
+        args.append(length)
+    if label is not None:
+        args.append(label if isinstance(label, Tensor) else Tensor(
+            jnp.asarray(label)))
+    out = apply(dec, *args)
+    out.stop_gradient = True  # argmax decode has no useful gradient
+    return out

@@ -28,10 +28,14 @@ in_dynamic_mode = _non_static_mode
 
 
 def __getattr__(name):
-    # paddle.framework.core is the fluid.core alias surface
-    # (reference framework/__init__.py re-exports core)
+    # paddle.framework.core is the fluid.core alias surface, and
+    # ParamAttr is re-exported (reference framework/__init__.py)
     if name == "core":
         from ..fluid import core
 
         return core
+    if name == "ParamAttr":
+        from ..nn.layer_base import ParamAttr
+
+        return ParamAttr
     raise AttributeError(f"module 'paddle.framework' has no {name!r}")

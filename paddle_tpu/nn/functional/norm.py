@@ -36,14 +36,18 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         return (0,) + tuple(range(2, a.ndim))
 
     def batch_stats(a):
+        # stats in fp32 regardless of activation dtype (bf16 means over
+        # 100k+ elements lose mantissa); the casts fuse into the conv
+        # epilogue, same as layer_norm below
         ax = stats_axes(a)
-        m = jnp.mean(a, axis=ax)
+        a32 = a.astype(jnp.float32)
+        m = jnp.mean(a32, axis=ax)
         if axis_name is not None:
             m = jax.lax.pmean(m, axis_name)
             v = jax.lax.pmean(
-                jnp.mean(jnp.square(a), axis=ax), axis_name) - m * m
+                jnp.mean(jnp.square(a32), axis=ax), axis_name) - m * m
         else:
-            v = jnp.var(a, axis=ax)
+            v = jnp.var(a32, axis=ax)
         return m, v
 
     def ch_shape(a, c):
@@ -76,14 +80,16 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             m, v = mr, vr
         c = m.size
         shp = ch_shape(a, c)
-        out = (a - m.reshape(shp)) * jax.lax.rsqrt(v.reshape(shp) + epsilon)
+        m32 = m.astype(jnp.float32).reshape(shp)
+        v32 = v.astype(jnp.float32).reshape(shp)
+        out = (a.astype(jnp.float32) - m32) * jax.lax.rsqrt(v32 + epsilon)
         i = 0
         if weight is not None:
-            out = out * wb[i].reshape(shp)
+            out = out * wb[i].astype(jnp.float32).reshape(shp)
             i += 1
         if bias is not None:
-            out = out + wb[i].reshape(shp)
-        return out
+            out = out + wb[i].astype(jnp.float32).reshape(shp)
+        return out.astype(a.dtype)
 
     args = (x, running_mean, running_var) + tuple(
         t for t in (weight, bias) if t is not None)

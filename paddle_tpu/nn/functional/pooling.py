@@ -13,6 +13,27 @@ from ...tensor import apply
 from .conv import _norm_tuple
 
 
+def _pool_padding(padding, n, channel_last):
+    """All the reference pool padding spellings → [(lo, hi)] * n:
+    int, [p]*n, per-edge [h0, h1, w0, w1], pair-per-dim [[h0, h1], ...],
+    and the full-rank form [[0,0],[0,0],[h0,h1],[w0,w1]]."""
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding),) * 2] * n
+    padding = list(padding)
+    if padding and isinstance(padding[0], (list, tuple)):
+        if len(padding) == n + 2:  # full-rank incl. batch/channel dims
+            spatial = padding[1:-1] if channel_last else padding[2:]
+            return [(int(p[0]), int(p[1])) for p in spatial]
+        if len(padding) == n:
+            return [(int(p[0]), int(p[1])) for p in padding]
+        raise ValueError(f"bad pool padding {padding!r}")
+    if len(padding) == 2 * n:  # per-edge flat form
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(n)]
+    pd = _norm_tuple(padding, n)
+    return [(int(p),) * 2 for p in pd]
+
+
 def _pool_nd(x, n, kernel, stride, padding, kind, ceil_mode=False,
              exclusive=True, data_format="NCHW", count_include_pad=None):
     channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
@@ -21,8 +42,7 @@ def _pool_nd(x, n, kernel, stride, padding, kind, ceil_mode=False,
     if isinstance(padding, str):
         pad = padding.upper()
     else:
-        pd = _norm_tuple(padding, n)
-        pad = [(p, p) for p in pd]
+        pad = _pool_padding(padding, n, channel_last)
     if count_include_pad is not None:
         exclusive = not count_include_pad
 

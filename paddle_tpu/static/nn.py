@@ -534,31 +534,22 @@ def nce(input, label, num_total_classes, sample_weight=None,
 
 def crf_decoding(input, param_attr, length=None, label=None, name=None):
     """Viterbi decode with start/stop-augmented transitions (reference
-    fluid/layers/nn.py crf_decoding): `param_attr` is the learned
-    [N+2, N] transition parameter (rows 0/1 = start/stop scores)."""
-    import jax.numpy as jnp
+    fluid/layers/nn.py crf_decoding): `param_attr` is either a
+    ParamAttr naming the shared [N+2, N] 'crfw' parameter (the
+    reference docstring idiom) or the parameter Tensor itself. Delegates
+    to the single CRF implementation in fluid.layers."""
+    from ..fluid.layers.tail import crf_decoding as _crf_dec
+    from ..tensor import Tensor
 
-    from ..tensor import Tensor, apply
-    from ..text.viterbi_decode import viterbi_decode
-
-    trans = param_attr  # a Tensor parameter in this stack
-    start = apply(lambda t: t[0], trans)
-    stop = apply(lambda t: t[1], trans)
-    body = apply(lambda t: t[2:], trans)
-    if length is None:
-        length = Tensor(jnp.full((int(input.shape[0]),),
-                                 int(input.shape[1]), jnp.int32))
-
-    # start scores at t=0, stop scores at each sequence's LAST VALID
-    # step (not the padded tail)
-    def add_boundary(em, st, sp, ln):
-        em = em.at[:, 0, :].add(st)
-        last = jnp.maximum(ln.reshape(-1).astype(jnp.int32) - 1, 0)
-        return em.at[jnp.arange(em.shape[0]), last, :].add(sp)
-    em = apply(add_boundary, input, start, stop, length)
-    _, path = viterbi_decode(em, body, length,
-                             include_bos_eos_tag=False)
-    return path
+    if isinstance(param_attr, Tensor):
+        # parameter passed directly: register it under a private attr so
+        # the shared implementation's create-or-share lookup finds it
+        class _Attr:
+            name = getattr(param_attr, "name", None) or "_crfw_direct"
+        from . import program as _prog_mod
+        _prog_mod.default_main_program()._vars[_Attr.name] = param_attr
+        return _crf_dec(input, _Attr, label=label, length=length)
+    return _crf_dec(input, param_attr, label=label, length=length)
 
 
 def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,

@@ -34,6 +34,7 @@ class Program:
         self._ops = []          # ("op", fn, args, kwargs, outs) | ("thunk", f)
         self._feed_vars = {}    # name -> placeholder Tensor
         self._vars = {}         # name -> Tensor (parameters/globals/fetch)
+        self._tmp_vars = {}     # auto-named op outputs (fetch-by-name)
         self.random_seed = None
         self._jit_cache = {}    # (n_ops, feed_sig, fetch_key) -> callable|None
 
@@ -48,6 +49,7 @@ class Program:
         d = dict(self.__dict__)
         d["_ops"] = []
         d["_jit_cache"] = {}
+        d["_tmp_vars"] = {}  # op outputs carry autograd-node closures
         # normalize_program's fetch Tensors carry autograd-node closures
         d.pop("_normalized", None)
         return d
@@ -55,11 +57,24 @@ class Program:
     def __setstate__(self, d):
         self.__dict__.update(d)
         self.__dict__.setdefault("_jit_cache", {})
+        self.__dict__.setdefault("_tmp_vars", {})
 
     # -- recording ---------------------------------------------------------
     def _recorder(self, fn, args, kwargs, outs):
         outs_t = outs if isinstance(outs, tuple) else (outs,)
         self._ops.append(("op", fn, args, kwargs, outs_t))
+        # every op output gets a fetchable name (reference LayerHelper
+        # names every out var): exe.run(fetch_list=[z.name]) is the
+        # canonical 1.x idiom. Generated names live in _tmp_vars so
+        # state_dict/save stay persistable-only.
+        from ..utils import unique_name
+        for o in outs_t:
+            if not isinstance(o, Tensor):
+                continue
+            if getattr(o, "name", None) is None:
+                o.name = unique_name.generate("tmp")
+            if o.name not in self._vars:
+                self._tmp_vars[o.name] = o
 
     def _append_thunk(self, thunk):
         self._ops.append(("thunk", thunk))
@@ -122,7 +137,28 @@ class Program:
             return self._vars[name]
         if name in self._feed_vars:
             return self._feed_vars[name]
+        if name in self._tmp_vars:
+            return self._tmp_vars[name]
         raise KeyError(name)
+
+    def create_var(self, name=None, shape=None, dtype="float32",
+                   persistable=False, **kwargs):
+        """Reference Block.create_var: declare a variable in the block.
+        Dynamic dims (-1/None) materialize as 1, like data()."""
+        dims = tuple(1 if (s is None or s < 0) else int(s)
+                     for s in (shape or (1,)))
+        with _no_record():
+            t = Tensor(jnp.zeros(dims,
+                                 dtype=dtype_mod.convert_dtype(dtype)),
+                       name=name)
+        t.persistable = persistable
+        key = name or f"var_{len(self._vars)}"
+        t.name = key
+        self._vars[key] = t
+        return t
+
+    def current_block(self):
+        return self
 
     def clone(self, for_test=False):
         return self  # replay is stateless modulo parameters

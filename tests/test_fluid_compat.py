@@ -250,3 +250,43 @@ def test_lr_decay_objects_feed_optimizers():
         loss = layers.reduce_mean(net(x))
         loss.backward()
         opt.minimize(loss)
+
+
+def test_linear_chain_crf_matches_brute_force():
+    """linear_chain_crf NLL and crf_decoding (fluid/layers/nn.py:1646,
+    1755) against exhaustive path enumeration on a tiny CRF, with
+    per-sequence lengths and the shared 'crfw' parameter."""
+    import itertools
+
+    np.random.seed(0)
+    N, T, D = 2, 4, 3
+    e = paddle.to_tensor(np.random.randn(N, T, D).astype("float32"))
+    lab = paddle.to_tensor(
+        np.random.randint(0, D, (N, T, 1)).astype("int64"))
+    ln = paddle.to_tensor(np.array([4, 3], "int64"))
+    cost = fluid.layers.linear_chain_crf(
+        e, lab, param_attr=fluid.ParamAttr(name="crfw_ut"), length=ln)
+    dec = fluid.layers.crf_decoding(
+        e, param_attr=fluid.ParamAttr(name="crfw_ut"), length=ln)
+    w = np.asarray(
+        paddle.static.default_main_program()._vars["crfw_ut"]._data)
+    en = np.asarray(e._data)
+    labn = np.asarray(lab._data).reshape(N, T)
+    for i, L in enumerate([4, 3]):
+        def pscore(path):
+            s = w[0][path[0]] + sum(en[i, t, path[t]] for t in range(L)) \
+                + w[1][path[L - 1]]
+            return s + sum(w[2 + path[t]][path[t + 1]]
+                           for t in range(L - 1))
+        paths = list(itertools.product(range(D), repeat=L))
+        z = np.log(sum(np.exp(pscore(p)) for p in paths))
+        want = z - pscore(tuple(labn[i, :L]))
+        np.testing.assert_allclose(
+            float(np.asarray(cost._data)[i, 0]), want, rtol=1e-4)
+        best = max(paths, key=pscore)
+        assert list(np.asarray(dec._data)[i][:L]) == list(best)
+    # gradient flows into emissions and the transition parameter
+    cost.sum().backward()
+    crfw = paddle.static.default_main_program()._vars["crfw_ut"]
+    assert crfw.grad is not None
+    assert np.any(np.asarray(crfw.grad._data) != 0)

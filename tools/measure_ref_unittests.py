@@ -19,54 +19,91 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.path.join(%(root)r, "tests"))
+import warnings
+warnings.filterwarnings("ignore")
 from test_reference_unittests import run_reference_test_file
-r = run_reference_test_file(%(relpath)r)
-out = {
-    "run": r.testsRun, "skip": len(r.skipped),
-    "fail": len(r.failures), "err": len(r.errors),
-    "failing": [t.id().split(".", 1)[1] for t, _ in r.failures + r.errors],
-    "skip_reasons": sorted({m[:60] for _, m in r.skipped}),
-}
-print("RESULT " + json.dumps(out))
+for relpath in %(relpaths)r:
+    try:
+        r = run_reference_test_file(relpath)
+        out = {
+            "run": r.testsRun, "skip": len(r.skipped),
+            "fail": len(r.failures), "err": len(r.errors),
+            "failing": [t.id().split(".", 1)[1]
+                        for t, _ in r.failures + r.errors],
+            "skip_reasons": sorted({m[:60] for _, m in r.skipped}),
+        }
+    except BaseException as e:
+        out = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+    print("RESULT " + json.dumps({"file": relpath, **out}), flush=True)
 """
 
 
-def measure(relpath, timeout=600):
-    code = CHILD % {"root": ROOT, "relpath": relpath}
+def measure_batch(relpaths,
+                  timeout=float(os.environ.get("PADDLE_TPU_MEASURE_TIMEOUT",
+                                               "600"))):
+    """One subprocess measures a CHUNK of files (the ~20s jax import is
+    paid once per chunk, not per file). State can leak between files in
+    a chunk — fine for floor scouting; final floors re-verify through
+    the real per-file harness."""
+    code = CHILD % {"root": ROOT, "relpaths": list(relpaths)}
     env = dict(os.environ, PYTHONPATH=ROOT)
+    err_tail = ""
     try:
         p = subprocess.run([sys.executable, "-c", code], cwd=ROOT, env=env,
-                           capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired:
-        return {"error": f"timeout {timeout}s"}
-    for line in p.stdout.splitlines():
+                           capture_output=True, text=True,
+                           timeout=timeout * max(1, len(relpaths)))
+        txt = p.stdout
+        err_tail = (p.stderr or "")[-300:]
+    except subprocess.TimeoutExpired as e:
+        txt = (e.stdout or b"").decode() if isinstance(
+            e.stdout, bytes) else (e.stdout or "")
+        err_tail = "chunk timeout"
+    results = {}
+    for line in txt.splitlines():
         if line.startswith("RESULT "):
-            return json.loads(line[len("RESULT "):])
-    return {"error": (p.stderr or p.stdout)[-400:]}
+            d = json.loads(line[len("RESULT "):])
+            results[d.pop("file")] = d
+    for rp in relpaths:
+        # keep the child's stderr tail so import crashes are debuggable
+        results.setdefault(rp, {"error": "no result (crash/timeout in "
+                                         f"chunk): {err_tail}"})
+    return results
+
+
+def measure(relpath, timeout=None):
+    kw = {} if timeout is None else {"timeout": timeout}
+    return measure_batch([relpath], **kw)[relpath]
 
 
 def main():
-    files = sys.argv[1:]
+    args = sys.argv[1:]
+    out_path = os.path.join(ROOT, "tools", "ref_ut_measure.json")
+    if args and args[0] == "--out":  # parallel sweeps write disjoint files
+        out_path = args[1]
+        args = args[2:]
+    files = args
     if not files:
         sys.path.insert(0, os.path.join(ROOT, "tests"))
         from test_reference_unittests import TARGETS
         files = sorted(TARGETS)
+    chunk_size = int(os.environ.get("PADDLE_TPU_MEASURE_CHUNK", "8"))
     results = {}
-    for f in files:
-        r = measure(f)
-        results[f] = r
-        if "error" in r:
-            print(f"{f:45s} ERROR {r['error'][:120]}", flush=True)
-        else:
-            counted = r["run"] - r["skip"]
-            passed = counted - r["fail"] - r["err"]
-            rate = passed / counted if counted else 0.0
-            print(f"{f:45s} run={r['run']:3d} skip={r['skip']:3d} "
-                  f"pass={passed:3d}/{counted:3d} = {rate:.2f}  "
-                  f"failing={r['failing'][:4]}", flush=True)
+    for start in range(0, len(files), chunk_size):
+        chunk = files[start:start + chunk_size]
+        for f, r in measure_batch(chunk).items():
+            results[f] = r
+            if "error" in r:
+                print(f"{f:45s} ERROR {r['error'][:120]}", flush=True)
+            else:
+                counted = r["run"] - r["skip"]
+                passed = counted - r["fail"] - r["err"]
+                rate = passed / counted if counted else 0.0
+                print(f"{f:45s} run={r['run']:3d} skip={r['skip']:3d} "
+                      f"pass={passed:3d}/{counted:3d} = {rate:.2f}  "
+                      f"failing={r['failing'][:4]}", flush=True)
     # merge into the existing sweep record: a partial re-measurement must
     # not destroy the provenance of floors measured in earlier sweeps
-    path = os.path.join(ROOT, "tools", "ref_ut_measure.json")
+    path = out_path
     merged = {}
     try:
         with open(path) as fh:
