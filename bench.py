@@ -153,7 +153,7 @@ def bench_resnet50(backend):
         try:
             r = run_one(resnet50, batch, 224, 6)
         except Exception as e:  # e.g. HBM OOM at the largest batch
-            sweep[f"bs{batch}"] = f"FAIL: {type(e).__name__}"
+            sweep[f"bs{batch}"] = f"FAIL: {type(e).__name__}: {str(e)[:80]}"
             continue
         sweep[f"bs{batch}"] = r["images_per_sec"]
         if best is None or r["images_per_sec"] > best["images_per_sec"]:

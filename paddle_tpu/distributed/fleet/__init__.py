@@ -226,7 +226,7 @@ def stop_worker():
 
 
 from .compat import (  # noqa: F401,E402
-    CommunicateTopology, MultiSlotDataGenerator,
+    CollectiveOptimizer, CommunicateTopology, MultiSlotDataGenerator,
     MultiSlotStringDataGenerator, PaddleCloudRoleMaker, Role,
     UserDefinedRoleMaker, UtilBase,
 )

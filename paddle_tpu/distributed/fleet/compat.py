@@ -261,3 +261,24 @@ class MultiSlotStringDataGenerator(_DataGeneratorBase):
             parts.append(str(len(vals)))
             parts.extend(vals)
         return " ".join(parts) + "\n"
+
+
+class CollectiveOptimizer:
+    """1.x fluid.incubate.fleet.collective.CollectiveOptimizer: wrap an
+    optimizer for collective (allreduce) training. Under the compiled
+    single-program model this delegates to fleet.distributed_optimizer
+    — the allreduce is implied by the mesh shardings."""
+
+    def __init__(self, optimizer, strategy=None):
+        from . import distributed_optimizer
+
+        self._inner = distributed_optimizer(optimizer, strategy)
+
+    def __getattr__(self, name):
+        if name == "_inner":  # unpickling probes before __init__ runs
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._inner.minimize(loss)

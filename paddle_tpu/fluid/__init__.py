@@ -144,3 +144,15 @@ from .transpiler import (DistributeTranspiler,  # noqa: E402,F401
 __all__ += ["transpiler", "DistributeTranspiler",
             "DistributeTranspilerConfig", "memory_optimize",
             "release_memory"]
+
+
+def __getattr__(name):
+    # fluid.incubate / fluid.generator resolve lazily against their
+    # paddle_tpu homes (reference fluid/__init__.py imports incubate);
+    # the import-statement spellings are served by the sys.modules
+    # aliases ref_alias registers ("fluid.generator" below)
+    if name in ("incubate", "generator"):
+        import importlib
+
+        return importlib.import_module(f"paddle_tpu.fluid.{name}")
+    raise AttributeError(f"module 'paddle.fluid' has no attribute {name!r}")

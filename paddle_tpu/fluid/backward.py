@@ -1,2 +1,23 @@
 """fluid.backward compat (reference python/paddle/fluid/backward.py)."""
 from ..static import append_backward, gradients  # noqa: F401
+
+
+def _append_grad_suffix_(name):
+    """x → x@GRAD (reference backward.py:448)."""
+    return str(name) + "@GRAD"
+
+
+def _strip_grad_suffix_(name):
+    """x@GRAD → x, grad/x@GRAD → x (reference backward.py:434)."""
+    name = str(name)
+    pos = name.find("@GRAD")
+    new_name = name[:pos] if pos != -1 else name
+    new_pos = new_name.rfind("grad/")
+    return new_name[new_pos + 5:] if new_pos != -1 else new_name
+
+
+def _as_list(x):
+    """Reference backward.py helper: None → [], scalar → [scalar]."""
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]

@@ -8,8 +8,33 @@ from ..nn.quant.qat import (ImperativeQuantAware,  # noqa: F401
                             PostTrainingQuantization)
 
 
-class layers:  # contrib.layers namespace stub
-    pass
+def _make_delegating_module(name, backing_import):
+    """A real sys.modules entry whose attributes resolve against a
+    backing module at access time (PEP 562 on a ModuleType)."""
+    import sys as _sys
+
+    mod = _types.ModuleType(name)
+
+    def _getattr(attr):
+        import importlib
+        backing = importlib.import_module(backing_import)
+        return getattr(backing, attr)
+
+    mod.__getattr__ = _getattr
+    _sys.modules[name] = mod
+    return mod
+
+
+# contrib.layers: tests `import paddle.fluid.contrib.layers` as a MODULE
+# and reach the normal fluid.layers surface plus contrib extras through
+# it (reference fluid/contrib/layers re-exports nn ops)
+layers = _make_delegating_module(__name__ + ".layers",
+                                 "paddle_tpu.fluid.layers")
+# contrib.mixed_precision: decorate/AMP lists (reference
+# fluid/contrib/mixed_precision) — backed by the amp surface
+# (static.amp is a re-export of paddle_tpu.amp, which IS importable)
+mixed_precision = _make_delegating_module(__name__ + ".mixed_precision",
+                                          "paddle_tpu.amp")
 
 
 # fluid.contrib.slim.quantization.* compat path (reference:

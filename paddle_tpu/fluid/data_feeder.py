@@ -5,6 +5,15 @@ reference's public APIs raise TypeError through."""
 import numpy as np
 
 
+def convert_dtype(dtype):
+    """Dtype → its canonical string name (reference
+    data_feeder.convert_dtype returns 'float32'-style strings)."""
+    from ..framework.dtype import convert_dtype as _cd
+
+    out = _cd(dtype)
+    return str(out) if out is not None else None
+
+
 def _dtype_str(x):
     dt = getattr(x, "dtype", None)
     if dt is None:

@@ -93,3 +93,29 @@ def swap_key(new_key):
     prev = _global_key()
     _key = new_key
     return prev
+
+
+class Generator:
+    """Seedable RNG handle (reference fluid/generator.py Generator over
+    the C++ generator): manual_seed re-keys the process stream."""
+
+    def __init__(self, place=None):
+        self._seed = get_seed()
+
+    def manual_seed(self, new_seed):
+        self._seed = int(new_seed)
+        seed(self._seed)
+        return self
+
+    def initial_seed(self):
+        return self._seed
+
+    def seed(self):
+        import secrets
+        return self.manual_seed(secrets.randbits(32))._seed
+
+    def get_state(self):
+        return get_rng_state()
+
+    def set_state(self, state):
+        set_rng_state(state)

@@ -43,3 +43,118 @@ def train_epoch_range(max_epoch_num, save_checkpoint_inter=None,
     checkpointed epoch (reference auto_checkpoint contract)."""
     return _EpochRange(name or "default", max_epoch_num,
                        save_checkpoint_inter)
+
+
+class SerializableBase:
+    """Reference auto_checkpoint.SerializableBase interface."""
+
+    def serialize(self, path):
+        raise NotImplementedError
+
+    def deserialize(self, path):
+        raise NotImplementedError
+
+
+class ExeTrainStatus(SerializableBase):
+    """Training progress record (reference
+    incubate/checkpoint/auto_checkpoint.py ExeTrainStatus): epoch
+    counter + checkpoint bookkeeping, serialized as json."""
+
+    def __init__(self):
+        self._epoch_no = -1
+        self._hash_key = None
+        self._key = None
+        self._checkpoint_path = None
+        self._checkpoint_no = None
+        self._restored_from = None
+        self._exe = None
+        self._program = None
+        self._exe_name = None
+        self._program_name = None
+
+    @property
+    def epoch_no(self):
+        return self._epoch_no
+
+    @epoch_no.setter
+    def epoch_no(self, v):
+        self._epoch_no = int(v)
+
+    def __eq__(self, other):
+        return (isinstance(other, ExeTrainStatus)
+                and self._epoch_no == other._epoch_no
+                and self._key == other._key)
+
+    def __ne__(self, other):
+        return not self == other
+
+    def serialize(self, path):
+        import json
+        with open(os.path.join(path, "exe_train_status.json"), "w") as f:
+            json.dump({"epoch_no": self._epoch_no, "key": self._key}, f)
+
+    def deserialize(self, path):
+        import json
+        with open(os.path.join(path, "exe_train_status.json")) as f:
+            d = json.load(f)
+        self._epoch_no = d["epoch_no"]
+        self._key = d.get("key")
+
+
+class CheckpointSaver:
+    """Save/load numbered checkpoint dirs of SerializableBase objects on
+    an FS client (reference incubate/checkpoint/checkpoint_saver.py)."""
+
+    def __init__(self, fs):
+        self._fs = fs
+
+    def save_checkpoint(self, path, slists, trainer_id=None,
+                        local_cache_path=".cache"):
+        if not self._fs.is_exist(path):
+            self._fs.mkdirs(path)
+        max_no = self.get_last_checkpoint_no(path)
+        new_no = max_no + 1
+        cdir = os.path.join(path, f"__paddle_checkpoint__{new_no}")
+        self._fs.mkdirs(cdir)
+        for s in slists:
+            s.serialize(cdir)
+        return new_no
+
+    def load_checkpoint(self, path, slists, trainer_id,
+                        checkpoint_no=None, local_cache_path=".cache"):
+        if checkpoint_no is None:
+            checkpoint_no = self.get_last_checkpoint_no(path)
+        if checkpoint_no < 0:
+            return False
+        cdir = os.path.join(path, f"__paddle_checkpoint__{checkpoint_no}")
+        for s in slists:
+            s.deserialize(cdir)
+        return True
+
+    def get_last_checkpoint_no(self, root_path):
+        max_no = -1
+        if not self._fs.is_exist(root_path):
+            return max_no
+        for d in self._fs.list_dirs(root_path):
+            base = os.path.basename(str(d))
+            if base.startswith("__paddle_checkpoint__"):
+                try:
+                    max_no = max(max_no,
+                                 int(base[len("__paddle_checkpoint__"):]))
+                except ValueError:
+                    pass
+        return max_no
+
+    def clean_redundant_checkpoints(self, root_path, reserved=None):
+        keep = set(reserved or [self.get_last_checkpoint_no(root_path)])
+        if not self._fs.is_exist(root_path):
+            return
+        for d in self._fs.list_dirs(root_path):
+            base = os.path.basename(str(d))
+            if base.startswith("__paddle_checkpoint__"):
+                try:
+                    no = int(base[len("__paddle_checkpoint__"):])
+                except ValueError:
+                    continue
+                if no not in keep:
+                    self._fs.delete(os.path.join(root_path, base))

@@ -68,8 +68,13 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             unbiased = v_ * n / jnp.maximum(n - 1, 1)
         else:
             unbiased = v_ * n / max(n - 1, 1)
-        running_mean._data = momentum * rm + (1 - momentum) * m_
-        running_var._data = momentum * rv + (1 - momentum) * unbiased
+        # keep the buffers' dtype (bf16 models carry bf16 buffers): the
+        # fp32 stats must not promote them — that would retrace the jit
+        # step and drift state_dict dtypes
+        running_mean._data = (momentum * rm.astype(jnp.float32)
+                              + (1 - momentum) * m_).astype(rm.dtype)
+        running_var._data = (momentum * rv.astype(jnp.float32)
+                             + (1 - momentum) * unbiased).astype(rv.dtype)
 
     def f(a, mr, vr, *wb):
         if use_batch:

@@ -345,8 +345,9 @@ _alias("fluid.incubate.checkpoint.auto_checkpoint",
        "incubate.auto_checkpoint",
        "reference fluid/incubate/checkpoint/auto_checkpoint.py")
 _alias("fluid.incubate.checkpoint.checkpoint_saver",
-       "distributed.checkpoint",
-       "reference fluid/incubate/checkpoint/checkpoint_saver.py")
+       ["incubate.auto_checkpoint", "distributed.checkpoint"],
+       "reference fluid/incubate/checkpoint/checkpoint_saver.py "
+       "(CheckpointSaver in incubate.auto_checkpoint)")
 
 # ---- fluid.transpiler per-file spellings ----
 for _leaf, _names in (("distribute_transpiler",
@@ -374,3 +375,22 @@ _alias("geometric.message_passing.send_recv", "geometric.message_passing",
        "reference geometric/message_passing/send_recv.py")
 _alias("geometric.message_passing.utils", "geometric.message_passing",
        "reference geometric/message_passing/utils.py")
+
+# ---- fluid.incubate.* remainder (pre-2.0 spellings; fleet.base.* and
+# checkpoint.* are registered in the block above) ----
+_alias("fluid.incubate", "incubate",
+       "reference fluid/incubate/__init__.py")
+_alias("fluid.incubate.checkpoint", "incubate",
+       "reference fluid/incubate/checkpoint/")
+_alias("fluid.incubate.fleet", "distributed.fleet",
+       "reference fluid/incubate/fleet/")
+_alias("fluid.incubate.fleet.base", "distributed.fleet",
+       "reference fluid/incubate/fleet/base/")
+_alias("fluid.incubate.fleet.collective", "distributed.fleet",
+       "reference fluid/incubate/fleet/collective/__init__.py")
+_alias("fluid.incubate.fleet.utils", "distributed.fleet.utils",
+       "reference fluid/incubate/fleet/utils/")
+_alias("fluid.incubate.fleet.utils.fs", "distributed.fleet.utils",
+       "reference fluid/incubate/fleet/utils/fs.py")
+_alias("fluid.generator", "framework.random_seed",
+       "reference fluid/generator.py", names={"Generator"})

@@ -72,6 +72,13 @@ def _set_use_system_allocator(flag=True):  # reference CI knob; no-op
     return None
 
 
+def randomize_probability(batch_size, class_num, dtype="float32"):
+    """Row-normalized random probabilities (reference op_test.py:117)."""
+    prob = np.random.uniform(0.1, 1.0,
+                             size=(batch_size, class_num)).astype(dtype)
+    return prob / prob.sum(axis=1, keepdims=True)
+
+
 def get_numeric_gradient(place, scope, op, inputs, input_to_check,
                          output_names, delta=0.005, in_place=False):
     """Import-compat shim for tests that call the raw scope/op numeric
