@@ -12,6 +12,21 @@ from ..tensor_ops._factory import raw
 
 
 class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        # reference distribution.py:54 — subclasses pass their shapes up
+        self._batch_shape = tuple(batch_shape) \
+            if not isinstance(batch_shape, tuple) else batch_shape
+        self._event_shape = tuple(event_shape) \
+            if not isinstance(event_shape, tuple) else event_shape
+
+    @property
+    def batch_shape(self):
+        return getattr(self, "_batch_shape", ())
+
+    @property
+    def event_shape(self):
+        return getattr(self, "_event_shape", ())
+
     def sample(self, shape=()):
         raise NotImplementedError
 
@@ -70,8 +85,12 @@ class Normal(Distribution):
                      self.scale)
 
     def kl_divergence(self, other):
+        # 0.5*log(ratio^2) rather than log(ratio): identical for positive
+        # scales and matches the reference's var-ratio formulation
+        # (kl uses squared scales) on degenerate sign cases
         return apply(lambda m1, s1, m2, s2:
-                     jnp.log(s2 / s1) + (s1 ** 2 + (m1 - m2) ** 2) / (2 * s2 ** 2) - 0.5,
+                     0.5 * jnp.log((s2 / s1) ** 2)
+                     + (s1 ** 2 + (m1 - m2) ** 2) / (2 * s2 ** 2) - 0.5,
                      self.loc, self.scale, other.loc, other.scale)
 
 
@@ -104,23 +123,32 @@ class Categorical(Distribution):
             next_key(), raw(self.logits), shape=tuple(shape) + raw(self.logits).shape[:-1] if shape else None))
 
     def _gather(self, scores, value):
+        """Reference categorical.py:303 gather semantics: 1-D scores
+        gather flat then reshape to value.shape; batched scores with a
+        1-D value broadcast the value across all distributions (output
+        [..., len(value)]); otherwise take_along_axis keeps dims."""
         idx = raw(value).astype(jnp.int32)
 
         def f(sc):
             if sc.ndim == 1:
-                # one distribution, many queried categories
-                return jnp.take(sc, idx)
-            return jnp.take_along_axis(sc, idx[..., None], -1)[..., 0]
+                return jnp.take(sc, idx.reshape(-1)).reshape(idx.shape)
+            if idx.ndim == 1:
+                bshape = (1,) * (sc.ndim - 1) + (-1,)
+                return jnp.take_along_axis(sc, idx.reshape(bshape), -1)
+            return jnp.take_along_axis(sc, idx, -1)
 
         return apply(f, scores)
 
-    def log_prob(self, value):
-        return self._gather(apply(
-            lambda lg: jax.nn.log_softmax(lg, -1), self.logits), value)
-
     def probs(self, value):
+        # reference categorical.py:119 quirk mirrored exactly: probs and
+        # log_prob LINEARLY normalize the given scores (self._prob =
+        # logits / logits.sum), while entropy/kl use softmax
         return self._gather(apply(
-            lambda lg: jax.nn.softmax(lg, -1), self.logits), value)
+            lambda lg: lg / jnp.sum(lg, -1, keepdims=True), self.logits),
+            value)
+
+    def log_prob(self, value):
+        return apply(lambda p: jnp.log(p), self.probs(value))
 
     def entropy(self):
         def f(lg):
@@ -158,11 +186,59 @@ class Bernoulli(Distribution):
                      self.probs_)
 
 
-class Beta(Distribution):
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions; entropy via the
+    Bregman-divergence identity when _log_normalizer is differentiable.
+    Reference: distribution/exponential_family.py."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        # reference exponential_family.py: only distributions with a
+        # known carrier measure override this; the Bregman entropy MUST
+        # refuse otherwise (TestExponentialFamilyException contract)
+        raise NotImplementedError
+
+    def entropy(self):
+        # reference exponential_family.py entropy: ELEMENTWISE Bregman
+        # identity H = logZ(η) - Σ η·∇logZ(η) - carrier, per batch
+        # element. _log_normalizer implementations use paddle ops, so
+        # thread Tensors in and raw values out around jax.grad.
+        nat = [raw(p) for p in self._natural_parameters]
+
+        def f(*ps):
+            out = self._log_normalizer(*[Tensor(p) for p in ps])
+            out = raw(out)
+            return jnp.sum(out), out
+
+        (_, log_norm), grads = jax.value_and_grad(
+            f, argnums=tuple(range(len(nat))), has_aux=True)(*nat)
+        ent = log_norm - self._mean_carrier_measure
+        for p, g in zip(nat, grads):
+            ent = ent - p * g
+        return Tensor(ent)
+
+
+class Beta(ExponentialFamily):
     def __init__(self, alpha, concentration1=None, name=None, beta=None):
         b = beta if beta is not None else concentration1
         self.alpha = _coerce(alpha)
         self.beta = _coerce(b)
+
+
+    @property
+    def _natural_parameters(self):
+        return (self.alpha, self.beta)
+
+    def _log_normalizer(self, x, y):
+        from ..tensor_ops import lgamma
+        return lgamma(x) + lgamma(y) - lgamma(x + y)
 
     @property
     def mean(self):
@@ -194,10 +270,24 @@ class Beta(Distribution):
                      value, self.alpha, self.beta)
 
 
-class Dirichlet(Distribution):
+class Dirichlet(ExponentialFamily):
     def __init__(self, concentration, name=None):
         self.concentration = concentration if isinstance(concentration, Tensor) \
             else Tensor(jnp.asarray(concentration, dtype=jnp.float32))
+
+
+    @property
+    def _natural_parameters(self):
+        return (self.concentration,)
+
+    @property
+    def event_shape(self):
+        return tuple(raw(self.concentration).shape[-1:])
+
+    def _log_normalizer(self, x):
+        from jax.scipy.special import gammaln
+        return apply(lambda c: jnp.sum(gammaln(c), -1)
+                     - gammaln(jnp.sum(c, -1)), x)
 
     def sample(self, shape=()):
         return Tensor(jax.random.dirichlet(next_key(), raw(self.concentration),
@@ -248,6 +338,64 @@ class Gumbel(Distribution):
                       jax.random.gumbel(next_key(), shp))
 
 
+def _kl_beta_beta(p, q):
+    """KL(Beta(a1,b1) || Beta(a2,b2)) closed form (reference kl.py)."""
+    from jax.scipy.special import betaln, digamma
+
+    def f(a1, b1, a2, b2):
+        return (betaln(a2, b2) - betaln(a1, b1)
+                + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+                + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+
+    return apply(f, p.alpha, p.beta, q.alpha, q.beta)
+
+
+def _kl_dirichlet_dirichlet(p, q):
+    """KL between Dirichlets (reference kl.py _kl_dirichlet_dirichlet)."""
+    from jax.scipy.special import digamma, gammaln
+
+    def f(c1, c2):
+        lnB1 = jnp.sum(gammaln(c1), -1) - gammaln(jnp.sum(c1, -1))
+        lnB2 = jnp.sum(gammaln(c2), -1) - gammaln(jnp.sum(c2, -1))
+        dg = digamma(c1) - digamma(jnp.sum(c1, -1, keepdims=True))
+        return lnB2 - lnB1 + jnp.sum((c1 - c2) * dg, -1)
+
+    return apply(f, p.concentration, q.concentration)
+
+
+def kl_expfamily_expfamily(p, q):
+    """Generic exponential-family KL via the Bregman divergence of the
+    log normalizer (reference kl.py _kl_expfamily_expfamily):
+    KL(p||q) = logZ(η_q) - logZ(η_p) - (η_q - η_p)·∇logZ(η_p)."""
+    if type(p) is not type(q):
+        raise NotImplementedError(
+            "expfamily KL needs matching distribution types")
+    np_ = [raw(t) for t in p._natural_parameters]
+    nq = [raw(t) for t in q._natural_parameters]
+
+    def logz(*ps):
+        out = raw(p._log_normalizer(*[Tensor(v) for v in ps]))
+        return jnp.sum(out), out
+
+    # ELEMENTWISE Bregman divergence, like the reference — the result
+    # has the distributions' batch shape, not a scalar
+    (_, lp_el), grads = jax.value_and_grad(
+        logz, argnums=tuple(range(len(np_))), has_aux=True)(*np_)
+    lq_el = raw(q._log_normalizer(*[Tensor(v) for v in nq]))
+    out = lq_el - lp_el
+    n_event = len(getattr(p, "event_shape", ()) or ())
+    for etap, etaq, g in zip(np_, nq, grads):
+        term = (etaq - etap) * g
+        if n_event > 0:  # reference kl.py: sum over the event dims
+            term = jnp.sum(term, axis=tuple(range(term.ndim - n_event,
+                                                  term.ndim)))
+        out = out - term
+    return Tensor(out)
+
+
+_kl_expfamily_expfamily = kl_expfamily_expfamily  # reference kl.py name
+
+
 def kl_divergence(p, q):
     fn = _registered_kl(p, q)
     if fn is not None:
@@ -260,34 +408,15 @@ def kl_divergence(p, q):
             return jnp.sum(pp * (jax.nn.log_softmax(lp, -1) -
                                  jax.nn.log_softmax(lq, -1)), -1)
         return apply(f, p.logits, q.logits)
+    if isinstance(p, Beta) and isinstance(q, Beta):
+        return _kl_beta_beta(p, q)
+    if isinstance(p, Dirichlet) and isinstance(q, Dirichlet):
+        return _kl_dirichlet_dirichlet(p, q)
+    if isinstance(p, ExponentialFamily) and isinstance(q,
+                                                      ExponentialFamily) \
+            and type(p) is type(q):
+        return kl_expfamily_expfamily(p, q)
     raise NotImplementedError(f"kl({type(p).__name__}, {type(q).__name__})")
-
-
-class ExponentialFamily(Distribution):
-    """Base for exponential-family distributions; entropy via the
-    Bregman-divergence identity when _log_normalizer is differentiable.
-    Reference: distribution/exponential_family.py."""
-
-    @property
-    def _natural_parameters(self):
-        raise NotImplementedError
-
-    def _log_normalizer(self, *natural_params):
-        raise NotImplementedError
-
-    @property
-    def _mean_carrier_measure(self):
-        return 0.0
-
-    def entropy(self):
-        nat = [raw(p) for p in self._natural_parameters]
-        logz, grads = jax.value_and_grad(
-            lambda *ps: jnp.sum(self._log_normalizer(*ps)),
-            argnums=tuple(range(len(nat))))(*nat)
-        ent = logz - self._mean_carrier_measure
-        for p, g in zip(nat, grads):
-            ent = ent - jnp.sum(p * g)
-        return Tensor(ent)
 
 
 class Multinomial(Distribution):
@@ -348,8 +477,19 @@ class Independent(Distribution):
     distribution/independent.py."""
 
     def __init__(self, base, reinterpreted_batch_rank=1):
+        if not isinstance(base, Distribution):
+            raise TypeError(
+                f"Expected base to be a Distribution, got {type(base)}")
         self.base = base
         self.rank = int(reinterpreted_batch_rank)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
 
     def sample(self, shape=()):
         return self.base.sample(shape)
@@ -402,62 +542,16 @@ class TransformedDistribution(Distribution):
         return lp - log_det if log_det is not None else lp
 
 
-# -- transforms used with TransformedDistribution -------------------------
+# -- transforms (full reference surface in distribution/transform.py) ------
 
-class Transform:
-    def forward(self, x):
-        raise NotImplementedError
-
-    def inverse(self, y):
-        raise NotImplementedError
-
-    def forward_log_det_jacobian(self, x):
-        raise NotImplementedError
-
-
-class AffineTransform(Transform):
-    """y = loc + scale * x. Reference: distribution/transform.py."""
-
-    def __init__(self, loc, scale):
-        self.loc = loc if isinstance(loc, Tensor) else Tensor(
-            jnp.asarray(float(loc)))
-        self.scale = scale if isinstance(scale, Tensor) else Tensor(
-            jnp.asarray(float(scale)))
-
-    def forward(self, x):
-        return apply(lambda v, l, s: l + s * v, x, self.loc, self.scale)
-
-    def inverse(self, y):
-        return apply(lambda v, l, s: (v - l) / s, y, self.loc, self.scale)
-
-    def forward_log_det_jacobian(self, x):
-        return apply(lambda v, s: jnp.broadcast_to(
-            jnp.log(jnp.abs(s)), v.shape), x, self.scale)
-
-
-class ExpTransform(Transform):
-    """y = exp(x). Reference: distribution/transform.py."""
-
-    def forward(self, x):
-        return apply(jnp.exp, x)
-
-    def inverse(self, y):
-        return apply(jnp.log, y)
-
-    def forward_log_det_jacobian(self, x):
-        return x
-
-
-class SigmoidTransform(Transform):
-    def forward(self, x):
-        return apply(jax.nn.sigmoid, x)
-
-    def inverse(self, y):
-        return apply(lambda v: jnp.log(v) - jnp.log1p(-v), y)
-
-    def forward_log_det_jacobian(self, x):
-        return apply(lambda v: jax.nn.log_sigmoid(v)
-                     + jax.nn.log_sigmoid(-v), x)
+from .transform import (AbsTransform, AffineTransform,  # noqa: E402,F401
+                        ChainTransform, ExpTransform,
+                        IndependentTransform, PowerTransform,
+                        ReshapeTransform, SigmoidTransform,
+                        SoftmaxTransform, StackTransform,
+                        StickBreakingTransform, TanhTransform, Transform,
+                        Type)
+from . import constraint, variable  # noqa: E402,F401
 
 
 # -- kl registry -----------------------------------------------------------

@@ -1,0 +1,4 @@
+"""Constraints on random-variable supports (reference
+python/paddle/distribution/constraint.py)."""
+from .transform import (Constraint, Positive, Range, Real,  # noqa: F401
+                        Simplex, positive, real, simplex)

@@ -1,0 +1,728 @@
+"""Random-variable transforms (reference python/paddle/distribution/
+transform.py, constraint.py, variable.py): the Type taxonomy, the
+Transform protocol (forward/inverse/log-det-jacobian/shape mapping with
+domain/codomain variables), and the full transform set — Abs, Affine,
+Chain, Exp, Independent, Power, Reshape, Sigmoid, Softmax, Stack,
+StickBreaking, Tanh. TPU-native: every mapping is a pure jnp expression
+through the autograd apply(), so transforms compose into compiled
+programs and their jacobian terms fuse.
+"""
+from __future__ import annotations
+
+import enum
+import functools
+import math
+import operator
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, apply
+
+__all__ = [
+    "Type", "Transform", "AbsTransform", "AffineTransform",
+    "ChainTransform", "ExpTransform", "IndependentTransform",
+    "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+    "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+    "TanhTransform",
+]
+
+
+# -- constraint (reference distribution/constraint.py) ----------------------
+
+class Constraint:
+    def __call__(self, value):
+        raise NotImplementedError
+
+
+class Real(Constraint):
+    def __call__(self, value):
+        return value == value
+
+
+class Range(Constraint):
+    def __init__(self, lower, upper):
+        self._lower = lower
+        self._upper = upper
+        super().__init__()
+
+    def __call__(self, value):
+        return (self._lower <= value) & (value <= self._upper)
+
+
+class Positive(Constraint):
+    def __call__(self, value):
+        return value >= 0.0
+
+
+class Simplex(Constraint):
+    def __call__(self, value):
+        return (value >= 0).all(-1) & ((value.sum(-1) - 1).abs() < 1e-6)
+
+
+real = Real()
+positive = Positive()
+simplex = Simplex()
+
+
+# -- variable (reference distribution/variable.py) --------------------------
+
+class Variable:
+    """Random-variable metadata: discreteness + event rank + constraint."""
+
+    def __init__(self, is_discrete=False, event_rank=0, constraint=None):
+        self._is_discrete = is_discrete
+        self._event_rank = event_rank
+        self._constraint = constraint
+
+    @property
+    def is_discrete(self):
+        return self._is_discrete
+
+    @property
+    def event_rank(self):
+        return self._event_rank
+
+    def constraint(self, value):
+        return self._constraint(value)
+
+
+class RealVariable(Variable):
+    def __init__(self, is_discrete=False, event_rank=0):
+        super().__init__(is_discrete, event_rank, Real())
+
+
+class PositiveVariable(Variable):
+    def __init__(self, is_discrete=False, event_rank=0):
+        super().__init__(is_discrete, event_rank, Positive())
+
+
+class IndependentVariable(Variable):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self._base = base
+        self._rank = reinterpreted_batch_rank
+        super().__init__(base.is_discrete,
+                         base.event_rank + reinterpreted_batch_rank,
+                         base._constraint)
+
+    def constraint(self, value):
+        ret = self._base.constraint(value)
+        nd = getattr(ret, "ndim", 0)
+        if nd < self._rank:
+            raise ValueError(
+                f"value's rank {nd} is less than the reinterpreted "
+                f"batch rank {self._rank}")
+        axes = tuple(range(nd - self._rank, nd))
+        return apply(lambda a: jnp.all(a, axis=axes), ret) \
+            if isinstance(ret, Tensor) else jnp.all(ret, axis=axes)
+
+
+class StackVariable(Variable):
+    def __init__(self, vars, axis=0):
+        self._vars = list(vars)
+        self._axis = axis
+        super().__init__(any(v.is_discrete for v in self._vars),
+                         max(v.event_rank for v in self._vars),
+                         self._vars[0]._constraint if self._vars else None)
+
+    def constraint(self, value):
+        nd = getattr(value, "ndim", 0)
+        if not (-nd <= self._axis < nd):
+            raise ValueError(
+                f"axis {self._axis} is out of range for a rank-{nd} "
+                "value")
+        from ..tensor_ops.manipulation import stack, unbind
+        parts = unbind(value, axis=self._axis)
+        return stack([v.constraint(p)
+                      for v, p in zip(self._vars, parts)],
+                     axis=self._axis)
+
+
+variable_real = RealVariable()
+variable_positive = PositiveVariable()
+
+
+# -- transform taxonomy -----------------------------------------------------
+
+class Type(enum.Enum):
+    """Mapping kind (reference transform.py:35)."""
+    BIJECTION = "bijection"      # injective + surjective
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Transform:
+    r"""Base transform (reference transform.py:50): subclasses implement
+    ``_forward`` / ``_inverse`` / ``_forward_log_det_jacobian`` (raw jnp
+    in, raw jnp out); the public API wraps them through the autograd
+    apply so gradients flow."""
+
+    _type = Type.INJECTION
+
+    @classmethod
+    def _is_injective(cls):
+        return Type.is_injective(cls._type)
+
+    @property
+    def type(self):
+        return self._type
+
+    def __call__(self, input):
+        if isinstance(input, Transform):
+            return ChainTransform([self, input])
+        from . import Distribution, TransformedDistribution
+        if isinstance(input, Distribution):
+            return TransformedDistribution(input, [self])
+        return self.forward(input)
+
+    def _forward(self, x):
+        raise NotImplementedError(
+            f"{type(self).__name__} forward not implemented")
+
+    def _inverse(self, y):
+        raise NotImplementedError(
+            f"{type(self).__name__} inverse not implemented")
+
+    # -- public API ----------------------------------------------------
+    def forward(self, x):
+        return apply(self._forward, x) if isinstance(x, Tensor) \
+            else Tensor(self._forward(jnp.asarray(x)))
+
+    def inverse(self, y):
+        return apply(self._inverse, y) if isinstance(y, Tensor) \
+            else Tensor(self._inverse(jnp.asarray(y)))
+
+    def forward_log_det_jacobian(self, x):
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return apply(self._forward_log_det_jacobian, x) \
+                if isinstance(x, Tensor) \
+                else Tensor(self._forward_log_det_jacobian(jnp.asarray(x)))
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return apply(
+                lambda v: -self._inverse_log_det_jacobian(
+                    self._forward(v)), x)
+        raise NotImplementedError(
+            f"{type(self).__name__} has no log det jacobian")
+
+    def inverse_log_det_jacobian(self, y):
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return apply(self._inverse_log_det_jacobian, y) \
+                if isinstance(y, Tensor) \
+                else Tensor(self._inverse_log_det_jacobian(jnp.asarray(y)))
+        # fall back through the PUBLIC methods: subclasses overriding
+        # forward/forward_log_det_jacobian directly still compose
+        return self.forward_log_det_jacobian(self.inverse(y)) * -1.0
+
+    def forward_shape(self, shape):
+        return tuple(self._forward_shape(tuple(shape)))
+
+    def inverse_shape(self, shape):
+        return tuple(self._inverse_shape(tuple(shape)))
+
+    def _forward_shape(self, shape):
+        return shape
+
+    def _inverse_shape(self, shape):
+        return shape
+
+    # domain/codomain variables (reference transform.py exposes the
+    # underscore spellings; tests read them directly)
+    @property
+    def _domain(self):
+        return variable_real
+
+    @property
+    def _codomain(self):
+        return variable_real
+
+    @property
+    def domain(self):
+        return self._domain
+
+    @property
+    def codomain(self):
+        return self._codomain
+
+
+class AbsTransform(Transform):
+    r"""y = |x| — surjective onto the nonnegative reals; inverse picks
+    the nonnegative preimage (reference transform.py:318)."""
+
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    @property
+    def _codomain(self):
+        return variable_positive
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (reference transform.py:390)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        if not isinstance(loc, Tensor):
+            raise TypeError(
+                f"Expected 'loc' is a Tensor, but got {type(loc)}")
+        if not isinstance(scale, Tensor):
+            raise TypeError(
+                f"Expected 'scale' is a Tensor, but got {type(scale)}")
+        self._loc = loc
+        self._scale = scale
+
+    @property
+    def loc(self):
+        return self._loc
+
+    @property
+    def scale(self):
+        return self._scale
+
+    def forward(self, x):
+        return apply(lambda v, l, s: l + s * v, x, self.loc, self.scale)
+
+    def inverse(self, y):
+        return apply(lambda v, l, s: (v - l) / s, y, self.loc, self.scale)
+
+    def forward_log_det_jacobian(self, x):
+        return apply(lambda v, s: jnp.broadcast_to(
+            jnp.log(jnp.abs(s)), v.shape), x, self.scale)
+
+    def _forward_shape(self, shape):
+        return tuple(jnp.broadcast_shapes(
+            tuple(shape), _raw(self.loc).shape, _raw(self.scale).shape))
+
+    _inverse_shape = _forward_shape
+
+
+class ChainTransform(Transform):
+    """Composition t_n ∘ ... ∘ t_1 (reference transform.py:467)."""
+
+    def __init__(self, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        if not isinstance(transforms, (list, tuple)):
+            raise TypeError(
+                f"Expected a sequence of Transform, got {type(transforms)}")
+        if not all(isinstance(t, Transform) for t in transforms):
+            raise TypeError(
+                "all chain elements must be Transform instances")
+        flat = []
+        for t in transforms:  # flatten nested chains
+            if isinstance(t, ChainTransform):
+                flat.extend(t.transforms)
+            else:
+                flat.append(t)
+        self.transforms = flat
+
+    @property
+    def _type(self):
+        ts = [t.type for t in self.transforms]
+        if all(t == Type.BIJECTION for t in ts):
+            return Type.BIJECTION
+        if all(Type.is_injective(t) for t in ts):
+            return Type.INJECTION
+        if all(t in (Type.BIJECTION, Type.SURJECTION) for t in ts):
+            return Type.SURJECTION
+        return Type.OTHER
+
+    def _is_injective(self):
+        return all(t._is_injective() for t in self.transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        # reference transform.py:527: each term is summed over the
+        # event dims the CHAIN (not the member) treats as event —
+        # event_rank tracks the rank delta as value flows through
+        total = None
+        event_rank = self._domain.event_rank
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            n = event_rank - t._domain.event_rank
+            if n > 0:
+                ld = apply(lambda a, n=n: jnp.sum(
+                    a, axis=tuple(range(a.ndim - n, a.ndim))), ld)
+            total = ld if total is None else total + ld
+            x = t.forward(x)
+            event_rank += (t._codomain.event_rank
+                           - t._domain.event_rank)
+        return total
+
+    def _forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return tuple(shape)
+
+    def _inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return tuple(shape)
+
+    @property
+    def _domain(self):
+        # reference transform.py:549 — the chain's minimum input event
+        # rank via the DP over per-transform rank deltas
+        domain = self.transforms[0]._domain
+        event_rank = self.transforms[-1]._codomain.event_rank
+        for t in reversed(self.transforms):
+            event_rank -= (t._codomain.event_rank
+                           - t._domain.event_rank)
+            event_rank = max(event_rank, t._domain.event_rank)
+        return IndependentVariable(domain,
+                                   event_rank - domain.event_rank)
+
+    @property
+    def _codomain(self):
+        codomain = self.transforms[-1]._codomain
+        event_rank = self.transforms[0]._domain.event_rank
+        for t in self.transforms:
+            event_rank += (t._codomain.event_rank
+                           - t._domain.event_rank)
+            event_rank = max(event_rank, t._codomain.event_rank)
+        return IndependentVariable(codomain,
+                                   event_rank - codomain.event_rank)
+
+
+class ExpTransform(Transform):
+    """y = exp(x) (reference transform.py:590)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+    @property
+    def _codomain(self):
+        return variable_positive
+
+
+class IndependentTransform(Transform):
+    """Reinterpret trailing batch dims as event dims: log-det sums over
+    the reinterpreted rank (reference transform.py:639)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not isinstance(base, Transform):
+            raise TypeError("base must be a Transform")
+        if int(reinterpreted_batch_rank) <= 0:
+            raise ValueError(
+                "reinterpreted_batch_rank must be a positive int")
+        self._base = base
+        self._rank = int(reinterpreted_batch_rank)
+
+    @property
+    def type(self):
+        return self._base.type
+
+    def _is_injective(self):
+        return self._base._is_injective()
+
+    def forward(self, x):
+        return self._base.forward(x)
+
+    def inverse(self, y):
+        return self._base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ld = self._base.forward_log_det_jacobian(x)
+        return apply(lambda a: jnp.sum(
+            a, axis=tuple(range(a.ndim - self._rank, a.ndim))), ld)
+
+    def _forward_shape(self, shape):
+        return self._base.forward_shape(shape)
+
+    def _inverse_shape(self, shape):
+        return self._base.inverse_shape(shape)
+
+    @property
+    def _domain(self):
+        return IndependentVariable(self._base.domain, self._rank)
+
+    @property
+    def _codomain(self):
+        return IndependentVariable(self._base.codomain, self._rank)
+
+
+class PowerTransform(Transform):
+    """y = x ** power on the positive reals (reference
+    transform.py:730)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        if not isinstance(power, Tensor):
+            raise TypeError(
+                f"Expected 'power' is a Tensor, but got {type(power)}")
+        self._power = power
+
+    @property
+    def power(self):
+        return self._power
+
+    def forward(self, x):
+        return apply(lambda v, p: jnp.power(v, p), x, self.power)
+
+    def inverse(self, y):
+        return apply(lambda v, p: jnp.power(v, 1.0 / p), y, self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return apply(lambda v, p: jnp.log(
+            jnp.abs(p * jnp.power(v, p - 1.0))), x, self.power)
+
+    def _forward_shape(self, shape):
+        return tuple(jnp.broadcast_shapes(tuple(shape),
+                                          _raw(self.power).shape))
+
+    _inverse_shape = _forward_shape
+
+    @property
+    def _domain(self):
+        return variable_positive
+
+    @property
+    def _codomain(self):
+        return variable_positive
+
+
+class ReshapeTransform(Transform):
+    """Reshape the event part (reference transform.py:793)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self._in = tuple(in_event_shape)
+        self._out = tuple(out_event_shape)
+        if functools.reduce(operator.mul, self._in, 1) != \
+                functools.reduce(operator.mul, self._out, 1):
+            raise ValueError(
+                f"in_event_shape {self._in} and out_event_shape "
+                f"{self._out} have different sizes")
+
+    @property
+    def in_event_shape(self):
+        return self._in
+
+    @property
+    def out_event_shape(self):
+        return self._out
+
+    def _batch(self, shape, event):
+        n = len(shape) - len(event)
+        if n < 0 or tuple(shape[n:]) != tuple(event):
+            raise ValueError(f"shape {tuple(shape)} does not end with "
+                             f"event shape {event}")
+        return tuple(shape[:n])
+
+    def _forward(self, x):
+        return x.reshape(self._batch(x.shape, self._in) + self._out)
+
+    def _inverse(self, y):
+        return y.reshape(self._batch(y.shape, self._out) + self._in)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros(self._batch(x.shape, self._in), x.dtype)
+
+    def _forward_shape(self, shape):
+        return self._batch(shape, self._in) + self._out
+
+    def _inverse_shape(self, shape):
+        return self._batch(shape, self._out) + self._in
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x) (reference transform.py:900)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return jax.nn.log_sigmoid(x) + jax.nn.log_sigmoid(-x)
+
+    @property
+    def _codomain(self):
+        return Variable(False, 0, Range(0.0, 1.0))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis — not injective (reference
+    transform.py:943); inverse maps back to logs."""
+
+    _type = Type.OTHER
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_shape(self, shape):
+        if len(shape) < 1:
+            raise ValueError("input rank must be at least 1")
+        return shape
+
+    _inverse_shape = _forward_shape
+
+    @property
+    def _domain(self):
+        return IndependentVariable(variable_real, 1)
+
+    @property
+    def _codomain(self):
+        return Variable(False, 1, Simplex())
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] to slice i along ``axis`` (reference
+    transform.py:999)."""
+
+    def __init__(self, transforms, axis=0):
+        if not transforms or not all(
+                isinstance(t, Transform) for t in transforms):
+            raise TypeError("transforms must be a non-empty sequence of "
+                            "Transform")
+        if not isinstance(axis, int):
+            raise TypeError("axis must be int")
+        self._transforms = list(transforms)
+        self._axis = axis
+
+    @property
+    def transforms(self):
+        return self._transforms
+
+    @property
+    def axis(self):
+        return self._axis
+
+    @property
+    def type(self):
+        ts = {t.type for t in self._transforms}
+        return ts.pop() if len(ts) == 1 else Type.OTHER
+
+    def _map(self, value, method):
+        from ..tensor_ops.manipulation import stack, unbind
+        parts = unbind(value, axis=self._axis)
+        if len(parts) != len(self._transforms):
+            raise ValueError(
+                f"input has {len(parts)} slices along axis {self._axis} "
+                f"but StackTransform holds {len(self._transforms)}")
+        outs = [getattr(t, method)(p)
+                for t, p in zip(self._transforms, parts)]
+        return stack(outs, axis=self._axis)
+
+    def forward(self, x):
+        return self._map(x, "forward")
+
+    def inverse(self, y):
+        return self._map(y, "inverse")
+
+    def forward_log_det_jacobian(self, x):
+        return self._map(x, "forward_log_det_jacobian")
+
+
+class StickBreakingTransform(Transform):
+    r"""R^K → interior of the (K+1)-simplex via stick breaking
+    (reference transform.py:1104): z_i = sigmoid(x_i - log(K - i)),
+    y_i = z_i * prod_{j<i}(1 - z_j), y_K = prod(1 - z)."""
+
+    _type = Type.BIJECTION  # onto the open simplex
+
+    def _offsets(self, k):
+        return jnp.log(jnp.arange(k, 0, -1).astype(jnp.float32))
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        z = jax.nn.sigmoid(x - self._offsets(k))
+        w = jnp.cumprod(1.0 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), w[..., :-1]], -1)
+        return jnp.concatenate([z * lead, w[..., -1:]], -1)
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        y_crop = y[..., :-1]
+        sf = 1.0 - jnp.cumsum(y_crop, axis=-1)
+        sticks = jnp.concatenate(
+            [jnp.ones(y.shape[:-1] + (1,), y.dtype), sf[..., :-1]], -1)
+        z = y_crop / sticks
+        return jnp.log(z) - jnp.log1p(-z) + self._offsets(k)
+
+    def _forward_log_det_jacobian(self, x):
+        k = x.shape[-1]
+        z = jax.nn.sigmoid(x - self._offsets(k))
+        w = jnp.cumprod(1.0 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.zeros(x.shape[:-1] + (1,), x.dtype),
+             jnp.log(w[..., :-1])], -1)
+        return jnp.sum(lead + jnp.log(z) + jnp.log1p(-z), axis=-1)
+
+    def _forward_shape(self, shape):
+        if not shape:
+            raise ValueError("input rank must be >= 1")
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def _inverse_shape(self, shape):
+        if not shape or shape[-1] < 2:
+            raise ValueError("last dim must be >= 2")
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+    @property
+    def _domain(self):
+        return IndependentVariable(variable_real, 1)
+
+    @property
+    def _codomain(self):
+        return Variable(False, 1, Simplex())
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (reference transform.py:1169)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2) = 2*(log2 - x - softplus(-2x)), stable form
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+    @property
+    def _codomain(self):
+        return Variable(False, 0, Range(-1.0, 1.0))

@@ -1,0 +1,9 @@
+"""Random-variable metadata (reference
+python/paddle/distribution/variable.py)."""
+from .transform import (Variable,  # noqa: F401
+                        IndependentVariable as Independent,
+                        PositiveVariable as Positive,
+                        RealVariable as Real,
+                        StackVariable as Stack,
+                        variable_positive as positive,
+                        variable_real as real)

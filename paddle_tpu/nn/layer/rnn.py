@@ -27,6 +27,11 @@ class RNNCellBase(Layer):
 
 
 def _cell_params(layer, input_size, hidden_size, gates):
+    if hidden_size <= 0:
+        # reference rnn.py: "hidden_size of cell must be greater than 0"
+        raise ValueError(
+            f"hidden_size of {type(layer).__name__} must be greater "
+            f"than 0, but now equals to {hidden_size}")
     k = 1.0 / math.sqrt(hidden_size)
     init = Uniform(-k, k)
     layer.weight_ih = layer.create_parameter(
@@ -208,6 +213,10 @@ class BiRNN(Layer):
         super().__init__()
         self.rnn_fw = RNN(cell_fw, False, time_major)
         self.rnn_bw = RNN(cell_bw, True, time_major)
+        # reference BiRNN exposes the cells directly (rnn.py BiRNN):
+        # the rnn test-suite's convert_params_for_net reads these
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
         states = initial_states or (None, None)
@@ -247,6 +256,17 @@ class _RNNBase(Layer):
                                        time_major))
             else:
                 self.rnns.append(RNN(make_cell(in_sz), False, time_major))
+
+    # reference multi-layer nets iterate over their per-layer RNN/BiRNN
+    # wrappers (LayerList protocol): `for layer in lstm: layer.cell`
+    def __iter__(self):
+        return iter(self.rnns)
+
+    def __len__(self):
+        return len(self.rnns)
+
+    def __getitem__(self, i):
+        return self.rnns[i]
 
     def _layer_states(self, initial_states, i):
         """Slice paddle-layout initial states ([L*D, B, H], LSTM: tuple of

@@ -111,10 +111,10 @@ for _sub, _names in (
         ("independent", {"Independent"}),
         ("transformed_distribution", {"TransformedDistribution"}),
         ("exponential_family", {"ExponentialFamily"}),
-        ("kl", {"kl_divergence", "register_kl"}),
-        ("transform", None),  # Transform/AffineTransform/... full surface
-        ("variable", None),
-        ("constraint", None)):
+        ("kl", {"kl_divergence", "register_kl",
+                "_kl_expfamily_expfamily"})):
+    # transform/variable/constraint are REAL files now
+    # (distribution/{transform,variable,constraint}.py) — no alias
     _alias(f"distribution.{_sub}", "distribution",
            f"reference python/paddle/distribution/{_sub}.py",
            names=_names)

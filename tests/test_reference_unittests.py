@@ -414,6 +414,40 @@ TARGETS = {
     "test_empty_like_op.py": (0.60, 8),  # measured 9/13 = 0.69 (unlock2)
     "test_sgd_op.py": (0.45, 5),  # measured 6/11 = 0.55 (unlock2)
     "test_svd_op.py": (0.40, 9),  # measured 10/20 = 0.50 (unlock2)
+    "dygraph_to_static/test_convert_operators.py": (0.50, 3),  # measured 4/7 = 0.57
+    "dygraph_to_static/test_cpu_cuda_to_tensor.py": (0.40, 1),  # measured 2/4 = 0.50
+    "dygraph_to_static/test_fetch_feed.py": (0.90, 1),  # measured 2/2 = 1.00
+    "dygraph_to_static/test_full_name_usage.py": (0.40, 1),  # measured 1/2 = 0.50
+    "dygraph_to_static/test_grad.py": (0.20, 1),  # measured 2/7 = 0.29
+    "dygraph_to_static/test_ifelse.py": (0.55, 18),  # measured 20/31 = 0.65
+    "dygraph_to_static/test_lambda.py": (0.90, 1),  # measured 1/1 = 1.00
+    "dygraph_to_static/test_lstm.py": (0.10, 1),  # measured 1/5 = 0.20
+    "dygraph_to_static/test_params_no_grad.py": (0.90, 1),  # measured 1/1 = 1.00
+    "dygraph_to_static/test_partial_program.py": (0.15, 1),  # isolated 2/5; in-suite 1/5
+    "dygraph_to_static/test_slice.py": (0.80, 7),  # isolated 9/9; in-suite 8/9
+    "dygraph_to_static/test_tensor_methods.py": (0.40, 1),  # measured 1/2 = 0.50
+    "dygraph_to_static/test_tensor_shape.py": (0.35, 19),  # measured 21/47 = 0.45
+    # distribution/ + rnn/ subdirectories (round-5: full
+    # transform/constraint/variable surface, expfamily Bregman
+    # entropy, Beta/Dirichlet exponential-family, KL registry)
+    "distribution/test_distribution_beta.py": (0.80, 14),  # measured 16/18 = 0.89
+    "distribution/test_distribution_beta_static.py": (0.45, 9),  # measured 10/18 = 0.56
+    "distribution/test_distribution_constraint.py": (0.90, 7),  # measured 8/8 = 1.00
+    "distribution/test_distribution_dirichlet.py": (0.75, 5),  # measured 6/7 = 0.86
+    "distribution/test_distribution_dirichlet_static.py": (0.70, 3),  # measured 4/5 = 0.80
+    "distribution/test_distribution_expfamily.py": (0.90, 3),  # measured 4/4 = 1.00
+    "distribution/test_distribution_independent.py": (0.75, 5),  # measured 6/7 = 0.86
+    "distribution/test_distribution_independent_static.py": (0.90, 3),  # measured 4/4 = 1.00
+    "distribution/test_distribution_normal.py": (0.40, 9),  # measured 10/20 = 0.50
+    "distribution/test_distribution_transform.py": (0.80, 143),  # measured 163/180 = 0.91
+    "distribution/test_distribution_transform_static.py": (0.80, 84),  # measured 96/110 = 0.87
+    "distribution/test_distribution_transformed_distribution.py": (0.90, 1),  # measured 2/2 = 1.00
+    "distribution/test_distribution_uniform.py": (0.40, 11),  # measured 12/24 = 0.50
+    "distribution/test_distribution_variable.py": (0.90, 3),  # measured 4/4 = 1.00
+    "distribution/test_kl.py": (0.70, 3),  # measured 4/5 = 0.80
+    "distribution/test_kl_static.py": (0.50, 2),  # measured 3/5 = 0.60
+    "rnn/test_rnn_cells.py": (0.25, 1),  # isolated 3/6; in-suite 2/6 (fp32 tolerance flake)
+    "rnn/test_rnn_cudnn_params_packing.py": (0.90, 1),  # measured 1/1 = 1.00
     # dy2static conformance (VERDICT r3 task 4): the reference's own
     # dygraph_to_static unittests running against jit/dy2static.py.
     # The misses are cases asserting the REFERENCE's limitations
@@ -472,7 +506,8 @@ def _numpy_compat():
 
 
 def _ensure_paths():
-    for p in (SHIMS, UT, D2S, os.path.join(UT, "rnn")):
+    for p in (SHIMS, UT, D2S, os.path.join(UT, "rnn"),
+              os.path.join(UT, "distribution")):
         if p not in sys.path:
             sys.path.append(p)
     # our shim must win over the reference's own op_test.py, under every
