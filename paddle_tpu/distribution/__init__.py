@@ -158,11 +158,13 @@ class Categorical(Distribution):
 
     def kl_divergence(self, other):
         """KL(self || other) over the category axis (reference
-        distribution/categorical.py kl_divergence)."""
+        distribution/categorical.py kl_divergence — keepdims, so the
+        result is [..., 1] like the C++ op)."""
         def f(lg, lg2):
             p = jax.nn.softmax(lg, -1)
             return jnp.sum(p * (jax.nn.log_softmax(lg, -1)
-                                - jax.nn.log_softmax(lg2, -1)), axis=-1)
+                                - jax.nn.log_softmax(lg2, -1)),
+                           axis=-1, keepdims=True)
         return apply(f, self.logits, other.logits)
 
 

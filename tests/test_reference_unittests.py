@@ -448,6 +448,7 @@ TARGETS = {
     "distribution/test_kl_static.py": (0.50, 2),  # measured 3/5 = 0.60
     "rnn/test_rnn_cells.py": (0.25, 1),  # isolated 3/6; in-suite 2/6 (fp32 tolerance flake)
     "rnn/test_rnn_cudnn_params_packing.py": (0.90, 1),  # measured 1/1 = 1.00
+    "distribution/test_distribution_categorical.py": (0.30, 7),  # measured 9/22 = 0.41 (static variants are shape-from-feed)
     # dy2static conformance (VERDICT r3 task 4): the reference's own
     # dygraph_to_static unittests running against jit/dy2static.py.
     # The misses are cases asserting the REFERENCE's limitations
