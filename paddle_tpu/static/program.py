@@ -277,6 +277,10 @@ class Executor:
         prog = program if program is not None else default_main_program()
         if isinstance(prog, CompiledProgram):
             prog = prog._program
+        if isinstance(fetch_list, (str, Tensor)):
+            # reference Executor accepts a bare name/var
+            # (fetch_list=loss.name is a common docstring idiom)
+            fetch_list = [fetch_list]
         feed = feed or {}
         for name in feed:
             if name not in prog._feed_vars:

@@ -116,6 +116,12 @@ TARGETS = {
     "nn/utils/weight_norm_hook.py": 0.95,
     "fluid/layers/tensor.py": 0.85,
     "fluid/layers/nn.py": 0.75,
+    # round-5 additions: the full transform surface + KL registry
+    "distribution/transform.py": 0.85,
+    "distribution/kl.py": 0.95,
+    "distribution/transformed_distribution.py": 0.95,
+    "distribution/multinomial.py": 0.95,
+    "distribution/independent.py": 0.95,
 }
 
 
@@ -232,11 +238,14 @@ def test_reference_examples_pass_rate(relpath, floor):
                     continue  # [malformed]: not a runnable example
                 total += 1
                 # deterministic per example: outcomes must not depend on
-                # RNG state left behind by earlier tests/examples (numpy,
-                # stdlib random AND the paddle key); seeding happens
-                # outside the try so a harness-side failure raises
-                # instead of being miscounted as an example failure
+                # RNG state OR global modes left behind by earlier
+                # examples (an enable_static() left on by one example
+                # breaks every dygraph example after it — each reference
+                # docstring example assumes a fresh interpreter);
+                # happens outside the try so a harness-side failure
+                # raises instead of being miscounted
                 _seed_all(1234)
+                _reset_global_modes()
                 try:
                     with warnings.catch_warnings():
                         warnings.simplefilter("ignore")
