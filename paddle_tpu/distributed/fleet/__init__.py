@@ -160,9 +160,13 @@ class _ASPMaskedStep:
 
         params = getattr(self._step, "_params", None)
         # scope to THIS step's parameters — another pruned model in the
-        # process may be dense-finetuning (same scoping as asp.decorate)
+        # process may be dense-finetuning (same scoping as asp.decorate).
+        # A step that owns NO params must skip entirely: passing None
+        # would widen to every pruned model in the process.
         own = {id(p) for p in (params or {}).values()}
-        _reapply_masks(own or None)
+        if not own:
+            return out
+        _reapply_masks(own)
         vals = getattr(self._step, "_param_vals", None)
         if vals is not None and params is not None:
             for k, p in params.items():

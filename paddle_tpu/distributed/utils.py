@@ -190,8 +190,17 @@ def _dispatch(x, local_count, global_count, group, name, raw_fn,
         return apply(lambda a: raw_fn(a, lc, gc, ax, cap), x)
     # eager single controller: world_size 1 — card-major and expert-major
     # coincide, so the dispatch is the identity on the first sum(counts)
-    # rows (exact dynamic shape, like the reference kernel)
+    # rows (exact dynamic shape, like the reference kernel). The identity
+    # only holds when both sides agree on the row total; mismatched
+    # counts are invalid input and must raise, not return wrong rows.
     import numpy as np
+    lc_sum = int(np.asarray(lc).sum())
+    gc_sum = int(np.asarray(gc).sum())
+    if lc_sum != gc_sum:
+        raise ValueError(
+            f"{name}: local_count.sum() ({lc_sum}) != global_count.sum() "
+            f"({gc_sum}); at world_size 1 the counts must describe the "
+            "same rows")
     total = int(np.asarray(out_counts_first(lc, gc)).sum())
     return apply(lambda a: a[:total], x)
 

@@ -405,11 +405,9 @@ def kl_divergence(p, q):
     if isinstance(p, Normal) and isinstance(q, Normal):
         return p.kl_divergence(q)
     if isinstance(p, Categorical) and isinstance(q, Categorical):
-        def f(lp, lq):
-            pp = jax.nn.softmax(lp, -1)
-            return jnp.sum(pp * (jax.nn.log_softmax(lp, -1) -
-                                 jax.nn.log_softmax(lq, -1)), -1)
-        return apply(f, p.logits, q.logits)
+        # delegate to the method (reference kl.py does the same) so the
+        # module-level API keeps the [..., 1] keepdims shape contract
+        return p.kl_divergence(q)
     if isinstance(p, Beta) and isinstance(q, Beta):
         return _kl_beta_beta(p, q)
     if isinstance(p, Dirichlet) and isinstance(q, Dirichlet):

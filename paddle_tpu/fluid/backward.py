@@ -1,6 +1,10 @@
 """fluid.backward compat (reference python/paddle/fluid/backward.py)."""
 from ..static import append_backward, gradients  # noqa: F401
 
+# reference backward.py:2204 — the 1.x spelling of gradients(); same
+# signature, same grad-holder result
+calc_gradient = gradients
+
 
 def _append_grad_suffix_(name):
     """x → x@GRAD (reference backward.py:448)."""

@@ -1,3 +1,15 @@
 """fluid.executor compat (reference python/paddle/fluid/executor.py)."""
 from ..static import Scope, global_scope, scope_guard  # noqa: F401
 from ..static.program import Executor  # noqa: F401
+
+
+def as_numpy(tensor, copy=False):
+    """Reference executor.py::as_numpy — LoDTensor/Tensor (or nested
+    lists of them) to numpy arrays. exe.run(return_numpy=False) returns
+    live Tensors here; this converts them the 1.x way."""
+    import numpy as np
+
+    if isinstance(tensor, (list, tuple)):
+        return [as_numpy(t, copy) for t in tensor]
+    arr = np.asarray(tensor._data if hasattr(tensor, "_data") else tensor)
+    return arr.copy() if copy else arr

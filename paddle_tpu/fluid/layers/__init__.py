@@ -394,7 +394,13 @@ def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
         tt._data = _T.full(shape, value, dtype=dtype)._data
         tt._node = None
 
-    Program.record_mutation(_reset, reads=(), writes=(target,))
+    # pure replay form: the declared constant, baked at record time
+    # (Tensor-valued `value` must re-read it — host form only)
+    traced = None
+    if not hasattr(value, "_data"):
+        traced = lambda c=t._data: c  # noqa: E731
+    Program.record_mutation(_reset, reads=(), writes=(target,),
+                            traced=traced)
     return target
 
 
@@ -560,7 +566,8 @@ def _mk_cmp(fn):
                 c._data = o._data
                 c._node = None
 
-            Program.record_mutation(_sync, reads=(out,), writes=(cond,))
+            Program.record_mutation(_sync, reads=(out,), writes=(cond,),
+                                    traced=lambda v: v)
             return cond
         return out
     return op

@@ -60,7 +60,10 @@ class While:
                 if guard > 10_000_000:
                     raise RuntimeError("While exceeded 1e7 iterations")
 
-        self._prog._append_thunk(_loop)
+        # structured entry: the jitted Executor lowers this block to one
+        # lax.while_loop (carry = cond + every tensor the span writes);
+        # _loop stays the eager fallback at entry[1]
+        self._prog._ops.append(("while", _loop, cond, span))
 
 
 class IfElse:
@@ -167,5 +170,6 @@ class Switch:
                     Program._replay_entries(span)
                     return
 
-        self._prog._append_thunk(_dispatch)
+        # structured entry: jitted replay lowers to a lax.cond chain
+        self._prog._ops.append(("switch", _dispatch, cases))
         return False

@@ -499,7 +499,14 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
     # a Program carrying this thunk must stay picklable (paddle.save)
     import functools
     tick = functools.partial(_step_counter_tick, counter, step)
-    if hasattr(prog, "_append_thunk"):
+    if hasattr(prog, "_append_mutation"):
+        # declared mutation with a pure form: the global step threads
+        # through the compiled train step as functional state instead of
+        # forcing the whole program onto the eager path
+        prog._append_mutation(
+            tick, reads=(counter,), writes=(counter,),
+            traced=functools.partial(_step_counter_traced, step))
+    elif hasattr(prog, "_append_thunk"):
         prog._append_thunk(tick)
     else:
         tick()
@@ -509,6 +516,11 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
 def _step_counter_tick(counter, step):
     import jax.numpy as jnp
     counter._data = counter._data + jnp.asarray(step, jnp.int64)
+
+
+def _step_counter_traced(step, v):
+    import jax.numpy as jnp
+    return v + jnp.asarray(step, jnp.int64)
 
 
 # -- recurrent builders (reference fluid/layers/rnn.py) --------------------

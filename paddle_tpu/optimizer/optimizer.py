@@ -146,12 +146,15 @@ class Optimizer:
                     _current_main.all_parameters())
             # static-graph recording: defer backward+update to each
             # Executor.run replay (reference: optimizer ops appended to the
-            # program, run by the executor)
+            # program, run by the executor). The structured entry lets the
+            # jitted replay compile the whole train step — jax.grad for the
+            # backward, the pure update_param for the step, param/moment
+            # buffers donated — instead of dropping to op-by-op eager.
             def thunk():
                 loss.backward()
                 self.step()
                 self.clear_grad()
-            _current_main._append_thunk(thunk)
+            _current_main._ops.append(("minimize", thunk, self, loss))
             return None, None
         ran_backward = all(p.grad is None for p in self._all_params())
         if ran_backward:

@@ -997,7 +997,7 @@ class StaticRNN:
             for ph, seq in self._inputs:
                 ph._data = seq._data[step]
             for entry in self._entries:
-                if entry[0] == "thunk":
+                if entry[0] != "op":  # thunks/mutations/blocks: eager form
                     entry[1]()
                     continue
                 _, fn, args, kwargs, outs = entry

@@ -5,12 +5,13 @@ program_guard, data, append_backward, scopes, places). TPU-native design —
 "define-by-run recording, replay-to-execute": under ``program_guard`` every
 primitive flowing through :func:`paddle_tpu.tensor.apply` is appended to
 the active Program's op list with its input/output Tensor objects.
-``Executor.run`` writes feed values into the placeholder Tensors, replays
-the ops in order (rebuilding the eager tape so recorded
-``minimize``/``append_backward`` thunks can run backward+update), and
-fetches results. The XLA performance path for static graphs remains
-``paddle_tpu.jit.to_static`` — this module provides the full fluid-era
-API surface on the same primitives.
+``Executor.run`` writes feed values into the placeholder Tensors and
+executes the recorded program — by default through the COMPILED replay
+plan (one jitted XLA program per (program, feed signature, fetch set),
+training included: see the "compiled replay" section), falling back to
+in-order eager replay (rebuilding the eager tape so recorded
+``minimize``/``append_backward`` thunks can run backward+update) for
+programs the compiler rejects or when ``PADDLE_TPU_STATIC_JIT=0``.
 """
 from __future__ import annotations
 
@@ -31,12 +32,26 @@ class Program:
     """Reference: fluid/framework.py::Program."""
 
     def __init__(self):
-        self._ops = []          # ("op", fn, args, kwargs, outs) | ("thunk", f)
+        # Typed entry list. Every recorded step is a tuple whose head names
+        # its kind; entry[1] is ALWAYS the eager replay callable for
+        # non-"op" kinds, so `_replay_entries` needs no per-kind logic and
+        # the jit compiler can pattern-match on the structure:
+        #   ("op", fn, args, kwargs, outs)           pure primitive
+        #   ("thunk", f)                             opaque host step
+        #   ("mutation", f, reads, writes, traced)   in-place write;
+        #       traced(*read_vals) -> write vals, or None if host-only
+        #   ("while", f, cond, span)                 legacy While block
+        #   ("switch", f, cases)                     Switch; cases =
+        #       [(cond Tensor|None, span), ...]
+        #   ("backward", f, loss, holders)           append_backward
+        #   ("gradients", f, targets, inputs, holders)
+        #   ("minimize", f, optimizer, loss)         Optimizer.minimize
+        self._ops = []
         self._feed_vars = {}    # name -> placeholder Tensor
         self._vars = {}         # name -> Tensor (parameters/globals/fetch)
         self._tmp_vars = {}     # auto-named op outputs (fetch-by-name)
         self.random_seed = None
-        self._jit_cache = {}    # (n_ops, feed_sig, fetch_key) -> callable|None
+        self._jit_cache = {}    # (n_ops, feed_sig, fetch_key) -> plan|None
 
     def __getstate__(self):
         """paddle.save(program) serializes the reference's ProgramDesc —
@@ -49,6 +64,7 @@ class Program:
         d = dict(self.__dict__)
         d["_ops"] = []
         d["_jit_cache"] = {}
+        d.pop("_jit_pending", None)
         d["_tmp_vars"] = {}  # op outputs carry autograd-node closures
         # normalize_program's fetch Tensors carry autograd-node closures
         d.pop("_normalized", None)
@@ -168,32 +184,42 @@ class Program:
         self._replay_entries(self._ops)
 
     @staticmethod
-    def record_mutation(thunk, reads=(), writes=()):
+    def record_mutation(thunk, reads=(), writes=(), traced=None):
         """Run an in-place mutation now AND re-run it on every static
         replay (fluid idioms: increment, assign-into-var, cond out-
         params). No-op registration outside program recording.
 
         ``reads``/``writes`` declare the Tensors the thunk consumes and
         produces so the inference-slice exporter can keep forward-compute
-        mutations (assign, cond syncs) and trace through them; thunks
-        registered WITHOUT metadata are training-time host control flow
-        (optimizer steps, While loops, EMA buffers) and are dropped from
-        exported graphs."""
+        mutations (assign, cond syncs) and trace through them.
+        ``traced`` is the pure functional form ``traced(*read_values) ->
+        write value(s)`` used by the whole-program jitted replay; a
+        mutation without one (host RNG, numpy side effects) forces that
+        entry onto the eager path. Thunks registered WITHOUT metadata are
+        training-time host control flow (EMA buffers, host counters with
+        no functional form) and are dropped from exported graphs."""
         thunk()
         if _current_main is not None:
-            if reads or writes:
-                _current_main._ops.append(
-                    ("thunk", thunk, tuple(reads), tuple(writes)))
-            else:
-                _current_main._append_thunk(thunk)
+            _current_main._append_mutation(thunk, reads, writes, traced)
+
+    def _append_mutation(self, thunk, reads=(), writes=(), traced=None):
+        """Register a replayed mutation WITHOUT running it now (the
+        record_mutation variant for thunks whose record-time execution
+        would double-apply, e.g. step counters)."""
+        if reads or writes:
+            self._ops.append(("mutation", thunk, tuple(reads),
+                              tuple(writes), traced))
+        else:
+            self._append_thunk(thunk)
 
     @staticmethod
     def _replay_entries(entries):
-        """Replay a span of recorded ops/thunks (also used by the fluid
-        block-style control flow to re-run a body per iteration)."""
+        """Replay a span of recorded entries eagerly (also used by the
+        fluid block-style control flow to re-run a body per iteration).
+        Every non-"op" kind keeps its eager callable at entry[1]."""
         from ..tensor import apply
         for entry in entries:
-            if entry[0] == "thunk":
+            if entry[0] != "op":
                 entry[1]()
                 continue
             _, fn, args, kwargs, outs = entry
@@ -309,24 +335,54 @@ class Executor:
 # -- compiled replay -------------------------------------------------------
 #
 # Reference: fluid/executor.py — the C++ executor IS the static-graph perf
-# path (op fusion, no per-op python). TPU-native analog: trace the
-# recorded op list ONCE per (program, feed shapes/dtypes, fetch set) into
-# a single jax.jit program, so a 1.x-style `exe.run(feed, fetch_list)`
-# loop gets whole-graph XLA instead of op-by-op eager replay. Programs
-# with thunks (append_backward / optimizer minimize / While blocks /
-# py_func host calls) keep the eager replay — those closures need the
-# live tape. Replay randomness is identical in both paths: PRNG keys are
-# baked into the recorded closures at build time.
+# path (op fusion, no per-op python). TPU-native analog: compile the
+# recorded entry list ONCE per (program, feed shapes/dtypes, fetch set)
+# into one jax.jit program, so a 1.x-style `exe.run(feed, fetch_list)`
+# loop gets whole-graph XLA instead of op-by-op eager replay. This covers
+# TRAINING programs too: `append_backward` / `Optimizer.minimize` entries
+# re-derive gradients with jax.grad inside the trace, parameters and
+# optimizer moments thread through as functional state with DONATED
+# buffers (copy-free in-place update), legacy While/Switch blocks lower
+# to lax.while_loop / lax.cond chains, and declared mutations replay
+# their pure `traced` form. Only genuinely untraceable host steps
+# (py_func, Print, host-RNG mutations) stay eager — per entry, not per
+# program: the plan splits into compiled segments around them. Replay
+# randomness is identical in both paths: PRNG keys are baked into the
+# recorded closures at build time.
+
+import itertools as _itertools
+
+_token_counter = _itertools.count()
+
+
+def _stable_token(t):
+    """Monotonic per-Tensor token for cache keys. id() reuse after GC
+    could resurrect a stale "not jittable" cache verdict; tokens never
+    recur, so a fresh Tensor can never alias a dead one's cache entry."""
+    tok = getattr(t, "_token", None)
+    if tok is None:
+        tok = next(_token_counter)
+        t._token = tok
+    return tok
+
+
+class _NotJittable(Exception):
+    pass
+
+
+def _jit_debug(msg):  # pragma: no cover - debug aid
+    if os.environ.get("PADDLE_TPU_STATIC_JIT_DEBUG", "0") != "0":
+        print(f"[static-jit] {msg}")
+
 
 def _jit_replay_run(prog, feed, fetch_list):
-    """Run one Executor.run via the cached jitted replay. Returns the
+    """Run one Executor.run via the cached compiled plan. Returns the
     fetched Tensors, or None when this program/feed must use the eager
     path."""
     if os.environ.get("PADDLE_TPU_STATIC_JIT", "1") == "0":
         return None
     ops = getattr(prog, "_ops", None)
-    if not ops or any(e[0] != "op" for e in ops) \
-            or getattr(prog, "_jit_cache", None) is None:
+    if not ops or getattr(prog, "_jit_cache", None) is None:
         return None
     feed_names = sorted(feed)
     raw_feed = {}
@@ -334,7 +390,8 @@ def _jit_replay_run(prog, feed, fetch_list):
         v = feed[n]
         raw_feed[n] = jnp.asarray(v._data if isinstance(v, Tensor) else v)
     try:
-        fetch_key = tuple(f if isinstance(f, str) else id(f)
+        fetch_key = tuple(f if isinstance(f, str)
+                          else ("#t", _stable_token(f))
                           for f in fetch_list)
         key = (len(prog._ops),
                tuple((n, tuple(raw_feed[n].shape), str(raw_feed[n].dtype))
@@ -342,116 +399,755 @@ def _jit_replay_run(prog, feed, fetch_list):
                fetch_key)
     except Exception:
         return None
-    entry = prog._jit_cache.get(key)
-    if entry is None and key not in prog._jit_cache:
-        entry = _build_jit_replay(prog, feed_names, fetch_list, raw_feed)
-        prog._jit_cache[key] = entry  # None = not jittable, stay eager
-    if entry is None:
+    plan = prog._jit_cache.get(key)
+    if plan is None and key not in prog._jit_cache:
+        # Programs beyond pure op-lists (training thunks, control-flow
+        # blocks) trace a much bigger XLA program (jax.grad re-derives
+        # the backward); a one-shot exe.run would pay the compile and
+        # never amortize it. First sighting of such a key runs eager and
+        # only a REPEAT triggers the build — the 1.x train loop hits the
+        # compiled path from step 2 on, single-shot programs never stall.
+        if any(e[0] != "op" for e in ops):
+            pending = getattr(prog, "_jit_pending", None)
+            if pending is None:
+                pending = prog._jit_pending = {}
+            seen = pending.get(key, 0) + 1
+            pending[key] = seen
+            if seen < 2:
+                return None
+        # the build EXECUTES the first run (compiling each segment just
+        # before running it, so every probe sees live shapes); `fetched`
+        # is None only when nothing ran and eager should take over
+        plan, fetched = _build_replay_plan(prog, feed_names, fetch_list,
+                                           raw_feed)
+        prog._jit_cache[key] = plan  # None = not jittable, stay eager
+        if fetched is not None:
+            return fetched
         return None
-    compiled, ext_inputs, out_tensors, n_fetch = entry
-    vals = [raw_feed[n] if isinstance(n, str) else n._data
-            for n in ext_inputs]
+    if plan is None:
+        return None
     try:
-        results = compiled(vals)
+        return plan.run(prog, raw_feed, feed_names)
     except Exception as e:  # pragma: no cover - transient runtime error
         # do NOT poison the cache: a transient failure (device hiccup,
-        # one-off OOM) must not silently disable the fast path forever
+        # one-off OOM) must not silently disable the fast path forever.
+        # If a donated buffer already died there is nothing to fall back
+        # to — re-raise instead of silently training on dead state.
+        if plan.donated and plan.state_dead():
+            raise
         import warnings
         warnings.warn(
             f"static jit replay failed ({type(e).__name__}: "
             f"{str(e)[:120]}); running this step eagerly", stacklevel=3)
         return None
-    with _no_record():
-        for name in feed_names:  # keep var() reads consistent with eager
-            ph = prog._feed_vars[name]
-            ph._data = raw_feed[name]
-            ph._node = None
-        # out_tensors = fetches + every NAMED program var the ops
-        # produce, so prog.var()/scope reads match the eager replay
-        for t, r in zip(out_tensors, results):
+
+
+# -- plan construction -----------------------------------------------------
+
+_BACKWARD_KINDS = ("backward", "gradients", "minimize")
+
+
+def _entry_writes(e, out, seen):
+    """Ordered unique Tensors an entry writes (recursing into blocks)."""
+    k = e[0]
+    if k == "op":
+        ws = [o for o in e[4] if isinstance(o, Tensor)]
+    elif k == "mutation":
+        ws = e[3]
+    elif k == "while":
+        _span_writes(e[3], out, seen)
+        ws = (e[2],)
+    elif k == "switch":
+        for _c, span in e[2]:
+            _span_writes(span, out, seen)
+        ws = ()
+    elif k == "backward":
+        ws = [h for _p, h in e[3]]
+    elif k == "gradients":
+        ws = e[4]
+    elif k == "minimize":
+        opt = e[2]
+        ws = [p for p in (opt._parameter_list or []) if p.trainable]
+    else:
+        ws = ()
+    for w in ws:
+        if id(w) not in seen:
+            seen.add(id(w))
+            out.append(w)
+
+
+def _span_writes(span, out=None, seen=None):
+    if out is None:
+        out, seen = [], set()
+    for e in span:
+        _entry_writes(e, out, seen)
+    return out
+
+
+def _entry_has_backward(e):
+    k = e[0]
+    if k in _BACKWARD_KINDS:
+        return True
+    if k == "while":
+        return any(_entry_has_backward(s) for s in e[3])
+    if k == "switch":
+        return any(_entry_has_backward(s) for _c, span in e[2]
+                   for s in span)
+    return False
+
+
+class _JitSegment:
+    """One compiled run of consecutive traceable entries."""
+
+    __slots__ = ("compiled", "ext_order", "out_tensors", "state_specs",
+                 "donated", "alias_count")
+
+    def gather_state(self):
+        vals = []
+        for spec in self.state_specs:
+            if spec[0] == "param":
+                vals.append(spec[1]._data)
+            else:
+                _, opt, p, key_ = spec
+                vals.append(opt._accumulators[id(p)][key_])
+        return vals
+
+    def state_dead(self):
+        return any(getattr(v, "is_deleted", lambda: False)()
+                   for v in self.gather_state())
+
+    def run(self, raw_feed):
+        state_vals = self.gather_state()
+        ext_vals = []
+        for kind, ref in self.ext_order:
+            if kind == "feed":
+                ext_vals.append(raw_feed[ref])
+            elif kind == "tensor":
+                ext_vals.append(ref._data)
+            else:  # "lr": live host scalar, so LR decay doesn't recompile
+                ext_vals.append(jnp.asarray(ref.get_lr(), jnp.float32))
+        outs, new_state = self.compiled(state_vals, ext_vals)
+        for t, r in zip(self.out_tensors, outs):
             t._data = r
             t._node = None
-    return out_tensors[:n_fetch]
+        for spec, v in zip(self.state_specs, new_state):
+            if spec[0] == "param":
+                spec[1]._data = v
+                spec[1]._node = None
+            else:
+                _, opt, p, key_ = spec
+                opt._accumulators[id(p)][key_] = v
 
 
-def _build_jit_replay(prog, feed_names, fetch_list, raw_feed):
-    """Trace the program's op list into one AOT-compiled callable.
-    Returns (compiled, ext_inputs, out_tensors, n_fetch) or None when
-    not jittable. ``ext_inputs`` entries are feed names (str) or live
-    Tensors whose CURRENT value is read each run (parameters keep
-    updating). ``out_tensors`` is fetches followed by every named
-    program var the ops produce — refreshed so ``prog.var()`` reads
-    stay consistent with the eager replay."""
-    import jax
+class _ReplayPlan:
+    """Alternating compiled segments and eager host entries covering one
+    (program, feed signature, fetch set)."""
 
-    def _is_t(x):
-        return isinstance(x, Tensor)
+    __slots__ = ("steps", "fetch_tensors", "calls", "n_host")
 
-    entries = prog._ops
-    produced = set()
-    ext, ext_order = {}, []
+    def __init__(self, steps, fetch_tensors):
+        self.steps = steps
+        self.fetch_tensors = fetch_tensors
+        self.calls = 0  # cache-hit counter (asserted by tests/bench)
+        self.n_host = sum(1 for k, _ in steps if k == "host")
+
+    @property
+    def segments(self):
+        return [s for k, s in self.steps if k == "jit"]
+
+    @property
+    def donated(self):
+        return any(s.donated for s in self.segments)
+
+    def state_dead(self):
+        return any(s.state_dead() for s in self.segments)
+
+    def run(self, prog, raw_feed, feed_names):
+        with _no_record():
+            for name in feed_names:  # keep var() reads eager-consistent
+                ph = prog._feed_vars[name]
+                ph._data = raw_feed[name]
+                ph._node = None
+            for kind, step in self.steps:
+                if kind == "jit":
+                    step.run(raw_feed)
+                else:  # host entry: eager, reads/writes live ._data
+                    Program._replay_entries([step])
+        self.calls += 1
+        return list(self.fetch_tensors)
+
+
+def _build_replay_plan(prog, feed_names, fetch_list, raw_feed):
+    """Compile the program into a _ReplayPlan AND perform the first run.
+
+    Returns ``(plan, fetched)``. Compilation interleaves with execution
+    — each segment is compiled against the live values the preceding
+    steps produced, then immediately run — so a host entry in the middle
+    can reshape tensors without breaking later probes. ``(None, None)``
+    means nothing executed (caller goes eager); ``(None, fetched)``
+    means this run completed but the program stays eager from now on."""
+    entries = list(prog._ops)
     try:
         fetch_tensors = [prog.var(f) if isinstance(f, str) else f
                          for f in fetch_list]
     except KeyError:
-        return None
-    feed_ids = {id(prog._feed_vars[n]): n for n in feed_names}
+        return None, None
+    # split into maximal traceable runs around host-only entries
+    runs, cur = [], []
     for e in entries:
+        if _entry_traceable(e):
+            cur.append(e)
+        else:
+            if cur:
+                runs.append(("jit", cur))
+                cur = []
+            runs.append(("host", e))
+    if cur:
+        runs.append(("jit", cur))
+    if not any(k == "jit" for k, _ in runs):
+        return None, None  # nothing to compile — plain eager is cheaper
+    # gradient entries must live in the segment that starts at entry 0:
+    # a compiled prefix builds no eager tape, and a segment-local jax.grad
+    # can't see ops from earlier segments — either way the grads would
+    # silently stop at the boundary instead of matching eager replay
+    for i, (kind, payload) in enumerate(runs):
+        span = payload if kind == "jit" else [payload]
+        if any(_entry_has_backward(e) for e in span) and i != 0:
+            _jit_debug("backward-like entry outside the leading segment; "
+                       "falling back to eager replay")
+            return None, None
+    whole = len(runs) == 1
+    steps = []
+    with _no_record():
+        for name in feed_names:
+            ph = prog._feed_vars[name]
+            ph._data = raw_feed[name]
+            ph._node = None
+        for idx, (kind, payload) in enumerate(runs):
+            if kind == "host":
+                Program._replay_entries([payload])
+                steps.append(("host", payload))
+                continue
+            final = idx == len(runs) - 1
+            seg = None
+            try:
+                seg = _compile_segment(
+                    prog, payload, feed_names, raw_feed,
+                    fetch_tensors if final else None,
+                    donate=whole, write_all=not whole)
+                seg.run(raw_feed)
+            except Exception as e:
+                _jit_debug(f"segment build failed: "
+                           f"{type(e).__name__}: {str(e)[:200]}")
+                if isinstance(e, KeyboardInterrupt):
+                    raise
+                # finish THIS run eagerly from here; future runs eager.
+                # (A failed donated call can leave dead state buffers —
+                # nothing to replay on, so surface the original error.)
+                try:
+                    dead = seg is not None and seg.state_dead()
+                except Exception:
+                    dead = False
+                if dead:
+                    raise
+                for k2, p2 in runs[idx:]:
+                    Program._replay_entries(
+                        p2 if k2 == "jit" else [p2])
+                return None, list(fetch_tensors)
+            steps.append(("jit", seg))
+    plan = _ReplayPlan(steps, fetch_tensors)
+    plan.calls = 1
+    return plan, list(fetch_tensors)
+
+
+def _entry_traceable(e):
+    """Shallow+deep structural check: can this entry enter a compiled
+    segment at all? (The trace itself may still fail — e.g. grads
+    through a While — which fails the whole build → eager.)"""
+    try:
+        _scan_entry_jittable(e)
+        return True
+    except _NotJittable:
+        return False
+
+
+def _scan_entry_jittable(e):
+    import jax
+    k = e[0]
+    if k == "op":
         _, fn, args, kwargs, outs = e
+
+        def _is_t(x):
+            return isinstance(x, Tensor)
         if any(_is_t(leaf) for leaf in jax.tree_util.tree_leaves(
                 kwargs, is_leaf=_is_t)):
-            return None  # Tensor-valued kwarg: apply bakes it — unsafe
+            raise _NotJittable("Tensor-valued kwarg")
         for a in args:
-            if _is_t(a):
-                if id(a) not in produced and id(a) not in ext:
-                    ext[id(a)] = len(ext_order)
-                    ext_order.append(a)
-            elif isinstance(a, (list, tuple, dict)):
-                if any(_is_t(leaf) for leaf in
-                       jax.tree_util.tree_leaves(a, is_leaf=_is_t)):
-                    return None  # Tensor nested in a container arg
-        for o in outs:
-            produced.add(id(o))
-    # fetches must be produced by ops or be externals/feeds
-    for t in fetch_tensors:
-        if id(t) not in produced and id(t) not in ext:
-            ext[id(t)] = len(ext_order)
-            ext_order.append(t)
-    # named vars the ops produce: refresh them too (fluid debugging /
-    # metric idioms read prog.var(name) without fetching)
-    out_tensors = list(fetch_tensors)
-    out_ids = {id(t) for t in fetch_tensors}
-    for t in prog._vars.values():
-        if id(t) in produced and id(t) not in out_ids:
-            out_tensors.append(t)
-            out_ids.add(id(t))
+            if isinstance(a, (list, tuple, dict)) and any(
+                    _is_t(leaf) for leaf in
+                    jax.tree_util.tree_leaves(a, is_leaf=_is_t)):
+                raise _NotJittable("Tensor nested in container arg")
+        return
+    if k == "mutation":
+        if e[4] is None:
+            raise _NotJittable("mutation without traced form")
+        return
+    if k == "while":
+        for s in e[3]:
+            _scan_entry_jittable(s)
+            if s[0] in _BACKWARD_KINDS:
+                raise _NotJittable("backward inside While block")
+        return
+    if k == "switch":
+        for _c, span in e[2]:
+            for s in span:
+                _scan_entry_jittable(s)
+                if s[0] in _BACKWARD_KINDS:
+                    raise _NotJittable("backward inside Switch block")
+        return
+    if k in ("backward", "gradients"):
+        return
+    if k == "minimize":
+        opt = e[2]
+        if opt._parameter_list is None:
+            raise _NotJittable("minimize without parameter list")
+        from ..nn.clip import ClipGradBase
+        if opt._grad_clip is not None and \
+                not isinstance(opt._grad_clip, ClipGradBase):
+            raise _NotJittable("unknown grad_clip type")
+        return
+    raise _NotJittable(f"host entry kind {k!r}")
 
-    def replay(vals):
-        env = dict(zip([id(t) for t in ext_order], vals))
-        for e in entries:
-            _, fn, args, kwargs, outs = e
-            a = [env[id(x)] if _is_t(x) else x for x in args]
-            res = fn(*a, **kwargs)
-            new = tuple(res) if isinstance(res, (tuple, list)) else (res,)
-            for o, r in zip(outs, new):
-                if r is not None:
-                    env[id(o)] = r
-        return tuple(env[id(t)] if id(t) in env else vals[ext[id(t)]]
-                     for t in out_tensors)
+
+def _compile_segment(prog, entries, feed_names, raw_feed, fetch_tensors,
+                     donate, write_all):
+    """AOT-compile one traceable run of entries.
+
+    The traced callable is ``replay(state_vals, ext_vals) -> (outs,
+    new_state)``: ``state_vals`` are parameter + optimizer-moment
+    buffers (donated when ``donate`` — the whole-program train-step
+    case — so XLA aliases the update in place, no O(params) copy),
+    ``ext_vals`` are feeds, live external Tensors and learning-rate
+    scalars re-read every call."""
+    import jax
+
+    feed_ids = {id(prog._feed_vars[n]): n for n in feed_names}
+    state_specs = []       # ("param", p) | ("opt", opt, p, key)
+    param_slot = {}        # id(param) -> state slot
+    opt_slot = {}          # (id(opt), id(p), key) -> state slot
+    ext_ids = {}           # id(tensor) -> ext slot
+    ext_order = []         # ("feed", name) | ("tensor", t) | ("lr", opt)
+    produced = set()
+
+    # pass 0: functional state — every minimize entry's params + moments
+    minimize_params = {}   # id(entry-opt) -> [trainable params]
+    for e in entries:
+        if e[0] != "minimize":
+            continue
+        opt = e[2]
+        params = [p for p in opt._parameter_list if p.trainable]
+        minimize_params[id(opt)] = params
+        for p in params:
+            if id(p) not in param_slot:
+                param_slot[id(p)] = len(state_specs)
+                state_specs.append(("param", p))
+            st = opt._accumulators.get(id(p))
+            if st is None:
+                st = opt.init_param_state(p._data)
+                opt._accumulators[id(p)] = st
+            for key_ in sorted(st):
+                sk = (id(opt), id(p), key_)
+                if sk not in opt_slot:
+                    opt_slot[sk] = len(state_specs)
+                    state_specs.append(("opt", opt, p, key_))
+        if not any(o is opt for k_, o in ext_order if k_ == "lr"):
+            ext_order.append(("lr", opt))
+
+    def note_read(t):
+        if not isinstance(t, Tensor):
+            return
+        if id(t) in produced or id(t) in param_slot or id(t) in ext_ids:
+            return
+        ext_ids[id(t)] = len(ext_order)
+        if id(t) in feed_ids:
+            ext_order.append(("feed", feed_ids[id(t)]))
+        else:
+            ext_order.append(("tensor", t))
+
+    def note_write(t):
+        produced.add(id(t))
+
+    def scan(span):
+        for e in span:
+            k = e[0]
+            if k == "op":
+                for a in e[2]:
+                    note_read(a)
+                for o in e[4]:
+                    if isinstance(o, Tensor):
+                        note_write(o)
+            elif k == "mutation":
+                for r in e[2]:
+                    note_read(r)
+                for w in e[3]:
+                    note_write(w)
+            elif k == "while":
+                note_read(e[2])
+                scan(e[3])
+                note_write(e[2])
+            elif k == "switch":
+                for c, sp in e[2]:
+                    if c is not None:
+                        note_read(c)
+                    scan(sp)
+            elif k == "backward":
+                note_read(e[2])
+                for p, h in e[3]:
+                    note_read(p)
+                    note_write(h)
+            elif k == "gradients":
+                for t in e[2]:
+                    note_read(t)
+                for i_ in e[3]:
+                    note_read(i_)
+                for h in e[4]:
+                    note_write(h)
+            elif k == "minimize":
+                note_read(e[3])
+                for p in minimize_params[id(e[2])]:
+                    note_write(p)
+            else:
+                raise _NotJittable(f"entry kind {k!r} in segment")
+    scan(entries)
+
+    # outputs: fetches + named program vars this segment produces (so
+    # prog.var()/scope reads match eager); intermediate segments write
+    # back EVERYTHING they produce — the following host entry may read
+    # any of it. State tensors write back through their own slots.
+    out_tensors = []
+    out_ids = set()
+
+    def add_out(t):
+        if id(t) not in out_ids and id(t) not in param_slot:
+            out_ids.add(id(t))
+            out_tensors.append(t)
+    if fetch_tensors is not None:
+        for t in fetch_tensors:
+            note_read(t)  # pass-through fetches become externals
+            add_out(t)
+        for t in prog._vars.values():
+            if id(t) in produced:
+                add_out(t)
+    if write_all:
+        for t in _span_writes(entries):
+            add_out(t)
+
+    n_state = len(state_specs)
+
+    def replay(state_vals, ext_vals):
+        env = {}
+        opt_state = {}
+        for i, spec in enumerate(state_specs):
+            if spec[0] == "param":
+                env[id(spec[1])] = state_vals[i]
+            else:
+                _, opt, p, key_ = spec
+                opt_state.setdefault((id(opt), id(p)), {})[key_] = \
+                    state_vals[i]
+        lr_vals = {}
+        for slot, (kind, ref) in enumerate(ext_order):
+            if kind == "lr":
+                lr_vals[id(ref)] = ext_vals[slot]
+            elif kind == "feed":
+                ph = prog._feed_vars[ref]
+                env[id(ph)] = ext_vals[slot]
+            else:
+                env[id(ref)] = ext_vals[slot]
+        ctx = {"env0": dict(env), "opt_state": opt_state,
+               "opt_state0": {k: dict(v) for k, v in opt_state.items()},
+               "lr": lr_vals, "minimize_params": minimize_params}
+        _trace_entries(entries, env, ctx)
+        outs = tuple(
+            env[id(t)] if id(t) in env else ext_vals[ext_ids[id(t)]]
+            for t in out_tensors)
+        new_state = []
+        for spec in state_specs:
+            if spec[0] == "param":
+                new_state.append(env[id(spec[1])])
+            else:
+                _, opt, p, key_ = spec
+                new_state.append(ctx["opt_state"][(id(opt), id(p))][key_])
+        return outs, tuple(new_state)
 
     # probe with the ACTUAL fed shapes (placeholders were recorded with
     # 1 for dynamic dims) so unjittable programs — data-dependent
-    # shapes, host callbacks — are detected at build time, not per run.
-    # AOT-compile the lowering: the cache key already pins shapes, and
-    # reusing the lowered module avoids a second full trace on first run.
-    probe = [raw_feed[feed_ids[id(t)]] if id(t) in feed_ids else t._data
-             for t in ext_order]
-    try:
-        executable = jax.jit(replay).lower(probe).compile()
-    except Exception:
-        return None
-    ext_inputs = [feed_ids.get(id(t), t) for t in ext_order]
-    return executable, ext_inputs, out_tensors, len(fetch_tensors)
+    # shapes, grads through While — are detected at build time, not per
+    # run. AOT-compile the lowering: the cache key already pins shapes.
+    state_probe = []
+    for spec in state_specs:
+        if spec[0] == "param":
+            state_probe.append(spec[1]._data)
+        else:
+            state_probe.append(spec[1]._accumulators[id(spec[2])][spec[3]])
+    ext_probe = []
+    for kind, ref in ext_order:
+        if kind == "feed":
+            ext_probe.append(raw_feed[ref])
+        elif kind == "tensor":
+            ext_probe.append(ref._data)
+        else:
+            ext_probe.append(jnp.asarray(ref.get_lr(), jnp.float32))
+    donate = donate and n_state > 0
+    jitted = jax.jit(replay, donate_argnums=(0,)) if donate \
+        else jax.jit(replay)
+    lowered = jitted.lower(state_probe, ext_probe)
+    alias_count = lowered.as_text().count("tf.aliasing_output") \
+        if donate else 0
+    seg = _JitSegment()
+    seg.compiled = lowered.compile()
+    seg.ext_order = ext_order
+    seg.out_tensors = out_tensors
+    seg.state_specs = state_specs
+    seg.donated = donate
+    seg.alias_count = alias_count
+    return seg
+
+
+# -- the traced interpreter ------------------------------------------------
+
+def _env_get(env, t):
+    v = env.get(id(t))
+    if v is None:
+        # untouched external constant (record-time value); reads that can
+        # vary between runs were registered as ext slots by the scan
+        return jnp.asarray(t._data)
+    return v
+
+
+def _bool_scalar(v):
+    return jnp.reshape(v, (-1,))[0].astype(bool)
+
+
+def _trace_entries(entries, env, ctx):
+    """Functionally execute a span of entries on traced values. ``env``
+    maps id(Tensor) -> traced value; ``ctx`` carries the segment-initial
+    env (for gradient re-derivation), threaded optimizer state and LR
+    scalars."""
+    import jax
+    for idx, e in enumerate(entries):
+        k = e[0]
+        if k == "op":
+            _, fn, args, kwargs, outs = e
+            a = [_env_get(env, x) if isinstance(x, Tensor) else x
+                 for x in args]
+            res = fn(*a, **kwargs)
+            new = tuple(res) if isinstance(res, (tuple, list)) else (res,)
+            for o, r in zip(outs, new):
+                if r is None or not isinstance(o, Tensor):
+                    continue
+                if o.stop_gradient:
+                    # mirror the eager tape: no node is recorded for
+                    # stop_gradient outs, so grads must not flow here
+                    r = jax.lax.stop_gradient(r)
+                env[id(o)] = r
+                _apply_override(env, ctx, o)
+        elif k == "mutation":
+            _, _f, reads, writes, traced = e
+            vals = traced(*[_env_get(env, r) for r in reads])
+            if not isinstance(vals, tuple):
+                vals = (vals,)
+            for w, v in zip(writes, vals):
+                env[id(w)] = jnp.asarray(v)
+                _apply_override(env, ctx, w)
+        elif k == "while":
+            _trace_while(e, env, ctx)
+        elif k == "switch":
+            _trace_switch(e, env, ctx)
+        elif k == "backward":
+            _, _f, loss, holders = e
+            params = [p for p, _h in holders]
+            grads = _trace_grads(entries[:idx], [loss], params, ctx)
+            for (_p, h), g in zip(holders, grads):
+                env[id(h)] = g
+        elif k == "gradients":
+            _, _f, tgts, ins, holders = e
+            grads = _trace_grads(entries[:idx], list(tgts), list(ins), ctx)
+            for h, g in zip(holders, grads):
+                env[id(h)] = g
+        elif k == "minimize":
+            _trace_minimize(e, entries[:idx], env, ctx)
+        else:
+            raise _NotJittable(f"entry kind {k!r} in trace")
+
+
+def _apply_override(env, ctx, t):
+    ov = ctx.get("overrides")
+    if ov and id(t) in ov:
+        env[id(t)] = ov[id(t)]
+
+
+def _trace_grads(prefix, targets, wrt, ctx):
+    """d(sum of targets)/d(wrt) by replaying the segment prefix under
+    jax.grad — the compiled analog of the eager tape walk. ``wrt`` may
+    be leaves (parameters, feeds) or intermediates: each write of a wrt
+    tensor is overridden with the independent variable, so the returned
+    cotangent matches seeding at that point."""
+    import jax
+
+    env0 = ctx["env0"]
+    wrt_ids = [id(t) for t in wrt]
+
+    def _fresh_ctx(overrides):
+        return {"env0": dict(env0), "opt_state":
+                {k_: dict(v) for k_, v in ctx["opt_state0"].items()},
+                "opt_state0": ctx["opt_state0"], "lr": ctx["lr"],
+                "minimize_params": ctx["minimize_params"],
+                "overrides": overrides}
+
+    # forward values of the wrt tensors at this point in the program;
+    # leaves (params/feeds) read straight from env0, intermediates need
+    # one forward replay of the prefix to find their current value
+    if all(i in env0 for i in wrt_ids):
+        primal = [env0[i] for i in wrt_ids]
+    else:
+        fenv = dict(env0)
+        _trace_entries(prefix, fenv, _fresh_ctx(None))
+        primal = [_env_get(fenv, t) for t in wrt]
+
+    def loss_fn(wrt_vals):
+        env = dict(env0)
+        overrides = dict(zip(wrt_ids, wrt_vals))
+        for i, v in overrides.items():
+            if i in env:
+                env[i] = v
+        _trace_entries(prefix, env, _fresh_ctx(overrides))
+        total = 0.0
+        for t in targets:
+            total = total + jnp.sum(_env_get(env, t))
+        return total
+
+    return jax.grad(loss_fn)(primal)
+
+
+def _trace_minimize(e, prefix, env, ctx):
+    """Traced Optimizer.minimize: jax.grad for the backward, the
+    optimizer's pure ``update_param`` for the step, state threaded
+    through ``ctx`` (reference: optimizer ops in the ProgramDesc, fused
+    by the executor; here they fuse into the same XLA program)."""
+    from ..regularizer import L1Decay, L2Decay
+
+    _, _f, opt, loss = e
+    params = ctx["minimize_params"][id(opt)]
+    grads = _trace_grads(prefix, [loss], params, ctx)
+    lr = ctx["lr"][id(opt)]
+    pgs = list(zip(params, grads))
+    if opt._grad_clip is not None:
+        pgs = opt._grad_clip(pgs)
+    for p, g in pgs:
+        lazy_sparse = getattr(opt, "_lazy", False) and \
+            getattr(p, "is_sparse_table", False)
+        reg = p.regularizer or opt._weight_decay
+        if isinstance(reg, (L1Decay, L2Decay)) and not lazy_sparse \
+                and not getattr(opt, "_decoupled", False):
+            g = g + reg.grad_term(_env_get(env, p))
+        # stateless algorithms (plain SGD) have no accumulator slots
+        st = ctx["opt_state"].get((id(opt), id(p)), {})
+        plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+        new_p, new_st = opt.update_param(_env_get(env, p), g, st, plr, p)
+        env[id(p)] = new_p
+        ctx["opt_state"][(id(opt), id(p))] = new_st
+
+
+def _trace_while(e, env, ctx):
+    """Lower a legacy While block to lax.while_loop: the carry is the
+    condition plus every Tensor the span writes; everything else closes
+    over as a loop constant."""
+    import jax
+
+    _, _f, cond_t, span = e
+    writes = _span_writes(span)
+    carry_ts = [cond_t] + [t for t in writes if t is not cond_t]
+    init = []
+    for t in carry_ts:
+        v = env.get(id(t))
+        init.append(jnp.asarray(t._data) if v is None else jnp.asarray(v))
+    outer = dict(env)
+
+    def cond_fn(carry):
+        return _bool_scalar(carry[0])
+
+    def body_fn(carry):
+        env2 = dict(outer)
+        for t, v in zip(carry_ts, carry):
+            env2[id(t)] = v
+        _trace_entries(span, env2, ctx)
+        return tuple(env2[id(t)] for t in carry_ts)
+
+    # align carry avals with the body outputs (weak-type promotion, and
+    # tensors first produced inside the loop start as zeros); two rounds
+    # reach the fixed point for promotion chains, like convert_while
+    aligned = list(init)
+    for _ in range(2):
+        avals = jax.eval_shape(body_fn, tuple(aligned))
+        nxt = []
+        for t, v, a in zip(carry_ts, aligned, avals):
+            if tuple(v.shape) != tuple(a.shape):
+                if id(t) in env:
+                    raise _NotJittable("While carry changes shape")
+                nxt.append(jnp.zeros(a.shape, a.dtype))
+            else:
+                nxt.append(v.astype(a.dtype))
+        aligned = nxt
+    out = jax.lax.while_loop(cond_fn, body_fn, tuple(aligned))
+    for t, v in zip(carry_ts, out):
+        env[id(t)] = v
+
+
+def _trace_switch(e, env, ctx):
+    """Lower a Switch block to a lax.cond chain (first true case wins,
+    matching the eager dispatch)."""
+    import jax
+
+    cases = e[2]
+    writes = []
+    seen = set()
+    for _c, span in cases:
+        for t in _span_writes(span):
+            if id(t) not in seen:
+                seen.add(id(t))
+                writes.append(t)
+    init = tuple(
+        jnp.asarray(env[id(t)]) if id(t) in env else jnp.asarray(t._data)
+        for t in writes)
+    outer = dict(env)
+
+    def make(i):
+        if i == len(cases):
+            return lambda vals: vals
+        cond_t, span = cases[i]
+
+        def run(vals, _span=span):
+            env2 = dict(outer)
+            for t, v in zip(writes, vals):
+                env2[id(t)] = v
+            _trace_entries(_span, env2, ctx)
+            outs = []
+            for t, v0 in zip(writes, init):
+                o = jnp.asarray(_env_get(env2, t))
+                # branches must agree with the pass-through avals
+                outs.append(o.astype(v0.dtype)
+                            if tuple(o.shape) == tuple(v0.shape) else o)
+            return tuple(outs)
+        if cond_t is None:
+            return run
+        nxt = make(i + 1)
+        pred = _bool_scalar(_env_get(env, cond_t))
+        return lambda vals, _p=pred, _r=run, _n=nxt: \
+            jax.lax.cond(_p, _r, _n, vals)
+
+    out = make(0)(init)
+    for t, v in zip(writes, out):
+        env[id(t)] = v
 
 
 # -- gradients ------------------------------------------------------------
@@ -465,6 +1161,12 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     params = parameter_list if parameter_list is not None \
         else prog.all_parameters()
     grad_holders = [(p, Tensor(jnp.zeros_like(p._data))) for p in params]
+    for p, g in grad_holders:
+        # reference naming: grads register as "<param>@GRAD" so the 1.x
+        # exe.run(fetch_list=[p.name + "@GRAD"]) idiom fetches them
+        if getattr(p, "name", None):
+            g.name = p.name + "@GRAD"
+            prog._tmp_vars[g.name] = g
 
     def thunk():
         for p, _ in grad_holders:  # fresh grads each run, no accumulation
@@ -473,7 +1175,9 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         for p, g in grad_holders:
             if p.grad is not None:
                 g._data = p.grad._data
-    prog._append_thunk(thunk)
+    # structured entry: the jitted replay re-derives these grads with
+    # jax.grad over the traced forward instead of walking the eager tape
+    prog._ops.append(("backward", thunk, loss, grad_holders))
     return grad_holders
 
 
@@ -496,7 +1200,8 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
         for i, h in zip(ins, holders):
             if i.grad is not None:
                 h._data = i.grad._data
-    prog._append_thunk(thunk)
+    prog._ops.append(("gradients", thunk, tuple(tgts), tuple(ins),
+                      tuple(holders)))
     return holders
 
 
@@ -523,6 +1228,8 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
         p = _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
                 default_initializer=default_initializer)
     key = name or f"param_{len(prog._vars)}"
+    if p.name is None:
+        p.name = key  # reference names every program parameter
     prog._vars[key] = p
     return p
 
@@ -592,13 +1299,16 @@ def serialize_program(feeds, fetches, program=None, **kwargs):
     needed = {id(f) for f in fs}
     kept = []
     for entry in reversed(prog._ops):
-        if entry[0] == "thunk":
-            if len(entry) >= 4:  # mutation with declared reads/writes
-                _, _thunk, reads, writes = entry
-                if any(id(w) in needed for w in writes):
-                    kept.append(entry)
-                    needed.update(id(r) for r in reads)
-            continue  # bare thunks: training-time host control flow
+        if entry[0] == "mutation":  # declared reads/writes: traceable
+            _, _thunk, reads, writes, _traced = entry
+            if any(id(w) in needed for w in writes):
+                kept.append(entry)
+                needed.update(id(r) for r in reads)
+            continue
+        if entry[0] != "op":
+            # thunks / While / Switch / backward / minimize: training-time
+            # host control flow, dropped from the exported forward
+            continue
         _, fn, args, kwargs, outs = entry
         if any(id(o) in needed for o in outs):
             kept.append(entry)
@@ -615,7 +1325,7 @@ def serialize_program(feeds, fetches, program=None, **kwargs):
     var_ids = {id(v) for v in prog._vars.values()}
     kept_out_ids = set()
     for entry in kept:
-        if entry[0] == "thunk":
+        if entry[0] == "mutation":
             kept_out_ids.update(id(w) for w in entry[3])
         else:
             kept_out_ids.update(id(o) for o in entry[4])

@@ -27,7 +27,8 @@ from .framework import dtype as dtype_mod
 
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_index",
-                 "name", "persistable", "_grad_hooks", "__weakref__")
+                 "name", "persistable", "_grad_hooks", "_token",
+                 "__weakref__")
 
     def __init__(self, data, dtype=None, stop_gradient: bool = True,
                  name: Optional[str] = None):
@@ -51,6 +52,10 @@ class Tensor:
         self.name = name
         self.persistable = False
         self._grad_hooks = None
+        # lazily-assigned monotonic id used by the static-graph jit cache:
+        # unlike id(), a token is never reused after the Tensor dies, so a
+        # cached "not jittable" verdict can't be resurrected by id reuse
+        self._token = None
 
     # -- basic properties ---------------------------------------------------
     @property

@@ -261,9 +261,13 @@ def assign(x, output=None):
             output._data = jnp.asarray(raw(x))
             output._node = None
 
-        Program.record_mutation(
-            _copy, reads=(x,) if isinstance(x, Tensor) else (),
-            writes=(output,))
+        if isinstance(x, Tensor):
+            Program.record_mutation(_copy, reads=(x,), writes=(output,),
+                                    traced=lambda v: jnp.asarray(v))
+        else:
+            const = jnp.asarray(raw(x))
+            Program.record_mutation(_copy, reads=(), writes=(output,),
+                                    traced=lambda c=const: c)
         return output
     return Tensor(jnp.asarray(raw(x)))
 

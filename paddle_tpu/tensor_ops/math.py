@@ -306,7 +306,8 @@ def increment(x, value=1.0, name=None):
         x._data = x._data + value
         x._node = None
 
-    Program.record_mutation(_inc, reads=(x,), writes=(x,))
+    Program.record_mutation(_inc, reads=(x,), writes=(x,),
+                            traced=lambda v: v + value)
     return x
 
 

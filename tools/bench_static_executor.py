@@ -1,9 +1,19 @@
 """Microbench: jitted Executor replay vs op-by-op eager replay
-(static/program.py _jit_replay_run; reference fluid/executor.py is the
+(static/program.py _build_replay_plan; reference fluid/executor.py is the
 C++ fused executor). Run on CPU:
 
-    env JAX_PLATFORMS=cpu python tools/bench_static_executor.py
+    env JAX_PLATFORMS=cpu python tools/bench_static_executor.py          # inference
+    env JAX_PLATFORMS=cpu python tools/bench_static_executor.py --train  # minimize loop
+
+``--train`` benchmarks the reference 1.x training idiom — `minimize(loss)`
+once, then `exe.run(feed, fetch_list=[loss])` per step — compiled as ONE
+jitted XLA program (jax.grad backward + donated param/moment buffers)
+against the eager op-by-op replay, asserts the first 3 fetched losses are
+bitwise identical across both paths, and emits one JSON line in the
+bench.py ledger shape.
 """
+import argparse
+import json
 import os
 import sys
 import time
@@ -46,7 +56,50 @@ def time_loop(main, y, iters=50):
     return (time.perf_counter() - t0) / iters * 1e3, float(out)
 
 
-def main():
+def build_train(depth=12, width=256, lr=0.01):
+    """Reference-style fluid training program: stacked fc+relu, MSE,
+    SGDOptimizer.minimize recorded into the main program."""
+    import paddle_tpu.fluid as fluid
+
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, width], "float32")
+        yt = static.data("y", [None, 1], "float32")
+        h = x
+        params = []
+        for _ in range(depth):
+            layer = nn.Linear(width, width)
+            params += layer.parameters()
+            h = paddle.nn.functional.relu(layer(h))
+        head = nn.Linear(width, 1)
+        params += head.parameters()
+        loss = ((head(h) - yt) ** 2).mean()
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=lr,
+                                           parameter_list=params)
+        opt.minimize(loss)
+    return main, loss
+
+
+def time_train_loop(depth, width, iters, warmup=2):
+    main, loss = build_train(depth, width)
+    exe = static.Executor()
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(64, width)).astype(np.float32)
+    ys = rng.normal(size=(64, 1)).astype(np.float32)
+    losses = []
+    for _ in range(warmup):  # warm: build/compile + first steps
+        lv, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        lv, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    ms = (time.perf_counter() - t0) / iters * 1e3
+    return ms, losses, main
+
+
+def main_infer():
     prog, y = build()
     jit_ms, jit_val = time_loop(prog, y)
     os.environ["PADDLE_TPU_STATIC_JIT"] = "0"
@@ -58,5 +111,61 @@ def main():
     print(f"speedup             : {eager_ms / jit_ms:8.1f}x")
 
 
+def main_train(depth=12, width=256, iters=30):
+    os.environ.pop("PADDLE_TPU_STATIC_JIT", None)
+    jit_ms, jit_losses, prog = time_train_loop(depth, width, iters)
+    plan = next((p for p in prog._jit_cache.values() if p is not None),
+                None)
+    assert plan is not None, "train program did not take the compiled path"
+    assert plan.n_host == 0 and len(plan.segments) == 1, \
+        "train step must be ONE jitted callable (no per-op eager dispatch)"
+    seg = plan.segments[0]
+    assert seg.donated and seg.alias_count >= len(seg.state_specs), \
+        "param/moment buffers must be donated into the compiled step"
+    os.environ["PADDLE_TPU_STATIC_JIT"] = "0"
+    try:
+        eager_ms, eager_losses, _ = time_train_loop(depth, width, iters)
+    finally:
+        del os.environ["PADDLE_TPU_STATIC_JIT"]
+    # the fused train step must not change the numerics: the first 3
+    # fetched losses (fresh params, 1 update, 2 updates) are bitwise equal
+    bitwise = [a == b for a, b in zip(jit_losses[:3], eager_losses[:3])]
+    assert all(bitwise), {
+        "jit": jit_losses[:3], "eager": eager_losses[:3]}
+    speedup = eager_ms / jit_ms
+    print(f"eager op-by-op train step: {eager_ms:8.3f} ms/step",
+          file=sys.stderr)
+    print(f"compiled train step      : {jit_ms:8.3f} ms/step",
+          file=sys.stderr)
+    print(f"speedup                  : {speedup:8.1f}x", file=sys.stderr)
+    print(json.dumps({
+        "metric": f"fluid-1.x train step (fc{depth}x{width}, SGD minimize, "
+                  "compiled executor, cpu)",
+        "value": round(jit_ms, 4),
+        "unit": "ms/step",
+        "vs_baseline": round(speedup, 2),
+        "extra": {
+            "eager_ms_per_step": round(eager_ms, 4),
+            "speedup_vs_eager": round(speedup, 2),
+            "bitwise_first3": bitwise,
+            "loss_first3": jit_losses[:3],
+            "donated_buffers": len(seg.state_specs),
+            "aliased_outputs": seg.alias_count,
+            "segments": len(plan.segments),
+            "host_entries": plan.n_host,
+        },
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--train", action="store_true",
+                    help="benchmark the minimize+run training loop")
+    ap.add_argument("--depth", type=int, default=12)
+    ap.add_argument("--width", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=30)
+    args = ap.parse_args()
+    if args.train:
+        main_train(args.depth, args.width, args.iters)
+    else:
+        main_infer()
