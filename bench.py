@@ -118,9 +118,15 @@ def bench_resnet50(backend):
     from paddle_tpu.distributed import fleet
     from paddle_tpu.vision.models import resnet50, resnet18
 
-    def run_one(model_fn, batch, size, n_steps):
+    def run_one(model_fn, batch, size, n_steps, channels_last=False):
         paddle_tpu.seed(0)
-        model = fleet.distributed_model(model_fn(num_classes=1000))
+        model = model_fn(num_classes=1000)
+        if channels_last:
+            # NHWC-native conv pipeline (framework/layout.py): activations
+            # stay channels-last across the whole jitted step
+            from paddle_tpu.framework import to_channels_last
+            model = to_channels_last(model)
+        model = fleet.distributed_model(model)
         if backend == "tpu":
             model.to(dtype="bfloat16")
         opt = fleet.distributed_optimizer(
@@ -161,6 +167,14 @@ def bench_resnet50(backend):
     if best is None:
         raise RuntimeError(f"all resnet50 configs failed: {sweep}")
     best["sweep"] = sweep
+    # layout A/B at the winning batch: the NHWC plan is the conv-path
+    # perf bet (resnet50 ~20% MFU in NCHW, BENCH_r05) — record both
+    try:
+        r_cl = run_one(resnet50, best["batch"], 224, 6, channels_last=True)
+        best["images_per_sec_channels_last"] = r_cl["images_per_sec"]
+    except Exception as e:
+        best["images_per_sec_channels_last"] = (
+            f"FAIL: {type(e).__name__}: {str(e)[:80]}")
     return best
 
 

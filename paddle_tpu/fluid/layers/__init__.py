@@ -19,6 +19,7 @@ from ...static import (Print, data as _static_data,  # noqa: F401
                        create_global_var, create_parameter, py_func,
                        accuracy, auc)
 from ...static.nn import (StaticRNN, batch_norm,  # noqa: F401
+                          inplace_abn,
                           bilinear_tensor_product, case, cond, conv2d,
                           conv2d_transpose, conv3d, conv3d_transpose,
                           crf_decoding, data_norm, deform_conv2d, embedding,

@@ -44,6 +44,9 @@ __all__ = [
     'sampled_softmax_with_cross_entropy',
     # CRF sequence labeling
     'linear_chain_crf', 'crf_decoding',
+    # PS sparse-table pull ops (local dense-table emulation)
+    '_pull_sparse', '_pull_sparse_v2', '_pull_box_sparse',
+    'pull_box_sparse', 'pull_gpups_sparse',
 ]
 
 
@@ -1180,3 +1183,66 @@ def crf_decoding(input, param_attr=None, label=None, length=None):
     out = apply(dec, *args)
     out.stop_gradient = True  # argmax decode has no useful gradient
     return out
+
+
+# -- PS sparse-table pull ops (reference fluid/layers/nn.py::_pull_sparse /
+# _pull_box_sparse / pull_gpups_sparse). The reference fetches rows from a
+# parameter-server / BoxPS / GpuPS table; here the table is a local dense
+# parameter (the same redesign as static.nn.sparse_embedding — on TPU,
+# sharded-dense replaces the PS table) with ids hashed into a fixed row
+# count. Keeps the legacy 1.x builder surface importable and runnable. ---
+
+_PULL_TABLE_ROWS = 4096
+
+
+def _pull_table_lookup(one, size, dtype, name):
+    import jax.numpy as jnp
+
+    from ...static.program import create_parameter
+
+    table = create_parameter((_PULL_TABLE_ROWS, int(size)), dtype,
+                             name=name)
+    ids = one
+    if len(ids.shape) > 1 and int(ids.shape[-1]) == 1:
+        ids = _T.squeeze(ids, axis=-1)
+    ids = _T.mod(ids.astype("int64"),
+                 _p.to_tensor(np.int64(_PULL_TABLE_ROWS)))
+    return _F.embedding(ids, table)
+
+
+def _pull_sparse(input, size, table_id, accessor_class, name="embedding",
+                 ctr_label_name="", padding_id=0, dtype="float32",
+                 scale_sparse_grad=True):
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    outs = [_pull_table_lookup(o, size, dtype, None) for o in inputs]
+    return outs if isinstance(input, (list, tuple)) and len(outs) > 1 \
+        else outs[0]
+
+
+def _pull_sparse_v2(input, size, table_id, accessor_class,
+                    name="embedding", ctr_label_name="", padding_id=0,
+                    dtype="float32", scale_sparse_grad=True):
+    return _pull_sparse(input, size, table_id, accessor_class, name,
+                        ctr_label_name, padding_id, dtype,
+                        scale_sparse_grad)
+
+
+def _pull_box_sparse(input, size, dtype="float32", is_distributed=False,
+                     is_sparse=False):
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    outs = [_pull_table_lookup(o, size, dtype, None) for o in inputs]
+    return outs if isinstance(input, (list, tuple)) and len(outs) > 1 \
+        else outs[0]
+
+
+pull_box_sparse = _pull_box_sparse
+
+
+def pull_gpups_sparse(input, size, dtype="float32", is_distributed=False,
+                      is_sparse=False):
+    sizes = size if isinstance(size, (list, tuple)) else [size]
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    outs = [_pull_table_lookup(o, sizes[min(i, len(sizes) - 1)], dtype, None)
+            for i, o in enumerate(inputs)]
+    return outs if isinstance(input, (list, tuple)) and len(outs) > 1 \
+        else outs[0]

@@ -38,4 +38,14 @@ def __getattr__(name):
         from ..nn.layer_base import ParamAttr
 
         return ParamAttr
+    # layout planner surface (lazy: layout imports nn.layer classes,
+    # which import framework.dtype — eager import here would cycle)
+    if name in ("layout", "to_channels_last", "fold_conv_bn",
+                "ChannelsLast", "LayoutPlan", "count_hlo_transposes"):
+        import importlib
+
+        layout = importlib.import_module(__name__ + ".layout")
+        if name == "layout":
+            return layout
+        return getattr(layout, name)
     raise AttributeError(f"module 'paddle.framework' has no {name!r}")

@@ -1,0 +1,252 @@
+"""Channels-last (NHWC) layout planning for conv models.
+
+TPUs — and XLA:CPU — are natively channels-last: an NCHW-shaped conv
+pipeline forces the backend to materialize layout transposes between
+every conv/norm/pool, which is exactly where the resnet path loses MFU.
+The public API stays NCHW-default like the reference; this module plans
+the layout *internally*:
+
+* ``to_channels_last(model)`` rewrites every Conv2D / BatchNorm2D /
+  MaxPool2D / AvgPool2D / AdaptiveAvgPool2D (and their 1D/3D siblings)
+  in the layer tree to its channels-last ``data_format`` and returns a
+  :class:`ChannelsLast` wrapper whose forward transposes once at the
+  region entry (NCHW → NHWC) and, for 4D outputs, once at the exit.
+  Between those boundaries every op consumes/produces NHWC natively via
+  conv dimension numbers and per-dim reduce windows
+  (nn/functional/{conv,pooling,norm}.py) — zero interior transposes in
+  the emitted HLO (``tools/check_hlo_layout.py`` enforces this on CPU).
+
+* ``fold_conv_bn(model)`` constant-folds eval-mode BatchNorm into the
+  preceding conv's weight/bias (inference/export only); the following
+  ReLU is left for XLA's fusion pass.
+
+* ``count_hlo_transposes(...)`` is the lint primitive: it lowers a
+  jitted forward and counts transpose ops in both the emitted StableHLO
+  (what this framework controls) and the backend-optimized HLO (what the
+  compiler had to insert).
+
+Because the plan is carried by layer attributes, ``jit.to_static``
+traces and the static-Program record/replay executor inherit it with no
+extra plumbing: whatever the converted layers emit is what gets traced,
+recorded, and compiled.
+
+The wrapper contract requires the wrapped region to be layout-safe:
+every spatially-shaped op must be a converted layer or an elementwise
+op, and any flatten must happen after spatial dims collapse to 1×1.
+Models in the vision zoo that satisfy this opt in via the
+``_channels_last_safe`` class attribute (ResNet/ResNeXt, MobileNetV1/2/3);
+models with channel-axis concat or flatten-of-spatial heads (DenseNet,
+Inception, VGG, ShuffleNet, SqueezeNet) do not, and require
+``force=True`` plus caller-managed boundaries.
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+
+
+class LayoutPlan:
+    """Record of a channels-last conversion: which layers were rewritten
+    and where the layout boundaries sit."""
+
+    def __init__(self, converted, boundary="NCHW->NHWC@entry"):
+        self.converted = tuple(converted)
+        self.boundary = boundary
+
+    def __repr__(self):
+        return (f"LayoutPlan({len(self.converted)} layers channels-last, "
+                f"boundary={self.boundary!r})")
+
+
+_CHANNEL_LAST = {"NCHW": "NHWC", "NCW": "NWC", "NCL": "NLC",
+                 "NCDHW": "NDHWC"}
+
+
+def _convert_layer(layer):
+    """Flip one layer to its channels-last data_format. Returns True if
+    the layer was rewritten."""
+    from ..nn.layer.conv import _ConvNd
+    from ..nn.layer.norm import _BatchNormBase
+    from ..nn.layer.pooling import _Pool
+
+    if isinstance(layer, (_ConvNd, _BatchNormBase)):
+        new = _CHANNEL_LAST.get(layer._data_format)
+        if new is not None:
+            layer._data_format = new
+            return True
+        return False
+    if isinstance(layer, _Pool):
+        new = _CHANNEL_LAST.get(layer._kw.get("data_format"))
+        if new is not None:
+            layer._kw["data_format"] = new
+            return True
+        # adaptive max pools take no data_format kwarg in the reference
+        # signature; they stay channels-first (none in the safe zoo)
+        return False
+    return False
+
+
+def to_channels_last(model, force=False):
+    """Rewrite ``model``'s conv/BN/pool layers to channels-last and wrap
+    it so activations stay NHWC across the whole jitted region.
+
+    The public contract is unchanged: the wrapper takes NCHW input
+    (transposed once at entry) and returns NCHW for 4D outputs
+    (transposed once at exit); 2D outputs (classifier logits) pass
+    through untouched. ``train()/eval()`` and ``state_dict`` follow the
+    wrapped model (keys gain a ``model.`` prefix).
+    """
+    if isinstance(model, ChannelsLast):
+        return model
+    if not getattr(model, "_channels_last_safe", False) and not force:
+        raise ValueError(
+            f"{type(model).__name__} is not marked channels-last-safe "
+            "(needs every spatial op layout-aware and flatten only after "
+            "1x1 pooling); pass force=True to convert anyway")
+    converted = []
+    for name, sub in model.named_sublayers(include_self=True):
+        if _convert_layer(sub):
+            converted.append(name or type(sub).__name__)
+    return ChannelsLast(model, LayoutPlan(converted))
+
+
+def _layer_base():
+    from ..nn.layer_base import Layer
+    return Layer
+
+
+class ChannelsLast(_layer_base()):
+    """Layout-region boundary: NCHW in, NHWC inside, NCHW (or 2D) out.
+
+    ``plan`` records what was converted. In eval mode with bf16
+    parameters the forward also enables the inference-only fp32
+    conv-accumulation policy (nn/functional/conv.py:conv_accum_fp32).
+    """
+
+    def __init__(self, model, plan):
+        super().__init__()
+        self.model = model
+        object.__setattr__(self, "plan", plan)
+
+    def _run(self, x):
+        from ..tensor_ops.manipulation import transpose
+
+        if len(x.shape) == 4:
+            x = transpose(x, [0, 2, 3, 1])
+        out = self.model(x)
+        if hasattr(out, "shape") and len(out.shape) == 4:
+            out = transpose(out, [0, 3, 1, 2])
+        return out
+
+    def forward(self, x):
+        from ..nn.functional.conv import conv_accum_fp32
+
+        params = self.model.parameters()
+        if not self.training and params \
+                and params[0]._data.dtype == jnp.bfloat16:
+            with conv_accum_fp32():
+                return self._run(x)
+        return self._run(x)
+
+
+def fold_conv_bn(model):
+    """Inference-time conv+BN constant folding (in place).
+
+    For every Conv2D immediately followed — in sublayer registration
+    order within the same parent, the dataflow order everywhere in the
+    vision zoo — by a BatchNorm over the conv's out_channels, the BN's
+    eval-mode affine transform is folded into the conv weight/bias:
+
+        scale = gamma / sqrt(running_var + eps)
+        W'    = W * scale            (per out-channel)
+        b'    = (b - running_mean) * scale + beta
+
+    and the BN is replaced by Identity. Folding uses *running* stats, so
+    it is only valid for eval/export; call ``model.eval()`` first (a
+    warning is emitted otherwise). Any trailing ReLU is left in place
+    for XLA to fuse into the conv epilogue. Returns the list of folded
+    BN layer names.
+    """
+    from ..nn.layer.common import Identity
+    from ..nn.layer.conv import _ConvNd
+    from ..nn.layer.norm import _BatchNormBase
+
+    target = model.model if isinstance(model, ChannelsLast) else model
+    folded = []
+    for pname, parent in target.named_sublayers(include_self=True):
+        prev = None
+        for name, sub in list(parent._sub_layers.items()):
+            if (isinstance(sub, _BatchNormBase)
+                    and isinstance(prev, _ConvNd)
+                    and not prev._transpose
+                    and sub._num_features == prev._out_channels):
+                if sub.training:
+                    warnings.warn(
+                        "fold_conv_bn on a training-mode BN: folding uses "
+                        "running stats; call model.eval() first",
+                        stacklevel=2)
+                _fold_pair(prev, sub)
+                parent._sub_layers[name] = Identity()
+                folded.append(f"{pname}.{name}" if pname else name)
+                prev = None
+                continue
+            prev = sub
+    return folded
+
+
+def _fold_pair(conv, bn):
+    import numpy as np
+
+    from ..tensor import Parameter
+
+    # constant math in float64 (numpy — jax x64 stays off by policy) so
+    # the only fp32 error left is the runtime re-association x*(W*scale)
+    w = conv.weight._data
+    c = bn._num_features
+    gamma = (np.asarray(bn.weight._data, np.float64)
+             if bn.weight is not None else np.ones((c,)))
+    beta = (np.asarray(bn.bias._data, np.float64)
+            if bn.bias is not None else np.zeros((c,)))
+    mean = np.asarray(bn._mean._data, np.float64)
+    var = np.asarray(bn._variance._data, np.float64)
+    scale = gamma / np.sqrt(var + bn._epsilon)
+    wshape = (-1,) + (1,) * (w.ndim - 1)  # out-channel axis 0 of OI*
+    w64 = np.asarray(w, np.float64) * scale.reshape(wshape)
+    conv.weight._data = jnp.asarray(w64).astype(w.dtype)
+    b = (np.asarray(conv.bias._data, np.float64)
+         if conv.bias is not None else np.zeros((c,)))
+    new_b = (b - mean) * scale + beta
+    if conv.bias is not None:
+        conv.bias._data = jnp.asarray(new_b).astype(conv.bias._data.dtype)
+    else:
+        # Conv built with bias_attr=False stored a plain None attribute;
+        # drop it so the registered Parameter is visible via __getattr__
+        conv.__dict__.pop("bias", None)
+        conv.bias = Parameter(jnp.asarray(new_b).astype(w.dtype), name=None)
+
+
+# -- HLO layout lint --------------------------------------------------------
+
+def count_hlo_transposes(layer, x, optimized=False):
+    """Count transpose ops in the jitted forward of ``layer`` on input
+    Tensor ``x``.
+
+    ``optimized=False`` counts ``stablehlo.transpose`` in the emitted
+    StableHLO — the ops *this framework* inserted (the layout-plan
+    claim: zero interior, boundaries only). ``optimized=True`` counts
+    transpose instructions in the backend-compiled HLO — what the
+    compiler had to materialize for the chosen layout (includes weight
+    relayouts; backend-specific, reported as evidence, not linted).
+    """
+    from ..jit.api import StaticFunction
+
+    sf = StaticFunction(layer.forward, convert_control_flow=False)
+    lowered = sf.lower(x)
+    if not optimized:
+        return lowered.as_text().count("stablehlo.transpose")
+    import re
+
+    text = lowered.compile().as_text()
+    # compiled HLO instruction form: "%name = f32[...]{...} transpose(...)"
+    return len(re.findall(r"=\s+\S+\s+transpose\(", text))

@@ -47,14 +47,17 @@ def _pool_nd(x, n, kernel, stride, padding, kind, ceil_mode=False,
         exclusive = not count_include_pad
 
     def f(a):
+        # reduce_window takes per-dimension window specs, so channels-last
+        # is consumed natively — the window sits on the spatial dims and
+        # no layout transpose is ever emitted (framework/layout.py policy)
         if channel_last:
-            a = jnp.moveaxis(a, -1, 1)
-        window = (1, 1) + ks
-        strides = (1, 1) + st
-        if isinstance(pad, str):
-            pads = pad
+            window = (1,) + ks + (1,)
+            strides = (1,) + st + (1,)
+            pads = pad if isinstance(pad, str) else [(0, 0)] + pad + [(0, 0)]
         else:
-            pads = [(0, 0), (0, 0)] + pad
+            window = (1, 1) + ks
+            strides = (1, 1) + st
+            pads = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + pad
         if kind == "max":
             init = (-jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
                     else np.iinfo(np.dtype(a.dtype)).min)
@@ -69,8 +72,6 @@ def _pool_nd(x, n, kernel, stride, padding, kind, ceil_mode=False,
                 out = s / cnt
             else:
                 out = s / float(np.prod(ks))
-        if channel_last:
-            out = jnp.moveaxis(out, 1, -1)
         return out
 
     return apply(f, x)
@@ -146,15 +147,17 @@ def _adaptive_pool(x, n, output_size, kind, data_format="NCHW"):
     channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
 
     def f(a):
-        if channel_last:
-            a = jnp.moveaxis(a, -1, 1)
-        spatial = a.shape[2:]
+        # spatial dims sit at [1, 1+n) channels-last, [2, 2+n) channels-
+        # first; binning is reshape/reduce on those axes either way, so
+        # channels-last needs no layout transpose (framework/layout.py)
+        so = 1 if channel_last else 2
+        spatial = a.shape[so:so + n]
         os_ = _adaptive_sizes(output_size, n, spatial)
         out = a
         # adaptive pooling: split each spatial dim into output_size bins
         for d in range(n):
             in_sz, out_sz = spatial[d], os_[d]
-            axis = 2 + d
+            axis = so + d
             if in_sz % out_sz == 0:
                 k = in_sz // out_sz
                 new_shape = out.shape[:axis] + (out_sz, k) + out.shape[axis + 1:]
@@ -174,8 +177,6 @@ def _adaptive_pool(x, n, output_size, kind, data_format="NCHW"):
                            else jnp.mean(seg, axis=axis, keepdims=True))
                     pieces.append(red)
                 out = jnp.concatenate(pieces, axis=axis)
-        if channel_last:
-            out = jnp.moveaxis(out, 1, -1)
         return out
 
     return apply(f, x)

@@ -29,6 +29,23 @@ class _ConvNd(Layer):
         self._n = n
         self._transpose = transpose
         self._output_padding = output_padding
+        self._padding_mode = padding_mode
+        if padding_mode != "zeros":
+            # reference Conv*D: non-zero padding modes pre-pad the input
+            # (F.pad innermost-first order: [w_lo, w_hi, h_lo, h_hi, ...])
+            # and run the conv itself unpadded
+            from ..functional.conv import _norm_tuple
+            pads = _norm_tuple(padding, n)
+            if len(pads) == 2 * n:  # flattened per-side pairs
+                pads = [(int(pads[2 * i]), int(pads[2 * i + 1]))
+                        for i in range(n)]
+            else:
+                pads = [(int(p), int(p)) for p in pads]
+            flat = []
+            for lo, hi in reversed(pads):
+                flat += [lo, hi]
+            self._pre_pad = flat
+            self._padding = 0
         if transpose:
             shape = (in_channels, out_channels // groups) + self._kernel_size
         else:
@@ -41,6 +58,12 @@ class _ConvNd(Layer):
             (out_channels,), attr=bias_attr, is_bias=True,
             default_initializer=Uniform(-bound, bound))
             if bias_attr is not False else None)
+
+    def _maybe_pre_pad(self, x):
+        if self._padding_mode == "zeros":
+            return x
+        return F.pad(x, self._pre_pad, mode=self._padding_mode,
+                     data_format=self._data_format)
 
     def extra_repr(self):
         return (f"{self._in_channels}, {self._out_channels}, "
@@ -56,7 +79,8 @@ class Conv1D(_ConvNd):
                          bias_attr, data_format)
 
     def forward(self, x):
-        return F.conv1d(x, self.weight, self.bias, self._stride, self._padding,
+        return F.conv1d(self._maybe_pre_pad(x), self.weight, self.bias,
+                        self._stride, self._padding,
                         self._dilation, self._groups, self._data_format)
 
 
@@ -69,7 +93,8 @@ class Conv2D(_ConvNd):
                          bias_attr, data_format)
 
     def forward(self, x):
-        return F.conv2d(x, self.weight, self.bias, self._stride, self._padding,
+        return F.conv2d(self._maybe_pre_pad(x), self.weight, self.bias,
+                        self._stride, self._padding,
                         self._dilation, self._groups, self._data_format)
 
 
@@ -82,7 +107,8 @@ class Conv3D(_ConvNd):
                          bias_attr, data_format)
 
     def forward(self, x):
-        return F.conv3d(x, self.weight, self.bias, self._stride, self._padding,
+        return F.conv3d(self._maybe_pre_pad(x), self.weight, self.bias,
+                        self._stride, self._padding,
                         self._dilation, self._groups, self._data_format)
 
 

@@ -178,6 +178,27 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
     return out
 
 
+def inplace_abn(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+                param_attr=None, bias_attr=None, data_layout="NCHW",
+                moving_mean_name=None, moving_variance_name=None,
+                do_model_average_for_mean_and_var=True, act_alpha=1.0,
+                name=None):
+    """Reference: fluid/layers/nn.py::inplace_abn (in-place activated
+    batch norm). XLA fuses BN+activation regardless of the in-place
+    spelling, so this is batch_norm with the activation applied here —
+    act_alpha parameterizes leaky_relu/elu as in the reference."""
+    out = batch_norm(input, act=None, momentum=momentum, epsilon=epsilon,
+                     param_attr=param_attr, bias_attr=bias_attr,
+                     is_test=is_test, data_layout=data_layout, name=name)
+    if act:
+        from ..nn import functional as F
+
+        if act in ("leaky_relu", "elu"):
+            return getattr(F, act)(out, act_alpha)
+        return getattr(F, act)(out)
+    return out
+
+
 def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
                epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
                name=None):

@@ -40,6 +40,7 @@ class DepthwiseSeparable(Sequential):
 
 
 class MobileNetV1(Layer):
+    _channels_last_safe = True  # framework/layout.py:to_channels_last
     def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
         super().__init__()
         self.num_classes = num_classes
@@ -90,6 +91,7 @@ class InvertedResidual(Layer):
 
 
 class MobileNetV2(Layer):
+    _channels_last_safe = True  # framework/layout.py:to_channels_last
     def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
         super().__init__()
         self.num_classes = num_classes
@@ -178,6 +180,7 @@ _V3_SMALL = [
 
 
 class MobileNetV3(Layer):
+    _channels_last_safe = True  # framework/layout.py:to_channels_last
     def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
                  with_pool=True):
         super().__init__()

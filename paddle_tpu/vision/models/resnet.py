@@ -63,6 +63,11 @@ class BottleneckBlock(Layer):
 
 
 class ResNet(Layer):
+    # layout-safe: conv/BN/pool all layout-aware, residual adds are
+    # elementwise, flatten only after the 1x1 adaptive pool
+    # (framework/layout.py:to_channels_last)
+    _channels_last_safe = True
+
     def __init__(self, block, depth=50, width=64, num_classes=1000,
                  with_pool=True, groups=1):
         super().__init__()

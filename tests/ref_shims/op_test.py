@@ -115,6 +115,96 @@ def check_out_dtype(api_fn, in_specs, expect_dtypes, target_index=0,
                 f"{expect_dtype}")
 
 
+# -- op_type → python_api fallback adapters ---------------------------------
+# The conv/BN/pool family predates the reference's python_api declaration
+# wave, so those files would skip every case ("no python_api declared")
+# even though the public eager API covers them. Map the legacy op types
+# onto it; attrs keep their op-attr spellings (strides/paddings/dilations/
+# ksize/...), **_ swallows CI-only knobs (exhaustive_search, use_addto...).
+
+def _legacy_pad(paddings, padding_algorithm):
+    if padding_algorithm in ("SAME", "VALID"):
+        return padding_algorithm
+    return list(paddings)
+
+
+def _conv2d_api(input, filter, strides=(1, 1), paddings=(0, 0), groups=1,
+                dilations=(1, 1), padding_algorithm="EXPLICIT",
+                data_format="NCHW", **_):
+    import paddle
+
+    if data_format in ("AnyLayout", "NCHW", None):
+        data_format = "NCHW"
+    return paddle.nn.functional.conv2d(
+        input, filter, None, list(strides),
+        _legacy_pad(paddings, padding_algorithm), list(dilations), groups,
+        data_format)
+
+
+def _conv3d_api(input, filter, strides=(1, 1, 1), paddings=(0, 0, 0),
+                groups=1, dilations=(1, 1, 1), padding_algorithm="EXPLICIT",
+                data_format="NCDHW", **_):
+    import paddle
+
+    if data_format in ("AnyLayout", None):
+        data_format = "NCDHW"
+    return paddle.nn.functional.conv3d(
+        input, filter, None, list(strides),
+        _legacy_pad(paddings, padding_algorithm), list(dilations), groups,
+        data_format)
+
+
+def _conv2d_transpose_api(input, filter, strides=(1, 1), paddings=(0, 0),
+                          output_padding=(), output_size=None, groups=1,
+                          dilations=(1, 1), padding_algorithm="EXPLICIT",
+                          data_format="NCHW", **_):
+    import paddle
+
+    if data_format in ("AnyLayout", None):
+        data_format = "NCHW"
+    return paddle.nn.functional.conv2d_transpose(
+        input, filter, None, list(strides),
+        _legacy_pad(paddings, padding_algorithm),
+        list(output_padding) if output_padding else 0, groups,
+        list(dilations), output_size or None, data_format)
+
+
+def _batch_norm_api(x, scale, bias, mean, variance, momentum=0.9,
+                    epsilon=1e-5, data_layout="NCHW", is_test=False,
+                    use_global_stats=None, trainable_statistics=False, **_):
+    import paddle
+
+    return paddle.nn.functional.batch_norm(
+        x, mean, variance, scale, bias, training=not is_test,
+        momentum=momentum, epsilon=epsilon, data_format=data_layout,
+        use_global_stats=use_global_stats)
+
+
+def _max_pool2d_with_index_api(x, ksize, strides=(1, 1), paddings=(0, 0),
+                               global_pooling=False, adaptive=False,
+                               ceil_mode=False, **_):
+    import paddle
+
+    if adaptive:
+        return paddle.nn.functional.adaptive_max_pool2d(x, list(ksize))
+    if global_pooling:
+        ksize = list(x.shape[2:])
+        paddings = (0, 0)
+    return paddle.nn.functional.max_pool2d(
+        x, list(ksize), list(strides), list(paddings), ceil_mode=ceil_mode)
+
+
+OP_FALLBACK_APIS = {
+    "conv2d": _conv2d_api,
+    "depthwise_conv2d": _conv2d_api,
+    "conv3d": _conv3d_api,
+    "conv2d_transpose": _conv2d_transpose_api,
+    "depthwise_conv2d_transpose": _conv2d_transpose_api,
+    "batch_norm": _batch_norm_api,
+    "max_pool2d_with_index": _max_pool2d_with_index_api,
+}
+
+
 class OpTest(unittest.TestCase):
     """Eager-API re-grounding of the reference OpTest (see module doc)."""
 
@@ -144,6 +234,10 @@ class OpTest(unittest.TestCase):
 
         paddle.disable_static()
         api = getattr(self, "python_api", None)
+        if api is None:
+            # conv/BN/pool legacy files declare only op_type; route them
+            # through the public-API adapters above
+            api = OP_FALLBACK_APIS.get(getattr(self, "op_type", None))
         if api is None:
             raise unittest.SkipTest("no python_api declared (legacy "
                                     "Program-IR-only case)")
@@ -196,10 +290,20 @@ class OpTest(unittest.TestCase):
                 names = [names[i] for i in order]
                 args = [args[i] for i in order]
         lowered_inputs = {n.lower() for n in names}
+        has_var_kw = sig is not None and any(
+            p.kind == p.VAR_KEYWORD for p in sig.parameters.values())
         attrs = {}
         for k, v in (getattr(self, "attrs", {}) or {}).items():
             if k in IGNORED_ATTRS:
-                continue
+                # some "CI knob" attrs are real semantics for specific
+                # families (data_format for conv/pool layout, is_test for
+                # batch_norm): pass one through when the api EXPLICITLY
+                # declares that parameter ("AnyLayout" = the legacy
+                # registry's NCHW default, never a real layout request)
+                if not (sig is not None and k in sig.parameters
+                        and not (k == "data_format"
+                                 and v in ("AnyLayout", None))):
+                    continue
             # an attr shadowed by a tensor input of the same name (clip's
             # Min/Max, scale's ScaleTensor...): the reference kernel
             # prefers the tensor input, and the python_api already
@@ -208,6 +312,8 @@ class OpTest(unittest.TestCase):
             if k.lower() in lowered_inputs:
                 continue
             if sig is not None and k not in sig.parameters:
+                if has_var_kw:
+                    continue  # adapter **_ swallows CI-only knobs
                 raise unittest.SkipTest(
                     f"attr {k!r} not a python_api parameter")
             attrs[k] = v
@@ -245,10 +351,30 @@ class OpTest(unittest.TestCase):
                 f"declares {len(expected)} checkable "
                 f"({[k for k, _ in expected]}) — positional pairing "
                 "unsafe")
-        if len(got) > len(expected) and [k for k, _ in expected] != ["Out"]:
-            raise unittest.SkipTest(
-                f"python_api returns {len(got)} output(s) for declared "
-                f"{[k for k, _ in expected]} — positional pairing unsafe")
+        if len(got) > len(expected):
+            if [k for k, _ in expected] != ["Out"]:
+                raise unittest.SkipTest(
+                    f"python_api returns {len(got)} output(s) for declared "
+                    f"{[k for k, _ in expected]} — positional pairing unsafe")
+            # single declared 'Out' vs multi-output api: pairing got[0]
+            # blindly mispairs apis whose primary output is not first
+            # (e.g. (indices, values) orderings) — pair by shape+dtype
+            # kind instead, and skip unless the match is unambiguous
+            try:
+                exp_arr = np.asarray(expected[0][1])
+            except Exception:
+                raise unittest.SkipTest("ragged expected output")
+            cands = []
+            for o in got:
+                oarr = np.asarray(o._data if hasattr(o, "_data") else o)
+                if tuple(oarr.shape) == tuple(exp_arr.shape) \
+                        and oarr.dtype.kind == exp_arr.dtype.kind:
+                    cands.append(o)
+            if len(cands) != 1:
+                raise unittest.SkipTest(
+                    f"{len(got)} api outputs, {len(cands)} match Out's "
+                    "shape/dtype — pairing ambiguous")
+            got = cands
         for (name, exp), out in zip(expected, got):
             if isinstance(exp, (list, tuple)) and exp \
                     and isinstance(exp[0], (list, tuple)):

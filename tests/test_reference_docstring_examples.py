@@ -54,11 +54,16 @@ REF = "/root/reference/python/paddle"
 #     (unequal-length axes lists) — unsupported corner
 # vision/transforms/...    (6/7):   [order-dep] ToTensor after the
 #     functional-module example
-# fluid/layers/nn.py       (~0.79 in-harness, ~0.91 isolated —
-#     example order leaks static-program state): residual
-#     [legacy-gap] is LoD ops
-#     (lod_reset/lod_append), PS pull_* sparse-table ops, inplace_abn,
-#     and 1.x internals (_pull_*); fetch-by-name + CRF + pool padding
+# fluid/layers/nn.py       (~0.79 in-harness pre-layout-PR, ~0.91
+#     isolated — example order leaks static-program state): the
+#     NHWC-layout PR added inplace_abn (static.nn) and the pull_*
+#     sparse-table family (_pull_sparse/_pull_sparse_v2/
+#     _pull_box_sparse/pull_box_sparse/pull_gpups_sparse, local
+#     dense-table emulation in fluid/layers/tail.py), closing the
+#     fixable residual; remaining [legacy-gap] is LoD ops
+#     (lod_reset/lod_append) only. Floor 0.75 -> 0.85 (set blind: the
+#     reference snapshot was absent that session — re-measure when it
+#     returns); fetch-by-name + CRF + pool padding
 #     + fluid.data-implies-static closed the rest in round 5
 # fluid/layers/tensor.py   (23/26): [legacy-gap] create_parameter w/
 #     LayerHelper idioms; flip-on-list corner
@@ -115,7 +120,7 @@ TARGETS = {
     "nn/layer/distance.py": 0.95,
     "nn/utils/weight_norm_hook.py": 0.95,
     "fluid/layers/tensor.py": 0.85,
-    "fluid/layers/nn.py": 0.75,
+    "fluid/layers/nn.py": 0.85,
     # round-5 additions: the full transform surface + KL registry
     "distribution/transform.py": 0.85,
     "distribution/kl.py": 0.95,

@@ -209,8 +209,19 @@ TARGETS = {
     "test_conv1d_layer.py": (0.55, 14),  # measured 16/24 = 0.67
     "test_conv1d_transpose_layer.py": (0.40, 8),  # measured 9/18 = 0.50
     "test_conv2d_fusion_op.py": (0.90, 25),  # measured 28/28 = 1.00
-    "test_conv2d_transpose_op.py": (0.25, 1),  # measured 1/3 = 0.33
+    # conv-family floors re-set by the NHWC-layout PR: OP_FALLBACK_APIS in
+    # ref_shims/op_test.py now routes the legacy conv/batch_norm/
+    # max_pool2d_with_index op declarations (no python_api) through the
+    # public eager API, data_format/is_test attrs pass through to apis
+    # that declare them, and channels-last full-form padding was fixed.
+    # The reference snapshot was absent in that session, so these are
+    # floor targets (>=0.5 per VERDICT item 3), not fresh measurements —
+    # re-measure with tools/measure_ref_unittests.py when it returns.
+    "test_conv2d_transpose_op.py": (0.50, 1),  # pre-PR measured 1/3
     "test_conv3d_op.py": (0.40, 1),  # measured 1/2 = 0.50
+    "test_conv2d_op.py": (0.50, 1),  # NEW via OP_FALLBACK_APIS (see conv-family note above)
+    "test_batch_norm_op.py": (0.50, 1),  # NEW via OP_FALLBACK_APIS (see conv-family note above)
+    "test_pool_max_op.py": (0.50, 1),  # NEW via OP_FALLBACK_APIS (see conv-family note above)
     "test_conv3d_transpose_op.py": (0.90, 14),  # measured 16/16 = 1.00
     "test_conv3d_transpose_part2_op.py": (0.75, 9),  # measured 10/12 = 0.83
     "test_corr.py": (0.70, 6),  # measured 7/9 = 0.78
@@ -255,7 +266,7 @@ TARGETS = {
     "test_fold_op.py": (0.75, 5),  # measured 6/7 = 0.86
     "test_frame_op.py": (0.90, 11),  # measured 12/12 = 1.00
     "test_functional_conv1d.py": (0.40, 1),  # measured 1/2 = 0.50
-    "test_functional_conv2d.py": (0.15, 4),  # measured 5/21 = 0.24
+    "test_functional_conv2d.py": (0.50, 4),  # pre-PR measured 5/21 (see conv-family note above)
     "test_functional_conv3d.py": (0.15, 4),  # measured 5/20 = 0.25
     "test_gather_tree_op.py": (0.65, 2),  # measured 3/4 = 0.75
     "test_gcd.py": (0.90, 9),  # measured 10/10 = 1.00

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Conv-pipeline layout microbench (CPU-verifiable, one JSON ledger line).
+
+Measures resnet18 inference in four configurations:
+
+* ``eager``  — per-op lowering, the seed's execution model. NCHW pays
+  XLA's per-program conv canonicalization transposes on every op, and
+  eval-mode BN is ~20 extra elementwise programs; channels-last +
+  folded BN removes both, which is the measurable CPU win.
+* ``jit``    — whole-graph XLA. On CPU the backend already
+  canonicalizes interior conv layouts (transpose-of-transpose
+  cancellation), so NCHW≈NHWC here; the layout claim for compiled mode
+  is structural — zero interior transposes in the emitted HLO — and is
+  gated by tools/check_hlo_layout.py, whose counts are embedded below.
+
+Also records conv+BN folding parity (single pair, absolute; end-to-end,
+relative) so numerical regressions ride the same ledger line.
+
+Usage: JAX_PLATFORMS=cpu python tools/bench_conv.py [--batch 2]
+       [--size 64] [--reps 8] [--skip-jit]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import time
+
+
+def _median(v):
+    import numpy as np
+    return float(np.median(v))
+
+
+def build_models():
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import fold_conv_bn, to_channels_last
+    from paddle_tpu.vision.models import resnet18
+
+    def build():
+        paddle.seed(1)
+        m = resnet18(num_classes=10)
+        m.eval()
+        return m
+
+    nchw = build()
+    cl = build()
+    cl.set_state_dict(nchw.state_dict())
+    cl = to_channels_last(cl)
+    clf = build()
+    clf.set_state_dict(nchw.state_dict())
+    clf = to_channels_last(clf)
+    n_folded = len(fold_conv_bn(clf))
+    return nchw, cl, clf, n_folded
+
+
+def bench_eager(models, x, reps):
+    import numpy as np
+    times = {k: [] for k in models}
+    for k, m in models.items():  # warm any op-level caches
+        np.asarray(m(x)._data)
+    for _ in range(reps):
+        for k, m in models.items():  # interleaved: cancels machine drift
+            t0 = time.perf_counter()
+            np.asarray(m(x)._data)
+            times[k].append((time.perf_counter() - t0) * 1000)
+    return {k: round(_median(v), 1) for k, v in times.items()}
+
+
+def bench_jit(models, x, reps):
+    import numpy as np
+
+    from paddle_tpu.jit.api import StaticFunction
+    fns = {}
+    for k, m in models.items():
+        sf = StaticFunction(m.forward, convert_control_flow=False)
+        np.asarray(sf(x)._data)  # compile + warm
+        fns[k] = sf
+    times = {k: [] for k in fns}
+    for _ in range(reps):
+        for k, sf in fns.items():
+            t0 = time.perf_counter()
+            np.asarray(sf(x)._data)
+            times[k].append((time.perf_counter() - t0) * 1000)
+    return {k: round(_median(v), 1) for k, v in times.items()}
+
+
+def fold_parity():
+    """Single conv+BN pair fold parity (the <=1e-5 fp32 contract) and
+    end-to-end resnet18 relative parity."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.framework import fold_conv_bn, to_channels_last
+    from paddle_tpu.vision.models import resnet18
+
+    rng = np.random.default_rng(7)
+    paddle.seed(7)
+    conv = nn.Conv2D(8, 16, 3, padding=1, bias_attr=False)
+    bn = nn.BatchNorm2D(16)
+    bn._mean._data = paddle.to_tensor(
+        rng.standard_normal((16,)).astype(np.float32))._data
+    bn._variance._data = paddle.to_tensor(
+        (np.abs(rng.standard_normal((16,))) + 0.3).astype(np.float32))._data
+    bn.weight._data = paddle.to_tensor(
+        rng.standard_normal((16,)).astype(np.float32))._data
+    bn.bias._data = paddle.to_tensor(
+        rng.standard_normal((16,)).astype(np.float32))._data
+    seq = nn.Sequential(conv, bn)
+    seq.eval()
+    x = paddle.to_tensor(rng.standard_normal((2, 8, 12, 12)).astype(np.float32))
+    before = np.asarray(seq(x)._data)
+    fold_conv_bn(seq)
+    single = float(np.abs(np.asarray(seq(x)._data) - before).max())
+
+    paddle.seed(1)
+    m = resnet18(num_classes=10)
+    m.eval()
+    xi = paddle.to_tensor(
+        rng.standard_normal((2, 3, 32, 32)).astype(np.float32))
+    ref = np.asarray(m(xi)._data)
+    paddle.seed(1)
+    m2 = resnet18(num_classes=10)
+    m2.eval()
+    m2.set_state_dict(m.state_dict())
+    clf = to_channels_last(m2)
+    fold_conv_bn(clf)
+    out = np.asarray(clf(xi)._data)
+    e2e_rel = float((np.abs(out - ref) / np.maximum(np.abs(ref), 1e-3)).max())
+    return single, e2e_rel
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--size", type=int, default=64)
+    ap.add_argument("--reps", type=int, default=8)
+    ap.add_argument("--skip-jit", action="store_true")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal(
+        (args.batch, 3, args.size, args.size)).astype(np.float32))
+
+    nchw, cl, clf, n_folded = build_models()
+    models = {"nchw": nchw, "channels_last": cl, "channels_last_folded": clf}
+
+    eager = bench_eager(models, x, args.reps)
+    jit = None if args.skip_jit else bench_jit(models, x, args.reps)
+    single, e2e_rel = fold_parity()
+
+    # HLO lint counts (same budgets as tools/check_hlo_layout.py)
+    from paddle_tpu.framework import count_hlo_transposes
+    xn = paddle.transpose(x, [0, 2, 3, 1])
+    transposes = {
+        "interior_stablehlo": count_hlo_transposes(cl.model, xn),
+        "boundary_stablehlo": count_hlo_transposes(cl, x),
+    }
+
+    record = {
+        "bench": "conv_layout",
+        "model": "resnet18",
+        "batch": args.batch, "size": args.size, "reps": args.reps,
+        "eager_ms": eager,
+        "eager_speedup_vs_nchw": round(
+            eager["nchw"] / eager["channels_last_folded"], 3),
+        "jit_ms": jit,
+        "fold_parity_single_abs": single,
+        "fold_parity_e2e_rel": e2e_rel,
+        "folded_bn_layers": n_folded,
+        "hlo_transposes": transposes,
+        "ok": (transposes["interior_stablehlo"] == 0
+               and transposes["boundary_stablehlo"] <= 1
+               and single <= 1e-5
+               and eager["nchw"] > eager["channels_last_folded"]),
+    }
+    print(json.dumps(record))
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())
