@@ -457,10 +457,14 @@ def bench_kernels(backend):
         _sync(r)
 
     def _stochrnd():
-        from paddle_tpu.nn.quant import quantize_int8_stochastic
+        from paddle_tpu.nn.quant import (quantize_int8_stochastic,
+                                         stochastic_round)
         w = jnp.asarray(rng.standard_normal((256, 256)), dtype=jnp.float32)
         q, s = quantize_int8_stochastic(w, seed=7)
         _sync(q.astype(jnp.int32))
+        # the supported-target float path (fp32 -> bf16) must pass too
+        r = stochastic_round(w, jnp.bfloat16, seed=7)
+        _sync(r.astype(jnp.float32))
 
     gate("flash_fwd", _flash_fwd)
     gate("flash_bwd", _flash_bwd)
@@ -833,7 +837,12 @@ def main():
         if isinstance(cur, dict) and ("error" in cur or "skipped" in cur) \
                 and isinstance(v, dict) and "error" not in v \
                 and "skipped" not in v:
-            secondary[k] = {**v, "replayed_from_session": True}
+            # merge the last good measurement instead of blanking the
+            # entry — one tunnel stall must not erase the secondary
+            # table. stale marks it as replayed, stall records why.
+            secondary[k] = {**v, "stale": True,
+                            "replayed_from_session": True,
+                            "stall": cur.get("error") or cur.get("skipped")}
     if isinstance(kernels, dict) and ("error" in kernels
                                       or "skipped" in kernels) \
             and isinstance(last.get("kernels"), dict):

@@ -36,6 +36,14 @@ class PyLayerContext:
 
 
 class PyLayer:
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        # a new custom vjp enters the op universe: drop compiled eager
+        # dispatch entries so nothing stale shadows it
+        from ..framework import dispatch_cache
+
+        dispatch_cache.invalidate()
+
     @staticmethod
     def forward(ctx, *args, **kwargs):
         raise NotImplementedError

@@ -146,6 +146,7 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
     """
     import jax.numpy as jnp
 
+    from ..framework import dispatch_cache as _dcache
     from ..tensor import Tensor
 
     if tensor._node is None and tensor.stop_gradient:
@@ -157,7 +158,7 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
         # paddle semantics (varbase_patch_methods.py backward): a None
         # grad_tensor seeds ones_like for ANY shape, scalar or not
         # (unlike torch, which rejects non-scalar roots)
-        seed_ct = jnp.ones_like(tensor._data)
+        seed_ct = _dcache.ones_like_ct(tensor._data)
     else:
         seed_ct = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
 
@@ -166,7 +167,7 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
 
     def add_ct(store, key, val):
         cur = store.get(key)
-        store[key] = val if cur is None else cur + val
+        store[key] = val if cur is None else _dcache.ct_add(cur, val)
 
     leaf_cts = {}  # id(tensor) -> (tensor, ct)
 
@@ -217,7 +218,8 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
             elif not parent.stop_gradient:
                 key = id(parent)
                 if key in leaf_cts:
-                    leaf_cts[key] = (parent, leaf_cts[key][1] + g)
+                    leaf_cts[key] = (parent,
+                                     _dcache.ct_add(leaf_cts[key][1], g))
                 else:
                     leaf_cts[key] = (parent, g)
         if not retain_graph:
@@ -228,6 +230,7 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
 
 
 def _accum_leaf(tensor, g):
+    from ..framework import dispatch_cache as _dcache
     from ..tensor import Tensor
 
     if tensor.stop_gradient:
@@ -237,4 +240,5 @@ def _accum_leaf(tensor, g):
     if tensor.grad is None:
         tensor.grad = Tensor(g, stop_gradient=True)
     else:
-        tensor.grad = Tensor(tensor.grad._data + g, stop_gradient=True)
+        tensor.grad = Tensor(_dcache.ct_add(tensor.grad._data, g),
+                             stop_gradient=True)

@@ -7,6 +7,7 @@ from .dtype import (  # noqa: F401
 from .device import (  # noqa: F401
     CPUPlace, CUDAPlace, TPUPlace, device_count, get_device, set_device,
 )
+from .dispatch_cache import dispatch_stats  # noqa: F401
 from .random_seed import seed  # noqa: F401
 
 

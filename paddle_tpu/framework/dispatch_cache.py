@@ -1,0 +1,542 @@
+"""Signature-keyed compile cache for the eager dispatcher (the "eager
+fast path").
+
+The dygraph layer re-traces every differentiable op on every call:
+``tensor.apply`` invokes ``jax.vjp`` per node, which costs ~0.5-1 ms of
+host tracing per op even when the op itself is microseconds of compute.
+This module makes steady-state eager execution trace-free:
+
+* key  = (op identity, static args/kwargs, input avals — shape/dtype/
+  weak-type, which positions are differentiated);
+* value = a jitted forward returning ``(outputs, pullback)`` — the
+  pullback is a ``jax.tree_util.Partial`` whose leaves are the vjp
+  residuals, so partial-eval splits the vjp into two compiled halves —
+  plus a jitted backward consuming ``(pullback, cotangents)``.  No-grad
+  dispatches use a plain jitted forward.
+
+Op identity for the per-call lambdas the op layer builds is the lambda's
+``__code__`` object (shared across calls from the same source location)
+plus its closure-cell values, which become part of the static key.
+
+Safety:
+
+* a signature only compiles once it has been seen ``_WARMUP`` times
+  (``PADDLE_TPU_EAGER_CACHE_WARMUP``, default 32): a compile costs
+  tens of ms while a hit saves well under one, so only loops hot
+  enough to amortize it — real train loops, not a test's handful of
+  iterations — ever pay one;
+* any value that cannot be made a hashable static key (captured PRNG
+  keys, Tensors, numpy arrays in closures, arbitrary objects) bypasses
+  the cache — randomness is never baked into a compiled entry;
+* ops whose python body is data-dependent (``.item()``, bool branches
+  on values, dynamic output shapes) fail their first trace; the op is
+  blacklisted and permanently falls back to the uncached path;
+* bounded LRU (``PADDLE_TPU_EAGER_CACHE_SIZE``, default 1024 entries);
+* ``PADDLE_TPU_EAGER_CACHE=0`` opts out entirely;
+* :func:`invalidate` drops every entry (called on grad-hook and
+  custom-vjp registration).
+
+Counters (hits / misses / compiles / bypasses) are surfaced through
+``paddle_tpu.framework.dispatch_stats()`` and ``paddle_tpu.profiler``.
+"""
+from __future__ import annotations
+
+import enum
+import functools
+import os
+import threading
+import types
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["dispatch", "dispatch_stats", "reset_stats", "enabled",
+           "set_enabled", "set_warmup", "invalidate"]
+
+_BYPASS = object()
+
+_enabled_flag = os.environ.get("PADDLE_TPU_EAGER_CACHE", "1").lower() \
+    not in ("0", "false", "off")
+_CAPACITY = max(8, int(os.environ.get("PADDLE_TPU_EAGER_CACHE_SIZE", "1024")))
+_SEEN_CAPACITY = 4 * _CAPACITY
+# sightings of a signature before it is worth compiling (see module
+# docstring); the Nth sighting compiles, the first N-1 are misses
+_WARMUP = max(1, int(os.environ.get("PADDLE_TPU_EAGER_CACHE_WARMUP", "32")))
+
+_lock = threading.RLock()
+_cache: "OrderedDict[tuple, _Entry]" = OrderedDict()
+_seen: "OrderedDict[tuple, bool]" = OrderedDict()
+_blacklist = set()   # fn keys whose trace failed: data-dependent python
+_epoch = 0           # bumped by invalidate(); part of every key
+
+# megamorphic demotion: an op that keeps producing NEW signatures (a
+# decode loop's per-step kv-cache shapes, padder churn) would compile
+# once per shape forever; past this many distinct compiled signatures
+# the op's new signatures bypass instead (existing entries keep hitting)
+_POLY_LIMIT = max(1, int(os.environ.get("PADDLE_TPU_EAGER_CACHE_POLY",
+                                        "16")))
+_fn_sig_count: dict = {}
+
+
+class _Stats:
+    __slots__ = ("hits", "misses", "compiles", "bypasses", "invalidations")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.hits = 0
+        self.misses = 0
+        self.compiles = 0
+        self.bypasses = 0
+        self.invalidations = 0
+
+
+_stats = _Stats()
+
+
+def enabled() -> bool:
+    return _enabled_flag
+
+
+def set_warmup(n: int) -> int:
+    """Runtime override of PADDLE_TPU_EAGER_CACHE_WARMUP (returns the
+    previous value). Tests drop it to 2 so the cache engages inside a
+    short loop; the default stays high because a compile only pays for
+    itself after dozens of hits."""
+    global _WARMUP
+    prev = _WARMUP
+    _WARMUP = max(1, int(n))
+    return prev
+
+
+def set_enabled(flag: bool) -> bool:
+    """Runtime override of PADDLE_TPU_EAGER_CACHE (returns previous).
+    Disabling drops all entries so re-enabling starts clean."""
+    global _enabled_flag
+    prev = _enabled_flag
+    _enabled_flag = bool(flag)
+    if not _enabled_flag:
+        invalidate()
+    return prev
+
+
+def invalidate():
+    """Drop every cached entry and seen-signature record. Called when op
+    semantics may have shifted under the cache: grad-hook registration,
+    custom-vjp (PyLayer) definition, or an explicit user reset."""
+    global _epoch
+    with _lock:
+        _epoch += 1
+        _cache.clear()
+        _seen.clear()
+        _blacklist.clear()
+        _fn_sig_count.clear()
+        _stats.invalidations += 1
+
+
+def dispatch_stats() -> dict:
+    """Snapshot of the eager-dispatch cache counters.
+
+    ``compiles`` is the retrace count: a steady-state (warm) eager loop
+    must add only ``hits``."""
+    with _lock:
+        return {"enabled": _enabled_flag, "hits": _stats.hits,
+                "misses": _stats.misses, "compiles": _stats.compiles,
+                "bypasses": _stats.bypasses,
+                "invalidations": _stats.invalidations,
+                "entries": len(_cache), "capacity": _CAPACITY}
+
+
+def reset_stats():
+    with _lock:
+        _stats.reset()
+
+
+# -- key construction --------------------------------------------------------
+
+_SIMPLE = (type(None), bool, int, str, bytes, type(Ellipsis))
+
+
+def _hkey(v):
+    """Hashable static-key form of ``v``, or _BYPASS. Only value types
+    whose semantics are fully captured by the key are allowed — arrays,
+    Tensors and arbitrary objects (layers, PRNG keys) must bypass, or a
+    compiled entry would bake a value that can change under it."""
+    if isinstance(v, bool):  # before int: key True distinctly from 1
+        return ("b", v)
+    if isinstance(v, float):
+        # hex() distinguishes -0.0 from 0.0 and collapses NaN payloads
+        return ("f", v.hex())
+    if isinstance(v, _SIMPLE):
+        return v
+    if isinstance(v, complex):
+        return ("c", v.real.hex(), v.imag.hex())
+    if isinstance(v, (tuple, list)):
+        parts = tuple(_hkey(x) for x in v)
+        if any(p is _BYPASS for p in parts):
+            return _BYPASS
+        return ("T" if isinstance(v, tuple) else "L",) + parts
+    if isinstance(v, dict):
+        try:
+            items = sorted(v.items())
+        except TypeError:
+            return _BYPASS
+        parts = tuple((k, _hkey(x)) for k, x in items)
+        if any(p is _BYPASS for _, p in parts):
+            return _BYPASS
+        return ("D",) + parts
+    if isinstance(v, slice):
+        return ("S", _hkey(v.start), _hkey(v.stop), _hkey(v.step))
+    if isinstance(v, np.dtype):
+        return ("dt", v.str)
+    if isinstance(v, enum.Enum):
+        return ("E", type(v).__name__, v.name)
+    if isinstance(v, (np.integer, np.floating, np.bool_)) and v.ndim == 0:
+        return ("np", v.dtype.str, v.item())
+    if isinstance(v, type):  # dtype classes (jnp.float32), Tensor classes
+        return v
+    if callable(v):
+        return _fn_key(v)
+    return _BYPASS
+
+
+def _fn_key(fn):
+    """Stable identity for the dispatched op. Per-call lambdas share
+    their ``__code__``; their captured values join the key."""
+    if isinstance(fn, functools.partial):
+        sub = _fn_key(fn.func)
+        args = _hkey(tuple(fn.args))
+        kw = _hkey(fn.keywords or {})
+        if _BYPASS in (sub, args, kw):
+            return _BYPASS
+        return ("P", sub, args, kw)
+    if isinstance(fn, types.MethodType):
+        return _BYPASS  # bound methods drag in mutable instance state
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # builtin / jnp ufunc: a stable module-level object — but C
+        # callables (ctypes funcptrs) may be unhashable
+        try:
+            hash(fn)
+        except TypeError:
+            return _BYPASS
+        return fn
+    cells = ()
+    if fn.__closure__:
+        vals = []
+        for cell in fn.__closure__:
+            try:
+                hv = _hkey(cell.cell_contents)
+            except ValueError:  # empty cell
+                return _BYPASS
+            if hv is _BYPASS:
+                return _BYPASS
+            vals.append(hv)
+        cells = tuple(vals)
+    defaults = _hkey(fn.__defaults__ or ())
+    if defaults is _BYPASS:
+        return _BYPASS
+    return (code, cells, defaults)
+
+
+def _classify(raw):
+    """Split positional args into dynamic arrays vs static values.
+
+    Returns (template, dyn_vals, avals) or None to bypass. template is a
+    tuple of 'd' / ('s', hkey); numpy arrays ride as dynamic args."""
+    template = []
+    dyn_vals = []
+    avals = []
+    for v in raw:
+        if isinstance(v, jax.core.Tracer):
+            return None  # inside jit/vmap/grad tracing: not our business
+        if isinstance(v, jax.Array):
+            template.append("d")
+            dyn_vals.append(v)
+            avals.append(v.aval)  # ShapedArray: shape+dtype+weak_type
+        elif isinstance(v, np.ndarray):
+            template.append("d")
+            dyn_vals.append(v)
+            avals.append(("np", v.shape, v.dtype.str))
+        else:
+            hk = _hkey(v)
+            if hk is _BYPASS:
+                return None
+            template.append(("s", hk))
+    return tuple(template), dyn_vals, tuple(avals)
+
+
+# -- compiled entries --------------------------------------------------------
+
+class _Entry:
+    __slots__ = ("fwd", "bwd")
+
+    def __init__(self, fwd, bwd=None):
+        self.fwd = fwd
+        self.bwd = bwd
+
+    def forward(self, dyn_vals):
+        return self.fwd(tuple(dyn_vals), runtime_zero())
+
+    def backward(self, pullback, cts):
+        return self.bwd(pullback, cts, runtime_zero())
+
+
+def _build_entry(fn, kwargs, template, statics, diff_idx):
+    """Compile fwd (and bwd for grad mode) for one signature.
+
+    ``statics`` are the live static arg values in template order (the key
+    pinned them, so baking them into the trace is sound). Both halves run
+    through :func:`bitwise_call`, so the compiled programs reproduce the
+    uncached path's per-op rounding exactly."""
+    n = len(template)
+    dyn_pos = tuple(i for i, t in enumerate(template) if t == "d")
+    static_by_pos = {}
+    it = iter(statics)
+    for i, t in enumerate(template):
+        if t != "d":
+            static_by_pos[i] = next(it)
+
+    def assemble(dyn):
+        vals = [None] * n
+        for i, v in zip(dyn_pos, dyn):
+            vals[i] = v
+        for i, v in static_by_pos.items():
+            vals[i] = v
+        return vals
+
+    if not diff_idx:
+        def fwd(dyn, zero):
+            def run(dyn):
+                return fn(*assemble(dyn), **kwargs)
+            return bitwise_call(zero, run, dyn)
+        return _Entry(jax.jit(fwd))
+
+    def fwd(dyn, zero):
+        def run(dyn):
+            vals = assemble(dyn)
+
+            def closed(*diff_vals):
+                v2 = list(vals)
+                for i, dv in zip(diff_idx, diff_vals):
+                    v2[i] = dv
+                return fn(*v2, **kwargs)
+
+            # jax.vjp under jit partial-evals the op: primal outputs plus
+            # a Partial pullback whose leaves are the residuals — both
+            # halves cross the jit boundary as pytrees
+            return jax.vjp(closed, *(vals[i] for i in diff_idx))
+        return bitwise_call(zero, run, dyn)
+
+    bwd = jax.jit(lambda pullback, cts, zero:
+                  bitwise_call(zero, lambda c: pullback(c), cts))
+    return _Entry(jax.jit(fwd), bwd)
+
+
+# -- the dispatcher ----------------------------------------------------------
+
+def dispatch(fn, raw, kwargs, diff_idx):
+    """Fast-path attempt for one eager op.
+
+    Returns None when the caller must run the uncached path (bypass or
+    cold signature), else ``(out, pullback, entry)`` — ``pullback`` is
+    None for no-grad dispatches.
+    """
+    try:
+        cls = _classify(raw)
+        if cls is None:
+            _stats.bypasses += 1
+            return None
+        template, dyn_vals, avals = cls
+        fnk = _fn_key(fn)
+        if fnk is _BYPASS or fnk in _blacklist:
+            _stats.bypasses += 1
+            return None
+        kwk = _hkey(kwargs) if kwargs else ()
+        if kwk is _BYPASS:
+            _stats.bypasses += 1
+            return None
+        key = (_epoch, fnk, template, avals, kwk, diff_idx)
+        hash(key)
+    except TypeError:  # unhashable corner smuggled through _hkey
+        _stats.bypasses += 1
+        return None
+
+    with _lock:
+        entry = _cache.get(key)
+        if entry is not None:
+            _cache.move_to_end(key)
+            _stats.hits += 1
+        elif _fn_sig_count.get(fnk, 0) >= _POLY_LIMIT:
+            _stats.bypasses += 1  # megamorphic op: stop compiling shapes
+            return None
+        else:
+            cnt = _seen.get(key, 0) + 1
+            if cnt < _WARMUP:
+                # still warming: record the sighting and fall back — a
+                # compile costs tens of ms and a hit saves <1 ms, so
+                # cold/one-shot signatures must never pay one
+                _seen[key] = cnt
+                _seen.move_to_end(key)
+                while len(_seen) > _SEEN_CAPACITY:
+                    _seen.popitem(last=False)
+                _stats.misses += 1
+                return None
+
+    if entry is None:
+        statics = [v for v, t in zip(raw, template) if t != "d"]
+        try:
+            entry = _build_entry(fn, dict(kwargs), template, statics,
+                                 diff_idx)
+        except Exception:
+            with _lock:
+                _blacklist.add(fnk)
+                _stats.bypasses += 1
+            return None
+        with _lock:
+            _stats.compiles += 1
+            if len(_fn_sig_count) > _SEEN_CAPACITY:
+                _fn_sig_count.clear()  # bound bookkeeping, keep entries
+            _fn_sig_count[fnk] = _fn_sig_count.get(fnk, 0) + 1
+            _cache[key] = entry
+            _seen.pop(key, None)
+            while len(_cache) > _CAPACITY:
+                _cache.popitem(last=False)
+
+    try:
+        if diff_idx:
+            out, pullback = entry.forward(dyn_vals)
+        else:
+            out, pullback = entry.forward(dyn_vals), None
+    except Exception:
+        # the first execution traces; data-dependent python (.item(),
+        # value branches, dynamic output shapes) surfaces here — fall
+        # back for good, the eager path reports the real error if any
+        with _lock:
+            _cache.pop(key, None)
+            _blacklist.add(fnk)
+            _stats.bypasses += 1
+        return None
+    return out, pullback, entry
+
+
+# -- bitwise-faithful fused evaluation ---------------------------------------
+
+_INT_FOR_WIDTH = {2: jnp.int16, 4: jnp.int32}
+
+# primitives whose raw params can't round-trip through Primitive.bind;
+# their primal body is inlined instead (matching eager, which executes
+# the undifferentiated body op-by-op)
+_INLINE_CALLS = ("custom_jvp_call", "custom_vjp_call",
+                 "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr",
+                 "custom_lin")
+
+
+def _seal(x, zero):
+    """Bitwise identity (xor with a runtime-zero mask) that neither XLA
+    nor LLVM can see through, so a consumer add can never FMA-contract
+    with the producer of ``x``."""
+    from jax import lax
+
+    dt = jnp.dtype(x.dtype)
+    if not jnp.issubdtype(dt, jnp.floating):
+        return x
+    it = _INT_FOR_WIDTH.get(dt.itemsize)
+    if it is None:
+        return x
+    mask = lax.convert_element_type(zero, it)
+    return lax.bitcast_convert_type(
+        lax.bitcast_convert_type(x, it) ^ mask, dt)
+
+
+def _eval_sealed(jaxpr, consts, args, zero):
+    from jax.util import safe_map
+
+    env = {}
+
+    def read(var):
+        return var.val if isinstance(var, jax.core.Literal) else env[var]
+
+    def write(var, val):
+        env[var] = val
+
+    safe_map(write, jaxpr.constvars, consts)
+    safe_map(write, jaxpr.invars, args)
+    for eqn in jaxpr.eqns:
+        invals = safe_map(read, eqn.invars)
+        if eqn.primitive.name in _INLINE_CALLS:
+            inner = eqn.params.get("call_jaxpr") or eqn.params.get("fun_jaxpr")
+            outs = _eval_sealed(inner.jaxpr, inner.consts, invals, zero)
+        else:
+            outs = eqn.primitive.bind(*invals, **eqn.params)
+            if not eqn.primitive.multiple_results:
+                outs = [outs]
+        outs = [_seal(o, zero) for o in outs]
+        safe_map(write, eqn.outvars, outs)
+    return safe_map(read, jaxpr.outvars)
+
+
+def bitwise_call(zero, fn, *args):
+    """Run ``fn`` under the current trace with every float primitive
+    output sealed against cross-op fusion.
+
+    A jitted composite lets XLA's CPU backend contract mul+add chains
+    into FMAs, which rounds once where the eager op-by-op path rounds
+    twice — a fused program would drift from the uncached path by an ulp
+    per axpy. Interpreting the jaxpr and xor-sealing each float output
+    with ``zero`` (a runtime-zero i32 scalar the compiler cannot fold)
+    keeps every primitive's result exactly the eagerly-computed bits
+    while still compiling to ONE dispatch. Higher-order custom-grad
+    calls are inlined; pjit/control-flow eqns re-bind as units, which is
+    what eager execution compiles them as too."""
+    closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(*args)
+    out_tree = jax.tree_util.tree_structure(out_shape)
+    flat_args = jax.tree_util.tree_leaves(args)
+    out_flat = _eval_sealed(closed.jaxpr, closed.consts, flat_args, zero)
+    return jax.tree_util.tree_unflatten(out_tree, out_flat)
+
+
+_zero_cache = None
+
+
+def runtime_zero():
+    """The i32 zero passed to sealed programs as a runtime argument (a
+    constant would be folded and the seals optimized away)."""
+    global _zero_cache
+    if _zero_cache is None:
+        _zero_cache = jnp.zeros((), jnp.int32)
+    return _zero_cache
+
+
+# -- jitted tree helpers (cotangent accumulation, seeds) ---------------------
+
+@jax.jit
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+@jax.jit
+def _ones_like(a):
+    return jnp.ones_like(a)
+
+
+def ct_add(a, b):
+    """Cotangent accumulation: jitted when the cache is on (saves one
+    eager dispatch per accumulation in backward())."""
+    if not _enabled_flag:
+        return a + b
+    if isinstance(a, jax.core.Tracer) or isinstance(b, jax.core.Tracer):
+        return a + b
+    if getattr(a, "dtype", None) != getattr(b, "dtype", None) or \
+            getattr(a, "shape", None) != getattr(b, "shape", None):
+        return a + b  # mixed avals: let eager promotion rules decide
+    return _tree_add(a, b)
+
+
+def ones_like_ct(a):
+    if not _enabled_flag or isinstance(a, jax.core.Tracer):
+        return jnp.ones_like(a)
+    return _ones_like(a)

@@ -19,6 +19,7 @@ from ..layer.common import Linear
 
 __all__ = ["quantize_int8", "dequantize_int8", "Int8Linear",
            "quantize_model", "quantize_int8_stochastic",
+           "stochastic_round", "MOSAIC_SR_TARGETS",
            "FakeQuantAbsMax", "FakeQuantMovingAverageAbsMax",
            "FakeQuantChannelWiseAbsMax", "QuantizedLinear",
            "QuantizedConv2D", "ImperativeQuantAware",
@@ -48,6 +49,65 @@ def dequantize_int8(q, scale, dtype="float32"):
     return f(q, scale)
 
 
+# float targets Mosaic's stochastic_round lowering accepts; every other
+# narrowing conversion inside a kernel must route around it (fp32→int8
+# direct casts get rewritten onto that lowering by current libtpu and die
+# with "Only bfloat16, float8_* ... are supported as target dtypes")
+MOSAIC_SR_TARGETS = ("bfloat16", "float8_e5m2", "float8_e4m3fn",
+                     "float8_e4m3b11fnuz")
+
+
+def stochastic_round(x, dtype=jnp.bfloat16, seed: int = 0,
+                     interpret: bool = False):
+    """fp32 → low-precision-float stochastic rounding (pallas PRNG).
+
+    The target dtype is gated to :data:`MOSAIC_SR_TARGETS`; for bf16 the
+    rounding is the classic add-uniform-to-discarded-mantissa-bits
+    construction (int ops + bitcasts only, so Mosaic never sees an
+    unsupported narrowing cast)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    dt = jnp.dtype(dtype)
+    if dt.name not in MOSAIC_SR_TARGETS:
+        raise ValueError(
+            f"stochastic_round target {dt.name!r} unsupported; Mosaic "
+            f"accepts {MOSAIC_SR_TARGETS} (integer targets: use "
+            "quantize_int8_stochastic, which rounds in fp32)")
+    if dt != jnp.bfloat16:
+        raise NotImplementedError(
+            "only the bf16 target is implemented on this backend")
+
+    def kernel(x_ref, seed_ref, o_ref):
+        pltpu.prng_seed(seed_ref[0])
+        bits = pltpu.bitcast(pltpu.prng_random_bits(x_ref.shape), jnp.int32)
+        # add U[0, 2^16) to the 16 mantissa bits bf16 truncation drops:
+        # carries propagate into the kept bits with probability equal to
+        # the dropped fraction — exactly stochastic rounding to bf16
+        u16 = jax.lax.shift_right_logical(bits, 16)
+        xi = pltpu.bitcast(x_ref[:], jnp.int32)
+        rounded = xi + u16
+        kept = jax.lax.shift_left(
+            jax.lax.shift_right_logical(rounded, 16), 16)
+        # emit fp32 with zeroed low mantissa: the bf16 cast outside the
+        # kernel is then exact (no second rounding, and no narrowing
+        # Mosaic has to reroute)
+        o_ref[:] = pltpu.bitcast(kept, jnp.float32)
+
+    rows, cols = x.shape
+    out = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY
+                               if interpret else pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY
+                               if interpret else pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, cols), jnp.float32),
+        interpret=interpret,
+    )(x.astype(jnp.float32), jnp.asarray([seed], dtype=jnp.int32))
+    return out.astype(jnp.bfloat16)
+
+
 def quantize_int8_stochastic(w, seed: int = 0, interpret: bool = False):
     """On-device int8 quantization with stochastic rounding (pallas PRNG).
 
@@ -63,14 +123,20 @@ def quantize_int8_stochastic(w, seed: int = 0, interpret: bool = False):
         s_ref[0, 0] = scale
         scaled = x_ref[:] / scale
         # Mosaic's stochastic_round primitive only targets float dtypes
-        # (bf16/fp8); integer stochastic rounding is floor(x + u) with
-        # u ~ U[0,1): E[q] == x. Top 24 bits of the PRNG word give a
-        # uniform that fp32 represents exactly.
+        # (MOSAIC_SR_TARGETS); integer stochastic rounding is floor(x+u)
+        # with u ~ U[0,1): E[q] == x. Keep the PRNG word in int32 lanes
+        # (shift_right_logical, no uint casts) and narrow the result via
+        # fp32 → int32 → int8 — current libtpu rewrites both unsigned
+        # converts and direct fp32→int8 truncation onto the
+        # stochastic_round lowering, which rejects integer targets
+        # (BENCH_r05 kernel-gate failure).
         bits = pltpu.bitcast(pltpu.prng_random_bits(scaled.shape),
-                             jnp.uint32)
-        u = (bits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+                             jnp.int32)
+        u = jax.lax.shift_right_logical(bits, 8).astype(jnp.float32) \
+            * (1.0 / (1 << 24))
         q = jnp.floor(scaled + u)
-        q_ref[:] = jnp.clip(q, -127.0, 127.0).astype(jnp.int8)
+        q32 = jnp.clip(q, -127.0, 127.0).astype(jnp.int32)
+        q_ref[:] = q32.astype(jnp.int8)
 
     rows, cols = w.shape
     q, s = pl.pallas_call(

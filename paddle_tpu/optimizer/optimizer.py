@@ -12,6 +12,7 @@ Dual interface:
 """
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional
 
 import jax
@@ -20,6 +21,13 @@ import jax.numpy as jnp
 from ..regularizer import L1Decay, L2Decay
 from ..tensor import Parameter, Tensor
 from .lr import LRScheduler
+
+# eager steps an optimizer runs before its fused micro-step compiles:
+# the whole-tree jit costs ~100 ms+ while one fused step saves a few ms
+# of per-param python, so only loops long enough to amortize the compile
+# (real training, not a test's handful of steps) should ever pay it
+_FUSED_WARMUP = max(0, int(os.environ.get("PADDLE_TPU_FUSED_STEP_WARMUP",
+                                          "32")))
 
 
 class Optimizer:
@@ -98,8 +106,11 @@ class Optimizer:
 
     def step(self):
         lr = self.get_lr()
-        pgs = [(p, p.grad._data) for p in self._all_params()
-               if p.grad is not None and p.trainable]
+        params = [p for p in self._all_params()
+                  if p.grad is not None and p.trainable]
+        if self._fused_step(params, lr):
+            return
+        pgs = [(p, p.grad._data) for p in params]
         if self._grad_clip is not None:
             pgs = self._grad_clip(pgs)
         for p, g in pgs:
@@ -113,18 +124,117 @@ class Optimizer:
             p._data = new_p
             self._accumulators[id(p)] = new_st
 
-    def _apply_decay_to_grad(self, p, g):
+    # -- fused micro-step -----------------------------------------------------
+    def _fused_step(self, params, lr):
+        """One jitted, donated whole-tree update — clip + decay + every
+        param's pure ``update_param`` compile into a single XLA program
+        (param and moment buffers donated, so the update aliases in
+        place) instead of a per-param python loop of eager ops. Returns
+        False when this optimizer must use the loop (cache off, no
+        params, or a previous trace failure)."""
+        from ..framework import dispatch_cache as _dcache
+        if not params or not _dcache.enabled() \
+                or getattr(self, "_fused_disabled", False):
+            return False
+        steps = self.__dict__.get("_fused_seen_steps", 0) + 1
+        self._fused_seen_steps = steps
+        if steps <= _FUSED_WARMUP:
+            return False  # still warming: the compile wouldn't amortize
+        for p in params:
+            if self._accumulators.get(id(p)) is None:
+                self._accumulators[id(p)] = self.init_param_state(p._data)
+        cache = self.__dict__.setdefault("_fused_cache", {})
+        try:
+            key = self._fused_key(params)
+            jitted = cache.get(key)
+        except TypeError:  # unhashable key part (tracer avals etc.)
+            return False
+        if jitted is None:
+            try:
+                jitted = self._build_fused_step(list(params))
+            except Exception:
+                self._fused_disabled = True
+                return False
+            if len(cache) >= 4:  # param-set churn: stop pinning old sets
+                cache.clear()
+            cache[key] = jitted
+        p_vals = tuple(p._data for p in params)
+        st_vals = tuple(self._accumulators[id(p)] for p in params)
+        g_vals = tuple(p.grad._data for p in params)
+        try:
+            new_ps, new_sts = jitted(p_vals, st_vals, g_vals,
+                                     jnp.asarray(lr, jnp.float32),
+                                     _dcache.runtime_zero())
+        except Exception:
+            # first call traces: data-dependent clip/update python lands
+            # here — permanently fall back to the eager loop
+            cache.pop(key, None)
+            self._fused_disabled = True
+            return False
+        for p, new_p, new_st in zip(params, new_ps, new_sts):
+            p._data = new_p
+            self._accumulators[id(p)] = new_st
+        return True
+
+    def _fused_key(self, params):
+        """Signature of the fused step: param identities + avals of
+        params/grads/state. Raises TypeError on unhashable parts."""
+        parts = []
+        for p in params:
+            st = self._accumulators[id(p)]
+            parts.append((id(p), p._data.aval, p.grad._data.aval,
+                          tuple((k, st[k].aval) for k in sorted(st)),
+                          p.optimize_attr.get("learning_rate", 1.0),
+                          type(p.regularizer),
+                          getattr(p.regularizer, "coeff", None)))
+        return (tuple(parts), type(self._weight_decay),
+                getattr(self._weight_decay, "coeff", None),
+                self._grad_clip is None)
+
+    def _build_fused_step(self, params):
+        from ..framework.dispatch_cache import bitwise_call
+        clip = self._grad_clip
+
+        def body(p_vals, st_vals, g_vals, lr):
+            if clip is not None:
+                g_vals = [g for _, g in clip(list(zip(params, g_vals)))]
+            new_ps, new_sts = [], []
+            for p, pv, st, g in zip(params, p_vals, st_vals, g_vals):
+                g = self._apply_decay_to_grad(p, g, p_raw=pv)
+                plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+                new_p, new_st = self.update_param(pv, g, st, plr, p)
+                new_ps.append(new_p)
+                new_sts.append(new_st)
+            return tuple(new_ps), tuple(new_sts)
+
+        def fused(p_vals, st_vals, g_vals, lr, zero):
+            # xor-sealed evaluation keeps the compiled update bit-equal
+            # to the eager per-param loop (no cross-op FMA contraction)
+            return bitwise_call(zero, body, p_vals, st_vals, g_vals, lr)
+
+        # Donation aliases the update in place (no O(params) copy) but
+        # kills the pre-step buffers — which, in eager mode, the user may
+        # still hold through state_dict()/detach() snapshots (the static
+        # executor owns its buffers outright, so it always donates).
+        # Opt-in keeps those snapshots alive by default.
+        import os
+        if os.environ.get("PADDLE_TPU_FUSED_STEP_DONATE", "0") == "1":
+            return jax.jit(fused, donate_argnums=(0, 1))
+        return jax.jit(fused)
+
+    def _apply_decay_to_grad(self, p, g, p_raw=None):
         # L1/L2Decay are coupled (added to grad); AdamW overrides with
         # decoupled decay in update_param. Sparse tables under lazy mode
         # skip coupled decay entirely — it would mark every row touched and
         # defeat the sparse-row semantics (the reference likewise skips the
-        # regularizer for SelectedRows grads with a warning).
+        # regularizer for SelectedRows grads with a warning). p_raw
+        # substitutes the traced param value inside the fused step.
         if getattr(self, "_lazy", False) and \
                 getattr(p, "is_sparse_table", False):
             return g
         reg = p.regularizer or self._weight_decay
         if isinstance(reg, (L1Decay, L2Decay)) and not getattr(self, "_decoupled", False):
-            g = g + reg.grad_term(p._data)
+            g = g + reg.grad_term(p._data if p_raw is None else p_raw)
         return g
 
     def clear_grad(self, set_to_zero=True):

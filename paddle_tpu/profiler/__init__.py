@@ -21,11 +21,22 @@ import jax
 from .timer import Benchmark, benchmark  # noqa: F401
 
 __all__ = [
-    "Benchmark", "benchmark",
+    "Benchmark", "benchmark", "dispatch_counters",
     "ProfilerState", "ProfilerTarget", "make_scheduler",
     "export_chrome_tracing", "export_protobuf", "Profiler", "RecordEvent",
     "RecordInstantEvent", "load_profiler_result", "SortedKeys",
 ]
+
+
+def dispatch_counters() -> dict:
+    """Eager dispatch fast-path counters (hits / misses / compiles —
+    the retrace count — / bypasses), same snapshot as
+    ``paddle.framework.dispatch_stats()``. A steady-state eager loop
+    should only add hits; anything else is a retrace or a cache bypass
+    worth profiling."""
+    from ..framework import dispatch_cache
+
+    return dispatch_cache.dispatch_stats()
 
 
 class ProfilerState(Enum):
@@ -185,6 +196,12 @@ class Profiler:
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
         print(self.step_info())
+        dc = dispatch_counters()
+        print("eager dispatch cache: "
+              f"hits={dc['hits']} misses={dc['misses']} "
+              f"retraces={dc['compiles']} bypasses={dc['bypasses']} "
+              f"entries={dc['entries']}"
+              + ("" if dc["enabled"] else " (disabled)"))
         if self.timer_only:
             return
         try:

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .autograd import tape
+from .framework import dispatch_cache as _dcache
 from .framework import dtype as dtype_mod
 
 
@@ -189,6 +190,9 @@ class Tensor:
                 "Cannot register hook on a Tensor with stop_gradient=True")
         if self._grad_hooks is None:
             self._grad_hooks = {}
+        # conservative: registering a hook may change what a cached
+        # backward half should observe — drop compiled entries
+        _dcache.invalidate()
         return tape.HookHandle(self._grad_hooks, hook)
 
     # -- display ------------------------------------------------------------
@@ -308,6 +312,33 @@ def _wrap_out(val, stop_gradient):
     return Tensor(val, stop_gradient=stop_gradient)
 
 
+def _cached_dispatch(fn, args, raw, kwargs, diff_idx):
+    """Signature-keyed fast path (framework.dispatch_cache): steady-state
+    eager ops run as two compiled halves instead of re-tracing jax.vjp.
+    Returns the wrapped result, or None when the caller must fall back."""
+    hit = _dcache.dispatch(fn, raw, kwargs, diff_idx)
+    if hit is None:
+        return None
+    out, pullback, entry = hit
+    multi = isinstance(out, (tuple, list))
+    if not diff_idx:
+        if multi:
+            return tuple(_wrap_out(o, True) for o in out)
+        return _wrap_out(out, True)
+    outs = tuple(out) if multi else (out,)
+
+    def vjp_fn(out_cts):
+        cts = tuple(
+            jnp.zeros_like(o) if ct is None else ct
+            for o, ct in zip(outs, out_cts)
+        )
+        return entry.backward(pullback, cts if multi else cts[0])
+
+    wrapped = tuple(_wrap_out(o, False) for o in outs)
+    tape.record(vjp_fn, [args[i] for i in diff_idx], wrapped)
+    return wrapped if multi else wrapped[0]
+
+
 def apply(fn: Callable, *args, n_outputs: Any = 1, **kwargs):
     """Run primitive ``fn`` (a pure jnp function) on mixed Tensor/array args.
 
@@ -321,6 +352,11 @@ def apply(fn: Callable, *args, n_outputs: Any = 1, **kwargs):
             if isinstance(a, Tensor) and not a.stop_gradient:
                 diff_idx.append(i)
     raw = [_unwrap(a) for a in args]
+
+    if _dcache.enabled() and _op_recorder is None:
+        res = _cached_dispatch(fn, args, raw, kwargs, tuple(diff_idx))
+        if res is not None:
+            return res
 
     if not diff_idx:
         out = fn(*raw, **kwargs)
@@ -362,6 +398,10 @@ def apply(fn: Callable, *args, n_outputs: Any = 1, **kwargs):
 def nondiff(fn: Callable, *args, **kwargs):
     """Apply a non-differentiable op (argmax, comparisons, ...)."""
     raw = [_unwrap(a) for a in args]
+    if _dcache.enabled() and _op_recorder is None:
+        res = _cached_dispatch(fn, args, raw, kwargs, ())
+        if res is not None:
+            return res
     out = fn(*raw, **kwargs)
     if isinstance(out, (tuple, list)):
         res = tuple(_wrap_out(o, True) for o in out)

@@ -92,6 +92,31 @@ def test_quantize_int8_stochastic_tpu():
     assert np.abs(wd - np.asarray(w)).max() <= float(s[0, 0]) + 1e-6
 
 
+def test_stochastic_round_bf16_tpu():
+    """fp32->bf16 stochastic rounding (the BENCH_r05 kernel-gate path):
+    target dtype gated to MOSAIC_SR_TARGETS, output lands on one of the
+    two bracketing bf16 values."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.quant import MOSAIC_SR_TARGETS, stochastic_round
+
+    w32 = np.random.default_rng(5).normal(size=(32, 128)).astype(np.float32)
+    with pytest.raises(ValueError):
+        stochastic_round(jnp.asarray(w32), jnp.int8)
+    assert "bfloat16" in MOSAIC_SR_TARGETS
+    if jax.default_backend() != "tpu":
+        pytest.skip("needs TPU (pallas PRNG has no CPU interpret support)")
+    r = stochastic_round(jnp.asarray(w32), jnp.bfloat16, seed=7)
+    assert r.dtype == jnp.bfloat16
+    rf = np.asarray(r, dtype=np.float32)
+    # each element must equal its value truncated to bf16 or one ulp up
+    lo = jnp.asarray(w32).astype(jnp.bfloat16)
+    err = np.abs(rf - w32)
+    ulp = np.abs(np.asarray(lo, np.float32)) * 2.0 ** -7 + 1e-30
+    assert (err <= ulp + 1e-6).all()
+
+
 def test_stablehlo_export_roundtrip():
     import jax
 

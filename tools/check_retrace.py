@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Retrace lint: a warm eager train loop must be trace-free.
+
+Runs an MLP train step (forward, cross-entropy, backward, Adam step,
+clear_grad) eagerly for a warmup phase, snapshots the dispatch-cache
+counters, then runs a measured phase and fails if ANY signature was
+compiled, missed, or bypassed during it — i.e. steady-state eager
+execution must be 100% cache hits (0 traces). Also cross-checks with a
+jax monitoring listener counting backend compile events, so a retrace
+that sneaks around the dispatch counters still fails the build.
+
+Modeled on tools/check_hlo_layout.py. Usage:
+
+    JAX_PLATFORMS=cpu python tools/check_retrace.py [--json]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", action="store_true", help="emit a JSON line")
+    # warmup must clear both engage thresholds at their defaults
+    # (PADDLE_TPU_EAGER_CACHE_WARMUP=32 sightings per op signature,
+    # PADDLE_TPU_FUSED_STEP_WARMUP=32 optimizer steps) plus the step
+    # that compiles, so the measured phase is pure steady state
+    ap.add_argument("--warmup", type=int, default=40)
+    ap.add_argument("--steps", type=int, default=8)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import dispatch_cache
+
+    compile_events = [0]
+
+    def on_event(event, *a, **k):
+        if "compil" in event.lower():
+            compile_events[0] += 1
+
+    try:
+        from jax._src import monitoring
+        monitoring.register_event_listener(on_event)
+        have_monitor = True
+    except Exception:
+        have_monitor = False
+
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((32, 64)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 10, (32,)).astype(np.int64))
+    net = paddle.nn.Sequential(paddle.nn.Linear(64, 64), paddle.nn.ReLU(),
+                               paddle.nn.Linear(64, 10))
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+
+    def step():
+        loss = paddle.nn.functional.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(args.warmup):
+        step()
+
+    warm = dispatch_cache.dispatch_stats()
+    compile_events[0] = 0
+    for _ in range(args.steps):
+        loss = step()
+    float(loss.numpy())
+
+    stats = dispatch_cache.dispatch_stats()
+    delta = {k: stats[k] - warm[k]
+             for k in ("hits", "misses", "compiles", "bypasses")}
+    traces = delta["misses"] + delta["compiles"] + delta["bypasses"]
+    if have_monitor:
+        traces += compile_events[0]
+    ok = stats["enabled"] and traces == 0 and delta["hits"] > 0
+
+    record = {"bench": "retrace_lint", "model": "mlp_adam",
+              "warmup": args.warmup, "steps": args.steps,
+              "steady_state_traces": traces, "delta": delta,
+              "backend_compiles": compile_events[0] if have_monitor else None,
+              "cache": stats, "ok": ok}
+    if args.json:
+        print(json.dumps(record))
+    else:
+        for k, v in delta.items():
+            print(f"{k:12s} {v}")
+        print(f"{'backend':12s} {record['backend_compiles']}")
+        print("OK (0 steady-state traces)" if ok else
+              "FAIL: warm eager loop still traces")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
