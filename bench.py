@@ -7,7 +7,8 @@ fp32 moments, so layer count is scaled down while keeping per-layer shapes
 MXU-saturating; tokens/sec/chip is comparable round over round.
 
 Secondary metrics (same JSON line, under extra.secondary): ResNet-50,
-BERT-base (DP proxy), ViT-B/16, ERNIE-MoE — the remaining BASELINE configs.
+BERT-base (DP proxy), ViT-B/16, ERNIE-MoE — the remaining BASELINE configs
+— plus the continuous-batching serving engine arm (serving_engine).
 Set PADDLE_TPU_BENCH_SECONDARY=0 to skip them.
 
 Timing methodology: the TPU tunnel's block_until_ready does NOT reliably
@@ -533,6 +534,58 @@ def bench_llama_fused_ce(backend):
             os.environ["PADDLE_TPU_BENCH_FUSED_CE"] = prev
 
 
+def bench_serving(backend):
+    """Continuous-batching serving engine (paddle_tpu.serving): a 16-
+    request mixed-prompt workload through the slot-KV engine vs
+    sequential one-request-at-a-time generate(), 8-layer llama. Reports
+    new tokens/sec and the TTFT/ITL ledger at the best n_slots (the CPU
+    ledger lives in tools/bench_serving.py; this is the TPU arm)."""
+    import paddle_tpu
+    from paddle_tpu.serving import Engine, ledger
+    from paddle_tpu.text.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if backend != "tpu":
+        return {"skipped": "tpu only"}
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5504, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=16,
+                      max_position_embeddings=512, dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    n_req, max_new = 16, 64
+    rng = np.random.default_rng(0)
+    lens = [(48, 96, 120, 128)[i % 4] for i in range(n_req)]
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in lens]
+    total_new = n_req * max_new
+
+    for n in sorted(set(lens)):          # warm per-length programs
+        p = next(q for q, m in zip(prompts, lens) if m == n)
+        _ = np.asarray(model.generate(
+            paddle_tpu.to_tensor(p[None]), max_new_tokens=max_new)._data)
+    t0 = time.perf_counter()
+    for p in prompts:
+        _ = np.asarray(model.generate(
+            paddle_tpu.to_tensor(p[None]), max_new_tokens=max_new)._data)
+    seq_tps = total_new / (time.perf_counter() - t0)
+
+    eng = Engine(model, n_slots=8, max_len=256, min_prompt_bucket=64)
+    eng.generate_all(prompts, max_new_tokens=max_new)        # warm
+    t0 = time.perf_counter()
+    handles = eng.generate_all(prompts, max_new_tokens=max_new)
+    wall = time.perf_counter() - t0
+    led = ledger(handles)
+    return {"engine_tokens_per_sec": round(total_new / wall, 1),
+            "sequential_tokens_per_sec": round(seq_tps, 1),
+            "speedup_vs_sequential": round(total_new / wall / seq_tps, 2),
+            "n_slots": 8, "requests": n_req, "max_new": max_new,
+            "ttft_ms_p50": led["ttft_ms_p50"],
+            "ttft_ms_p95": led["ttft_ms_p95"],
+            "itl_ms_p50": led["itl_ms_p50"],
+            "itl_ms_p95": led["itl_ms_p95"]}
+
+
 def bench_ctr_widedeep(backend):
     """Recsys/PS-analog throughput: wide&deep CTR over a 1M-row sharded
     embedding table (single chip: table replicated-equivalent), lazy-row
@@ -813,6 +866,7 @@ def main():
                          ("llama_b8_selective_remat",
                           bench_llama_b8_selective),
                          ("ctr_widedeep", bench_ctr_widedeep),
+                         ("serving_engine", bench_serving),
                          ("flash_blocks", bench_flash_blocks)):
             if only and name not in only:
                 # marker (not omission) so the artifact fill-loop below

@@ -66,10 +66,17 @@ class _Handle:
         self._value = None
 
     def reshape(self, shape):
-        self._shape = tuple(int(s) for s in shape)
-        if self._value is not None and self._value.size == int(
-                np.prod(self._shape)):
-            self._value = self._value.reshape(self._shape)
+        shape = tuple(int(s) for s in shape)
+        if self._value is not None:
+            if self._value.size != int(np.prod(shape)):
+                # refuse rather than silently keeping the old buffer with
+                # a contradicting declared shape
+                raise ValueError(
+                    f"handle '{self.name}': reshape{shape} changes element "
+                    f"count ({self._value.size} -> {int(np.prod(shape))}); "
+                    "clear or refill the handle first")
+            self._value = self._value.reshape(shape)
+        self._shape = shape
 
     def shape(self):
         if self._value is not None:
@@ -156,6 +163,64 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class LLMPredictor:
+    """Serving-engine predictor over a saved CausalLM artifact
+    (create_predictor analog for generative workloads): rebuilds the
+    model from the artifact's weights + config and serves it through
+    ``paddle_tpu.serving.Engine`` — concurrent requests, slot KV cache,
+    streaming callbacks. Thin delegation: submit/generate_all/drain and
+    the metrics ledger come straight from the engine."""
+
+    def __init__(self, config, n_slots=8, max_len=None, **engine_kwargs):
+        from ..jit.serialization import load as jit_load
+        from ..serving import Engine
+
+        path = config.prog_file() if isinstance(config, Config) else config
+        if path is None:
+            raise ValueError("Config has no model path")
+        if path.endswith(".pdmodel"):
+            path = path[:-len(".pdmodel")]
+        layer = jit_load(path)
+        cfgs = getattr(layer, "configs", {}) or {}
+        if "llm_config" not in cfgs:
+            raise ValueError(
+                "artifact was not saved with serving.save_lm (no "
+                "llm_config recorded); cannot rebuild the model")
+        arch = cfgs.get("llm_arch", "llama")
+        if arch == "llama":
+            from ..text.models.llama import LlamaConfig, LlamaForCausalLM
+            model = LlamaForCausalLM(LlamaConfig(**cfgs["llm_config"]))
+        else:
+            from ..text.models.gpt import GPTConfig, GPTForCausalLM
+            model = GPTForCausalLM(GPTConfig(**cfgs["llm_config"]))
+        model.set_state_dict(layer.state_dict())
+        model.eval()
+        self.model = model
+        self.engine = Engine(model, n_slots=n_slots, max_len=max_len,
+                             **engine_kwargs)
+
+    def submit(self, prompt, **gen_kwargs):
+        return self.engine.submit(prompt, **gen_kwargs)
+
+    def generate_all(self, prompts, **gen_kwargs):
+        return self.engine.generate_all(prompts, **gen_kwargs)
+
+    def drain(self):
+        self.engine.drain()
+
+    def stats(self):
+        return self.engine.stats()
+
+
+def create_llm_predictor(config, n_slots=8, max_len=None,
+                         **engine_kwargs) -> LLMPredictor:
+    """Serve a jit-saved LM artifact (serving.save_lm) through the
+    continuous-batching engine. ``config`` is an inference.Config (its
+    prog_file points at the artifact) or the artifact path itself."""
+    return LLMPredictor(config, n_slots=n_slots, max_len=max_len,
+                        **engine_kwargs)
 
 
 # -- type/query surface (reference paddle/inference/__init__.py wraps
@@ -254,4 +319,5 @@ class PredictorPool:
 __all__ += ["DataType", "PlaceType", "PrecisionType", "BackendType",
             "Tensor", "get_version", "get_trt_compile_version",
             "get_trt_runtime_version", "get_num_bytes_of_data_type",
-            "convert_to_mixed_precision", "PredictorPool"]
+            "convert_to_mixed_precision", "PredictorPool",
+            "LLMPredictor", "create_llm_predictor"]

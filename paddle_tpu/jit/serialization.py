@@ -42,6 +42,11 @@ def build_symbolic_specs(shapes, dtypes):
 def save(layer, path, input_spec=None, **configs):
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     payload = {"format": "paddle_tpu.jit", "version": 1}
+    if configs:
+        # reference jit.save forwards extra configs into the program desc;
+        # here they ride in the payload (serving.save_lm stores the LM
+        # config/arch this way for inference.create_llm_predictor)
+        payload["configs"] = dict(configs)
     from ..nn.layer_base import Layer
 
     if isinstance(layer, Layer):
@@ -101,6 +106,7 @@ class TranslatedLayer:
         self.output_avals = None
         self.input_names = payload.get("input_names")
         self.output_names = payload.get("output_names")
+        self.configs = payload.get("configs", {})
         if payload.get("stablehlo"):
             from jax import export as jax_export
             exported = jax_export.deserialize(payload["stablehlo"])

@@ -21,7 +21,7 @@ import jax
 from .timer import Benchmark, benchmark  # noqa: F401
 
 __all__ = [
-    "Benchmark", "benchmark", "dispatch_counters",
+    "Benchmark", "benchmark", "dispatch_counters", "serving_counters",
     "ProfilerState", "ProfilerTarget", "make_scheduler",
     "export_chrome_tracing", "export_protobuf", "Profiler", "RecordEvent",
     "RecordInstantEvent", "load_profiler_result", "SortedKeys",
@@ -37,6 +37,15 @@ def dispatch_counters() -> dict:
     from ..framework import dispatch_cache
 
     return dispatch_cache.dispatch_stats()
+
+
+def serving_counters() -> dict:
+    """Aggregate serving-engine counters across every live
+    ``paddle_tpu.serving.Engine`` (requests, tokens, prefills, decode
+    steps, queue pressure) — same plumbing as dispatch_counters()."""
+    from ..serving import metrics as serving_metrics
+
+    return serving_metrics.global_counters()
 
 
 class ProfilerState(Enum):
@@ -202,6 +211,16 @@ class Profiler:
               f"retraces={dc['compiles']} bypasses={dc['bypasses']} "
               f"entries={dc['entries']}"
               + ("" if dc["enabled"] else " (disabled)"))
+        sc = serving_counters()
+        if sc["engines"]:
+            print("serving: "
+                  f"engines={sc['engines']} "
+                  f"requests={sc['requests_completed']}/"
+                  f"{sc['requests_submitted']} "
+                  f"tokens={sc['tokens_generated']} "
+                  f"prefills={sc['prefills']} "
+                  f"decode_steps={sc['decode_steps']} "
+                  f"peak_queue={sc['peak_queue_depth']}")
         if self.timer_only:
             return
         try:

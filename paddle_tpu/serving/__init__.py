@@ -1,0 +1,45 @@
+"""paddle_tpu.serving — continuous-batching LLM serving engine.
+
+Reference pairing: paddle/fluid/inference is the reference deployment
+runtime (Config/Predictor over a saved program, one request at a time);
+this package is its many-concurrent-requests counterpart: a slot-based
+KV cache + iteration-level batching engine whose whole decode step is
+one fixed-shape jitted XLA program (see engine.py), with a
+latency/throughput ledger in metrics.py.
+
+Quick start::
+
+    from paddle_tpu.serving import Engine
+    eng = Engine(model, n_slots=8, max_len=256, eos_token_id=2)
+    h = eng.submit(prompt_ids, max_new_tokens=64,
+                   on_token=lambda h, t: print(t))
+    full = h.result()          # pumps the engine until this one finishes
+
+For a saved artifact, ``save_lm(model, path)`` then
+``paddle_tpu.inference.create_llm_predictor(path)``.
+"""
+from __future__ import annotations
+
+from .engine import Engine, RequestHandle                   # noqa: F401
+from .kv_cache import SlotKVCache                           # noqa: F401
+from .metrics import EngineMetrics, RequestMetrics, ledger  # noqa: F401
+from .scheduler import EngineOverloaded, FIFOScheduler      # noqa: F401
+
+__all__ = ["Engine", "RequestHandle", "SlotKVCache", "EngineMetrics",
+           "RequestMetrics", "ledger", "EngineOverloaded",
+           "FIFOScheduler", "save_lm"]
+
+
+def save_lm(model, path):
+    """Save a CausalLM as a servable artifact: jit.save's weight payload
+    plus the model config, so inference.create_llm_predictor can rebuild
+    the model and serve it through an Engine without the original python
+    construction code."""
+    import dataclasses
+
+    from ..jit.serialization import save
+    from .engine import _make_arch
+
+    _, hp, _ = _make_arch(model)      # validates the model type
+    return save(model, path, llm_arch=hp["arch"],
+                llm_config=dataclasses.asdict(model.config))

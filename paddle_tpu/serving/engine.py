@@ -1,0 +1,416 @@
+"""Iteration-level (continuous) batching engine over the stacked-weight
+Llama/GPT decode path.
+
+Design (ROADMAP north star: serve concurrent, asynchronously arriving
+requests without ever recompiling):
+
+- ``submit()`` enqueues a request; admission prefills it **directly into
+  a free KV slot** with a program bucketed to the next power-of-two
+  prompt length (bounded compile count: one prefill program per bucket).
+- ``step()`` advances ALL active slots one token with a single fused
+  jitted decode program of static shape ``[n_slots, ...]`` — new
+  requests join between steps, finished ones free their slot without
+  disturbing neighbours. Two XLA programs total in steady state
+  (n_buckets prefills + 1 decode), enforced by
+  tools/check_serving_compiles.py.
+- Per-request PRNG: each request owns a key chain seeded at admission
+  and split once per decode step, so sampled output is a function of
+  (prompt, seed, gen kwargs) only — independent of co-batched traffic.
+  The chain matches batch ``generate(seed=...)`` exactly for B=1.
+- The decode math is ``text/generation.py``'s module-level per-layer
+  bodies: the engine and batch ``generate()`` trace the same python, so
+  there is one lowering to keep conformant (greedy outputs are
+  token-identical).
+
+The engine is single-threaded and step-driven: callers (or
+``RequestHandle.result()`` / ``drain()``) pump ``step()``; all host-side
+bookkeeping is numpy so nothing but the two jitted programs ever reaches
+the device.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .kv_cache import SlotKVCache
+from .metrics import EngineMetrics, RequestMetrics
+from .scheduler import EngineOverloaded, FIFOScheduler  # noqa: F401
+
+__all__ = ["Engine", "RequestHandle", "EngineOverloaded"]
+
+
+# ---------------------------------------------------------------------------
+# jitted programs (module-level: every Engine over the same model/geometry
+# shares the compile cache)
+# ---------------------------------------------------------------------------
+
+def _prefill_impl(w, kc, vc, tok, cur_pos, keys, ids, n_prompt, slot, seed,
+                  temp, *, arch, n_heads, n_kv, eps, theta, do_sample,
+                  top_k, top_p):
+    """Prefill one request (ids [1, Lb], right-padded to its bucket) into
+    KV slot ``slot``, sample its first token, and register the request's
+    PRNG chain. One compile per bucket length Lb."""
+    from ..text import generation as G
+
+    Lb = ids.shape[1]
+    if arch == "llama":
+        x = jnp.take(w["embed"], ids, axis=0)
+        pos = jnp.arange(Lb)
+        stack = {k: w[k] for k in G._LLAMA_STACK_KEYS}
+
+        def one(xc, lw):
+            return G._llama_prefill_layer(xc, lw, pos, n_heads=n_heads,
+                                          n_kv=n_kv, eps=eps, theta=theta)
+
+        x, kvs = jax.lax.scan(one, x, stack)
+        hlast = jax.lax.dynamic_index_in_dim(
+            G._rms(x, w["norm"], eps)[0], n_prompt - 1, 0, keepdims=False)
+        logits0 = hlast @ w["head"]
+    else:
+        pos = jnp.arange(Lb)
+        x = jnp.take(w["wte"], ids, axis=0) + w["wpe"][pos][None]
+        stack = {k: w[k] for k in G._GPT_STACK_KEYS}
+
+        def one(xc, lw):
+            return G._gpt_prefill_layer(xc, lw, n_heads=n_heads)
+
+        x, kvs = jax.lax.scan(one, x, stack)
+        xlast = jax.lax.dynamic_index_in_dim(x[0], n_prompt - 1, 0,
+                                             keepdims=False)
+        logits0 = G._ln(xlast, w["lnfw"], w["lnfb"]) @ w["head"]
+
+    # bucket-pad KV lines beyond n_prompt land in the slot too, but the
+    # decode causal bound (<= write line) only exposes a line after the
+    # step that overwrote it with real KV — stale lines are never read
+    kc = jax.lax.dynamic_update_slice(kc, kvs[0], (0, slot, 0, 0, 0))
+    vc = jax.lax.dynamic_update_slice(vc, kvs[1], (0, slot, 0, 0, 0))
+
+    key = jax.random.PRNGKey(seed)
+    key, sk = jax.random.split(key)
+    logits_f = G._filter_logits(logits0[None], temp, do_sample, top_k,
+                                top_p)
+    if do_sample:
+        tok0 = jax.random.categorical(sk, logits_f, axis=-1)[0]
+    else:
+        tok0 = jnp.argmax(logits_f, axis=-1)[0]
+    tok0 = tok0.astype(jnp.int32)
+    tok = tok.at[slot].set(tok0)
+    cur_pos = cur_pos.at[slot].set(n_prompt.astype(jnp.int32))
+    keys = keys.at[slot].set(key)
+    return kc, vc, tok, cur_pos, keys, tok0
+
+
+def _decode_impl(w, kc, vc, tok, cur_pos, active, keys, temps, *, arch,
+                 n_heads, n_kv, eps, theta, do_sample, top_k, top_p):
+    """One fused decode step: every active slot advances one token at its
+    own position (inactive slots compute masked garbage and keep their
+    state). ONE program for the life of the engine."""
+    from ..text import generation as G
+
+    if arch == "llama":
+        xt = jnp.take(w["embed"], tok, axis=0)[:, None]
+        stack = {k: w[k] for k in G._LLAMA_STACK_KEYS}
+
+        def one(cx, lw_kv):
+            xt2, kc_l, vc_l = G._llama_decode_layer(
+                cx["x"], lw_kv, lw_kv["kc"], lw_kv["vc"], cur_pos,
+                cur_pos, None, n_heads=n_heads, n_kv=n_kv, eps=eps,
+                theta=theta)
+            return {"x": xt2}, (kc_l, vc_l)
+    else:
+        xt = (jnp.take(w["wte"], tok, axis=0)
+              + jnp.take(w["wpe"], cur_pos, axis=0))[:, None]
+        stack = {k: w[k] for k in G._GPT_STACK_KEYS}
+
+        def one(cx, lw_kv):
+            xt2, kc_l, vc_l = G._gpt_decode_layer(
+                cx["x"], lw_kv, lw_kv["kc"], lw_kv["vc"], cur_pos, None,
+                n_heads=n_heads)
+            return {"x": xt2}, (kc_l, vc_l)
+
+    lw_kv = dict(stack)
+    lw_kv["kc"] = kc
+    lw_kv["vc"] = vc
+    cx, (kc, vc) = jax.lax.scan(one, {"x": xt}, lw_kv)
+    if arch == "llama":
+        hidden = G._rms(cx["x"][:, 0], w["norm"], eps)
+        logits = hidden @ w["head"]
+    else:
+        logits = G._ln(cx["x"][:, 0], w["lnfw"], w["lnfb"]) @ w["head"]
+
+    split = jax.vmap(jax.random.split)(keys)        # [S, 2, 2]
+    new_keys, sks = split[:, 0], split[:, 1]
+    logits_f = G._filter_logits(logits, temps, do_sample, top_k, top_p)
+    if do_sample:
+        nxt = jax.vmap(jax.random.categorical)(sks, logits_f)
+    else:
+        nxt = jnp.argmax(logits_f, axis=-1)
+    nxt = nxt.astype(jnp.int32)
+    # inactive slots hold position: token, key chain and cur_pos freeze
+    nxt = jnp.where(active, nxt, tok)
+    new_keys = jnp.where(active[:, None], new_keys, keys)
+    cur2 = jnp.where(active, cur_pos + 1, cur_pos)
+    return nxt, kc, vc, cur2, new_keys
+
+
+_STATICS = ("arch", "n_heads", "n_kv", "eps", "theta", "do_sample",
+            "top_k", "top_p")
+_PREFILL = jax.jit(_prefill_impl, static_argnames=_STATICS)
+_PREFILL_DONATED = jax.jit(_prefill_impl, static_argnames=_STATICS,
+                           donate_argnums=(1, 2))
+_DECODE = jax.jit(_decode_impl, static_argnames=_STATICS)
+_DECODE_DONATED = jax.jit(_decode_impl, static_argnames=_STATICS,
+                          donate_argnums=(1, 2))
+
+
+def _make_arch(model):
+    """Weight stack + static hyperparams for a supported CausalLM."""
+    from ..text import generation as G
+
+    name = type(model).__name__
+    c = model.config
+    hd = c.hidden_size // c.num_attention_heads
+    if name == "LlamaForCausalLM":
+        w = G._stacked_weights(model)
+        hp = dict(arch="llama", n_heads=c.num_attention_heads,
+                  n_kv=c.num_key_value_heads, eps=c.rms_norm_eps,
+                  theta=c.rope_theta)
+        kvh = c.num_key_value_heads
+        dtype = w["embed"].dtype
+    elif name == "GPTForCausalLM":
+        w = G._gpt_stacked_weights(model)
+        hp = dict(arch="gpt", n_heads=c.num_attention_heads,
+                  n_kv=c.num_attention_heads, eps=1e-5, theta=0.0)
+        kvh = c.num_attention_heads
+        dtype = w["wte"].dtype
+    else:
+        raise TypeError(
+            f"serving.Engine supports LlamaForCausalLM / GPTForCausalLM, "
+            f"got {name}")
+    geo = dict(n_layers=c.num_hidden_layers, kv_heads=kvh, head_dim=hd,
+               dtype=dtype, max_pos=c.max_position_embeddings)
+    return w, hp, geo
+
+
+class RequestHandle:
+    """One submitted request: streams tokens as the engine decodes.
+
+    ``tokens`` grows as the engine steps; ``on_token(handle, token)``
+    fires per token (first one during prefill — that stamp is the TTFT);
+    ``result()`` pumps the engine until this request finishes and
+    returns the full sequence (prompt + generated) as int32 numpy.
+    """
+
+    def __init__(self, engine, request_id, prompt_ids, max_new_tokens,
+                 temperature, seed, on_token):
+        self._engine = engine
+        self.request_id = request_id
+        self.prompt_ids = prompt_ids
+        self.n_prompt = int(prompt_ids.shape[0])
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.on_token = on_token
+        self.tokens = []
+        self.finished = False
+        self.finish_reason = None      # "eos" | "length"
+        self.slot = None
+        self.metrics = RequestMetrics()
+
+    def result(self):
+        while not self.finished:
+            self._engine.step()
+        return np.concatenate(
+            [self.prompt_ids, np.asarray(self.tokens, np.int32)])
+
+    def __repr__(self):
+        state = self.finish_reason or (
+            "decoding" if self.slot is not None else "queued")
+        return (f"RequestHandle(id={self.request_id}, prompt={self.n_prompt}"
+                f", tokens={len(self.tokens)}, {state})")
+
+
+class Engine:
+    """Continuous-batching serving engine (see module docstring).
+
+    Sampling mode (do_sample/top_k/top_p) is engine-wide — it is baked
+    into the two compiled programs. Temperature, seed and length are
+    per-request (plain runtime operands).
+    """
+
+    def __init__(self, model, n_slots=8, max_len=None, *, do_sample=False,
+                 top_k=0, top_p=None, eos_token_id=None,
+                 min_prompt_bucket=8, token_budget=None, max_queue=None,
+                 base_seed=0, donate=None):
+        self._w, self._hp, geo = _make_arch(model)
+        self.n_slots = int(n_slots)
+        self.max_len = int(max_len if max_len is not None
+                           else geo["max_pos"])
+        if self.max_len > geo["max_pos"] and self._hp["arch"] == "gpt":
+            raise ValueError("max_len exceeds the position table")
+        self.eos_token_id = eos_token_id
+        self.min_prompt_bucket = int(min_prompt_bucket)
+        self._statics = dict(self._hp, do_sample=bool(do_sample),
+                             top_k=int(top_k),
+                             top_p=None if top_p is None else float(top_p))
+        self.cache = SlotKVCache(geo["n_layers"], self.n_slots,
+                                 self.max_len, geo["kv_heads"],
+                                 geo["head_dim"], geo["dtype"])
+        # threaded device state (numpy until the first jit call)
+        self._tok = np.zeros(self.n_slots, np.int32)
+        self._cur = np.zeros(self.n_slots, np.int32)
+        self._keys = np.zeros((self.n_slots, 2), np.uint32)
+        self._temps = np.ones(self.n_slots, np.float32)
+        self.scheduler = FIFOScheduler(
+            token_budget=token_budget or self.n_slots * self.max_len,
+            max_queue=max_queue or max(4 * self.n_slots, 16))
+        self.metrics = EngineMetrics()
+        self._by_slot = [None] * self.n_slots
+        self._next_id = 0
+        self.base_seed = int(base_seed)
+        if donate is None:
+            donate = jax.default_backend() != "cpu"
+        self._prefill = _PREFILL_DONATED if donate else _PREFILL
+        self._decode = _DECODE_DONATED if donate else _DECODE
+
+    # -- request intake ---------------------------------------------------
+
+    def _bucket(self, n):
+        b = self.min_prompt_bucket
+        while b < n:
+            b <<= 1
+        return min(b, self.max_len)
+
+    @staticmethod
+    def _as_ids(prompt):
+        if isinstance(prompt, Tensor):
+            prompt = np.asarray(prompt._data)
+        ids = np.asarray(prompt, np.int32)
+        if ids.ndim == 2 and ids.shape[0] == 1:
+            ids = ids[0]
+        if ids.ndim != 1:
+            raise ValueError(
+                f"prompt must be a 1-D token sequence, got {ids.shape}")
+        return ids
+
+    def submit(self, prompt, max_new_tokens=32, temperature=1.0,
+               seed=None, on_token=None):
+        """Enqueue a request; returns a RequestHandle immediately. The
+        request prefills as soon as a slot + token budget admit it (often
+        inside this call). Raises EngineOverloaded past max_queue."""
+        ids = self._as_ids(prompt)
+        if ids.shape[0] < 1:
+            raise ValueError("empty prompt")
+        if ids.shape[0] + int(max_new_tokens) > self.max_len:
+            raise ValueError(
+                f"prompt ({ids.shape[0]}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_len={self.max_len}")
+        rid = self._next_id
+        self._next_id += 1
+        h = RequestHandle(
+            self, rid, ids, max_new_tokens, temperature,
+            self.base_seed + rid if seed is None else seed, on_token)
+        self.metrics.requests_submitted += 1
+        try:
+            self.scheduler.enqueue(h)
+        except EngineOverloaded:
+            self.metrics.requests_rejected += 1
+            raise
+        self._admit()
+        return h
+
+    def _admit(self):
+        # a request that finishes during its own prefill (eos first token,
+        # or max_new_tokens=1) frees its slot immediately — loop so the
+        # queue keeps draining into freshly freed slots
+        while True:
+            popped = self.scheduler.pop_admissible(self.cache.n_free)
+            if not popped:
+                return
+            for h in popped:
+                self._admit_one(h)
+
+    def _admit_one(self, h):
+        slot = self.cache.alloc(h.request_id)
+        h.slot = slot
+        self._by_slot[slot] = h
+        self._temps[slot] = h.temperature
+        Lb = self._bucket(h.n_prompt)
+        ids = np.zeros((1, Lb), np.int32)
+        ids[0, :h.n_prompt] = h.prompt_ids
+        out = self._prefill(
+            self._w, self.cache.kc, self.cache.vc, self._tok,
+            self._cur, self._keys, ids, np.int32(h.n_prompt),
+            np.int32(slot), np.uint32(h.seed),
+            np.float32(h.temperature), **self._statics)
+        (self.cache.kc, self.cache.vc, self._tok, self._cur,
+         self._keys, tok0) = out
+        self.metrics.prefills += 1
+        self.cache.cur_pos[slot] = h.n_prompt
+        self._emit(h, int(tok0))
+
+    # -- the decode loop --------------------------------------------------
+
+    def step(self):
+        """One engine iteration: admit waiting requests into free slots,
+        then advance every active slot one token. Returns the number of
+        requests that were decoding this step."""
+        self._admit()
+        n_active = self.cache.n_active
+        self.metrics.sample(self.cache.occupancy,
+                            self.scheduler.queue_depth)
+        if n_active:
+            out = self._decode(
+                self._w, self.cache.kc, self.cache.vc, self._tok,
+                self._cur, self.cache.active, self._keys,
+                self._temps, **self._statics)
+            nxt, self.cache.kc, self.cache.vc, self._cur, self._keys = out
+            self._tok = nxt
+            self.metrics.decode_steps += 1
+            toks = np.asarray(nxt)
+            for slot in np.nonzero(self.cache.active)[0]:
+                h = self._by_slot[int(slot)]
+                self._emit(h, int(toks[slot]))
+        return n_active
+
+    def _emit(self, h, token):
+        h.tokens.append(token)
+        h.metrics.mark_token()
+        self.metrics.tokens_generated += 1
+        self.cache.cur_pos[h.slot] = h.n_prompt + len(h.tokens) - 1
+        if h.on_token is not None:
+            h.on_token(h, token)
+        if self.eos_token_id is not None and token == self.eos_token_id:
+            self._finish(h, "eos")
+        elif len(h.tokens) >= h.max_new_tokens:
+            self._finish(h, "length")
+
+    def _finish(self, h, reason):
+        h.finished = True
+        h.finish_reason = reason
+        h.metrics.mark_finished()
+        self._by_slot[h.slot] = None
+        self.cache.free(h.slot)
+        self.scheduler.release(h)
+        self.metrics.requests_completed += 1
+
+    def drain(self):
+        """Pump step() until every submitted request has finished."""
+        while self.scheduler.queue_depth or self.cache.n_active:
+            self.step()
+
+    def generate_all(self, prompts, **gen_kwargs):
+        """Submit a list of prompts, drain, return the handles."""
+        handles = [self.submit(p, **gen_kwargs) for p in prompts]
+        self.drain()
+        return handles
+
+    def stats(self):
+        return {**self.metrics.snapshot(),
+                "n_slots": self.n_slots, "max_len": self.max_len,
+                "active": self.cache.n_active,
+                "queue_depth": self.scheduler.queue_depth,
+                "kv_cache_bytes": self.cache.nbytes()}

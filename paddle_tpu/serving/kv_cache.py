@@ -1,0 +1,89 @@
+"""Slot-based batched KV cache for continuous batching.
+
+One fixed ``[n_layers, n_slots, max_len, kv_heads, head_dim]`` device
+buffer pair for the life of the engine: a request is admitted into a free
+slot (its prefill KV written at lines ``0..len-1``), decoded in place
+(line ``len + i`` per generated token), and evicted on EOS/length by
+flipping the host-side slot mask — neighbouring slots are never moved or
+copied, so the jitted decode step sees ONE static shape forever (zero
+steady-state recompiles, same discipline as framework/dispatch_cache.py).
+
+The device buffers are threaded functionally through the engine's jitted
+prefill/decode programs (this object just holds the latest arrays); the
+slot allocator and per-slot position mirrors live host-side in numpy so
+engine bookkeeping never dispatches device ops between steps.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+
+class SlotKVCache:
+    """Fixed-shape per-layer KV slabs plus a host-side slot allocator."""
+
+    def __init__(self, n_layers, n_slots, max_len, kv_heads, head_dim,
+                 dtype):
+        if n_slots < 1:
+            raise ValueError("n_slots must be >= 1")
+        if max_len < 2:
+            raise ValueError("max_len must be >= 2")
+        self.n_layers = int(n_layers)
+        self.n_slots = int(n_slots)
+        self.max_len = int(max_len)
+        self.kv_heads = int(kv_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = np.dtype(dtype)
+        shape = (self.n_layers, self.n_slots, self.max_len, self.kv_heads,
+                 self.head_dim)
+        # plain numpy zeros: the first jit call device-puts them, so cache
+        # construction itself never compiles an XLA program (the serving
+        # compile budget is exactly n_prefill_buckets + 1)
+        self.kc = np.zeros(shape, self.dtype)
+        self.vc = np.zeros(shape, self.dtype)
+        # host mirrors of per-slot state (device copies live inside the
+        # engine's threaded arrays)
+        self.cur_pos = np.zeros(self.n_slots, np.int32)
+        self.active = np.zeros(self.n_slots, bool)
+        self._free = collections.deque(range(self.n_slots))
+        self._owner = [None] * self.n_slots   # request_id per slot
+
+    @property
+    def n_free(self):
+        return len(self._free)
+
+    @property
+    def n_active(self):
+        return int(self.active.sum())
+
+    @property
+    def occupancy(self):
+        return self.n_active / self.n_slots
+
+    def alloc(self, request_id=None):
+        """Claim the lowest free slot (FIFO over frees) or return None."""
+        if not self._free:
+            return None
+        slot = self._free.popleft()
+        self.active[slot] = True
+        self.cur_pos[slot] = 0
+        self._owner[slot] = request_id
+        return slot
+
+    def free(self, slot):
+        """Evict: slot becomes reusable; device lines are NOT cleared —
+        a later occupant overwrites each line before it becomes
+        attendable (causal bound), so stale KV is never read."""
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        self.active[slot] = False
+        self._owner[slot] = None
+        self._free.append(slot)
+
+    def owner(self, slot):
+        return self._owner[slot]
+
+    def nbytes(self):
+        return 2 * self.n_layers * self.n_slots * self.max_len \
+            * self.kv_heads * self.head_dim * self.dtype.itemsize

@@ -1,0 +1,160 @@
+"""Latency/throughput ledger for the serving engine.
+
+Per-request: TTFT (submit -> first token out of prefill), inter-token
+latencies, tokens/sec. Per-engine: slot occupancy and queue depth sampled
+every decode step, admission/eviction counters. Snapshots surface through
+``paddle_tpu.profiler.serving_counters()`` (the same counter plumbing as
+the eager dispatch cache) and feed tools/bench_serving.py's JSON ledger.
+"""
+from __future__ import annotations
+
+import time
+import weakref
+
+
+def _percentile(values, p):
+    """Nearest-rank-with-interpolation percentile (no numpy needed for
+    tiny ledgers; matches numpy 'linear')."""
+    vals = sorted(values)
+    if not vals:
+        return None
+    if len(vals) == 1:
+        return float(vals[0])
+    k = (len(vals) - 1) * (p / 100.0)
+    lo = int(k)
+    hi = min(lo + 1, len(vals) - 1)
+    return float(vals[lo] + (vals[hi] - vals[lo]) * (k - lo))
+
+
+class RequestMetrics:
+    """Timing ledger of one request (wall-clock, perf_counter based)."""
+
+    def __init__(self):
+        self.submit_time = time.perf_counter()
+        self.first_token_time = None
+        self.finish_time = None
+        self.token_times = []          # one stamp per emitted token
+
+    def mark_token(self):
+        now = time.perf_counter()
+        if self.first_token_time is None:
+            self.first_token_time = now
+        self.token_times.append(now)
+
+    def mark_finished(self):
+        self.finish_time = time.perf_counter()
+
+    @property
+    def n_tokens(self):
+        return len(self.token_times)
+
+    @property
+    def ttft(self):
+        """Time to first token (seconds), None until the first token."""
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.submit_time
+
+    @property
+    def inter_token_latencies(self):
+        t = self.token_times
+        return [b - a for a, b in zip(t, t[1:])]
+
+    @property
+    def tokens_per_sec(self):
+        if self.finish_time is None or not self.token_times:
+            return None
+        dt = self.finish_time - self.submit_time
+        return self.n_tokens / dt if dt > 0 else float("inf")
+
+
+class EngineMetrics:
+    """Aggregate counters for one Engine; registered in the module-wide
+    ledger so profiler.serving_counters() sees every live engine."""
+
+    def __init__(self):
+        self.requests_submitted = 0
+        self.requests_completed = 0
+        self.requests_rejected = 0
+        self.tokens_generated = 0
+        self.prefills = 0
+        self.decode_steps = 0
+        self.occupancy_sum = 0.0       # sum over steps of active/n_slots
+        self.queue_depth_sum = 0
+        self.peak_queue_depth = 0
+        self.samples = 0
+        _register(self)
+
+    def sample(self, occupancy, queue_depth):
+        self.samples += 1
+        self.occupancy_sum += occupancy
+        self.queue_depth_sum += queue_depth
+        self.peak_queue_depth = max(self.peak_queue_depth, queue_depth)
+
+    def snapshot(self):
+        n = max(self.samples, 1)
+        return {
+            "requests_submitted": self.requests_submitted,
+            "requests_completed": self.requests_completed,
+            "requests_rejected": self.requests_rejected,
+            "tokens_generated": self.tokens_generated,
+            "prefills": self.prefills,
+            "decode_steps": self.decode_steps,
+            "avg_slot_occupancy": round(self.occupancy_sum / n, 4),
+            "avg_queue_depth": round(self.queue_depth_sum / n, 4),
+            "peak_queue_depth": self.peak_queue_depth,
+        }
+
+
+_ENGINES = []   # weakrefs; dead engines drop out of the global snapshot
+
+
+def _register(m):
+    _ENGINES.append(weakref.ref(m))
+
+
+def global_counters():
+    """Summed snapshot across every live engine (profiler plumbing)."""
+    total = {
+        "engines": 0, "requests_submitted": 0, "requests_completed": 0,
+        "requests_rejected": 0, "tokens_generated": 0, "prefills": 0,
+        "decode_steps": 0, "peak_queue_depth": 0,
+    }
+    live = []
+    for ref in _ENGINES:
+        m = ref()
+        if m is None:
+            continue
+        live.append(ref)
+        s = m.snapshot()
+        total["engines"] += 1
+        for k in ("requests_submitted", "requests_completed",
+                  "requests_rejected", "tokens_generated", "prefills",
+                  "decode_steps"):
+            total[k] += s[k]
+        total["peak_queue_depth"] = max(total["peak_queue_depth"],
+                                        s["peak_queue_depth"])
+    _ENGINES[:] = live
+    return total
+
+
+def ledger(handles):
+    """Aggregate a finished workload's handles into one latency ledger
+    (p50/p95 TTFT and inter-token latency in ms, total tokens/sec)."""
+    done = [h for h in handles if h.metrics.finish_time is not None]
+    ttfts = [h.metrics.ttft for h in done if h.metrics.ttft is not None]
+    itls = [d for h in done for d in h.metrics.inter_token_latencies]
+    total_tokens = sum(h.metrics.n_tokens for h in done)
+    t0 = min((h.metrics.submit_time for h in done), default=0.0)
+    t1 = max((h.metrics.finish_time for h in done), default=0.0)
+    wall = max(t1 - t0, 1e-9)
+    ms = 1e3
+    return {
+        "requests": len(done),
+        "total_new_tokens": total_tokens,
+        "tokens_per_sec": round(total_tokens / wall, 2),
+        "ttft_ms_p50": round((_percentile(ttfts, 50) or 0) * ms, 3),
+        "ttft_ms_p95": round((_percentile(ttfts, 95) or 0) * ms, 3),
+        "itl_ms_p50": round((_percentile(itls, 50) or 0) * ms, 3),
+        "itl_ms_p95": round((_percentile(itls, 95) or 0) * ms, 3),
+    }

@@ -1,0 +1,72 @@
+"""Request admission for the serving engine.
+
+FIFO with two guards:
+
+- **token-budget watermark** — the sum of ``prompt_len + max_new_tokens``
+  over in-flight requests stays under ``token_budget``; the queue head
+  waits (strict FIFO, no head-of-line skipping) until enough slots drain.
+  Keeps worst-case KV residency bounded independent of n_slots.
+- **queue-depth backpressure** — ``enqueue`` raises EngineOverloaded once
+  ``max_queue`` requests are waiting; callers shed load instead of
+  growing an unbounded host-side queue.
+
+Admission order is a pure function of arrival order (deque + watermark,
+no timestamps), which together with per-request PRNG chains makes every
+request's output independent of co-batched traffic.
+"""
+from __future__ import annotations
+
+import collections
+
+
+class EngineOverloaded(RuntimeError):
+    """Raised by submit() when the waiting queue is at max_queue depth."""
+
+
+class FIFOScheduler:
+    def __init__(self, token_budget, max_queue):
+        if token_budget < 1:
+            raise ValueError("token_budget must be >= 1")
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self.token_budget = int(token_budget)
+        self.max_queue = int(max_queue)
+        self._queue = collections.deque()
+        self._inflight_tokens = 0
+
+    @staticmethod
+    def _load(handle):
+        return handle.n_prompt + handle.max_new_tokens
+
+    @property
+    def queue_depth(self):
+        return len(self._queue)
+
+    @property
+    def inflight_tokens(self):
+        return self._inflight_tokens
+
+    def enqueue(self, handle):
+        if len(self._queue) >= self.max_queue:
+            raise EngineOverloaded(
+                f"serving queue full ({self.max_queue} waiting); retry "
+                "after the engine drains")
+        self._queue.append(handle)
+
+    def pop_admissible(self, free_slots):
+        """Pop the FIFO prefix that fits in ``free_slots`` and the token
+        watermark. Popped handles are counted in-flight immediately;
+        call release() when their request finishes."""
+        out = []
+        while self._queue and free_slots > 0:
+            need = self._load(self._queue[0])
+            if self._inflight_tokens + need > self.token_budget and \
+                    self._inflight_tokens > 0:
+                break   # strict FIFO: head waits, nothing overtakes it
+            out.append(self._queue.popleft())
+            self._inflight_tokens += need
+            free_slots -= 1
+        return out
+
+    def release(self, handle):
+        self._inflight_tokens -= self._load(handle)

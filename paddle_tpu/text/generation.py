@@ -7,6 +7,13 @@ the model's normal forward over the prompt, then `lax.scan` decodes
 max_new_tokens steps against a PREALLOCATED [layers, B, total_len, kv, hd]
 cache (static shapes: no per-step recompilation, no concat growth), with
 sampling and eos masking inside the scan.
+
+The per-layer prefill/decode bodies (`_llama_prefill_layer`,
+`_llama_decode_layer`, `_gpt_prefill_layer`, `_gpt_decode_layer`) are
+module-level and parameterized on per-row cache/rotary positions: batch
+``generate()``, beam search AND ``paddle_tpu.serving.Engine`` all trace
+the same python, so there is exactly one lowering of the decode math to
+keep conformant.
 """
 from __future__ import annotations
 
@@ -50,6 +57,22 @@ def _rms(x, w, eps):
     return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
 
 
+def _rope_rows(q, k, pos, theta, dtype):
+    """Rotary embedding for one-token-per-row decode: q, k [B, 1, H, D],
+    pos [B] (each row may sit at a different position)."""
+    d = q.shape[-1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = pos[:, None].astype(jnp.float32) * inv_freq[None, :]  # [B, D/2]
+    cos = jnp.cos(freqs)[:, None, None, :]
+    sin = jnp.sin(freqs)[:, None, None, :]
+
+    def rot(x):
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                              axis=-1)
+        return out.astype(dtype)
+
+    return rot(q), rot(k)
 
 
 def _nucleus_filter(logits, top_p):
@@ -67,14 +90,126 @@ def _nucleus_filter(logits, top_p):
     return jnp.where(keep, logits, -jnp.inf)
 
 
+def _filter_logits(logits, temperature, do_sample, top_k, top_p):
+    """Temperature / top-k / top-p filtering shared by batch generate()
+    and the serving engine. logits [B, V]; temperature scalar or
+    per-row [B]."""
+    t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    if t.ndim == 1:
+        t = t[:, None]
+    logits = logits.astype(jnp.float32) / t
+    if do_sample and top_k:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if do_sample and top_p is not None and top_p < 1.0:
+        logits = _nucleus_filter(logits, top_p)
+    return logits
+
+
+def _prompt_mask(ids, pad_token_id, attention_mask):
+    """[B, L0] int32 prefix mask (1 = real token) for right-padded
+    prompts. An explicit attention_mask wins; otherwise everything up to
+    the last non-pad token is real (a pad_token_id occurring inside the
+    prompt is kept as a real token)."""
+    if attention_mask is not None:
+        am = attention_mask._data if isinstance(attention_mask, Tensor) \
+            else jnp.asarray(attention_mask)
+        return am.astype(jnp.int32)
+    if pad_token_id is None:
+        return jnp.ones_like(ids)
+    L0 = ids.shape[1]
+    nonpad = ids != pad_token_id
+    plen = jnp.max(jnp.where(nonpad, jnp.arange(1, L0 + 1)[None, :], 0),
+                   axis=1)
+    return (jnp.arange(L0)[None, :] < plen[:, None]).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# shared per-layer bodies (Llama)
+# ---------------------------------------------------------------------------
+
+_LLAMA_STACK_KEYS = ("wq", "wk", "wv", "wo", "wg", "wu", "wd", "ln1", "ln2")
+
+
+def _llama_prefill_layer(x, lw, pos, *, n_heads, n_kv, eps, theta):
+    """One Llama decoder layer over a full [B, L] prompt (causal).
+    Returns (x, (k, v)) with k/v [B, L, n_kv, hd] for the KV cache."""
+    B, L, h = x.shape
+    hd = h // n_heads
+    dt = x.dtype
+    h1 = _rms(x, lw["ln1"], eps)
+    q = (h1 @ lw["wq"]).reshape(B, L, n_heads, hd)
+    k = (h1 @ lw["wk"]).reshape(B, L, n_kv, hd)
+    v = (h1 @ lw["wv"]).reshape(B, L, n_kv, hd)
+    q, k = _rope(q, k, pos, theta, dt)
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.repeat(jnp.swapaxes(k, 1, 2), n_heads // n_kv, axis=1)
+    vh = jnp.repeat(jnp.swapaxes(v, 1, 2), n_heads // n_kv, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(hd))
+    cm = jnp.tril(jnp.ones((L, L), bool))
+    s = jnp.where(cm, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    o = jnp.swapaxes(o, 1, 2).reshape(B, L, h)
+    x = x + o @ lw["wo"]
+    h2 = _rms(x, lw["ln2"], eps)
+    x = x + (jax.nn.silu(h2 @ lw["wg"]) * (h2 @ lw["wu"])) @ lw["wd"]
+    return x, (k, v)
+
+
+def _llama_decode_layer(xt, lw, kc_l, vc_l, write_idx, rope_pos, key_mask,
+                        *, n_heads, n_kv, eps, theta):
+    """One Llama decoder layer advancing every row one token.
+
+    xt [B, 1, h]; kc_l/vc_l [B, T, n_kv, hd]; write_idx [B] — the cache
+    line each row's new K/V lands in; rope_pos [B] — each row's rotary
+    position (differs from write_idx only for right-padded prompts);
+    key_mask [B, T] bool or None — extra attendable-position mask on top
+    of the causal ``<= write_idx`` bound (False = never attend; hides
+    prompt padding lines).
+    """
+    B, T = kc_l.shape[0], kc_l.shape[1]
+    h = xt.shape[-1]
+    hd = h // n_heads
+    dt = xt.dtype
+    h1 = _rms(xt, lw["ln1"], eps)
+    q = (h1 @ lw["wq"]).reshape(B, 1, n_heads, hd)
+    k = (h1 @ lw["wk"]).reshape(B, 1, n_kv, hd)
+    v = (h1 @ lw["wv"]).reshape(B, 1, n_kv, hd)
+    q, k = _rope_rows(q, k, rope_pos, theta, dt)
+    rows = jnp.arange(B)
+    kc_l = kc_l.at[rows, write_idx].set(k[:, 0])
+    vc_l = vc_l.at[rows, write_idx].set(v[:, 0])
+    kh = jnp.repeat(kc_l, n_heads // n_kv, axis=2)       # [B, T, H, hd]
+    vh = jnp.repeat(vc_l, n_heads // n_kv, axis=2)
+    s = jnp.einsum("bhd,bthd->bht", q[:, 0], kh,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(hd))
+    valid = jnp.arange(T)[None, :] <= write_idx[:, None]
+    if key_mask is not None:
+        valid = jnp.logical_and(valid, key_mask)
+    s = jnp.where(valid[:, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    o = jnp.einsum("bht,bthd->bhd", p, vh).reshape(B, 1, h)
+    xt2 = xt + o @ lw["wo"]
+    h2 = _rms(xt2, lw["ln2"], eps)
+    xt2 = xt2 + (jax.nn.silu(h2 @ lw["wg"]) * (h2 @ lw["wu"])) @ lw["wd"]
+    return xt2, kc_l, vc_l
+
+
 @functools.partial(jax.jit, static_argnames=(
     "n_heads", "n_kv", "eps", "theta", "max_new", "do_sample", "top_k",
-    "eos_id", "top_p"))
+    "eos_id", "top_p", "padded"))
 def _generate_jit(w, input_ids, prompt_len_mask, key, *, n_heads, n_kv, eps,
                   theta, max_new, do_sample, top_k, eos_id, temperature,
-                  top_p=None):
+                  top_p=None, padded=False):
     """input_ids: [B, L0] right-padded prompt; prompt_len_mask [B, L0]
-    (1 = real token). Returns [B, L0 + max_new]."""
+    (1 = real token). With padded=True the right-padding semantics are
+    active: per-row rotary positions continue from the prompt length and
+    pad KV lines are masked out of decode attention. Returns
+    [B, L0 + max_new]."""
     B, L0 = input_ids.shape
     h = w["embed"].shape[1]
     hd = h // n_heads
@@ -88,49 +223,27 @@ def _generate_jit(w, input_ids, prompt_len_mask, key, *, n_heads, n_kv, eps,
     kcache = jnp.zeros((nL, B, T, n_kv, hd), dt)
     vcache = jnp.zeros((nL, B, T, n_kv, hd), dt)
 
-    def one_prefill(x, lw):
-        h1 = _rms(x, lw["ln1"], eps)
-        q = (h1 @ lw["wq"]).reshape(B, L0, n_heads, hd)
-        k = (h1 @ lw["wk"]).reshape(B, L0, n_kv, hd)
-        v = (h1 @ lw["wv"]).reshape(B, L0, n_kv, hd)
-        q, k = _rope(q, k, pos, theta, dt)
-        qh = jnp.swapaxes(q, 1, 2)
-        kh = jnp.repeat(jnp.swapaxes(k, 1, 2), n_heads // n_kv, axis=1)
-        vh = jnp.repeat(jnp.swapaxes(v, 1, 2), n_heads // n_kv, axis=1)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
-                       preferred_element_type=jnp.float32) / jnp.sqrt(
-                           jnp.float32(hd))
-        cm = jnp.tril(jnp.ones((L0, L0), bool))
-        s = jnp.where(cm, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(dt)
-        o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
-        o = jnp.swapaxes(o, 1, 2).reshape(B, L0, h)
-        x = x + o @ lw["wo"]
-        h2 = _rms(x, lw["ln2"], eps)
-        x = x + (jax.nn.silu(h2 @ lw["wg"]) * (h2 @ lw["wu"])) @ lw["wd"]
-        return x, (k, v)
+    stack = {k: w[k] for k in _LLAMA_STACK_KEYS}
 
-    stack = {k: w[k] for k in
-             ("wq", "wk", "wv", "wo", "wg", "wu", "wd", "ln1", "ln2")}
-    x, kvs = jax.lax.scan(lambda c, lw: one_prefill(c, lw), x, stack)
+    def one_prefill(x, lw):
+        return _llama_prefill_layer(x, lw, pos, n_heads=n_heads, n_kv=n_kv,
+                                    eps=eps, theta=theta)
+
+    x, kvs = jax.lax.scan(one_prefill, x, stack)
     kcache = kcache.at[:, :, :L0].set(kvs[0])
     vcache = vcache.at[:, :, :L0].set(kvs[1])
 
     # last real token index per row
-    last_idx = jnp.sum(prompt_len_mask, axis=1).astype(jnp.int32) - 1
+    prompt_len = jnp.sum(prompt_len_mask, axis=1).astype(jnp.int32)
+    last_idx = prompt_len - 1
     hidden = _rms(x, w["norm"], eps)
     logits0 = jnp.take_along_axis(
         hidden, last_idx[:, None, None].repeat(h, 2), axis=1)[:, 0] @ w["head"]
 
     def sample(logits, key):
-        logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+        logits = _filter_logits(logits, temperature, do_sample, top_k, top_p)
         if not do_sample:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        if top_k:
-            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        if top_p is not None and top_p < 1.0:
-            logits = _nucleus_filter(logits, top_p)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     key, sk = jax.random.split(key)
@@ -141,36 +254,23 @@ def _generate_jit(w, input_ids, prompt_len_mask, key, *, n_heads, n_kv, eps,
     done0 = (tok0 == eos_id) if eos_id is not None else jnp.zeros(
         (B,), bool)
 
+    # pad lines of the prompt must never be attended; generated lines
+    # (>= L0) are gated by the causal <= write_idx bound alone
+    key_mask = (jnp.concatenate(
+        [prompt_len_mask.astype(bool), jnp.ones((B, max_new), bool)],
+        axis=1) if padded else None)
+
     def decode_step(carry, i):
         tok, cur_pos, kcache, vcache, key, done = carry
         xt = jnp.take(w["embed"], tok, axis=0)[:, None]          # [B,1,h]
+        write_idx = jnp.full((B,), cur_pos, jnp.int32)
+        rope_pos = prompt_len + (i - 1) if padded else write_idx
 
         def one(cx, lw_kv):
-            xt, kc_l, vc_l = cx["x"], lw_kv["kc"], lw_kv["vc"]
-            lw = lw_kv
-            h1 = _rms(xt, lw["ln1"], eps)
-            q = (h1 @ lw["wq"]).reshape(B, 1, n_heads, hd)
-            k = (h1 @ lw["wk"]).reshape(B, 1, n_kv, hd)
-            v = (h1 @ lw["wv"]).reshape(B, 1, n_kv, hd)
-            q, k = _rope(q, k, cur_pos[None], theta, dt)
-            kc_l = jax.lax.dynamic_update_slice(
-                kc_l, k, (0, cur_pos, 0, 0))
-            vc_l = jax.lax.dynamic_update_slice(
-                vc_l, v, (0, cur_pos, 0, 0))
-            qh = q[:, 0]                                         # [B,H,hd]
-            kh = jnp.repeat(kc_l, n_heads // n_kv, axis=2)       # [B,T,H,hd]
-            vh = jnp.repeat(vc_l, n_heads // n_kv, axis=2)
-            s = jnp.einsum("bhd,bthd->bht", qh, kh,
-                           preferred_element_type=jnp.float32) / jnp.sqrt(
-                               jnp.float32(hd))
-            valid = jnp.arange(T) <= cur_pos
-            s = jnp.where(valid[None, None, :], s, -1e30)
-            p = jax.nn.softmax(s, axis=-1).astype(dt)
-            o = jnp.einsum("bht,bthd->bhd", p, vh).reshape(B, 1, h)
-            xt2 = xt + o @ lw["wo"]
-            h2 = _rms(xt2, lw["ln2"], eps)
-            xt2 = xt2 + (jax.nn.silu(h2 @ lw["wg"])
-                         * (h2 @ lw["wu"])) @ lw["wd"]
+            xt2, kc_l, vc_l = _llama_decode_layer(
+                cx["x"], lw_kv, lw_kv["kc"], lw_kv["vc"], write_idx,
+                rope_pos, key_mask, n_heads=n_heads, n_kv=n_kv, eps=eps,
+                theta=theta)
             return {"x": xt2}, (kc_l, vc_l)
 
         lw_kv = dict(stack)
@@ -217,30 +317,11 @@ def _beam_generate_jit(w, input_ids, *, n_heads, n_kv, eps, theta, max_new,
     # ---- prefill once per batch row, then tile to beams ----
     x = jnp.take(w["embed"], input_ids, axis=0)
     pos = jnp.arange(L0)
-    stack = {k: w[k] for k in
-             ("wq", "wk", "wv", "wo", "wg", "wu", "wd", "ln1", "ln2")}
+    stack = {k: w[k] for k in _LLAMA_STACK_KEYS}
 
     def one_prefill(x, lw):
-        h1 = _rms(x, lw["ln1"], eps)
-        q = (h1 @ lw["wq"]).reshape(B, L0, n_heads, hd)
-        k = (h1 @ lw["wk"]).reshape(B, L0, n_kv, hd)
-        v = (h1 @ lw["wv"]).reshape(B, L0, n_kv, hd)
-        q, k = _rope(q, k, pos, theta, dt)
-        qh = jnp.swapaxes(q, 1, 2)
-        kh = jnp.repeat(jnp.swapaxes(k, 1, 2), n_heads // n_kv, axis=1)
-        vh = jnp.repeat(jnp.swapaxes(v, 1, 2), n_heads // n_kv, axis=1)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
-                       preferred_element_type=jnp.float32) / jnp.sqrt(
-                           jnp.float32(hd))
-        cm = jnp.tril(jnp.ones((L0, L0), bool))
-        s = jnp.where(cm, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(dt)
-        o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
-        o = jnp.swapaxes(o, 1, 2).reshape(B, L0, h)
-        x = x + o @ lw["wo"]
-        h2 = _rms(x, lw["ln2"], eps)
-        x = x + (jax.nn.silu(h2 @ lw["wg"]) * (h2 @ lw["wu"])) @ lw["wd"]
-        return x, (k, v)
+        return _llama_prefill_layer(x, lw, pos, n_heads=n_heads, n_kv=n_kv,
+                                    eps=eps, theta=theta)
 
     x, kvs = jax.lax.scan(one_prefill, x, stack)
     kcache = jnp.zeros((nL, B * K, T, n_kv, hd), dt)
@@ -262,30 +343,13 @@ def _beam_generate_jit(w, input_ids, *, n_heads, n_kv, eps, theta, max_new,
         toks, scores, cur_pos, kcache, vcache, done = carry
         tok = jax.lax.dynamic_index_in_dim(toks, i - 1, 2, False)  # [B,K]
         xt = jnp.take(w["embed"], tok.reshape(B * K), axis=0)[:, None]
+        write_idx = jnp.full((B * K,), cur_pos, jnp.int32)
 
         def one(cx, lw_kv):
-            xt, kc_l, vc_l = cx["x"], lw_kv["kc"], lw_kv["vc"]
-            lw = lw_kv
-            h1 = _rms(xt, lw["ln1"], eps)
-            q = (h1 @ lw["wq"]).reshape(B * K, 1, n_heads, hd)
-            k = (h1 @ lw["wk"]).reshape(B * K, 1, n_kv, hd)
-            v = (h1 @ lw["wv"]).reshape(B * K, 1, n_kv, hd)
-            q, k = _rope(q, k, cur_pos[None], theta, dt)
-            kc_l = jax.lax.dynamic_update_slice(kc_l, k, (0, cur_pos, 0, 0))
-            vc_l = jax.lax.dynamic_update_slice(vc_l, v, (0, cur_pos, 0, 0))
-            kh = jnp.repeat(kc_l, n_heads // n_kv, axis=2)
-            vh = jnp.repeat(vc_l, n_heads // n_kv, axis=2)
-            s = jnp.einsum("bhd,bthd->bht", q[:, 0], kh,
-                           preferred_element_type=jnp.float32) / jnp.sqrt(
-                               jnp.float32(hd))
-            valid = jnp.arange(T) <= cur_pos
-            s = jnp.where(valid[None, None, :], s, -1e30)
-            p = jax.nn.softmax(s, axis=-1).astype(dt)
-            o = jnp.einsum("bht,bthd->bhd", p, vh).reshape(B * K, 1, h)
-            xt2 = xt + o @ lw["wo"]
-            h2 = _rms(xt2, lw["ln2"], eps)
-            xt2 = xt2 + (jax.nn.silu(h2 @ lw["wg"])
-                         * (h2 @ lw["wu"])) @ lw["wd"]
+            xt2, kc_l, vc_l = _llama_decode_layer(
+                cx["x"], lw_kv, lw_kv["kc"], lw_kv["vc"], write_idx,
+                write_idx, None, n_heads=n_heads, n_kv=n_kv, eps=eps,
+                theta=theta)
             return {"x": xt2}, (kc_l, vc_l)
 
         lw_kv = dict(stack)
@@ -395,68 +459,110 @@ def _ln(x, w, b, eps=1e-5):
     return (((xf - m) * jax.lax.rsqrt(v + eps)).astype(x.dtype) * w + b)
 
 
+_GPT_STACK_KEYS = ("wqkv", "bqkv", "wproj", "bproj", "ln1w", "ln1b", "ln2w",
+                   "ln2b", "wfc1", "bfc1", "wfc2", "bfc2")
+
+
+def _gpt_prefill_layer(x, lw, *, n_heads):
+    """One GPT block over a full [B, L] prompt (causal; positions enter
+    via the wpe embedding). Returns (x, (k, v)), k/v [B, L, H, hd]."""
+    B, L, h = x.shape
+    hd = h // n_heads
+    dt = x.dtype
+    hN = _ln(x, lw["ln1w"], lw["ln1b"])
+    qkv = hN @ lw["wqkv"] + lw["bqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, L, n_heads, hd)
+    k = k.reshape(B, L, n_heads, hd)
+    v = v.reshape(B, L, n_heads, hd)
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(hd))
+    cm = jnp.tril(jnp.ones((L, L), bool))
+    s = jnp.where(cm, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    o = jnp.swapaxes(o, 1, 2).reshape(B, L, h)
+    x = x + o @ lw["wproj"] + lw["bproj"]
+    h2 = _ln(x, lw["ln2w"], lw["ln2b"])
+    x = x + jax.nn.gelu(h2 @ lw["wfc1"] + lw["bfc1"],
+                        approximate=False) @ lw["wfc2"] + lw["bfc2"]
+    return x, (k, v)
+
+
+def _gpt_decode_layer(xt, lw, kc_l, vc_l, write_idx, key_mask, *, n_heads):
+    """One GPT block advancing every row one token (learned positions are
+    applied at the embedding, so only the cache line index matters here).
+    kc_l/vc_l [B, T, H, hd]; write_idx [B]; key_mask as in the Llama
+    decode layer."""
+    B, T = kc_l.shape[0], kc_l.shape[1]
+    h = xt.shape[-1]
+    hd = h // n_heads
+    dt = xt.dtype
+    hN = _ln(xt, lw["ln1w"], lw["ln1b"])
+    qkv = hN @ lw["wqkv"] + lw["bqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, 1, n_heads, hd)
+    k = k.reshape(B, 1, n_heads, hd)
+    v = v.reshape(B, 1, n_heads, hd)
+    rows = jnp.arange(B)
+    kc_l = kc_l.at[rows, write_idx].set(k[:, 0])
+    vc_l = vc_l.at[rows, write_idx].set(v[:, 0])
+    s = jnp.einsum("bhd,bthd->bht", q[:, 0], kc_l,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(hd))
+    valid = jnp.arange(T)[None, :] <= write_idx[:, None]
+    if key_mask is not None:
+        valid = jnp.logical_and(valid, key_mask)
+    s = jnp.where(valid[:, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    o = jnp.einsum("bht,bthd->bhd", p, vc_l).reshape(B, 1, h)
+    xt2 = xt + o @ lw["wproj"] + lw["bproj"]
+    h2 = _ln(xt2, lw["ln2w"], lw["ln2b"])
+    xt2 = xt2 + jax.nn.gelu(h2 @ lw["wfc1"] + lw["bfc1"],
+                            approximate=False) @ lw["wfc2"] + lw["bfc2"]
+    return xt2, kc_l, vc_l
+
+
 @functools.partial(jax.jit, static_argnames=(
-    "n_heads", "max_new", "do_sample", "top_k", "eos_id", "top_p"))
-def _gpt_generate_jit(w, input_ids, key, *, n_heads, max_new, do_sample,
-                      top_k, eos_id, temperature, top_p=None):
+    "n_heads", "max_new", "do_sample", "top_k", "eos_id", "top_p",
+    "padded"))
+def _gpt_generate_jit(w, input_ids, prompt_len_mask, key, *, n_heads,
+                      max_new, do_sample, top_k, eos_id, temperature,
+                      top_p=None, padded=False):
     B, L0 = input_ids.shape
     h = w["wte"].shape[1]
     hd = h // n_heads
     T = L0 + max_new
     dt = w["wte"].dtype
 
-    def split_heads(x, L):
-        return x.reshape(B, L, n_heads, hd)
-
-    def attn_full(q, k, v, L):
-        qh = jnp.swapaxes(q, 1, 2)
-        kh = jnp.swapaxes(k, 1, 2)
-        vh = jnp.swapaxes(v, 1, 2)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
-                       preferred_element_type=jnp.float32) / jnp.sqrt(
-                           jnp.float32(hd))
-        cm = jnp.tril(jnp.ones((L, L), bool))
-        s = jnp.where(cm, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(dt)
-        o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
-        return jnp.swapaxes(o, 1, 2).reshape(B, L, h)
-
     pos = jnp.arange(L0)
     x = jnp.take(w["wte"], input_ids, axis=0) + w["wpe"][pos][None]
     kcache = jnp.zeros((w["wqkv"].shape[0], B, T, n_heads, hd), dt)
     vcache = jnp.zeros_like(kcache)
 
-    stack_keys = ("wqkv", "bqkv", "wproj", "bproj", "ln1w", "ln1b", "ln2w",
-                  "ln2b", "wfc1", "bfc1", "wfc2", "bfc2")
-    stack = {k: w[k] for k in stack_keys}
+    stack = {k: w[k] for k in _GPT_STACK_KEYS}
 
     def one_prefill(x, lw):
-        hN = _ln(x, lw["ln1w"], lw["ln1b"])
-        qkv = hN @ lw["wqkv"] + lw["bqkv"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q, k, v = (split_heads(t, L0) for t in (q, k, v))
-        o = attn_full(q, k, v, L0)
-        x = x + o @ lw["wproj"] + lw["bproj"]
-        h2 = _ln(x, lw["ln2w"], lw["ln2b"])
-        x = x + jax.nn.gelu(h2 @ lw["wfc1"] + lw["bfc1"],
-                            approximate=False) @ lw["wfc2"] + lw["bfc2"]
-        return x, (k, v)
+        return _gpt_prefill_layer(x, lw, n_heads=n_heads)
 
     x, kvs = jax.lax.scan(one_prefill, x, stack)
     kcache = kcache.at[:, :, :L0].set(kvs[0])
     vcache = vcache.at[:, :, :L0].set(kvs[1])
 
-    logits0 = _ln(x[:, -1], w["lnfw"], w["lnfb"]) @ w["head"]
+    prompt_len = jnp.sum(prompt_len_mask, axis=1).astype(jnp.int32)
+    last_idx = prompt_len - 1
+    xlast = jnp.take_along_axis(
+        x, last_idx[:, None, None].repeat(h, 2), axis=1)[:, 0]
+    logits0 = _ln(xlast, w["lnfw"], w["lnfb"]) @ w["head"]
 
     def sample(logits, key):
-        logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+        logits = _filter_logits(logits, temperature, do_sample, top_k, top_p)
         if not do_sample:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        if top_k:
-            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        if top_p is not None and top_p < 1.0:
-            logits = _nucleus_filter(logits, top_p)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     key, sk = jax.random.split(key)
@@ -464,34 +570,21 @@ def _gpt_generate_jit(w, input_ids, key, *, n_heads, max_new, do_sample,
     out = jnp.zeros((B, max_new), jnp.int32).at[:, 0].set(tok0)
     done0 = (tok0 == eos_id) if eos_id is not None else jnp.zeros((B,), bool)
 
-    def decode_step(carry, _):
+    key_mask = (jnp.concatenate(
+        [prompt_len_mask.astype(bool), jnp.ones((B, max_new), bool)],
+        axis=1) if padded else None)
+
+    def decode_step(carry, i):
         tok, cur_pos, kcache, vcache, key, done = carry
+        write_idx = jnp.full((B,), cur_pos, jnp.int32)
+        rope_pos = prompt_len + (i - 1) if padded else write_idx
         xt = (jnp.take(w["wte"], tok, axis=0)
-              + w["wpe"][cur_pos][None])[:, None]
+              + jnp.take(w["wpe"], rope_pos, axis=0))[:, None]
 
         def one(cx, lw_kv):
-            xt, kc_l, vc_l = cx["x"], lw_kv["kc"], lw_kv["vc"]
-            lw = lw_kv
-            hN = _ln(xt, lw["ln1w"], lw["ln1b"])
-            qkv = hN @ lw["wqkv"] + lw["bqkv"]
-            q, k, v = jnp.split(qkv, 3, axis=-1)
-            q = q.reshape(B, 1, n_heads, hd)
-            k = k.reshape(B, 1, n_heads, hd)
-            v = v.reshape(B, 1, n_heads, hd)
-            kc_l = jax.lax.dynamic_update_slice(kc_l, k, (0, cur_pos, 0, 0))
-            vc_l = jax.lax.dynamic_update_slice(vc_l, v, (0, cur_pos, 0, 0))
-            s = jnp.einsum("bhd,bthd->bht", q[:, 0], kc_l,
-                           preferred_element_type=jnp.float32) / jnp.sqrt(
-                               jnp.float32(hd))
-            valid = jnp.arange(T) <= cur_pos
-            s = jnp.where(valid[None, None, :], s, -1e30)
-            p = jax.nn.softmax(s, axis=-1).astype(dt)
-            o = jnp.einsum("bht,bthd->bhd", p, vc_l).reshape(B, 1, h)
-            xt2 = xt + o @ lw["wproj"] + lw["bproj"]
-            h2 = _ln(xt2, lw["ln2w"], lw["ln2b"])
-            xt2 = xt2 + jax.nn.gelu(h2 @ lw["wfc1"] + lw["bfc1"],
-                                    approximate=False) @ lw["wfc2"] \
-                + lw["bfc2"]
+            xt2, kc_l, vc_l = _gpt_decode_layer(
+                cx["x"], lw_kv, lw_kv["kc"], lw_kv["vc"], write_idx,
+                key_mask, n_heads=n_heads)
             return {"x": xt2}, (kc_l, vc_l)
 
         lw_kv = dict(stack)
@@ -517,19 +610,24 @@ def gpt_generate(model, input_ids, max_new_tokens: int = 32,
                  do_sample: bool = False, top_k: int = 0,
                  temperature: float = 1.0,
                  eos_token_id: Optional[int] = None, seed: int = 0,
-                 top_p: Optional[float] = None):
+                 top_p: Optional[float] = None,
+                 pad_token_id: Optional[int] = None, attention_mask=None):
     """Greedy / top-k generation for GPTForCausalLM (same static-cache
-    design as the Llama path)."""
+    design as the Llama path). Right-padded prompts are supported via
+    pad_token_id and/or an explicit attention_mask, as in generate()."""
     ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(
         input_ids)
+    ids = ids.astype(jnp.int32)
+    mask = _prompt_mask(ids, pad_token_id, attention_mask)
+    padded = pad_token_id is not None or attention_mask is not None
     w = _gpt_stacked_weights(model)
     out = _gpt_generate_jit(
-        w, ids.astype(jnp.int32), jax.random.PRNGKey(seed),
+        w, ids, mask, jax.random.PRNGKey(seed),
         n_heads=model.config.num_attention_heads,
         max_new=int(max_new_tokens), do_sample=bool(do_sample),
         top_k=int(top_k), eos_id=eos_token_id,
         temperature=jnp.float32(temperature),
-        top_p=None if top_p is None else float(top_p))
+        top_p=None if top_p is None else float(top_p), padded=padded)
     return Tensor(out)
 
 
@@ -537,18 +635,23 @@ def generate(model, input_ids, max_new_tokens: int = 32,
              do_sample: bool = False, top_k: int = 0,
              temperature: float = 1.0,
              eos_token_id: Optional[int] = None, seed: int = 0,
-             top_p: Optional[float] = None):
+             top_p: Optional[float] = None,
+             pad_token_id: Optional[int] = None, attention_mask=None):
     """Greedy / top-k sampled generation for LlamaForCausalLM.
 
-    input_ids: Tensor [B, L0] (no padding between rows' real tokens
-    required; right padding is allowed with identical lengths semantics).
-    Returns Tensor [B, L0 + max_new_tokens].
+    input_ids: Tensor [B, L0]. Right-padded prompts are supported: pass
+    pad_token_id (mask derived from trailing pad tokens) and/or an
+    explicit attention_mask [B, L0]; pad positions are excluded from
+    attention and each row's generated tokens take rotary positions
+    continuing from its own prompt length. Without either, every token
+    is treated as real context. Returns Tensor [B, L0 + max_new_tokens].
     """
     c = model.config
     ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(
         input_ids)
     ids = ids.astype(jnp.int32)
-    mask = jnp.ones_like(ids)
+    mask = _prompt_mask(ids, pad_token_id, attention_mask)
+    padded = pad_token_id is not None or attention_mask is not None
     w = _stacked_weights(model)
     key = jax.random.PRNGKey(seed)
     out = _generate_jit(
@@ -557,5 +660,5 @@ def generate(model, input_ids, max_new_tokens: int = 32,
         max_new=int(max_new_tokens), do_sample=bool(do_sample),
         top_k=int(top_k), eos_id=eos_token_id,
         temperature=jnp.float32(temperature),
-        top_p=None if top_p is None else float(top_p))
+        top_p=None if top_p is None else float(top_p), padded=padded)
     return Tensor(out)

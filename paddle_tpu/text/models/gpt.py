@@ -102,11 +102,13 @@ class GPTForCausalLM(Layer):
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  top_k=0, temperature=1.0, eos_token_id=None, seed=0,
-                 top_p=None):
+                 top_p=None, pad_token_id=None, attention_mask=None):
         """Jitted static-KV-cache decode (text/generation.py gpt path)."""
         from ..generation import gpt_generate
         return gpt_generate(self, input_ids,
                             max_new_tokens=max_new_tokens,
                             do_sample=do_sample, top_k=top_k,
                             top_p=top_p, temperature=temperature,
-                            eos_token_id=eos_token_id, seed=seed)
+                            eos_token_id=eos_token_id, seed=seed,
+                            pad_token_id=pad_token_id,
+                            attention_mask=attention_mask)

@@ -284,7 +284,8 @@ class LlamaForCausalLM(Layer):
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  top_k=0, temperature=1.0, eos_token_id=None, seed=0,
-                 num_beams=1, length_penalty=1.0, top_p=None):
+                 num_beams=1, length_penalty=1.0, top_p=None,
+                 pad_token_id=None, attention_mask=None):
         """Jitted autoregressive decode with a static KV cache
         (PaddleNLP GenerationMixin.generate analog; see
         text/generation.py for the TPU design). num_beams > 1 runs beam
@@ -299,7 +300,8 @@ class LlamaForCausalLM(Layer):
         return _gen(self, input_ids, max_new_tokens=max_new_tokens,
                     do_sample=do_sample, top_k=top_k, top_p=top_p,
                     temperature=temperature, eos_token_id=eos_token_id,
-                    seed=seed)
+                    seed=seed, pad_token_id=pad_token_id,
+                    attention_mask=attention_mask)
 
     def init_cache(self, batch_size):
         c = self.config

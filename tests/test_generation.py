@@ -165,6 +165,64 @@ def test_top_p_sampling_restricts_support():
     assert int(samp.numpy().max()) < cfg.vocab_size
 
 
+def test_right_padded_prompts_match_unpadded():
+    """pad_token_id: each right-padded row must generate exactly what an
+    unpadded single-row call produces (pad KV is never attended, rotary
+    positions continue from the row's own prompt length); an explicit
+    attention_mask is equivalent."""
+    paddle.seed(0)
+    model = LlamaForCausalLM(CFG)
+    model.eval()
+    rng = np.random.default_rng(7)
+    PAD = 0
+    lens = [5, 9, 7]
+    prompts = [rng.integers(1, CFG.vocab_size, (n,)).astype(np.int32)
+               for n in lens]
+    L0 = max(lens)
+    batch = np.full((len(lens), L0), PAD, np.int32)
+    for i, p in enumerate(prompts):
+        batch[i, :len(p)] = p
+
+    out = np.asarray(model.generate(paddle.to_tensor(batch),
+                                    max_new_tokens=5,
+                                    pad_token_id=PAD)._data)
+    for i, p in enumerate(prompts):
+        want = np.asarray(model.generate(paddle.to_tensor(p[None]),
+                                         max_new_tokens=5)._data)[0]
+        np.testing.assert_array_equal(out[i, L0:], want[len(p):])
+
+    am = (batch != PAD).astype(np.int32)
+    out2 = np.asarray(model.generate(paddle.to_tensor(batch),
+                                     max_new_tokens=5,
+                                     attention_mask=am)._data)
+    np.testing.assert_array_equal(out, out2)
+
+
+def test_gpt_right_padded_prompts_match_unpadded():
+    """gpt_generate carries the same pad_token_id keyword (API symmetry)
+    with the same per-row semantics."""
+    from paddle_tpu.text.models.gpt import GPT_TINY, GPTForCausalLM
+
+    paddle.seed(0)
+    model = GPTForCausalLM(GPT_TINY)
+    model.eval()
+    rng = np.random.default_rng(8)
+    PAD = 0
+    lens = [4, 7]
+    prompts = [rng.integers(1, GPT_TINY.vocab_size, (n,)).astype(np.int32)
+               for n in lens]
+    batch = np.full((2, 7), PAD, np.int32)
+    for i, p in enumerate(prompts):
+        batch[i, :len(p)] = p
+    out = np.asarray(model.generate(paddle.to_tensor(batch),
+                                    max_new_tokens=4,
+                                    pad_token_id=PAD)._data)
+    for i, p in enumerate(prompts):
+        want = np.asarray(model.generate(paddle.to_tensor(p[None]),
+                                         max_new_tokens=4)._data)[0]
+        np.testing.assert_array_equal(out[i, 7:], want[len(p):])
+
+
 def test_top_p_gpt_path():
     from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
 

@@ -79,3 +79,18 @@ def test_handle_reshape_and_validation():
             raise AssertionError("expected shape validation error")
         except ValueError:
             pass
+        # reshape that changes the element count of a FILLED handle must
+        # raise, not silently keep the old buffer under a new declared
+        # shape (handle state would go inconsistent)
+        try:
+            h.reshape([5, 6])
+            raise AssertionError("expected element-count error")
+        except ValueError:
+            pass
+        assert h.shape() == [3, 6]       # unchanged after the refusal
+        h.reshape([6, 3])                # same element count: fine
+        assert h.shape() == [6, 3]
+        # an EMPTY handle may redeclare freely
+        h2 = paddle.inference.Tensor("fresh", shape=(2, 2))
+        h2.reshape([7, 3])
+        assert h2.shape() == [7, 3]

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Serving engine throughput/latency ledger.
+
+Replays one fixed workload (N requests, mixed prompt buckets, same
+max_new) three ways and emits ONE JSON ledger line (same convention as
+tools/bench_eager.py):
+
+- sequential: one-request-at-a-time batch generate() (the pre-engine
+  deployment story) -> tokens/sec
+- engine sweep over n_slots: continuous batching -> tokens/sec plus
+  p50/p95 TTFT and inter-token latency from the metrics ledger
+
+ok requires the best engine arm to beat sequential throughput on the
+same workload. Warm programs only: every arm runs the workload once to
+compile, then measures a second identical run.
+
+Usage: JAX_PLATFORMS=cpu python tools/bench_serving.py [--requests N]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--max-new", type=int, default=24)
+    ap.add_argument("--slots", type=int, nargs="+", default=[2, 4, 8])
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--hidden", type=int, default=256)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import Engine, ledger
+    from paddle_tpu.text.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=2048, hidden_size=args.hidden,
+                      intermediate_size=args.hidden * 3,
+                      num_hidden_layers=args.layers,
+                      num_attention_heads=8, num_key_value_heads=4,
+                      max_position_embeddings=128, dtype="float32")
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    lens = [(5, 9, 14, 21)[i % 4] for i in range(args.requests)]
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in lens]
+    total_new = args.requests * args.max_new
+
+    # ---- sequential baseline (warm each distinct prompt-length program)
+    for n in sorted(set(lens)):
+        p = next(q for q, m in zip(prompts, lens) if m == n)
+        np.asarray(model.generate(paddle.to_tensor(p[None]),
+                                  max_new_tokens=args.max_new)._data)
+    t0 = time.perf_counter()
+    for p in prompts:
+        np.asarray(model.generate(paddle.to_tensor(p[None]),
+                                  max_new_tokens=args.max_new)._data)
+    seq_s = time.perf_counter() - t0
+    seq_tps = total_new / seq_s
+
+    # ---- engine arms: n_slots sweep over the same workload ----
+    def run_engine(n_slots):
+        eng = Engine(model, n_slots=n_slots, max_len=64,
+                     min_prompt_bucket=8)
+        eng.generate_all(prompts, max_new_tokens=args.max_new)  # warm
+        t0 = time.perf_counter()
+        handles = eng.generate_all(prompts, max_new_tokens=args.max_new)
+        wall = time.perf_counter() - t0
+        led = ledger(handles)
+        led["n_slots"] = n_slots
+        led["wall_s"] = round(wall, 3)
+        led["tokens_per_sec"] = round(total_new / wall, 2)
+        return led
+
+    sweep = [run_engine(s) for s in args.slots]
+    best = max(sweep, key=lambda r: r["tokens_per_sec"])
+    ok = best["tokens_per_sec"] > seq_tps
+
+    print(json.dumps({
+        "bench": "serving_engine",
+        "backend": jax.default_backend(),
+        "model": {"layers": args.layers, "hidden": args.hidden,
+                  "kv_heads": cfg.num_key_value_heads},
+        "requests": args.requests, "max_new": args.max_new,
+        "prompt_lens": sorted(set(lens)),
+        "sequential_tokens_per_sec": round(seq_tps, 2),
+        "sweep": sweep,
+        "best_tokens_per_sec": best["tokens_per_sec"],
+        "best_n_slots": best["n_slots"],
+        "speedup_vs_sequential": round(best["tokens_per_sec"] / seq_tps, 2),
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Serving compile lint: the engine's static-shape contract, enforced.
+
+Drives a staggered 16-request workload (prompt lengths spanning >= 2
+power-of-two prefill buckets, mid-stream admissions and evictions,
+slot reuse) through paddle_tpu.serving.Engine and fails if:
+
+- the workload compiles more than (n_prefill_buckets + 1 decode) XLA
+  programs (counted via the jax monitoring compile-event listener, the
+  same cross-check tools/check_retrace.py uses), or
+- a SECOND identical workload on the warm engine triggers ANY compile
+  (warm decode/prefill retrace), or
+- any request's greedy output differs from batch generate() on the same
+  prompt (token-identical, per request).
+
+Modeled on tools/check_retrace.py. Usage:
+
+    JAX_PLATFORMS=cpu python tools/check_serving_compiles.py [--json]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", action="store_true", help="emit a JSON line")
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-new", type=int, default=8)
+    args = ap.parse_args()
+
+    import dataclasses
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import Engine
+    from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+    compile_events = [0]
+
+    def on_event(event, *a, **k):
+        if "compil" in event.lower():
+            compile_events[0] += 1
+
+    try:
+        from jax._src import monitoring
+        monitoring.register_event_listener(on_event)
+        have_monitor = True
+    except Exception:
+        have_monitor = False
+
+    cfg = dataclasses.replace(LLAMA_TINY, dtype="float32",
+                              num_hidden_layers=2)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+
+    # prompt lengths 5..12 with min bucket 8 -> exactly 2 buckets (8, 16)
+    min_bucket = 8
+    lens = [5 + (i % 8) for i in range(args.requests)]
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in lens]
+    new_tokens = [3 + (i % (args.max_new - 2)) for i in range(args.requests)]
+
+    def bucket(n):
+        b = min_bucket
+        while b < n:
+            b <<= 1
+        return b
+
+    n_buckets = len({bucket(n) for n in lens})
+    budget = n_buckets + 1          # prefill programs + ONE decode program
+
+    def drive(engine):
+        """Staggered arrivals: a few up front, the rest fed one per step
+        so admissions/evictions interleave and slots get reused."""
+        handles = []
+        it = iter(range(args.requests))
+        for i in (next(it), next(it), next(it)):
+            handles.append(engine.submit(prompts[i],
+                                         max_new_tokens=new_tokens[i]))
+        for i in it:
+            engine.step()
+            handles.append(engine.submit(prompts[i],
+                                         max_new_tokens=new_tokens[i]))
+        engine.drain()
+        return handles
+
+    engine = Engine(model, n_slots=args.slots, max_len=64,
+                    min_prompt_bucket=min_bucket)
+    # engine construction (weight stacking) compiles host-side stacks;
+    # the serving budget is about the REQUEST WORKLOAD only
+    compile_events[0] = 0
+    handles = drive(engine)
+    cold_compiles = compile_events[0]
+
+    compile_events[0] = 0
+    handles2 = drive(engine)
+    warm_compiles = compile_events[0]
+
+    mismatches = []
+    for run in (handles, handles2):
+        for h, p in zip(run, prompts):
+            want = np.asarray(model.generate(
+                paddle.to_tensor(p[None]),
+                max_new_tokens=h.max_new_tokens)._data)[0, len(p):]
+            if not np.array_equal(np.asarray(h.tokens, np.int32), want):
+                mismatches.append(h.request_id)
+
+    ok = (not have_monitor or (cold_compiles <= budget
+                               and warm_compiles == 0)) \
+        and not mismatches \
+        and engine.metrics.requests_completed == 2 * args.requests
+
+    record = {
+        "bench": "serving_compile_lint",
+        "requests": args.requests, "slots": args.slots,
+        "prompt_buckets": n_buckets, "compile_budget": budget,
+        "cold_compiles": cold_compiles if have_monitor else None,
+        "warm_compiles": warm_compiles if have_monitor else None,
+        "greedy_mismatches": mismatches,
+        "engine": engine.stats(), "ok": ok,
+    }
+    if args.json:
+        print(json.dumps(record))
+    else:
+        print(f"prefill buckets {n_buckets}  compile budget {budget}")
+        print(f"cold compiles   {record['cold_compiles']}")
+        print(f"warm compiles   {record['warm_compiles']}")
+        print(f"parity          {'OK' if not mismatches else mismatches}")
+        print("OK (static-shape serving contract holds)" if ok else
+              "FAIL: serving engine recompiles or diverges")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
