@@ -31,6 +31,7 @@ from .autograd import enable_grad, grad, no_grad  # noqa: F401
 from .autograd.tape import set_grad_enabled  # noqa: F401
 
 from . import amp  # noqa: F401
+from . import analysis  # noqa: F401
 from . import autograd  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import cost_model  # noqa: F401

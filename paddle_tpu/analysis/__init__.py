@@ -1,0 +1,96 @@
+"""paddle_tpu.analysis — tpu_lint: static jaxpr/StableHLO + AST audit.
+
+Because every hot path in this framework compiles whole programs to XLA,
+most TPU perf/correctness regressions are visible *statically* in the
+traced jaxpr / lowered StableHLO long before a TPU run: an interior
+layout transpose costs ~20% MFU, one warm-loop retrace stalls a train
+step by ~100 ms, a host callback syncs the device every iteration. This
+package is the rule-driven analyzer that finds them on a 1-core CPU
+container, with machine-readable findings (rule id, severity, op path,
+suggested fix) that CI gates on.
+
+Front ends
+----------
+
+=====================================  =====================================
+``audit(fn, *args, **kw)``             trace+lower any jittable callable
+                                       (jax arrays or paddle Tensors) and
+                                       run the program rules
+``audit_model(model, x)``              a Layer's jitted forward (params
+                                       hoisted, same as jit.to_static)
+``audit_stablehlo(text)``              already-lowered StableHLO text
+``audit_plan(program_or_plan)``        a static-executor _ReplayPlan
+``audit_engine(engine)``               a serving.Engine (plus its real
+                                       lowered decode program)
+``audit_dispatch()``                   the live eager-dispatch cache
+``selflint(paths)``                    AST rules over python source
+=====================================  =====================================
+
+Program rules
+-------------
+
+====================  ========  =============================================
+id                    severity  catches
+====================  ========  =============================================
+interior-transpose    high      layout transpose between compute ops (not an
+                                entry/exit boundary)
+dtype-promotion       high      fp64 leaking into traced code; bf16
+                                dot/reduce accumulating in bf16; implicit
+                                mixed-precision promotion
+host-callback         high      pure_callback/io_callback in a compiled
+                                region; host entries splitting a replay plan
+donation              medium    large undonated state buffers; donated-but-
+                                aliased inputs; undonated serving KV
+retrace-risk          medium    unhashable statics reaching jit; blacklisted
+                                / megamorphic eager-dispatch ops
+padding-waste         low       dot dims far off the 8x128 TPU tile;
+                                non-power-of-two serving buckets; unaligned
+                                KV geometry
+compile-budget        high      XLA programs traced vs the declared budget
+                                (serving bucket sprawl, plan fragmentation)
+====================  ========  =============================================
+
+AST (self-lint) rules
+---------------------
+
+====================  ========  =============================================
+id-keyed-cache        high      id()-keyed entries in persistent containers
+                                (ids recycle after GC — ADVICE round-5 bug)
+numpy-in-traced       medium    np.* on traced values inside jitted/lax
+                                bodies
+silent-except         medium    blanket ``except Exception`` that neither
+                                re-raises nor records why
+dtype-promotion       medium    np.float64 constant math in library code
+====================  ========  =============================================
+
+Suppression is by inline annotation only — ``# tpu_lint:
+allow(rule-id)`` on the flagged line, the line above, or above a
+``def``/``class`` to cover its body; ``# tpu_lint: allow-file(rule-id)``
+covers a whole file. The CLI is ``tools/tpu_lint.py`` (``--json``,
+``--fail-on=SEVERITY``, ``--allowlist FILE``); the legacy
+``tools/check_*.py`` linters are thin wrappers over these rules.
+
+Adding a rule: decorate a generator with ``@registry.rule(id,
+kind="program"|"ast", severity=..., title=...)``; program rules receive
+a :class:`~paddle_tpu.analysis.audit.ProgramView` (``.module`` parsed
+StableHLO, ``.jaxpr``, ``.meta``), AST rules a
+:class:`~paddle_tpu.analysis.rules_ast.SourceFile`, and yield
+:class:`Finding`s.
+"""
+from .audit import (  # noqa: F401
+    ProgramView, audit, audit_dispatch, audit_engine, audit_model,
+    audit_plan, audit_stablehlo, findings_summary, selflint,
+)
+from .findings import (  # noqa: F401
+    SEVERITIES, Finding, Report, parse_allowlist, severity_rank,
+)
+from .hooks import CompileEventCounter  # noqa: F401
+from .registry import iter_rules, rule, rules_table  # noqa: F401
+
+__all__ = [
+    "ProgramView", "audit", "audit_dispatch", "audit_engine",
+    "audit_model", "audit_plan", "audit_stablehlo", "findings_summary",
+    "selflint", "SEVERITIES", "Finding", "Report", "parse_allowlist",
+    "severity_rank", "CompileEventCounter", "iter_rules", "rule",
+    "rules_table",
+]

@@ -1,0 +1,320 @@
+"""tpu_lint front ends: build a :class:`ProgramView` from whatever the
+caller has — a jittable callable + example args, a Layer, raw StableHLO
+text, a static-executor replay plan, a serving Engine, or the live
+eager-dispatch cache — then run every registered program rule over it.
+``selflint`` is the AST front end over python source files.
+"""
+from __future__ import annotations
+
+import os
+
+from . import rules_ast as _rules_ast  # noqa: F401  (registers rules)
+from . import rules_program as _rules_prog  # noqa: F401  (registers rules)
+from .findings import Report
+from .hlo import parse_stablehlo
+from .registry import iter_rules
+from .rules_ast import SourceFile
+
+# most recent reports, surfaced as one line in profiler.Profiler.summary()
+_last_report = None
+
+
+class ProgramView:
+    """One audited program: lowered StableHLO text (parsed lazily),
+    optionally the traced jaxpr, plus origin metadata the meta-level
+    rules (plan/engine/dispatch) read."""
+
+    def __init__(self, name, kind, stablehlo=None, jaxpr=None, meta=None):
+        self.name = name
+        self.kind = kind            # callable|stablehlo|plan|engine|dispatch
+        self.stablehlo = stablehlo
+        self.jaxpr = jaxpr          # ClosedJaxpr or None
+        self.meta = dict(meta or {})
+        self.metrics = {}
+        self._module = None
+
+    @property
+    def module(self):
+        if self._module is None and self.stablehlo:
+            self._module = parse_stablehlo(self.stablehlo)
+        return self._module
+
+    def iter_eqns(self):
+        """(eqn, path) over the jaxpr, recursing into sub-jaxprs
+        (pjit/scan/cond bodies)."""
+        if self.jaxpr is None:
+            return
+        yield from _walk_jaxpr(getattr(self.jaxpr, "jaxpr", self.jaxpr),
+                               "")
+
+    def run_rules(self, rules=None) -> Report:
+        global _last_report
+        report = Report(origin=f"{self.kind}:{self.name}")
+        for r in iter_rules(kind="program", ids=rules):
+            for f in r.run(self):
+                report.add(f)
+        report.metrics.update(self.metrics)
+        _last_report = report
+        return report
+
+
+def _walk_jaxpr(jaxpr, prefix):
+    for i, eqn in enumerate(jaxpr.eqns):
+        path = f"{prefix}eqn[{i}]:{eqn.primitive.name}"
+        yield eqn, path
+        for sub in _sub_jaxprs(eqn.params):
+            yield from _walk_jaxpr(sub, path + "/")
+
+
+def _sub_jaxprs(params):
+    for v in params.values():
+        yield from _as_jaxprs(v)
+
+
+def _as_jaxprs(v):
+    # ClosedJaxpr / Jaxpr duck-typing: avoids importing private core
+    if hasattr(v, "eqns"):
+        yield v
+    elif hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+        yield v.jaxpr
+    elif isinstance(v, (tuple, list)):
+        for x in v:
+            yield from _as_jaxprs(x)
+
+
+# -- callable / model front end ---------------------------------------------
+
+def _is_tensorish(fn, flat_args):
+    from ..nn.layer_base import Layer
+    from ..tensor import Tensor
+    if isinstance(fn, Layer) or isinstance(getattr(fn, "__self__", None),
+                                           Layer):
+        return True
+    return any(isinstance(a, Tensor) for a in flat_args)
+
+
+def _unhashable_statics(args, kwargs):
+    import jax
+    import numpy as np
+    out = []
+    flat, _ = jax.tree_util.tree_flatten_with_path((args, kwargs))
+    for path, leaf in flat:
+        if isinstance(leaf, (jax.Array, np.ndarray, np.generic)):
+            continue
+        try:
+            hash(leaf)
+        except TypeError:
+            out.append((jax.tree_util.keystr(path),
+                        type(leaf).__name__))
+    return out
+
+
+def _aliased_donations(args, donate_argnums):
+    import jax
+    if not donate_argnums:
+        return []
+    ids = {}
+    out = []
+    for i, a in enumerate(args):
+        for leaf in jax.tree_util.tree_leaves(a):
+            if not hasattr(leaf, "dtype"):
+                continue
+            j = ids.setdefault(id(leaf), i)
+            if j != i and (i in donate_argnums or j in donate_argnums):
+                out.append(f"args {j} and {i} share a buffer")
+    return out
+
+
+def audit(fn, *args, donate_argnums=(), name=None, rules=None,
+          **kwargs) -> Report:
+    """Trace + lower ``fn`` on the example arguments and run every
+    program rule over the jaxpr and emitted StableHLO.
+
+    Accepts plain jax-array callables (lowered directly, honoring
+    ``donate_argnums``) and paddle Tensor/Layer callables (lowered
+    through ``jit.to_static``'s StaticFunction, which hoists Layer
+    parameters into jit arguments).
+    """
+    import jax
+
+    flat_args = jax.tree_util.tree_leaves((args, kwargs))
+    label = name or getattr(fn, "__name__", None) or type(fn).__name__
+    meta = {"unhashable_statics": _unhashable_statics(args, kwargs),
+            "aliased_donations": _aliased_donations(args, donate_argnums),
+            "donate_argnums": tuple(donate_argnums)}
+
+    text = None
+    jaxpr = None
+    try:
+        if _is_tensorish(fn, flat_args):
+            from ..nn.layer_base import Layer
+            target = fn.forward if isinstance(fn, Layer) else fn
+            from ..jit.api import StaticFunction
+            sf = StaticFunction(target, convert_control_flow=False)
+            text = sf.lower(*args, **kwargs).as_text()
+        else:
+            jfn = jax.jit(fn, donate_argnums=tuple(donate_argnums))
+            text = jfn.lower(*args, **kwargs).as_text()
+            try:
+                jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+            except Exception as e:
+                meta["jaxpr_error"] = f"{type(e).__name__}: {e}"
+    except Exception as e:
+        # un-lowerable example args (unhashable statics, non-array
+        # leaves) are themselves a finding, not an audit crash: record
+        # why and let retrace-risk report the offending leaves
+        meta["lowering_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+
+    view = ProgramView(label, "callable", stablehlo=text, jaxpr=jaxpr,
+                       meta=meta)
+    return view.run_rules(rules)
+
+
+def audit_model(model, *args, rules=None, **kwargs) -> Report:
+    """Audit a Layer's jitted forward on example inputs (params hoisted
+    as jit arguments, exactly what ``jit.to_static`` would compile)."""
+    return audit(model, *args, rules=rules,
+                 name=type(model).__name__, **kwargs)
+
+
+def audit_stablehlo(text, name="stablehlo", rules=None) -> Report:
+    """Audit an already-lowered StableHLO module (text form)."""
+    return ProgramView(name, "stablehlo", stablehlo=text).run_rules(rules)
+
+
+# -- plan / engine / dispatch front ends ------------------------------------
+
+def _describe_entry(e):
+    try:
+        kind = e[0]
+        if kind == "op":
+            fn = e[1]
+            label = getattr(fn, "__name__", type(fn).__name__)
+            return f"op:{label}"
+        return str(kind)
+    except (AttributeError, IndexError, TypeError):
+        return "host entry"
+
+
+def audit_plan(plan_or_program, rules=None, name="replay_plan") -> Report:
+    """Audit a static-executor replay plan (or every cached plan of a
+    ``static.Program``): host splits, donation, fragmentation."""
+    from ..static.program import _ReplayPlan
+
+    if not isinstance(plan_or_program, _ReplayPlan):
+        cache = getattr(plan_or_program, "_jit_cache", None) or {}
+        plans = [p for p in cache.values() if p is not None]
+        if not plans:
+            raise ValueError(
+                "program has no compiled replay plan yet — run the "
+                "Executor at least twice so the plan builds")
+        report = Report(origin=f"plan:{name}")
+        for i, p in enumerate(plans):
+            report.extend(audit_plan(p, rules=rules, name=f"{name}[{i}]"))
+        global _last_report
+        _last_report = report
+        return report
+
+    plan = plan_or_program
+    host_entries = []
+    segments = []
+    for idx, (kind, payload) in enumerate(plan.steps):
+        if kind == "host":
+            host_entries.append((_describe_entry(payload), idx))
+        else:
+            segments.append({
+                "index": idx, "donated": payload.donated,
+                "n_state": len(payload.state_specs),
+                "alias_count": payload.alias_count})
+    meta = {"host_entries": host_entries, "segments": segments,
+            "n_segments": len(segments), "n_host": plan.n_host,
+            # segmented plans can't donate by design: don't double-count
+            # the donation finding on top of the host-split finding
+            "segmented": len(segments) > 1}
+    return ProgramView(name, "plan", meta=meta).run_rules(rules)
+
+
+def audit_engine(engine, compile_budget=None, rules=None,
+                 lower_decode=True) -> Report:
+    """Audit a serving Engine: compile-count budget, bucket/KV geometry,
+    donation policy — plus, when possible, the lowered decode program
+    itself (dtype / padding rules see real HLO)."""
+    import jax
+
+    from .engine_support import engine_donates, lower_decode_program
+
+    meta = {
+        "n_slots": engine.n_slots, "max_len": engine.max_len,
+        "min_prompt_bucket": engine.min_prompt_bucket,
+        "buckets_seen": sorted(engine.buckets_seen),
+        "decode_used": engine.metrics.decode_steps > 0
+        or bool(engine.buckets_seen),
+        "compile_budget": (compile_budget if compile_budget is not None
+                           else engine.compile_budget),
+        "backend": jax.default_backend(),
+        "donate": engine_donates(engine),
+        "kv_heads": engine.cache.kv_heads,
+        "head_dim": engine.cache.head_dim,
+    }
+    text = None
+    if lower_decode:
+        try:
+            text = lower_decode_program(engine)
+        except Exception as e:
+            meta["decode_lowering_error"] = f"{type(e).__name__}: {e}"
+    return ProgramView(f"Engine[{type(engine).__name__}]", "engine",
+                       stablehlo=text, meta=meta).run_rules(rules)
+
+
+def audit_dispatch(rules=None) -> Report:
+    """Audit the live eager-dispatch cache: blacklisted ops (with the
+    recorded reason), megamorphic signatures, retrace pressure."""
+    from ..framework.dispatch_cache import dispatch_stats
+
+    meta = {"dispatch_stats": dispatch_stats()}
+    return ProgramView("eager-dispatch", "dispatch",
+                       meta=meta).run_rules(rules)
+
+
+# -- AST self-lint front end -------------------------------------------------
+
+def _iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def selflint(paths, rules=None) -> Report:
+    """Run the AST rules over python source files/directories."""
+    global _last_report
+    report = Report(origin=f"selflint:{','.join(map(str, paths))}")
+    n_files = 0
+    for path in _iter_py_files(paths):
+        n_files += 1
+        sf = SourceFile.load(path)
+        if sf.parse_error:
+            from .findings import Finding
+            report.add(Finding("parse-error", "info", sf.parse_error,
+                               location=path))
+            continue
+        for r in iter_rules(kind="ast", ids=rules):
+            for f in r.run(sf):
+                report.add(f)
+    report.metrics["selflint"] = {"files": n_files}
+    _last_report = report
+    return report
+
+
+def findings_summary():
+    """One-line summary of the most recent audit (None when nothing has
+    been audited yet) — wired into profiler.Profiler.summary()."""
+    if _last_report is None:
+        return None
+    return _last_report.summary_line()

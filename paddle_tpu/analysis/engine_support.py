@@ -1,0 +1,33 @@
+"""Serving-engine introspection helpers for the audit front end.
+
+Kept out of ``audit.py`` so the serving package is only imported when an
+engine is actually being audited.
+"""
+from __future__ import annotations
+
+
+def engine_donates(engine) -> bool:
+    """True when the engine was built on the donating prefill/decode
+    programs (KV buffers updated in place)."""
+    from ..serving import engine as E
+
+    return engine._decode is E._DECODE_DONATED
+
+
+def lower_decode_program(engine) -> str:
+    """Lower the engine's fused decode step against its live state and
+    return the StableHLO text — the same program the engine executes, so
+    dtype/padding rules audit real serving HLO, not a proxy."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..serving.engine import _STATICS, _decode_impl
+
+    args = (engine._w, jnp.asarray(engine.cache.kc),
+            jnp.asarray(engine.cache.vc), jnp.asarray(engine._tok),
+            jnp.asarray(engine._cur), jnp.asarray(engine.cache.active),
+            jnp.asarray(engine._keys), jnp.asarray(engine._temps))
+    lowered = jax.jit(_decode_impl,
+                      static_argnames=_STATICS).lower(
+        *args, **engine._statics)
+    return lowered.as_text()

@@ -1,0 +1,155 @@
+"""Machine-readable findings for tpu_lint (paddle_tpu.analysis).
+
+A :class:`Finding` is one diagnosed hazard: rule id, severity, where it
+was found (an HLO op path, a jaxpr eqn, or ``file:line`` for the AST
+self-lint), a human message and a suggested fix. A :class:`Report` is
+the outcome of one audit: the findings plus per-rule metrics (e.g. the
+transpose counts the layout rule measured even when it found nothing),
+with JSON/serialization and severity-gating helpers the CLI and CI use.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+SEVERITIES = ("info", "low", "medium", "high")
+_SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+
+def severity_rank(sev: str) -> int:
+    try:
+        return _SEV_RANK[sev]
+    except KeyError:
+        raise ValueError(
+            f"unknown severity {sev!r}; expected one of {SEVERITIES}")
+
+
+@dataclass
+class Finding:
+    """One diagnosed hazard (machine-readable)."""
+
+    rule_id: str
+    severity: str
+    message: str
+    location: str = ""       # op path / file:line / engine component
+    suggested_fix: str = ""
+    origin: str = ""         # which audited program/file produced it
+    data: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d = {"rule": self.rule_id, "severity": self.severity,
+             "message": self.message, "location": self.location,
+             "fix": self.suggested_fix, "origin": self.origin}
+        if self.data:
+            d["data"] = self.data
+        return d
+
+    def __str__(self):
+        loc = f" [{self.location}]" if self.location else ""
+        fix = f" -> {self.suggested_fix}" if self.suggested_fix else ""
+        return (f"{self.severity.upper():6s} {self.rule_id}{loc}: "
+                f"{self.message}{fix}")
+
+
+class Report:
+    """Findings + metrics from one audit (or several merged)."""
+
+    def __init__(self, origin: str = "", findings=None, metrics=None):
+        self.origin = origin
+        self.findings: list = list(findings or [])
+        # rule_id -> dict of measurements (populated even when clean)
+        self.metrics: dict = dict(metrics or {})
+        self.suppressed = 0   # findings dropped by allowlist filtering
+
+    def add(self, finding: Finding):
+        if not finding.origin:
+            finding.origin = self.origin
+        self.findings.append(finding)
+
+    def extend(self, other: "Report"):
+        self.findings.extend(other.findings)
+        for k, v in other.metrics.items():
+            self.metrics.setdefault(k, v)
+        self.suppressed += other.suppressed
+        return self
+
+    def by_rule(self, rule_id: str):
+        return [f for f in self.findings if f.rule_id == rule_id]
+
+    def rule_ids(self):
+        return sorted({f.rule_id for f in self.findings})
+
+    def counts(self) -> dict:
+        c = {s: 0 for s in SEVERITIES}
+        for f in self.findings:
+            c[f.severity] += 1
+        return c
+
+    def max_severity(self):
+        if not self.findings:
+            return None
+        return max((f.severity for f in self.findings), key=severity_rank)
+
+    def ok(self, fail_on: str = "high") -> bool:
+        """True when no finding is at or above ``fail_on`` severity."""
+        floor = severity_rank(fail_on)
+        return all(severity_rank(f.severity) < floor for f in self.findings)
+
+    def apply_allowlist(self, allowlist):
+        """Drop findings matched by ``allowlist`` entries (see
+        :func:`parse_allowlist`); returns self."""
+        if not allowlist:
+            return self
+        kept = []
+        for f in self.findings:
+            if any(_allow_match(entry, f) for entry in allowlist):
+                self.suppressed += 1
+            else:
+                kept.append(f)
+        self.findings = kept
+        return self
+
+    def summary_line(self) -> str:
+        c = self.counts()
+        return (f"{len(self.findings)} finding"
+                f"{'s' if len(self.findings) != 1 else ''} "
+                f"({c['high']} high / {c['medium']} medium / "
+                f"{c['low']} low / {c['info']} info)"
+                + (f", {self.suppressed} allowlisted"
+                   if self.suppressed else "")
+                + (f" — {self.origin}" if self.origin else ""))
+
+    def to_dict(self) -> dict:
+        return {"origin": self.origin,
+                "findings": [f.to_dict() for f in self.findings],
+                "counts": self.counts(), "suppressed": self.suppressed,
+                "metrics": self.metrics}
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.to_dict(), default=str, **kw)
+
+    def __repr__(self):
+        return f"<Report {self.summary_line()}>"
+
+
+def parse_allowlist(text: str):
+    """Parse an allowlist file: one ``rule-id path[:line]`` entry per
+    line (``#`` comments; ``*`` path matches everywhere). Returns a list
+    of (rule_id, location_prefix) tuples."""
+    entries = []
+    for raw in text.splitlines():
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        parts = line.split(None, 1)
+        rule_id = parts[0]
+        loc = parts[1].strip() if len(parts) > 1 else "*"
+        entries.append((rule_id, loc))
+    return entries
+
+
+def _allow_match(entry, finding: Finding) -> bool:
+    rule_id, loc = entry
+    if rule_id not in ("*", finding.rule_id):
+        return False
+    return loc == "*" or finding.location.startswith(loc)

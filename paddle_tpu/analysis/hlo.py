@@ -1,0 +1,203 @@
+"""Minimal StableHLO *text* parser for tpu_lint.
+
+``jax.jit(fn).lower(...).as_text()`` emits MLIR in the stablehlo
+dialect; this module parses just enough structure for the audit rules —
+per-op name/operands/results/tensor-types, function arguments with their
+attribute dicts (donation shows up as ``tf.aliasing_output`` /
+``jax.buffer_donor``), and returned values — without an MLIR dependency.
+One shared parse feeds every rule (and the thin ``tools/check_*``
+CLIs), so the text is scanned once per audited program.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+# tensor<4x13xf32> / tensor<f32> / tensor<?x8xbf16> (inside tuples too)
+_TENSOR_RE = re.compile(r"tensor<([^<>]*)>")
+_VAR_RE = re.compile(r"%[A-Za-z0-9_#]+")
+# "  %5 = stablehlo.add %4, %cst : tensor<8xf32>"  /  "%5:2 = ..."
+_OP_RE = re.compile(
+    r"^\s*(%[A-Za-z0-9_#]+(?::\d+)?(?:\s*,\s*%[A-Za-z0-9_#]+(?::\d+)?)*)"
+    r"\s*=\s*\"?([A-Za-z_][\w.]*)\"?\s*(.*)$")
+_FUNC_RE = re.compile(r"^\s*func\.func\b.*@([\w$-]+)\s*\((.*)$")
+# arg attrs may carry quoted strings containing braces
+# (mhlo.sharding = "{devices=[2]<=[2]}"), so the attr-dict match must
+# treat quoted spans as opaque
+_ARG_RE = re.compile(
+    r"%arg(\d+):\s*tensor<([^<>]*)>\s*(\{(?:[^{}\"]|\"[^\"]*\")*\})?")
+_RETURN_RE = re.compile(r"^\s*(?:func\.)?return\b(.*)$")
+_CUSTOM_CALL_RE = re.compile(r"custom_call\s*@([\w.$-]+)")
+
+
+@dataclass
+class TensorType:
+    shape: tuple            # ints; dynamic dims recorded as -1
+    dtype: str              # "f32", "bf16", "i32", ...
+
+    @property
+    def elems(self):
+        n = 1
+        for d in self.shape:
+            n *= max(d, 1)
+        return n
+
+    def __str__(self):
+        return "x".join([*(str(d) for d in self.shape), self.dtype])
+
+
+def parse_tensor_type(spec: str):
+    """``"4x13xf32"`` -> TensorType((4, 13), "f32"); None if unparsable."""
+    parts = spec.strip().split("x")
+    if not parts or not parts[-1]:
+        return None
+    dtype = parts[-1]
+    dims = []
+    for p in parts[:-1]:
+        if p.isdigit():
+            dims.append(int(p))
+        elif p == "?":
+            dims.append(-1)
+        else:
+            return None
+    if not re.fullmatch(r"[a-z][a-z0-9]*", dtype):
+        return None
+    return TensorType(tuple(dims), dtype)
+
+
+def tensor_types(text: str):
+    """All tensor types mentioned in a text fragment, in order."""
+    out = []
+    for m in _TENSOR_RE.finditer(text):
+        t = parse_tensor_type(m.group(1))
+        if t is not None:
+            out.append(t)
+    return out
+
+
+@dataclass
+class HloOp:
+    name: str               # "stablehlo.transpose", "call", ...
+    results: tuple          # result %var names
+    operands: tuple         # operand %var names (in textual order)
+    types: tuple            # every TensorType on the line, in order
+    line_no: int            # 1-based line in the module text
+    raw: str
+    func: str = ""          # enclosing func symbol
+
+    @property
+    def custom_call_target(self):
+        m = _CUSTOM_CALL_RE.search(self.raw)
+        return m.group(1) if m else None
+
+    @property
+    def path(self):
+        return f"@{self.func}:{self.line_no} {self.name}"
+
+
+@dataclass
+class HloFunc:
+    name: str
+    args: list = field(default_factory=list)   # (index, TensorType, attrs)
+    returned: set = field(default_factory=set)  # %var names returned
+    result_types: list = field(default_factory=list)  # TensorTypes after ->
+
+
+
+@dataclass
+class HloModule:
+    ops: list = field(default_factory=list)
+    funcs: dict = field(default_factory=dict)
+    text: str = ""
+
+    @property
+    def main(self):
+        return self.funcs.get("main") or next(iter(self.funcs.values()),
+                                              HloFunc("main"))
+
+    def ops_named(self, *names):
+        want = set(names)
+        return [op for op in self.ops
+                if op.name in want or op.name.split(".")[-1] in want]
+
+
+def _parse_arg_attrs(attr_text):
+    """``{tf.aliasing_output = 0 : i32, ...}`` -> dict of key -> raw."""
+    attrs = {}
+    if not attr_text:
+        return attrs
+    for m in re.finditer(r"([\w.]+)\s*(?:=\s*([^,{}]+))?", attr_text):
+        attrs[m.group(1)] = (m.group(2) or "").strip()
+    return attrs
+
+
+def parse_stablehlo(text: str) -> HloModule:
+    mod = HloModule(text=text)
+    cur = None
+    for i, line in enumerate(text.splitlines(), start=1):
+        fm = _FUNC_RE.match(line)
+        if fm:
+            cur = HloFunc(fm.group(1))
+            mod.funcs[cur.name] = cur
+            # arg list may wrap lines in hand-written MLIR; jax emits it
+            # on one line, which is the contract this parser targets
+            head, _, tail = line.partition("->")
+            for am in _ARG_RE.finditer(head):
+                t = parse_tensor_type(am.group(2))
+                cur.args.append((int(am.group(1)), t,
+                                 _parse_arg_attrs(am.group(3))))
+            cur.result_types = tensor_types(tail)
+            continue
+        rm = _RETURN_RE.match(line)
+        if rm and cur is not None:
+            head = rm.group(1).split(":")[0]
+            cur.returned.update(v.group(0).split(":")[0]
+                                for v in _VAR_RE.finditer(head))
+            continue
+        om = _OP_RE.match(line)
+        if om:
+            results = tuple(r.strip().split(":")[0]
+                            for r in om.group(1).split(","))
+            rest = om.group(3)
+            operands = tuple(v.group(0) for v in _VAR_RE.finditer(rest))
+            mod.ops.append(HloOp(
+                name=om.group(2), results=results, operands=operands,
+                types=tuple(tensor_types(line)), line_no=i, raw=line,
+                func=cur.name if cur else ""))
+    return mod
+
+
+# -- shared measurements -----------------------------------------------------
+
+def classify_transposes(mod: HloModule):
+    """Split transpose ops into boundary (consume a func argument or
+    produce a returned value) vs interior (between compute ops — the
+    per-op relayouts the layout planner exists to eliminate)."""
+    arg_vars = {f"%arg{i}" for fn in mod.funcs.values()
+                for i, _t, _a in fn.args}
+    returned = {v for fn in mod.funcs.values() for v in fn.returned}
+    boundary, interior = [], []
+    for op in mod.ops_named("stablehlo.transpose", "transpose"):
+        if (any(o in arg_vars for o in op.operands)
+                or any(r in returned for r in op.results)):
+            boundary.append(op)
+        else:
+            interior.append(op)
+    return interior, boundary
+
+
+def count_transposes(text: str):
+    """(interior, boundary, total) transpose counts for StableHLO text."""
+    mod = parse_stablehlo(text)
+    interior, boundary = classify_transposes(mod)
+    return len(interior), len(boundary), len(interior) + len(boundary)
+
+
+def donated_arg_indices(mod: HloModule):
+    """Arg indices of @main carrying a donation/aliasing attribute."""
+    out = set()
+    for i, _t, attrs in mod.main.args:
+        if any(k.endswith("aliasing_output") or k.endswith("buffer_donor")
+               for k in attrs):
+            out.add(i)
+    return out

@@ -1,0 +1,45 @@
+"""Shared runtime hooks for the lint tooling.
+
+The retrace and serving-compile checkers both need an XLA compile-event
+counter; this is the one shared implementation (previously duplicated in
+``tools/check_retrace.py`` and ``tools/check_serving_compiles.py``).
+"""
+from __future__ import annotations
+
+
+class CompileEventCounter:
+    """Counts backend compile events via the jax monitoring API.
+
+    ``install()`` registers the listener (idempotent per instance) and
+    returns self; ``available`` is False when the private monitoring
+    module is missing, in which case ``count`` stays 0 and callers
+    should treat the signal as absent rather than "no compiles".
+    Listener registration is process-global in jax, so installation is
+    permanent — use ``reset()`` between measured phases.
+    """
+
+    def __init__(self):
+        self.count = 0
+        self.available = False
+        self._installed = False
+
+    def _on_event(self, event, *a, **k):
+        if "compil" in event.lower():
+            self.count += 1
+
+    def install(self):
+        if self._installed:
+            return self
+        try:
+            from jax._src import monitoring
+            monitoring.register_event_listener(self._on_event)
+            self.available = True
+        except Exception as e:  # monitoring API moved/absent
+            self.available = False
+            self._unavailable_reason = f"{type(e).__name__}: {e}"
+        self._installed = True
+        return self
+
+    def reset(self):
+        self.count = 0
+        return self

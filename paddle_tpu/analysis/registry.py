@@ -1,0 +1,69 @@
+"""Rule registry for tpu_lint.
+
+Rules come in two kinds:
+
+* ``program`` — run on a :class:`~paddle_tpu.analysis.audit.ProgramView`
+  (traced jaxpr + lowered StableHLO + origin metadata) and yield
+  :class:`~paddle_tpu.analysis.findings.Finding`s;
+* ``ast`` — run on a :class:`~paddle_tpu.analysis.rules_ast.SourceFile`
+  (parsed python source) during the self-lint.
+
+Registering a rule is one decorator::
+
+    @rule("my-rule", kind="program", severity="medium",
+          title="what it catches")
+    def _my_rule(view):
+        yield Finding("my-rule", "medium", "...")
+
+The decorated function may yield findings with any severity; the
+registered ``severity`` is the rule's *default/documented* level (shown
+in the README table and the CLI rule listing). Rule ids may be shared
+across kinds (``dtype-promotion`` has both a program and an AST facet).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .findings import SEVERITIES
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    kind: str            # "program" | "ast"
+    severity: str        # documented default severity
+    title: str
+    fn: object
+
+    def run(self, target):
+        return self.fn(target)
+
+
+_RULES: list = []
+
+
+def rule(rule_id: str, *, kind: str, severity: str, title: str):
+    if kind not in ("program", "ast"):
+        raise ValueError(f"unknown rule kind {kind!r}")
+    if severity not in SEVERITIES:
+        raise ValueError(f"unknown severity {severity!r}")
+
+    def deco(fn):
+        _RULES.append(Rule(rule_id, kind, severity, title, fn))
+        return fn
+
+    return deco
+
+
+def iter_rules(kind=None, ids=None):
+    for r in _RULES:
+        if kind is not None and r.kind != kind:
+            continue
+        if ids is not None and r.id not in ids:
+            continue
+        yield r
+
+
+def rules_table():
+    """[(id, kind, severity, title)] for docs/CLI listing."""
+    return [(r.id, r.kind, r.severity, r.title) for r in _RULES]

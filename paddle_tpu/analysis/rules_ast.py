@@ -1,0 +1,289 @@
+"""AST self-lint rules: hazard patterns in paddle_tpu's own source.
+
+Suppression is by inline annotation, never by config: a comment
+``# tpu_lint: allow(rule-id[, rule-id...])`` on the flagged line, the
+line above it, or the line directly above a ``def``/``class`` (which
+then covers the whole body) marks a reviewed-and-intentional site.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from .findings import Finding
+from .registry import rule
+
+_ALLOW_RE = re.compile(r"#\s*tpu_lint:\s*allow\(([\w\-, ]+)\)")
+_ALLOW_FILE_RE = re.compile(r"#\s*tpu_lint:\s*allow-file\(([\w\-, ]+)\)")
+
+
+@dataclass
+class SourceFile:
+    """One parsed python source file plus its allow annotations."""
+
+    path: str
+    text: str
+    tree: ast.AST = None
+    lines: list = field(default_factory=list)
+    allow_lines: dict = field(default_factory=dict)  # line -> {rule ids}
+    allow_file: set = field(default_factory=set)
+    parse_error: str = ""
+
+    @classmethod
+    def load(cls, path, text=None):
+        if text is None:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        sf = cls(path=path, text=text, lines=text.splitlines())
+        try:
+            sf.tree = ast.parse(text, filename=path)
+        except SyntaxError as e:
+            sf.parse_error = f"SyntaxError: {e}"
+            return sf
+        sf._collect_allows()
+        return sf
+
+    def _collect_allows(self):
+        for i, line in enumerate(self.lines, start=1):
+            m = _ALLOW_FILE_RE.search(line)
+            if m:
+                self.allow_file.update(
+                    x.strip() for x in m.group(1).split(","))
+                continue
+            m = _ALLOW_RE.search(line)
+            if m:
+                ids = {x.strip() for x in m.group(1).split(",")}
+                # the annotation covers its own line and the next one
+                self.allow_lines.setdefault(i, set()).update(ids)
+                self.allow_lines.setdefault(i + 1, set()).update(ids)
+        # an annotation on the line above a def/class (or its first
+        # decorator) covers the whole body
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                first = min([node.lineno]
+                            + [d.lineno for d in node.decorator_list])
+                ids = self.allow_lines.get(first, set()) \
+                    | self.allow_lines.get(first - 1, set())
+                ids = {i for i in ids}
+                if ids:
+                    end = getattr(node, "end_lineno", node.lineno)
+                    for ln in range(node.lineno, end + 1):
+                        self.allow_lines.setdefault(ln, set()).update(ids)
+
+    def allowed(self, rule_id, lineno):
+        return rule_id in self.allow_file or \
+            rule_id in self.allow_lines.get(lineno, ())
+
+    def loc(self, node):
+        return f"{self.path}:{getattr(node, 'lineno', '?')}"
+
+
+def _finding(sf, rule_id, severity, node, message, fix):
+    if sf.allowed(rule_id, getattr(node, "lineno", -1)):
+        return None
+    return Finding(rule_id, severity, message, location=sf.loc(node),
+                   suggested_fix=fix, origin=sf.path)
+
+
+# -- 1. id()-keyed caches ----------------------------------------------------
+
+def _is_id_call(node):
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "id" and node.args)
+
+
+def _contains_id_call(node):
+    return any(_is_id_call(n) for n in ast.walk(node))
+
+
+def _is_persistent_container(node):
+    """Attribute-rooted (self._cache / obj._slots) or plain-Name
+    containers can outlive the keyed object; calls/literals can't."""
+    return isinstance(node, ast.Attribute)
+
+
+@rule("id-keyed-cache", kind="ast", severity="high",
+      title="id()-keyed entry in a persistent container — ids recycle "
+            "after GC, resurrecting stale entries (ADVICE round-5 bug)")
+def _id_keyed_cache(sf):
+    if sf.tree is None:
+        return
+    for node in ast.walk(sf.tree):
+        target = None
+        if isinstance(node, ast.Subscript) and \
+                _contains_id_call(node.slice):
+            target = node.value
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("get", "setdefault", "pop") and \
+                node.args and _contains_id_call(node.args[0]):
+            target = node.func.value
+        if target is None or not _is_persistent_container(target):
+            continue
+        f = _finding(
+            sf, "id-keyed-cache", "high", node,
+            "cache keyed by id(obj) on a persistent container — after "
+            "the object dies its id can be reused, silently hitting the "
+            "stale entry",
+            "key by a stable monotonic token (static.program."
+            "_stable_token idiom) or hold a reference to the keyed "
+            "object; if the container provably outlives every key, "
+            "annotate with  # tpu_lint: allow(id-keyed-cache)")
+        if f:
+            yield f
+
+
+# -- 2. numpy calls inside traced bodies ------------------------------------
+
+_TRACER_CALLS = {"jit", "vmap", "pmap", "grad", "value_and_grad", "vjp",
+                 "jvp", "checkpoint", "remat", "scan", "while_loop",
+                 "cond", "fori_loop", "switch", "map", "custom_vjp",
+                 "custom_jvp", "to_static"}
+
+
+def _call_name(node):
+    """Trailing name of a call target: jax.jit -> 'jit'."""
+    f = node.func if isinstance(node, ast.Call) else node
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _collect_traced_funcs(tree):
+    """FunctionDef nodes whose body runs under a jax trace: decorated
+    with jit/to_static, referenced in a jit(...) call, or passed to a
+    lax control-flow / transform combinator."""
+    funcs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs[node.name] = node
+    traced = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _call_name(dec) in _TRACER_CALLS:
+                    traced.add(node)
+        if isinstance(node, ast.Call) and _call_name(node) in _TRACER_CALLS:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in funcs:
+                    traced.add(funcs[arg.id])
+                elif isinstance(arg, ast.Lambda):
+                    traced.add(arg)
+    return traced
+
+
+@rule("numpy-in-traced", kind="ast", severity="medium",
+      title="numpy call on a traced value inside a jitted/lax body — "
+            "fails the trace or silently bakes a constant")
+def _numpy_in_traced(sf):
+    if sf.tree is None:
+        return
+    for fn in _collect_traced_funcs(sf.tree):
+        if isinstance(fn, ast.Lambda):
+            params = {a.arg for a in fn.args.args}
+            body = [fn.body]
+        else:
+            params = {a.arg for a in fn.args.args
+                      + fn.args.kwonlyargs + fn.args.posonlyargs}
+            body = fn.body
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if not (isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id in ("np", "numpy")):
+                    continue
+                touches_param = any(
+                    isinstance(a, ast.Name) and a.id in params
+                    for a in ast.walk(node) if isinstance(a, ast.Name))
+                if not touches_param:
+                    continue  # np on python constants is host math: fine
+                found = _finding(
+                    sf, "numpy-in-traced", "medium", node,
+                    f"np.{f.attr}() applied to a traced-function "
+                    "argument — numpy can't consume tracers (trace "
+                    "error) or, via __array__, bakes the first value "
+                    "as a constant",
+                    "use the jnp equivalent inside traced code; keep "
+                    "numpy for host-side constant math only")
+                if found:
+                    yield found
+
+
+# -- 3. blanket except that swallows the reason ------------------------------
+
+_REPORTING_CALLS = {"warn", "warning", "error", "exception", "debug",
+                    "info", "log", "print", "fail", "record", "append",
+                    "add", "write"}
+
+
+@rule("silent-except", kind="ast", severity="medium",
+      title="blanket `except Exception` that neither re-raises nor "
+            "records why — trace failures vanish without a reason")
+def _silent_except(sf):
+    if sf.tree is None:
+        return
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        t = node.type
+        blanket = t is None or (isinstance(t, ast.Name)
+                                and t.id in ("Exception", "BaseException"))
+        if not blanket:
+            continue
+        caught_used = False
+        reports = False
+        reraises = False
+        for sub in ast.walk(ast.Module(body=node.body, type_ignores=[])):
+            if isinstance(sub, ast.Raise):
+                reraises = True
+            if node.name and isinstance(sub, ast.Name) \
+                    and sub.id == node.name:
+                caught_used = True
+            if isinstance(sub, ast.Call) and \
+                    _call_name(sub) in _REPORTING_CALLS:
+                reports = True
+        if reraises or caught_used or reports:
+            continue
+        f = _finding(
+            sf, "silent-except", "medium", node,
+            "blanket except swallows the exception without recording "
+            "type/message — when a trace fails here, nothing says why",
+            "capture `as e` and record f'{type(e).__name__}: {e}' "
+            "(blacklist reason, warning, or log) before falling back")
+        if f:
+            yield f
+
+
+# -- 4. fp64 constant math in library code (AST facet of dtype-promotion) ----
+
+@rule("dtype-promotion", kind="ast", severity="medium",
+      title="np.float64 constant math in library code — fp64 results "
+            "must not leak into traced/compute paths (x64 is off)")
+def _fp64_ast(sf):
+    if sf.tree is None:
+        return
+    for node in ast.walk(sf.tree):
+        is_f64_attr = (isinstance(node, ast.Attribute)
+                       and node.attr in ("float64", "double")
+                       and isinstance(node.value, ast.Name)
+                       and node.value.id in ("np", "numpy", "jnp"))
+        if not is_f64_attr:
+            continue
+        f = _finding(
+            sf, "dtype-promotion", "medium", node,
+            "explicit float64 in library code — jax x64 is off by "
+            "policy, so fp64 here is host-side constant math that must "
+            "be cast before reaching traced code",
+            "cast the result to the compute dtype at the boundary; if "
+            "the fp64 math is intentional (constant folding), annotate "
+            "with  # tpu_lint: allow(dtype-promotion)")
+        if f:
+            yield f

@@ -314,6 +314,7 @@ class CompiledTrainStep:
     def sync_optimizer_state(self):
         """Push compiled-state moments back into the eager optimizer dicts."""
         for k, p in self._params.items():
+            # tpu_lint: allow(id-keyed-cache) — p retained by self._params
             self.optimizer._accumulators[id(p)] = self._opt_state[k]
 
 

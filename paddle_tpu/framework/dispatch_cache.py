@@ -68,7 +68,10 @@ _WARMUP = max(1, int(os.environ.get("PADDLE_TPU_EAGER_CACHE_WARMUP", "32")))
 _lock = threading.RLock()
 _cache: "OrderedDict[tuple, _Entry]" = OrderedDict()
 _seen: "OrderedDict[tuple, bool]" = OrderedDict()
-_blacklist = set()   # fn keys whose trace failed: data-dependent python
+# fn key -> reason string for ops whose trace failed (data-dependent
+# python): the WHY is recorded so dispatch_stats()/tpu_lint can report
+# which op fell off the fast path and what actually went wrong there
+_blacklist: dict = {}
 _epoch = 0           # bumped by invalidate(); part of every key
 
 # megamorphic demotion: an op that keeps producing NEW signatures (a
@@ -137,17 +140,35 @@ def invalidate():
         _stats.invalidations += 1
 
 
+def _fn_label(fnk):
+    """Human-readable op label for a fn key (code objects carry their
+    source location; everything else falls back to repr)."""
+    if isinstance(fnk, tuple) and fnk and hasattr(fnk[0], "co_name"):
+        code = fnk[0]
+        fname = os.path.basename(code.co_filename)
+        return f"{code.co_name} ({fname}:{code.co_firstlineno})"
+    return repr(fnk)[:80]
+
+
 def dispatch_stats() -> dict:
     """Snapshot of the eager-dispatch cache counters.
 
     ``compiles`` is the retrace count: a steady-state (warm) eager loop
-    must add only ``hits``."""
+    must add only ``hits``. ``blacklist`` lists every op that fell off
+    the fast path with the recorded reason (exception type + message of
+    its failed trace); ``megamorphic`` lists ops demoted for producing
+    too many distinct signatures."""
     with _lock:
         return {"enabled": _enabled_flag, "hits": _stats.hits,
                 "misses": _stats.misses, "compiles": _stats.compiles,
                 "bypasses": _stats.bypasses,
                 "invalidations": _stats.invalidations,
-                "entries": len(_cache), "capacity": _CAPACITY}
+                "entries": len(_cache), "capacity": _CAPACITY,
+                "blacklist": [{"op": _fn_label(k), "reason": r}
+                              for k, r in list(_blacklist.items())[:32]],
+                "megamorphic": [_fn_label(k)
+                                for k, n in _fn_sig_count.items()
+                                if n >= _POLY_LIMIT][:32]}
 
 
 def reset_stats():
@@ -391,9 +412,10 @@ def dispatch(fn, raw, kwargs, diff_idx):
         try:
             entry = _build_entry(fn, dict(kwargs), template, statics,
                                  diff_idx)
-        except Exception:
+        except Exception as e:
             with _lock:
-                _blacklist.add(fnk)
+                _blacklist[fnk] = \
+                    f"build failed: {type(e).__name__}: {str(e)[:200]}"
                 _stats.bypasses += 1
             return None
         with _lock:
@@ -411,13 +433,14 @@ def dispatch(fn, raw, kwargs, diff_idx):
             out, pullback = entry.forward(dyn_vals)
         else:
             out, pullback = entry.forward(dyn_vals), None
-    except Exception:
+    except Exception as e:
         # the first execution traces; data-dependent python (.item(),
         # value branches, dynamic output shapes) surfaces here — fall
         # back for good, the eager path reports the real error if any
         with _lock:
             _cache.pop(key, None)
-            _blacklist.add(fnk)
+            _blacklist[fnk] = \
+                f"first trace failed: {type(e).__name__}: {str(e)[:200]}"
             _stats.bypasses += 1
         return None
     return out, pullback, entry

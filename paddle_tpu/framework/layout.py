@@ -195,13 +195,16 @@ def fold_conv_bn(model):
     return folded
 
 
+# tpu_lint: allow(dtype-promotion) — f64 folding is host-side by design
 def _fold_pair(conv, bn):
     import numpy as np
 
     from ..tensor import Parameter
 
     # constant math in float64 (numpy — jax x64 stays off by policy) so
-    # the only fp32 error left is the runtime re-association x*(W*scale)
+    # the only fp32 error left is the runtime re-association x*(W*scale);
+    # results are cast back to the weight dtype before any traced code
+    # sees them, which is exactly the pattern the allow() above blesses
     w = conv.weight._data
     c = bn._num_features
     gamma = (np.asarray(bn.weight._data, np.float64)

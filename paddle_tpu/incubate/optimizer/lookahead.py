@@ -4,6 +4,9 @@ Reference: python/paddle/incubate/optimizer/lookahead.py — wraps an inner
 ("fast") optimizer; every k steps the slow weights move toward the fast
 weights by alpha and the fast weights are reset to them.
 """
+# tpu_lint: allow-file(id-keyed-cache) — _slow keys by id(p); the inner
+# optimizer's _parameter_list retains every keyed Parameter for this
+# wrapper's life, so ids cannot recycle under the cache
 from __future__ import annotations
 
 

@@ -7,6 +7,9 @@ accumulate counters; the evaluation weights are
 (sum_1+sum_2+sum_3) / (num_accumulates + old_num_accumulates).
 ``apply()`` swaps averaged weights in, ``restore()`` swaps them back.
 """
+# tpu_lint: allow-file(id-keyed-cache) — _slots keys by id(p); self._params
+# retains every keyed Parameter for this optimizer's life, so ids cannot
+# recycle under the cache
 from __future__ import annotations
 
 import contextlib

@@ -78,6 +78,11 @@ class Optimizer:
         if isinstance(weight_decay, float):
             weight_decay = L2Decay(weight_decay)
         self._weight_decay = weight_decay
+        # tpu_lint: allow-file(id-keyed-cache) — _accumulators keys by
+        # id(p), which is safe here because self._parameter_list (or the
+        # per-step pgs) retains every keyed Parameter for the life of
+        # this optimizer: a key's id can never be recycled while its
+        # entry is reachable
         self._accumulators: Dict[int, dict] = {}
 
     # -- lr ------------------------------------------------------------------

@@ -221,6 +221,10 @@ class Profiler:
                   f"prefills={sc['prefills']} "
                   f"decode_steps={sc['decode_steps']} "
                   f"peak_queue={sc['peak_queue_depth']}")
+        from ..analysis import findings_summary
+        fs = findings_summary()
+        if fs:
+            print(f"tpu_lint: {fs}")
         if self.timer_only:
             return
         try:

@@ -244,7 +244,7 @@ class Engine:
     def __init__(self, model, n_slots=8, max_len=None, *, do_sample=False,
                  top_k=0, top_p=None, eos_token_id=None,
                  min_prompt_bucket=8, token_budget=None, max_queue=None,
-                 base_seed=0, donate=None):
+                 base_seed=0, donate=None, compile_budget=None):
         self._w, self._hp, geo = _make_arch(model)
         self.n_slots = int(n_slots)
         self.max_len = int(max_len if max_len is not None
@@ -275,6 +275,13 @@ class Engine:
             donate = jax.default_backend() != "cpu"
         self._prefill = _PREFILL_DONATED if donate else _PREFILL
         self._decode = _DECODE_DONATED if donate else _DECODE
+        # compile ledger: which prefill bucket lengths this engine has
+        # actually traced (each is one XLA program; + 1 fused decode).
+        # ``compile_budget`` is the declared cap the compile-budget lint
+        # rule (paddle_tpu.analysis) gates on — None means unbudgeted.
+        self.buckets_seen = set()
+        self.compile_budget = (None if compile_budget is None
+                               else int(compile_budget))
 
     # -- request intake ---------------------------------------------------
 
@@ -339,6 +346,7 @@ class Engine:
         self._by_slot[slot] = h
         self._temps[slot] = h.temperature
         Lb = self._bucket(h.n_prompt)
+        self.buckets_seen.add(Lb)
         ids = np.zeros((1, Lb), np.int32)
         ids[0, :h.n_prompt] = h.prompt_ids
         out = self._prefill(
@@ -413,4 +421,6 @@ class Engine:
                 "n_slots": self.n_slots, "max_len": self.max_len,
                 "active": self.cache.n_active,
                 "queue_depth": self.scheduler.queue_depth,
-                "kv_cache_bytes": self.cache.nbytes()}
+                "kv_cache_bytes": self.cache.nbytes(),
+                "prefill_buckets": sorted(self.buckets_seen),
+                "compile_budget": self.compile_budget}

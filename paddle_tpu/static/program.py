@@ -509,6 +509,7 @@ class _JitSegment:
                 vals.append(spec[1]._data)
             else:
                 _, opt, p, key_ = spec
+                # tpu_lint: allow(id-keyed-cache) — spec retains p
                 vals.append(opt._accumulators[id(p)][key_])
         return vals
 
@@ -536,6 +537,7 @@ class _JitSegment:
                 spec[1]._node = None
             else:
                 _, opt, p, key_ = spec
+                # tpu_lint: allow(id-keyed-cache) — spec retains p
                 opt._accumulators[id(p)][key_] = v
 
 
@@ -751,9 +753,11 @@ def _compile_segment(prog, entries, feed_names, raw_feed, fetch_tensors,
             if id(p) not in param_slot:
                 param_slot[id(p)] = len(state_specs)
                 state_specs.append(("param", p))
+            # tpu_lint: allow(id-keyed-cache) — state_specs retains p
             st = opt._accumulators.get(id(p))
             if st is None:
                 st = opt.init_param_state(p._data)
+                # tpu_lint: allow(id-keyed-cache) — state_specs retains p
                 opt._accumulators[id(p)] = st
             for key_ in sorted(st):
                 sk = (id(opt), id(p), key_)
@@ -888,6 +892,7 @@ def _compile_segment(prog, entries, feed_names, raw_feed, fetch_tensors,
         if spec[0] == "param":
             state_probe.append(spec[1]._data)
         else:
+            # tpu_lint: allow(id-keyed-cache) — spec retains the param
             state_probe.append(spec[1]._accumulators[id(spec[2])][spec[3]])
     ext_probe = []
     for kind, ref in ext_order:
@@ -1691,15 +1696,18 @@ class ExponentialMovingAverage:
         # bias-corrected decay as in the reference (min with (1+t)/(10+t))
         d = min(self._decay, (1.0 + self._step) / (10.0 + self._step))
         for p in self._params:
+            # tpu_lint: allow(id-keyed-cache) — self._params retains p
             prev = self._ema.get(id(p))
-            self._ema[id(p)] = p._data if prev is None \
+            new = p._data if prev is None \
                 else d * prev + (1.0 - d) * p._data
+            self._ema[id(p)] = new  # tpu_lint: allow(id-keyed-cache)
 
     @contextlib.contextmanager
     def apply(self, executor=None, need_restore=True):
         self._backup = [(p, p._data) for p in (self._params or [])]
         for p in (self._params or []):
             if id(p) in self._ema:
+                # tpu_lint: allow(id-keyed-cache) — _params retains p
                 p._data = self._ema[id(p)]
         try:
             yield

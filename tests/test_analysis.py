@@ -1,0 +1,491 @@
+"""tpu_lint (paddle_tpu.analysis): one synthesized-violation positive
+and one clean negative per program/AST rule, the satellite regressions
+(blacklist reasons, engine compile ledger, allow annotations), and the
+e2e audits the acceptance criteria name — resnet18 channels-last, the
+PR-1 compiled train plan, a 2-bucket serving Engine — each of which must
+report ZERO high-severity findings, while seeded violations are caught
+by the matching rule id. The in-process ``tpu_lint --self
+--fail-on=high`` gate runs here too, so the self-lint is enforced from
+this PR forward.
+"""
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+
+F32 = np.float32
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules_of(report):
+    return set(report.rule_ids())
+
+
+# ---------------------------------------------------------------------------
+# program rules: positives and negatives
+# ---------------------------------------------------------------------------
+
+class TestInteriorTranspose:
+    def test_positive_interior_sandwich(self):
+        def bad(x):
+            y = jnp.tanh(x)                      # pre-compute
+            y = jnp.transpose(y, (0, 2, 3, 1))   # interior
+            y = y * 2.0
+            return jnp.transpose(y, (0, 3, 1, 2)) + 1.0  # interior
+
+        r = analysis.audit(bad, np.ones((1, 3, 4, 4), F32))
+        hits = r.by_rule("interior-transpose")
+        assert hits and all(f.severity == "high" for f in hits)
+        assert r.metrics["interior-transpose"]["interior"] == 2
+
+    def test_negative_boundary_only(self):
+        def entry(x):
+            return jnp.tanh(jnp.transpose(x, (0, 2, 3, 1)))
+
+        r = analysis.audit(entry, np.ones((1, 3, 4, 4), F32))
+        assert not r.by_rule("interior-transpose")
+        assert r.metrics["interior-transpose"]["boundary"] >= 1
+        assert r.metrics["interior-transpose"]["interior"] == 0
+
+
+class TestDtypePromotion:
+    F64_MODULE = """\
+module @seeded {
+  func.func public @main(%arg0: tensor<4xf32>) -> (tensor<4xf64>) {
+    %0 = stablehlo.convert %arg0 : (tensor<4xf32>) -> tensor<4xf64>
+    return %0 : tensor<4xf64>
+  }
+}
+"""
+
+    def test_positive_fp64_constant(self):
+        r = analysis.audit_stablehlo(self.F64_MODULE, name="seeded_f64")
+        hits = r.by_rule("dtype-promotion")
+        assert hits and hits[0].severity == "high"
+        assert "fp64" in hits[0].message
+
+    def test_positive_bf16_accumulation(self):
+        def bfdot(a, b):
+            return jnp.dot(a.astype(jnp.bfloat16),
+                           b.astype(jnp.bfloat16))
+
+        r = analysis.audit(bfdot, np.ones((8, 128), F32),
+                           np.ones((128, 128), F32))
+        hits = r.by_rule("dtype-promotion")
+        assert hits and any("bf16 dot" in f.message for f in hits)
+
+    def test_negative_fp32_accumulation(self):
+        def good(a, b):
+            return jax.lax.dot_general(
+                a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        r = analysis.audit(good, np.ones((8, 128), F32),
+                           np.ones((128, 128), F32))
+        assert not [f for f in r.by_rule("dtype-promotion")
+                    if "bf16" in f.message]
+
+
+class TestHostCallback:
+    def test_positive_pure_callback(self):
+        def host(x):
+            return np.asarray(x) * 2
+
+        def f(x):
+            return jax.pure_callback(
+                host, jax.ShapeDtypeStruct(x.shape, x.dtype), x) + 1.0
+
+        r = analysis.audit(f, np.ones((4,), F32))
+        hits = r.by_rule("host-callback")
+        assert hits and hits[0].severity == "high"
+        assert "round-trip" in hits[0].message
+
+    def test_negative_pure_program(self):
+        r = analysis.audit(lambda x: jnp.tanh(x) + 1.0,
+                           np.ones((4,), F32))
+        assert not r.by_rule("host-callback")
+        assert r.metrics["host-callback"]["sites"] == 0
+
+
+class TestDonation:
+    BIG = np.ones((640, 640), F32)   # > 1 MiB
+
+    def _upd(self, p, g):
+        return p - 0.1 * g
+
+    def test_positive_large_undonated_param(self):
+        r = analysis.audit(self._upd, self.BIG, self.BIG.copy())
+        hits = r.by_rule("donation")
+        # exactly the aliasable buffer (p), not the gradient
+        assert len(hits) == 1 and hits[0].severity == "medium"
+        assert "not donated" in hits[0].message
+
+    def test_negative_donated(self):
+        r = analysis.audit(self._upd, self.BIG, self.BIG.copy(),
+                           donate_argnums=(0,))
+        assert not r.by_rule("donation")
+        assert r.metrics["donation"]["donated"] == 1
+
+    def test_positive_donated_but_aliased(self):
+        r = analysis.audit(self._upd, self.BIG, self.BIG,
+                           donate_argnums=(0,))
+        assert any(f.severity == "high" and "aliased" in f.message
+                   for f in r.by_rule("donation"))
+
+
+class TestRetraceRisk:
+    def test_positive_unhashable_static(self):
+        r = analysis.audit(lambda x, cfg: x * 1.0,
+                           np.ones((4,), F32), bytearray(b"cfg"))
+        hits = r.by_rule("retrace-risk")
+        assert hits and "bytearray" in hits[0].message
+
+    def test_negative_clean_args(self):
+        r = analysis.audit(lambda x, s: x * s, np.ones((4,), F32), 2.0)
+        assert not r.by_rule("retrace-risk")
+
+    def test_dispatch_blacklist_reason_surfaced(self):
+        """Satellite: a failed first trace records WHY the op was
+        blacklisted, and the retrace-risk rule reports it."""
+        from paddle_tpu.framework import dispatch_cache as dc
+        from paddle_tpu.tensor import apply
+
+        prev = dc.set_warmup(1)
+        try:
+            def value_branch(a):
+                if float(np.asarray(a).sum()) > 0:  # concretizes
+                    return a * 2.0
+                return a * -2.0
+
+            x = paddle.to_tensor(np.ones((2, 2), F32))
+            for _ in range(3):
+                apply(value_branch, x)
+        finally:
+            dc.set_warmup(prev)
+        stats = dc.dispatch_stats()
+        entry = next((b for b in stats["blacklist"]
+                      if "value_branch" in b["op"]), None)
+        assert entry is not None, stats["blacklist"]
+        assert "trace failed" in entry["reason"]
+        assert "Error" in entry["reason"]  # exception type recorded
+        rep = analysis.audit_dispatch()
+        assert any("value_branch" in f.message and "blacklisted"
+                   in f.message for f in rep.by_rule("retrace-risk"))
+
+
+class TestPaddingWaste:
+    def test_positive_misaligned_dot(self):
+        r = analysis.audit(lambda a, b: jnp.dot(a, b),
+                           np.ones((4, 13), F32), np.ones((13, 7), F32))
+        hits = r.by_rule("padding-waste")
+        assert hits and all(f.severity in ("low", "medium")
+                            for f in hits)
+
+    def test_negative_aligned_dot(self):
+        r = analysis.audit(lambda a, b: jnp.dot(a, b),
+                           np.ones((8, 128), F32),
+                           np.ones((128, 128), F32))
+        assert not r.by_rule("padding-waste")
+
+
+# ---------------------------------------------------------------------------
+# serving engine audit (compile-budget + geometry) — shared tiny engine
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    import dataclasses
+
+    from paddle_tpu.serving import Engine
+    from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+    cfg = dataclasses.replace(LLAMA_TINY, dtype="float32",
+                              num_hidden_layers=2)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    engine = Engine(model, n_slots=2, max_len=32, min_prompt_bucket=8,
+                    compile_budget=3)
+    for n in (5, 12):   # 2 power-of-two buckets: 8 and 16
+        engine.submit(rng.integers(0, cfg.vocab_size, (n,))
+                      .astype(np.int32), max_new_tokens=2)
+    engine.drain()
+    return engine
+
+
+class TestEngineAudit:
+    def test_compile_ledger_tracks_buckets(self, tiny_engine):
+        assert tiny_engine.buckets_seen == {8, 16}
+        assert tiny_engine.stats()["prefill_buckets"] == [8, 16]
+        assert tiny_engine.stats()["compile_budget"] == 3
+
+    def test_clean_engine_zero_high(self, tiny_engine):
+        r = analysis.audit_engine(tiny_engine)
+        assert r.ok("high"), [str(f) for f in r.findings]
+        assert r.metrics["compile-budget"]["programs"] == 3
+
+    def test_seeded_over_budget_caught(self, tiny_engine):
+        """A 3-program workload against a declared budget of 2 is
+        caught by the compile-budget rule id."""
+        r = analysis.audit_engine(tiny_engine, compile_budget=2,
+                                  lower_decode=False)
+        hits = r.by_rule("compile-budget")
+        assert hits and hits[0].severity == "high"
+        assert "exceeds the declared budget" in hits[0].message
+
+    def test_seeded_third_bucket_over_declared_budget(self, tiny_engine):
+        """Acceptance: a 3-bucket compile over the engine's own declared
+        budget (3 = 2 prefill buckets + decode) is caught. Runs LAST in
+        this class: it dirties the shared engine's bucket ledger."""
+        rng = np.random.default_rng(1)
+        tiny_engine.submit(
+            rng.integers(0, 1024, (20,)).astype(np.int32),  # bucket 32
+            max_new_tokens=2)
+        tiny_engine.drain()
+        assert tiny_engine.buckets_seen == {8, 16, 32}
+        r = analysis.audit_engine(tiny_engine, lower_decode=False)
+        hits = r.by_rule("compile-budget")
+        assert hits and hits[0].severity == "high"
+        assert "4 XLA programs" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# AST rules
+# ---------------------------------------------------------------------------
+
+def _lint_src(tmp_path, src, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return analysis.selflint([str(p)])
+
+
+class TestAstRules:
+    def test_id_keyed_cache_positive(self, tmp_path):
+        src = ("class C:\n"
+               "    def put(self, p, v):\n"
+               "        self._slots[id(p)] = v\n"
+               "    def get(self, p):\n"
+               "        return self._slots.get(id(p))\n")
+        r = _lint_src(tmp_path, src)
+        assert len(r.by_rule("id-keyed-cache")) == 2
+        assert all(f.severity == "high"
+                   for f in r.by_rule("id-keyed-cache"))
+
+    def test_id_keyed_cache_negative_transient_local(self, tmp_path):
+        src = ("def walk(items):\n"
+               "    seen = set()\n"
+               "    for x in items:\n"
+               "        seen.add(id(x))\n"   # local traversal: fine
+               "    return seen\n")
+        r = _lint_src(tmp_path, src)
+        assert not r.by_rule("id-keyed-cache")
+
+    def test_allow_annotation_suppresses(self, tmp_path):
+        src = ("class C:\n"
+               "    def put(self, p, v):\n"
+               "        # tpu_lint: allow(id-keyed-cache) — p retained\n"
+               "        self._slots[id(p)] = v\n")
+        r = _lint_src(tmp_path, src)
+        assert not r.by_rule("id-keyed-cache")
+
+    def test_numpy_in_traced_positive(self, tmp_path):
+        src = ("import jax\n"
+               "import numpy as np\n"
+               "@jax.jit\n"
+               "def f(x):\n"
+               "    return np.sum(x)\n")
+        r = _lint_src(tmp_path, src)
+        assert r.by_rule("numpy-in-traced")
+
+    def test_numpy_in_traced_negatives(self, tmp_path):
+        src = ("import jax\n"
+               "import numpy as np\n"
+               "@jax.jit\n"
+               "def f(x):\n"
+               "    scale = np.sqrt(2.0)\n"   # host constant math: fine
+               "    return x * scale\n"
+               "def g(x):\n"
+               "    return np.sum(x)\n")      # not traced: fine
+        r = _lint_src(tmp_path, src)
+        assert not r.by_rule("numpy-in-traced")
+
+    def test_silent_except_positive_negative(self, tmp_path):
+        src = ("def f():\n"
+               "    try:\n"
+               "        risky()\n"
+               "    except Exception:\n"
+               "        return None\n"         # swallowed, no reason
+               "def g():\n"
+               "    try:\n"
+               "        risky()\n"
+               "    except Exception as e:\n"
+               "        record(f'{type(e).__name__}: {e}')\n"
+               "        return None\n")
+        r = _lint_src(tmp_path, src)
+        hits = r.by_rule("silent-except")
+        assert len(hits) == 1 and "f" not in hits[0].location.split(":")
+
+    def test_fp64_ast_positive_and_allow_file(self, tmp_path):
+        bad = "import numpy as np\nX = np.float64(3.0)\n"
+        r = _lint_src(tmp_path, bad)
+        assert r.by_rule("dtype-promotion")
+        allowed = ("# tpu_lint: allow-file(dtype-promotion)\n" + bad)
+        r2 = _lint_src(tmp_path, allowed, name="mod2.py")
+        assert not r2.by_rule("dtype-promotion")
+
+
+# ---------------------------------------------------------------------------
+# e2e audits (acceptance criteria) + legacy-checker parity
+# ---------------------------------------------------------------------------
+
+class TestEndToEnd:
+    def test_resnet18_channels_last_zero_high(self):
+        """Acceptance (a): jitted channels-last resnet18 — 0 high
+        findings, and the rule's transpose counts agree with the legacy
+        counter (framework.count_hlo_transposes)."""
+        from paddle_tpu.framework import (count_hlo_transposes,
+                                          to_channels_last)
+        from paddle_tpu.vision.models import resnet18
+
+        paddle.seed(0)
+        cl = to_channels_last(resnet18(num_classes=10).eval())
+        x = paddle.to_tensor(np.ones((1, 3, 16, 16), F32))
+        r = analysis.audit_model(cl, x)
+        assert r.ok("high"), [str(f) for f in r.findings]
+        m = r.metrics["interior-transpose"]
+        assert m["interior"] == 0 and m["boundary"] == 1
+        assert m["total"] == count_hlo_transposes(cl, x)
+
+    def test_seeded_interior_transpose_in_model_caught(self):
+        """Acceptance: an injected interior transpose is caught by the
+        matching rule id on the same audit path."""
+        from paddle_tpu import nn
+
+        class Sandwich(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(3, 3, 1)
+
+            def forward(self, x):
+                y = self.conv(x)
+                y = paddle.transpose(y, [0, 2, 3, 1])  # interior
+                y = paddle.nn.functional.relu(y)
+                return paddle.transpose(y, [0, 3, 1, 2]).mean()
+
+        paddle.seed(0)
+        r = analysis.audit_model(Sandwich(),
+                                 paddle.to_tensor(np.ones((1, 3, 4, 4),
+                                                          F32)))
+        assert r.by_rule("interior-transpose")
+
+    def test_static_train_plan_zero_high(self):
+        """Acceptance (b): the PR-1 whole-program train plan — donated
+        state, no host splits, 0 high findings."""
+        from paddle_tpu import nn, static
+        from paddle_tpu import optimizer as optim
+
+        paddle.seed(0)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            yt = static.data("y", [None, 1], "float32")
+            layer = nn.Linear(4, 8)
+            head = nn.Linear(8, 1)
+            loss = ((head(paddle.nn.functional.relu(layer(x))) - yt)
+                    ** 2).mean()
+            opt = optim.Adam(
+                learning_rate=0.05,
+                parameters=layer.parameters() + head.parameters())
+            opt.minimize(loss)
+        exe = static.Executor()
+        rng = np.random.default_rng(0)
+        xs = rng.normal(size=(16, 4)).astype(F32)
+        ys = rng.normal(size=(16, 1)).astype(F32)
+        for _ in range(3):
+            exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        r = analysis.audit_plan(main, name="train")
+        assert r.ok("high"), [str(f) for f in r.findings]
+        assert not r.by_rule("host-callback")
+
+    def test_py_func_plan_split_caught(self):
+        """A py_func host entry in the program is named by the
+        host-callback rule on the plan audit."""
+        from paddle_tpu import static
+
+        seen = []
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 2], "float32")
+            h = x * 2.0
+            out_holder = paddle.Tensor(np.zeros((1,), F32))
+            static.py_func(lambda t: (seen.append(1),
+                                      np.asarray(t._data).sum())[1],
+                           h, out_holder)
+            y = h + 1.0
+        exe = static.Executor()
+        for _ in range(3):
+            exe.run(main, feed={"x": np.ones((2, 2), F32)},
+                    fetch_list=[y])
+        r = analysis.audit_plan(main, name="pyfunc")
+        hits = r.by_rule("host-callback")
+        assert hits and hits[0].severity == "high"
+        assert "splits the compiled plan" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# CLI + self-lint gate + profiler wiring
+# ---------------------------------------------------------------------------
+
+def _tpu_lint_main():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_lint", os.path.join(REPO, "tools", "tpu_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+class TestCliAndGate:
+    def test_selflint_gate_zero_high(self):
+        """Satellite: `tpu_lint --self --fail-on=high` passes — the
+        self-lint is enforced from this PR forward."""
+        rc = _tpu_lint_main()(["--self", "--fail-on=high", "--json"])
+        assert rc == 0
+
+    def test_selflint_report_clean_at_high(self):
+        r = analysis.selflint([os.path.join(REPO, "paddle_tpu")])
+        assert r.counts()["high"] == 0, \
+            [str(f) for f in r.findings if f.severity == "high"]
+        assert r.metrics["selflint"]["files"] > 100
+
+    def test_allowlist_file_filters(self, tmp_path):
+        src = "import numpy as np\nX = np.float64(3.0)\n"
+        p = tmp_path / "m.py"
+        p.write_text(src)
+        allow = tmp_path / "allow.txt"
+        allow.write_text("# third-party shim\ndtype-promotion %s\n" % p)
+        rc = _tpu_lint_main()([str(p), "--fail-on=medium",
+                               "--allowlist", str(allow)])
+        assert rc == 0
+        rc2 = _tpu_lint_main()([str(p), "--fail-on=medium"])
+        assert rc2 == 1
+
+    def test_profiler_summary_carries_findings_line(self, capsys):
+        from paddle_tpu import profiler
+
+        analysis.audit(lambda x: x + 1.0, np.ones((2,), F32))
+        assert isinstance(analysis.findings_summary(), str)
+        p = profiler.Profiler(timer_only=True)
+        p.start()
+        p.step()
+        p.stop()
+        p.summary()
+        out = capsys.readouterr().out
+        assert "tpu_lint:" in out

@@ -2,9 +2,10 @@
 """HLO layout lint: the channels-last plan must emit ZERO interior
 layout transposes.
 
-Lowers the jitted resnet18 forward on CPU and counts transpose ops in
-the emitted StableHLO (the ops THIS framework inserted — backend layout
-assignment is the compiler's business and is reported separately):
+Thin CLI over ``paddle_tpu.analysis`` (the ``interior-transpose`` rule):
+lowers the jitted resnet18 forward on CPU and reads the shared StableHLO
+parse's transpose counts (the ops THIS framework inserted — backend
+layout assignment is the compiler's business and is reported separately):
 
 * bare converted model on NHWC input  -> budget 0   (interior)
 * ChannelsLast wrapper on NCHW input  -> budget 1   (the entry boundary;
@@ -39,6 +40,7 @@ def main():
     import numpy as np
 
     import paddle_tpu as paddle
+    from paddle_tpu import analysis
     from paddle_tpu.framework import count_hlo_transposes, to_channels_last
     from paddle_tpu.vision.models import resnet18
 
@@ -53,17 +55,28 @@ def main():
     paddle.seed(0)
     cl = to_channels_last(resnet18(num_classes=10).eval())
 
+    def total(model, inp):
+        rep = analysis.audit_model(model, inp,
+                                   rules=("interior-transpose",))
+        return rep.metrics["interior-transpose"]["total"], rep
+
+    interior_total, rep_interior = total(cl.model, xn)
+    boundary_total, rep_boundary = total(cl, x)
+    nchw_total, _ = total(nchw, x)
     counts = {
-        "interior_stablehlo": count_hlo_transposes(cl.model, xn),
-        "boundary_stablehlo": count_hlo_transposes(cl, x),
-        "nchw_stablehlo": count_hlo_transposes(nchw, x),
+        "interior_stablehlo": interior_total,
+        "boundary_stablehlo": boundary_total,
+        "nchw_stablehlo": nchw_total,
         # compiled counts are backend evidence, not linted: XLA:CPU
         # inserts per-conv weight relayouts either way
         "nchw_compiled": count_hlo_transposes(nchw, x, optimized=True),
         "channels_last_compiled": count_hlo_transposes(cl, x, optimized=True),
     }
+    # the rule's boundary/interior split must agree with the budgets:
+    # the wrapper's one transpose is a boundary, never an interior
     ok = (counts["interior_stablehlo"] <= INTERIOR_BUDGET
-          and counts["boundary_stablehlo"] <= BOUNDARY_BUDGET)
+          and counts["boundary_stablehlo"] <= BOUNDARY_BUDGET
+          and rep_interior.ok("high") and rep_boundary.ok("high"))
     record = {"bench": "hlo_layout_lint", "model": "resnet18",
               "budgets": {"interior": INTERIOR_BUDGET,
                           "boundary": BOUNDARY_BUDGET},

@@ -35,22 +35,12 @@ def main():
 
     import numpy as np
 
-    import jax
     import paddle_tpu as paddle
+    from paddle_tpu import analysis
     from paddle_tpu.framework import dispatch_cache
 
-    compile_events = [0]
-
-    def on_event(event, *a, **k):
-        if "compil" in event.lower():
-            compile_events[0] += 1
-
-    try:
-        from jax._src import monitoring
-        monitoring.register_event_listener(on_event)
-        have_monitor = True
-    except Exception:
-        have_monitor = False
+    counter = analysis.CompileEventCounter().install()
+    have_monitor = counter.available
 
     paddle.seed(0)
     rng = np.random.default_rng(0)
@@ -72,7 +62,7 @@ def main():
         step()
 
     warm = dispatch_cache.dispatch_stats()
-    compile_events[0] = 0
+    counter.reset()
     for _ in range(args.steps):
         loss = step()
     float(loss.numpy())
@@ -82,14 +72,17 @@ def main():
              for k in ("hits", "misses", "compiles", "bypasses")}
     traces = delta["misses"] + delta["compiles"] + delta["bypasses"]
     if have_monitor:
-        traces += compile_events[0]
+        traces += counter.count
     ok = stats["enabled"] and traces == 0 and delta["hits"] > 0
 
+    # retrace-risk findings (blacklisted/megamorphic ops, with reasons)
+    # ride along in the ledger; the exit code stays the trace count's
+    findings = [f.to_dict() for f in analysis.audit_dispatch().findings]
     record = {"bench": "retrace_lint", "model": "mlp_adam",
               "warmup": args.warmup, "steps": args.steps,
               "steady_state_traces": traces, "delta": delta,
-              "backend_compiles": compile_events[0] if have_monitor else None,
-              "cache": stats, "ok": ok}
+              "backend_compiles": counter.count if have_monitor else None,
+              "cache": stats, "findings": findings, "ok": ok}
     if args.json:
         print(json.dumps(record))
     else:

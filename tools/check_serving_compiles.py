@@ -39,21 +39,12 @@ def main():
     import numpy as np
 
     import paddle_tpu as paddle
+    from paddle_tpu import analysis
     from paddle_tpu.serving import Engine
     from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
 
-    compile_events = [0]
-
-    def on_event(event, *a, **k):
-        if "compil" in event.lower():
-            compile_events[0] += 1
-
-    try:
-        from jax._src import monitoring
-        monitoring.register_event_listener(on_event)
-        have_monitor = True
-    except Exception:
-        have_monitor = False
+    counter = analysis.CompileEventCounter().install()
+    have_monitor = counter.available
 
     cfg = dataclasses.replace(LLAMA_TINY, dtype="float32",
                               num_hidden_layers=2)
@@ -94,16 +85,16 @@ def main():
         return handles
 
     engine = Engine(model, n_slots=args.slots, max_len=64,
-                    min_prompt_bucket=min_bucket)
+                    min_prompt_bucket=min_bucket, compile_budget=budget)
     # engine construction (weight stacking) compiles host-side stacks;
     # the serving budget is about the REQUEST WORKLOAD only
-    compile_events[0] = 0
+    counter.reset()
     handles = drive(engine)
-    cold_compiles = compile_events[0]
+    cold_compiles = counter.count
 
-    compile_events[0] = 0
+    counter.reset()
     handles2 = drive(engine)
-    warm_compiles = compile_events[0]
+    warm_compiles = counter.count
 
     mismatches = []
     for run in (handles, handles2):
@@ -119,6 +110,10 @@ def main():
         and not mismatches \
         and engine.metrics.requests_completed == 2 * args.requests
 
+    # the static audit of the same engine rides along in the ledger
+    # (compile-budget / padding / donation rules); exit code unchanged
+    findings = [f.to_dict()
+                for f in analysis.audit_engine(engine).findings]
     record = {
         "bench": "serving_compile_lint",
         "requests": args.requests, "slots": args.slots,
@@ -126,7 +121,7 @@ def main():
         "cold_compiles": cold_compiles if have_monitor else None,
         "warm_compiles": warm_compiles if have_monitor else None,
         "greedy_mismatches": mismatches,
-        "engine": engine.stats(), "ok": ok,
+        "engine": engine.stats(), "findings": findings, "ok": ok,
     }
     if args.json:
         print(json.dumps(record))
