@@ -52,6 +52,7 @@ from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
 from . import reader  # noqa: F401
 from . import regularizer  # noqa: F401
+from . import resilience  # noqa: F401
 from . import serving  # noqa: F401
 from . import signal  # noqa: F401
 from . import sparse  # noqa: F401

@@ -126,12 +126,16 @@ _add_accessors()
 
 
 def _scaler_state_dict(self):
+    # found_inf/unscaled make the dict complete even when snapshotted
+    # between unscale_() and update() (the resilience supervisor's
+    # guard capture can land there); at step boundaries both are False
     return {"scale": self._scale, "incr_ratio": self._incr_ratio,
             "decr_ratio": self._decr_ratio,
             "incr_every_n_steps": self._incr_every,
             "decr_every_n_nan_or_inf": self._decr_every,
             "good_steps": self._good_steps, "bad_steps": self._bad_steps,
-            "use_dynamic_loss_scaling": self._dynamic}
+            "use_dynamic_loss_scaling": self._dynamic,
+            "found_inf": self._found_inf, "unscaled": self._unscaled}
 
 
 def _scaler_load_state_dict(self, state):
@@ -146,6 +150,8 @@ def _scaler_load_state_dict(self, state):
     self._bad_steps = int(state.get("bad_steps", self._bad_steps))
     self._dynamic = bool(state.get("use_dynamic_loss_scaling",
                                    self._dynamic))
+    self._found_inf = bool(state.get("found_inf", False))
+    self._unscaled = bool(state.get("unscaled", False))
 
 
 # replaces the class's minimal {scale, good_steps, bad_steps} dict with

@@ -60,6 +60,8 @@ numpy-in-traced       medium    np.* on traced values inside jitted/lax
                                 bodies
 silent-except         medium    blanket ``except Exception`` that neither
                                 re-raises nor records why
+non-atomic-write      medium    open-write-close without tmp+rename in
+                                checkpoint-path modules (torn durable state)
 dtype-promotion       medium    np.float64 constant math in library code
 ====================  ========  =============================================
 

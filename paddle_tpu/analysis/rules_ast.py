@@ -262,7 +262,93 @@ def _silent_except(sf):
             yield f
 
 
-# -- 4. fp64 constant math in library code (AST facet of dtype-promotion) ----
+# -- 4. non-atomic writes in checkpoint-path modules -------------------------
+
+# modules on a durability-critical path: a torn write here is a lost
+# training run, so every publish must be tmp-write + rename
+_DURABLE_PATH_HINTS = (
+    "distributed/checkpoint", "distributed/elastic", "framework/io",
+    "incubate/auto_checkpoint", "incubate/checkpoint", "resilience/",
+)
+
+_RENAME_CALLS = {"rename", "replace", "move", "renames"}
+
+
+def _encl_funcs(tree):
+    """node -> innermost enclosing FunctionDef (or None: module level)."""
+    owner = {}
+
+    def walk(node, fn):
+        for child in ast.iter_child_nodes(node):
+            nxt = fn
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nxt = child
+            owner[child] = nxt
+            walk(child, nxt)
+
+    walk(tree, None)
+    return owner
+
+
+def _mentions_tmp(node):
+    """The opened filename is visibly a temp (literal containing 'tmp',
+    or a variable named like one) — the write IS the safe half of a
+    tmp+rename pair or scratch output."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and "tmp" in sub.value.lower():
+            return True
+        if isinstance(sub, ast.Name) and "tmp" in sub.id.lower():
+            return True
+    return False
+
+
+@rule("non-atomic-write", kind="ast", severity="medium",
+      title="open-write-close without tmp+rename in a checkpoint-path "
+            "module — a kill mid-write leaves a torn file where durable "
+            "state should be")
+def _non_atomic_write(sf):
+    if sf.tree is None:
+        return
+    path = sf.path.replace("\\", "/")
+    if not any(h in path for h in _DURABLE_PATH_HINTS):
+        return
+    owner = _encl_funcs(sf.tree)
+    renaming_funcs = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) \
+                and _call_name(node) in _RENAME_CALLS:
+            fn = owner.get(node)
+            if fn is not None:
+                renaming_funcs.add(fn)
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "open" and len(node.args) >= 2):
+            continue
+        mode = node.args[1]
+        if not (isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)
+                and mode.value.startswith("w")):
+            continue        # reads and appends can't tear existing state
+        if _mentions_tmp(node.args[0]):
+            continue
+        if owner.get(node) in renaming_funcs and owner.get(node) is not None:
+            continue        # the function publishes via rename
+        f = _finding(
+            sf, "non-atomic-write", "medium", node,
+            "checkpoint-path module writes a file in place "
+            "(open('w')/close with no tmp+rename in the function) — a "
+            "SIGKILL mid-write leaves a torn file that a restore may "
+            "load",
+            "write to '<path>.tmp' then os.replace(tmp, path); if the "
+            "file is genuinely disposable (heartbeat, scratch), annotate "
+            "with  # tpu_lint: allow(non-atomic-write)")
+        if f:
+            yield f
+
+
+# -- 5. fp64 constant math in library code (AST facet of dtype-promotion) ----
 
 @rule("dtype-promotion", kind="ast", severity="medium",
       title="np.float64 constant math in library code — fp64 results "

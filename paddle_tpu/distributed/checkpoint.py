@@ -9,12 +9,24 @@ unavailable.
 
 Accepts arbitrary pytrees (params, optimizer moments, scaler state, ...),
 with Tensor leaves unwrapped/rewrapped transparently.
+
+Durability contract (paddle_tpu.resilience depends on it): every
+``CheckpointManager`` step is written into a hidden temp dir, sealed
+with a ``COMMIT`` manifest of per-file sizes + CRC32 checksums, and then
+renamed into place — one atomic filesystem op. A SIGKILL at ANY instant
+therefore leaves either the previous committed steps untouched, or the
+new step fully committed; ``restore_latest`` verifies manifests and
+falls back past torn or corrupted steps instead of loading them.
 """
 from __future__ import annotations
 
+import json
 import os
 import re
 import shutil
+import threading
+import warnings
+import zlib
 from typing import Any, Optional
 
 import jax
@@ -123,18 +135,115 @@ def load_distributed(path, template=None):
     return out
 
 
+# -- atomic commit layer ------------------------------------------------------
+
+COMMIT_MARKER = "COMMIT"
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OverflowError):
+        return True     # exists (or unknowable): treat as live, don't sweep
+    return True
+
+
+def _crc32_file(path, chunk=1 << 20):
+    crc = 0
+    with open(path, "rb") as fh:
+        while True:
+            block = fh.read(chunk)
+            if not block:
+                break
+            crc = zlib.crc32(block, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _manifest(root):
+    """{relative file path: [size, crc32]} over every file under root
+    (excluding the COMMIT marker itself)."""
+    out = {}
+    for base, _dirs, names in os.walk(root):
+        for name in sorted(names):
+            if base == root and name == COMMIT_MARKER:
+                continue
+            full = os.path.join(base, name)
+            rel = os.path.relpath(full, root)
+            out[rel] = [os.path.getsize(full), _crc32_file(full)]
+    return out
+
+
+def write_commit_marker(root, step=None):
+    """Seal ``root``: record every file's size + CRC32 in a COMMIT
+    manifest, fsynced before it lands, so verify_commit can prove the
+    directory is neither torn nor bit-rotted."""
+    marker = {"step": step, "files": _manifest(root)}
+    path = os.path.join(root, COMMIT_MARKER)
+    # the marker is written inside a still-hidden .tmp-ckpt dir; the
+    # caller's dir rename IS the publish
+    # tpu_lint: allow(non-atomic-write)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps(marker))
+        fh.flush()
+        os.fsync(fh.fileno())
+    return path
+
+
+def verify_commit(root):
+    """(ok, reason): COMMIT marker present and every manifest entry
+    matches the bytes on disk."""
+    path = os.path.join(root, COMMIT_MARKER)
+    if not os.path.isfile(path):
+        return False, "missing COMMIT marker"
+    try:
+        with open(path, encoding="utf-8") as fh:
+            marker = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        return False, f"unreadable COMMIT marker ({type(e).__name__})"
+    for rel, (size, crc) in marker.get("files", {}).items():
+        full = os.path.join(root, rel)
+        if not os.path.isfile(full):
+            return False, f"missing shard {rel}"
+        if os.path.getsize(full) != size:
+            return False, f"truncated shard {rel}"
+        if _crc32_file(full) != crc:
+            return False, f"bad checksum on shard {rel}"
+    return True, "ok"
+
+
 class CheckpointManager:
     """Step-numbered checkpoints with retention (reference:
     incubate/checkpoint/auto_checkpoint.py train-epoch-range bookkeeping).
 
     save(step, state) writes <dir>/ckpt-<step> asynchronously and prunes to
     ``max_to_keep``; restore_latest() reloads the newest durable step.
+
+    Writes are atomic: state lands in a hidden ``.tmp-ckpt-*`` dir, a
+    COMMIT manifest (per-file size + CRC32) seals it, and one rename
+    publishes it. Async saves overlap training but serialize with each
+    other; retention prunes committed steps only and never the newest.
     """
 
     def __init__(self, directory, max_to_keep: int = 3):
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
         os.makedirs(self.directory, exist_ok=True)
+        self._inflight: Optional[threading.Thread] = None
+        self._inflight_error = None
+        # leftovers from a KILLED writer are dead on arrival (nobody can
+        # commit them) — but another live manager on this dir may still
+        # be writing its own tmp, so only sweep when the owning pid is
+        # gone
+        for name in os.listdir(self.directory):
+            if not name.startswith(".tmp-ckpt-"):
+                continue
+            m = re.fullmatch(r"\.tmp-ckpt-\d+-(\d+)", name)
+            if m and m.group(1) != str(os.getpid()) \
+                    and not _pid_alive(int(m.group(1))):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
 
     def _step_dirs(self):
         out = []
@@ -152,20 +261,108 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    def wait(self):
+        """Join the in-flight async save; re-raise its failure if any."""
+        t = self._inflight
+        if t is not None:
+            t.join()
+            self._inflight = None
+        if self._inflight_error is not None:
+            err, self._inflight_error = self._inflight_error, None
+            raise err
+
     def save(self, step: int, state: Any, async_save=True):
-        path = os.path.join(self.directory, f"ckpt-{step}")
-        save_distributed(state, path, async_save=async_save)
-        for s, p in self._step_dirs()[:-self.max_to_keep or None]:
-            if s != step and len(self.all_steps()) > self.max_to_keep:
-                shutil.rmtree(p, ignore_errors=True)
-        return path
+        """Write ckpt-<step>. With async_save the device-to-disk write and
+        the commit+rename run on a background thread (training continues);
+        call wait() — or the next save/latest_step-consumer — to join it.
+        """
+        self.wait()
+        step = int(step)
+        tmp = os.path.join(self.directory, f".tmp-ckpt-{step}-{os.getpid()}")
+        final = os.path.join(self.directory, f"ckpt-{step}")
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        save_distributed(state, os.path.join(tmp, "state"),
+                         async_save=async_save)
+
+        def finalize():
+            wait_for_checkpoints()          # join the orbax shard writers
+            write_commit_marker(tmp, step)
+            if os.path.isdir(final):        # re-save of the same step
+                shutil.rmtree(final)
+            os.rename(tmp, final)           # the atomic publish
+            self._prune(keep_step=step)
+
+        if async_save:
+            def runner():
+                try:
+                    finalize()
+                except Exception as e:      # surfaced on the next wait()
+                    self._inflight_error = e
+            t = threading.Thread(target=runner, daemon=True,
+                                 name=f"ckpt-commit-{step}")
+            self._inflight = t
+            t.start()
+        else:
+            finalize()
+        return final
+
+    def _prune(self, keep_step=None):
+        """Drop committed steps beyond max_to_keep, oldest first. The
+        newest committed step (and the one just written) are never
+        candidates, so a reader always finds an intact latest."""
+        dirs = self._step_dirs()
+        committed = [(s, p) for s, p in dirs
+                     if os.path.isfile(os.path.join(p, COMMIT_MARKER))]
+        excess = len(committed) - self.max_to_keep
+        for s, p in committed[:max(excess, 0)]:
+            if s == keep_step or (committed and s == committed[-1][0]):
+                continue
+            shutil.rmtree(p, ignore_errors=True)
 
     def restore(self, step: int, template=None):
+        root = os.path.join(self.directory, f"ckpt-{step}")
+        inner = os.path.join(root, "state")
+        # committed layout keeps the state under <step>/state; fall back
+        # to the pre-manifest layout where state WAS the step dir
         return load_distributed(
-            os.path.join(self.directory, f"ckpt-{step}"), template)
+            inner if os.path.exists(inner) else root, template)
 
     def restore_latest(self, template=None):
-        step = self.latest_step()
-        if step is None:
+        """(step, state) of the newest INTACT checkpoint: steps whose
+        COMMIT manifest is missing or fails verification are skipped
+        with a warning (torn write, bit rot) instead of raised on.
+        Directories from the pre-manifest format (no COMMIT anywhere)
+        load as before."""
+        try:
+            self.wait()
+        except Exception as e:
+            # a failed async save must not block restoring an older step
+            warnings.warn(f"in-flight save failed before restore: "
+                          f"{type(e).__name__}: {e}")
+        dirs = self._step_dirs()
+        if not dirs:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
-        return step, self.restore(step, template)
+        any_committed = any(
+            os.path.isfile(os.path.join(p, COMMIT_MARKER))
+            for _s, p in dirs)
+        skipped = []
+        for step, path in reversed(dirs):
+            if any_committed:
+                ok, reason = verify_commit(path)
+                if not ok:
+                    warnings.warn(
+                        f"skipping checkpoint step {step}: {reason}")
+                    skipped.append((step, reason))
+                    continue
+            try:
+                return step, self.restore(step, template)
+            except Exception as e:
+                warnings.warn(
+                    f"skipping checkpoint step {step}: restore failed "
+                    f"({type(e).__name__}: {e})")
+                skipped.append((step, f"{type(e).__name__}: {e}"))
+        raise FileNotFoundError(
+            f"no intact checkpoint under {self.directory} "
+            f"(skipped: {skipped})")

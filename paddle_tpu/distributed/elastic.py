@@ -45,6 +45,10 @@ class ElasticMembership:
         return self
 
     def heartbeat(self):
+        # a heartbeat is disposable: a torn write reads as a stale stamp
+        # and self-heals on the next beat; an extra rename per beat
+        # would just add metadata churn
+        # tpu_lint: allow(non-atomic-write)
         with open(self._path(self.node_id), "w") as fh:
             fh.write(str(time.time()))
 

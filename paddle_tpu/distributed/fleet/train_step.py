@@ -317,6 +317,39 @@ class CompiledTrainStep:
             # tpu_lint: allow(id-keyed-cache) — p retained by self._params
             self.optimizer._accumulators[id(p)] = self._opt_state[k]
 
+    # -- snapshot surface (resilience.TrainState / CheckpointManager) ------
+
+    def state_dict(self):
+        """The compiled step's canonical device state as one pytree —
+        params, optimizer moments, buffers and the in-graph loss-scaler
+        state. Leaves are (sharded) jax arrays; checkpointing them
+        through distributed.checkpoint preserves/reshapes shardings."""
+        return {"params": self._param_vals, "opt": self._opt_state,
+                "buffers": self._buffer_vals, "scaler": self._scaler_state}
+
+    def load_state_dict(self, state):
+        """Restore a state_dict(), re-committing every leaf to this
+        step's shardings (so a snapshot from a different mesh lands
+        correctly), and reflect params/buffers into the eager views."""
+        mesh = self._mesh
+
+        def put(tree, specs):
+            return jax.tree_util.tree_map(
+                lambda leaf, s: jax.device_put(
+                    jnp.asarray(leaf), NamedSharding(mesh, s)),
+                tree, specs)
+
+        self._param_vals = put(state["params"], self._param_specs)
+        self._opt_state = {k: put(state["opt"][k], self._opt_specs[k])
+                           for k in self._opt_state}
+        self._buffer_vals = put(state["buffers"], self._buffer_specs)
+        self._scaler_state = jax.tree_util.tree_map(
+            jnp.asarray, state["scaler"])
+        for k, p in self._params.items():
+            p._data = self._param_vals[k]
+        for k, b in self._buffers.items():
+            b._data = self._buffer_vals[k]
+
 
 def make_train_step(model, optimizer, loss_fn, strategy=None, amp_level=None,
                     amp_dtype="bfloat16", donate=True, accumulate_steps=None,

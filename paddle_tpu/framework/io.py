@@ -47,8 +47,14 @@ def save(obj, path, protocol=4, **configs):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
+    # atomic publish: a kill mid-pickle must never leave a torn state
+    # file where a previous good checkpoint used to be
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
         pickle.dump(_to_serializable(obj), f, protocol=protocol)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def load(path, **configs):

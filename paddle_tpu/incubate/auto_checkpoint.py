@@ -28,7 +28,11 @@ class _EpochRange:
             yield e
 
     def save(self, epoch, state):
-        self._mgr.save(epoch, state, async_save=True)
+        # synchronous: an epoch save must be COMMITted (tmp+manifest+
+        # rename) before it returns, so a fresh train_epoch_range — even
+        # in another process — resumes after it; epoch cadence makes the
+        # boundary latency negligible
+        self._mgr.save(epoch, state, async_save=False)
 
     def restore(self, template=None):
         step = self._mgr.latest_step()
@@ -90,8 +94,11 @@ class ExeTrainStatus(SerializableBase):
 
     def serialize(self, path):
         import json
-        with open(os.path.join(path, "exe_train_status.json"), "w") as f:
+        final = os.path.join(path, "exe_train_status.json")
+        tmp = final + ".tmp"   # atomic publish: status marks a checkpoint
+        with open(tmp, "w") as f:       # usable — it must never be torn
             json.dump({"epoch_no": self._epoch_no, "key": self._key}, f)
+        os.replace(tmp, final)
 
     def deserialize(self, path):
         import json

@@ -130,3 +130,14 @@ class DistributedBatchSampler(BatchSampler):
 
     def set_epoch(self, epoch):
         self.epoch = epoch
+
+    # the epoch fully determines this sampler's order (seeded shuffle),
+    # so it is the whole resumable position (resilience.ResumableLoader
+    # layers the intra-epoch batch cursor on top)
+    def state_dict(self):
+        return {"epoch": int(self.epoch)}
+
+    def set_state_dict(self, state):
+        self.set_epoch(int(state["epoch"]))
+
+    load_state_dict = set_state_dict

@@ -22,9 +22,10 @@ from .timer import Benchmark, benchmark  # noqa: F401
 
 __all__ = [
     "Benchmark", "benchmark", "dispatch_counters", "serving_counters",
-    "ProfilerState", "ProfilerTarget", "make_scheduler",
-    "export_chrome_tracing", "export_protobuf", "Profiler", "RecordEvent",
-    "RecordInstantEvent", "load_profiler_result", "SortedKeys",
+    "resilience_counters", "ProfilerState", "ProfilerTarget",
+    "make_scheduler", "export_chrome_tracing", "export_protobuf",
+    "Profiler", "RecordEvent", "RecordInstantEvent",
+    "load_profiler_result", "SortedKeys",
 ]
 
 
@@ -46,6 +47,15 @@ def serving_counters() -> dict:
     from ..serving import metrics as serving_metrics
 
     return serving_metrics.global_counters()
+
+
+def resilience_counters() -> dict:
+    """Aggregate flight-ledger event counts across every live
+    ``paddle_tpu.resilience`` ledger/supervisor (steps, anomalies,
+    saves, restores, rollbacks, aborts)."""
+    from ..resilience import ledger as resilience_ledger
+
+    return resilience_ledger.global_counters()
 
 
 class ProfilerState(Enum):
@@ -221,6 +231,16 @@ class Profiler:
                   f"prefills={sc['prefills']} "
                   f"decode_steps={sc['decode_steps']} "
                   f"peak_queue={sc['peak_queue_depth']}")
+        rc = resilience_counters()
+        if rc["ledgers"]:
+            print("resilience: "
+                  f"ledgers={rc['ledgers']} "
+                  f"steps={rc.get('step', 0)} "
+                  f"anomalies={rc.get('anomaly', 0)} "
+                  f"saves={rc.get('save', 0)} "
+                  f"restores={rc.get('resume', 0)} "
+                  f"rollbacks={rc.get('rollback', 0)} "
+                  f"aborts={rc.get('abort', 0)}")
         from ..analysis import findings_summary
         fs = findings_summary()
         if fs:

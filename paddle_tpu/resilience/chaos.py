@@ -1,0 +1,171 @@
+"""Deterministic, seeded fault injection for training loops.
+
+Every recovery path in the supervisor must be exercised by test, not by
+luck: ``ChaosMonkey`` wraps a train step and fires faults at
+deterministically chosen steps, so a CI run with ``seed=7`` reproduces
+the exact failure sequence of any previous run with ``seed=7``.
+
+Faults
+------
+
+``nan``      the step returns a non-finite loss (poisoned batch / bf16
+             overflow analog); the real step is NOT run, matching a loss
+             that was computed but useless
+``stall``    the step blocks for ``stall_s`` then raises
+             :class:`StallInjected` (the wedged-TPU-tunnel analog seen in
+             BENCH_r02–r05); nothing mutates, so a retry is safe
+``error``    the step raises :class:`ChaosError` (transient RPC failure)
+``kill``     SIGKILL to the current process — no atexit, no flushing;
+             only a durable checkpoint survives this
+``corrupt``  the newest committed checkpoint gets one shard truncated
+             (restore must detect the bad checksum and fall back)
+
+Schedules are explicit (``at={step: fault}``) or drawn from a seeded RNG
+(``p`` per-step probability over ``faults``); both are pure functions of
+the constructor arguments.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+import numpy as np
+
+FAULTS = ("nan", "stall", "error", "kill", "corrupt")
+
+
+class ChaosError(RuntimeError):
+    """Injected transient step failure (RPC-error analog)."""
+
+
+class StallInjected(TimeoutError):
+    """Injected wedged step: blocked past the deadline, then failed."""
+
+
+class ChaosMonkey:
+    """Wrap a train step so faults fire at deterministic steps.
+
+    ``at`` maps 0-based step invocation index -> fault name for an
+    explicit plan; alternatively ``p`` > 0 draws a schedule from
+    ``numpy.random.default_rng(seed)`` over ``faults`` for ``horizon``
+    steps. ``wrap(step_fn)`` returns the chaotic step; the monkey counts
+    invocations, so the Nth call fires the fault planned for step N
+    (a retried step advances the count — retries meet fresh weather).
+    """
+
+    def __init__(self, seed: int = 0, *, at=None, p: float = 0.0,
+                 faults=("nan", "stall", "error"), horizon: int = 1024,
+                 stall_s: float = 0.25, manager=None):
+        self.seed = int(seed)
+        self.stall_s = float(stall_s)
+        self.manager = manager
+        self.calls = 0
+        self.fired = []                 # [(step, fault)]
+        for f in dict(at or {}).values():
+            if f not in FAULTS:
+                raise ValueError(f"unknown fault {f!r} (one of {FAULTS})")
+        self.plan = {int(k): v for k, v in (at or {}).items()}
+        if p > 0.0:
+            rng = np.random.default_rng(self.seed)
+            for step in range(int(horizon)):
+                if step in self.plan:
+                    continue
+                if rng.random() < p:
+                    self.plan[step] = str(rng.choice(list(faults)))
+
+    def schedule(self, n_steps: int):
+        """The fault plan restricted to the first ``n_steps`` steps."""
+        return {s: f for s, f in sorted(self.plan.items()) if s < n_steps}
+
+    def wrap(self, step_fn):
+        def chaotic_step(*args, **kwargs):
+            step = self.calls
+            self.calls += 1
+            fault = self.plan.get(step)
+            if fault is not None:
+                self.fired.append((step, fault))
+                return self._fire(fault, step_fn, args, kwargs)
+            return step_fn(*args, **kwargs)
+
+        chaotic_step.chaos = self
+        return chaotic_step
+
+    def _fire(self, fault, step_fn, args, kwargs):
+        if fault == "nan":
+            return float("nan")
+        if fault == "stall":
+            time.sleep(self.stall_s)
+            raise StallInjected(
+                f"chaos: step wedged for {self.stall_s}s (seed={self.seed})")
+        if fault == "error":
+            raise ChaosError(f"chaos: transient step failure "
+                             f"(seed={self.seed})")
+        if fault == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+            raise RuntimeError("unreachable: SIGKILL did not fire")
+        if fault == "corrupt":
+            if self.manager is None:
+                raise ValueError(
+                    "chaos fault 'corrupt' needs ChaosMonkey(manager=...)")
+            corrupt_latest(self.manager, seed=self.seed)
+            return step_fn(*args, **kwargs)
+        raise ValueError(f"unknown fault {fault!r}")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint corruption helpers (used by chaos 'corrupt' and by tests)
+# ---------------------------------------------------------------------------
+
+def corrupt_checkpoint(path, seed: int = 0, mode: str = "truncate"):
+    """Damage a committed checkpoint dir in place.
+
+    ``truncate`` halves a deterministically chosen data file; ``flip``
+    xors one byte; ``uncommit`` removes the COMMIT marker (simulating a
+    kill after rename of a pre-manifest writer). Returns the damaged
+    file path (or the marker path for ``uncommit``).
+    """
+    path = os.path.abspath(path)
+    if mode == "uncommit":
+        marker = os.path.join(path, "COMMIT")
+        os.remove(marker)
+        return marker
+    files = []
+    for root, _dirs, names in os.walk(path):
+        for name in names:
+            if name == "COMMIT":
+                continue
+            full = os.path.join(root, name)
+            if os.path.getsize(full) > 0:
+                files.append(full)
+    if not files:
+        raise FileNotFoundError(f"no data files to corrupt under {path}")
+    files.sort()
+    rng = np.random.default_rng(seed)
+    victim = files[int(rng.integers(len(files)))]
+    size = os.path.getsize(victim)
+    if mode == "truncate":
+        with open(victim, "rb+") as fh:
+            fh.truncate(max(size // 2, 1))
+    elif mode == "flip":
+        off = int(rng.integers(size))
+        with open(victim, "rb+") as fh:
+            fh.seek(off)
+            b = fh.read(1)
+            fh.seek(off)
+            fh.write(bytes([b[0] ^ 0xFF]))
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    return victim
+
+
+def corrupt_latest(manager, seed: int = 0, mode: str = "truncate"):
+    """Corrupt the newest committed checkpoint of a CheckpointManager."""
+    manager.wait()
+    step = manager.latest_step()
+    if step is None:
+        raise FileNotFoundError(
+            f"no checkpoints under {manager.directory}")
+    return corrupt_checkpoint(
+        os.path.join(manager.directory, f"ckpt-{step}"), seed=seed,
+        mode=mode)

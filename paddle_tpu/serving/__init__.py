@@ -20,13 +20,13 @@ For a saved artifact, ``save_lm(model, path)`` then
 """
 from __future__ import annotations
 
-from .engine import Engine, RequestHandle                   # noqa: F401
+from .engine import Engine, RequestHandle, RequestTimeout   # noqa: F401
 from .kv_cache import SlotKVCache                           # noqa: F401
 from .metrics import EngineMetrics, RequestMetrics, ledger  # noqa: F401
 from .scheduler import EngineOverloaded, FIFOScheduler      # noqa: F401
 
-__all__ = ["Engine", "RequestHandle", "SlotKVCache", "EngineMetrics",
-           "RequestMetrics", "ledger", "EngineOverloaded",
+__all__ = ["Engine", "RequestHandle", "RequestTimeout", "SlotKVCache",
+           "EngineMetrics", "RequestMetrics", "ledger", "EngineOverloaded",
            "FIFOScheduler", "save_lm"]
 
 

@@ -29,6 +29,8 @@ the device.
 """
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 import jax
@@ -39,7 +41,13 @@ from .kv_cache import SlotKVCache
 from .metrics import EngineMetrics, RequestMetrics
 from .scheduler import EngineOverloaded, FIFOScheduler  # noqa: F401
 
-__all__ = ["Engine", "RequestHandle", "EngineOverloaded"]
+__all__ = ["Engine", "RequestHandle", "EngineOverloaded", "RequestTimeout"]
+
+
+class RequestTimeout(TimeoutError):
+    """A request exceeded its ``max_time_s`` deadline: its KV slot was
+    reclaimed and ``result()`` raises this instead of blocking forever.
+    Tokens generated before the deadline remain on ``handle.tokens``."""
 
 
 # ---------------------------------------------------------------------------
@@ -205,7 +213,7 @@ class RequestHandle:
     """
 
     def __init__(self, engine, request_id, prompt_ids, max_new_tokens,
-                 temperature, seed, on_token):
+                 temperature, seed, on_token, max_time_s=None):
         self._engine = engine
         self.request_id = request_id
         self.prompt_ids = prompt_ids
@@ -214,15 +222,23 @@ class RequestHandle:
         self.temperature = float(temperature)
         self.seed = int(seed)
         self.on_token = on_token
+        self.max_time_s = None if max_time_s is None else float(max_time_s)
+        self.deadline = (None if max_time_s is None
+                         else time.monotonic() + float(max_time_s))
         self.tokens = []
         self.finished = False
-        self.finish_reason = None      # "eos" | "length"
+        self.finish_reason = None      # "eos" | "length" | "timeout"
         self.slot = None
         self.metrics = RequestMetrics()
 
     def result(self):
         while not self.finished:
             self._engine.step()
+        if self.finish_reason == "timeout":
+            raise RequestTimeout(
+                f"request {self.request_id} exceeded max_time_s="
+                f"{self.max_time_s} after {len(self.tokens)} tokens; "
+                "its slot was reclaimed")
         return np.concatenate(
             [self.prompt_ids, np.asarray(self.tokens, np.int32)])
 
@@ -304,13 +320,21 @@ class Engine:
         return ids
 
     def submit(self, prompt, max_new_tokens=32, temperature=1.0,
-               seed=None, on_token=None):
+               seed=None, on_token=None, max_time_s=None):
         """Enqueue a request; returns a RequestHandle immediately. The
         request prefills as soon as a slot + token budget admit it (often
-        inside this call). Raises EngineOverloaded past max_queue."""
+        inside this call). Raises EngineOverloaded past max_queue.
+
+        ``max_time_s`` is a wall-clock deadline covering queueing AND
+        decoding: a request still unfinished when it expires frees its
+        KV slot at the next step and ``result()`` raises
+        :class:`RequestTimeout` — a wedged or runaway request can never
+        occupy the engine forever."""
         ids = self._as_ids(prompt)
         if ids.shape[0] < 1:
             raise ValueError("empty prompt")
+        if max_time_s is not None and float(max_time_s) <= 0:
+            raise ValueError("max_time_s must be positive")
         if ids.shape[0] + int(max_new_tokens) > self.max_len:
             raise ValueError(
                 f"prompt ({ids.shape[0]}) + max_new_tokens "
@@ -319,15 +343,28 @@ class Engine:
         self._next_id += 1
         h = RequestHandle(
             self, rid, ids, max_new_tokens, temperature,
-            self.base_seed + rid if seed is None else seed, on_token)
+            self.base_seed + rid if seed is None else seed, on_token,
+            max_time_s=max_time_s)
         self.metrics.requests_submitted += 1
         try:
-            self.scheduler.enqueue(h)
+            self.scheduler.enqueue(h, retry_after_s=self._retry_after_hint())
         except EngineOverloaded:
             self.metrics.requests_rejected += 1
             raise
         self._admit()
         return h
+
+    def _retry_after_hint(self):
+        """Seconds until a slot plausibly frees: the live inter-token
+        latency times the shortest remaining active request."""
+        itl = self.metrics.itl_estimate()
+        if itl is None:
+            return None
+        remaining = [h.max_new_tokens - len(h.tokens)
+                     for h in self._by_slot if h is not None]
+        if not remaining:
+            return None
+        return round(itl * max(1, min(remaining)), 3)
 
     def _admit(self):
         # a request that finishes during its own prefill (eos first token,
@@ -362,22 +399,36 @@ class Engine:
 
     # -- the decode loop --------------------------------------------------
 
+    def _expire(self):
+        """Enforce per-request deadlines: expired queued requests drop
+        before ever taking a slot; expired active ones free their slot
+        and resolve with a timeout."""
+        now = time.monotonic()
+        for h in self.scheduler.drop_expired(now):
+            self._finish(h, "timeout")
+        for h in list(self._by_slot):
+            if h is not None and h.deadline is not None \
+                    and now > h.deadline:
+                self._finish(h, "timeout")
+
     def step(self):
-        """One engine iteration: admit waiting requests into free slots,
-        then advance every active slot one token. Returns the number of
-        requests that were decoding this step."""
+        """One engine iteration: expire overdue requests, admit waiting
+        ones into free slots, then advance every active slot one token.
+        Returns the number of requests that were decoding this step."""
+        self._expire()
         self._admit()
         n_active = self.cache.n_active
         self.metrics.sample(self.cache.occupancy,
                             self.scheduler.queue_depth)
         if n_active:
+            t0 = time.perf_counter()
             out = self._decode(
                 self._w, self.cache.kc, self.cache.vc, self._tok,
                 self._cur, self.cache.active, self._keys,
                 self._temps, **self._statics)
             nxt, self.cache.kc, self.cache.vc, self._cur, self._keys = out
             self._tok = nxt
-            self.metrics.decode_steps += 1
+            self.metrics.mark_decode(time.perf_counter() - t0)
             toks = np.asarray(nxt)
             for slot in np.nonzero(self.cache.active)[0]:
                 h = self._by_slot[int(slot)]
@@ -400,10 +451,14 @@ class Engine:
         h.finished = True
         h.finish_reason = reason
         h.metrics.mark_finished()
-        self._by_slot[h.slot] = None
-        self.cache.free(h.slot)
-        self.scheduler.release(h)
-        self.metrics.requests_completed += 1
+        if h.slot is not None:         # queued-only timeouts held no slot
+            self._by_slot[h.slot] = None
+            self.cache.free(h.slot)
+            self.scheduler.release(h)
+        if reason == "timeout":
+            self.metrics.requests_timed_out += 1
+        else:
+            self.metrics.requests_completed += 1
 
     def drain(self):
         """Pump step() until every submitted request has finished."""

@@ -8,6 +8,7 @@ the eager dispatch cache) and feed tools/bench_serving.py's JSON ledger.
 """
 from __future__ import annotations
 
+import collections
 import time
 import weakref
 
@@ -76,6 +77,7 @@ class EngineMetrics:
         self.requests_submitted = 0
         self.requests_completed = 0
         self.requests_rejected = 0
+        self.requests_timed_out = 0
         self.tokens_generated = 0
         self.prefills = 0
         self.decode_steps = 0
@@ -83,6 +85,9 @@ class EngineMetrics:
         self.queue_depth_sum = 0
         self.peak_queue_depth = 0
         self.samples = 0
+        # rolling window of decode-step wall times: the live ITL estimate
+        # behind EngineOverloaded.retry_after_s and deadline accounting
+        self._decode_times = collections.deque(maxlen=64)
         _register(self)
 
     def sample(self, occupancy, queue_depth):
@@ -91,18 +96,34 @@ class EngineMetrics:
         self.queue_depth_sum += queue_depth
         self.peak_queue_depth = max(self.peak_queue_depth, queue_depth)
 
+    def mark_decode(self, duration_s):
+        self.decode_steps += 1
+        self._decode_times.append(duration_s)
+
+    def itl_estimate(self):
+        """Median recent decode-step wall time (seconds), None before the
+        first decode — one decode step advances every active slot one
+        token, so this IS the current inter-token latency."""
+        if not self._decode_times:
+            return None
+        return _percentile(self._decode_times, 50)
+
     def snapshot(self):
         n = max(self.samples, 1)
+        itl = self.itl_estimate()
         return {
             "requests_submitted": self.requests_submitted,
             "requests_completed": self.requests_completed,
             "requests_rejected": self.requests_rejected,
+            "requests_timed_out": self.requests_timed_out,
             "tokens_generated": self.tokens_generated,
             "prefills": self.prefills,
             "decode_steps": self.decode_steps,
             "avg_slot_occupancy": round(self.occupancy_sum / n, 4),
             "avg_queue_depth": round(self.queue_depth_sum / n, 4),
             "peak_queue_depth": self.peak_queue_depth,
+            "itl_estimate_ms": (None if itl is None
+                                else round(itl * 1e3, 3)),
         }
 
 
@@ -117,7 +138,8 @@ def global_counters():
     """Summed snapshot across every live engine (profiler plumbing)."""
     total = {
         "engines": 0, "requests_submitted": 0, "requests_completed": 0,
-        "requests_rejected": 0, "tokens_generated": 0, "prefills": 0,
+        "requests_rejected": 0, "requests_timed_out": 0,
+        "tokens_generated": 0, "prefills": 0,
         "decode_steps": 0, "peak_queue_depth": 0,
     }
     live = []
@@ -129,8 +151,8 @@ def global_counters():
         s = m.snapshot()
         total["engines"] += 1
         for k in ("requests_submitted", "requests_completed",
-                  "requests_rejected", "tokens_generated", "prefills",
-                  "decode_steps"):
+                  "requests_rejected", "requests_timed_out",
+                  "tokens_generated", "prefills", "decode_steps"):
             total[k] += s[k]
         total["peak_queue_depth"] = max(total["peak_queue_depth"],
                                         s["peak_queue_depth"])

@@ -20,7 +20,16 @@ import collections
 
 
 class EngineOverloaded(RuntimeError):
-    """Raised by submit() when the waiting queue is at max_queue depth."""
+    """Raised by submit() when the waiting queue is at max_queue depth.
+
+    ``retry_after_s`` (when the engine has decode-latency history) is
+    the estimated seconds until a slot frees — clients should back off
+    at least that long before resubmitting.
+    """
+
+    def __init__(self, message, retry_after_s=None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class FIFOScheduler:
@@ -46,12 +55,29 @@ class FIFOScheduler:
     def inflight_tokens(self):
         return self._inflight_tokens
 
-    def enqueue(self, handle):
+    def enqueue(self, handle, retry_after_s=None):
         if len(self._queue) >= self.max_queue:
+            hint = ("" if retry_after_s is None
+                    else f" ~{retry_after_s}s (current inter-token "
+                         f"latency x shortest active request)")
             raise EngineOverloaded(
                 f"serving queue full ({self.max_queue} waiting); retry "
-                "after the engine drains")
+                f"after{hint or ' the engine drains'}",
+                retry_after_s=retry_after_s)
         self._queue.append(handle)
+
+    def drop_expired(self, now):
+        """Remove and return queued handles whose deadline passed while
+        they waited — they never held a slot or token-budget share, so
+        nothing is released."""
+        expired = [h for h in self._queue
+                   if getattr(h, "deadline", None) is not None
+                   and now > h.deadline]
+        if expired:
+            dead = set(map(id, expired))
+            self._queue = collections.deque(
+                h for h in self._queue if id(h) not in dead)
+        return expired
 
     def pop_admissible(self, free_slots):
         """Pop the FIFO prefix that fits in ``free_slots`` and the token

@@ -3,7 +3,8 @@ python/paddle/incubate/checkpoint/auto_checkpoint.py + Fleet elastic).
 
 Watches step wall-time and loss health; on anomaly it invokes callbacks
 (checkpoint, skip-step). Pure host-side logic — no device sync beyond the
-loss scalar the loop already has.
+loss scalar the loop already has. The *recovering* superstructure grown
+on top of this detector lives in ``paddle_tpu.resilience.Supervisor``.
 """
 from __future__ import annotations
 
@@ -21,13 +22,24 @@ class TrainingWatchdog:
         self.nan_patience = nan_patience
         self.on_stall = on_stall
         self.on_nan = on_nan
-        self._last_step_t = time.monotonic()
+        # armed lazily: a watchdog built long before training begins must
+        # not report the setup gap as a phantom stall on step 1
+        self._last_step_t = None
         self._nan_streak = 0
         self.stats = {"steps": 0, "nan_steps": 0, "stalls": 0}
+
+    def start(self):
+        """Arm the stall timer now (optional — the first step() arms it
+        implicitly). Call right before the training loop if setup work
+        between the first two steps should count toward the timeout."""
+        self._last_step_t = time.monotonic()
+        return self
 
     def step(self, loss_value: float) -> bool:
         """Record one step. Returns True if the step is healthy (usable)."""
         now = time.monotonic()
+        if self._last_step_t is None:
+            self._last_step_t = now     # first step arms the timer
         if now - self._last_step_t > self.step_timeout_s:
             self.stats["stalls"] += 1
             if self.on_stall:

@@ -1,0 +1,767 @@
+"""paddle_tpu.resilience: supervisor escalation ladder, deterministic
+chaos injection, atomic checkpoint commit, and bitwise preemption resume.
+
+The headline is the kill-and-resume subprocess test: a training run
+SIGKILLed at a chaos-chosen step must resume from the last durable
+checkpoint and produce losses bitwise-equal to the uninterrupted run
+(dataloader position + PRNG chain + optimizer moments + loss-scaler
+state all restored). Kept slim for the tier-1 budget; the kill-window
+soak and chaos sweeps are marked slow.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.checkpoint import (
+    CheckpointManager, verify_commit, write_commit_marker)
+from paddle_tpu.resilience import (
+    ChaosMonkey, FlightLedger, ResumableLoader, StallInjected, Supervisor,
+    SupervisorAborted, TrainState, corrupt_checkpoint)
+from paddle_tpu.utils.watchdog import TrainingWatchdog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# watchdog satellite: no phantom stall on step 1
+# ---------------------------------------------------------------------------
+
+class TestWatchdog:
+    def test_no_phantom_stall_on_first_step(self):
+        """Regression: a watchdog built long before training begins must
+        not report the setup gap as a stall on step 1."""
+        stalls = []
+        wd = TrainingWatchdog(step_timeout_s=0.05, on_stall=stalls.append)
+        time.sleep(0.12)            # "long setup" before training starts
+        assert wd.step(1.0)
+        assert wd.stats["stalls"] == 0 and not stalls
+        time.sleep(0.12)            # a real inter-step stall IS reported
+        assert wd.step(1.0)
+        assert wd.stats["stalls"] == 1 and len(stalls) == 1
+
+    def test_explicit_start_arms_timer(self):
+        wd = TrainingWatchdog(step_timeout_s=0.05).start()
+        time.sleep(0.12)
+        wd.step(1.0)
+        assert wd.stats["stalls"] == 1
+
+    def test_nan_patience_still_raises(self):
+        wd = TrainingWatchdog(nan_patience=2)
+        assert not wd.step(float("nan"))
+        with pytest.raises(FloatingPointError):
+            wd.step(float("nan"))
+
+
+# ---------------------------------------------------------------------------
+# flight ledger
+# ---------------------------------------------------------------------------
+
+class TestFlightLedger:
+    def test_bounded_ring_and_file_compaction(self, tmp_path):
+        path = str(tmp_path / "flight.jsonl")
+        led = FlightLedger(path, max_records=8)
+        for i in range(40):
+            led.record("step", step=i)
+        assert len(led) == 8
+        assert [r["step"] for r in led.tail(3)] == [37, 38, 39]
+        # file was compacted back under the bound, not grown unbounded
+        with open(path) as fh:
+            assert sum(1 for _ in fh) <= 16
+        assert led.counts() == {"step": 8}
+
+    def test_read_tolerates_torn_tail(self, tmp_path):
+        path = str(tmp_path / "flight.jsonl")
+        led = FlightLedger(path, max_records=8)
+        led.record("save", step=1)
+        with open(path, "a") as fh:
+            fh.write('{"t": 1, "event": "sa')     # kill mid-append
+        recs = FlightLedger.read(path)
+        assert len(recs) == 1 and recs[0]["event"] == "save"
+        # and a new ledger over the same file picks up the intact prefix
+        led2 = FlightLedger(path, max_records=8)
+        assert led2.counts() == {"save": 1}
+
+
+# ---------------------------------------------------------------------------
+# chaos determinism
+# ---------------------------------------------------------------------------
+
+class TestChaos:
+    def test_seeded_schedule_is_deterministic(self):
+        a = ChaosMonkey(seed=7, p=0.3, horizon=64)
+        b = ChaosMonkey(seed=7, p=0.3, horizon=64)
+        assert a.plan == b.plan and a.plan
+        c = ChaosMonkey(seed=8, p=0.3, horizon=64)
+        assert a.plan != c.plan
+
+    def test_explicit_plan_and_fired_log(self):
+        calls = []
+        chaos = ChaosMonkey(at={1: "nan"})
+        fn = chaos.wrap(lambda: calls.append(1) or 0.5)
+        assert fn() == 0.5
+        assert np.isnan(fn())           # injected, real step NOT run
+        assert fn() == 0.5
+        assert chaos.fired == [(1, "nan")] and len(calls) == 2
+
+    def test_unknown_fault_rejected(self):
+        with pytest.raises(ValueError):
+            ChaosMonkey(at={0: "gremlins"})
+
+    def test_stall_raises_timeout(self):
+        chaos = ChaosMonkey(at={0: "stall"}, stall_s=0.01)
+        with pytest.raises(StallInjected):
+            chaos.wrap(lambda: 0.0)()
+
+
+# ---------------------------------------------------------------------------
+# atomic checkpoint commit + hardened restore
+# ---------------------------------------------------------------------------
+
+def _np_state(v):
+    return {"w": np.full((4,), float(v), np.float32),
+            "step": np.asarray(v, np.int64)}
+
+
+class TestAtomicCheckpoint:
+    def test_commit_marker_written_and_verified(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, max_to_keep=3)
+        path = mgr.save(1, _np_state(1), async_save=False)
+        assert os.path.isfile(os.path.join(path, "COMMIT"))
+        assert verify_commit(path) == (True, "ok")
+
+    @pytest.mark.parametrize("damage", ["truncate", "flip", "uncommit"])
+    def test_restore_latest_skips_damaged_newest(self, tmp_path, damage):
+        """Torn/corrupt newest checkpoint: restore falls back to the
+        newest intact step with a warning instead of raising."""
+        mgr = CheckpointManager(tmp_path, max_to_keep=3)
+        mgr.save(1, _np_state(1), async_save=False)
+        mgr.save(2, _np_state(2), async_save=False)
+        corrupt_checkpoint(os.path.join(str(tmp_path), "ckpt-2"),
+                           mode=damage)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            step, out = mgr.restore_latest(_np_state(0))
+        assert step == 1
+        np.testing.assert_array_equal(np.asarray(out["w"]), [1.0] * 4)
+        assert any("skipping checkpoint step 2" in str(x.message)
+                   for x in w)
+
+    def test_all_damaged_raises_filenotfound(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, max_to_keep=3)
+        mgr.save(1, _np_state(1), async_save=False)
+        corrupt_checkpoint(os.path.join(str(tmp_path), "ckpt-1"),
+                           mode="truncate")
+        with pytest.raises(FileNotFoundError), warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mgr.restore_latest(_np_state(0))
+
+    def test_stale_tmp_dir_ignored_and_cleaned(self, tmp_path):
+        """A kill mid-write leaves only a hidden tmp dir: restore never
+        sees it, and the next manager construction sweeps it — but only
+        when the writing pid is truly gone (a live writer's tmp is not
+        touched)."""
+        mgr = CheckpointManager(tmp_path, max_to_keep=3)
+        mgr.save(3, _np_state(3), async_save=False)
+        gone = subprocess.Popen(["true"])
+        gone.wait()                             # reaped: the pid is free
+        dead = tmp_path / f".tmp-ckpt-9-{gone.pid}"
+        dead.mkdir()
+        (dead / "partial").write_bytes(b"\x00" * 64)
+        live = tmp_path / f".tmp-ckpt-8-{os.getpid()}"
+        live.mkdir()
+        step, _ = mgr.restore_latest(_np_state(0))
+        assert step == 3
+        CheckpointManager(tmp_path)             # init sweeps dead tmp
+        assert not dead.exists()
+        assert live.exists()                    # live writer untouched
+
+    def test_prune_keeps_newest_and_skips_uncommitted(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, max_to_keep=2)
+        for s in range(1, 5):
+            mgr.save(s, _np_state(s), async_save=False)
+        assert mgr.all_steps() == [3, 4]
+        # an uncommitted (torn) dir neither blocks pruning nor counts
+        torn = tmp_path / "ckpt-9"
+        torn.mkdir()
+        mgr.save(5, _np_state(5), async_save=False)
+        assert 5 in mgr.all_steps()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            step, _ = mgr.restore_latest(_np_state(0))
+        assert step == 5
+
+    def test_async_save_overlaps_and_commits(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, max_to_keep=2)
+        mgr.save(1, _np_state(1), async_save=True)
+        mgr.wait()
+        assert verify_commit(os.path.join(str(tmp_path), "ckpt-1"))[0]
+
+    def test_legacy_dirs_without_any_commit_still_load(self, tmp_path):
+        """Pre-manifest checkpoint dirs (no COMMIT anywhere) keep
+        loading — upgrades don't strand old runs."""
+        from paddle_tpu.distributed.checkpoint import save_distributed
+        save_distributed(_np_state(4), str(tmp_path / "ckpt-4"),
+                         async_save=False)
+        mgr = CheckpointManager(tmp_path)
+        step, out = mgr.restore_latest(_np_state(0))
+        assert step == 4
+        np.testing.assert_array_equal(np.asarray(out["w"]), [4.0] * 4)
+
+
+# ---------------------------------------------------------------------------
+# supervisor escalation ladder (in-process)
+# ---------------------------------------------------------------------------
+
+def _tiny_setup(seed=0, lr=0.05):
+    paddle.seed(seed)
+    net = nn.Linear(4, 1)
+    opt = optimizer.Adam(learning_rate=lr, parameters=net.parameters())
+    rng = np.random.default_rng(seed)
+    x = paddle.to_tensor(rng.normal(size=(8, 4)).astype(np.float32))
+    y = paddle.to_tensor(rng.normal(size=(8, 1)).astype(np.float32))
+
+    def train_step(xb, yb):
+        loss = ((net(xb) - yb) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    return net, opt, x, y, train_step
+
+
+def _wbits(net):
+    return np.asarray(net.state_dict()["weight"]._data).tobytes()
+
+
+class TestSupervisorLadder:
+    def test_skip_nonfinite_without_touching_state(self, tmp_path):
+        net, opt, x, y, train_step = _tiny_setup()
+        chaos = ChaosMonkey(at={1: "nan"})
+        sup = Supervisor(chaos.wrap(train_step),
+                         TrainState(model=net, optimizer=opt))
+        assert sup.step(x, y) is not None
+        before = _wbits(net)
+        assert sup.step(x, y) is None          # skipped
+        assert _wbits(net) == before           # params untouched
+        assert sup.skipped == 1 and sup.anomalies["nonfinite"] == 1
+        assert sup.step(x, y) is not None      # training continues
+        assert sup.stats()["steps_completed"] == 3
+
+    def test_retry_on_error_and_stall(self, tmp_path):
+        net, opt, x, y, train_step = _tiny_setup()
+        chaos = ChaosMonkey(at={1: "error", 3: "stall"}, stall_s=0.01)
+        sup = Supervisor(chaos.wrap(train_step),
+                         TrainState(model=net, optimizer=opt),
+                         max_retries=2, retry_backoff_s=0.0)
+        losses = [sup.step(x, y) for _ in range(4)]
+        assert all(l is not None for l in losses)
+        assert sup.retries == 2
+        assert sup.anomalies == {"step-error": 1, "stall": 1}
+
+    def test_wedged_step_detected_by_timeout_thread(self):
+        """A step that HANGS (no exception) trips step_timeout_s, is
+        retried, and training recovers."""
+        net, opt, x, y, train_step = _tiny_setup()
+        state = {"calls": 0}
+
+        def sometimes_hangs(xb, yb):
+            state["calls"] += 1
+            if state["calls"] == 1:
+                time.sleep(0.6)        # wedged (abandoned by supervisor)
+            return train_step(xb, yb)
+
+        sup = Supervisor(sometimes_hangs,
+                         TrainState(model=net, optimizer=opt),
+                         step_timeout_s=0.1, max_retries=1,
+                         retry_backoff_s=0.0)
+        assert sup.step(x, y) is not None
+        assert sup.retries == 1 and sup.anomalies["stall"] == 1
+
+    def test_rollback_restores_durable_state(self, tmp_path):
+        net, opt, x, y, train_step = _tiny_setup()
+        mgr = CheckpointManager(tmp_path / "ck", max_to_keep=2)
+        # NaN streak past patience forces the rollback rung
+        chaos = ChaosMonkey(at={2: "nan", 3: "nan"})
+        sup = Supervisor(chaos.wrap(train_step),
+                         TrainState(model=net, optimizer=opt),
+                         manager=mgr, save_interval=1, nan_patience=2,
+                         max_rollbacks=1)
+        sup.step(x, y)
+        sup.step(x, y)
+        out = sup.step(x, y)       # nan: streak 1 -> skipped
+        assert out is None and sup.skipped == 1
+        out2 = sup.step(x, y)      # nan: streak 2 -> rollback -> retry ok
+        assert sup.rollbacks == 1
+        assert out2 is not None
+        rb = [r for r in sup.ledger.to_list() if r["event"] == "rollback"]
+        # the emergency save at the first nan stamped the consumed-step
+        # count (2); the streak rolled back to that durable state
+        assert rb and rb[0]["to_step"] == 2 \
+            and rb[0]["why"] == "nonfinite-streak"
+
+    def test_abort_writes_postmortem(self, tmp_path):
+        net, opt, x, y, train_step = _tiny_setup()
+        mgr = CheckpointManager(tmp_path / "ck", max_to_keep=2)
+        chaos = ChaosMonkey(at={k: "error" for k in range(40)})
+        sup = Supervisor(chaos.wrap(train_step),
+                         TrainState(model=net, optimizer=opt),
+                         manager=mgr, max_retries=1, max_rollbacks=0,
+                         retry_backoff_s=0.0)
+        with pytest.raises(SupervisorAborted) as ei:
+            sup.step(x, y)
+        pm = ei.value.postmortem
+        assert pm["exception"].startswith("ChaosError")
+        assert pm["stats"]["retries"] == 1
+        assert os.path.isfile(ei.value.path)
+        assert json.load(open(ei.value.path))["aborted_at_step"] == 0
+        assert any(r["event"] == "abort" for r in sup.ledger.to_list())
+        with pytest.raises(SupervisorAborted):
+            sup.step(x, y)          # supervisor stays dead after abort
+
+    def test_emergency_save_on_first_anomaly(self, tmp_path):
+        net, opt, x, y, train_step = _tiny_setup()
+        mgr = CheckpointManager(tmp_path / "ck", max_to_keep=4)
+        chaos = ChaosMonkey(at={3: "nan"})
+        sup = Supervisor(chaos.wrap(train_step),
+                         TrainState(model=net, optimizer=opt),
+                         manager=mgr, save_interval=0)  # no cadence saves
+        for _ in range(4):
+            sup.step(x, y)
+        mgr.wait()
+        # the anomaly at step 3 persisted the last good state (step 2)
+        assert mgr.all_steps() == [2]
+        assert any(r["event"] == "save" and r["reason"] == "emergency"
+                   for r in sup.ledger.to_list())
+
+    def test_cadence_saves_and_resume_roundtrip(self, tmp_path):
+        net, opt, x, y, train_step = _tiny_setup()
+        mgr = CheckpointManager(tmp_path / "ck", max_to_keep=2)
+        sup = Supervisor(train_step, TrainState(model=net, optimizer=opt),
+                         manager=mgr, save_interval=2)
+        for _ in range(4):
+            sup.step(x, y)
+        sup.close()
+        assert mgr.all_steps() == [1, 3]
+        w_trained = _wbits(net)
+        # restart analog: clobber the live state, then resume from disk
+        # (true cross-process resume is the kill-and-resume test below)
+        net.set_state_dict(
+            {k: paddle.to_tensor(np.zeros_like(np.asarray(v._data)))
+             for k, v in net.state_dict().items()})
+        assert _wbits(net) != w_trained
+        sup2 = Supervisor(train_step,
+                          TrainState(model=net, optimizer=opt),
+                          manager=mgr)
+        assert sup2.resume() == 4
+        assert _wbits(net) == w_trained
+
+
+# ---------------------------------------------------------------------------
+# resumable loader
+# ---------------------------------------------------------------------------
+
+class TestResumableLoader:
+    def _loader(self):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.io.sampler import DistributedBatchSampler
+
+        class _DS:
+            def __len__(self):
+                return 12
+
+            def __getitem__(self, i):
+                return np.asarray([i], np.float32)
+
+        ds = _DS()
+        sampler = DistributedBatchSampler(ds, batch_size=2, num_replicas=1,
+                                          rank=0, shuffle=True)
+        return DataLoader(ds, batch_sampler=sampler)
+
+    def test_fast_forward_continues_exactly(self):
+        ref = ResumableLoader(self._loader(), epochs=2)
+        ref_batches = [np.asarray(b._data).ravel().tolist()
+                       for b in ref]
+        rl = ResumableLoader(self._loader(), epochs=2)
+        seen = []
+        for b in rl:
+            seen.append(np.asarray(b._data).ravel().tolist())
+            if len(seen) == 7:          # mid-epoch-2 interruption
+                break
+        cursor = rl.state_dict()
+        assert cursor == {"epoch": 1, "batch_index": 1}
+        rl2 = ResumableLoader(self._loader(), epochs=2)
+        rl2.set_state_dict(cursor)
+        rest = [np.asarray(b._data).ravel().tolist() for b in rl2]
+        assert seen + rest == ref_batches
+
+    def test_sampler_state_dict_satellite(self):
+        from paddle_tpu.io.sampler import DistributedBatchSampler
+
+        s = DistributedBatchSampler(list(range(8)), batch_size=2,
+                                    num_replicas=1, rank=0, shuffle=True)
+        s.set_epoch(3)
+        assert s.state_dict() == {"epoch": 3}
+        s2 = DistributedBatchSampler(list(range(8)), batch_size=2,
+                                     num_replicas=1, rank=0, shuffle=True)
+        s2.load_state_dict(s.state_dict())
+        assert [b for b in s2] == [b for b in s]
+
+
+# ---------------------------------------------------------------------------
+# the headline: SIGKILL at a chaos-chosen step, bitwise-equal resume
+# ---------------------------------------------------------------------------
+
+_WORKER = r'''
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.amp import GradScaler
+from paddle_tpu.io import DataLoader
+from paddle_tpu.io.sampler import DistributedBatchSampler
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+from paddle_tpu.resilience import (ChaosMonkey, ResumableLoader,
+                                   Supervisor, TrainState)
+
+mode, out_path, ckpt_dir, kill_step = (sys.argv[1], sys.argv[2],
+                                       sys.argv[3], int(sys.argv[4]))
+TOTAL = 12
+
+paddle.seed(1234)
+
+class _DS:
+    def __init__(self):
+        rng = np.random.default_rng(7)
+        self.x = rng.normal(size=(32, 4)).astype(np.float32)
+        w = rng.normal(size=(4, 1)).astype(np.float32)
+        self.y = (self.x @ w).astype(np.float32)
+    def __len__(self): return 32
+    def __getitem__(self, i): return self.x[i], self.y[i]
+
+# dropout exercises the PRNG chain; the scaler exercises AMP state
+net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Dropout(0.25),
+                    nn.Linear(16, 1))
+opt = optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+scaler = GradScaler(enable=True, init_loss_scaling=2.0 ** 10,
+                    incr_every_n_steps=4)
+ds = _DS()
+sampler = DistributedBatchSampler(ds, batch_size=4, num_replicas=1,
+                                  rank=0, shuffle=True)
+loader = ResumableLoader(DataLoader(ds, batch_sampler=sampler), epochs=3)
+
+def train_step(xb, yb):
+    loss = ((net(xb) - yb) ** 2).mean()
+    scaler.scale(loss).backward()
+    scaler.step(opt)
+    scaler.update()
+    opt.clear_grad()
+    return loss
+
+mgr = CheckpointManager(ckpt_dir, max_to_keep=2)
+step_fn = train_step
+if mode == "victim":
+    step_fn = ChaosMonkey(at={kill_step: "kill"}).wrap(train_step)
+sup = Supervisor(step_fn,
+                 TrainState(model=net, optimizer=opt, scaler=scaler,
+                            loader=loader),
+                 manager=mgr, save_interval=3)
+start = sup.resume()
+recs, step = [], start
+for xb, yb in loader:
+    if step >= TOTAL:
+        break
+    loss = sup.step(xb, yb)
+    recs.append({"step": step,
+                 "bits": int(np.float32(float(loss)).view(np.int32)),
+                 "scale": float(scaler.get_loss_scaling().numpy())})
+    step += 1
+sup.close()
+with open(out_path, "w") as fh:
+    json.dump({"start": start, "recs": recs}, fh)
+'''
+
+
+def _run_worker(script, mode, out, ckpt, kill_step, expect_kill=False):
+    r = subprocess.run(
+        [sys.executable, str(script), mode, str(out), str(ckpt),
+         str(kill_step)],
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    if expect_kill:
+        assert r.returncode == -9, (r.returncode, r.stderr[-2000:])
+    else:
+        assert r.returncode == 0, r.stderr[-2000:]
+    return r
+
+
+def test_kill_and_resume_bitwise_equal(tmp_path):
+    """SIGKILL at step 9 of 12 (mid-epoch 2, between cadence saves):
+    the relaunched run must resume from the last durable checkpoint and
+    every overlapping step's loss must be bitwise-identical to the
+    uninterrupted baseline — dataloader cursor, PRNG chain, Adam
+    moments and loss-scaler state all restored exactly."""
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    base_out = tmp_path / "baseline.json"
+    _run_worker(script, "baseline", base_out, tmp_path / "ck_base", 0)
+    baseline = json.load(open(base_out))
+    assert baseline["start"] == 0 and len(baseline["recs"]) == 12
+
+    kill_out = tmp_path / "victim.json"
+    _run_worker(script, "victim", kill_out, tmp_path / "ck", 9,
+                expect_kill=True)
+    assert not kill_out.exists()        # SIGKILL: no flush, no atexit
+
+    res_out = tmp_path / "resume.json"
+    _run_worker(script, "resume", res_out, tmp_path / "ck", 0)
+    resumed = json.load(open(res_out))
+    # resumed from a durable checkpoint (cadence saves at steps 2/5/8;
+    # the step-8 save is async, so the kill may race its commit — the
+    # resume point is whichever step COMMITted, never a torn one)
+    assert resumed["start"] in (6, 9), resumed["start"]
+    assert resumed["recs"][-1]["step"] == 11
+
+    by_step = {r["step"]: r for r in baseline["recs"]}
+    for rec in resumed["recs"]:
+        want = by_step[rec["step"]]
+        assert rec["bits"] == want["bits"], (
+            f"step {rec['step']}: resumed loss bits {rec['bits']:#x} != "
+            f"baseline {want['bits']:#x}")
+        assert rec["scale"] == want["scale"]
+
+
+@pytest.mark.slow
+def test_kill_window_sweep_never_loads_torn_state(tmp_path):
+    """Soak: SIGKILL the victim at several points (including mid-
+    checkpoint-write) — whatever the instant, the resumed run must find
+    an intact checkpoint (or start fresh) and finish bitwise-correct."""
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    base_out = tmp_path / "baseline.json"
+    _run_worker(script, "baseline", base_out, tmp_path / "ck_base", 0)
+    by_step = {r["step"]: r
+               for r in json.load(open(base_out))["recs"]}
+    for kill_step in (3, 6, 10):
+        ck = tmp_path / f"ck_{kill_step}"
+        _run_worker(script, "victim", tmp_path / "v.json", ck, kill_step,
+                    expect_kill=True)
+        out = tmp_path / f"resume_{kill_step}.json"
+        _run_worker(script, "resume", out, ck, 0)
+        resumed = json.load(open(out))
+        assert resumed["recs"][-1]["step"] == 11
+        for rec in resumed["recs"]:
+            assert rec["bits"] == by_step[rec["step"]]["bits"], kill_step
+
+
+# ---------------------------------------------------------------------------
+# 8 -> 4 virtual-device re-mesh restore
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(
+    not os.environ.get("JAX_PLATFORMS", "").startswith("cpu"),
+    reason="needs the 8-device CPU mesh")
+def test_remesh_restore_8_to_4_devices(tmp_path):
+    """A snapshot sharded over all 8 virtual devices restores onto a
+    4-device mesh via the template — the scale-in path after losing
+    half the fleet."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed.mesh import build_mesh
+
+    mesh8 = build_mesh(dp=2, tp=2, sharding=2)
+    w = jax.device_put(np.arange(64, dtype=np.float32).reshape(8, 8),
+                       NamedSharding(mesh8, P(("dp", "sharding"), "tp")))
+    mgr = CheckpointManager(tmp_path / "ck")
+    mgr.save(5, {"w": w, "m": jnp.float32(3.0)}, async_save=False)
+
+    mesh4 = Mesh(np.asarray(jax.devices()[:4]).reshape(4), ("dp",))
+    tmpl = {"w": jax.ShapeDtypeStruct(
+                (8, 8), jnp.float32,
+                sharding=NamedSharding(mesh4, P("dp", None))),
+            "m": jax.ShapeDtypeStruct((), jnp.float32)}
+    step, out = mgr.restore_latest(tmpl)
+    assert step == 5
+    np.testing.assert_array_equal(
+        np.asarray(out["w"]),
+        np.arange(64, dtype=np.float32).reshape(8, 8))
+    got = out["w"].sharding
+    assert isinstance(got, NamedSharding)
+    assert got.mesh.devices.size == 4 and got.spec == P("dp", None)
+    assert float(out["m"]) == 3.0
+
+
+# ---------------------------------------------------------------------------
+# static-program (_ReplayPlan) snapshot path
+# ---------------------------------------------------------------------------
+
+def test_supervisor_wraps_static_executor_train(tmp_path):
+    """The compiled fluid-style Executor (_ReplayPlan) train loop
+    snapshots through TrainState: restoring a checkpoint mid-run makes
+    the compiled plan replay the exact loss trajectory — the donated
+    functional state re-gathers from the restored params/moments."""
+    from paddle_tpu import static
+
+    paddle.seed(0)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(4, 8)
+            self.l2 = nn.Linear(8, 1)
+
+        def forward(self, v):
+            return self.l2(paddle.nn.functional.relu(self.l1(v)))
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        yt = static.data("y", [None, 1], "float32")
+        net = Net()
+        loss = ((net(x) - yt) ** 2).mean()
+        opt = optimizer.Adam(learning_rate=0.05,
+                             parameters=net.parameters())
+        opt.minimize(loss)
+    exe = static.Executor()
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(16, 4)).astype(np.float32)
+    ys = rng.normal(size=(16, 1)).astype(np.float32)
+
+    def train_step():
+        (lv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        return float(np.asarray(lv))
+
+    state = TrainState(model=net, optimizer=opt, program=main)
+    mgr = CheckpointManager(tmp_path / "ck", max_to_keep=2)
+    sup = Supervisor(train_step, state, manager=mgr, save_interval=2)
+    for _ in range(4):
+        sup.step()
+    sup.close()
+    tail_a = [train_step() for _ in range(2)]
+    # roll back to the step-3 checkpoint and replay: identical losses
+    step, snap = mgr.restore_latest(state.capture())
+    assert step == 3
+    state.restore(snap)
+    tail_b = [train_step() for _ in range(2)]
+    assert tail_a == tail_b
+
+
+# ---------------------------------------------------------------------------
+# chaos_train CLI smoke (the tier-1 wiring for tools/chaos_train.py)
+# ---------------------------------------------------------------------------
+
+def test_chaos_train_cli_smoke(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import chaos_train
+    finally:
+        sys.path.pop(0)
+    rc = chaos_train.main(["--fault", "nan", "--step", "3", "--json",
+                           "--workdir", str(tmp_path)])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and rec["ok"]
+    assert rec["fired"] == [[3, "nan"]] and rec["skipped"] == 1
+
+
+@pytest.mark.slow
+def test_chaos_train_cli_kill_roundtrip(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import chaos_train
+    finally:
+        sys.path.pop(0)
+    rc = chaos_train.main(["--fault", "kill", "--step", "5", "--json",
+                           "--workdir", str(tmp_path)])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and rec["victim_sigkilled"] and rec["resumed_from"] > 0
+
+
+# ---------------------------------------------------------------------------
+# tpu_lint non-atomic-write rule
+# ---------------------------------------------------------------------------
+
+class TestNonAtomicWriteRule:
+    def _lint(self, tmp_path, body):
+        from paddle_tpu import analysis
+
+        d = tmp_path / "resilience"         # in-scope module path
+        d.mkdir(exist_ok=True)
+        p = d / "mod.py"
+        p.write_text(body)
+        return [f for f in analysis.selflint([str(p)]).findings
+                if f.rule_id == "non-atomic-write"]
+
+    def test_positive_in_place_write(self, tmp_path):
+        hits = self._lint(tmp_path, (
+            "def save_state(path, blob):\n"
+            "    with open(path, 'wb') as f:\n"
+            "        f.write(blob)\n"))
+        assert len(hits) == 1
+
+    def test_negative_tmp_plus_rename(self, tmp_path):
+        assert not self._lint(tmp_path, (
+            "import os\n"
+            "def save_state(path, blob):\n"
+            "    tmp = path + '.tmp'\n"
+            "    with open(tmp, 'wb') as f:\n"
+            "        f.write(blob)\n"
+            "    os.replace(tmp, path)\n"))
+
+    def test_negative_out_of_scope_module(self, tmp_path):
+        p = tmp_path / "vision_mod.py"      # not a checkpoint-path module
+        p.write_text("def f(path, b):\n"
+                     "    with open(path, 'wb') as f:\n"
+                     "        f.write(b)\n")
+        from paddle_tpu import analysis
+
+        assert not [f for f in analysis.selflint([str(p)]).findings
+                    if f.rule_id == "non-atomic-write"]
+
+    def test_allow_annotation(self, tmp_path):
+        assert not self._lint(tmp_path, (
+            "def beat(path):\n"
+            "    # tpu_lint: allow(non-atomic-write)\n"
+            "    with open(path, 'w') as f:\n"
+            "        f.write('1')\n"))
+
+    def test_reads_and_appends_not_flagged(self, tmp_path):
+        assert not self._lint(tmp_path, (
+            "def log(path):\n"
+            "    with open(path, 'a') as f:\n"
+            "        f.write('x')\n"
+            "    with open(path) as f:\n"
+            "        return f.read()\n"))
+
+
+# ---------------------------------------------------------------------------
+# profiler surfacing
+# ---------------------------------------------------------------------------
+
+def test_profiler_summary_resilience_line(capsys):
+    from paddle_tpu import profiler
+
+    led = FlightLedger()
+    led.record("step", step=0)
+    led.record("anomaly", kind="nonfinite")
+    led.record("save", step=0, reason="cadence")
+    rc = profiler.resilience_counters()
+    assert rc["ledgers"] >= 1 and rc["anomaly"] >= 1
+    p = profiler.Profiler(timer_only=True)
+    p.start()
+    p.stop()
+    p.summary()
+    out = capsys.readouterr().out
+    assert "resilience:" in out and "anomalies=" in out

@@ -245,3 +245,66 @@ def test_soak_many_requests_random_arrivals(model):
         want = np.asarray(gpt.generate(paddle.to_tensor(p[None]),
                                        max_new_tokens=5)._data)[0, len(p):]
         np.testing.assert_array_equal(np.asarray(h.tokens, np.int32), want)
+
+
+def test_deadline_frees_slot_and_raises_timeout(model):
+    """Graceful degradation: a request whose max_time_s expires mid-
+    decode frees its KV slot at the next step and result() raises
+    RequestTimeout instead of occupying the engine forever."""
+    from paddle_tpu.serving import RequestTimeout
+
+    eng = Engine(model, n_slots=2, max_len=64, min_prompt_bucket=4)
+    p = _prompts([5], np.random.default_rng(9))[0]
+    h = eng.submit(p, max_new_tokens=40, max_time_s=1e-4)
+    assert h.slot is not None
+    import time as _time
+    _time.sleep(0.01)                  # let the deadline lapse
+    eng.step()
+    assert h.finished and h.finish_reason == "timeout"
+    with pytest.raises(RequestTimeout):
+        h.result()
+    assert eng.cache.n_free == eng.n_slots          # slot reclaimed
+    assert eng.stats()["requests_timed_out"] == 1
+    # the engine keeps serving: a healthy request still completes
+    h2 = eng.submit(p, max_new_tokens=3)
+    np.testing.assert_array_equal(
+        np.asarray(h2.result()[len(p):], np.int32), _want(model, p, 3))
+
+
+def test_deadline_expires_queued_request_without_slot(model):
+    """A deadline can lapse while the request is still queued: it drops
+    out of the FIFO without ever holding a slot or budget share."""
+    from paddle_tpu.serving import RequestTimeout
+
+    eng = Engine(model, n_slots=1, max_len=64, min_prompt_bucket=4)
+    rng = np.random.default_rng(10)
+    p = _prompts([5], rng)[0]
+    hog = eng.submit(p, max_new_tokens=8)           # owns the only slot
+    waiting = eng.submit(p, max_new_tokens=8, max_time_s=1e-4)
+    assert waiting.slot is None
+    import time as _time
+    _time.sleep(0.01)
+    eng.step()
+    assert waiting.finished and waiting.finish_reason == "timeout"
+    assert eng.scheduler.queue_depth == 0
+    with pytest.raises(RequestTimeout):
+        waiting.result()
+    hog.result()                                    # unaffected
+    assert hog.finish_reason == "length"
+
+
+def test_overload_message_carries_retry_after_hint(model):
+    """EngineOverloaded carries a retry-after estimate once the engine
+    has decode-latency history (live ITL x shortest active request)."""
+    eng = Engine(model, n_slots=1, max_len=64, min_prompt_bucket=4,
+                 max_queue=1)
+    rng = np.random.default_rng(11)
+    p = _prompts([5], rng)[0]
+    eng.submit(p, max_new_tokens=6)
+    eng.step()                                      # ITL history exists
+    eng.submit(p, max_new_tokens=6)                 # fills the queue
+    with pytest.raises(EngineOverloaded) as ei:
+        eng.submit(p, max_new_tokens=6)
+    assert ei.value.retry_after_s is not None and ei.value.retry_after_s > 0
+    assert "retry after" in str(ei.value)
+    assert eng.metrics.itl_estimate() is not None
