@@ -767,27 +767,64 @@ def _backend_or_die(timeout_s=240):
                    f"{timeout_s}s (TPU tunnel unreachable)")
 
 
-def _run_guarded(fn, backend, deadline_s):
+def _run_guarded(fn, backend, deadline_s, retries=None):
     """Run one bench on a daemon thread with a deadline: a wedged TPU
     tunnel mid-computation must not hang the whole bench (the thread
     leaks if stuck, but the process exits after the JSON line is
-    printed). Exceptions are recorded, distinct from stalls."""
+    printed). Exceptions are recorded, distinct from stalls.
+
+    Supervisor-style retry ladder (ROADMAP item 5, the r02–r05 stale-
+    replay debt): a stalled or raising bench gets PADDLE_TPU_BENCH_RETRIES
+    fresh attempts with backoff — the deadline window is split across
+    them — BEFORE falling back to last-good session replay, and a
+    recovered result records ``retried: true`` + ``attempts`` so the
+    ledger shows the wedge instead of silently replaying stale data."""
     import threading
 
-    box = {}
+    if retries is None:
+        retries = int(os.environ.get("PADDLE_TPU_BENCH_RETRIES", "1"))
+    backoff_s = float(os.environ.get("PADDLE_TPU_BENCH_RETRY_BACKOFF_S",
+                                     "10"))
+    t_start = time.perf_counter()
+    errors = []
+    for attempt in range(retries + 1):
+        remaining = deadline_s - (time.perf_counter() - t_start)
+        attempts_left = retries + 1 - attempt
+        attempt_deadline = remaining / attempts_left
+        if attempt_deadline < 30.0:
+            if attempt == 0:
+                attempt_deadline = remaining   # never skip the first try
+            else:
+                break                          # window too small to retry
+        box = {}
 
-    def work():
-        try:
-            box["result"] = fn(backend)
-        except Exception as e:
-            box["result"] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
-            traceback.print_exc(file=sys.stderr)
+        def work():
+            try:
+                box["result"] = fn(backend)
+            except Exception as e:
+                box["result"] = {
+                    "error": f"{type(e).__name__}: {str(e)[:200]}"}
+                traceback.print_exc(file=sys.stderr)
 
-    t = threading.Thread(target=work, daemon=True)
-    t.start()
-    t.join(deadline_s)
-    return box.get("result", {"error": f"timed out after {deadline_s:.0f}s "
-                                       "(TPU tunnel stall?)"})
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        t.join(attempt_deadline)
+        result = box.get("result",
+                         {"error": f"timed out after {attempt_deadline:.0f}s "
+                                   "(TPU tunnel stall?)"})
+        if "error" not in result:
+            if attempt:
+                result = dict(result, retried=True, attempts=attempt + 1,
+                              retry_errors=errors)
+            return result
+        errors.append(result["error"])
+        if attempt < retries:
+            print(f"bench attempt {attempt + 1}/{retries + 1} failed "
+                  f"({result['error']}); retrying after backoff",
+                  file=sys.stderr)
+            time.sleep(backoff_s * (attempt + 1))
+    return {"error": errors[-1], "attempts": len(errors),
+            "retried": len(errors) > 1, "retry_errors": errors[:-1]}
 
 
 def main():
@@ -893,10 +930,14 @@ def main():
                 and "skipped" not in v:
             # merge the last good measurement instead of blanking the
             # entry — one tunnel stall must not erase the secondary
-            # table. stale marks it as replayed, stall records why.
+            # table. stale marks it as replayed, stall records why, and
+            # retried/attempts record that the supervisor ladder ran
+            # before the replay (not merely a silent stale copy).
             secondary[k] = {**v, "stale": True,
                             "replayed_from_session": True,
-                            "stall": cur.get("error") or cur.get("skipped")}
+                            "stall": cur.get("error") or cur.get("skipped"),
+                            "retried": bool(cur.get("retried")),
+                            "attempts": cur.get("attempts", 1)}
     if isinstance(kernels, dict) and ("error" in kernels
                                       or "skipped" in kernels) \
             and isinstance(last.get("kernels"), dict):

@@ -238,17 +238,30 @@ def audit_engine(engine, compile_budget=None, rules=None,
                  lower_decode=True) -> Report:
     """Audit a serving Engine: compile-count budget, bucket/KV geometry,
     donation policy — plus, when possible, the lowered decode program
-    itself (dtype / padding rules see real HLO)."""
+    itself (dtype / padding rules see real HLO).
+
+    Accepts a ``serving.resilience.EngineSupervisor`` too: the live
+    engine incarnation is audited, and the compile budget accounts the
+    UNION of prefill buckets across every rebuilt incarnation — an
+    in-process rebuild re-traces nothing (module-level jit cache), but a
+    fresh process pays the union, so that is the honest budget."""
     import jax
 
     from .engine_support import engine_donates, lower_decode_program
 
+    supervisor = None
+    if hasattr(engine, "buckets_seen_total") and hasattr(engine, "engine"):
+        supervisor = engine
+        engine = supervisor.engine
+    buckets = set(engine.buckets_seen)
+    if supervisor is not None:
+        buckets |= supervisor.buckets_seen_total
     meta = {
         "n_slots": engine.n_slots, "max_len": engine.max_len,
         "min_prompt_bucket": engine.min_prompt_bucket,
-        "buckets_seen": sorted(engine.buckets_seen),
+        "buckets_seen": sorted(buckets),
         "decode_used": engine.metrics.decode_steps > 0
-        or bool(engine.buckets_seen),
+        or bool(buckets),
         "compile_budget": (compile_budget if compile_budget is not None
                            else engine.compile_budget),
         "backend": jax.default_backend(),
@@ -256,6 +269,9 @@ def audit_engine(engine, compile_budget=None, rules=None,
         "kv_heads": engine.cache.kv_heads,
         "head_dim": engine.cache.head_dim,
     }
+    if supervisor is not None:
+        meta["supervisor"] = {"rebuilds": supervisor.rebuilds,
+                              "replayed": supervisor.replayed}
     text = None
     if lower_decode:
         try:

@@ -22,7 +22,8 @@ from .timer import Benchmark, benchmark  # noqa: F401
 
 __all__ = [
     "Benchmark", "benchmark", "dispatch_counters", "serving_counters",
-    "resilience_counters", "ProfilerState", "ProfilerTarget",
+    "resilience_counters", "serving_resilience_counters",
+    "ProfilerState", "ProfilerTarget",
     "make_scheduler", "export_chrome_tracing", "export_protobuf",
     "Profiler", "RecordEvent", "RecordInstantEvent",
     "load_profiler_result", "SortedKeys",
@@ -52,10 +53,21 @@ def serving_counters() -> dict:
 def resilience_counters() -> dict:
     """Aggregate flight-ledger event counts across every live
     ``paddle_tpu.resilience`` ledger/supervisor (steps, anomalies,
-    saves, restores, rollbacks, aborts)."""
+    saves, restores, rollbacks, aborts). Serving-side supervisors keep
+    their own ledgers under scope "serving" — see
+    :func:`serving_resilience_counters`."""
     from ..resilience import ledger as resilience_ledger
 
-    return resilience_ledger.global_counters()
+    return resilience_ledger.global_counters(scope="train")
+
+
+def serving_resilience_counters() -> dict:
+    """Aggregate serving-engine supervisor counters across every live
+    ``serving.resilience.EngineSupervisor`` (rebuilds, token-identical
+    replays, wedges, KV corruptions, brownout sheds, drains)."""
+    from ..serving import resilience as serving_resilience
+
+    return serving_resilience.global_counters()
 
 
 class ProfilerState(Enum):
@@ -241,6 +253,18 @@ class Profiler:
                   f"restores={rc.get('resume', 0)} "
                   f"rollbacks={rc.get('rollback', 0)} "
                   f"aborts={rc.get('abort', 0)}")
+        sv = serving_resilience_counters()
+        if sv["supervisors"]:
+            print("serving-resilience: "
+                  f"supervisors={sv['supervisors']} "
+                  f"rebuilds={sv['rebuilds']} "
+                  f"replayed={sv['replayed']} "
+                  f"wedges={sv['wedges']} "
+                  f"step_errors={sv['step_errors']} "
+                  f"kv_corruptions={sv['kv_corruptions']} "
+                  f"shed={sv['shed']} "
+                  f"abandoned={sv['abandoned']} "
+                  f"drains={sv['drains']}")
         from ..analysis import findings_summary
         fs = findings_summary()
         if fs:

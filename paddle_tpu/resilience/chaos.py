@@ -20,6 +20,18 @@ Faults
 ``corrupt``  the newest committed checkpoint gets one shard truncated
              (restore must detect the bad checksum and fall back)
 
+Serving faults (consumed by ``serving.resilience.EngineSupervisor`` via
+:meth:`ChaosMonkey.take` — the supervisor, not the monkey, performs the
+injection because each fault manipulates live engine state):
+
+``decode-stall``   the fused decode step wedges past its deadline then
+                   fails (TPU-tunnel analog on the serving path)
+``decode-raise``   the decode step raises (transient device/RPC error)
+``kv-corrupt``     an active KV slot's attendable lines are poisoned in
+                   place (:func:`corrupt_kv`); the supervisor's probe
+                   must catch it before the next decode consumes it
+``abandon``        a client abandons an in-flight request mid-stream
+
 Schedules are explicit (``at={step: fault}``) or drawn from a seeded RNG
 (``p`` per-step probability over ``faults``); both are pure functions of
 the constructor arguments.
@@ -33,6 +45,7 @@ import time
 import numpy as np
 
 FAULTS = ("nan", "stall", "error", "kill", "corrupt")
+SERVING_FAULTS = ("decode-stall", "decode-raise", "kv-corrupt", "abandon")
 
 
 class ChaosError(RuntimeError):
@@ -62,9 +75,10 @@ class ChaosMonkey:
         self.manager = manager
         self.calls = 0
         self.fired = []                 # [(step, fault)]
-        for f in dict(at or {}).values():
-            if f not in FAULTS:
-                raise ValueError(f"unknown fault {f!r} (one of {FAULTS})")
+        known = FAULTS + SERVING_FAULTS
+        for f in tuple(dict(at or {}).values()) + tuple(faults):
+            if f not in known:
+                raise ValueError(f"unknown fault {f!r} (one of {known})")
         self.plan = {int(k): v for k, v in (at or {}).items()}
         if p > 0.0:
             rng = np.random.default_rng(self.seed)
@@ -77,6 +91,19 @@ class ChaosMonkey:
     def schedule(self, n_steps: int):
         """The fault plan restricted to the first ``n_steps`` steps."""
         return {s: f for s, f in sorted(self.plan.items()) if s < n_steps}
+
+    def take(self):
+        """Consume one supervised step's planned fault (or None) without
+        executing it — the serving EngineSupervisor drives injection
+        itself because serving faults manipulate live engine state.
+        Counts an invocation exactly like :meth:`wrap`'s chaotic step,
+        so the Nth supervised step meets the fault planned for step N."""
+        step = self.calls
+        self.calls += 1
+        fault = self.plan.get(step)
+        if fault is not None:
+            self.fired.append((step, fault))
+        return fault
 
     def wrap(self, step_fn):
         def chaotic_step(*args, **kwargs):
@@ -169,3 +196,24 @@ def corrupt_latest(manager, seed: int = 0, mode: str = "truncate"):
     return corrupt_checkpoint(
         os.path.join(manager.directory, f"ckpt-{step}"), seed=seed,
         mode=mode)
+
+
+def corrupt_kv(engine, seed: int = 0, value: float = float("nan")):
+    """Serving-side corruption analog (chaos fault ``kv-corrupt``):
+    poison one deterministically chosen active KV slot's attendable
+    lines in place. The EngineSupervisor's finiteness probe must catch
+    this BEFORE the next decode step consumes it; rebuild-and-replay
+    then *heals* the slot by recomputing its KV from the request's own
+    prompt + emitted-token history. Returns the poisoned slot index."""
+    import jax.numpy as jnp
+
+    active = np.nonzero(engine.cache.active)[0]
+    if active.size == 0:
+        raise ValueError("no active slots to corrupt")
+    rng = np.random.default_rng(seed)
+    slot = int(active[int(rng.integers(active.size))])
+    lines = max(int(engine.cache.cur_pos[slot]), 1)
+    kc = np.asarray(engine.cache.kc).copy()
+    kc[:, slot, :lines] = value
+    engine.cache.kc = jnp.asarray(kc)
+    return slot

@@ -30,9 +30,13 @@ class FlightLedger:
     ``resume``.
     """
 
-    def __init__(self, path=None, max_records: int = 2048):
+    def __init__(self, path=None, max_records: int = 2048,
+                 scope: str = "train"):
         self.path = os.path.abspath(path) if path else None
         self.max_records = int(max_records)
+        # which profiler line aggregates this ledger: "train" feeds the
+        # `resilience:` summary, "serving" the `serving-resilience:` one
+        self.scope = str(scope)
         self._ring = collections.deque(maxlen=self.max_records)
         self._file_lines = 0
         if self.path:
@@ -110,9 +114,12 @@ def _register(ledger):
     _LEDGERS.append(weakref.ref(ledger))
 
 
-def global_counters():
+def global_counters(scope=None):
     """Aggregate event counts across every live ledger (profiler
-    plumbing — the ``resilience:`` line in Profiler.summary())."""
+    plumbing — the ``resilience:`` line in Profiler.summary()).
+    ``scope`` filters to ledgers created with that scope tag ("train"
+    supervisors vs "serving" engine supervisors) so each profiler line
+    aggregates only its own subsystem; None sums everything."""
     total = {"ledgers": 0}
     live = []
     for ref in _LEDGERS:
@@ -120,6 +127,8 @@ def global_counters():
         if led is None:
             continue
         live.append(ref)
+        if scope is not None and getattr(led, "scope", "train") != scope:
+            continue
         total["ledgers"] += 1
         for event, n in led.counts().items():
             total[event] = total.get(event, 0) + n

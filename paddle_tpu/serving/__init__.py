@@ -17,17 +17,29 @@ Quick start::
 
 For a saved artifact, ``save_lm(model, path)`` then
 ``paddle_tpu.inference.create_llm_predictor(path)``.
+
+Production deployments wrap the engine in
+``EngineSupervisor`` (serving/resilience.py): wedged/crashed decode
+steps rebuild the engine and replay in-flight requests
+token-identically; overload degrades gracefully via priority/EDF
+admission, brownout shedding and ``drain()``.
 """
 from __future__ import annotations
 
-from .engine import Engine, RequestHandle, RequestTimeout   # noqa: F401
+from .engine import (Engine, RequestCancelled, RequestHandle,  # noqa: F401
+                     RequestShed, RequestTimeout)
 from .kv_cache import SlotKVCache                           # noqa: F401
 from .metrics import EngineMetrics, RequestMetrics, ledger  # noqa: F401
-from .scheduler import EngineOverloaded, FIFOScheduler      # noqa: F401
+from .resilience import (EngineDraining, EngineSupervisor,  # noqa: F401
+                         ServingAborted)
+from .scheduler import (EngineOverloaded, FIFOScheduler,    # noqa: F401
+                        PriorityScheduler)
 
-__all__ = ["Engine", "RequestHandle", "RequestTimeout", "SlotKVCache",
-           "EngineMetrics", "RequestMetrics", "ledger", "EngineOverloaded",
-           "FIFOScheduler", "save_lm"]
+__all__ = ["Engine", "RequestHandle", "RequestTimeout", "RequestShed",
+           "RequestCancelled", "SlotKVCache", "EngineMetrics",
+           "RequestMetrics", "ledger", "EngineOverloaded", "FIFOScheduler",
+           "PriorityScheduler", "EngineSupervisor", "ServingAborted",
+           "EngineDraining", "save_lm"]
 
 
 def save_lm(model, path):

@@ -39,9 +39,18 @@ import jax.numpy as jnp
 from ..tensor import Tensor
 from .kv_cache import SlotKVCache
 from .metrics import EngineMetrics, RequestMetrics
-from .scheduler import EngineOverloaded, FIFOScheduler  # noqa: F401
+from .scheduler import (EngineOverloaded, FIFOScheduler,  # noqa: F401
+                        PriorityScheduler)
 
-__all__ = ["Engine", "RequestHandle", "EngineOverloaded", "RequestTimeout"]
+__all__ = ["Engine", "RequestHandle", "EngineOverloaded", "RequestTimeout",
+           "RequestShed", "RequestCancelled", "DEFAULT_RETRY_AFTER_S"]
+
+#: Conservative retry-after hint (seconds) when the engine has no basis
+#: for a live estimate — a cold engine (no decode history yet) or an
+#: idle one (nothing active, the queue blocked on the token watermark).
+#: Roughly one prefill + a few decode steps on any real deployment;
+#: overridable per engine via ``Engine(default_retry_after_s=...)``.
+DEFAULT_RETRY_AFTER_S = 1.0
 
 
 class RequestTimeout(TimeoutError):
@@ -50,17 +59,42 @@ class RequestTimeout(TimeoutError):
     Tokens generated before the deadline remain on ``handle.tokens``."""
 
 
+class RequestShed(RuntimeError):
+    """The request was evicted from the queue by overload brownout
+    (``serving.resilience.EngineSupervisor`` past its ITL SLO): retry
+    after ``retry_after_s`` seconds, by which point the engine expects
+    to be back under its latency target."""
+
+    def __init__(self, message, retry_after_s=None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class RequestCancelled(RuntimeError):
+    """The request was cancelled (client abandoned the stream) before
+    finishing; tokens generated before cancellation stay on
+    ``handle.tokens``."""
+
+
 # ---------------------------------------------------------------------------
 # jitted programs (module-level: every Engine over the same model/geometry
 # shares the compile cache)
 # ---------------------------------------------------------------------------
 
 def _prefill_impl(w, kc, vc, tok, cur_pos, keys, ids, n_prompt, slot, seed,
-                  temp, *, arch, n_heads, n_kv, eps, theta, do_sample,
+                  skip, temp, *, arch, n_heads, n_kv, eps, theta, do_sample,
                   top_k, top_p):
     """Prefill one request (ids [1, Lb], right-padded to its bucket) into
     KV slot ``slot``, sample its first token, and register the request's
-    PRNG chain. One compile per bucket length Lb."""
+    PRNG chain. One compile per bucket length Lb.
+
+    ``skip`` (int32 operand, 0 on normal admission) is the supervisor
+    replay path: the admission-seeded key chain is fast-forwarded past
+    the ``skip`` splits the crashed engine incarnation already consumed,
+    so a request re-prefilled as ``prompt + tokens_emitted_so_far``
+    samples its next token with exactly the key the uninterrupted run
+    would have used. Being a runtime operand, replay shares the ONE
+    prefill program per bucket with normal admission."""
     from ..text import generation as G
 
     Lb = ids.shape[1]
@@ -97,6 +131,8 @@ def _prefill_impl(w, kc, vc, tok, cur_pos, keys, ids, n_prompt, slot, seed,
     vc = jax.lax.dynamic_update_slice(vc, kvs[1], (0, slot, 0, 0, 0))
 
     key = jax.random.PRNGKey(seed)
+    key = jax.lax.fori_loop(0, skip,
+                            lambda _, k: jax.random.split(k)[0], key)
     key, sk = jax.random.split(key)
     logits_f = G._filter_logits(logits0[None], temp, do_sample, top_k,
                                 top_p)
@@ -213,7 +249,7 @@ class RequestHandle:
     """
 
     def __init__(self, engine, request_id, prompt_ids, max_new_tokens,
-                 temperature, seed, on_token, max_time_s=None):
+                 temperature, seed, on_token, max_time_s=None, priority=0):
         self._engine = engine
         self.request_id = request_id
         self.prompt_ids = prompt_ids
@@ -222,12 +258,15 @@ class RequestHandle:
         self.temperature = float(temperature)
         self.seed = int(seed)
         self.on_token = on_token
+        self.priority = int(priority)
         self.max_time_s = None if max_time_s is None else float(max_time_s)
         self.deadline = (None if max_time_s is None
                          else time.monotonic() + float(max_time_s))
         self.tokens = []
         self.finished = False
-        self.finish_reason = None      # "eos" | "length" | "timeout"
+        # "eos" | "length" | "timeout" | "shed" | "cancelled"
+        self.finish_reason = None
+        self.retry_after_s = None      # stamped when shed under brownout
         self.slot = None
         self.metrics = RequestMetrics()
 
@@ -239,6 +278,15 @@ class RequestHandle:
                 f"request {self.request_id} exceeded max_time_s="
                 f"{self.max_time_s} after {len(self.tokens)} tokens; "
                 "its slot was reclaimed")
+        if self.finish_reason == "shed":
+            raise RequestShed(
+                f"request {self.request_id} (priority {self.priority}) "
+                f"was shed under overload; retry after "
+                f"{self.retry_after_s}s", retry_after_s=self.retry_after_s)
+        if self.finish_reason == "cancelled":
+            raise RequestCancelled(
+                f"request {self.request_id} was cancelled after "
+                f"{len(self.tokens)} tokens")
         return np.concatenate(
             [self.prompt_ids, np.asarray(self.tokens, np.int32)])
 
@@ -260,7 +308,8 @@ class Engine:
     def __init__(self, model, n_slots=8, max_len=None, *, do_sample=False,
                  top_k=0, top_p=None, eos_token_id=None,
                  min_prompt_bucket=8, token_budget=None, max_queue=None,
-                 base_seed=0, donate=None, compile_budget=None):
+                 base_seed=0, donate=None, compile_budget=None,
+                 default_retry_after_s=DEFAULT_RETRY_AFTER_S):
         self._w, self._hp, geo = _make_arch(model)
         self.n_slots = int(n_slots)
         self.max_len = int(max_len if max_len is not None
@@ -280,9 +329,16 @@ class Engine:
         self._cur = np.zeros(self.n_slots, np.int32)
         self._keys = np.zeros((self.n_slots, 2), np.uint32)
         self._temps = np.ones(self.n_slots, np.float32)
-        self.scheduler = FIFOScheduler(
+        # PriorityScheduler degenerates to strict FIFO when every request
+        # uses the default priority and carries no deadline
+        self.scheduler = PriorityScheduler(
             token_budget=token_budget or self.n_slots * self.max_len,
             max_queue=max_queue or max(4 * self.n_slots, 16))
+        self.default_retry_after_s = float(default_retry_after_s)
+        # flipped by serving.resilience.EngineSupervisor when this
+        # incarnation is replaced after a fault: an abandoned wedged step
+        # thread that later unblocks must not mutate replayed handles
+        self._condemned = False
         self.metrics = EngineMetrics()
         self._by_slot = [None] * self.n_slots
         self._next_id = 0
@@ -320,7 +376,7 @@ class Engine:
         return ids
 
     def submit(self, prompt, max_new_tokens=32, temperature=1.0,
-               seed=None, on_token=None, max_time_s=None):
+               seed=None, on_token=None, max_time_s=None, priority=0):
         """Enqueue a request; returns a RequestHandle immediately. The
         request prefills as soon as a slot + token budget admit it (often
         inside this call). Raises EngineOverloaded past max_queue.
@@ -329,7 +385,14 @@ class Engine:
         decoding: a request still unfinished when it expires frees its
         KV slot at the next step and ``result()`` raises
         :class:`RequestTimeout` — a wedged or runaway request can never
-        occupy the engine forever."""
+        occupy the engine forever.
+
+        ``priority`` is the admission class (0 = most important): lower
+        numbers admit first, and overload brownout
+        (:class:`~paddle_tpu.serving.resilience.EngineSupervisor`) sheds
+        the highest-numbered queued classes first. Within a class,
+        deadline-carrying requests admit earliest-deadline-first and
+        the rest keep strict FIFO (see PriorityScheduler)."""
         ids = self._as_ids(prompt)
         if ids.shape[0] < 1:
             raise ValueError("empty prompt")
@@ -344,7 +407,7 @@ class Engine:
         h = RequestHandle(
             self, rid, ids, max_new_tokens, temperature,
             self.base_seed + rid if seed is None else seed, on_token,
-            max_time_s=max_time_s)
+            max_time_s=max_time_s, priority=priority)
         self.metrics.requests_submitted += 1
         try:
             self.scheduler.enqueue(h, retry_after_s=self._retry_after_hint())
@@ -356,14 +419,17 @@ class Engine:
 
     def _retry_after_hint(self):
         """Seconds until a slot plausibly frees: the live inter-token
-        latency times the shortest remaining active request."""
+        latency times the shortest remaining active request. A cold
+        engine (no decode history yet) or an idle one (no active
+        requests — the queue is blocked on the token watermark, not on
+        slots) has no basis for an estimate and returns the documented
+        conservative ``default_retry_after_s``, so clients ALWAYS get a
+        finite back-off."""
         itl = self.metrics.itl_estimate()
-        if itl is None:
-            return None
         remaining = [h.max_new_tokens - len(h.tokens)
                      for h in self._by_slot if h is not None]
-        if not remaining:
-            return None
+        if itl is None or not remaining:
+            return self.default_retry_after_s
         return round(itl * max(1, min(remaining)), 3)
 
     def _admit(self):
@@ -382,20 +448,72 @@ class Engine:
         h.slot = slot
         self._by_slot[slot] = h
         self._temps[slot] = h.temperature
-        Lb = self._bucket(h.n_prompt)
+        # supervisor replay (adopt()) re-prefills prompt + the k tokens
+        # the crashed incarnation already emitted and fast-forwards the
+        # PRNG chain k splits — the next sampled token is exactly what
+        # the uninterrupted run would have produced. Normal admission is
+        # the k=0 degenerate case (same program).
+        k = len(h.tokens)
+        n_eff = h.n_prompt + k
+        Lb = self._bucket(n_eff)
         self.buckets_seen.add(Lb)
         ids = np.zeros((1, Lb), np.int32)
         ids[0, :h.n_prompt] = h.prompt_ids
+        if k:
+            ids[0, h.n_prompt:n_eff] = np.asarray(h.tokens, np.int32)
         out = self._prefill(
             self._w, self.cache.kc, self.cache.vc, self._tok,
-            self._cur, self._keys, ids, np.int32(h.n_prompt),
-            np.int32(slot), np.uint32(h.seed),
+            self._cur, self._keys, ids, np.int32(n_eff),
+            np.int32(slot), np.uint32(h.seed), np.int32(k),
             np.float32(h.temperature), **self._statics)
         (self.cache.kc, self.cache.vc, self._tok, self._cur,
          self._keys, tok0) = out
         self.metrics.prefills += 1
-        self.cache.cur_pos[slot] = h.n_prompt
+        self.cache.cur_pos[slot] = n_eff
         self._emit(h, int(tok0))
+
+    def adopt(self, handle):
+        """Re-inject a handle from a previous engine incarnation
+        (EngineSupervisor rebuild-and-replay): the handle keeps its
+        identity, seed, priority and emitted tokens; admission
+        re-prefills ``prompt + tokens`` and resumes the PRNG chain at
+        the right split index, so decoding continues token-identically
+        to the uninterrupted run."""
+        handle.slot = None
+        handle._engine = self
+        self._next_id = max(self._next_id, handle.request_id + 1)
+        self.metrics.requests_submitted += 1
+        self.scheduler.enqueue(handle,
+                               retry_after_s=self._retry_after_hint())
+        self._admit()
+        return handle
+
+    def cancel(self, handle):
+        """Client abandoned the stream mid-request: a queued handle
+        drops out of the scheduler, an active one frees its KV slot at
+        once (co-batched neighbours untouched — per-request PRNG chains
+        keep their output unchanged). ``result()`` raises
+        :class:`RequestCancelled`. Returns False if already finished."""
+        if handle.finished:
+            return False
+        if handle.slot is None:
+            self.scheduler.remove(handle)
+        self._finish(handle, "cancelled")
+        return True
+
+    def shed_queued(self, protect_priority=0, retry_after_s=None):
+        """Brownout degradation: evict the single lowest-priority class
+        of queued requests (classes <= ``protect_priority`` are never
+        shed). Evicted handles finish with reason ``"shed"`` and their
+        ``result()`` raises :class:`RequestShed` carrying a finite
+        ``retry_after_s``. Returns the evicted handles."""
+        if retry_after_s is None:
+            retry_after_s = self._retry_after_hint()
+        out = self.scheduler.shed_lowest(protect_priority)
+        for h in out:
+            h.retry_after_s = retry_after_s
+            self._finish(h, "shed")
+        return out
 
     # -- the decode loop --------------------------------------------------
 
@@ -415,6 +533,8 @@ class Engine:
         """One engine iteration: expire overdue requests, admit waiting
         ones into free slots, then advance every active slot one token.
         Returns the number of requests that were decoding this step."""
+        if self._condemned:
+            return 0     # a supervisor replaced this engine incarnation
         self._expire()
         self._admit()
         n_active = self.cache.n_active
@@ -436,6 +556,12 @@ class Engine:
         return n_active
 
     def _emit(self, h, token):
+        if self._condemned:
+            # an abandoned wedged step thread unblocked after the
+            # supervisor rebuilt: the handle now lives on the
+            # replacement engine — dropping the stale emission keeps the
+            # replayed stream token-identical
+            return
         h.tokens.append(token)
         h.metrics.mark_token()
         self.metrics.tokens_generated += 1
@@ -457,6 +583,10 @@ class Engine:
             self.scheduler.release(h)
         if reason == "timeout":
             self.metrics.requests_timed_out += 1
+        elif reason == "cancelled":
+            self.metrics.requests_cancelled += 1
+        elif reason == "shed":
+            self.metrics.requests_shed += 1
         else:
             self.metrics.requests_completed += 1
 

@@ -78,6 +78,8 @@ class EngineMetrics:
         self.requests_completed = 0
         self.requests_rejected = 0
         self.requests_timed_out = 0
+        self.requests_cancelled = 0
+        self.requests_shed = 0
         self.tokens_generated = 0
         self.prefills = 0
         self.decode_steps = 0
@@ -108,14 +110,25 @@ class EngineMetrics:
             return None
         return _percentile(self._decode_times, 50)
 
+    def itl_p95(self):
+        """p95 of the rolling decode-step window (seconds) — the tail
+        latency that the brownout SLO in serving.resilience gates on;
+        None before the first decode step."""
+        if not self._decode_times:
+            return None
+        return _percentile(self._decode_times, 95)
+
     def snapshot(self):
         n = max(self.samples, 1)
         itl = self.itl_estimate()
+        p95 = self.itl_p95()
         return {
             "requests_submitted": self.requests_submitted,
             "requests_completed": self.requests_completed,
             "requests_rejected": self.requests_rejected,
             "requests_timed_out": self.requests_timed_out,
+            "requests_cancelled": self.requests_cancelled,
+            "requests_shed": self.requests_shed,
             "tokens_generated": self.tokens_generated,
             "prefills": self.prefills,
             "decode_steps": self.decode_steps,
@@ -124,6 +137,8 @@ class EngineMetrics:
             "peak_queue_depth": self.peak_queue_depth,
             "itl_estimate_ms": (None if itl is None
                                 else round(itl * 1e3, 3)),
+            "itl_p95_ms": (None if p95 is None
+                           else round(p95 * 1e3, 3)),
         }
 
 
@@ -139,6 +154,7 @@ def global_counters():
     total = {
         "engines": 0, "requests_submitted": 0, "requests_completed": 0,
         "requests_rejected": 0, "requests_timed_out": 0,
+        "requests_cancelled": 0, "requests_shed": 0,
         "tokens_generated": 0, "prefills": 0,
         "decode_steps": 0, "peak_queue_depth": 0,
     }
@@ -152,6 +168,7 @@ def global_counters():
         total["engines"] += 1
         for k in ("requests_submitted", "requests_completed",
                   "requests_rejected", "requests_timed_out",
+                  "requests_cancelled", "requests_shed",
                   "tokens_generated", "prefills", "decode_steps"):
             total[k] += s[k]
         total["peak_queue_depth"] = max(total["peak_queue_depth"],

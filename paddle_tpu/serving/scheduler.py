@@ -1,5 +1,10 @@
 """Request admission for the serving engine.
 
+Two schedulers share the guard machinery: :class:`FIFOScheduler`
+(strict arrival order) and :class:`PriorityScheduler` (the Engine
+default — priority classes with EDF deadline ordering within a class,
+falling back to exact FIFO behavior for all-default traffic).
+
 FIFO with two guards:
 
 - **token-budget watermark** — the sum of ``prompt_len + max_new_tokens``
@@ -94,5 +99,69 @@ class FIFOScheduler:
             free_slots -= 1
         return out
 
+    def remove(self, handle):
+        """Drop one queued handle (client abandon). Queued handles hold
+        no budget share, so nothing is released. True if it was
+        queued."""
+        try:
+            self._queue.remove(handle)
+            return True
+        except ValueError:
+            return False
+
+    def shed_lowest(self, protect_priority=0):
+        """Brownout eviction: remove and return every queued handle of
+        the single lowest-priority class present among priorities
+        strictly above ``protect_priority`` — the least important work
+        goes first, one class at a time, and protected classes are
+        never shed."""
+        worst = max((getattr(h, "priority", 0) for h in self._queue
+                     if getattr(h, "priority", 0) > protect_priority),
+                    default=None)
+        if worst is None:
+            return []
+        out = [h for h in self._queue
+               if getattr(h, "priority", 0) == worst]
+        for h in out:
+            self._queue.remove(h)
+        return out
+
     def release(self, handle):
         self._inflight_tokens -= self._load(handle)
+
+
+class PriorityScheduler(FIFOScheduler):
+    """Priority classes + deadline-aware (EDF) admission.
+
+    Queued requests admit in ``(priority, deadline, arrival)`` order: a
+    lower priority number always admits first; within a class, requests
+    carrying wall-clock deadlines run earliest-deadline-first (they are
+    exactly the ones overload would expire while they wait), and
+    deadline-less requests keep strict FIFO arrival order behind them.
+    The token watermark applies to the sorted head exactly as in the
+    FIFO base: the most urgent waiting request blocks admission rather
+    than being overtaken, so a class can never starve its own head.
+    With all-default priorities and no deadlines this degenerates to
+    strict FIFO — the Engine default costs nothing.
+    """
+
+    @staticmethod
+    def _key(h):
+        d = getattr(h, "deadline", None)
+        return (getattr(h, "priority", 0),
+                d if d is not None else float("inf"),
+                getattr(h, "request_id", 0))
+
+    def pop_admissible(self, free_slots):
+        out = []
+        while self._queue and free_slots > 0:
+            head = min(self._queue, key=self._key)
+            need = self._load(head)
+            if self._inflight_tokens + need > self.token_budget and \
+                    self._inflight_tokens > 0:
+                break   # the most urgent request waits; nothing overtakes
+            self._queue.remove(head)
+            out.append(head)
+            self._inflight_tokens += need
+            free_slots -= 1
+        return out

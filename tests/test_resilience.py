@@ -765,3 +765,100 @@ def test_profiler_summary_resilience_line(capsys):
     p.summary()
     out = capsys.readouterr().out
     assert "resilience:" in out and "anomalies=" in out
+
+
+# ---------------------------------------------------------------------------
+# elastic scale-in/out under the supervisor (ROADMAP item 5 leftover)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not os.environ.get("JAX_PLATFORMS", "").startswith("cpu"),
+    reason="needs the 8-device CPU mesh")
+def test_elastic_scale_in_out_under_supervisor(tmp_path):
+    """Multi-host dryrun: membership re-rank drives a re-meshed restore
+    under the supervisor. Two heartbeat nodes train on the 8-device
+    mesh; node b dies -> rerank reports the shrunken world -> a new
+    supervisor resumes the SAME checkpoint onto a 4-device mesh and
+    keeps training; node b returns -> scale back out to 8. Parameter
+    trajectories are elementwise-identical to an uninterrupted single-
+    mesh run throughout (resharding moves bytes, not values)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed.elastic import ElasticMembership
+    from paddle_tpu.distributed.mesh import build_mesh
+
+    init = np.arange(64, dtype=np.float32).reshape(8, 8) / 64.0
+    upd = jax.jit(lambda w: (w * 1.0001 + 0.01, jnp.float32(w.sum())))
+
+    def make(mesh, spec):
+        holder = {"w": jax.device_put(init, NamedSharding(mesh, spec))}
+
+        def train_step():
+            holder["w"], loss = upd(holder["w"])
+            return float(loss)
+
+        state = TrainState(
+            extra_capture=lambda: {"w": holder["w"]},
+            extra_restore=lambda s: holder.__setitem__(
+                "w", jnp.asarray(s["w"])))
+        return holder, train_step, state
+
+    mesh8 = build_mesh(dp=2, tp=2, sharding=2)
+    spec8 = P(("dp", "sharding"), "tp")
+    mesh4 = Mesh(np.asarray(jax.devices()[:4]).reshape(4), ("dp",))
+    spec4 = P("dp", None)
+
+    # uninterrupted baseline on the 8-device mesh
+    bh, bstep, _ = make(mesh8, spec8)
+    base_w = []
+    for _ in range(9):
+        bstep()
+        base_w.append(np.asarray(bh["w"]))
+
+    run_dir = tmp_path / "membership"
+    node_a = ElasticMembership(run_dir, "a", timeout=30.0).register()
+    node_b = ElasticMembership(run_dir, "b", timeout=30.0).register()
+    assert node_a.wait_for(2, timeout=5.0)
+    assert node_a.rerank() == (0, 2) and node_b.rerank() == (1, 2)
+
+    mgr = CheckpointManager(tmp_path / "ck", max_to_keep=3)
+    holder, train_step, state = make(mesh8, spec8)
+    sup = Supervisor(train_step, state, manager=mgr, save_interval=1)
+    start = sup.resume()
+    assert start == 0
+    for _ in range(3):
+        sup.step()
+    sup.close()
+    np.testing.assert_array_equal(np.asarray(holder["w"]), base_w[2])
+
+    # node b dies: re-rank shrinks the world -> re-meshed restore on 4
+    node_b.leave()
+    assert node_a.lost(["a", "b"]) == ["b"]
+    assert node_a.rerank() == (0, 2 - 1)
+    holder, train_step, state = make(mesh4, spec4)
+    sup = Supervisor(train_step, state, manager=mgr, save_interval=1)
+    start = sup.resume()
+    assert start == 3                    # continues, not step 0
+    got = holder["w"]
+    assert got.sharding.mesh.devices.size == 4
+    np.testing.assert_array_equal(np.asarray(got), base_w[2])
+    for _ in range(start, 6):
+        sup.step()
+    sup.close()
+    np.testing.assert_array_equal(np.asarray(holder["w"]), base_w[5])
+
+    # node b comes back: scale OUT, resume the 4-dev checkpoint onto 8
+    node_b.register()
+    assert node_a.rerank() == (0, 2)
+    holder, train_step, state = make(mesh8, spec8)
+    sup = Supervisor(train_step, state, manager=mgr, save_interval=1)
+    start = sup.resume()
+    assert start == 6
+    assert holder["w"].sharding.mesh.devices.size == 8
+    for _ in range(start, 9):
+        sup.step()
+    sup.close()
+    np.testing.assert_array_equal(np.asarray(holder["w"]), base_w[8])

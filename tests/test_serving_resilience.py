@@ -1,0 +1,489 @@
+"""Serving-side fault tolerance (paddle_tpu.serving.resilience).
+
+The headline contract: an engine wedged/killed mid-decode with several
+requests in flight at different positions is rebuilt by the
+EngineSupervisor and every surviving request's full output is
+TOKEN-IDENTICAL to the uninterrupted run — the replay re-prefills
+``prompt + emitted`` and resumes the admission-seeded PRNG chain at the
+correct split index, so even SAMPLED output matches byte for byte.
+Graceful degradation (priority/EDF admission, brownout shedding with a
+finite retry_after_s, drain) and the serving chaos faults ride along.
+
+Kept slim for the tier-1 budget: one module-scope tiny model with the
+same geometry/statics as test_serving_engine.py so the module-level jit
+programs are shared; the kill-sweep soak is marked slow.
+"""
+import dataclasses
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.resilience import SERVING_FAULTS, ChaosMonkey, corrupt_kv
+from paddle_tpu.serving import (Engine, EngineDraining, EngineOverloaded,
+                                EngineSupervisor, PriorityScheduler,
+                                RequestCancelled, RequestShed)
+from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CFG = dataclasses.replace(LLAMA_TINY, dtype="float32", num_hidden_layers=2)
+
+GREEDY = dict(n_slots=2, max_len=64, min_prompt_bucket=4)
+SAMPLED = dict(n_slots=2, max_len=64, min_prompt_bucket=4, do_sample=True,
+               top_k=8)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(CFG)
+    m.eval()
+    return m
+
+
+def _prompts(lens, seed):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, CFG.vocab_size, (n,)).astype(np.int32)
+            for n in lens]
+
+
+def _staggered(server, prompts, gen):
+    """Same staggered submission schedule against an Engine or an
+    EngineSupervisor: ≥3 requests at different decode positions when a
+    mid-run fault fires, plus one still queued behind the 2 slots."""
+    handles = []
+    handles.append(server.submit(prompts[0], **gen[0]))
+    server.step()
+    server.step()
+    handles.append(server.submit(prompts[1], **gen[1]))
+    server.step()
+    handles.append(server.submit(prompts[2], **gen[2]))
+    handles.append(server.submit(prompts[3], **gen[3]))   # queued
+    while any(not h.finished for h in handles):
+        server.step()
+    return handles
+
+
+# ---------------------------------------------------------------------------
+# headline: wedge/crash mid-decode -> rebuild -> token-identical replay
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fault", ["decode-stall", "decode-raise"])
+def test_crash_mid_decode_replays_token_identical(model, fault):
+    """Engine wedged (stall) or crashed (raise) mid-decode with requests
+    at different positions: the supervisor rebuilds and EVERY request's
+    sampled output equals the uninterrupted run exactly — the PRNG
+    chain resumes at the right split index through the re-prefill."""
+    prompts = _prompts([5, 9, 5, 6], seed=1)
+    gen = [dict(max_new_tokens=6, temperature=0.8, seed=11),
+           dict(max_new_tokens=6, temperature=1.2, seed=7),
+           dict(max_new_tokens=5, temperature=0.6, seed=3),
+           dict(max_new_tokens=4, temperature=1.0, seed=23)]
+    base = _staggered(Engine(model, **SAMPLED), prompts, gen)
+    want = [list(h.tokens) for h in base]
+
+    chaos = ChaosMonkey(seed=0, at={4: fault}, stall_s=0.01)
+    sup = EngineSupervisor(model, chaos=chaos, **SAMPLED)
+    got = _staggered(sup, prompts, gen)
+    assert [list(h.tokens) for h in got] == want
+    assert sup.rebuilds == 1 and chaos.fired == [(4, fault)]
+    assert sup.replayed >= 2           # mid-stream handles re-prefilled
+    assert all(h.finish_reason == "length" for h in got)
+    # the supervisor ledger tells the story
+    counts = sup.ledger.counts()
+    assert counts["rebuild"] == 1 and counts["anomaly"] == 1
+
+
+def test_real_wedge_timeout_thread_and_zombie_guard(model):
+    """A decode step that genuinely blocks past step_timeout_s is
+    abandoned (worker-thread join), the engine rebuilt, and the output
+    still token-identical: the condemned incarnation drops the zombie
+    thread's late emissions instead of corrupting replayed handles."""
+    prompts = _prompts([5, 5], seed=2)
+    eng = Engine(model, **GREEDY)
+    b0 = eng.submit(prompts[0], max_new_tokens=5)
+    b1 = eng.submit(prompts[1], max_new_tokens=5)
+    eng.drain()
+    want = [list(b0.tokens), list(b1.tokens)]
+
+    sup = EngineSupervisor(model, step_timeout_s=0.15, **GREEDY)
+    h0 = sup.submit(prompts[0], max_new_tokens=5)
+    h1 = sup.submit(prompts[1], max_new_tokens=5)
+    orig_step = sup.engine.step
+    state = {"blocked": False}
+
+    def wedged_step():
+        if not state["blocked"]:
+            state["blocked"] = True
+            time.sleep(0.8)            # wedge well past the deadline,
+        return orig_step()             # then emit against the condemned
+
+    sup.engine.step = wedged_step
+    while any(not h.finished for h in (h0, h1)):
+        sup.step()
+    assert sup.wedges == 1 and sup.rebuilds == 1
+    assert [list(h0.tokens), list(h1.tokens)] == want
+    time.sleep(0.9)                    # let the zombie thread finish
+    assert [list(h0.tokens), list(h1.tokens)] == want   # no late tokens
+
+
+def test_kv_corrupt_detected_and_healed(model):
+    """KV poisoning is caught by the finiteness probe BEFORE the next
+    decode consumes it; rebuild-and-replay recomputes the slot's KV
+    from the request's own token history, so output stays identical."""
+    prompts = _prompts([5, 9, 5, 6], seed=3)
+    gen = [dict(max_new_tokens=6, temperature=0.8, seed=4),
+           dict(max_new_tokens=6, temperature=1.1, seed=5),
+           dict(max_new_tokens=5, temperature=0.7, seed=6),
+           dict(max_new_tokens=4, temperature=1.0, seed=8)]
+    base = _staggered(Engine(model, **SAMPLED), prompts, gen)
+    want = [list(h.tokens) for h in base]
+
+    chaos = ChaosMonkey(seed=0, at={4: "kv-corrupt"})
+    sup = EngineSupervisor(model, chaos=chaos, kv_probe_interval=1,
+                           **SAMPLED)
+    got = _staggered(sup, prompts, gen)
+    assert sup.kv_corruptions == 1 and sup.rebuilds == 1
+    assert [list(h.tokens) for h in got] == want
+
+
+def test_corrupt_kv_needs_active_slot(model):
+    eng = Engine(model, **GREEDY)
+    with pytest.raises(ValueError):
+        corrupt_kv(eng)
+
+
+# ---------------------------------------------------------------------------
+# client abandon
+# ---------------------------------------------------------------------------
+
+def test_client_abandon_frees_slot_neighbours_unaffected(model):
+    """A client disconnect mid-stream frees the slot immediately;
+    result() raises RequestCancelled; the co-batched neighbour's output
+    is untouched (per-request PRNG chains). A queued handle cancels out
+    of the scheduler without ever taking a slot."""
+    prompts = _prompts([5, 5, 5], seed=4)
+    eng = Engine(model, **GREEDY)
+    ref = eng.submit(prompts[1], max_new_tokens=5)
+    eng.drain()
+
+    sup = EngineSupervisor(model, n_slots=1, max_len=64,
+                           min_prompt_bucket=4)
+    victim = sup.submit(prompts[0], max_new_tokens=8)
+    survivor = sup.submit(prompts[1], max_new_tokens=5)
+    queued = sup.submit(prompts[2], max_new_tokens=5)
+    sup.step()
+    assert victim.slot is not None and survivor.slot is None
+    assert sup.cancel(victim) and not sup.cancel(victim)    # idempotent
+    assert victim.finish_reason == "cancelled"
+    with pytest.raises(RequestCancelled):
+        victim.result()
+    assert sup.cancel(queued)           # cancelled straight out of queue
+    assert sup.engine.scheduler.queue_depth == 1            # survivor
+    np.testing.assert_array_equal(
+        np.asarray(survivor.result()[5:], np.int32),
+        np.asarray(ref.tokens, np.int32))
+    assert sup.engine.metrics.requests_cancelled == 2
+    assert sup.engine.cache.n_free == 1
+
+
+# ---------------------------------------------------------------------------
+# priority classes + EDF admission
+# ---------------------------------------------------------------------------
+
+class _H:
+    _n = 0
+
+    def __init__(self, priority=0, deadline=None, tokens=4):
+        self.priority = priority
+        self.deadline = deadline
+        self.n_prompt, self.max_new_tokens = tokens, 0
+        self.request_id = _H._n
+        _H._n += 1
+
+
+def test_priority_scheduler_edf_within_class_fifo_behind():
+    """Admission order: lower priority class first; EDF among
+    deadline-carrying requests of a class; strict FIFO for the rest.
+    The token watermark still blocks the most urgent head (no
+    overtaking, no starvation)."""
+    s = PriorityScheduler(token_budget=100, max_queue=16)
+    lo_late = _H(priority=2, deadline=50.0)
+    lo_soon = _H(priority=2, deadline=10.0)
+    hi_fifo1 = _H(priority=0)
+    hi_soon = _H(priority=0, deadline=5.0)
+    hi_fifo2 = _H(priority=0)
+    for h in (lo_late, lo_soon, hi_fifo1, hi_soon, hi_fifo2):
+        s.enqueue(h)
+    got = s.pop_admissible(free_slots=5)
+    assert got == [hi_soon, hi_fifo1, hi_fifo2, lo_soon, lo_late]
+
+    # watermark: the urgent head waits, nothing overtakes it
+    s2 = PriorityScheduler(token_budget=10, max_queue=8)
+    big_urgent = _H(priority=0, deadline=1.0, tokens=8)
+    small_low = _H(priority=1, tokens=3)
+    s2.enqueue(small_low)
+    s2.enqueue(big_urgent)
+    first = s2.pop_admissible(free_slots=2)
+    assert first == [big_urgent]        # 8+3 > 10: urgent head only
+    s2.release(big_urgent)
+    assert s2.pop_admissible(2) == [small_low]
+
+    # shedding takes the lowest class only, protected classes never
+    s3 = PriorityScheduler(token_budget=100, max_queue=16)
+    hs = [_H(priority=p) for p in (0, 2, 5, 5, 2)]
+    for h in hs:
+        s3.enqueue(h)
+    shed = s3.shed_lowest(protect_priority=0)
+    assert sorted(h.priority for h in shed) == [5, 5]
+    assert s3.queue_depth == 3
+    assert s3.shed_lowest(protect_priority=2) == []         # all protected
+
+
+def test_engine_priority_admission_order(model):
+    """End-to-end: with one slot, a later high-priority submit admits
+    before an earlier low-priority one."""
+    prompts = _prompts([5, 5, 5], seed=5)
+    eng = Engine(model, n_slots=1, max_len=64, min_prompt_bucket=4)
+    hog = eng.submit(prompts[0], max_new_tokens=2)
+    low = eng.submit(prompts[1], max_new_tokens=2, priority=5)
+    high = eng.submit(prompts[2], max_new_tokens=2, priority=0)
+    order = []
+    for h in (hog, low, high):
+        h.on_token = lambda hh, t: (
+            order.append(hh.request_id) if len(hh.tokens) == 1 else None)
+    eng.drain()
+    assert order == [high.request_id, low.request_id]
+
+
+# ---------------------------------------------------------------------------
+# brownout shedding under ITL inflation
+# ---------------------------------------------------------------------------
+
+def test_brownout_sheds_low_priority_with_finite_retry_after(model):
+    """Injected overload (rolling ITL p95 pushed over the SLO): queued
+    low-priority work is shed with a FINITE retry_after_s and new
+    low-priority submits are rejected, while the protected class keeps
+    decoding; when the p95 recovers, brownout exits and admission
+    resumes."""
+    prompts = _prompts([5, 5, 5], seed=6)
+    eng_ref = Engine(model, **GREEDY)
+    ref = eng_ref.submit(prompts[0], max_new_tokens=6)
+    eng_ref.drain()
+
+    sup = EngineSupervisor(model, n_slots=1, max_len=64,
+                           min_prompt_bucket=4, itl_slo_ms=50.0)
+    active_high = sup.submit(prompts[0], max_new_tokens=6, priority=0)
+    queued_high = sup.submit(prompts[1], max_new_tokens=4, priority=0)
+    queued_low = sup.submit(prompts[2], max_new_tokens=4, priority=5)
+    # inject overload: decode walls way past the 50ms SLO
+    for _ in range(8):
+        sup.engine.metrics.mark_decode(0.5)
+    sup.step()
+    assert queued_low.finished and queued_low.finish_reason == "shed"
+    assert queued_low.retry_after_s is not None \
+        and np.isfinite(queued_low.retry_after_s)
+    with pytest.raises(RequestShed) as si:
+        queued_low.result()
+    assert si.value.retry_after_s == queued_low.retry_after_s
+    # brownout rejects new unprotected work with a finite hint...
+    with pytest.raises(EngineOverloaded) as ei:
+        sup.submit(prompts[2], max_new_tokens=4, priority=5)
+    assert ei.value.retry_after_s is not None \
+        and np.isfinite(ei.value.retry_after_s)
+    # ...while the protected class keeps decoding, token-correct
+    assert not active_high.finished or active_high.finish_reason == "length"
+    assert sup.shed == 1 and sup.brownout_steps >= 1
+    # recovery: p95 back under SLO -> brownout exits, queued high admits
+    for _ in range(64):
+        sup.engine.metrics.mark_decode(0.001)
+    sup.step()
+    assert not sup._brownout
+    np.testing.assert_array_equal(
+        np.asarray(active_high.result()[5:], np.int32),
+        np.asarray(ref.tokens, np.int32))
+    queued_high.result()                       # survived the brownout
+    assert queued_high.finish_reason == "length"
+    assert sup.ledger.counts().get("brownout-exit") == 1
+
+
+# ---------------------------------------------------------------------------
+# drain
+# ---------------------------------------------------------------------------
+
+def test_drain_finishes_inflight_admits_nothing_new(model):
+    prompts = _prompts([5, 5], seed=7)
+    sup = EngineSupervisor(model, **GREEDY)
+    h0 = sup.submit(prompts[0], max_new_tokens=4)
+    h1 = sup.submit(prompts[1], max_new_tokens=6)
+    report = sup.drain()
+    assert report["drained"] and report["completed"] == 2
+    assert h0.finished and h1.finished
+    with pytest.raises(EngineDraining):
+        sup.submit(prompts[0], max_new_tokens=2)
+    assert sup.engine.metrics.requests_submitted == 2   # nothing admitted
+    sup.reopen()
+    h2 = sup.submit(prompts[0], max_new_tokens=2)
+    h2.result()
+    assert sup.drains == 1 and sup.ledger.counts()["drain"] == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos plans + cold-engine retry hint satellites
+# ---------------------------------------------------------------------------
+
+def test_serving_chaos_plans_deterministic():
+    """Serving fault plans are a pure function of the seed; take()
+    consumes invocations exactly like wrap()'s chaotic step."""
+    a = ChaosMonkey(seed=5, p=0.5, faults=SERVING_FAULTS, horizon=32)
+    b = ChaosMonkey(seed=5, p=0.5, faults=SERVING_FAULTS, horizon=32)
+    c = ChaosMonkey(seed=6, p=0.5, faults=SERVING_FAULTS, horizon=32)
+    assert a.plan == b.plan and a.plan and a.plan != c.plan
+    assert set(a.plan.values()) <= set(SERVING_FAULTS)
+    taken = [a.take() for _ in range(32)]
+    assert taken == [b.plan.get(i) for i in range(32)]
+    assert a.fired == sorted(b.plan.items())
+    with pytest.raises(ValueError):
+        ChaosMonkey(at={3: "decode-explode"})
+    with pytest.raises(ValueError):
+        ChaosMonkey(p=0.5, faults=("decode-stall", "bogus"))
+
+
+def test_retry_after_hint_cold_and_idle_engine(model):
+    """Satellite: a cold engine (no decode history) and an idle one (no
+    active requests) return the documented conservative default instead
+    of no hint — EngineOverloaded.retry_after_s is ALWAYS finite."""
+    eng = Engine(model, n_slots=1, max_len=64, min_prompt_bucket=4,
+                 max_queue=1)
+    assert eng._retry_after_hint() == eng.default_retry_after_s == 1.0
+    p = _prompts([5], seed=8)[0]
+    eng.submit(p, max_new_tokens=4)        # active, but still no decode
+    eng.submit(p, max_new_tokens=4)        # fills the queue
+    with pytest.raises(EngineOverloaded) as ei:
+        eng.submit(p, max_new_tokens=4)
+    assert ei.value.retry_after_s == 1.0   # cold: documented default
+    eng.drain()
+    # idle engine WITH decode history: still the default (no active
+    # request to scale the ITL by)
+    assert eng.metrics.itl_estimate() is not None
+    assert eng._retry_after_hint() == 1.0
+    # the default is a constructor knob
+    eng2 = Engine(model, n_slots=1, max_len=64, min_prompt_bucket=4,
+                  default_retry_after_s=2.5)
+    assert eng2._retry_after_hint() == 2.5
+
+
+# ---------------------------------------------------------------------------
+# analysis + profiler integration
+# ---------------------------------------------------------------------------
+
+def test_audit_engine_supervisor_budgets_union_across_rebuilds(model):
+    """tpu_lint's compile-budget rule sees the UNION of prefill buckets
+    across engine incarnations when auditing through the supervisor —
+    the honest fresh-process compile cost after a rebuild."""
+    from paddle_tpu import analysis
+
+    chaos = ChaosMonkey(seed=0, at={2: "decode-raise"})
+    sup = EngineSupervisor(model, chaos=chaos, compile_budget=2,
+                           **GREEDY)
+    h = sup.submit(_prompts([5], seed=9)[0], max_new_tokens=4)
+    while not h.finished:
+        sup.step()
+    assert sup.rebuilds == 1
+    rep = analysis.audit_engine(sup, lower_decode=False)
+    m = rep.metrics["compile-budget"]
+    assert m["prefill_buckets"] == [8]     # union: one bucket, both lives
+    assert m["programs"] == 2 and not [f for f in rep.findings
+                                       if f.rule_id == "compile-budget"
+                                       and f.severity == "high"]
+
+
+def test_profiler_serving_resilience_line(model, capsys):
+    import paddle_tpu.profiler as profiler
+
+    sup = EngineSupervisor(model, **GREEDY)   # noqa: F841 — live ref
+    c = profiler.serving_resilience_counters()
+    assert c["supervisors"] >= 1
+    for k in ("rebuilds", "replayed", "wedges", "kv_corruptions", "shed",
+              "abandoned", "drains"):
+        assert k in c
+    prof = profiler.Profiler(timer_only=True)
+    prof.start()
+    prof.step()
+    prof.stop()
+    prof.summary()
+    out = capsys.readouterr().out
+    assert "serving-resilience:" in out and "rebuilds=" in out
+    # serving supervisor ledgers do NOT leak into the train line
+    assert profiler.resilience_counters()["ledgers"] == len(
+        [1 for r in __import__(
+            "paddle_tpu.resilience.ledger", fromlist=["_LEDGERS"]
+        )._LEDGERS if r() is not None
+            and getattr(r(), "scope", "train") == "train"])
+
+
+# ---------------------------------------------------------------------------
+# chaos_serve CLI smoke (the tier-1 wiring for tools/chaos_serve.py)
+# ---------------------------------------------------------------------------
+
+def test_chaos_serve_cli_smoke(capsys):
+    import json
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import chaos_serve
+    finally:
+        sys.path.pop(0)
+    rc = chaos_serve.main(["--fault", "stall", "--json"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and rec["ok"] and rec["token_identical"]
+    assert rec["rebuilds"] == 1 and rec["fired"] == [[4, "decode-stall"]]
+
+
+# ---------------------------------------------------------------------------
+# soak (slow): seeded kill-sweep over random arrivals
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_soak_chaos_sweep_random_arrivals(model):
+    """Seeded chaos across all serving faults over a mixed workload:
+    whatever fires, every non-abandoned request finishes with output
+    token-identical to the uninterrupted run."""
+    rng = np.random.default_rng(10)
+    reqs = [(rng.integers(0, CFG.vocab_size, (int(n),)).astype(np.int32),
+             int(m), int(s))
+            for n, m, s in zip(rng.integers(4, 13, 16),
+                               rng.integers(2, 8, 16),
+                               rng.integers(0, 1 << 30, 16))]
+
+    def run(server):
+        handles = []
+        for i, (p, m, s) in enumerate(reqs):
+            handles.append(server.submit(p, max_new_tokens=m, seed=s,
+                                         temperature=0.9))
+            for _ in range(int(i % 3)):
+                server.step()
+        while any(not h.finished for h in handles):
+            server.step()
+        return handles
+
+    want = [list(h.tokens) for h in run(Engine(model, n_slots=4,
+                                               max_len=64,
+                                               min_prompt_bucket=4,
+                                               do_sample=True, top_k=8))]
+    for seed in (1, 2, 3):
+        chaos = ChaosMonkey(seed=seed, p=0.15, faults=SERVING_FAULTS,
+                            stall_s=0.01, horizon=256)
+        sup = EngineSupervisor(model, chaos=chaos, kv_probe_interval=1,
+                               step_timeout_s=5.0, n_slots=4, max_len=64,
+                               min_prompt_bucket=4, do_sample=True,
+                               top_k=8)
+        got = run(sup)
+        for i, h in enumerate(got):
+            if h.finish_reason == "cancelled":
+                continue
+            assert list(h.tokens) == want[i], (seed, i, chaos.fired)
+        assert sup.engine.cache.n_active == 0

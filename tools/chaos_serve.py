@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""chaos_serve — drive the serving EngineSupervisor through an injected
+fault and emit a JSON verdict ledger (the check_* tool contract;
+chaos_train.py's serving counterpart).
+
+A tiny llama serves a staggered, SAMPLED workload (per-request seeds, so
+the verdict also proves the PRNG-chain resume) twice: once uninterrupted
+on a plain Engine (the baseline), once under
+``serving.resilience.EngineSupervisor`` with a ChaosMonkey firing the
+chosen serving fault at the chosen supervised step. The verdict asserts
+every surviving request's full output is token-identical to the
+uninterrupted run.
+
+    JAX_PLATFORMS=cpu python tools/chaos_serve.py --fault stall --json
+    JAX_PLATFORMS=cpu python tools/chaos_serve.py --fault corrupt --step 5
+    JAX_PLATFORMS=cpu python tools/chaos_serve.py --fault abandon
+
+Faults: stall (wedged decode) | raise (decode error) | corrupt (KV slot
+poisoned; probe must detect before decode consumes it) | abandon (client
+disconnect mid-stream) | none. Exit code 0 iff the run recovered with
+token-identical survivors.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_FAULT_MAP = {"stall": "decode-stall", "raise": "decode-raise",
+              "corrupt": "kv-corrupt", "abandon": "abandon"}
+
+
+def _workload(seed):
+    """Deterministic staggered workload: (prompt, max_new, temp, seed)
+    per request, plus the submission schedule (request idx -> steps to
+    pump before the next arrival). ≥3 requests in flight at different
+    positions when a mid-run fault fires."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    reqs = [(rng.integers(0, 1000, (int(n),)).astype(np.int32), int(m),
+             float(t), int(s))
+            for n, m, t, s in ((5, 8, 0.8, 11), (9, 8, 1.2, 7),
+                               (5, 7, 0.6, 3), (6, 6, 1.0, 23))]
+    schedule = (2, 1, 1, 0)     # decode steps pumped after each submit
+    return reqs, schedule
+
+
+def _run(server, reqs, schedule):
+    """Submit the workload on the given engine/supervisor, pump to
+    completion, return the handles (order = submission order)."""
+    handles = []
+    for (ids, m, t, s), pump in zip(reqs, schedule):
+        handles.append(server.submit(ids, max_new_tokens=m, temperature=t,
+                                     seed=s))
+        for _ in range(pump):
+            server.step()
+    while any(not h.finished for h in handles):
+        server.step()
+    return handles
+
+
+def _verdict(fault, step, seed, stall_s):
+    import dataclasses
+
+    import paddle_tpu as paddle
+    from paddle_tpu.resilience import ChaosMonkey
+    from paddle_tpu.serving import Engine, EngineSupervisor
+    from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+    cfg = dataclasses.replace(LLAMA_TINY, dtype="float32",
+                              num_hidden_layers=2)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    kw = dict(n_slots=2, max_len=64, min_prompt_bucket=4, do_sample=True,
+              top_k=8)
+    reqs, schedule = _workload(seed)
+
+    baseline = _run(Engine(model, **kw), reqs, schedule)
+    base_tokens = [list(h.tokens) for h in baseline]
+
+    chaos = ChaosMonkey(seed=seed,
+                        at=({int(step): _FAULT_MAP[fault]}
+                            if fault != "none" else {}),
+                        stall_s=stall_s)
+    sup = EngineSupervisor(model, chaos=chaos, step_timeout_s=None,
+                           kv_probe_interval=1, **kw)
+    handles = _run(sup, reqs, schedule)
+
+    abandoned = [h for h in handles if h.finish_reason == "cancelled"]
+    survivors = [(i, h) for i, h in enumerate(handles)
+                 if h.finish_reason not in ("cancelled",)]
+    mismatches = [i for i, h in survivors if list(h.tokens) != base_tokens[i]]
+    fired = list(chaos.fired)
+    expected_counter = {"stall": sup.wedges + sup.step_errors,
+                        "raise": sup.step_errors,
+                        "corrupt": sup.kv_corruptions,
+                        "abandon": sup.abandoned}.get(fault, 0)
+    detected = fault == "none" or (bool(fired) and expected_counter > 0)
+    recovered = (fault in ("none", "abandon")
+                 or sup.rebuilds > 0) and not mismatches
+    # the engine must still be healthy after the fault: everything done
+    idle = (sup.engine.cache.n_active == 0
+            and sup.engine.scheduler.queue_depth == 0)
+    ok = bool(detected and recovered and idle
+              and (fault != "abandon" or len(abandoned) == 1))
+    return {
+        "fault": fault, "injected_step": step, "seed": seed,
+        "requests": len(reqs), "fired": fired,
+        "rebuilds": sup.rebuilds, "replayed": sup.replayed,
+        "wedges": sup.wedges, "step_errors": sup.step_errors,
+        "kv_corruptions": sup.kv_corruptions, "abandoned": sup.abandoned,
+        "survivors": len(survivors), "mismatched_requests": mismatches,
+        "token_identical": not mismatches, "ledger": sup.ledger.counts(),
+        "ok": ok,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="chaos_serve",
+        description="deterministic serving chaos vs the engine "
+        "supervisor (JSON verdict ledger)")
+    ap.add_argument("--fault", default="stall",
+                    choices=("stall", "raise", "corrupt", "abandon",
+                             "none"))
+    ap.add_argument("--step", type=int, default=4,
+                    help="0-based supervised step at which the fault "
+                    "fires (mid-decode for the default workload)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--stall-s", type=float, default=0.05)
+    ap.add_argument("--json", action="store_true", help="emit a JSON line")
+    args = ap.parse_args(argv)
+
+    record = {"bench": "chaos_serve",
+              **_verdict(args.fault, args.step, args.seed, args.stall_s)}
+    if args.json:
+        print(json.dumps(record, default=str))
+    else:
+        for k in ("fault", "injected_step", "requests", "rebuilds",
+                  "replayed", "survivors", "token_identical"):
+            print(f"{k:18s} {record[k]}")
+        print("OK (recovered, token-identical)" if record["ok"]
+              else "FAIL: did not recover token-identically")
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,13 +7,21 @@ single-client):
     python tools/tpu_sweep.py int8             # int8 kernel vs bf16
 
 All timing syncs by host value fetch (block_until_ready does not block
-through the tunnel).
+through the tunnel). Each sweep runs behind a resilience-Supervisor-style
+retry ladder (ROADMAP item 5): a wedged or raising sweep retries with
+backoff and the final JSON ledger line records ``retried: true`` plus
+the per-attempt errors — the sweep has no last-good session to replay,
+so the ladder is the whole recovery story. Knobs:
+PADDLE_TPU_SWEEP_RETRIES / _TIMEOUT_S / _BACKOFF_S.
 """
 from __future__ import annotations
 
+import json
 import os
 import sys
+import threading
 import time
+import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -126,6 +134,50 @@ def sweep_int8():
               f"ms  speedup {tb/ti:.2f}x", flush=True)
 
 
+def _supervised(mode, fn):
+    """Retry a sweep that wedges (thread-join deadline — the TPU-tunnel
+    class) or raises, with backoff between attempts; emit one JSON
+    ledger line either way so the driver sees attempts + errors instead
+    of a silent hang. Returns the process exit code."""
+    retries = int(os.environ.get("PADDLE_TPU_SWEEP_RETRIES", "2"))
+    timeout_s = float(os.environ.get("PADDLE_TPU_SWEEP_TIMEOUT_S", "1200"))
+    backoff_s = float(os.environ.get("PADDLE_TPU_SWEEP_BACKOFF_S", "30"))
+    errors = []
+    for attempt in range(retries + 1):
+        box = {}
+
+        def work():
+            try:
+                fn()
+                box["ok"] = True
+            except Exception as e:
+                box["error"] = f"{type(e).__name__}: {str(e)[:200]}"
+                traceback.print_exc(file=sys.stderr)
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        t.join(timeout_s)
+        if box.get("ok"):
+            print(json.dumps({"sweep": mode, "ok": True,
+                              "attempts": attempt + 1,
+                              "retried": attempt > 0, "errors": errors}),
+                  flush=True)
+            return 0
+        errors.append(box.get("error",
+                              f"wedged > {timeout_s:.0f}s (TPU tunnel "
+                              "stall?)"))
+        if attempt < retries:
+            print(f"sweep {mode} attempt {attempt + 1}/{retries + 1} "
+                  f"failed ({errors[-1]}); retrying after backoff",
+                  file=sys.stderr, flush=True)
+            time.sleep(backoff_s * (attempt + 1))
+    print(json.dumps({"sweep": mode, "ok": False,
+                      "attempts": retries + 1, "retried": retries > 0,
+                      "errors": errors}), flush=True)
+    return 1
+
+
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "step"
-    {"flash": sweep_flash, "step": sweep_step, "int8": sweep_int8}[mode]()
+    sys.exit(_supervised(mode, {"flash": sweep_flash, "step": sweep_step,
+                                "int8": sweep_int8}[mode]))
