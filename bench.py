@@ -586,6 +586,39 @@ def bench_serving(backend):
             "itl_ms_p95": led["itl_ms_p95"]}
 
 
+def bench_serving_paged(backend):
+    """Paged, prefix-shared KV serving A/B (the ROADMAP-1 heavy-traffic
+    lever): a shared-system-prompt offered load served by the slot
+    engine vs the paged engine at the SAME KV byte budget. Reports max
+    admitted concurrency, KV bytes per resident token, prefix hit rate
+    and the TTFT/ITL ledger per arm; ok requires >= 2x concurrency (or
+    equivalently <= 1/2 KV bytes/token) at token-identical quality.
+    The CPU ledger lives in tools/bench_serving.py (prefix_reuse_sweep,
+    reused here verbatim); this is the TPU arm."""
+    import paddle_tpu
+    from paddle_tpu.text.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if backend != "tpu":
+        return {"skipped": "tpu only"}
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    try:
+        from bench_serving import prefix_reuse_sweep
+    finally:
+        sys.path.pop(0)
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5504, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=16,
+                      max_position_embeddings=512, dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    out = prefix_reuse_sweep(model, cfg, n_requests=32, max_new=32,
+                             slot_slots=8, max_len=256, block_size=32,
+                             sys_len=192, tail_len=16)
+    return out
+
+
 def bench_ctr_widedeep(backend):
     """Recsys/PS-analog throughput: wide&deep CTR over a 1M-row sharded
     embedding table (single chip: table replicated-equivalent), lazy-row
@@ -904,6 +937,7 @@ def main():
                           bench_llama_b8_selective),
                          ("ctr_widedeep", bench_ctr_widedeep),
                          ("serving_engine", bench_serving),
+                         ("serving_paged", bench_serving_paged),
                          ("flash_blocks", bench_flash_blocks)):
             if only and name not in only:
                 # marker (not omission) so the artifact fill-loop below

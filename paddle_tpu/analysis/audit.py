@@ -254,8 +254,10 @@ def audit_engine(engine, compile_budget=None, rules=None,
         supervisor = engine
         engine = supervisor.engine
     buckets = set(engine.buckets_seen)
+    chunk_used = bool(getattr(engine, "chunk_used", False))
     if supervisor is not None:
         buckets |= supervisor.buckets_seen_total
+        chunk_used |= bool(getattr(supervisor, "chunk_used_total", False))
     meta = {
         "n_slots": engine.n_slots, "max_len": engine.max_len,
         "min_prompt_bucket": engine.min_prompt_bucket,
@@ -268,6 +270,12 @@ def audit_engine(engine, compile_budget=None, rules=None,
         "donate": engine_donates(engine),
         "kv_heads": engine.cache.kv_heads,
         "head_dim": engine.cache.head_dim,
+        "kv_layout": getattr(engine, "kv_layout", "slot"),
+        "block_size": getattr(engine, "block_size", None),
+        "n_blocks": (engine.cache.pool.n_blocks
+                     if hasattr(engine.cache, "pool") else None),
+        "prefill_chunk": getattr(engine, "prefill_chunk", None),
+        "chunk_used": chunk_used,
     }
     if supervisor is not None:
         meta["supervisor"] = {"rebuilds": supervisor.rebuilds,

@@ -8,21 +8,34 @@ from __future__ import annotations
 
 def engine_donates(engine) -> bool:
     """True when the engine was built on the donating prefill/decode
-    programs (KV buffers updated in place)."""
+    programs (KV buffers/pool updated in place)."""
     from ..serving import engine as E
 
-    return engine._decode is E._DECODE_DONATED
+    return engine._decode in (E._DECODE_DONATED, E._PAGED_DECODE_DONATED)
 
 
 def lower_decode_program(engine) -> str:
     """Lower the engine's fused decode step against its live state and
-    return the StableHLO text — the same program the engine executes, so
-    dtype/padding rules audit real serving HLO, not a proxy."""
+    return the StableHLO text — the same program the engine executes
+    (slot or paged layout), so dtype/padding rules audit real serving
+    HLO, not a proxy."""
     import jax
     import jax.numpy as jnp
 
-    from ..serving.engine import _STATICS, _decode_impl
+    from ..serving.engine import (_PAGED_STATICS, _STATICS, _decode_impl,
+                                  _paged_decode_impl)
 
+    if getattr(engine, "kv_layout", "slot") == "paged":
+        args = (engine._w, jnp.asarray(engine.cache.kc),
+                jnp.asarray(engine.cache.vc),
+                jnp.asarray(engine.cache.block_tables),
+                jnp.asarray(engine._tok), jnp.asarray(engine._cur),
+                jnp.asarray(engine.cache.active),
+                jnp.asarray(engine._keys), jnp.asarray(engine._temps))
+        lowered = jax.jit(_paged_decode_impl,
+                          static_argnames=_PAGED_STATICS).lower(
+            *args, **engine._paged_statics)
+        return lowered.as_text()
     args = (engine._w, jnp.asarray(engine.cache.kc),
             jnp.asarray(engine.cache.vc), jnp.asarray(engine._tok),
             jnp.asarray(engine._cur), jnp.asarray(engine.cache.active),

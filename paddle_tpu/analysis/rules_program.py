@@ -389,6 +389,31 @@ def _padding_waste(view):
                 "waste)", location="serving.SlotKVCache",
                 suggested_fix="choose head_dim so kv_heads*head_dim is "
                 "a multiple of 128, or pack heads before caching")
+        bs = m.get("block_size")
+        if bs:
+            if bs % _SUBLANE:
+                padded = -(-bs // _SUBLANE) * _SUBLANE
+                yield Finding(
+                    "padding-waste", "medium",
+                    f"paged KV block_size={bs} is not a multiple of the "
+                    f"{_SUBLANE}-line TPU sublane — every block "
+                    f"scatter/gather tiles to {padded} lines "
+                    f"({padded / bs:.2f}x pool HBM + DMA waste)",
+                    location="serving.PagedKVCache",
+                    suggested_fix="use a block_size that is a multiple "
+                    "of 8 (16/32/64): KV lines then tile the sublane "
+                    "dim exactly")
+            if m.get("max_len", 0) % bs:
+                mb = -(-m["max_len"] // bs)
+                yield Finding(
+                    "padding-waste", "low",
+                    f"max_len={m['max_len']} is not a multiple of "
+                    f"block_size={bs} — every slot's gathered view "
+                    f"carries {mb * bs - m['max_len']} dead lines past "
+                    "the causal bound",
+                    location="serving.PagedKVCache",
+                    suggested_fix="round max_len to a multiple of "
+                    "block_size")
 
 
 # -- 7. compile-count budget -------------------------------------------------
@@ -400,26 +425,51 @@ def _compile_budget(view):
     if view.kind == "engine":
         m = view.meta
         buckets = sorted(m.get("buckets_seen", ()))
-        programs = len(buckets) + (1 if m.get("decode_used") else 0)
+        chunk = 1 if m.get("chunk_used") else 0
+        # paged budget: the block table is a plain RUNTIME operand, so
+        # paging itself adds zero lowerings; chunked prefill adds
+        # exactly ONE shared chunk program regardless of prompt length
+        programs = len(buckets) + (1 if m.get("decode_used") else 0) \
+            + chunk
         budget = m.get("compile_budget")
         view.metrics["compile-budget"] = {
             "programs": programs, "prefill_buckets": buckets,
-            "budget": budget}
+            "chunk_program": bool(chunk), "budget": budget}
+        pc = m.get("prefill_chunk")
+        # a request of length <= prefill_chunk legitimately buckets to
+        # the next power of two above it; anything beyond that should
+        # have gone through the chunk program
+        cap = None if pc is None else max(pc, 1 << (pc - 1).bit_length())
+        sprawl = [b for b in buckets if cap is not None and b > cap]
+        if sprawl:
+            yield Finding(
+                "compile-budget", "high",
+                f"per-length prefill lowerings {sprawl} traced beyond "
+                f"prefill_chunk={pc} — block-table operands must not "
+                "add per-length programs; prompts above the chunk "
+                "threshold must go through the single chunked-prefill "
+                "program", location="serving.Engine",
+                suggested_fix="route long prompts through chunked "
+                "prefill (they bucket only up to prefill_chunk)")
         if budget is not None and programs > budget:
             yield Finding(
                 "compile-budget", "high",
                 f"{programs} XLA programs compiled ({len(buckets)} "
-                f"prefill buckets {buckets} + decode) exceeds the "
+                f"prefill buckets {buckets} + decode"
+                + (" + chunk" if chunk else "") + ") exceeds the "
                 f"declared budget of {budget}",
                 location="serving.Engine",
                 suggested_fix="cap prompt bucketing (raise "
-                "min_prompt_bucket / clamp max prompt len) or raise "
-                "compile_budget if the traffic mix justifies it")
+                "min_prompt_bucket / clamp max prompt len, or enable "
+                "chunked prefill so long prompts share one program) or "
+                "raise compile_budget if the traffic mix justifies it")
         elif budget is None and programs:
             yield Finding(
                 "compile-budget", "info",
                 f"{programs} XLA programs in use ({len(buckets)} "
-                "prefill buckets + decode); no compile budget declared",
+                "prefill buckets + decode"
+                + (" + chunk" if chunk else "") + "); no compile "
+                "budget declared",
                 location="serving.Engine",
                 suggested_fix="construct Engine(compile_budget=N) to "
                 "gate compile-count regressions in CI")

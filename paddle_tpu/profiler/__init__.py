@@ -235,6 +235,8 @@ class Profiler:
               + ("" if dc["enabled"] else " (disabled)"))
         sc = serving_counters()
         if sc["engines"]:
+            hr = sc.get("prefix_hit_rate")
+            lw = sc.get("pool_low_watermark")
             print("serving: "
                   f"engines={sc['engines']} "
                   f"requests={sc['requests_completed']}/"
@@ -242,7 +244,13 @@ class Profiler:
                   f"tokens={sc['tokens_generated']} "
                   f"prefills={sc['prefills']} "
                   f"decode_steps={sc['decode_steps']} "
-                  f"peak_queue={sc['peak_queue_depth']}")
+                  f"peak_queue={sc['peak_queue_depth']} "
+                  f"peak_active={sc.get('peak_active', 0)} "
+                  f"prefix_hit_rate={'-' if hr is None else hr} "
+                  f"cow={sc.get('cow_copies', 0)} "
+                  f"preempt={sc.get('preemptions', 0)} "
+                  f"chunk_steps={sc.get('chunk_steps', 0)} "
+                  f"pool_low_watermark={'-' if lw is None else lw}")
         rc = resilience_counters()
         if rc["ledgers"]:
             print("resilience: "

@@ -200,20 +200,37 @@ def corrupt_latest(manager, seed: int = 0, mode: str = "truncate"):
 
 def corrupt_kv(engine, seed: int = 0, value: float = float("nan")):
     """Serving-side corruption analog (chaos fault ``kv-corrupt``):
-    poison one deterministically chosen active KV slot's attendable
-    lines in place. The EngineSupervisor's finiteness probe must catch
-    this BEFORE the next decode step consumes it; rebuild-and-replay
-    then *heals* the slot by recomputing its KV from the request's own
-    prompt + emitted-token history. Returns the poisoned slot index."""
+    poison deterministically chosen live KV state in place. The
+    EngineSupervisor's finiteness probe must catch this BEFORE the next
+    decode step consumes it; rebuild-and-replay then *heals* the state
+    by recomputing KV from each request's own prompt + emitted-token
+    history.
+
+    Slot layout: one active slot's attendable lines are poisoned
+    (returns the slot index). Paged layout: one live BLOCK is poisoned —
+    preferring a SHARED prefix block (refcount > 1) when one exists, the
+    nastiest case: every sharer reads it, so the verdict must show ALL
+    of them healed by replay (returns the block id)."""
     import jax.numpy as jnp
 
-    active = np.nonzero(engine.cache.active)[0]
+    rng = np.random.default_rng(seed)
+    cache = engine.cache
+    if hasattr(cache, "live_blocks"):              # paged pool
+        shared = cache.shared_live_blocks()
+        cand = shared if shared else cache.live_blocks()
+        if not cand:
+            raise ValueError("no live blocks to corrupt")
+        block = int(cand[int(rng.integers(len(cand)))])
+        kc = np.asarray(cache.kc).copy()
+        kc[:, block] = value
+        cache.kc = jnp.asarray(kc)
+        return block
+    active = np.nonzero(cache.active)[0]
     if active.size == 0:
         raise ValueError("no active slots to corrupt")
-    rng = np.random.default_rng(seed)
     slot = int(active[int(rng.integers(active.size))])
-    lines = max(int(engine.cache.cur_pos[slot]), 1)
-    kc = np.asarray(engine.cache.kc).copy()
+    lines = max(int(cache.cur_pos[slot]), 1)
+    kc = np.asarray(cache.kc).copy()
     kc[:, slot, :lines] = value
-    engine.cache.kc = jnp.asarray(kc)
+    cache.kc = jnp.asarray(kc)
     return slot

@@ -2,8 +2,9 @@
 
 Reference pairing: paddle/fluid/inference is the reference deployment
 runtime (Config/Predictor over a saved program, one request at a time);
-this package is its many-concurrent-requests counterpart: a slot-based
-KV cache + iteration-level batching engine whose whole decode step is
+this package is its many-concurrent-requests counterpart: a paged,
+prefix-shared KV cache (block pool + radix index; slot layout kept for
+A/B) + iteration-level batching engine whose whole decode step is
 one fixed-shape jitted XLA program (see engine.py), with a
 latency/throughput ledger in metrics.py.
 
@@ -28,7 +29,8 @@ from __future__ import annotations
 
 from .engine import (Engine, RequestCancelled, RequestHandle,  # noqa: F401
                      RequestShed, RequestTimeout)
-from .kv_cache import SlotKVCache                           # noqa: F401
+from .kv_cache import (BlockPool, PagedKVCache, RadixIndex,  # noqa: F401
+                       SlotKVCache)
 from .metrics import EngineMetrics, RequestMetrics, ledger  # noqa: F401
 from .resilience import (EngineDraining, EngineSupervisor,  # noqa: F401
                          ServingAborted)
@@ -36,7 +38,8 @@ from .scheduler import (EngineOverloaded, FIFOScheduler,    # noqa: F401
                         PriorityScheduler)
 
 __all__ = ["Engine", "RequestHandle", "RequestTimeout", "RequestShed",
-           "RequestCancelled", "SlotKVCache", "EngineMetrics",
+           "RequestCancelled", "SlotKVCache", "PagedKVCache", "BlockPool",
+           "RadixIndex", "EngineMetrics",
            "RequestMetrics", "ledger", "EngineOverloaded", "FIFOScheduler",
            "PriorityScheduler", "EngineSupervisor", "ServingAborted",
            "EngineDraining", "save_lm"]

@@ -5,14 +5,22 @@ Design (ROADMAP north star: serve concurrent, asynchronously arriving
 requests without ever recompiling):
 
 - ``submit()`` enqueues a request; admission prefills it **directly into
-  a free KV slot** with a program bucketed to the next power-of-two
+  its KV storage** with a program bucketed to the next power-of-two
   prompt length (bounded compile count: one prefill program per bucket).
-- ``step()`` advances ALL active slots one token with a single fused
-  jitted decode program of static shape ``[n_slots, ...]`` — new
-  requests join between steps, finished ones free their slot without
-  disturbing neighbours. Two XLA programs total in steady state
-  (n_buckets prefills + 1 decode), enforced by
-  tools/check_serving_compiles.py.
+  The default ``kv_layout="paged"`` draws fixed-size blocks from a
+  shared pool through host-side block tables (runtime operands — zero
+  extra lowerings): requests hold ``ceil(len/block_size)`` blocks
+  instead of worst-case ``max_len`` lines, common prompt prefixes are
+  deduped through a refcounted radix index, prompts longer than
+  ``prefill_chunk`` prefill in block-aligned chunks co-scheduled with
+  decode, and pool exhaustion preempts (token-identical replay later).
+  ``kv_layout="slot"`` keeps the PR-4 one-slab-per-slot layout.
+- ``step()`` advances ALL decode-active slots one token with a single
+  fused jitted decode program of static shape ``[n_slots, ...]`` — new
+  requests join between steps, finished ones free their slot/blocks
+  without disturbing neighbours. Steady-state XLA programs:
+  n_buckets prefills + 1 decode (+ 1 chunk program if chunking ever
+  ran), enforced by tools/check_serving_compiles.py.
 - Per-request PRNG: each request owns a key chain seeded at admission
   and split once per decode step, so sampled output is a function of
   (prompt, seed, gen kwargs) only — independent of co-batched traffic.
@@ -37,7 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from ..tensor import Tensor
-from .kv_cache import SlotKVCache
+from .kv_cache import PagedKVCache, SlotKVCache
 from .metrics import EngineMetrics, RequestMetrics
 from .scheduler import (EngineOverloaded, FIFOScheduler,  # noqa: F401
                         PriorityScheduler)
@@ -200,14 +208,237 @@ def _decode_impl(w, kc, vc, tok, cur_pos, active, keys, temps, *, arch,
     return nxt, kc, vc, cur2, new_keys
 
 
+def _paged_prefill_impl(w, kc, vc, tok, cur_pos, keys, ids, n_prompt, slot,
+                        seed, skip, temp, table_row, skip_write, *, arch,
+                        n_heads, n_kv, eps, theta, do_sample, top_k, top_p,
+                        block_size):
+    """Paged prefill: the SAME full causal forward as ``_prefill_impl``
+    (so the first sampled token is bit-identical to the slot engine and
+    ``generate()``), but K/V lands in the paged pool through the slot's
+    block-table row — a block-aligned masked scatter. Positions below
+    ``skip_write`` (radix-shared prefix, already resident from the
+    producing request) and at/above ``n_prompt`` (bucket padding)
+    redirect into the trash block, so shared blocks are NEVER rewritten
+    and prefix sharing cannot perturb a co-batched neighbour."""
+    from ..text import generation as G
+
+    Lb = ids.shape[1]
+    if arch == "llama":
+        x = jnp.take(w["embed"], ids, axis=0)
+        pos = jnp.arange(Lb)
+        stack = {k: w[k] for k in G._LLAMA_STACK_KEYS}
+
+        def one(xc, lw):
+            return G._llama_prefill_layer(xc, lw, pos, n_heads=n_heads,
+                                          n_kv=n_kv, eps=eps, theta=theta)
+
+        x, kvs = jax.lax.scan(one, x, stack)
+        hlast = jax.lax.dynamic_index_in_dim(
+            G._rms(x, w["norm"], eps)[0], n_prompt - 1, 0, keepdims=False)
+        logits0 = hlast @ w["head"]
+    else:
+        pos = jnp.arange(Lb)
+        x = jnp.take(w["wte"], ids, axis=0) + w["wpe"][pos][None]
+        stack = {k: w[k] for k in G._GPT_STACK_KEYS}
+
+        def one(xc, lw):
+            return G._gpt_prefill_layer(xc, lw, n_heads=n_heads)
+
+        x, kvs = jax.lax.scan(one, x, stack)
+        xlast = jax.lax.dynamic_index_in_dim(x[0], n_prompt - 1, 0,
+                                             keepdims=False)
+        logits0 = G._ln(xlast, w["lnfw"], w["lnfb"]) @ w["head"]
+
+    j = jnp.arange(Lb)
+    writable = (j >= skip_write) & (j < n_prompt)
+    dest = jnp.where(writable,
+                     table_row[j // block_size] * block_size
+                     + j % block_size,
+                     j % block_size)             # trash block rows
+    L, nb, bs = kc.shape[0], kc.shape[1], kc.shape[2]
+    kvh, hd = kc.shape[3], kc.shape[4]
+    kc = kc.reshape(L, nb * bs, kvh, hd).at[:, dest].set(
+        kvs[0][:, 0]).reshape(L, nb, bs, kvh, hd)
+    vc = vc.reshape(L, nb * bs, kvh, hd).at[:, dest].set(
+        kvs[1][:, 0]).reshape(L, nb, bs, kvh, hd)
+
+    key = jax.random.PRNGKey(seed)
+    key = jax.lax.fori_loop(0, skip,
+                            lambda _, k: jax.random.split(k)[0], key)
+    key, sk = jax.random.split(key)
+    logits_f = G._filter_logits(logits0[None], temp, do_sample, top_k,
+                                top_p)
+    if do_sample:
+        tok0 = jax.random.categorical(sk, logits_f, axis=-1)[0]
+    else:
+        tok0 = jnp.argmax(logits_f, axis=-1)[0]
+    tok0 = tok0.astype(jnp.int32)
+    tok = tok.at[slot].set(tok0)
+    cur_pos = cur_pos.at[slot].set(n_prompt.astype(jnp.int32))
+    keys = keys.at[slot].set(key)
+    return kc, vc, tok, cur_pos, keys, tok0
+
+
+def _paged_decode_impl(w, kc, vc, tables, tok, cur_pos, active, keys,
+                       temps, *, arch, n_heads, n_kv, eps, theta, do_sample,
+                       top_k, top_p, block_size):
+    """One fused paged decode step: every decode-active slot advances a
+    token at its own position, writing K/V through its block table
+    (inactive rows scatter into the trash block so a freed slot's stale
+    table can never corrupt the pool) and attending over the gathered
+    per-slot view. ONE program for the life of the engine — the block
+    table is a plain runtime operand of static shape."""
+    from ..text import generation as G
+
+    S = tok.shape[0]
+    rows = jnp.arange(S)
+    blk = tables[rows, cur_pos // block_size]
+    dest = jnp.where(active, blk * block_size + cur_pos % block_size,
+                     cur_pos % block_size)
+    if arch == "llama":
+        xt = jnp.take(w["embed"], tok, axis=0)[:, None]
+        stack = {k: w[k] for k in G._LLAMA_STACK_KEYS}
+
+        def one(cx, lw_kv):
+            xt2, kc_l, vc_l = G._llama_decode_layer_paged(
+                cx["x"], lw_kv, lw_kv["kc"], lw_kv["vc"], tables, dest,
+                cur_pos, cur_pos, n_heads=n_heads, n_kv=n_kv, eps=eps,
+                theta=theta, block_size=block_size)
+            return {"x": xt2}, (kc_l, vc_l)
+    else:
+        xt = (jnp.take(w["wte"], tok, axis=0)
+              + jnp.take(w["wpe"], cur_pos, axis=0))[:, None]
+        stack = {k: w[k] for k in G._GPT_STACK_KEYS}
+
+        def one(cx, lw_kv):
+            xt2, kc_l, vc_l = G._gpt_decode_layer_paged(
+                cx["x"], lw_kv, lw_kv["kc"], lw_kv["vc"], tables, dest,
+                cur_pos, n_heads=n_heads, block_size=block_size)
+            return {"x": xt2}, (kc_l, vc_l)
+
+    lw_kv = dict(stack)
+    lw_kv["kc"] = kc
+    lw_kv["vc"] = vc
+    cx, (kc, vc) = jax.lax.scan(one, {"x": xt}, lw_kv)
+    if arch == "llama":
+        hidden = G._rms(cx["x"][:, 0], w["norm"], eps)
+        logits = hidden @ w["head"]
+    else:
+        logits = G._ln(cx["x"][:, 0], w["lnfw"], w["lnfb"]) @ w["head"]
+
+    split = jax.vmap(jax.random.split)(keys)        # [S, 2, 2]
+    new_keys, sks = split[:, 0], split[:, 1]
+    logits_f = G._filter_logits(logits, temps, do_sample, top_k, top_p)
+    if do_sample:
+        nxt = jax.vmap(jax.random.categorical)(sks, logits_f)
+    else:
+        nxt = jnp.argmax(logits_f, axis=-1)
+    nxt = nxt.astype(jnp.int32)
+    nxt = jnp.where(active, nxt, tok)
+    new_keys = jnp.where(active[:, None], new_keys, keys)
+    cur2 = jnp.where(active, cur_pos + 1, cur_pos)
+    return nxt, kc, vc, cur2, new_keys
+
+
+def _paged_chunk_impl(w, kc, vc, tok, cur_pos, keys, ids, chunk_start,
+                      n_prompt, slot, table_row, skip_write, is_final,
+                      seed, skip, temp, *, arch, n_heads, n_kv, eps, theta,
+                      do_sample, top_k, top_p, block_size):
+    """One block-aligned prefill CHUNK of one slot, co-schedulable with
+    the fused decode step: processes ``ids`` ([1, C], global positions
+    ``chunk_start + j``) through every layer, scattering its K/V into
+    the pool (shared-prefix / pad positions trash-redirected) and
+    attending over the slot's gathered view. The SAME program serves
+    every chunk of every long prompt (mid or final — ``is_final`` is a
+    runtime operand gating the sampling side effects), so chunked
+    prefill costs exactly ONE extra lowering, independent of prompt
+    length. Sampling uses the admission-seeded PRNG chain with the
+    supervisor-replay ``skip`` fast-forward, like the one-shot paths."""
+    from ..text import generation as G
+
+    C = ids.shape[1]
+    gpos = chunk_start + jnp.arange(C)
+    writable = (gpos >= skip_write) & (gpos < n_prompt)
+    wdest = jnp.where(writable,
+                      table_row[gpos // block_size] * block_size
+                      + gpos % block_size,
+                      gpos % block_size)
+    if arch == "llama":
+        x = jnp.take(w["embed"], ids, axis=0)
+        stack = {k: w[k] for k in G._LLAMA_STACK_KEYS}
+
+        def one(cx, lw_kv):
+            x2, kc_l, vc_l = G._llama_chunk_layer(
+                cx["x"], lw_kv, lw_kv["kc"], lw_kv["vc"], table_row, gpos,
+                wdest, n_heads=n_heads, n_kv=n_kv, eps=eps, theta=theta,
+                block_size=block_size)
+            return {"x": x2}, (kc_l, vc_l)
+    else:
+        x = jnp.take(w["wte"], ids, axis=0) + w["wpe"][gpos][None]
+        stack = {k: w[k] for k in G._GPT_STACK_KEYS}
+
+        def one(cx, lw_kv):
+            x2, kc_l, vc_l = G._gpt_chunk_layer(
+                cx["x"], lw_kv, lw_kv["kc"], lw_kv["vc"], table_row, gpos,
+                wdest, n_heads=n_heads, block_size=block_size)
+            return {"x": x2}, (kc_l, vc_l)
+
+    lw_kv = dict(stack)
+    lw_kv["kc"] = kc
+    lw_kv["vc"] = vc
+    cx, (kc, vc) = jax.lax.scan(one, {"x": x}, lw_kv)
+    li = jnp.clip(n_prompt - 1 - chunk_start, 0, C - 1)
+    if arch == "llama":
+        hlast = jax.lax.dynamic_index_in_dim(
+            G._rms(cx["x"], w["norm"], eps)[0], li, 0, keepdims=False)
+        logits0 = hlast @ w["head"]
+    else:
+        xlast = jax.lax.dynamic_index_in_dim(cx["x"][0], li, 0,
+                                             keepdims=False)
+        logits0 = G._ln(xlast, w["lnfw"], w["lnfb"]) @ w["head"]
+
+    key = jax.random.PRNGKey(seed)
+    key = jax.lax.fori_loop(0, skip,
+                            lambda _, k: jax.random.split(k)[0], key)
+    key, sk = jax.random.split(key)
+    logits_f = G._filter_logits(logits0[None], temp, do_sample, top_k,
+                                top_p)
+    if do_sample:
+        tok0 = jax.random.categorical(sk, logits_f, axis=-1)[0]
+    else:
+        tok0 = jnp.argmax(logits_f, axis=-1)[0]
+    tok0 = tok0.astype(jnp.int32)
+    fin = is_final.astype(bool)
+    tok = jnp.where(fin, tok.at[slot].set(tok0), tok)
+    cur_pos = jnp.where(fin,
+                        cur_pos.at[slot].set(n_prompt.astype(jnp.int32)),
+                        cur_pos)
+    keys = jnp.where(fin, keys.at[slot].set(key), keys)
+    return kc, vc, tok, cur_pos, keys, tok0
+
+
 _STATICS = ("arch", "n_heads", "n_kv", "eps", "theta", "do_sample",
             "top_k", "top_p")
+_PAGED_STATICS = _STATICS + ("block_size",)
 _PREFILL = jax.jit(_prefill_impl, static_argnames=_STATICS)
 _PREFILL_DONATED = jax.jit(_prefill_impl, static_argnames=_STATICS,
                            donate_argnums=(1, 2))
 _DECODE = jax.jit(_decode_impl, static_argnames=_STATICS)
 _DECODE_DONATED = jax.jit(_decode_impl, static_argnames=_STATICS,
                           donate_argnums=(1, 2))
+_PAGED_PREFILL = jax.jit(_paged_prefill_impl,
+                         static_argnames=_PAGED_STATICS)
+_PAGED_PREFILL_DONATED = jax.jit(_paged_prefill_impl,
+                                 static_argnames=_PAGED_STATICS,
+                                 donate_argnums=(1, 2))
+_PAGED_DECODE = jax.jit(_paged_decode_impl, static_argnames=_PAGED_STATICS)
+_PAGED_DECODE_DONATED = jax.jit(_paged_decode_impl,
+                                static_argnames=_PAGED_STATICS,
+                                donate_argnums=(1, 2))
+_PAGED_CHUNK = jax.jit(_paged_chunk_impl, static_argnames=_PAGED_STATICS)
+_PAGED_CHUNK_DONATED = jax.jit(_paged_chunk_impl,
+                               static_argnames=_PAGED_STATICS,
+                               donate_argnums=(1, 2))
 
 
 def _make_arch(model):
@@ -297,6 +528,20 @@ class RequestHandle:
                 f", tokens={len(self.tokens)}, {state})")
 
 
+class _ChunkState:
+    """Host bookkeeping of one in-progress chunked prefill."""
+
+    __slots__ = ("h", "ids", "n_eff", "n_shared", "next", "skip")
+
+    def __init__(self, h, ids, n_eff, n_shared, start):
+        self.h = h
+        self.ids = np.ascontiguousarray(ids, np.int32)
+        self.n_eff = int(n_eff)
+        self.n_shared = int(n_shared)
+        self.next = int(start)          # next chunk-start position
+        self.skip = len(h.tokens)       # PRNG fast-forward (replay)
+
+
 class Engine:
     """Continuous-batching serving engine (see module docstring).
 
@@ -309,7 +554,9 @@ class Engine:
                  top_k=0, top_p=None, eos_token_id=None,
                  min_prompt_bucket=8, token_budget=None, max_queue=None,
                  base_seed=0, donate=None, compile_budget=None,
-                 default_retry_after_s=DEFAULT_RETRY_AFTER_S):
+                 default_retry_after_s=DEFAULT_RETRY_AFTER_S,
+                 kv_layout="paged", block_size=16, n_blocks=None,
+                 prefill_chunk=None, prefix_sharing=True):
         self._w, self._hp, geo = _make_arch(model)
         self.n_slots = int(n_slots)
         self.max_len = int(max_len if max_len is not None
@@ -321,9 +568,35 @@ class Engine:
         self._statics = dict(self._hp, do_sample=bool(do_sample),
                              top_k=int(top_k),
                              top_p=None if top_p is None else float(top_p))
-        self.cache = SlotKVCache(geo["n_layers"], self.n_slots,
-                                 self.max_len, geo["kv_heads"],
-                                 geo["head_dim"], geo["dtype"])
+        if kv_layout not in ("paged", "slot"):
+            raise ValueError("kv_layout must be 'paged' or 'slot'")
+        self.kv_layout = kv_layout
+        self.prefix_sharing = bool(prefix_sharing) and kv_layout == "paged"
+        self._chunking = []        # in-progress chunked prefills (paged)
+        self.chunk_used = False    # the +1 chunk lowering, once traced
+        if kv_layout == "paged":
+            self.block_size = int(block_size)
+            if prefill_chunk is not None:
+                prefill_chunk = int(prefill_chunk)
+                if prefill_chunk < self.block_size \
+                        or prefill_chunk % self.block_size:
+                    raise ValueError(
+                        "prefill_chunk must be a block-aligned multiple "
+                        f"of block_size={self.block_size}")
+            self.prefill_chunk = prefill_chunk
+            self.cache = PagedKVCache(geo["n_layers"], self.n_slots,
+                                      self.max_len, geo["kv_heads"],
+                                      geo["head_dim"], geo["dtype"],
+                                      block_size=self.block_size,
+                                      n_blocks=n_blocks)
+            self._paged_statics = dict(self._statics,
+                                       block_size=self.block_size)
+        else:
+            self.block_size = None
+            self.prefill_chunk = None
+            self.cache = SlotKVCache(geo["n_layers"], self.n_slots,
+                                     self.max_len, geo["kv_heads"],
+                                     geo["head_dim"], geo["dtype"])
         # threaded device state (numpy until the first jit call)
         self._tok = np.zeros(self.n_slots, np.int32)
         self._cur = np.zeros(self.n_slots, np.int32)
@@ -345,8 +618,16 @@ class Engine:
         self.base_seed = int(base_seed)
         if donate is None:
             donate = jax.default_backend() != "cpu"
-        self._prefill = _PREFILL_DONATED if donate else _PREFILL
-        self._decode = _DECODE_DONATED if donate else _DECODE
+        if self.kv_layout == "paged":
+            self._prefill = (_PAGED_PREFILL_DONATED if donate
+                             else _PAGED_PREFILL)
+            self._decode = (_PAGED_DECODE_DONATED if donate
+                            else _PAGED_DECODE)
+            self._chunk = _PAGED_CHUNK_DONATED if donate else _PAGED_CHUNK
+        else:
+            self._prefill = _PREFILL_DONATED if donate else _PREFILL
+            self._decode = _DECODE_DONATED if donate else _DECODE
+            self._chunk = None
         # compile ledger: which prefill bucket lengths this engine has
         # actually traced (each is one XLA program; + 1 fused decode).
         # ``compile_budget`` is the declared cap the compile-budget lint
@@ -402,6 +683,13 @@ class Engine:
             raise ValueError(
                 f"prompt ({ids.shape[0]}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_len={self.max_len}")
+        if self.kv_layout == "paged":
+            cap = (self.cache.pool.n_blocks - 1) * self.block_size
+            if ids.shape[0] + int(max_new_tokens) + 1 > cap:
+                raise ValueError(
+                    f"prompt ({ids.shape[0]}) + max_new_tokens "
+                    f"({max_new_tokens}) can never fit the KV pool "
+                    f"({cap} token lines) — raise n_blocks")
         rid = self._next_id
         self._next_id += 1
         h = RequestHandle(
@@ -437,30 +725,50 @@ class Engine:
         # or max_new_tokens=1) frees its slot immediately — loop so the
         # queue keeps draining into freshly freed slots
         while True:
-            popped = self.scheduler.pop_admissible(self.cache.n_free)
+            if self.kv_layout == "paged":
+                popped = self.scheduler.pop_admissible(
+                    self.cache.n_free,
+                    free_tokens=self.cache.free_tokens())
+            else:
+                popped = self.scheduler.pop_admissible(self.cache.n_free)
             if not popped:
                 return
             for h in popped:
-                self._admit_one(h)
+                if not self._admit_one(h):
+                    # the pool could not cover it even after radix
+                    # eviction (free_tokens was an optimistic estimate):
+                    # back to the queue head, nothing overtakes it
+                    self.scheduler.release(h)
+                    self.scheduler.requeue(h)
+                    return
+
+    @staticmethod
+    def _full_ids(h):
+        """prompt + already-emitted tokens (the replay/adopt sequence)."""
+        if not h.tokens:
+            return h.prompt_ids
+        return np.concatenate(
+            [h.prompt_ids, np.asarray(h.tokens, np.int32)])
 
     def _admit_one(self, h):
-        slot = self.cache.alloc(h.request_id)
-        h.slot = slot
-        self._by_slot[slot] = h
-        self._temps[slot] = h.temperature
         # supervisor replay (adopt()) re-prefills prompt + the k tokens
         # the crashed incarnation already emitted and fast-forwards the
         # PRNG chain k splits — the next sampled token is exactly what
         # the uninterrupted run would have produced. Normal admission is
-        # the k=0 degenerate case (same program).
+        # the k=0 degenerate case (same program). Preemption on pool
+        # exhaustion re-enters through the same path.
         k = len(h.tokens)
         n_eff = h.n_prompt + k
+        if self.kv_layout == "paged":
+            return self._admit_one_paged(h, k, n_eff)
+        slot = self.cache.alloc(h.request_id)
+        h.slot = slot
+        self._by_slot[slot] = h
+        self._temps[slot] = h.temperature
         Lb = self._bucket(n_eff)
         self.buckets_seen.add(Lb)
         ids = np.zeros((1, Lb), np.int32)
-        ids[0, :h.n_prompt] = h.prompt_ids
-        if k:
-            ids[0, h.n_prompt:n_eff] = np.asarray(h.tokens, np.int32)
+        ids[0, :n_eff] = self._full_ids(h)
         out = self._prefill(
             self._w, self.cache.kc, self.cache.vc, self._tok,
             self._cur, self._keys, ids, np.int32(n_eff),
@@ -471,6 +779,148 @@ class Engine:
         self.metrics.prefills += 1
         self.cache.cur_pos[slot] = n_eff
         self._emit(h, int(tok0))
+        return True
+
+    def _admit_one_paged(self, h, k, n_eff):
+        full = self._full_ids(h)
+        slot = self.cache.alloc(h.request_id)
+        # wire block-table coverage for [0, n_eff] (prompt + replay
+        # tokens + the first decode write line); the radix index shares
+        # any cached full-block prefix (memory dedup + skipped chunk
+        # compute), copy-on-write on a partial tail block
+        match_ids = full if self.prefix_sharing else full[:0]
+        admitted = self.cache.admit(slot, match_ids, n_eff + 1)
+        if admitted is None:
+            self.cache.free(slot)
+            h.slot = None
+            return False
+        n_shared, cow = admitted
+        h.slot = slot
+        self._by_slot[slot] = h
+        self._temps[slot] = h.temperature
+        self.metrics.prompt_tokens += n_eff
+        self.metrics.prefix_hit_tokens += min(n_shared, n_eff)
+        if cow:
+            self.metrics.cow_copies += 1
+        if self.prefill_chunk is not None and n_eff > self.prefill_chunk:
+            # long prompt: prefill in block-aligned chunks co-scheduled
+            # with decode (one chunk per step) — the slot is occupied
+            # but joins the fused decode only after its final chunk.
+            # Fully-shared leading chunks are skipped outright (the
+            # radix already holds their KV): start at the chunk holding
+            # the first non-shared position, clamped so the chunk with
+            # the last prompt token (the sampling row) always runs.
+            C = self.prefill_chunk
+            start = (min(n_shared, n_eff - 1) // C) * C
+            self._chunking.append(
+                _ChunkState(h, full, n_eff, n_shared, start))
+            self.metrics.chunked_prefills += 1
+            return True
+        Lb = self._bucket(n_eff)
+        self.buckets_seen.add(Lb)
+        ids = np.zeros((1, Lb), np.int32)
+        ids[0, :n_eff] = full
+        out = self._prefill(
+            self._w, self.cache.kc, self.cache.vc, self._tok,
+            self._cur, self._keys, ids, np.int32(n_eff),
+            np.int32(slot), np.uint32(h.seed), np.int32(k),
+            np.float32(h.temperature),
+            self.cache.block_tables[slot].copy(), np.int32(n_shared),
+            **self._paged_statics)
+        (self.cache.kc, self.cache.vc, self._tok, self._cur,
+         self._keys, tok0) = out
+        self.metrics.prefills += 1
+        self.cache.cur_pos[slot] = n_eff
+        if self.prefix_sharing:
+            self.cache.commit_prefix(slot, full)
+        self._emit(h, int(tok0))
+        return True
+
+    def _chunk_tick(self):
+        """Advance the oldest in-progress chunked prefill by ONE chunk
+        (then the fused decode step runs for everyone else — long
+        prompts never block active decodes for more than a chunk)."""
+        cs = self._chunking[0]
+        h = cs.h
+        C = self.prefill_chunk
+        start = cs.next
+        end = min(start + C, cs.n_eff)
+        ids = np.zeros((1, C), np.int32)
+        ids[0, :end - start] = cs.ids[start:end]
+        is_final = end >= cs.n_eff
+        out = self._chunk(
+            self._w, self.cache.kc, self.cache.vc, self._tok, self._cur,
+            self._keys, ids, np.int32(start), np.int32(cs.n_eff),
+            np.int32(h.slot), self.cache.block_tables[h.slot].copy(),
+            np.int32(cs.n_shared), np.int32(1 if is_final else 0),
+            np.uint32(h.seed), np.int32(cs.skip),
+            np.float32(h.temperature), **self._paged_statics)
+        (self.cache.kc, self.cache.vc, self._tok, self._cur,
+         self._keys, tok0) = out
+        self.chunk_used = True
+        self.metrics.chunk_steps += 1
+        cs.next = end
+        if is_final:
+            self._chunking.pop(0)
+            self.metrics.prefills += 1
+            self.cache.cur_pos[h.slot] = cs.n_eff
+            if self.prefix_sharing:
+                self.cache.commit_prefix(h.slot, cs.ids)
+            self._emit(h, int(tok0))
+
+    # -- paged pool pressure ----------------------------------------------
+
+    def _decode_active(self):
+        """Decode-step row mask: occupied slots minus those still mid-
+        chunked-prefill (they hold their slot but have no sampled state
+        yet)."""
+        if not self._chunking:
+            return self.cache.active
+        m = self.cache.active.copy()
+        for cs in self._chunking:
+            m[cs.h.slot] = False
+        return m
+
+    def _ensure_decode_capacity(self, active_mask):
+        """Every decode-active slot needs a writable block for its next
+        line. On pool exhaustion (after radix eviction) the least
+        important active request is PREEMPTED — its blocks free, it
+        re-queues, and later re-admission replays prompt + emitted
+        tokens with the PRNG-chain fast-forward, so its final output is
+        token-identical (same machinery as supervisor adopt())."""
+        for slot in np.nonzero(active_mask)[0]:
+            slot = int(slot)
+            h = self._by_slot[slot]
+            if h is None:
+                continue
+            while not self.cache.ensure(slot, int(self.cache.cur_pos[slot])):
+                victim = self._pick_preempt_victim(exclude=h)
+                if victim is None:
+                    raise RuntimeError(
+                        "KV pool exhausted with a single active request "
+                        "— unreachable given the submit() capacity check")
+                self._preempt(victim)
+                if h.slot is None:
+                    break      # the needing slot itself got preempted
+
+    def _pick_preempt_victim(self, exclude):
+        cand = [x for x in self._by_slot
+                if x is not None and x is not exclude]
+        if not cand:
+            return None
+        # least important class first, newest arrival within it —
+        # mirrors brownout shedding order
+        return max(cand, key=lambda x: (x.priority, x.request_id))
+
+    def _preempt(self, h):
+        slot = h.slot
+        self._by_slot[slot] = None
+        self.cache.free(slot)
+        h.slot = None
+        self._chunking = [cs for cs in self._chunking if cs.h is not h]
+        self.scheduler.release(h)
+        self.scheduler.requeue(h)
+        self.metrics.preemptions += 1
 
     def adopt(self, handle):
         """Re-inject a handle from a previous engine incarnation
@@ -531,26 +981,53 @@ class Engine:
 
     def step(self):
         """One engine iteration: expire overdue requests, admit waiting
-        ones into free slots, then advance every active slot one token.
-        Returns the number of requests that were decoding this step."""
+        ones into free slots, advance ONE chunk of any in-progress
+        chunked prefill, then advance every decode-active slot one token
+        with the fused decode step (paged: gathering K/V through block
+        tables; preempting on pool exhaustion first). Returns the number
+        of requests that were decoding this step."""
         if self._condemned:
             return 0     # a supervisor replaced this engine incarnation
         self._expire()
         self._admit()
-        n_active = self.cache.n_active
-        self.metrics.sample(self.cache.occupancy,
-                            self.scheduler.queue_depth)
+        paged = self.kv_layout == "paged"
+        if paged and self._chunking:
+            self._chunk_tick()
+        if paged:
+            active = self._decode_active()
+            self._ensure_decode_capacity(active)
+            active = self._decode_active()     # preemption may shrink it
+        else:
+            active = self.cache.active
+        n_active = int(active.sum())
+        if paged:
+            self.metrics.sample(self.cache.occupancy,
+                                self.scheduler.queue_depth,
+                                active=self.cache.n_active,
+                                pool_free=self.cache.pool.n_free,
+                                pool_total=self.cache.pool.n_blocks - 1)
+        else:
+            self.metrics.sample(self.cache.occupancy,
+                                self.scheduler.queue_depth,
+                                active=self.cache.n_active)
         if n_active:
             t0 = time.perf_counter()
-            out = self._decode(
-                self._w, self.cache.kc, self.cache.vc, self._tok,
-                self._cur, self.cache.active, self._keys,
-                self._temps, **self._statics)
+            if paged:
+                out = self._decode(
+                    self._w, self.cache.kc, self.cache.vc,
+                    self.cache.block_tables.copy(), self._tok,
+                    self._cur, active, self._keys, self._temps,
+                    **self._paged_statics)
+            else:
+                out = self._decode(
+                    self._w, self.cache.kc, self.cache.vc, self._tok,
+                    self._cur, active, self._keys,
+                    self._temps, **self._statics)
             nxt, self.cache.kc, self.cache.vc, self._cur, self._keys = out
             self._tok = nxt
             self.metrics.mark_decode(time.perf_counter() - t0)
             toks = np.asarray(nxt)
-            for slot in np.nonzero(self.cache.active)[0]:
+            for slot in np.nonzero(active)[0]:
                 h = self._by_slot[int(slot)]
                 self._emit(h, int(toks[slot]))
         return n_active
@@ -579,8 +1056,15 @@ class Engine:
         h.metrics.mark_finished()
         if h.slot is not None:         # queued-only timeouts held no slot
             self._by_slot[h.slot] = None
+            # paged: every block the slot holds is released here —
+            # shared-prefix refcounts drop and private blocks (including
+            # the already-written chunks of a cancelled/timed-out
+            # mid-prefill request) return to the pool
             self.cache.free(h.slot)
             self.scheduler.release(h)
+            if self._chunking:
+                self._chunking = [cs for cs in self._chunking
+                                  if cs.h is not h]
         if reason == "timeout":
             self.metrics.requests_timed_out += 1
         elif reason == "cancelled":
@@ -602,10 +1086,17 @@ class Engine:
         return handles
 
     def stats(self):
-        return {**self.metrics.snapshot(),
-                "n_slots": self.n_slots, "max_len": self.max_len,
-                "active": self.cache.n_active,
-                "queue_depth": self.scheduler.queue_depth,
-                "kv_cache_bytes": self.cache.nbytes(),
-                "prefill_buckets": sorted(self.buckets_seen),
-                "compile_budget": self.compile_budget}
+        out = {**self.metrics.snapshot(),
+               "n_slots": self.n_slots, "max_len": self.max_len,
+               "kv_layout": self.kv_layout,
+               "active": self.cache.n_active,
+               "queue_depth": self.scheduler.queue_depth,
+               "kv_cache_bytes": self.cache.nbytes(),
+               "prefill_buckets": sorted(self.buckets_seen),
+               "chunk_program": self.chunk_used,
+               "compile_budget": self.compile_budget}
+        if self.kv_layout == "paged":
+            out.update(self.cache.pool_stats())
+            out["prefill_chunk"] = self.prefill_chunk
+            out["prefix_sharing"] = self.prefix_sharing
+        return out

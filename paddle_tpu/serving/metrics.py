@@ -87,16 +87,44 @@ class EngineMetrics:
         self.queue_depth_sum = 0
         self.peak_queue_depth = 0
         self.samples = 0
+        # paged-KV counters: admitted concurrency, pool pressure,
+        # prefix sharing and chunked prefill (zero on slot engines)
+        self.peak_active = 0           # max concurrently admitted
+        self.preemptions = 0           # pool-exhaustion evict+replay
+        self.chunked_prefills = 0      # requests that prefilled chunked
+        self.chunk_steps = 0           # chunk-program invocations
+        self.prefix_hit_tokens = 0     # prompt tokens served from radix
+        self.prompt_tokens = 0         # total prompt tokens admitted
+        self.cow_copies = 0            # partial tail blocks privatized
+        self.pool_occupancy_sum = 0.0  # used/total blocks per sample
+        self.pool_samples = 0
+        self.pool_low_watermark = None  # min free blocks ever seen
         # rolling window of decode-step wall times: the live ITL estimate
         # behind EngineOverloaded.retry_after_s and deadline accounting
         self._decode_times = collections.deque(maxlen=64)
         _register(self)
 
-    def sample(self, occupancy, queue_depth):
+    def sample(self, occupancy, queue_depth, active=0, pool_free=None,
+               pool_total=None):
         self.samples += 1
         self.occupancy_sum += occupancy
         self.queue_depth_sum += queue_depth
         self.peak_queue_depth = max(self.peak_queue_depth, queue_depth)
+        self.peak_active = max(self.peak_active, int(active))
+        if pool_total:
+            self.pool_samples += 1
+            self.pool_occupancy_sum += 1.0 - pool_free / pool_total
+            self.pool_low_watermark = (
+                pool_free if self.pool_low_watermark is None
+                else min(self.pool_low_watermark, pool_free))
+
+    def prefix_hit_rate(self):
+        """Fraction of admitted prompt tokens served out of the radix
+        prefix index instead of freshly-written blocks; None before any
+        admission."""
+        if not self.prompt_tokens:
+            return None
+        return self.prefix_hit_tokens / self.prompt_tokens
 
     def mark_decode(self, duration_s):
         self.decode_steps += 1
@@ -122,6 +150,7 @@ class EngineMetrics:
         n = max(self.samples, 1)
         itl = self.itl_estimate()
         p95 = self.itl_p95()
+        hr = self.prefix_hit_rate()
         return {
             "requests_submitted": self.requests_submitted,
             "requests_completed": self.requests_completed,
@@ -135,6 +164,19 @@ class EngineMetrics:
             "avg_slot_occupancy": round(self.occupancy_sum / n, 4),
             "avg_queue_depth": round(self.queue_depth_sum / n, 4),
             "peak_queue_depth": self.peak_queue_depth,
+            "peak_active": self.peak_active,
+            "preemptions": self.preemptions,
+            "chunked_prefills": self.chunked_prefills,
+            "chunk_steps": self.chunk_steps,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prompt_tokens": self.prompt_tokens,
+            "prefix_hit_rate": (None if hr is None else round(hr, 4)),
+            "cow_copies": self.cow_copies,
+            "pool_occupancy": (
+                None if not self.pool_samples
+                else round(self.pool_occupancy_sum / self.pool_samples,
+                           4)),
+            "pool_low_watermark": self.pool_low_watermark,
             "itl_estimate_ms": (None if itl is None
                                 else round(itl * 1e3, 3)),
             "itl_p95_ms": (None if p95 is None
@@ -157,6 +199,10 @@ def global_counters():
         "requests_cancelled": 0, "requests_shed": 0,
         "tokens_generated": 0, "prefills": 0,
         "decode_steps": 0, "peak_queue_depth": 0,
+        "preemptions": 0, "chunked_prefills": 0, "chunk_steps": 0,
+        "prefix_hit_tokens": 0, "prompt_tokens": 0, "cow_copies": 0,
+        "peak_active": 0, "prefix_hit_rate": None,
+        "pool_low_watermark": None,
     }
     live = []
     for ref in _ENGINES:
@@ -169,11 +215,22 @@ def global_counters():
         for k in ("requests_submitted", "requests_completed",
                   "requests_rejected", "requests_timed_out",
                   "requests_cancelled", "requests_shed",
-                  "tokens_generated", "prefills", "decode_steps"):
+                  "tokens_generated", "prefills", "decode_steps",
+                  "preemptions", "chunked_prefills", "chunk_steps",
+                  "prefix_hit_tokens", "prompt_tokens", "cow_copies"):
             total[k] += s[k]
         total["peak_queue_depth"] = max(total["peak_queue_depth"],
                                         s["peak_queue_depth"])
+        total["peak_active"] = max(total["peak_active"], s["peak_active"])
+        if s["pool_low_watermark"] is not None:
+            lw = total["pool_low_watermark"]
+            total["pool_low_watermark"] = (
+                s["pool_low_watermark"] if lw is None
+                else min(lw, s["pool_low_watermark"]))
     _ENGINES[:] = live
+    if total["prompt_tokens"]:
+        total["prefix_hit_rate"] = round(
+            total["prefix_hit_tokens"] / total["prompt_tokens"], 4)
     return total
 
 

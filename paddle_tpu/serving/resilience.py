@@ -115,7 +115,8 @@ class EngineSupervisor:
         # nothing in-process (module-level jit cache) but a fresh
         # process pays the union — analysis.audit_engine budgets on it
         self.buckets_seen_total = set()
-        self.rebuilds = 0
+        self.chunk_used_total = False   # any incarnation traced the
+        self.rebuilds = 0               # chunked-prefill program
         self.replayed = 0              # handles re-admitted with tokens
         self.wedges = 0
         self.step_errors = 0
@@ -241,10 +242,17 @@ class EngineSupervisor:
     # -- detect ------------------------------------------------------------
 
     def _probe_kv(self):
-        """Finiteness probe over the active slots' KV buffers: poisoned
-        state (bit flips, a bad DMA — chaos fault ``kv-corrupt``) is
-        caught BEFORE the next decode step can consume it, so the
-        rebuild's replay-from-tokens stays token-identical."""
+        """Finiteness probe over the live KV state: poisoned state (bit
+        flips, a bad DMA — chaos fault ``kv-corrupt``) is caught BEFORE
+        the next decode step can consume it, so the rebuild's
+        replay-from-tokens stays token-identical. On a paged engine the
+        probe walks the LIVE BLOCKS only (blocks referenced by occupied
+        slots' block tables — the trash block and radix-only residents
+        hold no in-flight request state), so probe cost scales with
+        resident tokens, not pool capacity. A corrupted SHARED prefix
+        block is healed for every sharer at once: the rebuild re-admits
+        all of them through a fresh radix index, and the first
+        re-prefill rewrites the prefix bit-identically."""
         if not self.kv_probe_interval:
             return
         self._steps_since_probe += 1
@@ -252,16 +260,24 @@ class EngineSupervisor:
             return
         self._steps_since_probe = 0
         eng = self.engine
-        active = np.nonzero(eng.cache.active)[0]
-        if active.size == 0:
-            return
-        kc = np.asarray(eng.cache.kc)[:, active]
-        vc = np.asarray(eng.cache.vc)[:, active]
+        cache = eng.cache
+        if hasattr(cache, "live_blocks"):          # paged pool
+            where = cache.live_blocks()
+            if not where:
+                return
+            kc = np.asarray(cache.kc)[:, where]
+            vc = np.asarray(cache.vc)[:, where]
+        else:
+            where = np.nonzero(cache.active)[0]
+            if len(where) == 0:
+                return
+            kc = np.asarray(cache.kc)[:, where]
+            vc = np.asarray(cache.vc)[:, where]
         if np.isfinite(kc).all() and np.isfinite(vc).all():
             return
         self.kv_corruptions += 1
         self.ledger.record("anomaly", kind="kv-corrupt",
-                           slots=[int(s) for s in active])
+                           slots=[int(s) for s in where])
         self._rebuild_and_replay(why="kv-corrupt")
 
     # -- rebuild + replay --------------------------------------------------
@@ -278,6 +294,7 @@ class EngineSupervisor:
                          key=lambda h: h.request_id)
         queued = [h for h in list(old.scheduler._queue) if not h.finished]
         self.buckets_seen_total |= old.buckets_seen
+        self.chunk_used_total |= bool(getattr(old, "chunk_used", False))
         self.engine = self._build()
         self.engine._next_id = old._next_id
         self.rebuilds += 1

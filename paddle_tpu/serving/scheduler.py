@@ -52,6 +52,16 @@ class FIFOScheduler:
     def _load(handle):
         return handle.n_prompt + handle.max_new_tokens
 
+    @staticmethod
+    def _immediate(handle):
+        """Token lines the request needs the moment it admits: prompt +
+        already-emitted replay tokens + the first decode write line.
+        The paged engine gates admission on this against the pool's
+        free-block headroom (``free_tokens``) instead of reserving the
+        worst case — decode growth allocates lazily, preemption covers
+        the tail risk."""
+        return (handle.n_prompt + len(getattr(handle, "tokens", ())) + 1)
+
     @property
     def queue_depth(self):
         return len(self._queue)
@@ -84,20 +94,35 @@ class FIFOScheduler:
                 h for h in self._queue if id(h) not in dead)
         return expired
 
-    def pop_admissible(self, free_slots):
-        """Pop the FIFO prefix that fits in ``free_slots`` and the token
-        watermark. Popped handles are counted in-flight immediately;
-        call release() when their request finishes."""
+    def pop_admissible(self, free_slots, free_tokens=None):
+        """Pop the FIFO prefix that fits in ``free_slots``, the token
+        watermark, and (when given) ``free_tokens`` — the paged pool's
+        free-block headroom in token lines, so admission accounts FREE
+        BLOCKS, not worst-case slot reservations. Popped handles are
+        counted in-flight immediately; call release() when their request
+        finishes."""
         out = []
         while self._queue and free_slots > 0:
-            need = self._load(self._queue[0])
+            head = self._queue[0]
+            need = self._load(head)
             if self._inflight_tokens + need > self.token_budget and \
                     self._inflight_tokens > 0:
                 break   # strict FIFO: head waits, nothing overtakes it
+            if free_tokens is not None:
+                imm = self._immediate(head)
+                if imm > free_tokens:
+                    break   # head waits for blocks; nothing overtakes
+                free_tokens -= imm
             out.append(self._queue.popleft())
             self._inflight_tokens += need
             free_slots -= 1
         return out
+
+    def requeue(self, handle):
+        """Put a preempted (or pool-bounced) handle back at the front of
+        arrival order, bypassing max_queue backpressure — it was already
+        admitted once and holds no budget share while queued."""
+        self._queue.appendleft(handle)
 
     def remove(self, handle):
         """Drop one queued handle (client abandon). Queued handles hold
@@ -152,7 +177,7 @@ class PriorityScheduler(FIFOScheduler):
                 d if d is not None else float("inf"),
                 getattr(h, "request_id", 0))
 
-    def pop_admissible(self, free_slots):
+    def pop_admissible(self, free_slots, free_tokens=None):
         out = []
         while self._queue and free_slots > 0:
             head = min(self._queue, key=self._key)
@@ -160,6 +185,11 @@ class PriorityScheduler(FIFOScheduler):
             if self._inflight_tokens + need > self.token_budget and \
                     self._inflight_tokens > 0:
                 break   # the most urgent request waits; nothing overtakes
+            if free_tokens is not None:
+                imm = self._immediate(head)
+                if imm > free_tokens:
+                    break   # urgent head waits for blocks; no overtaking
+                free_tokens -= imm
             self._queue.remove(head)
             out.append(head)
             self._inflight_tokens += need

@@ -295,6 +295,181 @@ def _generate_jit(w, input_ids, prompt_len_mask, key, *, n_heads, n_kv, eps,
 
 
 # ---------------------------------------------------------------------------
+# paged-KV bodies (serving engine): decode attention gathers K/V through
+# per-slot block tables; prefill/chunk writes are block-aligned scatters
+# into the shared pool (masked writes redirect to the reserved trash
+# block). Module-level like the slot bodies: one lowering per shape.
+# ---------------------------------------------------------------------------
+
+
+def _paged_view(pool_l, tables, block_size):
+    """Gather contiguous per-slot K or V views through block tables:
+    pool_l [n_blocks, bs, kv, hd], tables [S, mb] -> [S, mb*bs, kv, hd]
+    (view index == logical position; unused table entries point at the
+    trash block and sit beyond the causal bound)."""
+    v = pool_l[tables]                       # [S, mb, bs, kv, hd]
+    S, mb = tables.shape
+    return v.reshape(S, mb * block_size, pool_l.shape[-2],
+                     pool_l.shape[-1])
+
+
+def _llama_decode_layer_paged(xt, lw, kc_pool, vc_pool, tables, dest,
+                              write_pos, rope_pos, *, n_heads, n_kv, eps,
+                              theta, block_size):
+    """One Llama decoder layer advancing every slot one token against
+    the paged pool: the new K/V scatters to flat pool index ``dest``
+    (trash-redirected for inactive rows), then attention gathers each
+    slot's view through its block-table row. kc_pool/vc_pool
+    [n_blocks, bs, n_kv, hd] (one layer); tables [S, mb]; dest [S];
+    write_pos/rope_pos [S]."""
+    S = xt.shape[0]
+    h = xt.shape[-1]
+    hd = h // n_heads
+    dt = xt.dtype
+    h1 = _rms(xt, lw["ln1"], eps)
+    q = (h1 @ lw["wq"]).reshape(S, 1, n_heads, hd)
+    k = (h1 @ lw["wk"]).reshape(S, 1, n_kv, hd)
+    v = (h1 @ lw["wv"]).reshape(S, 1, n_kv, hd)
+    q, k = _rope_rows(q, k, rope_pos, theta, dt)
+    nb, bs = kc_pool.shape[0], kc_pool.shape[1]
+    kc_pool = kc_pool.reshape(nb * bs, n_kv, hd).at[dest].set(
+        k[:, 0]).reshape(nb, bs, n_kv, hd)
+    vc_pool = vc_pool.reshape(nb * bs, n_kv, hd).at[dest].set(
+        v[:, 0]).reshape(nb, bs, n_kv, hd)
+    kview = _paged_view(kc_pool, tables, block_size)   # [S, T, n_kv, hd]
+    vview = _paged_view(vc_pool, tables, block_size)
+    kh = jnp.repeat(kview, n_heads // n_kv, axis=2)
+    vh = jnp.repeat(vview, n_heads // n_kv, axis=2)
+    s = jnp.einsum("bhd,bthd->bht", q[:, 0], kh,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(hd))
+    T = kview.shape[1]
+    valid = jnp.arange(T)[None, :] <= write_pos[:, None]
+    s = jnp.where(valid[:, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    o = jnp.einsum("bht,bthd->bhd", p, vh).reshape(S, 1, h)
+    xt2 = xt + o @ lw["wo"]
+    h2 = _rms(xt2, lw["ln2"], eps)
+    xt2 = xt2 + (jax.nn.silu(h2 @ lw["wg"]) * (h2 @ lw["wu"])) @ lw["wd"]
+    return xt2, kc_pool, vc_pool
+
+
+def _gpt_decode_layer_paged(xt, lw, kc_pool, vc_pool, tables, dest,
+                            write_pos, *, n_heads, block_size):
+    """GPT block, paged decode (learned positions enter at the
+    embedding; only the pool write/gather differs from the slot body)."""
+    S = xt.shape[0]
+    h = xt.shape[-1]
+    hd = h // n_heads
+    dt = xt.dtype
+    hN = _ln(xt, lw["ln1w"], lw["ln1b"])
+    qkv = hN @ lw["wqkv"] + lw["bqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(S, 1, n_heads, hd)
+    k = k.reshape(S, 1, n_heads, hd)
+    v = v.reshape(S, 1, n_heads, hd)
+    nb, bs = kc_pool.shape[0], kc_pool.shape[1]
+    kc_pool = kc_pool.reshape(nb * bs, n_heads, hd).at[dest].set(
+        k[:, 0]).reshape(nb, bs, n_heads, hd)
+    vc_pool = vc_pool.reshape(nb * bs, n_heads, hd).at[dest].set(
+        v[:, 0]).reshape(nb, bs, n_heads, hd)
+    kview = _paged_view(kc_pool, tables, block_size)
+    vview = _paged_view(vc_pool, tables, block_size)
+    s = jnp.einsum("bhd,bthd->bht", q[:, 0], kview,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(hd))
+    T = kview.shape[1]
+    valid = jnp.arange(T)[None, :] <= write_pos[:, None]
+    s = jnp.where(valid[:, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    o = jnp.einsum("bht,bthd->bhd", p, vview).reshape(S, 1, h)
+    xt2 = xt + o @ lw["wproj"] + lw["bproj"]
+    h2 = _ln(xt2, lw["ln2w"], lw["ln2b"])
+    xt2 = xt2 + jax.nn.gelu(h2 @ lw["wfc1"] + lw["bfc1"],
+                            approximate=False) @ lw["wfc2"] + lw["bfc2"]
+    return xt2, kc_pool, vc_pool
+
+
+def _llama_chunk_layer(x, lw, kc_pool, vc_pool, table_row, gpos, wdest, *,
+                       n_heads, n_kv, eps, theta, block_size):
+    """One Llama layer over one block-aligned prefill CHUNK of a single
+    slot: x [1, C, h] at global positions ``gpos`` [C]; the chunk's K/V
+    scatter to flat pool indices ``wdest`` [C] (shared-prefix / pad
+    positions trash-redirected), then the chunk rows attend to the
+    slot's full gathered view (earlier chunks + this one) under the
+    causal bound ``view_pos <= gpos``."""
+    B, C, h = x.shape
+    hd = h // n_heads
+    dt = x.dtype
+    h1 = _rms(x, lw["ln1"], eps)
+    q = (h1 @ lw["wq"]).reshape(B, C, n_heads, hd)
+    k = (h1 @ lw["wk"]).reshape(B, C, n_kv, hd)
+    v = (h1 @ lw["wv"]).reshape(B, C, n_kv, hd)
+    q, k = _rope(q, k, gpos, theta, dt)
+    nb, bs = kc_pool.shape[0], kc_pool.shape[1]
+    kc_pool = kc_pool.reshape(nb * bs, n_kv, hd).at[wdest].set(
+        k[0]).reshape(nb, bs, n_kv, hd)
+    vc_pool = vc_pool.reshape(nb * bs, n_kv, hd).at[wdest].set(
+        v[0]).reshape(nb, bs, n_kv, hd)
+    kview = _paged_view(kc_pool, table_row[None], block_size)  # [1,T,kv,hd]
+    vview = _paged_view(vc_pool, table_row[None], block_size)
+    qh = jnp.swapaxes(q, 1, 2)                                 # [1,H,C,hd]
+    kh = jnp.repeat(jnp.swapaxes(kview, 1, 2), n_heads // n_kv, axis=1)
+    vh = jnp.repeat(jnp.swapaxes(vview, 1, 2), n_heads // n_kv, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(hd))
+    T = kview.shape[1]
+    cm = jnp.arange(T)[None, :] <= gpos[:, None]               # [C, T]
+    s = jnp.where(cm[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    o = jnp.swapaxes(o, 1, 2).reshape(B, C, h)
+    x = x + o @ lw["wo"]
+    h2 = _rms(x, lw["ln2"], eps)
+    x = x + (jax.nn.silu(h2 @ lw["wg"]) * (h2 @ lw["wu"])) @ lw["wd"]
+    return x, kc_pool, vc_pool
+
+
+def _gpt_chunk_layer(x, lw, kc_pool, vc_pool, table_row, gpos, wdest, *,
+                     n_heads, block_size):
+    """GPT block over one prefill chunk (positions via wpe upstream)."""
+    B, C, h = x.shape
+    hd = h // n_heads
+    dt = x.dtype
+    hN = _ln(x, lw["ln1w"], lw["ln1b"])
+    qkv = hN @ lw["wqkv"] + lw["bqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, C, n_heads, hd)
+    k = k.reshape(B, C, n_heads, hd)
+    v = v.reshape(B, C, n_heads, hd)
+    nb, bs = kc_pool.shape[0], kc_pool.shape[1]
+    kc_pool = kc_pool.reshape(nb * bs, n_heads, hd).at[wdest].set(
+        k[0]).reshape(nb, bs, n_heads, hd)
+    vc_pool = vc_pool.reshape(nb * bs, n_heads, hd).at[wdest].set(
+        v[0]).reshape(nb, bs, n_heads, hd)
+    kview = _paged_view(kc_pool, table_row[None], block_size)
+    vview = _paged_view(vc_pool, table_row[None], block_size)
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(kview, 1, 2)
+    vh = jnp.swapaxes(vview, 1, 2)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(hd))
+    T = kview.shape[1]
+    cm = jnp.arange(T)[None, :] <= gpos[:, None]
+    s = jnp.where(cm[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    o = jnp.swapaxes(o, 1, 2).reshape(B, C, h)
+    x = x + o @ lw["wproj"] + lw["bproj"]
+    h2 = _ln(x, lw["ln2w"], lw["ln2b"])
+    x = x + jax.nn.gelu(h2 @ lw["wfc1"] + lw["bfc1"],
+                        approximate=False) @ lw["wfc2"] + lw["bfc2"]
+    return x, kc_pool, vc_pool
+
+
+# ---------------------------------------------------------------------------
 # beam search (Llama decoder)
 # ---------------------------------------------------------------------------
 

@@ -9,12 +9,20 @@ tools/bench_eager.py):
   deployment story) -> tokens/sec
 - engine sweep over n_slots: continuous batching -> tokens/sec plus
   p50/p95 TTFT and inter-token latency from the metrics ledger
+- prefix-reuse sweep (offered-load A/B at EQUAL KV byte budget): a
+  shared-system-prompt workload served by the slot engine vs the paged
+  engine — max admitted concurrency, KV bytes per resident token,
+  TTFT/ITL p50/p95, prefix hit rate. The paged pool must admit >= 2x
+  the concurrency (equivalently <= 1/2 the KV bytes/token) at equal
+  quality (token-identical outputs across arms).
 
 ok requires the best engine arm to beat sequential throughput on the
-same workload. Warm programs only: every arm runs the workload once to
-compile, then measures a second identical run.
+same workload AND the paged arm to hit the 2x prefix-reuse bar.
+Warm programs only: every arm runs the workload once to compile, then
+measures a second identical run.
 
 Usage: JAX_PLATFORMS=cpu python tools/bench_serving.py [--requests N]
+       [--skip-prefix-sweep]
 """
 import argparse
 import json
@@ -26,6 +34,75 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+def prefix_reuse_sweep(model, cfg, *, n_requests=24, max_new=8,
+                       slot_slots=6, max_len=64, block_size=16,
+                       sys_len=48, tail_len=4):
+    """Shared-system-prompt offered load, slot vs paged at the SAME KV
+    byte budget: the slot arm reserves ``slot_slots * max_len`` lines;
+    the paged arm gets exactly that many lines as blocks and as many
+    host-side slots as there are requests, so admitted concurrency is
+    bounded by the POOL, not by worst-case reservations."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import Engine, ledger
+
+    rng = np.random.default_rng(0)
+    sys_prompt = rng.integers(0, cfg.vocab_size, (sys_len,)).astype(
+        np.int32)
+    prompts = [np.concatenate(
+        [sys_prompt,
+         rng.integers(0, cfg.vocab_size, (tail_len,)).astype(np.int32)])
+        for _ in range(n_requests)]
+    budget_tokens = slot_slots * max_len
+    n_blocks = budget_tokens // block_size + 1     # +1: the trash block
+    req_tokens = sys_len + tail_len + max_new
+
+    def run(**engine_kw):
+        eng = Engine(model, max_len=max_len, min_prompt_bucket=8,
+                     **engine_kw)
+        eng.generate_all(prompts, max_new_tokens=max_new)      # warm
+        eng2 = Engine(model, max_len=max_len, min_prompt_bucket=8,
+                      **engine_kw)
+        t0 = time.perf_counter()
+        handles = eng2.generate_all(prompts, max_new_tokens=max_new)
+        wall = time.perf_counter() - t0
+        led = ledger(handles)
+        st = eng2.stats()
+        peak = st["peak_active"]
+        led.update({
+            "kv_layout": st["kv_layout"], "wall_s": round(wall, 3),
+            "kv_bytes": st["kv_cache_bytes"],
+            "max_admitted_concurrency": peak,
+            "kv_bytes_per_resident_token": round(
+                st["kv_cache_bytes"] / max(1, peak * req_tokens), 1),
+            "prefix_hit_rate": st.get("prefix_hit_rate"),
+            "preemptions": st.get("preemptions", 0),
+            "cow_copies": st.get("cow_copies", 0),
+            "pool_low_watermark": st.get("pool_low_watermark"),
+        })
+        return led, [list(h.tokens) for h in handles]
+
+    slot_led, slot_toks = run(kv_layout="slot", n_slots=slot_slots)
+    paged_led, paged_toks = run(kv_layout="paged", n_slots=n_requests,
+                                block_size=block_size, n_blocks=n_blocks)
+    conc_ratio = (paged_led["max_admitted_concurrency"]
+                  / max(1, slot_led["max_admitted_concurrency"]))
+    bytes_ratio = (slot_led["kv_bytes_per_resident_token"]
+                   / max(1e-9, paged_led["kv_bytes_per_resident_token"]))
+    return {
+        "requests": n_requests, "max_new": max_new,
+        "shared_prefix_len": sys_len, "tail_len": tail_len,
+        "kv_byte_budget": slot_led["kv_bytes"],
+        "slot": slot_led, "paged": paged_led,
+        "admitted_concurrency_ratio": round(conc_ratio, 2),
+        "kv_bytes_per_token_ratio": round(bytes_ratio, 2),
+        "equal_quality": paged_toks == slot_toks,
+        "ok": bool((conc_ratio >= 2.0 or bytes_ratio >= 2.0)
+                   and paged_toks == slot_toks),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=16)
@@ -33,6 +110,7 @@ def main():
     ap.add_argument("--slots", type=int, nargs="+", default=[2, 4, 8])
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--skip-prefix-sweep", action="store_true")
     args = ap.parse_args()
 
     import numpy as np
@@ -86,6 +164,11 @@ def main():
     best = max(sweep, key=lambda r: r["tokens_per_sec"])
     ok = best["tokens_per_sec"] > seq_tps
 
+    prefix = None
+    if not args.skip_prefix_sweep:
+        prefix = prefix_reuse_sweep(model, cfg)
+        ok = ok and prefix["ok"]
+
     print(json.dumps({
         "bench": "serving_engine",
         "backend": jax.default_backend(),
@@ -98,6 +181,7 @@ def main():
         "best_tokens_per_sec": best["tokens_per_sec"],
         "best_n_slots": best["n_slots"],
         "speedup_vs_sequential": round(best["tokens_per_sec"] / seq_tps, 2),
+        "prefix_reuse": prefix,
         "ok": ok,
     }))
     return 0 if ok else 1

@@ -36,14 +36,24 @@ def _workload(seed):
     """Deterministic staggered workload: (prompt, max_new, temp, seed)
     per request, plus the submission schedule (request idx -> steps to
     pump before the next arrival). ≥3 requests in flight at different
-    positions when a mid-run fault fires."""
+    positions when a mid-run fault fires. The first two requests share
+    an 8-token system prefix — one full paged block — so the kv-corrupt
+    fault has a live SHARED prefix block to poison (the nastiest case:
+    every sharer reads it, and replay must heal them all)."""
     import numpy as np
 
     rng = np.random.default_rng(seed)
-    reqs = [(rng.integers(0, 1000, (int(n),)).astype(np.int32), int(m),
-             float(t), int(s))
-            for n, m, t, s in ((5, 8, 0.8, 11), (9, 8, 1.2, 7),
-                               (5, 7, 0.6, 3), (6, 6, 1.0, 23))]
+    sys_prefix = rng.integers(0, 1000, (8,)).astype(np.int32)
+
+    def prompt(n, shared):
+        tail = rng.integers(0, 1000, (int(n),)).astype(np.int32)
+        return np.concatenate([sys_prefix, tail]) if shared else tail
+
+    reqs = [(prompt(n, sh), int(m), float(t), int(s))
+            for n, m, t, s, sh in ((3, 8, 0.8, 11, True),
+                                   (4, 8, 1.2, 7, True),
+                                   (5, 7, 0.6, 3, False),
+                                   (6, 6, 1.0, 23, False))]
     schedule = (2, 1, 1, 0)     # decode steps pumped after each submit
     return reqs, schedule
 
@@ -76,8 +86,8 @@ def _verdict(fault, step, seed, stall_s):
     model = LlamaForCausalLM(cfg)
     model.eval()
     kw = dict(n_slots=2, max_len=64, min_prompt_bucket=4, do_sample=True,
-              top_k=8)
-    reqs, schedule = _workload(seed)
+              top_k=8, block_size=8)     # 8-token blocks: the shared
+    reqs, schedule = _workload(seed)     # prefix aliases one full block
 
     baseline = _run(Engine(model, **kw), reqs, schedule)
     base_tokens = [list(h.tokens) for h in baseline]
@@ -105,7 +115,14 @@ def _verdict(fault, step, seed, stall_s):
     # the engine must still be healthy after the fault: everything done
     idle = (sup.engine.cache.n_active == 0
             and sup.engine.scheduler.queue_depth == 0)
-    ok = bool(detected and recovered and idle
+    # paged-pool hygiene: block/radix refcounts must balance after the
+    # fault + replay, and the corrupt fault must have exercised prefix
+    # sharing (the poisoned block had sharers to heal)
+    refcounts_ok = sup.engine.cache.check_refcounts()
+    shared_tokens = sup.engine.metrics.prefix_hit_tokens
+    shared_ok = fault != "corrupt" or shared_tokens > 0
+    ok = bool(detected and recovered and idle and refcounts_ok
+              and shared_ok
               and (fault != "abandon" or len(abandoned) == 1))
     return {
         "fault": fault, "injected_step": step, "seed": seed,
@@ -114,7 +131,10 @@ def _verdict(fault, step, seed, stall_s):
         "wedges": sup.wedges, "step_errors": sup.step_errors,
         "kv_corruptions": sup.kv_corruptions, "abandoned": sup.abandoned,
         "survivors": len(survivors), "mismatched_requests": mismatches,
-        "token_identical": not mismatches, "ledger": sup.ledger.counts(),
+        "token_identical": not mismatches,
+        "refcounts_consistent": refcounts_ok,
+        "prefix_hit_tokens": int(shared_tokens),
+        "ledger": sup.ledger.counts(),
         "ok": ok,
     }
 
