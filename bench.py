@@ -983,6 +983,16 @@ def main():
         print(f"PERF REGRESSION: {tokens_per_sec} tok/s vs best {best} "
               f"(ratio {vs:.3f} < 0.95)", file=sys.stderr)
 
+    try:
+        # ride-along registry scrape: compile attribution + metrics
+        # state of the measured run for offline diffing (ledger-only —
+        # never gates the bench verdict)
+        from paddle_tpu import observability as obs
+        observability = {"compiles_by_origin": obs.compiles_by_origin(),
+                         "metrics": obs.snapshot()}
+    except Exception as e:
+        observability = {"error": f"{type(e).__name__}: {e}"}
+
     print(json.dumps({
         "metric": f"llama-0.5B pretrain tokens/sec/chip "
                   f"(bf16+flash, AdamW, {backend})",
@@ -992,7 +1002,8 @@ def main():
         "extra": {**{k: v for k, v in headline.items()
                      if k != "tokens_per_sec"},
                   "kernels": kernels,
-                  "secondary": secondary},
+                  "secondary": secondary,
+                  "observability": observability},
     }))
 
 

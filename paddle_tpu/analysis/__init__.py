@@ -62,6 +62,9 @@ silent-except         medium    blanket ``except Exception`` that neither
                                 re-raises nor records why
 non-atomic-write      medium    open-write-close without tmp+rename in
                                 checkpoint-path modules (torn durable state)
+wallclock-in-span     high      time.time() subtraction measuring a duration
+                                (NTP-steppable; spans/latency need
+                                perf_counter/monotonic)
 dtype-promotion       medium    np.float64 constant math in library code
 ====================  ========  =============================================
 

@@ -348,7 +348,78 @@ def _non_atomic_write(sf):
             yield f
 
 
-# -- 5. fp64 constant math in library code (AST facet of dtype-promotion) ----
+# -- 5. wall-clock durations (the observability span/latency contract) ------
+
+def _is_walltime_call(node):
+    """``time.time()`` — the NTP-steppable wall clock."""
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time")
+
+
+@rule("wallclock-in-span", kind="ast", severity="high",
+      title="time.time() used for a duration — wall clock steps under "
+            "NTP/suspend; durations must use perf_counter()/monotonic()")
+def _wallclock_in_span(sf):
+    """Flag subtraction involving a ``time.time()`` result: the
+    difference of two wall-clock reads is a DURATION, and wall clock is
+    the wrong clock for one (NTP slew/step, DST, suspend). Plain
+    ``time.time()`` reads (ledger timestamps, absolute deadlines that
+    only get compared) are untouched. Legitimate wall-clock subtraction
+    — cross-process liveness stamps, where monotonic clocks are not
+    comparable — carries ``# tpu_lint: allow(wallclock-in-span)``."""
+    if sf.tree is None:
+        return
+    # names assigned from time.time(), tracked PER enclosing function
+    # (a `t0` in one function must not taint another's perf_counter
+    # math); attribute targets (self._t0) are file-global because the
+    # assignment and the subtraction usually live in different methods
+    owner = _encl_funcs(sf.tree)
+    wall_names, wall_attrs = set(), set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and _is_walltime_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    wall_names.add((owner.get(node), tgt.id))
+                elif isinstance(tgt, ast.Attribute):
+                    wall_attrs.add(tgt.attr)
+
+    def is_wall_operand(op, fn):
+        if _is_walltime_call(op):
+            return True
+        if isinstance(op, ast.Name) and (fn, op.id) in wall_names:
+            return True
+        return isinstance(op, ast.Attribute) and op.attr in wall_attrs
+
+    seen_lines = set()
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Sub)):
+            continue
+        fn = owner.get(node)
+        if not (is_wall_operand(node.left, fn)
+                or is_wall_operand(node.right, fn)):
+            continue
+        if node.lineno in seen_lines:
+            continue
+        seen_lines.add(node.lineno)
+        f = _finding(
+            sf, "wallclock-in-span", "high", node,
+            "duration computed by subtracting wall-clock time.time() "
+            "reads — NTP steps/suspend make the difference wrong, and "
+            "spans/latency ledgers built on it lie",
+            "use time.perf_counter() (sub-second durations) or "
+            "time.monotonic() (deadlines/elapsed); if the subtraction "
+            "genuinely needs wall clock (cross-process liveness "
+            "stamps), annotate with "
+            "# tpu_lint: allow(wallclock-in-span)")
+        if f:
+            yield f
+
+
+# -- 6. fp64 constant math in library code (AST facet of dtype-promotion) ----
 
 @rule("dtype-promotion", kind="ast", severity="medium",
       title="np.float64 constant math in library code — fp64 results "

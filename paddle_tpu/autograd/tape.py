@@ -144,6 +144,17 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
     Populates ``.grad`` on every reachable leaf with stop_gradient=False.
     Grads accumulate across calls (paddle semantics) until clear_grad.
     """
+    from ..observability import tracing as _trc
+    from ..observability.compile_attr import compile_scope
+    if _trc._ENABLED:
+        with _trc.span("train.backward", cat="train"), \
+                compile_scope("eager:backward"):
+            return _backward_impl(tensor, grad_tensor, retain_graph)
+    with compile_scope("eager:backward"):
+        return _backward_impl(tensor, grad_tensor, retain_graph)
+
+
+def _backward_impl(tensor, grad_tensor=None, retain_graph=False):
     import jax.numpy as jnp
 
     from ..framework import dispatch_cache as _dcache

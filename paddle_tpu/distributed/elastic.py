@@ -74,6 +74,10 @@ class ElasticMembership:
                     stamp = float(fh.read().strip() or 0)
             except (OSError, ValueError):
                 continue
+            # cross-process liveness: heartbeat files carry wall-clock
+            # stamps (monotonic clocks aren't comparable across
+            # processes), so wall minus wall is the right arithmetic
+            # tpu_lint: allow(wallclock-in-span)
             if now - stamp <= self.timeout:
                 out.append(nid)
         return sorted(out)

@@ -126,18 +126,19 @@ def _supervise(procs, heartbeat=None, beat_every=5.0):
                 for _, q, _l in procs:
                     if q.poll() is None:
                         q.terminate()
-                deadline = time.time() + 10
+                deadline = time.monotonic() + 10
                 for _, q, _l in procs:
                     try:
-                        q.wait(timeout=max(0.1, deadline - time.time()))
+                        q.wait(timeout=max(0.1, deadline - time.monotonic()))
                     except subprocess.TimeoutExpired:
                         q.kill()
                 return rc_first, failed
             if not alive:
                 return 0, []
-            if heartbeat is not None and time.time() - last_beat > beat_every:
+            if heartbeat is not None \
+                    and time.monotonic() - last_beat > beat_every:
                 heartbeat()
-                last_beat = time.time()
+                last_beat = time.monotonic()
             time.sleep(0.2)
     finally:
         for _, _p, log in procs:

@@ -293,20 +293,39 @@ def _classify(raw):
 # -- compiled entries --------------------------------------------------------
 
 class _Entry:
-    __slots__ = ("fwd", "bwd")
+    __slots__ = ("fwd", "bwd", "label", "_fwd_warm", "_bwd_warm")
 
-    def __init__(self, fwd, bwd=None):
+    def __init__(self, fwd, bwd=None, label=""):
         self.fwd = fwd
         self.bwd = bwd
+        # compile attribution: the first execution of each half traces
+        # and compiles — scope it under the op label so the XLA compile
+        # lands in paddle_xla_compiles_total{origin="eager:<op>"}; warm
+        # calls pay one attribute check
+        self.label = label
+        self._fwd_warm = False
+        self._bwd_warm = False
 
     def forward(self, dyn_vals):
-        return self.fwd(tuple(dyn_vals), runtime_zero())
+        if self._fwd_warm:
+            return self.fwd(tuple(dyn_vals), runtime_zero())
+        from ..observability.compile_attr import compile_scope
+        with compile_scope(f"eager:{self.label}"):
+            out = self.fwd(tuple(dyn_vals), runtime_zero())
+        self._fwd_warm = True
+        return out
 
     def backward(self, pullback, cts):
-        return self.bwd(pullback, cts, runtime_zero())
+        if self._bwd_warm:
+            return self.bwd(pullback, cts, runtime_zero())
+        from ..observability.compile_attr import compile_scope
+        with compile_scope(f"eager:{self.label}"):
+            out = self.bwd(pullback, cts, runtime_zero())
+        self._bwd_warm = True
+        return out
 
 
-def _build_entry(fn, kwargs, template, statics, diff_idx):
+def _build_entry(fn, kwargs, template, statics, diff_idx, label=""):
     """Compile fwd (and bwd for grad mode) for one signature.
 
     ``statics`` are the live static arg values in template order (the key
@@ -334,7 +353,7 @@ def _build_entry(fn, kwargs, template, statics, diff_idx):
             def run(dyn):
                 return fn(*assemble(dyn), **kwargs)
             return bitwise_call(zero, run, dyn)
-        return _Entry(jax.jit(fwd))
+        return _Entry(jax.jit(fwd), label=label)
 
     def fwd(dyn, zero):
         def run(dyn):
@@ -354,7 +373,7 @@ def _build_entry(fn, kwargs, template, statics, diff_idx):
 
     bwd = jax.jit(lambda pullback, cts, zero:
                   bitwise_call(zero, lambda c: pullback(c), cts))
-    return _Entry(jax.jit(fwd), bwd)
+    return _Entry(jax.jit(fwd), bwd, label=label)
 
 
 # -- the dispatcher ----------------------------------------------------------
@@ -411,7 +430,7 @@ def dispatch(fn, raw, kwargs, diff_idx):
         statics = [v for v, t in zip(raw, template) if t != "d"]
         try:
             entry = _build_entry(fn, dict(kwargs), template, statics,
-                                 diff_idx)
+                                 diff_idx, label=_fn_label(fnk))
         except Exception as e:
             with _lock:
                 _blacklist[fnk] = \

@@ -83,7 +83,7 @@ class ProgBarLogger(Callback):
     def on_epoch_begin(self, epoch, logs=None):
         self.epoch = epoch
         self.steps = self.params.get("steps")
-        self._t0 = time.time()
+        self._t0 = time.perf_counter()
 
     def on_train_batch_end(self, step, logs=None):
         if self.verbose and step % self.log_freq == 0:
@@ -95,7 +95,7 @@ class ProgBarLogger(Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
-            dt = time.time() - self._t0
+            dt = time.perf_counter() - self._t0
             items = " - ".join(
                 f"{k}: {v:.4f}" if isinstance(v, numbers.Number) else f"{k}: {v}"
                 for k, v in (logs or {}).items())

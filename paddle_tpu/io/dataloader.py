@@ -330,12 +330,15 @@ class DataLoader:
 
         bm = _benchmark()
         bm.check_if_need_record(self)  # first active loader owns timing
+        from ..observability import tracing as _trc
+
         it = self._iter_batches()
         try:
             while True:
                 bm.before_reader(owner=id(self))
                 try:
-                    batch = next(it)
+                    with _trc.span("train.data", cat="train"):
+                        batch = next(it)
                 except StopIteration:
                     return
                 finally:

@@ -17,6 +17,7 @@ import numpy as np
 
 from ..framework import dtype as dtype_mod
 from ..framework.random_seed import next_key
+from ..observability import tracing as _obs_tracing
 from ..tensor import Parameter, Tensor
 from ..utils import unique_name
 from .initializer import Constant, XavierUniform, _to_initializer
@@ -313,6 +314,15 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *inputs, **kwargs):
+        # observability: one train.forward span per OUTERMOST model call
+        # when the tracer is on; the disabled path pays one module-attr
+        # branch (this is the hottest python call site in eager mode)
+        if _obs_tracing._ENABLED:
+            with _obs_tracing.forward_span(type(self).__name__):
+                return self._dispatch_forward(inputs, kwargs)
+        return self._dispatch_forward(inputs, kwargs)
+
+    def _dispatch_forward(self, inputs, kwargs):
         for hook in self._forward_pre_hooks.values():
             result = hook(self, inputs)
             if result is not None:

@@ -18,6 +18,8 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
+from ..observability import tracing as _obs_tracing
+from ..observability.compile_attr import compile_scope as _compile_scope
 from ..regularizer import L1Decay, L2Decay
 from ..tensor import Parameter, Tensor
 from .lr import LRScheduler
@@ -110,11 +112,22 @@ class Optimizer:
         return self._parameter_list
 
     def step(self):
+        if _obs_tracing._ENABLED:
+            with _obs_tracing.span("train.optimizer", cat="train",
+                                   optimizer=type(self).__name__):
+                return self._step_impl()
+        return self._step_impl()
+
+    def _step_impl(self):
         lr = self.get_lr()
         params = [p for p in self._all_params()
                   if p.grad is not None and p.trainable]
         if self._fused_step(params, lr):
-            return
+            return      # fused path scopes its own (one) cold compile
+        with _compile_scope(f"eager:optimizer:{type(self).__name__}"):
+            return self._step_body(params, lr)
+
+    def _step_body(self, params, lr):
         pgs = [(p, p.grad._data) for p in params]
         if self._grad_clip is not None:
             pgs = self._grad_clip(pgs)
@@ -154,7 +167,8 @@ class Optimizer:
             jitted = cache.get(key)
         except TypeError:  # unhashable key part (tracer avals etc.)
             return False
-        if jitted is None:
+        fresh = jitted is None
+        if fresh:
             try:
                 jitted = self._build_fused_step(list(params))
             except Exception:
@@ -167,9 +181,17 @@ class Optimizer:
         st_vals = tuple(self._accumulators[id(p)] for p in params)
         g_vals = tuple(p.grad._data for p in params)
         try:
-            new_ps, new_sts = jitted(p_vals, st_vals, g_vals,
-                                     jnp.asarray(lr, jnp.float32),
-                                     _dcache.runtime_zero())
+            if fresh:     # first call traces+compiles: attribute it
+                with _compile_scope(
+                        f"eager:fused_step:{type(self).__name__}"):
+                    new_ps, new_sts = jitted(
+                        p_vals, st_vals, g_vals,
+                        jnp.asarray(lr, jnp.float32),
+                        _dcache.runtime_zero())
+            else:
+                new_ps, new_sts = jitted(p_vals, st_vals, g_vals,
+                                         jnp.asarray(lr, jnp.float32),
+                                         _dcache.runtime_zero())
         except Exception:
             # first call traces: data-dependent clip/update python lands
             # here — permanently fall back to the eager loop

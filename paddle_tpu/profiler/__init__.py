@@ -123,6 +123,10 @@ def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
     return export_chrome_tracing(dir_name, worker_name)
 
 
+#: live started-but-not-stopped Profiler count — utils.in_profiler_mode
+_ACTIVE_PROFILERS = 0
+
+
 class Profiler:
     """paddle.profiler.Profiler over jax.profiler traces.
 
@@ -158,6 +162,8 @@ class Profiler:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self):
+        global _ACTIVE_PROFILERS
+        _ACTIVE_PROFILERS += 1
         self.current_state = self.scheduler(self._step)
         self._maybe_toggle()
         self._t0 = time.perf_counter()
@@ -167,6 +173,8 @@ class Profiler:
         return self
 
     def stop(self):
+        global _ACTIVE_PROFILERS
+        _ACTIVE_PROFILERS = max(0, _ACTIVE_PROFILERS - 1)
         if self._tracing:
             jax.profiler.stop_trace()
             self._tracing = False
@@ -277,6 +285,17 @@ class Profiler:
         fs = findings_summary()
         if fs:
             print(f"tpu_lint: {fs}")
+        from ..observability import compile_summary, tracing as _trc
+        cs = compile_summary()
+        if cs:
+            # every XLA compile this process paid, attributed to its
+            # origin (eager op / prefill bucket / chunk / decode /
+            # static segment) — paddle_tpu.observability.compile_attr
+            print(f"compiles: {cs}")
+        if _trc.enabled() and _trc.spans():
+            from .profiler_statistic import build_span_summary
+            print(build_span_summary(sorted_by=sorted_by,
+                                     time_unit=time_unit))
         if self.timer_only:
             return
         try:
@@ -296,11 +315,15 @@ class Profiler:
 
 
 class RecordEvent:
-    """Custom named range; shows in the device trace (TraceAnnotation)."""
+    """Custom named range; shows in the device trace (TraceAnnotation)
+    AND, when the observability tracer is on, as a ``user::<name>``
+    span in the in-process ring / Chrome export — so RecordEvent works
+    even without an active jax trace."""
 
     def __init__(self, name: str, event_type=None):
         self.name = name
         self._ann = None
+        self._span_tok = None
 
     def begin(self):
         # the UserDefined:: prefix is how the statistic parser routes
@@ -309,8 +332,16 @@ class RecordEvent:
         self._ann = jax.profiler.TraceAnnotation(
             f"UserDefined::{self.name}")
         self._ann.__enter__()
+        from ..observability import tracing as _trc
+        if _trc.enabled():
+            self._span_tok = _trc.begin_span(f"user::{self.name}",
+                                             cat="user")
 
     def end(self):
+        if self._span_tok is not None:
+            from ..observability import tracing as _trc
+            _trc.end_span(self._span_tok)
+            self._span_tok = None
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             self._ann = None
@@ -333,7 +364,13 @@ class RecordEvent:
 
 
 class RecordInstantEvent(RecordEvent):
-    pass
+    """Zero-duration marker: an instant event in the observability ring
+    plus a degenerate TraceAnnotation range in the device trace."""
+
+    def begin(self):
+        from ..observability import tracing as _trc
+        _trc.instant(f"user::{self.name}", cat="user")
+        super().begin()
 
 
 from .statistic import (ProfilerResult, build_summary,  # noqa: E402

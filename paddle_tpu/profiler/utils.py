@@ -1,4 +1,39 @@
-"""Reference import-path spelling (python/paddle/profiler/utils.py)."""
+"""Profiler utilities (reference: python/paddle/profiler/utils.py).
+
+Previously a 4-line re-export stub; now a working surface over the
+observability substrate:
+
+* :class:`RecordEvent` / :class:`RecordInstantEvent` — user ranges that
+  land both in the jax device trace and the observability span ring.
+* :func:`in_profiler_mode` — True while any ``Profiler`` is started
+  (the reference gates RecordEvent emission on this; ours emit
+  unconditionally, but callers can still branch on it).
+* :func:`wrap_optimizers` — the reference patches every optimizer's
+  ``step`` with an ``Optimization Step`` RecordEvent; here the span
+  instrumentation is built into ``Optimizer.step`` (the
+  ``train.optimizer`` span), so this idempotently enables the tracer —
+  the part of the reference behavior that still needs doing.
+"""
+from __future__ import annotations
+
 from . import RecordEvent, RecordInstantEvent  # noqa: F401
 
-__all__ = ["RecordEvent", "RecordInstantEvent"]
+__all__ = ["RecordEvent", "RecordInstantEvent", "in_profiler_mode",
+           "wrap_optimizers"]
+
+
+def in_profiler_mode():
+    """True while at least one ``profiler.Profiler`` is started."""
+    from . import _ACTIVE_PROFILERS
+
+    return _ACTIVE_PROFILERS > 0
+
+
+def wrap_optimizers():
+    """Make optimizer steps visible as spans (reference analog: patch
+    ``Optimizer.step`` with a RecordEvent). ``Optimizer.step`` already
+    emits a ``train.optimizer`` span whenever the observability tracer
+    is enabled, so wrapping == enabling the tracer. Idempotent."""
+    from ..observability import tracing
+
+    tracing.enable()

@@ -75,6 +75,11 @@ class ChaosMonkey:
         self.manager = manager
         self.calls = 0
         self.fired = []                 # [(step, fault)]
+        # observability: every fired fault gets a trace id (minted even
+        # with the tracer off) so chaos verdicts/ledgers link a fault to
+        # its spans; last_trace_id is the most recent fault's
+        self.trace_ids = {}             # step -> trace id
+        self.last_trace_id = None
         known = FAULTS + SERVING_FAULTS
         for f in tuple(dict(at or {}).values()) + tuple(faults):
             if f not in known:
@@ -103,7 +108,16 @@ class ChaosMonkey:
         fault = self.plan.get(step)
         if fault is not None:
             self.fired.append((step, fault))
+            self._mark_fired(step, fault)
         return fault
+
+    def _mark_fired(self, step, fault):
+        from ..observability import tracing
+        tid = tracing.new_trace_id()
+        self.trace_ids[step] = tid
+        self.last_trace_id = tid
+        tracing.instant(f"chaos.{fault}", cat="chaos", trace_id=tid,
+                        step=step, seed=self.seed)
 
     def wrap(self, step_fn):
         def chaotic_step(*args, **kwargs):
@@ -112,6 +126,7 @@ class ChaosMonkey:
             fault = self.plan.get(step)
             if fault is not None:
                 self.fired.append((step, fault))
+                self._mark_fired(step, fault)
                 return self._fire(fault, step_fn, args, kwargs)
             return step_fn(*args, **kwargs)
 

@@ -37,6 +37,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from ..observability import tracing as _tracing
 from ..utils.watchdog import TrainingWatchdog
 from .ledger import FlightLedger
 
@@ -337,8 +338,10 @@ class Supervisor:
         label = self.steps_completed - 1
         if label < 0:
             return None
-        path = self.manager.save(label, self.state.capture(),
-                                 async_save=async_save)
+        with _tracing.span("train.checkpoint", cat="train", step=label,
+                           reason=reason):
+            path = self.manager.save(label, self.state.capture(),
+                                     async_save=async_save)
         self._last_saved_step = label
         self.ledger.record("save", step=label, reason=reason)
         return path
@@ -438,7 +441,10 @@ class Supervisor:
         while True:
             t0 = time.perf_counter()
             try:
-                loss = self._call_step(args, kwargs)
+                with _tracing.span("train.step", cat="train",
+                                   step=self.steps_completed,
+                                   attempt=attempt):
+                    loss = self._call_step(args, kwargs)
             except Exception as e:
                 kind = ("stall" if isinstance(e, TimeoutError)
                         else "step-error")

@@ -44,6 +44,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..observability import tracing as _tracing
+from ..observability.compile_attr import compile_scope as _compile_scope
 from ..tensor import Tensor
 from .kv_cache import PagedKVCache, SlotKVCache
 from .metrics import EngineMetrics, RequestMetrics
@@ -500,6 +502,12 @@ class RequestHandle:
         self.retry_after_s = None      # stamped when shed under brownout
         self.slot = None
         self.metrics = RequestMetrics()
+        # one trace id for the request's whole lifecycle — minted
+        # whether or not tracing is on (ledgers/chaos verdicts refer to
+        # it), and kept by adopt() so a token-identical replay on a
+        # rebuilt engine links to the original request's trace
+        self.trace_id = _tracing.new_trace_id()
+        self._queued_t = self.metrics.submit_time
 
     def result(self):
         while not self.finished:
@@ -697,6 +705,9 @@ class Engine:
             self.base_seed + rid if seed is None else seed, on_token,
             max_time_s=max_time_s, priority=priority)
         self.metrics.requests_submitted += 1
+        _tracing.instant("serving.submit", cat="serving",
+                         trace_id=h.trace_id, request_id=rid,
+                         n_prompt=h.n_prompt, priority=h.priority)
         try:
             self.scheduler.enqueue(h, retry_after_s=self._retry_after_hint())
         except EngineOverloaded:
@@ -706,14 +717,16 @@ class Engine:
         return h
 
     def _retry_after_hint(self):
-        """Seconds until a slot plausibly frees: the live inter-token
-        latency times the shortest remaining active request. A cold
-        engine (no decode history yet) or an idle one (no active
-        requests — the queue is blocked on the token watermark, not on
-        slots) has no basis for an estimate and returns the documented
-        conservative ``default_retry_after_s``, so clients ALWAYS get a
-        finite back-off."""
-        itl = self.metrics.itl_estimate()
+        """Seconds until a slot plausibly frees: the rolling inter-token
+        latency p95 (histogram-backed — the same tail estimate brownout
+        sheds on, deliberately conservative) times the shortest
+        remaining active request. A cold engine (no decode history yet)
+        or an idle one (no active requests — the queue is blocked on
+        the token watermark, not on slots) has no basis for an estimate
+        and returns the documented conservative
+        ``default_retry_after_s``, so clients ALWAYS get a finite
+        back-off."""
+        itl = self.metrics.itl_p95()
         remaining = [h.max_new_tokens - len(h.tokens)
                      for h in self._by_slot if h is not None]
         if itl is None or not remaining:
@@ -769,11 +782,20 @@ class Engine:
         self.buckets_seen.add(Lb)
         ids = np.zeros((1, Lb), np.int32)
         ids[0, :n_eff] = self._full_ids(h)
-        out = self._prefill(
-            self._w, self.cache.kc, self.cache.vc, self._tok,
-            self._cur, self._keys, ids, np.int32(n_eff),
-            np.int32(slot), np.uint32(h.seed), np.int32(k),
-            np.float32(h.temperature), **self._statics)
+        _tracing.span_event("serving.queue", h._queued_t,
+                            time.perf_counter(), cat="serving",
+                            trace_id=h.trace_id,
+                            request_id=h.request_id)
+        with _tracing.span("serving.prefill", cat="serving",
+                           trace_id=h.trace_id,
+                           request_id=h.request_id, bucket=Lb,
+                           replay_k=k), \
+                _compile_scope(f"prefill:L{Lb}"):
+            out = self._prefill(
+                self._w, self.cache.kc, self.cache.vc, self._tok,
+                self._cur, self._keys, ids, np.int32(n_eff),
+                np.int32(slot), np.uint32(h.seed), np.int32(k),
+                np.float32(h.temperature), **self._statics)
         (self.cache.kc, self.cache.vc, self._tok, self._cur,
          self._keys, tok0) = out
         self.metrics.prefills += 1
@@ -812,6 +834,10 @@ class Engine:
             # the last prompt token (the sampling row) always runs.
             C = self.prefill_chunk
             start = (min(n_shared, n_eff - 1) // C) * C
+            _tracing.span_event("serving.queue", h._queued_t,
+                                time.perf_counter(), cat="serving",
+                                trace_id=h.trace_id,
+                                request_id=h.request_id)
             self._chunking.append(
                 _ChunkState(h, full, n_eff, n_shared, start))
             self.metrics.chunked_prefills += 1
@@ -820,13 +846,22 @@ class Engine:
         self.buckets_seen.add(Lb)
         ids = np.zeros((1, Lb), np.int32)
         ids[0, :n_eff] = full
-        out = self._prefill(
-            self._w, self.cache.kc, self.cache.vc, self._tok,
-            self._cur, self._keys, ids, np.int32(n_eff),
-            np.int32(slot), np.uint32(h.seed), np.int32(k),
-            np.float32(h.temperature),
-            self.cache.block_tables[slot].copy(), np.int32(n_shared),
-            **self._paged_statics)
+        _tracing.span_event("serving.queue", h._queued_t,
+                            time.perf_counter(), cat="serving",
+                            trace_id=h.trace_id,
+                            request_id=h.request_id)
+        with _tracing.span("serving.prefill", cat="serving",
+                           trace_id=h.trace_id,
+                           request_id=h.request_id, bucket=Lb,
+                           replay_k=k, n_shared=n_shared), \
+                _compile_scope(f"prefill:L{Lb}"):
+            out = self._prefill(
+                self._w, self.cache.kc, self.cache.vc, self._tok,
+                self._cur, self._keys, ids, np.int32(n_eff),
+                np.int32(slot), np.uint32(h.seed), np.int32(k),
+                np.float32(h.temperature),
+                self.cache.block_tables[slot].copy(), np.int32(n_shared),
+                **self._paged_statics)
         (self.cache.kc, self.cache.vc, self._tok, self._cur,
          self._keys, tok0) = out
         self.metrics.prefills += 1
@@ -848,13 +883,19 @@ class Engine:
         ids = np.zeros((1, C), np.int32)
         ids[0, :end - start] = cs.ids[start:end]
         is_final = end >= cs.n_eff
-        out = self._chunk(
-            self._w, self.cache.kc, self.cache.vc, self._tok, self._cur,
-            self._keys, ids, np.int32(start), np.int32(cs.n_eff),
-            np.int32(h.slot), self.cache.block_tables[h.slot].copy(),
-            np.int32(cs.n_shared), np.int32(1 if is_final else 0),
-            np.uint32(h.seed), np.int32(cs.skip),
-            np.float32(h.temperature), **self._paged_statics)
+        with _tracing.span("serving.prefill_chunk", cat="serving",
+                           trace_id=h.trace_id,
+                           request_id=h.request_id, start=start,
+                           final=is_final), \
+                _compile_scope("chunk"):
+            out = self._chunk(
+                self._w, self.cache.kc, self.cache.vc, self._tok,
+                self._cur, self._keys, ids, np.int32(start),
+                np.int32(cs.n_eff), np.int32(h.slot),
+                self.cache.block_tables[h.slot].copy(),
+                np.int32(cs.n_shared), np.int32(1 if is_final else 0),
+                np.uint32(h.seed), np.int32(cs.skip),
+                np.float32(h.temperature), **self._paged_statics)
         (self.cache.kc, self.cache.vc, self._tok, self._cur,
          self._keys, tok0) = out
         self.chunk_used = True
@@ -917,10 +958,14 @@ class Engine:
         self._by_slot[slot] = None
         self.cache.free(slot)
         h.slot = None
+        h._queued_t = time.perf_counter()
         self._chunking = [cs for cs in self._chunking if cs.h is not h]
         self.scheduler.release(h)
         self.scheduler.requeue(h)
         self.metrics.preemptions += 1
+        _tracing.instant("serving.preempt", cat="serving",
+                         trace_id=h.trace_id, request_id=h.request_id,
+                         tokens=len(h.tokens))
 
     def adopt(self, handle):
         """Re-inject a handle from a previous engine incarnation
@@ -931,8 +976,13 @@ class Engine:
         to the uninterrupted run."""
         handle.slot = None
         handle._engine = self
+        handle._queued_t = time.perf_counter()
         self._next_id = max(self._next_id, handle.request_id + 1)
         self.metrics.requests_submitted += 1
+        _tracing.instant("serving.adopt", cat="serving",
+                         trace_id=handle.trace_id,
+                         request_id=handle.request_id,
+                         replayed_tokens=len(handle.tokens))
         self.scheduler.enqueue(handle,
                                retry_after_s=self._retry_after_hint())
         self._admit()
@@ -1012,17 +1062,20 @@ class Engine:
                                 active=self.cache.n_active)
         if n_active:
             t0 = time.perf_counter()
-            if paged:
-                out = self._decode(
-                    self._w, self.cache.kc, self.cache.vc,
-                    self.cache.block_tables.copy(), self._tok,
-                    self._cur, active, self._keys, self._temps,
-                    **self._paged_statics)
-            else:
-                out = self._decode(
-                    self._w, self.cache.kc, self.cache.vc, self._tok,
-                    self._cur, active, self._keys,
-                    self._temps, **self._statics)
+            with _tracing.span("serving.decode_step", cat="serving",
+                               n_active=n_active), \
+                    _compile_scope("decode"):
+                if paged:
+                    out = self._decode(
+                        self._w, self.cache.kc, self.cache.vc,
+                        self.cache.block_tables.copy(), self._tok,
+                        self._cur, active, self._keys, self._temps,
+                        **self._paged_statics)
+                else:
+                    out = self._decode(
+                        self._w, self.cache.kc, self.cache.vc, self._tok,
+                        self._cur, active, self._keys,
+                        self._temps, **self._statics)
             nxt, self.cache.kc, self.cache.vc, self._cur, self._keys = out
             self._tok = nxt
             self.metrics.mark_decode(time.perf_counter() - t0)
@@ -1054,6 +1107,19 @@ class Engine:
         h.finished = True
         h.finish_reason = reason
         h.metrics.mark_finished()
+        if _tracing.enabled():
+            m = h.metrics
+            if m.first_token_time is not None:
+                # the request's whole decode phase as one span (first
+                # token out of prefill -> finish)
+                _tracing.span_event(
+                    "serving.decode", m.first_token_time, m.finish_time,
+                    cat="serving", trace_id=h.trace_id,
+                    request_id=h.request_id, tokens=len(h.tokens))
+            _tracing.instant("serving.finish", cat="serving",
+                             trace_id=h.trace_id,
+                             request_id=h.request_id, reason=reason,
+                             tokens=len(h.tokens))
         if h.slot is not None:         # queued-only timeouts held no slot
             self._by_slot[h.slot] = None
             # paged: every block the slot holds is released here —

@@ -8,9 +8,10 @@ the eager dispatch cache) and feed tools/bench_serving.py's JSON ledger.
 """
 from __future__ import annotations
 
-import collections
 import time
 import weakref
+
+from ..observability.metrics import Histogram
 
 
 def _percentile(values, p):
@@ -99,9 +100,13 @@ class EngineMetrics:
         self.pool_occupancy_sum = 0.0  # used/total blocks per sample
         self.pool_samples = 0
         self.pool_low_watermark = None  # min free blocks ever seen
-        # rolling window of decode-step wall times: the live ITL estimate
-        # behind EngineOverloaded.retry_after_s and deadline accounting
-        self._decode_times = collections.deque(maxlen=64)
+        # decode-step wall times, histogram-backed: the ~64-observation
+        # rolling window drives the live ITL p50/p95 behind
+        # EngineOverloaded.retry_after_s and brownout shedding, while
+        # the cumulative buckets export through the observability
+        # registry's merged paddle_serving_itl_seconds family
+        self.itl_hist = Histogram("serving_itl_seconds_local",
+                                  window=64, registry=None)
         _register(self)
 
     def sample(self, occupancy, queue_depth, active=0, pool_free=None,
@@ -128,23 +133,20 @@ class EngineMetrics:
 
     def mark_decode(self, duration_s):
         self.decode_steps += 1
-        self._decode_times.append(duration_s)
+        self.itl_hist.observe(duration_s)
 
     def itl_estimate(self):
-        """Median recent decode-step wall time (seconds), None before the
-        first decode — one decode step advances every active slot one
-        token, so this IS the current inter-token latency."""
-        if not self._decode_times:
-            return None
-        return _percentile(self._decode_times, 50)
+        """Rolling-window median decode-step wall time (seconds), None
+        before the first decode — one decode step advances every active
+        slot one token, so this IS the current inter-token latency."""
+        return self.itl_hist.percentile(50)
 
     def itl_p95(self):
-        """p95 of the rolling decode-step window (seconds) — the tail
-        latency that the brownout SLO in serving.resilience gates on;
+        """p95 of the rolling decode-step histogram window (seconds) —
+        the tail latency that the brownout SLO in serving.resilience
+        gates on AND the basis of ``EngineOverloaded.retry_after_s``;
         None before the first decode step."""
-        if not self._decode_times:
-            return None
-        return _percentile(self._decode_times, 95)
+        return self.itl_hist.percentile(95)
 
     def snapshot(self):
         n = max(self.samples, 1)

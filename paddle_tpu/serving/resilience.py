@@ -129,6 +129,7 @@ class EngineSupervisor:
         self._brownout = False
         self._steps_since_probe = 0
         self._aborted = False
+        self._last_fault_trace_id = None
         _register(self)
 
     def _build(self):
@@ -174,6 +175,10 @@ class EngineSupervisor:
             raise ServingAborted("supervisor already aborted",
                                  stats=self.stats())
         fault = self.chaos.take() if self.chaos is not None else None
+        if fault is not None:
+            # the fault's trace id: anomaly/rebuild ledger records carry
+            # it so a chaos run links to its spans (chaos verdicts too)
+            self._last_fault_trace_id = self.chaos.last_trace_id
         if fault == "kv-corrupt":
             try:
                 corrupt_kv(self.engine, seed=self.chaos.seed)
@@ -208,7 +213,8 @@ class EngineSupervisor:
                     kind = "step-error"
                     self.step_errors += 1
                 self.ledger.record("anomaly", kind=kind,
-                                   error=f"{type(e).__name__}: {e}")
+                                   error=f"{type(e).__name__}: {e}",
+                                   trace_id=self._last_fault_trace_id)
                 failures += 1
                 if failures > self.max_rebuilds:
                     self._abort(e)
@@ -277,7 +283,8 @@ class EngineSupervisor:
             return
         self.kv_corruptions += 1
         self.ledger.record("anomaly", kind="kv-corrupt",
-                           slots=[int(s) for s in where])
+                           slots=[int(s) for s in where],
+                           trace_id=self._last_fault_trace_id)
         self._rebuild_and_replay(why="kv-corrupt")
 
     # -- rebuild + replay --------------------------------------------------
@@ -299,7 +306,10 @@ class EngineSupervisor:
         self.engine._next_id = old._next_id
         self.rebuilds += 1
         self.ledger.record("rebuild", why=why, n_active=len(actives),
-                           n_queued=len(queued))
+                           n_queued=len(queued),
+                           trace_id=self._last_fault_trace_id,
+                           request_traces=[h.trace_id
+                                           for h in actives + queued])
         for h in actives + queued:
             if h.tokens:
                 self.replayed += 1

@@ -909,7 +909,9 @@ def _compile_segment(prog, entries, feed_names, raw_feed, fetch_tensors,
     alias_count = lowered.as_text().count("tf.aliasing_output") \
         if donate else 0
     seg = _JitSegment()
-    seg.compiled = lowered.compile()
+    from ..observability.compile_attr import compile_scope
+    with compile_scope(f"static:segment[{len(entries)} entries]"):
+        seg.compiled = lowered.compile()
     seg.ext_order = ext_order
     seg.out_tensors = out_tensors
     seg.state_specs = state_specs

@@ -23,6 +23,7 @@ import numpy as np
 
 from .autograd import tape
 from .framework import dispatch_cache as _dcache
+from .observability.compile_attr import compile_scope as _compile_scope
 from .framework import dtype as dtype_mod
 
 
@@ -359,7 +360,10 @@ def apply(fn: Callable, *args, n_outputs: Any = 1, **kwargs):
             return res
 
     if not diff_idx:
-        out = fn(*raw, **kwargs)
+        # compile attribution: a cold jnp primitive compiling under this
+        # op lands in paddle_xla_compiles_total{origin="eager:<op>"}
+        with _compile_scope(f"eager:{getattr(fn, '__name__', 'op')}"):
+            out = fn(*raw, **kwargs)
         if isinstance(out, (tuple, list)):
             res = tuple(_wrap_out(o, True) for o in out)
         else:
@@ -376,7 +380,8 @@ def apply(fn: Callable, *args, n_outputs: Any = 1, **kwargs):
             vals[i] = v
         return fn(*vals, **kwargs)
 
-    out, vjp = jax.vjp(closed, *(raw[i] for i in diff_idx))
+    with _compile_scope(f"eager:{getattr(fn, '__name__', 'op')}"):
+        out, vjp = jax.vjp(closed, *(raw[i] for i in diff_idx))
     multi = isinstance(out, (tuple, list))
     outs = tuple(out) if multi else (out,)
 
@@ -402,7 +407,8 @@ def nondiff(fn: Callable, *args, **kwargs):
         res = _cached_dispatch(fn, args, raw, kwargs, ())
         if res is not None:
             return res
-    out = fn(*raw, **kwargs)
+    with _compile_scope(f"eager:{getattr(fn, '__name__', 'op')}"):
+        out = fn(*raw, **kwargs)
     if isinstance(out, (tuple, list)):
         res = tuple(_wrap_out(o, True) for o in out)
     else:

@@ -169,6 +169,11 @@ def main():
         prefix = prefix_reuse_sweep(model, cfg)
         ok = ok and prefix["ok"]
 
+    # ride-along registry scrape: the ledger line carries the full
+    # metrics state of the run (ITL histogram, compile attribution,
+    # pool/prefix counters) for offline diffing
+    from paddle_tpu import observability as obs
+
     print(json.dumps({
         "bench": "serving_engine",
         "backend": jax.default_backend(),
@@ -182,6 +187,8 @@ def main():
         "best_n_slots": best["n_slots"],
         "speedup_vs_sequential": round(best["tokens_per_sec"] / seq_tps, 2),
         "prefix_reuse": prefix,
+        "observability": obs.snapshot(),
+        "compiles_by_origin": obs.compiles_by_origin(),
         "ok": ok,
     }))
     return 0 if ok else 1

@@ -76,9 +76,14 @@ def _verdict(fault, step, seed, stall_s):
     import dataclasses
 
     import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
     from paddle_tpu.resilience import ChaosMonkey
     from paddle_tpu.serving import Engine, EngineSupervisor
     from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+    # spans for the chaotic run (the fault's trace id in the verdict
+    # points into this ring — dump with tools/obs_dump.py --trace)
+    obs.enable_tracing()
 
     cfg = dataclasses.replace(LLAMA_TINY, dtype="float32",
                               num_hidden_layers=2)
@@ -127,6 +132,8 @@ def _verdict(fault, step, seed, stall_s):
     return {
         "fault": fault, "injected_step": step, "seed": seed,
         "requests": len(reqs), "fired": fired,
+        "trace_id": chaos.last_trace_id,
+        "request_trace_ids": [h.trace_id for h in handles],
         "rebuilds": sup.rebuilds, "replayed": sup.replayed,
         "wedges": sup.wedges, "step_errors": sup.step_errors,
         "kv_corruptions": sup.kv_corruptions, "abandoned": sup.abandoned,

@@ -32,9 +32,13 @@ def _train(fault, step, seed, steps, workdir, stall_s):
     import numpy as np
 
     import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
     from paddle_tpu import nn, optimizer
     from paddle_tpu.distributed.checkpoint import CheckpointManager
     from paddle_tpu.resilience import ChaosMonkey, Supervisor, TrainState
+
+    # spans for the chaotic run; the verdict's trace_id points at them
+    obs.enable_tracing()
 
     paddle.seed(seed)
     net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
@@ -77,6 +81,7 @@ def _train(fault, step, seed, steps, workdir, stall_s):
             "skipped": stats["skipped"], "retries": stats["retries"],
             "rollbacks": stats["rollbacks"],
             "anomalies": stats["anomalies"], "fired": chaos.fired,
+            "trace_id": chaos.last_trace_id,
             "first_loss": finite[0] if finite else None,
             "final_loss": final, "ledger": sup.ledger.counts(),
             "ok": bool(improved
