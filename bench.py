@@ -474,6 +474,27 @@ def bench_kernels(backend):
     return out
 
 
+def bench_coldstart(backend):
+    """Process-restart cold-start A/B for the paddle_tpu.aot persistent
+    executable cache (ROADMAP item 4): subprocess pairs measure the
+    eager MLP first-step wall and the serving predictor TTFT with the
+    cache off vs warm / with and without save_lm precompiled programs.
+    The warm arms must perform 0 XLA backend compiles with bitwise- /
+    token-identical outputs. CPU-measurable (the ledger lives in
+    tools/bench_coldstart.py); on TPU the same harness exercises
+    executable serialization through PJRT."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    try:
+        import bench_coldstart as bc
+    finally:
+        sys.path.pop(0)
+    out = {"eager": bc.bench_eager_coldstart(),
+           "serving": bc.bench_serving_coldstart()}
+    out["ok"] = out["eager"]["ok"] and out["serving"]["ok"]
+    return out
+
+
 def bench_flash_blocks(backend):
     """Sweep flash-attention block sizes at the headline shapes
     ([4, 2048, 16, 128] bf16, causal, fwd+bwd) and report ms per config.
@@ -938,6 +959,7 @@ def main():
                          ("ctr_widedeep", bench_ctr_widedeep),
                          ("serving_engine", bench_serving),
                          ("serving_paged", bench_serving_paged),
+                         ("coldstart", bench_coldstart),
                          ("flash_blocks", bench_flash_blocks)):
             if only and name not in only:
                 # marker (not omission) so the artifact fill-loop below

@@ -277,6 +277,23 @@ def audit_engine(engine, compile_budget=None, rules=None,
         "prefill_chunk": getattr(engine, "prefill_chunk", None),
         "chunk_used": chunk_used,
     }
+    # AOT warm-start visibility: programs restored from the executable
+    # cache cost a fresh process zero backend compiles — the honest
+    # warm-start compile count is programs minus disk-exec entries
+    try:
+        from ..aot import aot_stats
+        sources = engine.aot_stats() if hasattr(engine, "aot_stats") \
+            else {}
+        # "live" programs have no persisted entry (a restart compiles
+        # them); "compiled" ones were persisted at build and "disk-exec"
+        # ones restored — both cost a warm restart nothing; "disk-hlo"
+        # pays one recompile-from-StableHLO
+        meta["aot"] = {**aot_stats(), "engine_programs": sources,
+                       "warm_start_compiles": sum(
+                           n for k, n in sources.items()
+                           if k in ("live", "disk-hlo"))}
+    except Exception as e:
+        meta["aot_error"] = f"{type(e).__name__}: {e}"
     if supervisor is not None:
         meta["supervisor"] = {"rebuilds": supervisor.rebuilds,
                               "replayed": supervisor.replayed}
@@ -292,10 +309,13 @@ def audit_engine(engine, compile_budget=None, rules=None,
 
 def audit_dispatch(rules=None) -> Report:
     """Audit the live eager-dispatch cache: blacklisted ops (with the
-    recorded reason), megamorphic signatures, retrace pressure."""
+    recorded reason), megamorphic signatures, retrace pressure — plus
+    the AOT compile-service view (warm-start compile counts with the
+    executable cache enabled, key-instability findings)."""
+    from ..aot import aot_stats
     from ..framework.dispatch_cache import dispatch_stats
 
-    meta = {"dispatch_stats": dispatch_stats()}
+    meta = {"dispatch_stats": dispatch_stats(), "aot": aot_stats()}
     return ProgramView("eager-dispatch", "dispatch",
                        meta=meta).run_rules(rules)
 

@@ -485,3 +485,39 @@ def _compile_budget(view):
                 location="static._ReplayPlan",
                 suggested_fix="remove host-only entries from the "
                 "program (see host-callback findings)")
+
+
+# -- 8. AOT executable-cache key stability -----------------------------------
+
+@rule("aot-key-instability", kind="program", severity="medium",
+      title="identical program compiled under multiple AOT cache keys "
+            "(warm starts will recompile instead of restoring)")
+def _aot_key_instability(view):
+    """The aot.CompileService signature key must uniquely name a
+    program: when two different signatures both go through a FULL build
+    and lower to the same StableHLO fingerprint in one process, the key
+    is unstable (an unstable closure value, a per-process salt in the
+    material, churned code tokens) and the on-disk cache degrades to
+    one recompile per alias — exactly the cold start it exists to
+    eliminate."""
+    info = view.meta.get("aot")
+    if not info:
+        return
+    unstable = info.get("instability") or []
+    if unstable:
+        view.metrics["aot-key-instability"] = {
+            "programs": len(unstable),
+            "extra_compiles": sum(u["n_keys"] - 1 for u in unstable)}
+    for u in unstable:
+        yield Finding(
+            "aot-key-instability", "medium",
+            f"program {u['fingerprint'][:12]}... was fully compiled "
+            f"under {u['n_keys']} distinct cache keys ({', '.join(u['keys'][:4])}) "
+            "in one process — the signature fails to unify identical "
+            "programs, so a warm process recompiles instead of "
+            "restoring the executable",
+            location="aot.CompileService",
+            suggested_fix="make the key material stable: drop "
+            "process-local values (ids, unsalted reprs) from key_parts "
+            "and derive code tokens from the functions the trace "
+            "actually reaches")

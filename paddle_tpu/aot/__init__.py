@@ -1,0 +1,54 @@
+"""paddle_tpu.aot — shared compile service + persistent executable cache.
+
+Four subsystems used to own a private trace->lower->compile path (the
+eager ``dispatch_cache``, the static ``_ReplayPlan``, ``jit.to_static``
+and ``serving.Engine``); every process restart recompiled all of them.
+This package factors the compile step into one :class:`CompileService`
+backed by an on-disk cache of **serialized XLA executables**, keyed by
+(program fingerprint: StableHLO hash / signature material + input
+avals + statics + donation, device assignment, jax + backend versions).
+A fresh process with a warm cache restores executables with ZERO
+backend compiles — and ``serving.save_lm`` ships precompiled
+decode/prefill programs inside the artifact so
+``inference.create_llm_predictor`` cold-starts compile-free.
+
+Env knobs:
+
+* ``PADDLE_TPU_AOT_CACHE_DIR`` — cache directory; persistence is OFF
+  until this is set (artifact-embedded program sets still load).
+* ``PADDLE_TPU_AOT_CACHE=0`` — kill switch (also disables artifact
+  program sets).
+* ``PADDLE_TPU_AOT_CACHE_MAX_BYTES`` — LRU size bound (default 2 GiB).
+
+See README "AOT compile cache" for the key schema and the degradation
+ladder (executable -> cached StableHLO -> full recompile; corrupt or
+torn entries always recompile-and-overwrite, never raise).
+"""
+from __future__ import annotations
+
+from . import keys  # noqa: F401
+from .cache import DiskCache  # noqa: F401
+from .service import (AotProgram, CompileService,  # noqa: F401
+                      get_service, reset_service, service_enabled)
+
+__all__ = ["CompileService", "AotProgram", "DiskCache", "get_service",
+           "reset_service", "service_enabled", "keys", "aot_stats",
+           "aot_summary"]
+
+
+def aot_stats() -> dict:
+    """Snapshot for profiler/collectors (safe when never used)."""
+    return get_service().stats()
+
+
+def aot_summary() -> str:
+    """One-line ``aot:`` summary for Profiler.summary(); empty when the
+    service saw no traffic."""
+    s = get_service().stats()
+    if not s["hits"] and not s["misses"]:
+        return ""
+    disk_bytes = sum(d.get("bytes", 0) for d in s["disk"])
+    return (f"hits={s['hits']} misses={s['misses']} "
+            f"exec={s['disk_exec_hits']} hlo={s['disk_hlo_hits']} "
+            f"compiled={s['compiled']} bytes={disk_bytes}"
+            + (f" dir={s['cache_dir']}" if s["cache_dir"] else ""))

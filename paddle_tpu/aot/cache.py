@@ -1,0 +1,200 @@
+"""Persistent on-disk executable store with production hygiene.
+
+Layout (one directory per cache)::
+
+    <root>/objs/<fingerprint>.bin    # CRC-framed pickled entry payload
+    <root>/index/<sig>.json          # signature -> fingerprint mapping
+
+Entry payloads are dicts (see service.py): serialized executable bytes +
+pytree defs + optional exported-StableHLO bytes + metadata.
+
+Hygiene rules (the whole point of this module):
+
+* **atomic writes** — every file is written to a ``.tmp-*`` sibling,
+  fsynced, then ``os.replace``d into place; a crash mid-write leaves at
+  worst a stale tmp file (swept on init), never a torn entry;
+* **CRC-checked reads** — entries carry a crc32 over the body; a torn
+  or corrupt file reads as *miss* (the caller recompiles and
+  overwrites), never as an exception or a garbage executable;
+* **size-bounded LRU** — ``max_bytes`` caps ``objs/``; eviction drops
+  oldest-accessed entries first (reads touch mtime);
+* **concurrent-process safe** — replace is atomic per entry, readers
+  tolerate files vanishing underneath them, and two writers racing the
+  same fingerprint write identical bytes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+import zlib
+
+__all__ = ["DiskCache"]
+
+_MAGIC = b"PTAOT1\n"
+
+
+def _atomic_write(path: str, data: bytes):
+    tmp = os.path.join(
+        os.path.dirname(path),
+        ".tmp-%s-%d" % (os.path.basename(path), os.getpid()))
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class DiskCache:
+    def __init__(self, root: str, max_bytes: int = 0, readonly: bool = False):
+        self.root = root
+        self.max_bytes = int(max_bytes)
+        self.readonly = bool(readonly)
+        self._objs = os.path.join(root, "objs")
+        self._index = os.path.join(root, "index")
+        if not readonly:
+            os.makedirs(self._objs, exist_ok=True)
+            os.makedirs(self._index, exist_ok=True)
+            self._sweep_tmp()
+
+    def _sweep_tmp(self):
+        # comparing against file mtimes from (possibly) other processes:
+        # wall clock is the correct basis here, not perf_counter
+        now = time.time()
+        for d in (self._objs, self._index):
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for n in names:
+                if not n.startswith(".tmp-"):
+                    continue
+                p = os.path.join(d, n)
+                try:
+                    # another live process may be mid-write: only sweep
+                    # tmp files old enough to be certainly abandoned
+                    # (cross-process file-mtime liveness, wall by design)
+                    # tpu_lint: allow(wallclock-in-span)
+                    if now - os.path.getmtime(p) > 300:
+                        os.unlink(p)
+                except OSError:
+                    pass
+
+    # -- objects (fingerprint -> payload) ---------------------------------
+
+    def _obj_path(self, fp: str) -> str:
+        return os.path.join(self._objs, fp + ".bin")
+
+    def get(self, fp: str):
+        """Payload dict, or None on miss/torn/corrupt (never raises)."""
+        path = self._obj_path(fp)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return None
+        if len(raw) < len(_MAGIC) + 8 or not raw.startswith(_MAGIC):
+            return None
+        want = raw[len(_MAGIC):len(_MAGIC) + 8]
+        body = raw[len(_MAGIC) + 8:]
+        if b"%08x" % (zlib.crc32(body) & 0xFFFFFFFF) != want:
+            return None
+        try:
+            payload = pickle.loads(body)
+        except Exception:   # tpu_lint: allow(silent-except) — the get()
+            return None     # contract IS miss-on-corrupt; the service
+                            # counts corrupt_entries and recompiles
+        try:
+            os.utime(path)          # LRU recency
+        except OSError:
+            pass
+        return payload
+
+    def put(self, fp: str, payload: dict) -> int:
+        """Atomically persist; returns bytes written (0 when readonly or
+        the payload is unpicklable)."""
+        if self.readonly:
+            return 0
+        try:
+            body = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception:   # tpu_lint: allow(silent-except) — returns 0,
+            return 0        # which the service records as persist_errors
+                            # with the reason in last_errors
+        data = _MAGIC + (b"%08x" % (zlib.crc32(body) & 0xFFFFFFFF)) + body
+        try:
+            _atomic_write(self._obj_path(fp), data)
+        except OSError:
+            return 0
+        if self.max_bytes:
+            self._evict()
+        return len(data)
+
+    def _evict(self):
+        try:
+            entries = []
+            total = 0
+            with os.scandir(self._objs) as it:
+                for e in it:
+                    if not e.name.endswith(".bin"):
+                        continue
+                    st = e.stat()
+                    entries.append((st.st_mtime, st.st_size, e.path))
+                    total += st.st_size
+            if total <= self.max_bytes:
+                return
+            for mtime, size, path in sorted(entries):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    continue
+                total -= size
+                if total <= self.max_bytes:
+                    break
+        except OSError:
+            pass
+
+    # -- index (signature -> fingerprint) ---------------------------------
+
+    def get_index(self, sig: str):
+        path = os.path.join(self._index, sig + ".json")
+        try:
+            with open(path, "rb") as f:
+                rec = json.loads(f.read().decode("utf-8"))
+            return rec.get("fingerprint")
+        except (OSError, ValueError):
+            return None
+
+    def put_index(self, sig: str, fp: str, meta=None):
+        if self.readonly:
+            return
+        rec = {"fingerprint": fp, "meta": meta or {}}
+        try:
+            _atomic_write(os.path.join(self._index, sig + ".json"),
+                          json.dumps(rec, sort_keys=True).encode("utf-8"))
+        except (OSError, TypeError, ValueError):
+            pass
+
+    # -- stats ------------------------------------------------------------
+
+    def stats(self) -> dict:
+        entries = n_bytes = n_index = 0
+        for d, ext in ((self._objs, ".bin"), (self._index, ".json")):
+            try:
+                with os.scandir(d) as it:
+                    for e in it:
+                        if not e.name.endswith(ext):
+                            continue
+                        if ext == ".bin":
+                            entries += 1
+                            try:
+                                n_bytes += e.stat().st_size
+                            except OSError:
+                                pass
+                        else:
+                            n_index += 1
+            except OSError:
+                pass
+        return {"dir": self.root, "entries": entries, "bytes": n_bytes,
+                "index_entries": n_index, "max_bytes": self.max_bytes,
+                "readonly": self.readonly}

@@ -1,0 +1,236 @@
+"""Stable key material for the AOT compile service.
+
+Two kinds of keys exist:
+
+* a **signature key** (``sig_hash``) — computed *without* tracing from
+  whatever the caller knows statically: program name, code identity,
+  input avals, static arguments, and the environment fingerprint. It is
+  the trace-free warm-start path, so it must be byte-stable across
+  processes; anything that cannot be rendered stably poisons the key
+  with a per-process salt (the entry then simply never matches across
+  processes — a safe degradation to always-miss, never a stale hit).
+* a **program fingerprint** (``fingerprint``) — the hash of the lowered
+  StableHLO text plus the environment fingerprint. It is exact: two
+  identical fingerprints are the same XLA program on the same toolchain.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+import types
+
+import numpy as np
+
+__all__ = ["stable_bytes", "sig_hash", "fingerprint", "code_token",
+           "aval_sig", "env_fingerprint"]
+
+#: bump when the entry format or key schema changes — old cache entries
+#: become unreachable instead of mis-deserialized
+FORMAT_VERSION = "ptaot-1"
+
+# objects that cannot be rendered stably get this salt so their keys
+# never collide across processes (always-miss, never stale)
+_PROCESS_SALT = os.urandom(16).hex()
+
+
+def _render(obj, out):
+    """Append a canonical byte rendering of ``obj`` to list ``out``."""
+    if obj is None or obj is Ellipsis:
+        out.append(repr(obj).encode())
+    elif isinstance(obj, bool):
+        out.append(b"b1" if obj else b"b0")
+    elif isinstance(obj, int):
+        out.append(b"i" + str(obj).encode())
+    elif isinstance(obj, float):
+        out.append(b"f" + obj.hex().encode())
+    elif isinstance(obj, complex):
+        out.append(b"c" + obj.real.hex().encode() + b","
+                   + obj.imag.hex().encode())
+    elif isinstance(obj, str):
+        out.append(b"s" + obj.encode("utf-8", "backslashreplace"))
+    elif isinstance(obj, bytes):
+        out.append(b"y" + obj)
+    elif isinstance(obj, (tuple, list)):
+        out.append(b"T(" if isinstance(obj, tuple) else b"L(")
+        for x in obj:
+            _render(x, out)
+            out.append(b",")
+        out.append(b")")
+    elif isinstance(obj, dict):
+        out.append(b"D(")
+        try:
+            items = sorted(obj.items())
+        except TypeError:
+            items = sorted(obj.items(), key=lambda kv: repr(kv[0]))
+        for k, v in items:
+            _render(k, out)
+            out.append(b"=")
+            _render(v, out)
+            out.append(b",")
+        out.append(b")")
+    elif isinstance(obj, (set, frozenset)):
+        _render(sorted(obj, key=repr), out)
+    elif isinstance(obj, slice):
+        _render(("slice", obj.start, obj.stop, obj.step), out)
+    elif isinstance(obj, np.dtype):
+        out.append(b"dt" + obj.str.encode())
+    elif isinstance(obj, (np.integer, np.floating, np.bool_)):
+        out.append(b"np" + obj.dtype.str.encode() + repr(obj.item()).encode())
+    elif isinstance(obj, types.CodeType):
+        out.append(b"code")
+        _render((obj.co_name, obj.co_argcount, obj.co_names,
+                 obj.co_varnames, obj.co_code), out)
+        # consts can nest code objects (inner lambdas/closures)
+        for c in obj.co_consts:
+            if isinstance(c, types.CodeType):
+                _render(c, out)
+            else:
+                _render(_best_effort(c), out)
+    elif isinstance(obj, type):
+        out.append(b"t" + (obj.__module__ + "." + obj.__qualname__).encode())
+    elif isinstance(obj, types.ModuleType):
+        out.append(b"m" + _module_token(obj).encode())
+    elif callable(obj):
+        out.append(b"fn")
+        _render(_callable_parts(obj), out)
+    else:
+        av = aval_sig(obj)
+        if av is not None:
+            _render(av, out)
+        else:
+            _render(_best_effort(obj), out)
+
+
+def _best_effort(obj):
+    """repr-based fallback; default reprs embed ``0x`` addresses, which
+    would be different every process — salt those so they never match."""
+    r = repr(obj)
+    if "0x" in r:
+        return ("unstable", type(obj).__module__, type(obj).__qualname__,
+                _PROCESS_SALT)
+    return ("repr", type(obj).__module__, type(obj).__qualname__, r)
+
+
+def _callable_parts(fn):
+    import functools
+    if isinstance(fn, functools.partial):
+        return ("partial", _callable_parts(fn.func), tuple(fn.args),
+                dict(fn.keywords or {}))
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return ("builtin", getattr(fn, "__module__", ""),
+                getattr(fn, "__qualname__", repr(fn)))
+    cells = []
+    if getattr(fn, "__closure__", None):
+        for cell in fn.__closure__:
+            try:
+                cells.append(cell.cell_contents)
+            except ValueError:
+                cells.append(("empty-cell",))
+    return ("pyfn", code, tuple(cells), fn.__defaults__ or ())
+
+
+_module_hash_cache: dict = {}
+
+
+def _module_token(mod) -> str:
+    """Content hash of a module's source file (for "the math in this
+    module defines the program" dependencies like text/generation.py)."""
+    f = getattr(mod, "__file__", None)
+    tok = _module_hash_cache.get(f)
+    if tok is None:
+        try:
+            with open(f, "rb") as fh:
+                tok = hashlib.sha256(fh.read()).hexdigest()[:16]
+        except Exception:   # tpu_lint: allow(silent-except) — the
+            # degradation IS the record: a salted token never matches
+            # across processes, so an unreadable source can only miss
+            tok = "nosrc-" + _PROCESS_SALT
+        _module_hash_cache[f] = tok
+    return tok
+
+
+def aval_sig(x):
+    """("aval", shape, dtype) for any array-like / abstract value, else
+    None. ShapeDtypeStructs and concrete arrays render identically, so
+    save-time precompiled keys match serve-time lookups."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        return None
+    try:
+        shape = tuple(int(d) for d in shape)
+    except (TypeError, ValueError):
+        return ("aval-sym", str(shape), str(np.dtype(dtype)))
+    sharding = getattr(x, "sharding", None)
+    spec = ""
+    if sharding is not None and type(sharding).__name__ == "NamedSharding":
+        spec = str(getattr(sharding, "spec", ""))
+    # weak_type changes promotion semantics, hence the compiled program
+    weak = bool(getattr(x, "weak_type", False))
+    return ("aval", shape, str(np.dtype(dtype)), spec, weak)
+
+
+def avals_of(tree):
+    """Aval signature pytree of an argument tuple (arrays -> aval sigs,
+    everything else passes through for stable rendering)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: aval_sig(x) if aval_sig(x) is not None else x, tree)
+
+
+def stable_bytes(obj) -> bytes:
+    out: list = []
+    _render(obj, out)
+    return b"".join(out)
+
+
+def code_token(*objs) -> str:
+    """Short content token over functions/modules whose source defines
+    the program being cached: any edit changes the token and therefore
+    the signature key (stale executables become unreachable)."""
+    h = hashlib.sha256()
+    for o in objs:
+        h.update(stable_bytes(o))
+    return h.hexdigest()[:16]
+
+
+_env_fp = None
+
+
+def env_fingerprint() -> tuple:
+    """Everything about the toolchain/devices that a serialized
+    executable is only valid for."""
+    global _env_fp
+    if _env_fp is None:
+        import jax
+        import jaxlib
+
+        try:
+            dev = jax.devices()[0]
+            kind = getattr(dev, "device_kind", "?")
+            pver = str(getattr(dev.client, "platform_version", "?"))
+            ndev = len(jax.devices())
+        except Exception:   # tpu_lint: allow(silent-except) — device
+            # probe failure degrades to a '?' fingerprint component
+            kind, pver, ndev = "?", "?", 0
+        _env_fp = (FORMAT_VERSION, jax.__version__, jaxlib.__version__,
+                   jax.default_backend(), kind, pver, ndev,
+                   "py%d.%d" % sys.version_info[:2])
+    return _env_fp
+
+
+def sig_hash(name, key_parts, args_avals, statics) -> str:
+    h = hashlib.sha256()
+    h.update(stable_bytes((env_fingerprint(), name, key_parts,
+                           args_avals, statics)))
+    return h.hexdigest()
+
+
+def fingerprint(hlo_text: str) -> str:
+    h = hashlib.sha256()
+    h.update(stable_bytes(env_fingerprint()))
+    h.update(hlo_text.encode("utf-8", "backslashreplace"))
+    return h.hexdigest()

@@ -1,0 +1,433 @@
+"""The shared compile service: one trace->lower->compile path for every
+subsystem, backed by the persistent executable store in cache.py.
+
+Degradation ladder for a signature lookup (strongest first):
+
+1. **in-memory hit** — the program was already built this process;
+2. **disk executable hit** — the signature index names a fingerprint
+   whose entry deserializes into a loaded executable: *zero* trace,
+   *zero* lower, *zero* XLA backend compile;
+3. **disk StableHLO hit** — the executable bytes are absent or the
+   backend refuses to deserialize them, but the entry carries a
+   ``jax.export`` module: skip trace+lower, pay one backend compile;
+4. **fingerprint hit after lowering** — the signature was never seen
+   but lowering produced a known program (a second key for the same
+   fingerprint — recorded as *key instability* for tpu_lint);
+5. **full build** — trace, lower, compile, then serialize + persist
+   atomically for the next process.
+
+Corrupt/torn entries at any tier read as a miss one tier down — the
+service recompiles and overwrites, it never raises for a cache problem.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from ..observability import tracing as _tracing
+from ..observability.compile_attr import compile_scope as _compile_scope
+from ..observability.metrics import Counter
+from . import keys as _keys
+from .cache import DiskCache
+
+__all__ = ["CompileService", "AotProgram", "get_service", "reset_service",
+           "service_enabled"]
+
+CACHE_HITS = Counter(
+    "paddle_aot_cache_hits_total",
+    "AOT executable-cache hits by originating subsystem and tier",
+    labelnames=("origin", "tier"))
+CACHE_MISSES = Counter(
+    "paddle_aot_cache_misses_total",
+    "AOT executable-cache misses (full trace+lower+compile) by origin",
+    labelnames=("origin",))
+
+_DEFAULT_MAX_BYTES = int(os.environ.get(
+    "PADDLE_TPU_AOT_CACHE_MAX_BYTES", str(2 << 30)))
+
+
+def _cache_flag_on() -> bool:
+    return os.environ.get("PADDLE_TPU_AOT_CACHE", "1").lower() \
+        not in ("0", "false", "off")
+
+
+class AotProgram:
+    """Handle for one compiled program signature.
+
+    ``call`` runs the program. Statics (kwargs or ``static_argnums``
+    positions) are accepted for interface parity with the live jitted
+    callable but dropped when the backing is an AOT ``Compiled`` —
+    compiled objects take dynamic operands only, the statics were baked
+    at lowering time (and are part of the signature, so a mismatch is a
+    different handle).
+
+    ``source`` is the provenance: ``live`` (passthrough, service
+    disabled for this lookup), ``compiled`` (full build this process),
+    ``memory``, ``disk-exec`` (deserialized executable — no backend
+    compile), ``disk-hlo`` (recompiled from cached StableHLO).
+    """
+
+    __slots__ = ("name", "sig", "fingerprint", "source", "_compiled",
+                 "_jitted", "_static_argnums")
+
+    def __init__(self, name, sig=None, fingerprint=None, source="live",
+                 compiled=None, jitted=None, static_argnums=()):
+        self.name = name
+        self.sig = sig
+        self.fingerprint = fingerprint
+        self.source = source
+        self._compiled = compiled
+        self._jitted = jitted
+        self._static_argnums = tuple(static_argnums or ())
+
+    def call(self, *args, **kwargs):
+        if self._compiled is None:
+            return self._jitted(*args, **kwargs)
+        if self._static_argnums:
+            args = tuple(a for i, a in enumerate(args)
+                         if i not in self._static_argnums)
+        return self._compiled(*args)
+
+    def __repr__(self):
+        return (f"AotProgram({self.name!r}, source={self.source}, "
+                f"sig={str(self.sig)[:12]}...)")
+
+
+class CompileService:
+    def __init__(self, cache_dir=None, max_bytes=None, enabled=None):
+        if cache_dir is None:
+            cache_dir = os.environ.get("PADDLE_TPU_AOT_CACHE_DIR") or None
+        self.cache_dir = cache_dir
+        flag = _cache_flag_on() if enabled is None else bool(enabled)
+        self._flag = flag
+        self.disk = None
+        if flag and cache_dir:
+            try:
+                self.disk = DiskCache(
+                    cache_dir,
+                    max_bytes=(_DEFAULT_MAX_BYTES if max_bytes is None
+                               else int(max_bytes)))
+            except OSError:
+                self.disk = None
+        #: read-only secondary stores (e.g. a save_lm artifact's
+        #: precompiled program set), consulted after the primary
+        self.sources: list = []
+        self._mem: dict = {}
+        self._mem_cap = max(64, int(os.environ.get(
+            "PADDLE_TPU_AOT_MEM_ENTRIES", "4096")))
+        self._lock = threading.RLock()
+        # fingerprint -> set of sigs that went through a FULL build for
+        # it this process; len > 1 means the signature key failed to
+        # unify identical programs (tpu_lint aot-key-instability)
+        self._built: dict = {}
+        self.counters = {"hits": 0, "misses": 0, "mem_hits": 0,
+                         "disk_exec_hits": 0, "disk_hlo_hits": 0,
+                         "fingerprint_hits": 0, "compiled": 0,
+                         "serialized_bytes": 0, "persist_errors": 0,
+                         "corrupt_entries": 0}
+        # bounded ring of the most recent cache-degradation reasons:
+        # every swallowed revive/persist failure records WHY here
+        self.last_errors: list = []
+
+    def _note_error(self, where, e):
+        self.last_errors.append(f"{where}: {type(e).__name__}: "
+                                f"{str(e)[:160]}")
+        del self.last_errors[:-16]
+
+    # -- state -------------------------------------------------------------
+
+    @property
+    def persistent(self) -> bool:
+        return self._flag and (self.disk is not None or bool(self.sources))
+
+    def add_source(self, path, readonly=True):
+        """Attach a read-only secondary entry store (artifact dirs)."""
+        if not self._flag or not os.path.isdir(os.path.join(path, "objs")):
+            return False
+        with self._lock:
+            if all(s.root != path for s in self.sources):
+                self.sources.append(DiskCache(path, readonly=readonly))
+        return True
+
+    def _stores(self):
+        return ([self.disk] if self.disk is not None else []) + self.sources
+
+    # -- lookup helpers ----------------------------------------------------
+
+    def _load_entry(self, fp, origin, statics_argnums, name, sig):
+        """objs entry -> AotProgram via the deserialize/export ladder,
+        or None. Never raises."""
+        for store in self._stores():
+            payload = self.get_payload(store, fp)
+            if payload is None:
+                continue
+            h = self._revive(payload, fp, origin, statics_argnums, name,
+                             sig)
+            if h is not None:
+                return h
+        return None
+
+    def get_payload(self, store, fp):
+        payload = store.get(fp)
+        if payload is None:
+            return None
+        if payload.get("format") != _keys.FORMAT_VERSION:
+            return None
+        return payload
+
+    def _revive(self, payload, fp, origin, static_argnums, name, sig):
+        exec_bytes = payload.get("exec")
+        if exec_bytes is not None:
+            try:
+                from jax.experimental.serialize_executable import \
+                    deserialize_and_load
+                compiled = deserialize_and_load(
+                    exec_bytes, payload["in_tree"], payload["out_tree"])
+                self.counters["disk_exec_hits"] += 1
+                CACHE_HITS.labels(origin=origin, tier="exec").inc()
+                return AotProgram(name, sig=sig, fingerprint=fp,
+                                  source="disk-exec", compiled=compiled,
+                                  static_argnums=static_argnums)
+            except Exception as e:   # backend refused the executable:
+                self.counters["corrupt_entries"] += 1
+                self._note_error("deserialize", e)
+        export_bytes = payload.get("export")
+        if export_bytes is not None:
+            try:
+                import jax
+                from jax import export as jax_export
+                exported = jax_export.deserialize(export_bytes)
+                with _compile_scope(origin):
+                    compiled = jax.jit(exported.call).lower(
+                        *exported.in_avals).compile()
+                self.counters["disk_hlo_hits"] += 1
+                CACHE_HITS.labels(origin=origin, tier="hlo").inc()
+                return AotProgram(name, sig=sig, fingerprint=fp,
+                                  source="disk-hlo", compiled=compiled,
+                                  static_argnums=static_argnums)
+            except Exception as e:   # stale/unloadable export module
+                self.counters["corrupt_entries"] += 1
+                self._note_error("export-revive", e)
+        return None
+
+    # -- the main entry point ----------------------------------------------
+
+    def get(self, name, *, args, statics=None, key_parts=None,
+            origin=None, jitted=None, jitted_thunk=None,
+            static_argnums=()):
+        """Resolve one program signature to an :class:`AotProgram`.
+
+        ``args`` are the dynamic call operands (concrete arrays or
+        ShapeDtypeStructs — both produce the same key); ``statics`` the
+        static kwargs baked into the lowering; ``key_parts`` whatever
+        else pins program identity (code tokens, geometry, donation).
+        ``jitted`` (or lazy ``jitted_thunk``) supplies the live
+        ``jax.jit`` callable for the miss path; with the service
+        disabled it is returned as a passthrough handle untouched.
+        """
+        origin = origin or name
+        if not self.persistent:
+            if jitted is None:
+                jitted = jitted_thunk()
+            return AotProgram(name, jitted=jitted, source="live",
+                              static_argnums=static_argnums)
+        sig = _keys.sig_hash(name, key_parts, _keys.avals_of(args),
+                             statics)
+        with self._lock:
+            h = self._mem.get(sig)
+        if h is not None:
+            self.counters["mem_hits"] += 1
+            self.counters["hits"] += 1
+            return h
+        with _tracing.span("aot.cache_lookup", cat="aot",
+                           program=name, origin=origin):
+            h = self._lookup_disk(name, sig, origin, static_argnums)
+        if h is None:
+            h = self._build(name, sig, args, statics or {}, origin,
+                            jitted if jitted is not None else jitted_thunk(),
+                            static_argnums)
+        else:
+            self.counters["hits"] += 1
+        with self._lock:
+            if len(self._mem) >= self._mem_cap:
+                self._mem.clear()
+            self._mem[sig] = h
+        return h
+
+    def _lookup_disk(self, name, sig, origin, static_argnums):
+        for store in self._stores():
+            fp = store.get_index(sig)
+            if fp is None:
+                continue
+            h = self._load_entry(fp, origin, static_argnums, name, sig)
+            if h is not None:
+                return h
+        return None
+
+    def _build(self, name, sig, args, statics, origin, jitted,
+               static_argnums):
+        self.counters["misses"] += 1
+        CACHE_MISSES.labels(origin=origin).inc()
+        with _compile_scope(origin):
+            lowered = jitted.lower(*args, **statics)
+            hlo = lowered.as_text()
+            fp = _keys.fingerprint(hlo)
+            # the program may already be stored under another signature
+            h = self._load_entry(fp, origin, static_argnums, name, sig)
+            if h is not None:
+                self.counters["fingerprint_hits"] += 1
+                with self._lock:
+                    # a full build (trace+lower paid) that lands on an
+                    # existing fingerprint means the signature failed to
+                    # unify identical programs — key instability
+                    self._built.setdefault(fp, set()).add((name, sig))
+                for store in self._stores():
+                    store.put_index(sig, fp, {"name": name})
+                return h
+            compiled = lowered.compile()
+        self.counters["compiled"] += 1
+        with self._lock:
+            sigs = self._built.setdefault(fp, set())
+            sigs.add((name, sig))
+        self._persist(fp, sig, name, compiled, jitted, args, statics, hlo)
+        return AotProgram(name, sig=sig, fingerprint=fp, source="compiled",
+                          compiled=compiled, static_argnums=static_argnums)
+
+    def _persist(self, fp, sig, name, compiled, jitted, args, statics,
+                 hlo):
+        if self.disk is None:
+            return
+        # host callbacks hold process-local pointers: such a program
+        # must never be revived in another process
+        if "callback" in hlo:
+            return
+        payload = {"format": _keys.FORMAT_VERSION, "name": name,
+                   "env": _keys.env_fingerprint()}
+        try:
+            from jax.experimental.serialize_executable import serialize
+            exec_bytes, in_tree, out_tree = serialize(compiled)
+            payload.update(exec=exec_bytes, in_tree=in_tree,
+                           out_tree=out_tree)
+        except Exception as e:  # backend without executable serialization
+            payload.update(exec=None, in_tree=None, out_tree=None)
+            self._note_error("serialize", e)
+        try:
+            import jax
+            from jax import export as jax_export
+            specs = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), args)
+            payload["export"] = jax_export.export(
+                jax.jit(lambda *a: jitted(*a, **statics)))(*specs).serialize()
+        except Exception as e:  # not exportable (donation/symbolic dims)
+            payload["export"] = None
+            self._note_error("export", e)
+        if payload["exec"] is None and payload["export"] is None:
+            self.counters["persist_errors"] += 1
+            return
+        try:
+            n = self.disk.put(fp, payload)
+            if n:
+                self.counters["serialized_bytes"] += n
+                self.disk.put_index(sig, fp, {"name": name})
+            else:
+                self.counters["persist_errors"] += 1
+        except Exception as e:
+            self.counters["persist_errors"] += 1
+            self._note_error("persist", e)
+
+    # -- fingerprint-only path (callers that must trace anyway) ------------
+
+    def compile_lowered(self, lowered, name, origin=None):
+        """Compile a ``Lowered`` through the store, keyed by program
+        fingerprint only (for paths — static segments, to_static — whose
+        tracing is structural and must run per process anyway). Returns
+        a callable taking the dynamic operands positionally."""
+        origin = origin or name
+        if not self.persistent:
+            with _compile_scope(origin):
+                return lowered.compile()
+        hlo = lowered.as_text()
+        fp = _keys.fingerprint(hlo)
+        with _tracing.span("aot.cache_lookup", cat="aot",
+                           program=name, origin=origin):
+            h = self._load_entry(fp, origin, (), name, fp)
+        if h is not None:
+            self.counters["hits"] += 1
+            return h._compiled
+        self.counters["misses"] += 1
+        CACHE_MISSES.labels(origin=origin).inc()
+        with _compile_scope(origin):
+            compiled = lowered.compile()
+        self.counters["compiled"] += 1
+        with self._lock:
+            self._built.setdefault(fp, set()).add((name, fp))
+        if self.disk is not None and "callback" not in hlo:
+            payload = {"format": _keys.FORMAT_VERSION, "name": name,
+                       "env": _keys.env_fingerprint(), "export": None}
+            try:
+                from jax.experimental.serialize_executable import serialize
+                exec_bytes, in_tree, out_tree = serialize(compiled)
+                payload.update(exec=exec_bytes, in_tree=in_tree,
+                               out_tree=out_tree)
+                n = self.disk.put(fp, payload)
+                if n:
+                    self.counters["serialized_bytes"] += n
+                else:
+                    self.counters["persist_errors"] += 1
+            except Exception as e:
+                self.counters["persist_errors"] += 1
+                self._note_error("serialize-lowered", e)
+        return compiled
+
+    # -- introspection -----------------------------------------------------
+
+    def instability(self):
+        """Programs compiled more than once this process under different
+        signature keys — the signature failed to unify them, so warm
+        starts will recompile where they should restore."""
+        with self._lock:
+            return [{"fingerprint": fp,
+                     "keys": sorted(n for n, _ in sigs),
+                     "n_keys": len(sigs)}
+                    for fp, sigs in self._built.items() if len(sigs) > 1]
+
+    def disk_stats(self):
+        out = []
+        if self.disk is not None:
+            out.append(self.disk.stats())
+        out.extend(s.stats() for s in self.sources)
+        return out
+
+    def stats(self) -> dict:
+        return {"enabled": self._flag, "persistent": self.persistent,
+                "cache_dir": self.cache_dir,
+                **self.counters,
+                "last_errors": list(self.last_errors),
+                "mem_entries": len(self._mem),
+                "instability": self.instability(),
+                "disk": self.disk_stats()}
+
+
+_service = None
+_service_lock = threading.Lock()
+
+
+def get_service() -> CompileService:
+    global _service
+    if _service is None:
+        with _service_lock:
+            if _service is None:
+                _service = CompileService()
+    return _service
+
+
+def reset_service(**kwargs) -> CompileService:
+    """Replace the process service (tests; new env knobs)."""
+    global _service
+    with _service_lock:
+        _service = CompileService(**kwargs)
+    return _service
+
+
+def service_enabled() -> bool:
+    return get_service().persistent

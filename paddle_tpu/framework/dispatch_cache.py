@@ -168,7 +168,19 @@ def dispatch_stats() -> dict:
                               for k, r in list(_blacklist.items())[:32]],
                 "megamorphic": [_fn_label(k)
                                 for k, n in _fn_sig_count.items()
-                                if n >= _POLY_LIMIT][:32]}
+                                if n >= _POLY_LIMIT][:32],
+                "aot": _aot_entry_sources()}
+
+
+def _aot_entry_sources() -> dict:
+    """Per-provenance entry counts (aot warm-start visibility): how many
+    live cache entries were deserialized from disk vs compiled here."""
+    out: dict = {}
+    for e in _cache.values():
+        for h in (e.fwd, e.bwd):
+            if h is not None:
+                out[h.source] = out.get(h.source, 0) + 1
+    return out
 
 
 def reset_stats():
@@ -292,12 +304,39 @@ def _classify(raw):
 
 # -- compiled entries --------------------------------------------------------
 
-class _Entry:
-    __slots__ = ("fwd", "bwd", "label", "_fwd_warm", "_bwd_warm")
+def _as_struct(x):
+    return jax.ShapeDtypeStruct(x.shape, x.dtype) \
+        if hasattr(x, "shape") and hasattr(x, "dtype") else x
 
-    def __init__(self, fwd, bwd=None, label=""):
-        self.fwd = fwd
-        self.bwd = bwd
+
+def _any_tracer(*trees):
+    for t in trees:
+        for leaf in jax.tree_util.tree_leaves(t):
+            if isinstance(leaf, jax.core.Tracer):
+                return True
+    return False
+
+
+class _Entry:
+    """One compiled signature: AOT fwd/bwd program handles.
+
+    Residuals cross the fwd jit boundary as a FLAT tuple of arrays (not
+    the ``jax.tree_util.Partial`` pullback jax.vjp returns): Partial
+    pytree defs embed vjp closure functions that cannot be pickled, and
+    flat tuples are what lets the AOT service serialize both halves to
+    disk. The pullback tree structure is captured host-side during the
+    fwd trace (``_res_cell``); a warm process that restored fwd from
+    disk never needs it unless bwd misses, in which case the fwd is
+    re-traced abstractly (lower only, no compile) to recover it.
+    """
+
+    __slots__ = ("fwd", "bwd", "label", "_fwd_warm", "_bwd_warm",
+                 "_sig_mat", "_fwd_jitted", "_bwd_jitted", "_fwd_struct",
+                 "_res_cell")
+
+    def __init__(self, label="", sig_mat=None):
+        self.fwd = None
+        self.bwd = None
         # compile attribution: the first execution of each half traces
         # and compiles — scope it under the op label so the XLA compile
         # lands in paddle_xla_compiles_total{origin="eager:<op>"}; warm
@@ -305,33 +344,75 @@ class _Entry:
         self.label = label
         self._fwd_warm = False
         self._bwd_warm = False
+        self._sig_mat = sig_mat
+        self._fwd_jitted = None
+        self._bwd_jitted = None
+        self._fwd_struct = None
+        self._res_cell = {}
 
     def forward(self, dyn_vals):
         if self._fwd_warm:
-            return self.fwd(tuple(dyn_vals), runtime_zero())
+            return self.fwd.call(tuple(dyn_vals), runtime_zero())
         from ..observability.compile_attr import compile_scope
         with compile_scope(f"eager:{self.label}"):
-            out = self.fwd(tuple(dyn_vals), runtime_zero())
+            out = self.fwd.call(tuple(dyn_vals), runtime_zero())
         self._fwd_warm = True
         return out
 
-    def backward(self, pullback, cts):
+    def _ensure_res_tree(self):
+        if "tree" not in self._res_cell:
+            # abstract re-trace of fwd (lower only — no backend compile)
+            # reruns the host-side flatten and fills the cell
+            self._fwd_jitted.lower(*self._fwd_struct)
+
+    def _make_bwd_jitted(self):
+        if self._bwd_jitted is None:
+            self._ensure_res_tree()
+            tree = self._res_cell["tree"]
+
+            def bwd(flat, cts, zero):
+                pb = jax.tree_util.tree_unflatten(tree, list(flat))
+                return bitwise_call(zero, lambda c: pb(c), cts)
+
+            self._bwd_jitted = jax.jit(bwd)
+        return self._bwd_jitted
+
+    def backward(self, flat_res, cts):
+        zero = runtime_zero()
+        if _any_tracer(flat_res, cts):
+            # grad-of-grad traces through the cached bwd: only the live
+            # jitted program composes with an outer trace
+            return self._make_bwd_jitted()(flat_res, cts, zero)
+        if self.bwd is None:
+            from ..aot import get_service
+            self.bwd = get_service().get(
+                "eager-bwd", args=(flat_res, cts, zero),
+                key_parts=("eager-bwd", self._sig_mat),
+                jitted_thunk=self._make_bwd_jitted,
+                origin=f"eager:{self.label}")
         if self._bwd_warm:
-            return self.bwd(pullback, cts, runtime_zero())
+            return self.bwd.call(flat_res, cts, zero)
         from ..observability.compile_attr import compile_scope
         with compile_scope(f"eager:{self.label}"):
-            out = self.bwd(pullback, cts, runtime_zero())
+            out = self.bwd.call(flat_res, cts, zero)
         self._bwd_warm = True
         return out
 
 
-def _build_entry(fn, kwargs, template, statics, diff_idx, label=""):
-    """Compile fwd (and bwd for grad mode) for one signature.
+def _build_entry(fn, kwargs, template, statics, diff_idx, label="",
+                 sig_mat=None, dyn_vals=()):
+    """Build the AOT fwd (and lazily bwd) programs for one signature.
 
     ``statics`` are the live static arg values in template order (the key
     pinned them, so baking them into the trace is sound). Both halves run
     through :func:`bitwise_call`, so the compiled programs reproduce the
-    uncached path's per-op rounding exactly."""
+    uncached path's per-op rounding exactly. With the AOT disk cache
+    enabled the fwd program is resolved through the service (a warm
+    process deserializes the executable — zero trace, zero compile);
+    without it the live jitted callable compiles on first execution,
+    exactly the pre-AOT behavior."""
+    from ..aot import get_service
+
     n = len(template)
     dyn_pos = tuple(i for i, t in enumerate(template) if t == "d")
     static_by_pos = {}
@@ -348,32 +429,42 @@ def _build_entry(fn, kwargs, template, statics, diff_idx, label=""):
             vals[i] = v
         return vals
 
+    entry = _Entry(label=label, sig_mat=sig_mat)
+
     if not diff_idx:
         def fwd(dyn, zero):
             def run(dyn):
                 return fn(*assemble(dyn), **kwargs)
             return bitwise_call(zero, run, dyn)
-        return _Entry(jax.jit(fwd), label=label)
+    else:
+        cell = entry._res_cell
 
-    def fwd(dyn, zero):
-        def run(dyn):
-            vals = assemble(dyn)
+        def fwd(dyn, zero):
+            def run(dyn):
+                vals = assemble(dyn)
 
-            def closed(*diff_vals):
-                v2 = list(vals)
-                for i, dv in zip(diff_idx, diff_vals):
-                    v2[i] = dv
-                return fn(*v2, **kwargs)
+                def closed(*diff_vals):
+                    v2 = list(vals)
+                    for i, dv in zip(diff_idx, diff_vals):
+                        v2[i] = dv
+                    return fn(*v2, **kwargs)
 
-            # jax.vjp under jit partial-evals the op: primal outputs plus
-            # a Partial pullback whose leaves are the residuals — both
-            # halves cross the jit boundary as pytrees
-            return jax.vjp(closed, *(vals[i] for i in diff_idx))
-        return bitwise_call(zero, run, dyn)
+                # jax.vjp under jit partial-evals the op: primal outputs
+                # plus a Partial pullback whose leaves are the residuals
+                return jax.vjp(closed, *(vals[i] for i in diff_idx))
 
-    bwd = jax.jit(lambda pullback, cts, zero:
-                  bitwise_call(zero, lambda c: pullback(c), cts))
-    return _Entry(jax.jit(fwd), bwd, label=label)
+            out, pullback = bitwise_call(zero, run, dyn)
+            flat, tree = jax.tree_util.tree_flatten(pullback)
+            cell["tree"] = tree
+            return out, tuple(flat)
+
+    entry._fwd_jitted = jax.jit(fwd)
+    args = (tuple(dyn_vals), runtime_zero())
+    entry._fwd_struct = jax.tree_util.tree_map(_as_struct, args)
+    entry.fwd = get_service().get(
+        "eager-fwd", args=args, key_parts=("eager-fwd", sig_mat),
+        jitted=entry._fwd_jitted, origin=f"eager:{label}")
+    return entry
 
 
 # -- the dispatcher ----------------------------------------------------------
@@ -429,8 +520,15 @@ def dispatch(fn, raw, kwargs, diff_idx):
     if entry is None:
         statics = [v for v, t in zip(raw, template) if t != "d"]
         try:
+            # sig material for the AOT disk key: the in-memory key minus
+            # the process-local epoch (code objects/values render stably
+            # through aot.keys; epoch invalidation is conservative — the
+            # rebuilt program is identical, so a disk restore is correct)
             entry = _build_entry(fn, dict(kwargs), template, statics,
-                                 diff_idx, label=_fn_label(fnk))
+                                 diff_idx, label=_fn_label(fnk),
+                                 sig_mat=(fnk, template, avals, kwk,
+                                          diff_idx),
+                                 dyn_vals=dyn_vals)
         except Exception as e:
             with _lock:
                 _blacklist[fnk] = \
@@ -546,10 +644,13 @@ _zero_cache = None
 
 def runtime_zero():
     """The i32 zero passed to sealed programs as a runtime argument (a
-    constant would be folded and the seals optimized away)."""
+    constant would be folded and the seals optimized away). device_put
+    of a host zero, NOT jnp.zeros — the latter is itself a tiny XLA
+    program and would be the one unavoidable backend compile in an
+    otherwise fully warm AOT-cached process."""
     global _zero_cache
     if _zero_cache is None:
-        _zero_cache = jnp.zeros((), jnp.int32)
+        _zero_cache = jax.device_put(np.zeros((), np.int32))
     return _zero_cache
 
 
@@ -565,6 +666,35 @@ def _ones_like(a):
     return jnp.ones_like(a)
 
 
+# (name, avals) -> AotProgram for the tiny per-signature helper programs
+# above: with the AOT disk cache enabled even these restore in a warm
+# process instead of compiling (they are part of every backward pass, so
+# they count against the fresh-subprocess zero-compile budget)
+_helper_handles: dict = {}
+
+
+def _aot_helper(name, jitted, args):
+    from ..aot import get_service
+    svc = get_service()
+    if not svc.persistent:
+        return None
+    try:
+        key = (name,) + tuple(
+            (tuple(a.shape), str(a.dtype),
+             bool(getattr(a, "weak_type", False))) for a in args)
+        h = _helper_handles.get(key)
+        if h is None:
+            h = svc.get(f"eager-{name}", args=args,
+                        key_parts=("helper", name), jitted=jitted,
+                        origin=f"eager:{name}")
+            if len(_helper_handles) > 512:
+                _helper_handles.clear()
+            _helper_handles[key] = h
+        return h
+    except Exception:
+        return None
+
+
 def ct_add(a, b):
     """Cotangent accumulation: jitted when the cache is on (saves one
     eager dispatch per accumulation in backward())."""
@@ -575,10 +705,16 @@ def ct_add(a, b):
     if getattr(a, "dtype", None) != getattr(b, "dtype", None) or \
             getattr(a, "shape", None) != getattr(b, "shape", None):
         return a + b  # mixed avals: let eager promotion rules decide
+    h = _aot_helper("ct_add", _tree_add, (a, b))
+    if h is not None:
+        return h.call(a, b)
     return _tree_add(a, b)
 
 
 def ones_like_ct(a):
     if not _enabled_flag or isinstance(a, jax.core.Tracer):
         return jnp.ones_like(a)
+    h = _aot_helper("ones_like", _ones_like, (a,))
+    if h is not None:
+        return h.call(a)
     return _ones_like(a)

@@ -173,7 +173,10 @@ class LLMPredictor:
     streaming callbacks. Thin delegation: submit/generate_all/drain and
     the metrics ledger come straight from the engine."""
 
-    def __init__(self, config, n_slots=8, max_len=None, **engine_kwargs):
+    def __init__(self, config, n_slots=None, max_len=None,
+                 **engine_kwargs):
+        import os
+
         from ..jit.serialization import load as jit_load
         from ..serving import Engine
 
@@ -198,8 +201,25 @@ class LLMPredictor:
         model.set_state_dict(layer.state_dict())
         model.eval()
         self.model = model
+        # save_lm precompiled artifacts: attach <path>.aot as a
+        # read-only executable source and default the engine geometry
+        # to the one the programs were compiled for — the engine then
+        # deserializes its decode/prefill executables instead of
+        # compiling (zero-compile first token on a matching toolchain).
+        # Explicit kwargs win; a different geometry just compiles.
+        geo = dict(cfgs.get("aot_geometry") or {})
+        aot_dir = path + ".aot"
+        if geo and os.path.isdir(aot_dir):
+            from ..aot import get_service
+            get_service().add_source(aot_dir)
+        merged = {**{k: v for k, v in geo.items()
+                     if k not in ("n_slots", "max_len")}, **engine_kwargs}
+        if n_slots is None:
+            n_slots = geo.get("n_slots", 8)
+        if max_len is None:
+            max_len = geo.get("max_len")
         self.engine = Engine(model, n_slots=n_slots, max_len=max_len,
-                             **engine_kwargs)
+                             **merged)
 
     def submit(self, prompt, **gen_kwargs):
         return self.engine.submit(prompt, **gen_kwargs)
@@ -214,11 +234,13 @@ class LLMPredictor:
         return self.engine.stats()
 
 
-def create_llm_predictor(config, n_slots=8, max_len=None,
+def create_llm_predictor(config, n_slots=None, max_len=None,
                          **engine_kwargs) -> LLMPredictor:
     """Serve a jit-saved LM artifact (serving.save_lm) through the
     continuous-batching engine. ``config`` is an inference.Config (its
-    prog_file points at the artifact) or the artifact path itself."""
+    prog_file points at the artifact) or the artifact path itself.
+    Geometry defaults to the artifact's precompiled ``aot_geometry``
+    when present (zero-compile cold start), else n_slots=8."""
     return LLMPredictor(config, n_slots=n_slots, max_len=max_len,
                         **engine_kwargs)
 

@@ -86,6 +86,10 @@ class StaticFunction:
         self._input_spec = input_spec
         self._jit = jax.jit(self._run_split, static_argnums=(1,),
                             **(jit_kwargs or {}))
+        # signature -> AOT Compiled when the persistent executable cache
+        # is configured (paddle_tpu.aot): tracing still happens once per
+        # process per signature, but the XLA compile restores from disk
+        self._aot_compiled: dict = {}
         functools.update_wrapper(self, fn, updated=())
 
     def _traced(self, raw_params, args, kwargs):
@@ -164,6 +168,23 @@ class StaticFunction:
                 self._last_args = tuple(
                     jax.ShapeDtypeStruct(tuple(s), d) for s, d in sig)
         try:
+            from ..aot import get_service
+            svc = get_service()
+            if svc.persistent:
+                if key is None:
+                    key = self._sig_key((raw_params, args, kwargs))
+                compiled = self._aot_compiled.get(key)
+                if compiled is None:
+                    lowered = self._jit.lower(dyn, static_spec)
+                    name = getattr(self._fn, "__name__", "fn")
+                    compiled = svc.compile_lowered(
+                        lowered, f"to_static:{name}", origin=f"jit:{name}")
+                    if len(self._aot_compiled) > 64:
+                        self._aot_compiled.clear()
+                    self._aot_compiled[key] = compiled
+                # statics are baked into the AOT program (and into the
+                # signature key), so the compiled object takes only dyn
+                return compiled(dyn)
             return self._jit(dyn, static_spec)
         except (jax.errors.ConcretizationTypeError,
                 jax.errors.TracerArrayConversionError,

@@ -107,6 +107,28 @@ def _serving_resilience_families():
                [({"kind": k}, v) for k, v in sorted(t.items())])
 
 
+def _aot_families():
+    from ..aot import get_service
+
+    s = get_service().stats()
+    yield _fam("paddle_aot_cache_enabled", "gauge",
+               "1 when the persistent AOT executable cache is active",
+               [({}, 1 if s["persistent"] else 0)])
+    yield _fam("paddle_aot_cache_events_total", "counter",
+               "AOT compile-service events by kind",
+               [({"kind": k}, s[k]) for k in
+                ("hits", "misses", "disk_exec_hits", "disk_hlo_hits",
+                 "fingerprint_hits", "compiled", "corrupt_entries",
+                 "persist_errors")])
+    # store size: primary cache dir + read-only artifact sources
+    yield _fam("paddle_aot_cache_bytes", "gauge",
+               "bytes of serialized executables on disk by store",
+               [({"dir": d["dir"]}, d["bytes"]) for d in s["disk"]])
+    yield _fam("paddle_aot_cache_entries", "gauge",
+               "serialized executable entries on disk by store",
+               [({"dir": d["dir"]}, d["entries"]) for d in s["disk"]])
+
+
 def install_default_collectors():
     """Attach the built-in sources to the default registry (idempotent:
     re-registration under the same name replaces)."""
@@ -114,3 +136,4 @@ def install_default_collectors():
     register_collector(_serving_families, "serving")
     register_collector(_resilience_families, "resilience")
     register_collector(_serving_resilience_families, "serving_resilience")
+    register_collector(_aot_families, "aot")

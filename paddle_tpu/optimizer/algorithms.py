@@ -33,6 +33,31 @@ def _f32(x):
     return x.astype(jnp.float32)
 
 
+def _one_f32():
+    """f32 scalar 1.0 for beta-power accumulators: device_put of a host
+    scalar (jnp.asarray of a python float lowers a convert program — a
+    spurious backend compile in a warm AOT-cached process)."""
+    import jax
+    import numpy as np
+
+    return jax.device_put(np.float32(1.0))
+
+
+def _zeros_like(p, dtype=None):
+    """Zero accumulator matching ``p``. Off-trace this is a host
+    allocation + device_put, NOT jnp.zeros_like: the latter is itself a
+    tiny XLA program, and moment init would be the only backend compile
+    left in a warm AOT-cached fresh process (tools/bench_coldstart.py).
+    Under an outer trace it stays a traced constant as before."""
+    import jax
+    import numpy as np
+
+    dt = p.dtype if dtype is None else dtype
+    if isinstance(p, jax.core.Tracer):
+        return jnp.zeros_like(p, dtype=dt)
+    return jax.device_put(np.zeros(np.shape(p), np.dtype(dt)))
+
+
 def _needs_master(self, p):
     """Low-precision params keep a persistent fp32 master copy in the state
     (reference FusedAdam multi_precision): without it, late-training updates
@@ -79,7 +104,7 @@ class Momentum(Optimizer):
 
     def init_param_state(self, p):
         return _master_init(self, p, {
-            "velocity": jnp.zeros_like(p, dtype=_acc_dtype(p, self._multi_precision))})
+            "velocity": _zeros_like(p, dtype=_acc_dtype(p, self._multi_precision))})
 
     def update_param(self, p, g, st, lr, param):
         st = dict(st)
@@ -132,10 +157,10 @@ class Adam(Optimizer):
     def init_param_state(self, p):
         dt = _acc_dtype(p, self._multi_precision)
         return _master_init(self, p, {
-            "moment1": jnp.zeros_like(p, dtype=dt),
-            "moment2": jnp.zeros_like(p, dtype=dt),
-            "beta1_pow": jnp.asarray(1.0, dtype=jnp.float32),
-            "beta2_pow": jnp.asarray(1.0, dtype=jnp.float32)})
+            "moment1": _zeros_like(p, dtype=dt),
+            "moment2": _zeros_like(p, dtype=dt),
+            "beta1_pow": _one_f32(),
+            "beta2_pow": _one_f32()})
 
     def _adam_update(self, p, g, st, lr, param=None):
         """Returns (step, new_state, touched_rows_or_None)."""
@@ -207,9 +232,9 @@ class Adamax(Optimizer):
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
     def init_param_state(self, p):
-        return {"moment": jnp.zeros_like(p, dtype=jnp.float32),
-                "inf_norm": jnp.zeros_like(p, dtype=jnp.float32),
-                "beta1_pow": jnp.asarray(1.0, dtype=jnp.float32)}
+        return {"moment": _zeros_like(p, dtype=jnp.float32),
+                "inf_norm": _zeros_like(p, dtype=jnp.float32),
+                "beta1_pow": _one_f32()}
 
     def update_param(self, p, g, st, lr, param):
         g32 = _f32(g)
@@ -246,8 +271,8 @@ class Adadelta(Optimizer):
         self._epsilon, self._rho = epsilon, rho
 
     def init_param_state(self, p):
-        return {"avg_squared_grad": jnp.zeros_like(p, dtype=jnp.float32),
-                "avg_squared_update": jnp.zeros_like(p, dtype=jnp.float32)}
+        return {"avg_squared_grad": _zeros_like(p, dtype=jnp.float32),
+                "avg_squared_update": _zeros_like(p, dtype=jnp.float32)}
 
     def update_param(self, p, g, st, lr, param):
         g32 = _f32(g)
@@ -272,10 +297,10 @@ class RMSProp(Optimizer):
         self._momentum, self._centered = momentum, centered
 
     def init_param_state(self, p):
-        st = {"mean_square": jnp.zeros_like(p, dtype=jnp.float32),
-              "momentum": jnp.zeros_like(p, dtype=jnp.float32)}
+        st = {"mean_square": _zeros_like(p, dtype=jnp.float32),
+              "momentum": _zeros_like(p, dtype=jnp.float32)}
         if self._centered:
-            st["mean_grad"] = jnp.zeros_like(p, dtype=jnp.float32)
+            st["mean_grad"] = _zeros_like(p, dtype=jnp.float32)
         return st
 
     def update_param(self, p, g, st, lr, param):
@@ -304,10 +329,10 @@ class Lamb(Optimizer):
         self._exclude_fn = exclude_from_weight_decay_fn
 
     def init_param_state(self, p):
-        return {"moment1": jnp.zeros_like(p, dtype=jnp.float32),
-                "moment2": jnp.zeros_like(p, dtype=jnp.float32),
-                "beta1_pow": jnp.asarray(1.0, dtype=jnp.float32),
-                "beta2_pow": jnp.asarray(1.0, dtype=jnp.float32)}
+        return {"moment1": _zeros_like(p, dtype=jnp.float32),
+                "moment2": _zeros_like(p, dtype=jnp.float32),
+                "beta1_pow": _one_f32(),
+                "beta2_pow": _one_f32()}
 
     def update_param(self, p, g, st, lr, param):
         b1, b2 = self._beta1, self._beta2
@@ -357,7 +382,7 @@ class LarsMomentum(Optimizer):
 
     def init_param_state(self, p):
         return _master_init(self, p, {
-            "velocity": jnp.zeros_like(
+            "velocity": _zeros_like(
                 p, dtype=_acc_dtype(p, self._multi_precision))})
 
     def update_param(self, p, g, st, lr, param):

@@ -168,29 +168,46 @@ class Optimizer:
         except TypeError:  # unhashable key part (tracer avals etc.)
             return False
         fresh = jitted is None
+        p_vals = tuple(p._data for p in params)
+        st_vals = tuple(self._accumulators[id(p)] for p in params)
+        g_vals = tuple(p.grad._data for p in params)
         if fresh:
             try:
                 jitted = self._build_fused_step(list(params))
+                from ..aot import get_service
+                svc = get_service()
+                if svc.persistent:
+                    # AOT-route the whole-tree step: a warm process
+                    # deserializes the executable instead of compiling.
+                    # Disk key: aval-level signature (no id(p)) + code
+                    # tokens of the algorithm pieces the trace bakes in.
+                    jitted = svc.get(
+                        "eager-fused-step",
+                        args=(p_vals, st_vals, g_vals,
+                              self._lr_operand(lr),
+                              _dcache.runtime_zero()),
+                        key_parts=("fused-step", type(self).__qualname__,
+                                   self._fused_disk_key(params)),
+                        jitted=jitted,
+                        origin=f"eager:fused_step:{type(self).__name__}"
+                    ).call
             except Exception:
                 self._fused_disabled = True
                 return False
             if len(cache) >= 4:  # param-set churn: stop pinning old sets
                 cache.clear()
             cache[key] = jitted
-        p_vals = tuple(p._data for p in params)
-        st_vals = tuple(self._accumulators[id(p)] for p in params)
-        g_vals = tuple(p.grad._data for p in params)
         try:
             if fresh:     # first call traces+compiles: attribute it
                 with _compile_scope(
                         f"eager:fused_step:{type(self).__name__}"):
                     new_ps, new_sts = jitted(
                         p_vals, st_vals, g_vals,
-                        jnp.asarray(lr, jnp.float32),
+                        self._lr_operand(lr),
                         _dcache.runtime_zero())
             else:
                 new_ps, new_sts = jitted(p_vals, st_vals, g_vals,
-                                         jnp.asarray(lr, jnp.float32),
+                                         self._lr_operand(lr),
                                          _dcache.runtime_zero())
         except Exception:
             # first call traces: data-dependent clip/update python lands
@@ -202,6 +219,37 @@ class Optimizer:
             p._data = new_p
             self._accumulators[id(p)] = new_st
         return True
+
+    @staticmethod
+    def _lr_operand(lr):
+        """lr as a concrete f32 scalar operand: device_put for host
+        floats (jnp.asarray of a python float lowers a tiny convert
+        program — a spurious backend compile in a warm AOT process)."""
+        import numpy as np
+        if isinstance(lr, (float, int)):
+            return jax.device_put(np.float32(lr))
+        return jnp.asarray(lr, jnp.float32)
+
+    def _fused_disk_key(self, params):
+        """Cross-process identity of the fused step (no id()s): the
+        algorithm code (update_param/decay/clip bake into the trace) and
+        the per-param attrs that alter it. Avals ride separately via the
+        service args signature."""
+        import os as _os
+        from ..aot import keys as _akeys
+
+        clip = self._grad_clip
+        return (_akeys.code_token(type(self).update_param,
+                                  type(self)._apply_decay_to_grad,
+                                  type(self).init_param_state),
+                type(clip).__qualname__ if clip is not None else None,
+                type(self._weight_decay).__qualname__,
+                getattr(self._weight_decay, "coeff", None),
+                _os.environ.get("PADDLE_TPU_FUSED_STEP_DONATE", "0"),
+                tuple((p.optimize_attr.get("learning_rate", 1.0),
+                       type(p.regularizer).__qualname__,
+                       getattr(p.regularizer, "coeff", None))
+                      for p in params))
 
     def _fused_key(self, params):
         """Signature of the fused step: param identities + avals of

@@ -22,7 +22,7 @@ from .timer import Benchmark, benchmark  # noqa: F401
 
 __all__ = [
     "Benchmark", "benchmark", "dispatch_counters", "serving_counters",
-    "resilience_counters", "serving_resilience_counters",
+    "resilience_counters", "serving_resilience_counters", "aot_counters",
     "ProfilerState", "ProfilerTarget",
     "make_scheduler", "export_chrome_tracing", "export_protobuf",
     "Profiler", "RecordEvent", "RecordInstantEvent",
@@ -48,6 +48,16 @@ def serving_counters() -> dict:
     from ..serving import metrics as serving_metrics
 
     return serving_metrics.global_counters()
+
+
+def aot_counters() -> dict:
+    """AOT compile-service snapshot (hits by tier, misses, compiles,
+    persist errors, per-store disk bytes) — ``paddle_tpu.aot`` plumbing.
+    Zero XLA backend compiles in a warm process shows up here as
+    ``disk_exec_hits == hits`` with ``compiled == 0``."""
+    from ..aot import aot_stats
+
+    return aot_stats()
 
 
 def resilience_counters() -> dict:
@@ -292,6 +302,12 @@ class Profiler:
             # origin (eager op / prefill bucket / chunk / decode /
             # static segment) — paddle_tpu.observability.compile_attr
             print(f"compiles: {cs}")
+        from ..aot import aot_summary
+        ao = aot_summary()
+        if ao:
+            # executable-cache traffic: how many of those compiles were
+            # avoided (deserialized) and what the store holds on disk
+            print(f"aot: {ao}")
         if _trc.enabled() and _trc.spans():
             from .profiler_statistic import build_span_summary
             print(build_span_summary(sorted_by=sorted_by,

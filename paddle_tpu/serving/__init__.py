@@ -45,16 +45,47 @@ __all__ = ["Engine", "RequestHandle", "RequestTimeout", "RequestShed",
            "EngineDraining", "save_lm"]
 
 
-def save_lm(model, path):
+def save_lm(model, path, precompile=None, n_slots=8, max_len=None,
+            buckets=None, **engine_kwargs):
     """Save a CausalLM as a servable artifact: jit.save's weight payload
     plus the model config, so inference.create_llm_predictor can rebuild
     the model and serve it through an Engine without the original python
-    construction code."""
+    construction code.
+
+    With ``precompile`` (default: the ``PADDLE_TPU_AOT_PRECOMPILE=1``
+    env opt-in), the artifact additionally ships the engine's full
+    compiled program set — decode + every prefill bucket (+ chunk) —
+    serialized into ``<path>.aot/`` by ``Engine.precompile_aot``, and
+    records the engine geometry it was compiled for. A predictor built
+    from the artifact on the same backend/jax version then cold-starts
+    with ZERO XLA backend compiles for its first token (deserialized
+    executables; different toolchains fall back to a normal compile).
+    ``n_slots`` / ``max_len`` / ``engine_kwargs`` pin that geometry and
+    become the predictor's defaults."""
     import dataclasses
+    import os
+    import warnings
 
     from ..jit.serialization import save
-    from .engine import _make_arch
+    from .engine import Engine, _make_arch
 
     _, hp, _ = _make_arch(model)      # validates the model type
-    return save(model, path, llm_arch=hp["arch"],
-                llm_config=dataclasses.asdict(model.config))
+    if precompile is None:
+        precompile = os.environ.get("PADDLE_TPU_AOT_PRECOMPILE",
+                                    "0") == "1"
+    extra = {}
+    if precompile:
+        extra["aot_geometry"] = dict(n_slots=int(n_slots),
+                                     max_len=max_len, **engine_kwargs)
+    out = save(model, path, llm_arch=hp["arch"],
+               llm_config=dataclasses.asdict(model.config), **extra)
+    if precompile:
+        try:
+            eng = Engine(model, n_slots=n_slots, max_len=max_len,
+                         **engine_kwargs)
+            eng.precompile_aot(path + ".aot", buckets=buckets)
+        except Exception as e:   # artifact stays valid without programs
+            warnings.warn(
+                f"save_lm: AOT precompile failed ({type(e).__name__}: "
+                f"{e}); artifact carries weights/config only")
+    return out

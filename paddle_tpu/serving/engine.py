@@ -422,6 +422,22 @@ def _paged_chunk_impl(w, kc, vc, tok, cur_pos, keys, ids, chunk_start,
 _STATICS = ("arch", "n_heads", "n_kv", "eps", "theta", "do_sample",
             "top_k", "top_p")
 _PAGED_STATICS = _STATICS + ("block_size",)
+
+_CODE_TOKEN = None
+
+
+def _serving_code_token():
+    """AOT cache-key component covering every source file the serving
+    programs trace through: editing the math invalidates persisted
+    executables instead of silently reviving stale ones."""
+    global _CODE_TOKEN
+    if _CODE_TOKEN is None:
+        import sys
+
+        from ..aot import keys as _akeys
+        from ..text import generation as G
+        _CODE_TOKEN = _akeys.code_token(G, sys.modules[__name__])
+    return _CODE_TOKEN
 _PREFILL = jax.jit(_prefill_impl, static_argnames=_STATICS)
 _PREFILL_DONATED = jax.jit(_prefill_impl, static_argnames=_STATICS,
                            donate_argnums=(1, 2))
@@ -626,6 +642,13 @@ class Engine:
         self.base_seed = int(base_seed)
         if donate is None:
             donate = jax.default_backend() != "cpu"
+        self._donate = bool(donate)
+        # (kind, bucket) -> aot.AotProgram: every program invocation
+        # routes through the shared compile service, so a warm on-disk
+        # cache (or a save_lm artifact's precompiled program set)
+        # deserializes executables instead of compiling — zero XLA
+        # backend compiles for a fresh process's first token
+        self._aot: dict = {}
         if self.kv_layout == "paged":
             self._prefill = (_PAGED_PREFILL_DONATED if donate
                              else _PAGED_PREFILL)
@@ -643,6 +666,117 @@ class Engine:
         self.buckets_seen = set()
         self.compile_budget = (None if compile_budget is None
                                else int(compile_budget))
+
+    # -- AOT program routing ----------------------------------------------
+
+    def _aot_key_parts(self, kind):
+        return ("serving", kind, self.kv_layout, self._donate,
+                _serving_code_token())
+
+    def _run_program(self, kind, hkey, jitted, args, statics, origin):
+        """Invoke one engine program through the shared compile service.
+        The handle is resolved once per (kind, bucket) and cached; with
+        no persistent cache configured this is a plain passthrough to
+        the module-level jitted program (pre-AOT behavior)."""
+        h = self._aot.get(hkey)
+        if h is None:
+            from ..aot import get_service
+            h = get_service().get(
+                f"serving:{kind}", args=args, statics=statics,
+                key_parts=self._aot_key_parts(kind), jitted=jitted,
+                origin=origin)
+            self._aot[hkey] = h
+        return h.call(*args, **statics)
+
+    def aot_stats(self) -> dict:
+        """Per-provenance program counts (audit_engine warm-start
+        visibility): disk-exec entries cost a fresh process nothing."""
+        out: dict = {}
+        for h in self._aot.values():
+            out[h.source] = out.get(h.source, 0) + 1
+        return out
+
+    def _aot_buckets(self):
+        out, b = [], self.min_prompt_bucket
+        while True:
+            out.append(min(b, self.max_len))
+            if b >= self.max_len:
+                return out
+            b <<= 1
+
+    def _aot_probe_specs(self, buckets=None):
+        """(kind, hkey, jitted, abstract args, statics, origin) for every
+        program this engine geometry can run — ShapeDtypeStruct probes
+        mirroring the live call sites operand for operand, so the
+        signatures save_lm precompiles under are exactly the ones a
+        serving process looks up."""
+        def sds(a):
+            return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+
+        S = self.n_slots
+        w = jax.tree_util.tree_map(sds, self._w)
+        kc, vc = sds(self.cache.kc), sds(self.cache.vc)
+        tok = jax.ShapeDtypeStruct((S,), np.int32)
+        cur = jax.ShapeDtypeStruct((S,), np.int32)
+        keys = jax.ShapeDtypeStruct((S, 2), np.uint32)
+        temps = jax.ShapeDtypeStruct((S,), np.float32)
+        active = jax.ShapeDtypeStruct((S,), np.bool_)
+        i32 = jax.ShapeDtypeStruct((), np.int32)
+        u32 = jax.ShapeDtypeStruct((), np.uint32)
+        f32 = jax.ShapeDtypeStruct((), np.float32)
+        if buckets is None:
+            buckets = self._aot_buckets()
+        specs = []
+        if self.kv_layout == "paged":
+            mb = self.cache.block_tables.shape[1]
+            trow = jax.ShapeDtypeStruct((mb,), np.int32)
+            tables = jax.ShapeDtypeStruct((S, mb), np.int32)
+            for Lb in buckets:
+                ids = jax.ShapeDtypeStruct((1, int(Lb)), np.int32)
+                specs.append((
+                    "prefill", ("prefill", int(Lb)), self._prefill,
+                    (w, kc, vc, tok, cur, keys, ids, i32, i32, u32, i32,
+                     f32, trow, i32),
+                    self._paged_statics, f"prefill:L{Lb}"))
+            specs.append((
+                "decode", ("decode",), self._decode,
+                (w, kc, vc, tables, tok, cur, active, keys, temps),
+                self._paged_statics, "decode"))
+            if self.prefill_chunk is not None:
+                ids = jax.ShapeDtypeStruct((1, self.prefill_chunk),
+                                           np.int32)
+                specs.append((
+                    "chunk", ("chunk",), self._chunk,
+                    (w, kc, vc, tok, cur, keys, ids, i32, i32, i32, trow,
+                     i32, i32, u32, i32, f32),
+                    self._paged_statics, "chunk"))
+        else:
+            for Lb in buckets:
+                ids = jax.ShapeDtypeStruct((1, int(Lb)), np.int32)
+                specs.append((
+                    "prefill", ("prefill", int(Lb)), self._prefill,
+                    (w, kc, vc, tok, cur, keys, ids, i32, i32, u32, i32,
+                     f32),
+                    self._statics, f"prefill:L{Lb}"))
+            specs.append((
+                "decode", ("decode",), self._decode,
+                (w, kc, vc, tok, cur, active, keys, temps),
+                self._statics, "decode"))
+        return specs
+
+    def precompile_aot(self, dest_dir, buckets=None):
+        """Compile + serialize this engine's full program set (decode +
+        every prefill bucket + the chunk program when configured) into
+        ``dest_dir`` — the ``save_lm`` artifact path. Nothing executes:
+        probes are abstract. Returns the service stats of the build."""
+        from ..aot import CompileService
+        svc = CompileService(cache_dir=dest_dir, enabled=True)
+        for kind, hkey, jitted, args, statics, origin in \
+                self._aot_probe_specs(buckets):
+            svc.get(f"serving:{kind}", args=args, statics=statics,
+                    key_parts=self._aot_key_parts(kind), jitted=jitted,
+                    origin=origin)
+        return svc.stats()
 
     # -- request intake ---------------------------------------------------
 
@@ -791,11 +925,13 @@ class Engine:
                            request_id=h.request_id, bucket=Lb,
                            replay_k=k), \
                 _compile_scope(f"prefill:L{Lb}"):
-            out = self._prefill(
-                self._w, self.cache.kc, self.cache.vc, self._tok,
-                self._cur, self._keys, ids, np.int32(n_eff),
-                np.int32(slot), np.uint32(h.seed), np.int32(k),
-                np.float32(h.temperature), **self._statics)
+            out = self._run_program(
+                "prefill", ("prefill", Lb), self._prefill,
+                (self._w, self.cache.kc, self.cache.vc, self._tok,
+                 self._cur, self._keys, ids, np.int32(n_eff),
+                 np.int32(slot), np.uint32(h.seed), np.int32(k),
+                 np.float32(h.temperature)), self._statics,
+                f"prefill:L{Lb}")
         (self.cache.kc, self.cache.vc, self._tok, self._cur,
          self._keys, tok0) = out
         self.metrics.prefills += 1
@@ -855,13 +991,15 @@ class Engine:
                            request_id=h.request_id, bucket=Lb,
                            replay_k=k, n_shared=n_shared), \
                 _compile_scope(f"prefill:L{Lb}"):
-            out = self._prefill(
-                self._w, self.cache.kc, self.cache.vc, self._tok,
-                self._cur, self._keys, ids, np.int32(n_eff),
-                np.int32(slot), np.uint32(h.seed), np.int32(k),
-                np.float32(h.temperature),
-                self.cache.block_tables[slot].copy(), np.int32(n_shared),
-                **self._paged_statics)
+            out = self._run_program(
+                "prefill", ("prefill", Lb), self._prefill,
+                (self._w, self.cache.kc, self.cache.vc, self._tok,
+                 self._cur, self._keys, ids, np.int32(n_eff),
+                 np.int32(slot), np.uint32(h.seed), np.int32(k),
+                 np.float32(h.temperature),
+                 self.cache.block_tables[slot].copy(),
+                 np.int32(n_shared)), self._paged_statics,
+                f"prefill:L{Lb}")
         (self.cache.kc, self.cache.vc, self._tok, self._cur,
          self._keys, tok0) = out
         self.metrics.prefills += 1
@@ -888,14 +1026,16 @@ class Engine:
                            request_id=h.request_id, start=start,
                            final=is_final), \
                 _compile_scope("chunk"):
-            out = self._chunk(
-                self._w, self.cache.kc, self.cache.vc, self._tok,
-                self._cur, self._keys, ids, np.int32(start),
-                np.int32(cs.n_eff), np.int32(h.slot),
-                self.cache.block_tables[h.slot].copy(),
-                np.int32(cs.n_shared), np.int32(1 if is_final else 0),
-                np.uint32(h.seed), np.int32(cs.skip),
-                np.float32(h.temperature), **self._paged_statics)
+            out = self._run_program(
+                "chunk", ("chunk",), self._chunk,
+                (self._w, self.cache.kc, self.cache.vc, self._tok,
+                 self._cur, self._keys, ids, np.int32(start),
+                 np.int32(cs.n_eff), np.int32(h.slot),
+                 self.cache.block_tables[h.slot].copy(),
+                 np.int32(cs.n_shared), np.int32(1 if is_final else 0),
+                 np.uint32(h.seed), np.int32(cs.skip),
+                 np.float32(h.temperature)), self._paged_statics,
+                "chunk")
         (self.cache.kc, self.cache.vc, self._tok, self._cur,
          self._keys, tok0) = out
         self.chunk_used = True
@@ -1066,16 +1206,18 @@ class Engine:
                                n_active=n_active), \
                     _compile_scope("decode"):
                 if paged:
-                    out = self._decode(
-                        self._w, self.cache.kc, self.cache.vc,
-                        self.cache.block_tables.copy(), self._tok,
-                        self._cur, active, self._keys, self._temps,
-                        **self._paged_statics)
+                    out = self._run_program(
+                        "decode", ("decode",), self._decode,
+                        (self._w, self.cache.kc, self.cache.vc,
+                         self.cache.block_tables.copy(), self._tok,
+                         self._cur, active, self._keys, self._temps),
+                        self._paged_statics, "decode")
                 else:
-                    out = self._decode(
-                        self._w, self.cache.kc, self.cache.vc, self._tok,
-                        self._cur, active, self._keys,
-                        self._temps, **self._statics)
+                    out = self._run_program(
+                        "decode", ("decode",), self._decode,
+                        (self._w, self.cache.kc, self.cache.vc,
+                         self._tok, self._cur, active, self._keys,
+                         self._temps), self._statics, "decode")
             nxt, self.cache.kc, self.cache.vc, self._cur, self._keys = out
             self._tok = nxt
             self.metrics.mark_decode(time.perf_counter() - t0)

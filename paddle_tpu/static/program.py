@@ -909,9 +909,14 @@ def _compile_segment(prog, entries, feed_names, raw_feed, fetch_tensors,
     alias_count = lowered.as_text().count("tf.aliasing_output") \
         if donate else 0
     seg = _JitSegment()
-    from ..observability.compile_attr import compile_scope
-    with compile_scope(f"static:segment[{len(entries)} entries]"):
-        seg.compiled = lowered.compile()
+    # replay segments trace per process by design (the plan structure is
+    # rebuilt), but the expensive XLA compile routes through the shared
+    # AOT service keyed by the lowered program's fingerprint: a process
+    # restart deserializes the segment executables instead of compiling
+    from ..aot import get_service
+    seg.compiled = get_service().compile_lowered(
+        lowered, "static-segment",
+        origin=f"static:segment[{len(entries)} entries]")
     seg.ext_order = ext_order
     seg.out_tensors = out_tensors
     seg.state_specs = state_specs

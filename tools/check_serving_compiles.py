@@ -13,9 +13,17 @@ slot reuse) through paddle_tpu.serving.Engine and fails if:
 - any request's greedy output differs from batch generate() on the same
   prompt (token-identical, per request).
 
+``--warm-cache`` runs the same workload in two fresh subprocesses
+sharing one paddle_tpu.aot cache directory and asserts the SECOND
+process drives the whole workload with 0 cold XLA backend compiles
+(deserialized executables) and unchanged token parity — the honest
+budget once the persistent executable cache lands (without this mode a
+warm cache would read as a spurious budget pass/violation).
+
 Modeled on tools/check_retrace.py. Usage:
 
     JAX_PLATFORMS=cpu python tools/check_serving_compiles.py [--json]
+    JAX_PLATFORMS=cpu python tools/check_serving_compiles.py --warm-cache
 """
 import argparse
 import json
@@ -26,13 +34,61 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+def run_warm_cache(args):
+    """Subprocess pair sharing one AOT cache dir: the second process
+    must serve the whole workload with 0 cold backend compiles."""
+    import json as _json
+    import subprocess
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="aot-serving-")
+    env = dict(os.environ, PADDLE_TPU_AOT_CACHE_DIR=cache_dir)
+    runs = []
+    for tag in ("cold", "warm"):
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--json",
+             "--requests", str(args.requests), "--slots", str(args.slots),
+             "--max-new", str(args.max_new)],
+            capture_output=True, text=True, env=env)
+        if not out.stdout.strip():
+            print(_json.dumps({"bench": "serving_compile_warm_cache",
+                               "ok": False,
+                               "error": f"{tag}: {out.stderr[-800:]}"}))
+            return 1
+        runs.append(_json.loads(out.stdout.strip().splitlines()[-1]))
+    cold, warm = runs
+    have = warm["cold_compiles"] is not None
+    ok = (cold["ok"] and warm["ok"]
+          and not warm["greedy_mismatches"]
+          and (not have or warm["cold_compiles"] == 0))
+    record = {"bench": "serving_compile_warm_cache",
+              "cache_dir": cache_dir,
+              "cold_run_compiles": cold["cold_compiles"],
+              "warm_run_compiles": warm["cold_compiles"],
+              "cold": cold, "warm": warm, "ok": ok}
+    if args.json:
+        print(_json.dumps(record))
+    else:
+        print(f"cold-process compiles {record['cold_run_compiles']}")
+        print(f"warm-process compiles {record['warm_run_compiles']}")
+        print("OK (warm process serves compile-free)" if ok else
+              "FAIL: warm cache still compiles (or parity broke)")
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", action="store_true", help="emit a JSON line")
     ap.add_argument("--requests", type=int, default=16)
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--max-new", type=int, default=8)
+    ap.add_argument("--warm-cache", action="store_true",
+                    help="subprocess-pair AOT cache gate: the second "
+                         "process must do 0 cold backend compiles")
     args = ap.parse_args()
+
+    if args.warm_cache:
+        return run_warm_cache(args)
 
     import dataclasses
 
