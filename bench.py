@@ -640,6 +640,47 @@ def bench_serving_paged(backend):
     return out
 
 
+def bench_serving_tp(backend):
+    """Tensor-parallel serving decode A/B (ROADMAP item 1(a)): the same
+    mixed-prompt workload through tp=1/2/4 engines on real chips — the
+    fused decode step, paged pool and prefill programs shard over the
+    Fleet ``tp`` mesh axis with the TP dots decomposed into overlapped
+    collective-matmuls (ppermute-pipelined partial dots). Reports
+    tokens/sec and ITL per tp degree plus the per-step collective count;
+    ok requires token-identical output across degrees. The CPU ledger
+    lives in tools/bench_serving.py (tp_sweep, reused here verbatim);
+    this is the TPU arm."""
+    import paddle_tpu
+    from paddle_tpu.text.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if backend != "tpu":
+        return {"skipped": "tpu only"}
+    import jax
+    degrees = [d for d in (1, 2, 4) if d <= len(jax.devices())]
+    if degrees == [1]:
+        return {"skipped": "needs >= 2 devices for a tp arm"}
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    try:
+        from bench_serving import tp_sweep
+    finally:
+        sys.path.pop(0)
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5504, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=16,
+                      max_position_embeddings=512, dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    n_req, max_new = 16, 64
+    rng = np.random.default_rng(0)
+    lens = [(48, 96, 120, 128)[i % 4] for i in range(n_req)]
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in lens]
+    return tp_sweep(model, cfg, prompts, degrees, max_new=max_new,
+                    n_slots=8, max_len=256)
+
+
 def bench_ctr_widedeep(backend):
     """Recsys/PS-analog throughput: wide&deep CTR over a 1M-row sharded
     embedding table (single chip: table replicated-equivalent), lazy-row
@@ -959,6 +1000,7 @@ def main():
                          ("ctr_widedeep", bench_ctr_widedeep),
                          ("serving_engine", bench_serving),
                          ("serving_paged", bench_serving_paged),
+                         ("serving_tp", bench_serving_tp),
                          ("coldstart", bench_coldstart),
                          ("flash_blocks", bench_flash_blocks)):
             if only and name not in only:

@@ -276,6 +276,9 @@ def audit_engine(engine, compile_budget=None, rules=None,
                      if hasattr(engine.cache, "pool") else None),
         "prefill_chunk": getattr(engine, "prefill_chunk", None),
         "chunk_used": chunk_used,
+        "tp": getattr(engine, "tp", 1),
+        "mesh": (engine.tp_geometry()
+                 if hasattr(engine, "tp_geometry") else None),
     }
     # AOT warm-start visibility: programs restored from the executable
     # cache cost a fresh process zero backend compiles — the honest

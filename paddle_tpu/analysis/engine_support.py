@@ -11,20 +11,35 @@ def engine_donates(engine) -> bool:
     programs (KV buffers/pool updated in place)."""
     from ..serving import engine as E
 
+    if getattr(engine, "tp", 1) > 1:
+        # TP programs are per-mesh shard_map jits, not the module-level
+        # constants — the engine records its donation policy directly
+        return bool(engine._donate)
     return engine._decode in (E._DECODE_DONATED, E._PAGED_DECODE_DONATED)
 
 
 def lower_decode_program(engine) -> str:
     """Lower the engine's fused decode step against its live state and
     return the StableHLO text — the same program the engine executes
-    (slot or paged layout), so dtype/padding rules audit real serving
-    HLO, not a proxy."""
+    (slot, paged or tensor-parallel layout), so dtype/padding/collective
+    rules audit real serving HLO, not a proxy."""
     import jax
     import jax.numpy as jnp
 
     from ..serving.engine import (_PAGED_STATICS, _STATICS, _decode_impl,
                                   _paged_decode_impl)
 
+    if getattr(engine, "tp", 1) > 1:
+        # the engine's own jitted shard_map program (statics baked):
+        # this is the SPMD decode the mesh executes, ring collective-
+        # matmuls included
+        lowered = engine._decode.lower(
+            engine._w, engine.cache.kc, engine.cache.vc,
+            engine.cache.block_tables.copy(),
+            jnp.asarray(engine._tok), jnp.asarray(engine._cur),
+            engine.cache.active.copy(), jnp.asarray(engine._keys),
+            engine._temps.copy())
+        return lowered.as_text()
     if getattr(engine, "kv_layout", "slot") == "paged":
         args = (engine._w, jnp.asarray(engine.cache.kc),
                 jnp.asarray(engine.cache.vc),

@@ -444,3 +444,50 @@ def _fp64_ast(sf):
             "with  # tpu_lint: allow(dtype-promotion)")
         if f:
             yield f
+
+
+# -- 7. serial collectives wrapping matmuls (AST facet) ----------------------
+
+_COLLECTIVE_CALLS = {"psum", "all_gather", "reduce_scatter",
+                     "psum_scatter", "all_to_all"}
+_DOT_CALLS = {"dot", "matmul", "einsum", "dot_general"}
+
+
+def _contains_matmul(node):
+    for n in ast.walk(node):
+        if isinstance(n, ast.BinOp) and isinstance(n.op, ast.MatMult):
+            return True
+        if isinstance(n, ast.Call) and _call_name(n) in _DOT_CALLS:
+            return True
+    return False
+
+
+@rule("unoverlapped-collective", kind="ast", severity="high",
+      title="lax.psum/all_gather/reduce_scatter wrapping a matmul "
+            "expression — the serial collective-after-dot form")
+def _unoverlapped_collective_ast(sf):
+    """AST facet of the program rule: ``jax.lax.psum(x @ w, axis)`` (or
+    a gather/scatter-reduce around a dot/matmul/einsum) writes the
+    serial tensor-parallel form directly in source. The decomposed
+    overlapped form lives in ``distributed.collective_matmul``; code
+    that intentionally keeps the serial form (references, one-shot
+    setup paths off the decode/train loop) annotates with
+    ``# tpu_lint: allow(unoverlapped-collective)``."""
+    if sf.tree is None:
+        return
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call)
+                and _call_name(node) in _COLLECTIVE_CALLS
+                and node.args and _contains_matmul(node.args[0])):
+            continue
+        f = _finding(
+            sf, "unoverlapped-collective", "high", node,
+            f"{_call_name(node)}() wraps a matmul expression — the "
+            "collective serializes after the dot and its latency lands "
+            "on the critical path",
+            "use distributed.collective_matmul.ring_rowparallel_matmul"
+            " / matmul_allgather (ppermute-pipelined partial dots); if "
+            "the serial form is intentional, annotate with  "
+            "# tpu_lint: allow(unoverlapped-collective)")
+        if f:
+            yield f

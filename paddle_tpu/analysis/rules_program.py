@@ -487,7 +487,89 @@ def _compile_budget(view):
                 "program (see host-callback findings)")
 
 
-# -- 8. AOT executable-cache key stability -----------------------------------
+# -- 8. unoverlapped collectives on the critical path ------------------------
+
+_SERIAL_COLLECTIVES = {"all_reduce", "reduce_scatter"}
+_GATHER_COLLECTIVES = {"all_gather", "all_to_all"}
+_DOT_OPS = {"dot_general", "dot", "convolution"}
+# ops a collective operand may transparently pass through while still
+# being "the dot's result" (no compute to hide a hop behind)
+_PASSTHROUGH_OPS = {"reshape", "transpose", "convert",
+                    "bitcast_convert", "broadcast_in_dim"}
+
+
+def _defining_dot(mod, var, defs, depth=0):
+    op = defs.get(var)
+    if op is None or depth > 4:
+        return None
+    base = op.name.split(".")[-1]
+    if base in _DOT_OPS:
+        return op
+    if base in _PASSTHROUGH_OPS:
+        for o in op.operands:
+            hit = _defining_dot(mod, o, defs, depth + 1)
+            if hit is not None:
+                return hit
+    return None
+
+
+@rule("unoverlapped-collective", kind="program", severity="high",
+      title="all_reduce/reduce_scatter/all_gather serializing directly "
+            "after a dot — decompose into a ppermute-pipelined "
+            "collective-matmul so the hops hide behind compute")
+def _unoverlapped_collective(view):
+    """The serial tensor-parallel form ``dot -> collective`` puts the
+    collective's full latency on the critical path; fused
+    computation-collectives (arXiv 2305.06942,
+    ``distributed.collective_matmul``) split the dot into per-chunk
+    partial dots pipelined over a ppermute ring so the wire time
+    overlaps the math. A collective whose operand IS a dot result
+    (through reshapes/converts only) is the serial form: high for the
+    reducing collectives (all_reduce / reduce_scatter — the row-parallel
+    matmul pattern), medium for a gather of dot output (the sharded-
+    output pattern; sometimes terminal, still unoverlapped)."""
+    mod = view.module
+    if mod is None:
+        return
+    defs = {r: op for op in mod.ops for r in op.results}
+    serial = []
+    n_coll = 0
+    n_ppermute = len(mod.ops_named("stablehlo.collective_permute",
+                                   "collective_permute"))
+    for op in mod.ops:
+        base = op.name.split(".")[-1]
+        if base not in _SERIAL_COLLECTIVES | _GATHER_COLLECTIVES:
+            continue
+        n_coll += 1
+        for o in op.operands:
+            dot = _defining_dot(mod, o, defs)
+            if dot is not None:
+                serial.append((op, dot, base))
+                break
+    view.metrics["unoverlapped-collective"] = {
+        "collectives": n_coll, "serial_after_dot": len(serial),
+        "collective_permutes": n_ppermute}
+    for op, dot, base in serial[:8]:
+        sev = "high" if base in _SERIAL_COLLECTIVES else "medium"
+        yield Finding(
+            "unoverlapped-collective", sev,
+            f"{op.name} consumes the result of {dot.name} directly — "
+            "the collective serializes after the matmul and its full "
+            "latency lands on the decode/train critical path",
+            location=op.path,
+            suggested_fix="decompose into an overlapped collective-"
+            "matmul (distributed.collective_matmul."
+            "ring_rowparallel_matmul / matmul_allgather): per-chunk "
+            "partial dots pipelined over a ppermute ring hide the hops "
+            "behind compute")
+    if len(serial) > 8:
+        yield Finding(
+            "unoverlapped-collective", "high",
+            f"... and {len(serial) - 8} more serial collectives after "
+            "dots", location=f"@{mod.main.name}")
+
+
+# -- 9. AOT executable-cache key stability -----------------------------------
 
 @rule("aot-key-instability", kind="program", severity="medium",
       title="identical program compiled under multiple AOT cache keys "

@@ -1,0 +1,137 @@
+"""Overlapped collective-matmuls for tensor-parallel programs.
+
+Fused computation-collectives (arXiv 2305.06942): a tensor-parallel
+matmul whose result needs a collective should not serialize as
+``dot -> all_reduce`` — the collective then sits on the critical path
+for its full latency. Decomposing the dot into per-chunk partial dots
+pipelined over a ``ppermute`` ring lets every hop travel WHILE the next
+chunk's dot executes, so the ICI time hides behind compute.
+
+Two decompositions cover the serving/TP layer vocabulary:
+
+* :func:`ring_rowparallel_matmul` — the row-parallel projection
+  (o-proj / down-proj): ``y = psum_tp(x_local @ w_local)``. Phase one is
+  a matmul+reduce-scatter pipeline (each step computes the local partial
+  for ONE output chunk while the accumulating chunk travels the ring);
+  phase two ring-gathers the owned chunks into the full, replicated
+  result. The emitted HLO contains ONLY ``collective_permute`` ops —
+  no ``all_reduce`` serializing after the dot.
+* :func:`matmul_allgather` — the sharded-output matmul (vocab head):
+  ``y = concat_tp(x @ w_local)``. The local dot is split into sub-chunks
+  whose ring hops interleave with the remaining sub-chunk dots.
+
+Both are bit-deterministic (fixed ring order) and replicated across the
+axis on return; they are NOT bitwise-equal to the single-dot form (the
+partial sums reduce in ring order), which is why TP serving parity is
+asserted token-identically rather than bitwise.
+
+:func:`serial_rowparallel_matmul` keeps the naive ``psum(x @ w)`` form
+as the A/B reference — the exact pattern the ``unoverlapped-collective``
+tpu_lint rule exists to flag.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ring_rowparallel_matmul", "matmul_allgather",
+           "serial_rowparallel_matmul", "gather_chunks",
+           "ppermutes_per_rowparallel", "ppermutes_per_gather"]
+
+#: sub-chunks the local shard of a matmul+all-gather is split into so
+#: ring hops of chunk c overlap the dot of chunk c+1 (2 is enough to
+#: start the pipeline; odd shard widths fall back to 1 chunk)
+GATHER_CHUNKS = 2
+
+
+def gather_chunks(local_width: int, n_chunks: int = GATHER_CHUNKS) -> int:
+    """Sub-chunk count :func:`matmul_allgather` will actually use for a
+    local shard of ``local_width`` columns."""
+    return n_chunks if n_chunks > 1 and local_width % n_chunks == 0 else 1
+
+
+def ppermutes_per_rowparallel(tp: int) -> int:
+    """collective_permute ops one ring_rowparallel_matmul emits."""
+    return 2 * (tp - 1)
+
+
+def ppermutes_per_gather(tp: int, local_width: int) -> int:
+    """collective_permute ops one matmul_allgather emits."""
+    return gather_chunks(local_width) * (tp - 1)
+
+
+def ring_rowparallel_matmul(x, w_local, axis_name, tp):
+    """``y = psum_over(axis_name)(x @ w_local)`` as a ppermute-pipelined
+    collective-matmul, replicated on return.
+
+    ``x`` ``[..., k_local]`` (each device holds its contraction shard),
+    ``w_local`` ``[k_local, F]`` with ``F % tp == 0``. Phase one: at
+    step ``s`` device ``i`` computes its partial dot for output chunk
+    ``(i + s + 1) % tp`` and adds it to the accumulator ppermuted in
+    from upstream — the next step's dot has no data dependency on the
+    hop, so XLA overlaps them. After ``tp`` steps device ``i`` owns the
+    fully-reduced chunk ``i`` (a matmul+reduce-scatter). Phase two
+    ring-gathers the chunks into the full ``[..., F]`` result with
+    traced-offset dynamic updates (no ``all_gather`` op is emitted)."""
+    F = w_local.shape[-1]
+    Fc = F // tp
+    i = jax.lax.axis_index(axis_name)
+    wr = w_local.reshape(w_local.shape[0], tp, Fc)
+    down = [(d, (d - 1) % tp) for d in range(tp)]
+    up = [(d, (d + 1) % tp) for d in range(tp)]
+    acc = None
+    for s in range(tp):
+        c = (i + s + 1) % tp
+        wc = jax.lax.dynamic_index_in_dim(wr, c, axis=1, keepdims=False)
+        part = x @ wc
+        acc = part if acc is None \
+            else jax.lax.ppermute(acc, axis_name, down) + part
+    out = jnp.zeros(x.shape[:-1] + (F,), acc.dtype)
+    lead = (0,) * (x.ndim - 1)
+    cur, src = acc, i
+    out = jax.lax.dynamic_update_slice(out, cur, lead + (src * Fc,))
+    for s in range(tp - 1):
+        cur = jax.lax.ppermute(cur, axis_name, up)
+        src = (i - s - 1) % tp
+        out = jax.lax.dynamic_update_slice(out, cur, lead + (src * Fc,))
+    return out
+
+
+def matmul_allgather(x, w_local, axis_name, tp, n_chunks=GATHER_CHUNKS):
+    """``y = concat_over(axis_name)(x @ w_local)`` with the local dot
+    split into sub-chunks whose ring hops overlap the remaining dots.
+
+    ``x`` ``[..., k]`` replicated, ``w_local`` ``[k, V_local]`` (the
+    device's output-column shard). Chunk ``c+1``'s dot has no dependency
+    on chunk ``c``'s hops, so the ppermutes hide behind compute; the
+    assembled ``[..., tp * V_local]`` result is replicated and bitwise
+    equal to a plain gather (pure data movement). Sub-chunking degrades
+    to one chunk when ``V_local % n_chunks != 0``."""
+    Vl = w_local.shape[-1]
+    n_chunks = gather_chunks(Vl, n_chunks)
+    Vc = Vl // n_chunks
+    i = jax.lax.axis_index(axis_name)
+    up = [(d, (d + 1) % tp) for d in range(tp)]
+    out = jnp.zeros(x.shape[:-1] + (tp * Vl,), x.dtype)
+    lead = (0,) * (x.ndim - 1)
+    for c in range(n_chunks):
+        y = x @ w_local[:, c * Vc:(c + 1) * Vc]
+        cur, src = y, i
+        out = jax.lax.dynamic_update_slice(
+            out, cur, lead + (src * Vl + c * Vc,))
+        for s in range(tp - 1):
+            cur = jax.lax.ppermute(cur, axis_name, up)
+            src = (i - s - 1) % tp
+            out = jax.lax.dynamic_update_slice(
+                out, cur, lead + (src * Vl + c * Vc,))
+    return out
+
+
+def serial_rowparallel_matmul(x, w_local, axis_name):
+    """The NAIVE row-parallel form: the all-reduce serializes after the
+    dot (its full latency lands on the critical path). Kept as the A/B
+    reference and the seeded positive for the ``unoverlapped-collective``
+    lint rule — production programs use :func:`ring_rowparallel_matmul`.
+    """
+    # tpu_lint: allow(unoverlapped-collective) — this IS the serial form
+    return jax.lax.psum(x @ w_local, axis_name)

@@ -268,7 +268,9 @@ class Profiler:
                   f"cow={sc.get('cow_copies', 0)} "
                   f"preempt={sc.get('preemptions', 0)} "
                   f"chunk_steps={sc.get('chunk_steps', 0)} "
-                  f"pool_low_watermark={'-' if lw is None else lw}")
+                  f"pool_low_watermark={'-' if lw is None else lw}"
+                  + (f" tp={sc['tp_max']}"
+                     if sc.get("tp_max", 1) > 1 else ""))
         rc = resilience_counters()
         if rc["ledgers"]:
             print("resilience: "

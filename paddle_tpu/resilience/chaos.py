@@ -238,7 +238,14 @@ def corrupt_kv(engine, seed: int = 0, value: float = float("nan")):
         block = int(cand[int(rng.integers(len(cand)))])
         kc = np.asarray(cache.kc).copy()
         kc[:, block] = value
-        cache.kc = jnp.asarray(kc)
+        old_sharding = getattr(cache.kc, "sharding", None)
+        if old_sharding is not None and hasattr(old_sharding, "mesh"):
+            # tensor-parallel pool: keep the NamedSharding so the poisoned
+            # array still matches the SPMD program's operand signature
+            import jax
+            cache.kc = jax.device_put(kc, old_sharding)
+        else:
+            cache.kc = jnp.asarray(kc)
         return block
     active = np.nonzero(cache.active)[0]
     if active.size == 0:

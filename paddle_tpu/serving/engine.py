@@ -34,6 +34,17 @@ The engine is single-threaded and step-driven: callers (or
 ``RequestHandle.result()`` / ``drain()``) pump ``step()``; all host-side
 bookkeeping is numpy so nothing but the two jitted programs ever reaches
 the device.
+
+``Engine(tp=N)`` shards the whole program set over a ``tp`` mesh axis
+(one engine across N chips): column-parallel qkv/gate-up, row-parallel
+o-/down-proj, vocab-sharded head, kv-heads-split paged pool — each
+program becomes ONE shard_map SPMD lowering (budget unchanged) whose TP
+dots are overlapped collective-matmuls
+(``distributed.collective_matmul``), and sampling runs on the
+ring-gathered full logits with the same PRNG chains, so output stays
+token-identical to the single-device engine. Host-side bookkeeping,
+scheduling, prefix sharing and the adopt()/skip replay machinery are
+untouched by sharding.
 """
 from __future__ import annotations
 
@@ -419,9 +430,221 @@ def _paged_chunk_impl(w, kc, vc, tok, cur_pos, keys, ids, chunk_start,
     return kc, vc, tok, cur_pos, keys, tok0
 
 
+def _tp_prefill_impl(w, kc, vc, tok, cur_pos, keys, ids, n_prompt, slot,
+                     seed, skip, temp, table_row, skip_write, *, arch,
+                     n_heads, n_kv, eps, theta, do_sample, top_k, top_p,
+                     block_size, tp):
+    """Tensor-parallel paged prefill (runs INSIDE shard_map over the
+    ``tp`` mesh axis): same causal forward and PRNG chain as
+    ``_paged_prefill_impl``, but every weight leaf / the KV pool arrive
+    as per-device shards — attention runs over the local head group and
+    the row-parallel projections reassemble replicated activations
+    through ppermute-pipelined collective-matmuls. The sampled token is
+    drawn from the ring-gathered FULL logits row, so the sampling math
+    (and therefore the token stream) is shared with the single-device
+    engine."""
+    from ..text import generation as G
+
+    Lb = ids.shape[1]
+    if arch == "llama":
+        x = jnp.take(w["embed"], ids, axis=0)
+        pos = jnp.arange(Lb)
+        stack = {k: w[k] for k in G._LLAMA_STACK_KEYS}
+
+        def one(xc, lw):
+            return G._llama_prefill_layer_tp(
+                xc, lw, pos, n_heads=n_heads, n_kv=n_kv, eps=eps,
+                theta=theta, tp=tp)
+
+        x, kvs = jax.lax.scan(one, x, stack)
+        hlast = jax.lax.dynamic_index_in_dim(
+            G._rms(x, w["norm"], eps)[0], n_prompt - 1, 0, keepdims=False)
+        logits0 = G.matmul_allgather(hlast[None], w["head"], G._TP_AXIS,
+                                     tp)[0]
+    else:
+        pos = jnp.arange(Lb)
+        x = jnp.take(w["wte"], ids, axis=0) + w["wpe"][pos][None]
+        stack = {k: w[k] for k in G._GPT_STACK_KEYS}
+
+        def one(xc, lw):
+            return G._gpt_prefill_layer_tp(xc, lw, n_heads=n_heads, tp=tp)
+
+        x, kvs = jax.lax.scan(one, x, stack)
+        xlast = jax.lax.dynamic_index_in_dim(x[0], n_prompt - 1, 0,
+                                             keepdims=False)
+        logits0 = G.matmul_allgather(
+            G._ln(xlast, w["lnfw"], w["lnfb"])[None], w["head"],
+            G._TP_AXIS, tp)[0]
+
+    j = jnp.arange(Lb)
+    writable = (j >= skip_write) & (j < n_prompt)
+    dest = jnp.where(writable,
+                     table_row[j // block_size] * block_size
+                     + j % block_size,
+                     j % block_size)             # trash block rows
+    L, nb, bs = kc.shape[0], kc.shape[1], kc.shape[2]
+    kvh, hd = kc.shape[3], kc.shape[4]
+    kc = kc.reshape(L, nb * bs, kvh, hd).at[:, dest].set(
+        kvs[0][:, 0]).reshape(L, nb, bs, kvh, hd)
+    vc = vc.reshape(L, nb * bs, kvh, hd).at[:, dest].set(
+        kvs[1][:, 0]).reshape(L, nb, bs, kvh, hd)
+
+    key = jax.random.PRNGKey(seed)
+    key = jax.lax.fori_loop(0, skip,
+                            lambda _, k: jax.random.split(k)[0], key)
+    key, sk = jax.random.split(key)
+    logits_f = G._filter_logits(logits0[None], temp, do_sample, top_k,
+                                top_p)
+    if do_sample:
+        tok0 = jax.random.categorical(sk, logits_f, axis=-1)[0]
+    else:
+        tok0 = jnp.argmax(logits_f, axis=-1)[0]
+    tok0 = tok0.astype(jnp.int32)
+    tok = tok.at[slot].set(tok0)
+    cur_pos = cur_pos.at[slot].set(n_prompt.astype(jnp.int32))
+    keys = keys.at[slot].set(key)
+    return kc, vc, tok, cur_pos, keys, tok0
+
+
+def _tp_decode_impl(w, kc, vc, tables, tok, cur_pos, active, keys, temps,
+                    *, arch, n_heads, n_kv, eps, theta, do_sample, top_k,
+                    top_p, block_size, tp):
+    """Tensor-parallel fused paged decode step (inside shard_map): ONE
+    SPMD program for the life of the engine. Each device scatters its
+    kv-head shard into its pool shard and attends over its local head
+    group; the o-/down-projections and the vocab head are overlapped
+    collective-matmuls, so the decode HLO contains only
+    ``collective_permute`` ops — nothing serializes after a dot."""
+    from ..text import generation as G
+
+    S = tok.shape[0]
+    rows = jnp.arange(S)
+    blk = tables[rows, cur_pos // block_size]
+    dest = jnp.where(active, blk * block_size + cur_pos % block_size,
+                     cur_pos % block_size)
+    if arch == "llama":
+        xt = jnp.take(w["embed"], tok, axis=0)[:, None]
+        stack = {k: w[k] for k in G._LLAMA_STACK_KEYS}
+
+        def one(cx, lw_kv):
+            xt2, kc_l, vc_l = G._llama_decode_layer_paged_tp(
+                cx["x"], lw_kv, lw_kv["kc"], lw_kv["vc"], tables, dest,
+                cur_pos, cur_pos, n_heads=n_heads, n_kv=n_kv, eps=eps,
+                theta=theta, block_size=block_size, tp=tp)
+            return {"x": xt2}, (kc_l, vc_l)
+    else:
+        xt = (jnp.take(w["wte"], tok, axis=0)
+              + jnp.take(w["wpe"], cur_pos, axis=0))[:, None]
+        stack = {k: w[k] for k in G._GPT_STACK_KEYS}
+
+        def one(cx, lw_kv):
+            xt2, kc_l, vc_l = G._gpt_decode_layer_paged_tp(
+                cx["x"], lw_kv, lw_kv["kc"], lw_kv["vc"], tables, dest,
+                cur_pos, n_heads=n_heads, block_size=block_size, tp=tp)
+            return {"x": xt2}, (kc_l, vc_l)
+
+    lw_kv = dict(stack)
+    lw_kv["kc"] = kc
+    lw_kv["vc"] = vc
+    cx, (kc, vc) = jax.lax.scan(one, {"x": xt}, lw_kv)
+    if arch == "llama":
+        hidden = G._rms(cx["x"][:, 0], w["norm"], eps)
+    else:
+        hidden = G._ln(cx["x"][:, 0], w["lnfw"], w["lnfb"])
+    logits = G.matmul_allgather(hidden, w["head"], G._TP_AXIS, tp)
+
+    split = jax.vmap(jax.random.split)(keys)        # [S, 2, 2]
+    new_keys, sks = split[:, 0], split[:, 1]
+    logits_f = G._filter_logits(logits, temps, do_sample, top_k, top_p)
+    if do_sample:
+        nxt = jax.vmap(jax.random.categorical)(sks, logits_f)
+    else:
+        nxt = jnp.argmax(logits_f, axis=-1)
+    nxt = nxt.astype(jnp.int32)
+    nxt = jnp.where(active, nxt, tok)
+    new_keys = jnp.where(active[:, None], new_keys, keys)
+    cur2 = jnp.where(active, cur_pos + 1, cur_pos)
+    return nxt, kc, vc, cur2, new_keys
+
+
+def _tp_chunk_impl(w, kc, vc, tok, cur_pos, keys, ids, chunk_start,
+                   n_prompt, slot, table_row, skip_write, is_final, seed,
+                   skip, temp, *, arch, n_heads, n_kv, eps, theta,
+                   do_sample, top_k, top_p, block_size, tp):
+    """Tensor-parallel chunked-prefill step (inside shard_map): the SAME
+    one-extra-lowering contract as ``_paged_chunk_impl`` — every chunk
+    of every long prompt shares this program, ``is_final`` gating the
+    sampling side effects as a runtime operand."""
+    from ..text import generation as G
+
+    C = ids.shape[1]
+    gpos = chunk_start + jnp.arange(C)
+    writable = (gpos >= skip_write) & (gpos < n_prompt)
+    wdest = jnp.where(writable,
+                      table_row[gpos // block_size] * block_size
+                      + gpos % block_size,
+                      gpos % block_size)
+    if arch == "llama":
+        x = jnp.take(w["embed"], ids, axis=0)
+        stack = {k: w[k] for k in G._LLAMA_STACK_KEYS}
+
+        def one(cx, lw_kv):
+            x2, kc_l, vc_l = G._llama_chunk_layer_tp(
+                cx["x"], lw_kv, lw_kv["kc"], lw_kv["vc"], table_row, gpos,
+                wdest, n_heads=n_heads, n_kv=n_kv, eps=eps, theta=theta,
+                block_size=block_size, tp=tp)
+            return {"x": x2}, (kc_l, vc_l)
+    else:
+        x = jnp.take(w["wte"], ids, axis=0) + w["wpe"][gpos][None]
+        stack = {k: w[k] for k in G._GPT_STACK_KEYS}
+
+        def one(cx, lw_kv):
+            x2, kc_l, vc_l = G._gpt_chunk_layer_tp(
+                cx["x"], lw_kv, lw_kv["kc"], lw_kv["vc"], table_row, gpos,
+                wdest, n_heads=n_heads, block_size=block_size, tp=tp)
+            return {"x": x2}, (kc_l, vc_l)
+
+    lw_kv = dict(stack)
+    lw_kv["kc"] = kc
+    lw_kv["vc"] = vc
+    cx, (kc, vc) = jax.lax.scan(one, {"x": x}, lw_kv)
+    li = jnp.clip(n_prompt - 1 - chunk_start, 0, C - 1)
+    if arch == "llama":
+        hlast = jax.lax.dynamic_index_in_dim(
+            G._rms(cx["x"], w["norm"], eps)[0], li, 0, keepdims=False)
+        logits0 = G.matmul_allgather(hlast[None], w["head"], G._TP_AXIS,
+                                     tp)[0]
+    else:
+        xlast = jax.lax.dynamic_index_in_dim(cx["x"][0], li, 0,
+                                             keepdims=False)
+        logits0 = G.matmul_allgather(
+            G._ln(xlast, w["lnfw"], w["lnfb"])[None], w["head"],
+            G._TP_AXIS, tp)[0]
+
+    key = jax.random.PRNGKey(seed)
+    key = jax.lax.fori_loop(0, skip,
+                            lambda _, k: jax.random.split(k)[0], key)
+    key, sk = jax.random.split(key)
+    logits_f = G._filter_logits(logits0[None], temp, do_sample, top_k,
+                                top_p)
+    if do_sample:
+        tok0 = jax.random.categorical(sk, logits_f, axis=-1)[0]
+    else:
+        tok0 = jnp.argmax(logits_f, axis=-1)[0]
+    tok0 = tok0.astype(jnp.int32)
+    fin = is_final.astype(bool)
+    tok = jnp.where(fin, tok.at[slot].set(tok0), tok)
+    cur_pos = jnp.where(fin,
+                        cur_pos.at[slot].set(n_prompt.astype(jnp.int32)),
+                        cur_pos)
+    keys = jnp.where(fin, keys.at[slot].set(key), keys)
+    return kc, vc, tok, cur_pos, keys, tok0
+
+
 _STATICS = ("arch", "n_heads", "n_kv", "eps", "theta", "do_sample",
             "top_k", "top_p")
 _PAGED_STATICS = _STATICS + ("block_size",)
+_TP_STATICS = _PAGED_STATICS + ("tp",)
 
 _CODE_TOKEN = None
 
@@ -435,9 +658,55 @@ def _serving_code_token():
         import sys
 
         from ..aot import keys as _akeys
+        from ..distributed import collective_matmul as _cm
         from ..text import generation as G
-        _CODE_TOKEN = _akeys.code_token(G, sys.modules[__name__])
+        _CODE_TOKEN = _akeys.code_token(G, _cm, sys.modules[__name__])
     return _CODE_TOKEN
+
+
+#: (mesh, kind, arch, donate, statics) -> jitted shard_map program.
+#: Module-level like the single-device programs: every engine (and every
+#: supervisor-rebuilt incarnation) over an EQUAL mesh + geometry shares
+#: one SPMD lowering per program kind — jax.sharding.Mesh hashes by
+#: device ids + axis names, so a rebuilt engine's fresh-but-equal mesh
+#: still hits this cache and re-traces nothing in-process.
+_TP_PROGRAMS: dict = {}
+
+_TP_IN_REST = {"prefill": 11, "decode": 6, "chunk": 13}
+_TP_IMPLS = {"prefill": _tp_prefill_impl, "decode": _tp_decode_impl,
+             "chunk": _tp_chunk_impl}
+
+
+def _tp_jitted(mesh, kind, arch, donate, statics_items):
+    """Build (or fetch) the jitted shard_map wrapper for one TP program
+    kind. Statics are BAKED via closure (shard_map has no static-kwarg
+    channel); they live in the cache key and in the engine's AOT key
+    parts instead."""
+    key = (mesh, kind, arch, donate, statics_items)
+    fn = _TP_PROGRAMS.get(key)
+    if fn is not None:
+        return fn
+    import functools
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..text import generation as G
+
+    wspec = G._llama_tp_specs() if arch == "llama" else G._gpt_tp_specs()
+    kv = P(None, None, None, "tp", None)
+    R = P()
+    in_specs = (wspec, kv, kv) + (R,) * _TP_IN_REST[kind]
+    if kind == "decode":
+        out_specs = (R, kv, kv, R, R)
+    else:
+        out_specs = (kv, kv, R, R, R, R)
+    body = functools.partial(_TP_IMPLS[kind], **dict(statics_items))
+    sm = shard_map(body, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_rep=False)
+    fn = jax.jit(sm, donate_argnums=(1, 2) if donate else ())
+    _TP_PROGRAMS[key] = fn
+    return fn
 _PREFILL = jax.jit(_prefill_impl, static_argnames=_STATICS)
 _PREFILL_DONATED = jax.jit(_prefill_impl, static_argnames=_STATICS,
                            donate_argnums=(1, 2))
@@ -580,8 +849,16 @@ class Engine:
                  base_seed=0, donate=None, compile_budget=None,
                  default_retry_after_s=DEFAULT_RETRY_AFTER_S,
                  kv_layout="paged", block_size=16, n_blocks=None,
-                 prefill_chunk=None, prefix_sharing=True):
+                 prefill_chunk=None, prefix_sharing=True, tp=1,
+                 mesh=None):
         self._w, self._hp, geo = _make_arch(model)
+        self.tp = int(tp)
+        self._mesh = None
+        self._n_layers = geo["n_layers"]
+        if self.tp > 1:
+            mesh = self._init_tp(mesh, geo, kv_layout)
+        elif mesh is not None:
+            raise ValueError("mesh= requires tp > 1")
         self.n_slots = int(n_slots)
         self.max_len = int(max_len if max_len is not None
                            else geo["max_pos"])
@@ -626,6 +903,19 @@ class Engine:
         self._cur = np.zeros(self.n_slots, np.int32)
         self._keys = np.zeros((self.n_slots, 2), np.uint32)
         self._temps = np.ones(self.n_slots, np.float32)
+        if self.tp > 1:
+            # commit the KV pool (head dim split over tp) and the small
+            # replicated state up front so every program call sees one
+            # stable sharded signature — the AOT keys then match the
+            # save_lm precompile probes operand for operand
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            kvP = NamedSharding(mesh, P(None, None, None, "tp", None))
+            rep = NamedSharding(mesh, P())
+            self.cache.kc = jax.device_put(self.cache.kc, kvP)
+            self.cache.vc = jax.device_put(self.cache.vc, kvP)
+            self._tok = jax.device_put(self._tok, rep)
+            self._cur = jax.device_put(self._cur, rep)
+            self._keys = jax.device_put(self._keys, rep)
         # PriorityScheduler degenerates to strict FIFO when every request
         # uses the default priority and carries no deadline
         self.scheduler = PriorityScheduler(
@@ -649,7 +939,16 @@ class Engine:
         # deserializes executables instead of compiling — zero XLA
         # backend compiles for a fresh process's first token
         self._aot: dict = {}
-        if self.kv_layout == "paged":
+        if self.tp > 1:
+            arch = self._hp["arch"]
+            items = tuple(sorted(dict(self._paged_statics,
+                                      tp=self.tp).items()))
+            self._tp_statics_items = items
+            self._prefill = _tp_jitted(mesh, "prefill", arch, donate,
+                                       items)
+            self._decode = _tp_jitted(mesh, "decode", arch, donate, items)
+            self._chunk = _tp_jitted(mesh, "chunk", arch, donate, items)
+        elif self.kv_layout == "paged":
             self._prefill = (_PAGED_PREFILL_DONATED if donate
                              else _PAGED_PREFILL)
             self._decode = (_PAGED_DECODE_DONATED if donate
@@ -666,18 +965,107 @@ class Engine:
         self.buckets_seen = set()
         self.compile_budget = (None if compile_budget is None
                                else int(compile_budget))
+        self.metrics.tp = self.tp
+        if self.tp > 1:
+            g = self.tp_geometry()
+            self.metrics.kv_pool_bytes_per_device = \
+                g["kv_pool_bytes_per_device"]
+            self.metrics.collectives_per_decode_step = \
+                g["collectives_per_decode_step"]
+
+    # -- tensor parallelism -----------------------------------------------
+
+    def _init_tp(self, mesh, geo, kv_layout):
+        """Validate the tp geometry and commit the stacked weights to
+        the mesh: column-parallel qkv/gate-up, row-parallel o-/down-proj
+        (GPT: the fused qkv columns pre-permuted to device-major order),
+        vocab-sharded head, everything else replicated. Returns the
+        mesh; the engine's three programs are then shard_map SPMD
+        lowerings over it — still exactly buckets + decode (+ chunk)."""
+        from jax.sharding import NamedSharding
+
+        from ..distributed import mesh as mesh_mod
+        from ..text import generation as G
+
+        if kv_layout != "paged":
+            raise ValueError(
+                "tensor-parallel serving requires kv_layout='paged' "
+                "(the sharded pool + block-table operands)")
+        tp = self.tp
+        if mesh is None:
+            mesh = mesh_mod.build_mesh(tp=tp)
+        if dict(mesh.shape).get("tp", 1) != tp:
+            raise ValueError(
+                f"mesh tp axis {dict(mesh.shape).get('tp', 1)} != tp={tp}")
+        self._mesh = mesh
+        arch = self._hp["arch"]
+        nh, nkv = self._hp["n_heads"], self._hp["n_kv"]
+        V = int(self._w["head"].shape[-1])
+        f = int(self._w["wg"].shape[-1] if arch == "llama"
+                else self._w["wfc1"].shape[-1])
+        h = int(self._w["wq"].shape[1] if arch == "llama"
+                else self._w["wqkv"].shape[1])
+        for name, dim in (("num_attention_heads", nh),
+                          ("num_key_value_heads", nkv),
+                          ("vocab (head columns)", V),
+                          ("intermediate_size", f), ("hidden_size", h)):
+            if dim % tp:
+                raise ValueError(
+                    f"tp={tp} does not divide {name}={dim}")
+        w = dict(self._w)
+        if arch == "gpt":
+            perm = G._gpt_qkv_tp_permutation(h, tp)
+            w["wqkv"] = w["wqkv"][..., perm]
+            w["bqkv"] = w["bqkv"][..., perm]
+        specs = (G._llama_tp_specs() if arch == "llama"
+                 else G._gpt_tp_specs())
+        self._w = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+                   for k, v in w.items()}
+        return mesh
+
+    def tp_geometry(self):
+        """Mesh geometry at a glance (stats()/audit_engine/profiler):
+        tp axis size, per-device KV pool bytes, and the collective count
+        one fused decode step issues — all ppermute ring hops; an
+        undersharded or serial-collective engine is visible here before
+        it is visible in a profile. None on single-device engines."""
+        if self.tp <= 1:
+            return None
+        from ..distributed.collective_matmul import (
+            ppermutes_per_gather, ppermutes_per_rowparallel)
+        V = int(self._w["head"].shape[-1])      # jax Array shape: global
+        per_layer = 2 * ppermutes_per_rowparallel(self.tp)
+        head = ppermutes_per_gather(self.tp, V // self.tp)
+        return {
+            "tp": self.tp,
+            "devices": [str(d) for d in self._mesh.devices.flat],
+            "kv_pool_bytes_per_device": self.cache.nbytes() // self.tp,
+            "kv_heads_per_device": self.cache.kv_heads // self.tp,
+            "weight_sharding": "column(qkv/gate-up) row(o/down) "
+                               "vocab(head)",
+            "collectives_per_decode_step": (
+                self._n_layers * per_layer + head),
+            "collective_kind": "collective_permute (overlapped ring)",
+        }
 
     # -- AOT program routing ----------------------------------------------
 
     def _aot_key_parts(self, kind):
-        return ("serving", kind, self.kv_layout, self._donate,
-                _serving_code_token())
+        parts = ("serving", kind, self.kv_layout, self._donate,
+                 _serving_code_token())
+        if self.tp > 1:
+            # statics are baked into the shard_map closure (not call-site
+            # kwargs), so they pin program identity here instead
+            parts = parts + ("tp", self._tp_statics_items)
+        return parts
 
     def _run_program(self, kind, hkey, jitted, args, statics, origin):
         """Invoke one engine program through the shared compile service.
         The handle is resolved once per (kind, bucket) and cached; with
         no persistent cache configured this is a plain passthrough to
         the module-level jitted program (pre-AOT behavior)."""
+        if self.tp > 1:
+            statics = {}       # baked into the shard_map program
         h = self._aot.get(hkey)
         if h is None:
             from ..aot import get_service
@@ -710,15 +1098,32 @@ class Engine:
         mirroring the live call sites operand for operand, so the
         signatures save_lm precompiles under are exactly the ones a
         serving process looks up."""
-        def sds(a):
-            return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+        def sds(a, sharding=None):
+            return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype,
+                                        sharding=sharding)
 
         S = self.n_slots
-        w = jax.tree_util.tree_map(sds, self._w)
-        kc, vc = sds(self.cache.kc), sds(self.cache.vc)
-        tok = jax.ShapeDtypeStruct((S,), np.int32)
-        cur = jax.ShapeDtypeStruct((S,), np.int32)
-        keys = jax.ShapeDtypeStruct((S, 2), np.uint32)
+        rep = None
+        if self.tp > 1:
+            # probes must mirror the live sharded signatures (weights /
+            # pool committed to the mesh, small state replicated)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..text import generation as G
+            specs = (G._llama_tp_specs() if self._hp["arch"] == "llama"
+                     else G._gpt_tp_specs())
+            w = {k: sds(v, NamedSharding(self._mesh, specs[k]))
+                 for k, v in self._w.items()}
+            kvP = NamedSharding(self._mesh, P(None, None, None, "tp",
+                                              None))
+            kc, vc = sds(self.cache.kc, kvP), sds(self.cache.vc, kvP)
+            rep = NamedSharding(self._mesh, P())
+        else:
+            w = jax.tree_util.tree_map(sds, self._w)
+            kc, vc = sds(self.cache.kc), sds(self.cache.vc)
+        tok = jax.ShapeDtypeStruct((S,), np.int32, sharding=rep)
+        cur = jax.ShapeDtypeStruct((S,), np.int32, sharding=rep)
+        keys = jax.ShapeDtypeStruct((S, 2), np.uint32, sharding=rep)
         temps = jax.ShapeDtypeStruct((S,), np.float32)
         active = jax.ShapeDtypeStruct((S,), np.bool_)
         i32 = jax.ShapeDtypeStruct((), np.int32)
@@ -728,6 +1133,8 @@ class Engine:
             buckets = self._aot_buckets()
         specs = []
         if self.kv_layout == "paged":
+            # TP programs bake their statics into the shard_map closure
+            stat = {} if self.tp > 1 else self._paged_statics
             mb = self.cache.block_tables.shape[1]
             trow = jax.ShapeDtypeStruct((mb,), np.int32)
             tables = jax.ShapeDtypeStruct((S, mb), np.int32)
@@ -737,11 +1144,11 @@ class Engine:
                     "prefill", ("prefill", int(Lb)), self._prefill,
                     (w, kc, vc, tok, cur, keys, ids, i32, i32, u32, i32,
                      f32, trow, i32),
-                    self._paged_statics, f"prefill:L{Lb}"))
+                    stat, f"prefill:L{Lb}"))
             specs.append((
                 "decode", ("decode",), self._decode,
                 (w, kc, vc, tables, tok, cur, active, keys, temps),
-                self._paged_statics, "decode"))
+                stat, "decode"))
             if self.prefill_chunk is not None:
                 ids = jax.ShapeDtypeStruct((1, self.prefill_chunk),
                                            np.int32)
@@ -749,7 +1156,7 @@ class Engine:
                     "chunk", ("chunk",), self._chunk,
                     (w, kc, vc, tok, cur, keys, ids, i32, i32, i32, trow,
                      i32, i32, u32, i32, f32),
-                    self._paged_statics, "chunk"))
+                    stat, "chunk"))
         else:
             for Lb in buckets:
                 ids = jax.ShapeDtypeStruct((1, int(Lb)), np.int32)
@@ -1307,4 +1714,7 @@ class Engine:
             out.update(self.cache.pool_stats())
             out["prefill_chunk"] = self.prefill_chunk
             out["prefix_sharing"] = self.prefix_sharing
+        out["tp"] = self.tp
+        if self.tp > 1:
+            out["mesh"] = self.tp_geometry()
         return out

@@ -100,6 +100,12 @@ class EngineMetrics:
         self.pool_occupancy_sum = 0.0  # used/total blocks per sample
         self.pool_samples = 0
         self.pool_low_watermark = None  # min free blocks ever seen
+        # mesh geometry (stamped by the engine; tp=1 on single-device
+        # engines) — surfaces underscoring at a glance in the profiler
+        # serving line and the snapshot
+        self.tp = 1
+        self.kv_pool_bytes_per_device = None
+        self.collectives_per_decode_step = None
         # decode-step wall times, histogram-backed: the ~64-observation
         # rolling window drives the live ITL p50/p95 behind
         # EngineOverloaded.retry_after_s and brownout shedding, while
@@ -183,6 +189,10 @@ class EngineMetrics:
                                 else round(itl * 1e3, 3)),
             "itl_p95_ms": (None if p95 is None
                            else round(p95 * 1e3, 3)),
+            "tp": self.tp,
+            "kv_pool_bytes_per_device": self.kv_pool_bytes_per_device,
+            "collectives_per_decode_step":
+                self.collectives_per_decode_step,
         }
 
 
@@ -204,7 +214,7 @@ def global_counters():
         "preemptions": 0, "chunked_prefills": 0, "chunk_steps": 0,
         "prefix_hit_tokens": 0, "prompt_tokens": 0, "cow_copies": 0,
         "peak_active": 0, "prefix_hit_rate": None,
-        "pool_low_watermark": None,
+        "pool_low_watermark": None, "tp_max": 1,
     }
     live = []
     for ref in _ENGINES:
@@ -224,6 +234,7 @@ def global_counters():
         total["peak_queue_depth"] = max(total["peak_queue_depth"],
                                         s["peak_queue_depth"])
         total["peak_active"] = max(total["peak_active"], s["peak_active"])
+        total["tp_max"] = max(total["tp_max"], s.get("tp", 1))
         if s["pool_low_watermark"] is not None:
             lw = total["pool_low_watermark"]
             total["pool_low_watermark"] = (

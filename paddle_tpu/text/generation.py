@@ -470,6 +470,290 @@ def _gpt_chunk_layer(x, lw, kc_pool, vc_pool, table_row, gpos, wdest, *,
 
 
 # ---------------------------------------------------------------------------
+# tensor-parallel bodies (serving engine, paged KV): the SAME math as the
+# single-device bodies above with the weights column-/row-parallel over a
+# "tp" mesh axis — each device computes its head/column shard locally and
+# the two row-parallel projections (o-proj, down-proj) reassemble the
+# replicated activations through ppermute-pipelined collective-matmuls
+# (distributed.collective_matmul), so no collective serializes after a
+# dot. These run INSIDE shard_map: every weight leaf and the KV pool
+# arrive as LOCAL shards; activations between layers stay replicated.
+# ---------------------------------------------------------------------------
+
+from ..distributed.collective_matmul import (matmul_allgather,  # noqa: E402
+                                             ring_rowparallel_matmul)
+
+_TP_AXIS = "tp"
+
+
+def _llama_tp_specs():
+    """PartitionSpec per stacked-Llama weight key over the ``tp`` axis:
+    column-parallel QKV/gate-up (output dim sharded), row-parallel
+    o-/down-proj (contraction dim sharded), vocab-sharded head; norms
+    and the embedding table replicated."""
+    from jax.sharding import PartitionSpec as P
+    col, row = P(None, None, _TP_AXIS), P(None, _TP_AXIS, None)
+    return {"wq": col, "wk": col, "wv": col, "wg": col, "wu": col,
+            "wo": row, "wd": row, "ln1": P(), "ln2": P(),
+            "embed": P(), "norm": P(), "head": P(None, _TP_AXIS)}
+
+
+def _gpt_tp_specs():
+    """GPT weight placement: the fused qkv columns are pre-permuted to
+    device-major ``[q_d | k_d | v_d]`` order (see
+    :func:`_gpt_qkv_tp_permutation`) so a contiguous tp shard carries
+    one head-slice of each of q, k, v; row-parallel proj/fc2 biases stay
+    replicated (added once, after the reduce)."""
+    from jax.sharding import PartitionSpec as P
+    col, row = P(None, None, _TP_AXIS), P(None, _TP_AXIS, None)
+    return {"wqkv": col, "bqkv": P(None, _TP_AXIS),
+            "wproj": row, "bproj": P(),
+            "wfc1": col, "bfc1": P(None, _TP_AXIS),
+            "wfc2": row, "bfc2": P(),
+            "ln1w": P(), "ln1b": P(), "ln2w": P(), "ln2b": P(),
+            "wte": P(), "wpe": P(), "lnfw": P(), "lnfb": P(),
+            "head": P(None, _TP_AXIS)}
+
+
+def _gpt_qkv_tp_permutation(h, tp):
+    """Column permutation mapping the fused ``[q | k | v]`` qkv layout to
+    device-major ``[q_0 k_0 v_0 | q_1 k_1 v_1 | ...]``: sharding the
+    permuted last dim over ``tp`` then hands each device its own head
+    slice of all three projections as one contiguous block."""
+    import numpy as np
+    hc = h // tp
+    idx = []
+    for d in range(tp):
+        for blk in range(3):
+            idx.append(np.arange(blk * h + d * hc, blk * h + (d + 1) * hc))
+    return np.concatenate(idx)
+
+
+def _llama_prefill_layer_tp(x, lw, pos, *, n_heads, n_kv, eps, theta, tp):
+    """TP variant of :func:`_llama_prefill_layer`: local head shards for
+    attention, collective-matmul for the o-projection and down-proj.
+    Returns (x_replicated, (k_local, v_local)) — k/v carry this device's
+    ``n_kv // tp`` head shard for the sharded KV pool."""
+    B, L, h = x.shape
+    hd = h // n_heads
+    hl, kvl = n_heads // tp, n_kv // tp
+    dt = x.dtype
+    h1 = _rms(x, lw["ln1"], eps)
+    q = (h1 @ lw["wq"]).reshape(B, L, hl, hd)
+    k = (h1 @ lw["wk"]).reshape(B, L, kvl, hd)
+    v = (h1 @ lw["wv"]).reshape(B, L, kvl, hd)
+    q, k = _rope(q, k, pos, theta, dt)
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.repeat(jnp.swapaxes(k, 1, 2), n_heads // n_kv, axis=1)
+    vh = jnp.repeat(jnp.swapaxes(v, 1, 2), n_heads // n_kv, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(hd))
+    cm = jnp.tril(jnp.ones((L, L), bool))
+    s = jnp.where(cm, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    o = jnp.swapaxes(o, 1, 2).reshape(B, L, h // tp)
+    x = x + ring_rowparallel_matmul(o, lw["wo"], _TP_AXIS, tp)
+    h2 = _rms(x, lw["ln2"], eps)
+    act = jax.nn.silu(h2 @ lw["wg"]) * (h2 @ lw["wu"])
+    x = x + ring_rowparallel_matmul(act, lw["wd"], _TP_AXIS, tp)
+    return x, (k, v)
+
+
+def _llama_decode_layer_paged_tp(xt, lw, kc_pool, vc_pool, tables, dest,
+                                 write_pos, rope_pos, *, n_heads, n_kv,
+                                 eps, theta, block_size, tp):
+    """TP variant of :func:`_llama_decode_layer_paged`: the pool shards
+    hold this device's kv-head slice, attention runs over the local head
+    group, and the o-/down-projections are overlapped collective-matmuls
+    (the activations they produce are replicated for the next layer)."""
+    S = xt.shape[0]
+    h = xt.shape[-1]
+    hd = h // n_heads
+    hl, kvl = n_heads // tp, n_kv // tp
+    dt = xt.dtype
+    h1 = _rms(xt, lw["ln1"], eps)
+    q = (h1 @ lw["wq"]).reshape(S, 1, hl, hd)
+    k = (h1 @ lw["wk"]).reshape(S, 1, kvl, hd)
+    v = (h1 @ lw["wv"]).reshape(S, 1, kvl, hd)
+    q, k = _rope_rows(q, k, rope_pos, theta, dt)
+    nb, bs = kc_pool.shape[0], kc_pool.shape[1]
+    kc_pool = kc_pool.reshape(nb * bs, kvl, hd).at[dest].set(
+        k[:, 0]).reshape(nb, bs, kvl, hd)
+    vc_pool = vc_pool.reshape(nb * bs, kvl, hd).at[dest].set(
+        v[:, 0]).reshape(nb, bs, kvl, hd)
+    kview = _paged_view(kc_pool, tables, block_size)   # [S, T, kvl, hd]
+    vview = _paged_view(vc_pool, tables, block_size)
+    kh = jnp.repeat(kview, n_heads // n_kv, axis=2)
+    vh = jnp.repeat(vview, n_heads // n_kv, axis=2)
+    s = jnp.einsum("bhd,bthd->bht", q[:, 0], kh,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(hd))
+    T = kview.shape[1]
+    valid = jnp.arange(T)[None, :] <= write_pos[:, None]
+    s = jnp.where(valid[:, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    o = jnp.einsum("bht,bthd->bhd", p, vh).reshape(S, 1, h // tp)
+    xt2 = xt + ring_rowparallel_matmul(o, lw["wo"], _TP_AXIS, tp)
+    h2 = _rms(xt2, lw["ln2"], eps)
+    act = jax.nn.silu(h2 @ lw["wg"]) * (h2 @ lw["wu"])
+    xt2 = xt2 + ring_rowparallel_matmul(act, lw["wd"], _TP_AXIS, tp)
+    return xt2, kc_pool, vc_pool
+
+
+def _llama_chunk_layer_tp(x, lw, kc_pool, vc_pool, table_row, gpos, wdest,
+                          *, n_heads, n_kv, eps, theta, block_size, tp):
+    """TP variant of :func:`_llama_chunk_layer` (one prefill chunk of
+    one slot against the sharded pool)."""
+    B, C, h = x.shape
+    hd = h // n_heads
+    hl, kvl = n_heads // tp, n_kv // tp
+    dt = x.dtype
+    h1 = _rms(x, lw["ln1"], eps)
+    q = (h1 @ lw["wq"]).reshape(B, C, hl, hd)
+    k = (h1 @ lw["wk"]).reshape(B, C, kvl, hd)
+    v = (h1 @ lw["wv"]).reshape(B, C, kvl, hd)
+    q, k = _rope(q, k, gpos, theta, dt)
+    nb, bs = kc_pool.shape[0], kc_pool.shape[1]
+    kc_pool = kc_pool.reshape(nb * bs, kvl, hd).at[wdest].set(
+        k[0]).reshape(nb, bs, kvl, hd)
+    vc_pool = vc_pool.reshape(nb * bs, kvl, hd).at[wdest].set(
+        v[0]).reshape(nb, bs, kvl, hd)
+    kview = _paged_view(kc_pool, table_row[None], block_size)
+    vview = _paged_view(vc_pool, table_row[None], block_size)
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.repeat(jnp.swapaxes(kview, 1, 2), n_heads // n_kv, axis=1)
+    vh = jnp.repeat(jnp.swapaxes(vview, 1, 2), n_heads // n_kv, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(hd))
+    T = kview.shape[1]
+    cm = jnp.arange(T)[None, :] <= gpos[:, None]
+    s = jnp.where(cm[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    o = jnp.swapaxes(o, 1, 2).reshape(B, C, h // tp)
+    x = x + ring_rowparallel_matmul(o, lw["wo"], _TP_AXIS, tp)
+    h2 = _rms(x, lw["ln2"], eps)
+    act = jax.nn.silu(h2 @ lw["wg"]) * (h2 @ lw["wu"])
+    x = x + ring_rowparallel_matmul(act, lw["wd"], _TP_AXIS, tp)
+    return x, kc_pool, vc_pool
+
+
+def _gpt_prefill_layer_tp(x, lw, *, n_heads, tp):
+    """TP variant of :func:`_gpt_prefill_layer` (device-major permuted
+    qkv shard; row-parallel proj/fc2 add their bias after the reduce)."""
+    B, L, h = x.shape
+    hd = h // n_heads
+    hl = n_heads // tp
+    dt = x.dtype
+    hN = _ln(x, lw["ln1w"], lw["ln1b"])
+    qkv = hN @ lw["wqkv"] + lw["bqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, L, hl, hd)
+    k = k.reshape(B, L, hl, hd)
+    v = v.reshape(B, L, hl, hd)
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(hd))
+    cm = jnp.tril(jnp.ones((L, L), bool))
+    s = jnp.where(cm, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    o = jnp.swapaxes(o, 1, 2).reshape(B, L, h // tp)
+    x = x + ring_rowparallel_matmul(o, lw["wproj"], _TP_AXIS, tp) \
+        + lw["bproj"]
+    h2 = _ln(x, lw["ln2w"], lw["ln2b"])
+    act = jax.nn.gelu(h2 @ lw["wfc1"] + lw["bfc1"], approximate=False)
+    x = x + ring_rowparallel_matmul(act, lw["wfc2"], _TP_AXIS, tp) \
+        + lw["bfc2"]
+    return x, (k, v)
+
+
+def _gpt_decode_layer_paged_tp(xt, lw, kc_pool, vc_pool, tables, dest,
+                               write_pos, *, n_heads, block_size, tp):
+    """TP variant of :func:`_gpt_decode_layer_paged`."""
+    S = xt.shape[0]
+    h = xt.shape[-1]
+    hd = h // n_heads
+    hl = n_heads // tp
+    dt = xt.dtype
+    hN = _ln(xt, lw["ln1w"], lw["ln1b"])
+    qkv = hN @ lw["wqkv"] + lw["bqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(S, 1, hl, hd)
+    k = k.reshape(S, 1, hl, hd)
+    v = v.reshape(S, 1, hl, hd)
+    nb, bs = kc_pool.shape[0], kc_pool.shape[1]
+    kc_pool = kc_pool.reshape(nb * bs, hl, hd).at[dest].set(
+        k[:, 0]).reshape(nb, bs, hl, hd)
+    vc_pool = vc_pool.reshape(nb * bs, hl, hd).at[dest].set(
+        v[:, 0]).reshape(nb, bs, hl, hd)
+    kview = _paged_view(kc_pool, tables, block_size)
+    vview = _paged_view(vc_pool, tables, block_size)
+    s = jnp.einsum("bhd,bthd->bht", q[:, 0], kview,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(hd))
+    T = kview.shape[1]
+    valid = jnp.arange(T)[None, :] <= write_pos[:, None]
+    s = jnp.where(valid[:, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    o = jnp.einsum("bht,bthd->bhd", p, vview).reshape(S, 1, h // tp)
+    xt2 = xt + ring_rowparallel_matmul(o, lw["wproj"], _TP_AXIS, tp) \
+        + lw["bproj"]
+    h2 = _ln(xt2, lw["ln2w"], lw["ln2b"])
+    act = jax.nn.gelu(h2 @ lw["wfc1"] + lw["bfc1"], approximate=False)
+    xt2 = xt2 + ring_rowparallel_matmul(act, lw["wfc2"], _TP_AXIS, tp) \
+        + lw["bfc2"]
+    return xt2, kc_pool, vc_pool
+
+
+def _gpt_chunk_layer_tp(x, lw, kc_pool, vc_pool, table_row, gpos, wdest,
+                        *, n_heads, block_size, tp):
+    """TP variant of :func:`_gpt_chunk_layer`."""
+    B, C, h = x.shape
+    hd = h // n_heads
+    hl = n_heads // tp
+    dt = x.dtype
+    hN = _ln(x, lw["ln1w"], lw["ln1b"])
+    qkv = hN @ lw["wqkv"] + lw["bqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, C, hl, hd)
+    k = k.reshape(B, C, hl, hd)
+    v = v.reshape(B, C, hl, hd)
+    nb, bs = kc_pool.shape[0], kc_pool.shape[1]
+    kc_pool = kc_pool.reshape(nb * bs, hl, hd).at[wdest].set(
+        k[0]).reshape(nb, bs, hl, hd)
+    vc_pool = vc_pool.reshape(nb * bs, hl, hd).at[wdest].set(
+        v[0]).reshape(nb, bs, hl, hd)
+    kview = _paged_view(kc_pool, table_row[None], block_size)
+    vview = _paged_view(vc_pool, table_row[None], block_size)
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(kview, 1, 2)
+    vh = jnp.swapaxes(vview, 1, 2)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(hd))
+    T = kview.shape[1]
+    cm = jnp.arange(T)[None, :] <= gpos[:, None]
+    s = jnp.where(cm[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    o = jnp.swapaxes(o, 1, 2).reshape(B, C, h // tp)
+    x = x + ring_rowparallel_matmul(o, lw["wproj"], _TP_AXIS, tp) \
+        + lw["bproj"]
+    h2 = _ln(x, lw["ln2w"], lw["ln2b"])
+    act = jax.nn.gelu(h2 @ lw["wfc1"] + lw["bfc1"], approximate=False)
+    x = x + ring_rowparallel_matmul(act, lw["wfc2"], _TP_AXIS, tp) \
+        + lw["bfc2"]
+    return x, kc_pool, vc_pool
+
+
+# ---------------------------------------------------------------------------
 # beam search (Llama decoder)
 # ---------------------------------------------------------------------------
 

@@ -341,6 +341,33 @@ class TestAstRules:
         r2 = _lint_src(tmp_path, allowed, name="mod2.py")
         assert not r2.by_rule("dtype-promotion")
 
+    def test_unoverlapped_collective_ast_positive(self, tmp_path):
+        src = ("import jax\n"
+               "def rowpar(x, w):\n"
+               "    return jax.lax.psum(x @ w, 'tp')\n"
+               "def gathered(x, w):\n"
+               "    return jax.lax.all_gather(jax.numpy.matmul(x, w),"
+               " 'tp')\n")
+        r = _lint_src(tmp_path, src)
+        found = r.by_rule("unoverlapped-collective")
+        assert len(found) == 2
+        assert all(f.severity == "high" for f in found)
+
+    def test_unoverlapped_collective_ast_negative_and_allow(
+            self, tmp_path):
+        src = ("import jax\n"
+               "def sync(g):\n"
+               "    return jax.lax.psum(g, 'dp')\n"       # no dot inside
+               "def overlapped(o, w):\n"
+               "    from paddle_tpu.distributed.collective_matmul "
+               "import ring_rowparallel_matmul\n"
+               "    return ring_rowparallel_matmul(o, w, 'tp', 4)\n"
+               "def reference(x, w):\n"
+               "    # tpu_lint: allow(unoverlapped-collective) — A/B\n"
+               "    return jax.lax.psum(x @ w, 'tp')\n")
+        r = _lint_src(tmp_path, src)
+        assert not r.by_rule("unoverlapped-collective")
+
 
 # ---------------------------------------------------------------------------
 # e2e audits (acceptance criteria) + legacy-checker parity

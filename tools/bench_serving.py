@@ -15,14 +15,21 @@ tools/bench_eager.py):
   TTFT/ITL p50/p95, prefix hit rate. The paged pool must admit >= 2x
   the concurrency (equivalently <= 1/2 the KV bytes/token) at equal
   quality (token-identical outputs across arms).
+- ``--tp 1 2 4`` adds a tensor-parallel sweep (virtual devices on CPU,
+  real chips on TPU): the same workload through a tp=N engine per
+  degree, recording tokens/sec, TTFT/ITL p50/p95, the per-decode-step
+  collective count and token parity vs tp=1 — the ledger line carries
+  the registry snapshot + compiles_by_origin so compile-budget drift
+  across tp degrees is visible offline.
 
 ok requires the best engine arm to beat sequential throughput on the
-same workload AND the paged arm to hit the 2x prefix-reuse bar.
+same workload AND the paged arm to hit the 2x prefix-reuse bar (AND
+every tp arm to stay token-identical when --tp is given).
 Warm programs only: every arm runs the workload once to compile, then
 measures a second identical run.
 
 Usage: JAX_PLATFORMS=cpu python tools/bench_serving.py [--requests N]
-       [--skip-prefix-sweep]
+       [--skip-prefix-sweep] [--tp 1 2 4]
 """
 import argparse
 import json
@@ -103,6 +110,57 @@ def prefix_reuse_sweep(model, cfg, *, n_requests=24, max_new=8,
     }
 
 
+def tp_sweep(model, cfg, prompts, tp_degrees, *, max_new=8, n_slots=4,
+             max_len=64):
+    """Tensor-parallel A/B on the live device set: the same workload
+    through one engine per tp degree (tp=1 is the baseline), warm-run
+    timed. Records tokens/sec and the TTFT/ITL ledger per degree, the
+    engine's mesh geometry (collectives per decode step, per-device KV
+    pool bytes) and token parity vs the tp=1 arm — the honest "did
+    sharding buy anything and did it stay correct" table."""
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.serving import Engine, ledger
+
+    total_new = len(prompts) * max_new
+    arms = []
+    base_tokens = None
+    for tp in tp_degrees:
+        kw = {} if tp == 1 else {"tp": tp}
+        eng = Engine(model, n_slots=n_slots, max_len=max_len,
+                     min_prompt_bucket=8, **kw)
+        eng.generate_all(prompts, max_new_tokens=max_new)      # warm
+        eng2 = Engine(model, n_slots=n_slots, max_len=max_len,
+                      min_prompt_bucket=8, **kw)
+        t0 = time.perf_counter()
+        handles = eng2.generate_all(prompts, max_new_tokens=max_new)
+        wall = time.perf_counter() - t0
+        toks = [list(h.tokens) for h in handles]
+        if base_tokens is None:
+            base_tokens = toks
+        led = ledger(handles)
+        st = eng2.stats()
+        led.update({
+            "tp": tp, "wall_s": round(wall, 3),
+            "tokens_per_sec": round(total_new / wall, 2),
+            "mesh": st.get("mesh"),
+            "token_identical_vs_tp1": toks == base_tokens,
+        })
+        arms.append(led)
+    return {
+        "degrees": list(tp_degrees),
+        "arms": arms,
+        "token_identical": all(a["token_identical_vs_tp1"]
+                               for a in arms),
+        "tokens_per_sec_by_tp": {a["tp"]: a["tokens_per_sec"]
+                                 for a in arms},
+        "itl_ms_p50_by_tp": {a["tp"]: a["itl_ms_p50"] for a in arms},
+        "ok": all(a["token_identical_vs_tp1"] for a in arms),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=16)
@@ -111,7 +169,18 @@ def main():
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--hidden", type=int, default=256)
     ap.add_argument("--skip-prefix-sweep", action="store_true")
+    ap.add_argument("--tp", type=int, nargs="+", default=None,
+                    help="tensor-parallel degrees to sweep (virtual "
+                         "devices on CPU; must divide the head counts)")
     args = ap.parse_args()
+
+    if args.tp and max(args.tp) > 1:
+        # virtual devices must be forced before the first jax import
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{max(args.tp)}").strip()
 
     import numpy as np
 
@@ -169,6 +238,12 @@ def main():
         prefix = prefix_reuse_sweep(model, cfg)
         ok = ok and prefix["ok"]
 
+    tp = None
+    if args.tp:
+        tp = tp_sweep(model, cfg, prompts, args.tp,
+                      max_new=args.max_new)
+        ok = ok and tp["ok"]
+
     # ride-along registry scrape: the ledger line carries the full
     # metrics state of the run (ITL histogram, compile attribution,
     # pool/prefix counters) for offline diffing
@@ -187,6 +262,7 @@ def main():
         "best_n_slots": best["n_slots"],
         "speedup_vs_sequential": round(best["tokens_per_sec"] / seq_tps, 2),
         "prefix_reuse": prefix,
+        "tp_sweep": tp,
         "observability": obs.snapshot(),
         "compiles_by_origin": obs.compiles_by_origin(),
         "ok": ok,

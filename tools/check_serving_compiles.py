@@ -20,10 +20,20 @@ process drives the whole workload with 0 cold XLA backend compiles
 budget once the persistent executable cache lands (without this mode a
 warm cache would read as a spurious budget pass/violation).
 
+``--mesh N`` is the tensor-parallel contract: N virtual CPU devices, the
+same workload through a single-device engine and a tp=N engine. The TP
+engine must compile exactly its declared budget (buckets + decode —
+shard_map SPMD programs count once each), do 0 warm compiles, emit
+token-identical output to the single-device engine AND batch
+``generate()``, and its lowered decode HLO must carry 0 high
+``unoverlapped-collective`` findings while a seeded serial
+``psum(x @ w)`` program IS caught by the same rule.
+
 Modeled on tools/check_retrace.py. Usage:
 
     JAX_PLATFORMS=cpu python tools/check_serving_compiles.py [--json]
     JAX_PLATFORMS=cpu python tools/check_serving_compiles.py --warm-cache
+    JAX_PLATFORMS=cpu python tools/check_serving_compiles.py --mesh 4
 """
 import argparse
 import json
@@ -76,6 +86,170 @@ def run_warm_cache(args):
     return 0 if ok else 1
 
 
+def run_mesh(args):
+    """Tensor-parallel serving contract on a virtual-device mesh: the
+    TP engine compiles exactly its budget, recompiles nothing warm, and
+    stays token-identical to the single-device engine (greedy AND
+    sampled, including one adopt()-replayed request) — with the decode
+    HLO overlap-verified by the unoverlapped-collective rule."""
+    import dataclasses
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from paddle_tpu.serving import Engine
+    from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+    tp = args.mesh
+    counter = analysis.CompileEventCounter().install()
+    have_monitor = counter.available
+
+    cfg = dataclasses.replace(LLAMA_TINY, dtype="float32",
+                              num_hidden_layers=2)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    lens = [5 + (i % 8) for i in range(args.requests)]
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in lens]
+    new_tokens = [3 + (i % (args.max_new - 2))
+                  for i in range(args.requests)]
+    min_bucket = 8
+    n_buckets = len({max(min_bucket, 1 << (n - 1).bit_length())
+                     for n in lens})
+    budget = n_buckets + 1
+
+    def drive(engine, sampled=False):
+        handles = []
+        for i in range(args.requests):
+            if i >= 3:
+                engine.step()
+            handles.append(engine.submit(
+                prompts[i], max_new_tokens=new_tokens[i],
+                temperature=0.9 if sampled else 1.0, seed=100 + i))
+        engine.drain()
+        return handles
+
+    record = {"bench": "serving_tp_mesh", "tp": tp,
+              "requests": args.requests, "compile_budget": budget}
+    arms = {}
+    for label, kw, sampled in (
+            ("single_greedy", {}, False),
+            ("tp_greedy", {"tp": tp}, False),
+            ("single_sampled", {"do_sample": True, "top_k": 8}, True),
+            ("tp_sampled", {"tp": tp, "do_sample": True, "top_k": 8},
+             True)):
+        engine = Engine(model, n_slots=args.slots, max_len=64,
+                        min_prompt_bucket=min_bucket,
+                        compile_budget=budget, **kw)
+        counter.reset()
+        handles = drive(engine, sampled)
+        cold = counter.count
+        counter.reset()
+        handles2 = drive(engine, sampled)
+        warm = counter.count
+        arms[label] = {
+            "cold_compiles": cold if have_monitor else None,
+            "warm_compiles": warm if have_monitor else None,
+            "tokens": [list(h.tokens) for h in handles],
+            "tokens2": [list(h.tokens) for h in handles2],
+            "engine": engine, "stats": engine.stats()}
+
+    # one adopt()-replayed request on a rebuilt TP engine mid-decode
+    eng_a = Engine(model, n_slots=args.slots, max_len=64,
+                   min_prompt_bucket=min_bucket, tp=tp)
+    h = eng_a.submit(prompts[0], max_new_tokens=8, seed=7)
+    for _ in range(3):
+        eng_a.step()
+    eng_a._condemned = True
+    counter.reset()
+    eng_b = Engine(model, n_slots=args.slots, max_len=64,
+                   min_prompt_bucket=min_bucket, tp=tp)
+    eng_b.adopt(h)
+    h.result()
+    adopt_compiles = counter.count
+    base = Engine(model, n_slots=args.slots, max_len=64,
+                  min_prompt_bucket=min_bucket).generate_all(
+        [prompts[0]], max_new_tokens=8, seed=7)[0]
+
+    greedy_parity = arms["tp_greedy"]["tokens"] == \
+        arms["single_greedy"]["tokens"] == arms["single_greedy"]["tokens2"]
+    sampled_parity = arms["tp_sampled"]["tokens"] == \
+        arms["single_sampled"]["tokens"]
+    gen_parity = all(
+        np.array_equal(
+            np.asarray(t, np.int32),
+            np.asarray(model.generate(
+                paddle.to_tensor(p[None]), max_new_tokens=n)._data)
+            [0, len(p):])
+        for t, p, n in zip(arms["tp_greedy"]["tokens"], prompts,
+                           new_tokens))
+
+    # overlap evidence: 0 high unoverlapped-collective findings on the
+    # REAL TP decode HLO, while a seeded serial psum(x @ w) is caught
+    rep = analysis.audit_engine(arms["tp_greedy"]["engine"])
+    tp_high = [f for f in rep.findings
+               if f.rule_id == "unoverlapped-collective"
+               and f.severity == "high"]
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.collective_matmul import \
+        serial_rowparallel_matmul
+    mesh = mesh_mod.build_mesh(tp=tp)
+    seeded = shard_map(
+        lambda a, b: serial_rowparallel_matmul(a, b, "tp"), mesh=mesh,
+        in_specs=(P(None, "tp"), P("tp", None)), out_specs=P(),
+        check_rep=False)
+    srep = analysis.audit(
+        seeded, np.zeros((4, 8 * tp), np.float32),
+        np.zeros((8 * tp, 16 * tp), np.float32), name="seeded-serial")
+    seeded_caught = any(f.rule_id == "unoverlapped-collective"
+                        and f.severity == "high" for f in srep.findings)
+
+    budgets_ok = not have_monitor or all(
+        arms[a]["cold_compiles"] <= budget
+        and arms[a]["warm_compiles"] == 0
+        for a in arms)
+    ok = bool(budgets_ok and greedy_parity and sampled_parity
+              and gen_parity and h.tokens == list(base.tokens)
+              and (not have_monitor or adopt_compiles == 0)
+              and not tp_high and seeded_caught)
+    for a in arms:
+        arms[a].pop("engine")
+        arms[a].pop("tokens")
+        arms[a].pop("tokens2")
+    record.update({
+        "arms": arms, "greedy_parity": greedy_parity,
+        "sampled_parity": sampled_parity,
+        "generate_parity": gen_parity,
+        "adopt_parity": h.tokens == list(base.tokens),
+        "adopt_warm_compiles": adopt_compiles if have_monitor else None,
+        "unoverlapped_high_on_tp_decode": len(tp_high),
+        "decode_collective_metrics": rep.metrics.get(
+            "unoverlapped-collective"),
+        "seeded_serial_caught": seeded_caught, "ok": ok})
+    if args.json:
+        print(json.dumps(record))
+    else:
+        print(f"tp={tp} compile budget {budget}")
+        for a, r in arms.items():
+            print(f"  {a}: cold={r['cold_compiles']} "
+                  f"warm={r['warm_compiles']}")
+        print(f"parity greedy={greedy_parity} sampled={sampled_parity} "
+              f"generate={gen_parity} adopt={record['adopt_parity']}")
+        print(f"unoverlapped high on TP decode: {len(tp_high)}  "
+              f"seeded serial caught: {seeded_caught}")
+        print("OK (TP serving contract holds)" if ok else
+              "FAIL: TP engine recompiles, diverges, or serializes "
+              "collectives")
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", action="store_true", help="emit a JSON line")
@@ -85,7 +259,19 @@ def main():
     ap.add_argument("--warm-cache", action="store_true",
                     help="subprocess-pair AOT cache gate: the second "
                          "process must do 0 cold backend compiles")
+    ap.add_argument("--mesh", type=int, default=0, metavar="N",
+                    help="tensor-parallel mode: N virtual devices, "
+                         "tp=N engine vs single-device parity + budget")
     args = ap.parse_args()
+
+    if args.mesh:
+        # must win before the first jax import in this process
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{args.mesh}").strip()
+        return run_mesh(args)
 
     if args.warm_cache:
         return run_warm_cache(args)
