@@ -681,6 +681,29 @@ def bench_serving_tp(backend):
                     n_slots=8, max_len=256)
 
 
+def bench_multichip_commopt(backend):
+    """Comm-efficient multichip training A/B (ROADMAP item 2): exact vs
+    bf16 vs int8 gradient exchange (error feedback on), ZeRO-1 on/off,
+    and overlapped-vs-serial TP training matmuls through the comm-opt
+    train step. Records per-arm step time, wire bytes + compression
+    ratio, HLO collective profiles and the ``unoverlapped-collective``
+    verdicts; ok requires bitwise ZeRO-1 parity, int8 loss tracking, and
+    a clean overlap audit. The ledger lives in tools/bench_commopt.py
+    (``commopt_sweep``), which doubles as the 8-virtual-CPU-device
+    dryrun — this arm reuses it verbatim on whatever mesh is up, so it
+    runs as a dryrun (not tpu-only) wherever >= 8 devices exist."""
+    import jax
+    if len(jax.devices()) < 8:
+        return {"skipped": "needs >= 8 devices (dp=4 x tp=2 sweep)"}
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    try:
+        from bench_commopt import commopt_sweep
+        return commopt_sweep(steps=24)
+    finally:
+        sys.path.pop(0)
+
+
 def bench_ctr_widedeep(backend):
     """Recsys/PS-analog throughput: wide&deep CTR over a 1M-row sharded
     embedding table (single chip: table replicated-equivalent), lazy-row
@@ -1001,6 +1024,7 @@ def main():
                          ("serving_engine", bench_serving),
                          ("serving_paged", bench_serving_paged),
                          ("serving_tp", bench_serving_tp),
+                         ("multichip_commopt", bench_multichip_commopt),
                          ("coldstart", bench_coldstart),
                          ("flash_blocks", bench_flash_blocks)):
             if only and name not in only:

@@ -84,7 +84,8 @@ StableHLO, ``.jaxpr``, ``.meta``), AST rules a
 """
 from .audit import (  # noqa: F401
     ProgramView, audit, audit_dispatch, audit_engine, audit_model,
-    audit_plan, audit_stablehlo, findings_summary, selflint,
+    audit_plan, audit_stablehlo, audit_train_step, findings_summary,
+    selflint,
 )
 from .findings import (  # noqa: F401
     SEVERITIES, Finding, Report, parse_allowlist, severity_rank,
@@ -94,7 +95,8 @@ from .registry import iter_rules, rule, rules_table  # noqa: F401
 
 __all__ = [
     "ProgramView", "audit", "audit_dispatch", "audit_engine",
-    "audit_model", "audit_plan", "audit_stablehlo", "findings_summary",
+    "audit_model", "audit_plan", "audit_stablehlo", "audit_train_step",
+    "findings_summary",
     "selflint", "SEVERITIES", "Finding", "Report", "parse_allowlist",
     "severity_rank", "CompileEventCounter", "iter_rules", "rule",
     "rules_table",

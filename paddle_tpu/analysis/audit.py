@@ -196,11 +196,17 @@ def _describe_entry(e):
         return "host entry"
 
 
-def audit_plan(plan_or_program, rules=None, name="replay_plan") -> Report:
+def audit_plan(plan_or_program, *batch, rules=None,
+               name="replay_plan") -> Report:
     """Audit a static-executor replay plan (or every cached plan of a
-    ``static.Program``): host splits, donation, fragmentation."""
+    ``static.Program``): host splits, donation, fragmentation. A Fleet
+    train step (anything exposing ``lower_hlo``) delegates to
+    :func:`audit_train_step`, so the one entry point covers both
+    compiled-training front ends."""
     from ..static.program import _ReplayPlan
 
+    if hasattr(plan_or_program, "lower_hlo"):
+        return audit_train_step(plan_or_program, *batch, rules=rules)
     if not isinstance(plan_or_program, _ReplayPlan):
         cache = getattr(plan_or_program, "_jit_cache", None) or {}
         plans = [p for p in cache.values() if p is not None]
@@ -232,6 +238,34 @@ def audit_plan(plan_or_program, rules=None, name="replay_plan") -> Report:
             # the donation finding on top of the host-split finding
             "segmented": len(segments) > 1}
     return ProgramView(name, "plan", meta=meta).run_rules(rules)
+
+
+def audit_train_step(step, *batch, rules=None) -> Report:
+    """Audit a compiled Fleet train step (``CompiledTrainStep`` or
+    ``distributed.comm_opt.CommOptTrainStep``) on an example batch: the
+    REAL step program — forward, backward, gradient exchange and the
+    optimizer update — is lowered and every program rule runs over its
+    StableHLO. The ``unoverlapped-collective`` rule is the headline:
+    a TP training matmul whose collective serializes after the dot
+    (the GSPMD/serial form) is a high finding here, exactly like
+    ``audit_engine`` gates the serving decode program."""
+    meta = {"train_step": type(step).__name__}
+    for attr in ("grad_compress", "zero1", "tp_overlap", "dp", "tp",
+                 "stage", "accumulate_steps"):
+        if hasattr(step, attr):
+            meta[attr] = getattr(step, attr)
+    try:
+        from ..aot import aot_stats
+        meta["aot"] = aot_stats()
+    except Exception as e:
+        meta["aot_error"] = f"{type(e).__name__}: {e}"
+    text = None
+    try:
+        text = step.lower_hlo(*batch)
+    except Exception as e:
+        meta["lowering_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    return ProgramView(type(step).__name__, "train_step", stablehlo=text,
+                       meta=meta).run_rules(rules)
 
 
 def audit_engine(engine, compile_budget=None, rules=None,

@@ -31,12 +31,18 @@ tpu_lint rule exists to flag.
 """
 from __future__ import annotations
 
+import contextlib
+import functools
+import threading
+
 import jax
 import jax.numpy as jnp
 
 __all__ = ["ring_rowparallel_matmul", "matmul_allgather",
            "serial_rowparallel_matmul", "gather_chunks",
-           "ppermutes_per_rowparallel", "ppermutes_per_gather"]
+           "ppermutes_per_rowparallel", "ppermutes_per_gather",
+           "explicit_tp", "current_tp", "ring_concat",
+           "tp_row_matmul", "tp_col_matmul"]
 
 #: sub-chunks the local shard of a matmul+all-gather is split into so
 #: ring hops of chunk c overlap the dot of chunk c+1 (2 is enough to
@@ -135,3 +141,159 @@ def serial_rowparallel_matmul(x, w_local, axis_name):
     """
     # tpu_lint: allow(unoverlapped-collective) — this IS the serial form
     return jax.lax.psum(x @ w_local, axis_name)
+
+
+# -- explicit tensor-parallel TRAINING context --------------------------------
+#
+# PR 11 built the overlapped collective-matmuls for the serving decode
+# path, where the TP programs are hand-written shard_map lowerings. The
+# training path runs arbitrary Layer forwards, so the routing decision
+# lives here instead: a CommOptTrainStep traces the model inside
+# ``explicit_tp(axis, tp)``, and the Fleet mp_layers consult
+# ``current_tp()`` to replace their GSPMD-annotated dots (which lower to
+# the serial ``dot -> all_reduce`` form) with the custom-vjp collective-
+# matmuls below — whose BACKWARD is also expressed as ppermute rings, so
+# neither the fwd nor the bwd train-step HLO carries a collective that
+# serializes after a matmul.
+
+_tp_ctx = threading.local()
+
+
+@contextlib.contextmanager
+def explicit_tp(axis_name: str, tp: int, overlap: bool = True):
+    """Mark the enclosed trace as an explicit tensor-parallel region:
+    mp_layers route their matmuls through :func:`tp_col_matmul` /
+    :func:`tp_row_matmul` over mesh axis ``axis_name`` of size ``tp``.
+    ``overlap=False`` keeps the serial ``dot -> collective`` forms — the
+    A/B reference arm the ``unoverlapped-collective`` rule exists to
+    catch."""
+    stack = getattr(_tp_ctx, "stack", None)
+    if stack is None:
+        stack = _tp_ctx.stack = []
+    stack.append((axis_name, int(tp), bool(overlap)))
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def current_tp():
+    """(axis_name, tp, overlap) of the innermost explicit-tp region, or
+    None outside one."""
+    stack = getattr(_tp_ctx, "stack", None)
+    return stack[-1] if stack else None
+
+
+def ring_concat(x_local, axis_name, tp):
+    """Concatenate the per-device ``x_local`` shards along the last axis
+    in axis order, as a ppermute ring (pure data movement — bitwise equal
+    to an all_gather, but never emits a gather op that could sit behind a
+    dot result)."""
+    W = x_local.shape[-1]
+    i = jax.lax.axis_index(axis_name)
+    up = [(d, (d + 1) % tp) for d in range(tp)]
+    out = jnp.zeros(x_local.shape[:-1] + (tp * W,), x_local.dtype)
+    lead = (0,) * (x_local.ndim - 1)
+    cur, src = x_local, i
+    out = jax.lax.dynamic_update_slice(out, cur, lead + (src * W,))
+    for s in range(tp - 1):
+        cur = jax.lax.ppermute(cur, axis_name, up)
+        src = (i - s - 1) % tp
+        out = jax.lax.dynamic_update_slice(out, cur, lead + (src * W,))
+    return out
+
+
+def _psum_of_partial(x_part, w_part, axis_name, tp, overlap):
+    """``psum_over(axis)(x_part @ w_part)``, ring-overlapped when the
+    output width allows chunking (ring_rowparallel needs F % tp == 0)."""
+    if overlap and w_part.shape[-1] % tp == 0:
+        return ring_rowparallel_matmul(x_part, w_part, axis_name, tp)
+    # tpu_lint: allow(unoverlapped-collective) — serial fallback/A-B arm
+    return jax.lax.psum(x_part @ w_part, axis_name)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def tp_row_matmul(x_local, w_local, axis_name, tp, overlap=True):
+    """Row-parallel training matmul ``y = psum_tp(x_local @ w_local)``
+    (o-proj / down-proj; ``x_local [..., k/tp]``, ``w_local [k/tp, F]``),
+    replicated on return.
+
+    fwd: ppermute-pipelined collective-matmul (serial psum when
+    ``overlap=False``). bwd (custom): ``dx = dy @ w_localᵀ`` and
+    ``dw = x_localᵀ @ dy`` are both LOCAL dots — the row-parallel
+    backward needs no collective at all, so nothing can serialize."""
+    return _psum_of_partial(x_local, w_local, axis_name, tp, overlap)
+
+
+def _tp_row_fwd(x_local, w_local, axis_name, tp, overlap):
+    y = _psum_of_partial(x_local, w_local, axis_name, tp, overlap)
+    return y, (x_local, w_local)
+
+
+def _tp_row_bwd(axis_name, tp, overlap, res, dy):
+    x_local, w_local = res
+    dx = (dy @ w_local.T).astype(x_local.dtype)
+    dw = jnp.einsum("...k,...f->kf", x_local, dy).astype(w_local.dtype)
+    return dx, dw
+
+
+tp_row_matmul.defvjp(_tp_row_fwd, _tp_row_bwd)
+
+
+def _col_fwd_impl(x, w_local, b_local, axis_name, tp, gather, overlap):
+    if gather:
+        if overlap:
+            y = matmul_allgather(x, w_local, axis_name, tp)
+        else:
+            # tpu_lint: allow(unoverlapped-collective) — serial A/B arm
+            y = jax.lax.all_gather(x @ w_local, axis_name,
+                                   axis=x.ndim - 1, tiled=True)
+        if b_local is not None:
+            # bias travels as a ring of tiny [V/tp] hops (param operand,
+            # not a dot result — nothing serializes behind compute)
+            y = y + ring_concat(b_local, axis_name, tp).astype(y.dtype)
+        return y
+    y = x @ w_local
+    if b_local is not None:
+        y = y + b_local.astype(y.dtype)
+    return y
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def tp_col_matmul(x, w_local, b_local, axis_name, tp, gather, overlap=True):
+    """Column-parallel training matmul (qkv / gate-up / vocab head):
+    ``x [..., k]`` replicated, ``w_local [k, V/tp]`` the output-column
+    shard, optional sharded bias ``b_local [V/tp]``.
+
+    fwd: local dot (+ the chunked matmul+all-gather pipeline when
+    ``gather=True``). bwd (custom): the Megatron identity-fwd/allreduce-
+    bwd ``dx = psum_tp(dy_local @ w_localᵀ)`` is itself a row-parallel
+    matmul, so it runs as the SAME ppermute ring — the training backward
+    pass overlaps exactly like the forward."""
+    return _col_fwd_impl(x, w_local, b_local, axis_name, tp, gather,
+                         overlap)
+
+
+def _tp_col_fwd(x, w_local, b_local, axis_name, tp, gather, overlap):
+    y = _col_fwd_impl(x, w_local, b_local, axis_name, tp, gather, overlap)
+    return y, (x, w_local, b_local is None)
+
+
+def _tp_col_bwd(axis_name, tp, gather, overlap, res, dy):
+    x, w_local, no_bias = res
+    Vl = w_local.shape[-1]
+    if gather:
+        i = jax.lax.axis_index(axis_name)
+        start = (0,) * (dy.ndim - 1) + (i * Vl,)
+        dy_local = jax.lax.dynamic_slice(dy, start, dy.shape[:-1] + (Vl,))
+    else:
+        dy_local = dy
+    db = None if no_bias else \
+        dy_local.reshape(-1, Vl).sum(axis=0).astype(w_local.dtype)
+    dw = jnp.einsum("...k,...v->kv", x, dy_local).astype(w_local.dtype)
+    dx = _psum_of_partial(dy_local, w_local.T, axis_name, tp,
+                          overlap).astype(x.dtype)
+    return dx, dw, db
+
+
+tp_col_matmul.defvjp(_tp_col_fwd, _tp_col_bwd)

@@ -89,7 +89,8 @@ class _FleetOptimizer:
 
     def make_train_step(self, model, loss_fn, **kw):
         s = self._strategy
-        modes = [m for m in ("localsgd", "dgc", "fp16_allreduce", "a_sync")
+        modes = [m for m in ("localsgd", "dgc", "fp16_allreduce", "a_sync",
+                             "comm_opt")
                  if getattr(s, m, False)]
         if len(modes) > 1:
             raise NotImplementedError(
@@ -104,6 +105,17 @@ class _FleetOptimizer:
                 raise NotImplementedError(
                     f"options {sorted(kw)} are not supported by the "
                     f"{modes[0]} train step")
+        if getattr(s, "comm_opt", False):
+            # ROADMAP item 2: quantized-allreduce + ZeRO-1 + overlapped
+            # TP training matmuls, one compiled shard_map program
+            from ..comm_opt import CommOptTrainStep
+            cfg = getattr(s, "comm_opt_configs", {}) or {}
+            return CommOptTrainStep(
+                model, self._inner, loss_fn, strategy=s,
+                grad_compress=cfg.get("grad_compress"),
+                zero1=bool(cfg.get("zero1", False)),
+                tp_overlap=bool(cfg.get("tp_overlap", True)),
+                qblock=int(cfg.get("qblock", 1024)))
         if getattr(s, "a_sync", False):
             # PS-era geo mode (reference a_sync_configs k_steps>0 → geo
             # sparse tables, the_one_ps.py:655)

@@ -62,6 +62,14 @@ class DistributedStrategy:
         # dtype: "bfloat16" (half the psum bytes) or "int8" (EQuARX-style
         # two-phase quantized allreduce, ~4x fewer bytes)
         self.fp16_allreduce_configs = {"dtype": "bfloat16"}
+        # ROADMAP item 2 — comm-efficient multichip training
+        # (distributed.comm_opt.CommOptTrainStep): quantized gradient
+        # allreduce with error feedback, ZeRO-1 optimizer-state
+        # sharding, and overlapped TP training matmuls; grad_compress in
+        # (None, "bf16", "int8")
+        self.comm_opt = False
+        self.comm_opt_configs = {"grad_compress": None, "zero1": False,
+                                 "tp_overlap": True, "qblock": 1024}
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True
         self.nccl_comm_num = 1

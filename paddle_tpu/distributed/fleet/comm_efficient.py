@@ -377,7 +377,9 @@ class DGCTrainStep:
         return _unflatten_by(flat, self._order, self._shapes, self._sizes)
 
     def _step(self, param_vals, u, v, batch, key, lr):
-        from jax import shard_map
+        # jax 0.4.x: shard_map lives under jax.experimental (the
+        # top-level jax.shard_map + check_vma spelling is newer jax)
+        from jax.experimental.shard_map import shard_map
 
         loss_of = _loss_of(self.model, self._params, self.loss_fn)
         micro = _split_batch(batch, self.dp)
@@ -418,8 +420,7 @@ class DGCTrainStep:
                       P("dp", None)),
             out_specs=(P("dp"), P(None, None), P("dp", None),
                        P("dp", None)),
-            axis_names=frozenset({"dp"}),
-            check_vma=False)
+            check_rep=False)
         loss, g_comb, u, v = fn(param_vals, u, v, micro, keys)
         g_tree = self._unflatten(g_comb[0])
         newp = {k: (param_vals[k].astype(jnp.float32)
@@ -501,7 +502,8 @@ class CompressedAllreduceTrainStep:
         return _unflatten_by(flat, self._order, self._shapes, self._sizes)
 
     def _step(self, param_vals, opt_state, batch, key, lr):
-        from jax import shard_map
+        # jax 0.4.x import path (see DGCTrainStep._step)
+        from jax.experimental.shard_map import shard_map
 
         loss_of = _loss_of(self.model, self._params, self.loss_fn)
         micro = _split_batch(batch, self.dp)
@@ -560,8 +562,7 @@ class CompressedAllreduceTrainStep:
             per_replica, mesh=self._mesh,
             in_specs=(spec_rep, spec_dp0, P("dp", None)),
             out_specs=(P("dp"), P(None, None)),
-            axis_names=frozenset({"dp"}),
-            check_vma=False)
+            check_rep=False)
         loss, g_avg = fn(param_vals, micro, keys)
         g_tree = self._unflatten(g_avg[0])
         grads = {k: g_tree[k].astype(param_vals[k].dtype)

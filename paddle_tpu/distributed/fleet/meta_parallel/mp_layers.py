@@ -10,15 +10,25 @@ its PartitionSpec over the mesh "tp" axis. Under pjit the GSPMD partitioner
 materializes exactly the reference's communication pattern (identity fwd /
 all-reduce bwd for column, all-reduce fwd for row) on ICI — no hand-written
 collectives, and eager single-device execution stays correct.
+
+Inside a ``collective_matmul.explicit_tp`` region (the comm-opt training
+step traces the model inside shard_map with the weights passed as local
+shards), GSPMD is not driving — the fwd/bwd collectives would otherwise
+serialize after their dots — so Column/Row route through the custom-vjp
+overlapped collective-matmuls instead. The layer detects the explicit
+path by its weight arriving as a shard (local shape != logical shape);
+a tp-indivisible weight stays replicated and falls back to the plain
+form automatically.
 """
 from __future__ import annotations
 
+import jax
 from jax.sharding import PartitionSpec as P
 
 from ....nn import functional as F
 from ....nn.initializer import Constant, XavierUniform
 from ....nn.layer_base import Layer
-from ....tensor import Tensor
+from ....tensor import Tensor, apply
 
 
 class ColumnParallelLinear(Layer):
@@ -40,6 +50,21 @@ class ColumnParallelLinear(Layer):
             self.bias.pspec = P("tp")
 
     def forward(self, x):
+        from ... import collective_matmul as cm
+        ctx = cm.current_tp()
+        if ctx is not None:
+            axis, tp, overlap = ctx
+            # explicit-TP trace: the swapped-in weight is the local
+            # output-column shard [in, out/tp]
+            if tp > 1 and self.weight._data.shape[-1] != self.out_features:
+                gather = self.gather_output
+                args = (x, self.weight) + (
+                    (self.bias,) if self.bias is not None else ())
+                return apply(
+                    lambda a, wl, *b: cm.tp_col_matmul(
+                        a, wl, b[0] if b else None, axis, tp, gather,
+                        overlap),
+                    *args)
         return F.linear(x, self.weight, self.bias)
 
 
@@ -61,6 +86,28 @@ class RowParallelLinear(Layer):
             self.bias.pspec = P(None)
 
     def forward(self, x):
+        from ... import collective_matmul as cm
+        ctx = cm.current_tp()
+        if ctx is not None:
+            axis, tp, overlap = ctx
+            # explicit-TP trace: the swapped-in weight is the local
+            # input-row shard [in/tp, out]
+            if tp > 1 and self.weight._data.shape[0] != self.in_features:
+                def f(a, wl, *b):
+                    kl = wl.shape[0]
+                    if a.shape[-1] != kl:
+                        # reference input_is_parallel=False: split the
+                        # replicated activation to this rank's rows
+                        i = jax.lax.axis_index(axis)
+                        a = jax.lax.dynamic_slice_in_dim(
+                            a, i * kl, kl, axis=a.ndim - 1)
+                    y = cm.tp_row_matmul(a, wl, axis, tp, overlap)
+                    if b:
+                        y = y + b[0].astype(y.dtype)
+                    return y
+                args = (x, self.weight) + (
+                    (self.bias,) if self.bias is not None else ())
+                return apply(f, *args)
         return F.linear(x, self.weight, self.bias)
 
 

@@ -311,6 +311,24 @@ class CompiledTrainStep:
             sched.step()
         return Tensor(loss)
 
+    def lower_hlo(self, *batch) -> str:
+        """Lowered StableHLO of the REAL compiled step on this batch
+        (post-GSPMD in/out shardings baked) — the program text
+        ``analysis.audit_train_step`` runs the tpu_lint rules over."""
+        raw_batch = jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, Tensor) else x,
+            tuple(batch), is_leaf=lambda t: isinstance(t, Tensor))
+        raw_batch = jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                x, NamedSharding(self._mesh, data_pspec(jnp.shape(x))))
+            if jnp.ndim(x) else x,
+            raw_batch)
+        key = jax.random.PRNGKey(0)       # aval-compatible probe key
+        lr = jnp.asarray(0.1, jnp.float32)
+        return self._compiled.lower(
+            self._param_vals, self._opt_state, self._buffer_vals,
+            self._scaler_state, raw_batch, key, lr).as_text()
+
     def sync_optimizer_state(self):
         """Push compiled-state moments back into the eager optimizer dicts."""
         for k, p in self._params.items():

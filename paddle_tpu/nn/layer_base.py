@@ -77,6 +77,10 @@ class Layer:
             for d in (subs, bufs):
                 if d is not None:
                     d.pop(name, None)
+            # drop a stale instance attribute (e.g. `self.bias = None`
+            # before the real assignment) — it would shadow the
+            # parameter store on every subsequent lookup
+            self.__dict__.pop(name, None)
             params[name] = value
         elif isinstance(value, Layer):
             if subs is None:
@@ -84,6 +88,7 @@ class Layer:
             for d in (params, bufs):
                 if d is not None:
                     d.pop(name, None)
+            self.__dict__.pop(name, None)
             subs[name] = value
         else:
             if params is not None and name in params:

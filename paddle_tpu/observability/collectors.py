@@ -129,6 +129,28 @@ def _aot_families():
                [({"dir": d["dir"]}, d["entries"]) for d in s["disk"]])
 
 
+def _comm_families():
+    from ..distributed.comm_opt import global_comm_stats
+
+    s = global_comm_stats()
+    if not s["steps"]:
+        return
+    yield _fam("paddle_comm_opt_steps", "gauge",
+               "live comm-opt train steps", [({}, s["steps"])])
+    # the byte COUNTERS live on the registry directly
+    # (paddle_collective_bytes_total); the per-arm ratio is a pull-time
+    # gauge because it is a static property of each live step's config
+    yield _fam(
+        "paddle_comm_compression_ratio", "gauge",
+        "fp32 gradient-exchange bytes / actual wire bytes per live "
+        "comm-opt step",
+        [({"arm": str(i),
+           "compress": a["grad_compress"] or "none",
+           "zero1": "1" if a["zero1"] else "0",
+           "tp": str(a["tp"])}, a["compression_ratio"])
+         for i, a in enumerate(s["arms"])])
+
+
 def install_default_collectors():
     """Attach the built-in sources to the default registry (idempotent:
     re-registration under the same name replaces)."""
@@ -137,3 +159,4 @@ def install_default_collectors():
     register_collector(_resilience_families, "resilience")
     register_collector(_serving_resilience_families, "serving_resilience")
     register_collector(_aot_families, "aot")
+    register_collector(_comm_families, "comm_opt")

@@ -23,8 +23,32 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
+
+
+def _partial_manual_guard(mesh, manual):
+    """jax 0.4.x cannot compile partial-manual shard_map nested under
+    the GSPMD partitioner (XLA aborts in backend_compile). Returns the
+    mesh to run on: the original when fully manual; a reduced
+    single-axis mesh over the same devices when every automatic axis is
+    trivial (size 1 — semantically full-manual); otherwise a python
+    error, never a process abort."""
+    auto = frozenset(mesh.axis_names) - frozenset(manual)
+    if not auto:
+        return mesh
+    if all(mesh.shape[a] == 1 for a in auto) and len(manual) == 1:
+        import numpy as _np
+        from jax.sharding import Mesh as _Mesh
+        name = next(iter(manual))
+        return _Mesh(_np.asarray(mesh.devices).reshape(
+            mesh.shape[name]), (name,))
+    raise NotImplementedError(
+        f"partial-manual shard_map over {sorted(manual)} with "
+        f"non-trivial automatic axes "
+        f"{sorted(a for a in auto if mesh.shape[a] > 1)} is "
+        "unsupported on jax 0.4.x (XLA aborts); build a mesh carrying "
+        "only the manual axis")
 
 
 def _pvary(x, axis_name):
@@ -33,7 +57,12 @@ def _pvary(x, axis_name):
     try:
         return jax.lax.pcast(x, axis_name, to="varying")
     except (AttributeError, TypeError):
+        pass
+    try:
         return jax.lax.pvary(x, axis_name)
+    except AttributeError:
+        # jax 0.4.x: no varying-type system (check_rep=False) — identity
+        return x
 
 
 def _shift_right(x, axis_name, n):
@@ -113,6 +142,7 @@ def spmd_pipeline(stage_fn: Callable, stacked_params, x, *, mesh=None,
     param_specs = jax.tree_util.tree_map(
         lambda l: P(axis_name, *([None] * (l.ndim - 1))), stacked_params)
     manual = frozenset({axis_name})
+    mesh = _partial_manual_guard(mesh, manual)
     # jax 0.9 quirk: check_vma=False breaks partial-manual shard_map (its
     # internal unmatch spec then names every mesh axis), so keep the vma
     # check on whenever other mesh axes stay automatic
@@ -123,8 +153,8 @@ def spmd_pipeline(stage_fn: Callable, stacked_params, x, *, mesh=None,
         mesh=mesh,
         in_specs=(param_specs, P()),
         out_specs=P(),
-        axis_names=manual,
-        check_vma=frozenset(mesh.axis_names) != manual,
+        auto=frozenset(mesh.axis_names) - manual,
+        check_rep=False,
     )
     out = fn(stacked_params, micro)
     return out.reshape(b, *out.shape[2:])
@@ -299,6 +329,7 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stacked_params, x,
         lambda l: P(axis_name, *([None] * (l.ndim - 1))), stacked_params)
     last_specs = jax.tree_util.tree_map(lambda l: P(), last_params)
     manual = frozenset({axis_name})
+    mesh = _partial_manual_guard(mesh, manual)
     fn = shard_map(
         functools.partial(_pipeline_1f1b_local, stage_fn=stage_fn,
                           last_fn=last_fn, axis_name=axis_name,
@@ -306,8 +337,8 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stacked_params, x,
         mesh=mesh,
         in_specs=(param_specs, last_specs, P(), P()),
         out_specs=(P(), param_specs, last_specs, P()),
-        axis_names=manual,
-        check_vma=frozenset(mesh.axis_names) != manual,
+        auto=frozenset(mesh.axis_names) - manual,
+        check_rep=False,
     )
     loss, grads, last_grads, dx = fn(stacked_params, last_params, micro_x,
                                      micro_t)

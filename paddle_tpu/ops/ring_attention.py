@@ -198,13 +198,37 @@ def _ring_bwd_rule(axis_name, n, causal, scale, res, do):
 ring_attention_local.defvjp(_ring_fwd_rule, _ring_bwd_rule)
 
 
+def _partial_manual_guard(mesh, manual):
+    """jax 0.4.x cannot compile partial-manual shard_map nested under
+    the GSPMD partitioner (XLA aborts in backend_compile). Returns the
+    mesh to run on: the original when fully manual; a reduced
+    single-axis mesh over the same devices when every automatic axis is
+    trivial (size 1 — semantically full-manual); otherwise a python
+    error, never a process abort."""
+    auto = frozenset(mesh.axis_names) - frozenset(manual)
+    if not auto:
+        return mesh
+    if all(mesh.shape[a] == 1 for a in auto) and len(manual) == 1:
+        import numpy as _np
+        from jax.sharding import Mesh as _Mesh
+        name = next(iter(manual))
+        return _Mesh(_np.asarray(mesh.devices).reshape(
+            mesh.shape[name]), (name,))
+    raise NotImplementedError(
+        f"partial-manual shard_map over {sorted(manual)} with "
+        f"non-trivial automatic axes "
+        f"{sorted(a for a in auto if mesh.shape[a] > 1)} is "
+        "unsupported on jax 0.4.x (XLA aborts); build a mesh carrying "
+        "only the manual axis")
+
+
 def ring_attention(q, k, v, mesh=None, axis_name="sep", causal=False,
                    scale=None):
     """Ring attention on full arrays [B, L, H, D]; builds the shard_map.
 
     L must divide evenly by the ``axis_name`` mesh axis size.
     """
-    from jax import shard_map
+    from jax.experimental.shard_map import shard_map
 
     if mesh is None:
         from ..distributed.mesh import get_mesh
@@ -224,10 +248,11 @@ def ring_attention(q, k, v, mesh=None, axis_name="sep", causal=False,
     # inside the pjit train step. jax 0.9 quirk: partial-manual shard_map
     # requires check_vma=True (its unmatch spec otherwise names every axis).
     manual = frozenset({axis_name})
+    mesh = _partial_manual_guard(mesh, manual)
     fn = shard_map(
         functools.partial(ring_attention_local, axis_name=axis_name, n=n,
                           causal=causal, scale=float(scale)),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        axis_names=manual,
-        check_vma=frozenset(mesh.axis_names) != manual)
+        auto=frozenset(mesh.axis_names) - manual,
+        check_rep=False)
     return fn(q, k, v)

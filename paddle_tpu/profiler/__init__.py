@@ -293,6 +293,24 @@ class Profiler:
                   f"shed={sv['shed']} "
                   f"abandoned={sv['abandoned']} "
                   f"drains={sv['drains']}")
+        try:
+            from ..distributed.comm_opt import global_comm_stats
+            cg = global_comm_stats()
+        except Exception:   # tpu_lint: allow(silent-except) — summary
+            # line only: an unimportable comm subsystem reads as "no
+            # live comm-opt steps", never as a profiler crash
+            cg = {"steps": 0}
+        if cg["steps"]:
+            arms = " ".join(
+                f"[{a['grad_compress'] or 'exact'}"
+                f"{'+zero1' if a['zero1'] else ''}"
+                + (f" tp={a['tp']}" if a['tp'] > 1 else "")
+                + f" ratio={a['compression_ratio']}x"
+                f" {a['exchange_bytes_per_step']}B/step"
+                f" steps={a['steps']}]"
+                for a in cg["arms"])
+            print(f"comm: arms={cg['steps']} "
+                  f"steps={cg['total_steps_run']} {arms}")
         from ..analysis import findings_summary
         fs = findings_summary()
         if fs:

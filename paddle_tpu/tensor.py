@@ -199,6 +199,9 @@ class Tensor:
     # -- display ------------------------------------------------------------
     def __repr__(self):
         sg = self.stop_gradient
+        if not hasattr(self._data, "shape"):
+            # placeholder payload from a jax-internal tree unflatten
+            return f"Tensor(<opaque {type(self._data).__name__}>)"
         try:
             body = np.array2string(np.asarray(self._data), precision=8,
                                    separator=", ", prefix="       ")
@@ -256,7 +259,26 @@ def _tensor_flatten(t: Tensor):
 
 
 def _tensor_unflatten(aux, children):
-    return Tensor(children[0], stop_gradient=aux)
+    c = children[0]
+    if isinstance(c, (jax.Array, jax.core.Tracer, np.ndarray, np.generic,
+                      bool, int, float, complex)):
+        return Tensor(c, stop_gradient=aux)
+    # jax internally unflattens argument trees with non-array leaves
+    # (sharding specs, sentinel objects) while resolving pjit
+    # in/out_shardings; those must pass through untouched — coercing
+    # them via jnp.asarray raises and breaks any jit whose argument
+    # tree contains a Tensor node alongside explicit shardings.
+    t = object.__new__(Tensor)
+    t._data = c
+    t.stop_gradient = aux
+    t.grad = None
+    t._node = None
+    t._out_index = 0
+    t.name = None
+    t.persistable = False
+    t._grad_hooks = None
+    t._token = None
+    return t
 
 
 jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)

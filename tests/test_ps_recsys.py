@@ -59,6 +59,8 @@ def _fleet_ctr(model_cls, sharding_degree, vocab=VOCAB, steps=3,
     return losses, model
 
 
+@pytest.mark.slow   # unblocked by the PR-12 Tensor-pytree fix; multi-
+# second GSPMD compile load — slow lane per the tier-1 fast-test budget
 def test_table_row_sharded_over_mesh():
     """The table's rows live sharded over the mesh: each device holds
     V/8 rows — a table 8x bigger than one device could replicate."""
@@ -106,6 +108,8 @@ def test_non_lazy_decay_touches_all_rows():
     assert changed.mean() > 0.99
 
 
+@pytest.mark.slow   # unblocked by the PR-12 Tensor-pytree fix; multi-
+# second GSPMD compile load — slow lane per the tier-1 fast-test budget
 def test_ctr_model_learns_signal():
     """End-to-end: generator → dataset → batches → compiled train step;
     the synthetic signal (dense[0] + C1 parity) is learnable."""
@@ -215,6 +219,8 @@ def _ctr_stream(n=512, batch=64, seed=1):
     return list(iter_ctr_batches(iter(samples), schema, batch))
 
 
+@pytest.mark.slow   # unblocked by the PR-12 Tensor-pytree fix; multi-
+# second GSPMD compile load — slow lane per the tier-1 fast-test budget
 def test_geo_ctr_converges_close_to_sync():
     """Geo-mode CTR training converges within tolerance of synchronous
     training on the same data (the_one_ps geo-vs-sync contract)."""
@@ -258,6 +264,8 @@ def test_geo_ctr_converges_close_to_sync():
     assert g_last < s_last * 1.25 + 0.05, (g_last, s_last)
 
 
+@pytest.mark.slow   # unblocked by the PR-12 Tensor-pytree fix; multi-
+# second GSPMD compile load — slow lane per the tier-1 fast-test budget
 def test_geo_staleness_bound():
     """Between merges replicas drift (different microbatches); right
     after every k-th step all replicas hold identical parameters — the
@@ -278,6 +286,8 @@ def test_geo_staleness_bound():
     assert divs[0] > 0.0 and divs[3] > 0.0, divs
 
 
+@pytest.mark.slow   # unblocked by the PR-12 Tensor-pytree fix; multi-
+# second GSPMD compile load — slow lane per the tier-1 fast-test budget
 def test_geo_table_rows_stay_sharded():
     """The geo replica axis composes with row sharding: the embedding
     table lives [dp, V/sharding, D] over the dp×sharding mesh."""

@@ -124,6 +124,8 @@ def test_qat_convert_matches_fake_quant_eval():
     assert np.abs(y_int8 - y_qat).max() < 0.1, np.abs(y_int8 - y_qat).max()
 
 
+@pytest.mark.slow   # unblocked by the PR-12 Tensor-pytree fix; multi-
+# second GSPMD compile load — slow lane per the tier-1 fast-test budget
 def test_qat_llama_tiny_compiled_step():
     """QAT through the COMPILED fleet train step: observer buffers must
     thread through jit like BN stats, and training must converge."""

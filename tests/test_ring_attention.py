@@ -3,6 +3,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+# enabled by the jax-0.4.x shard_map port (PR 12); ~90s of 8-device
+# ring-attention compiles — slow lane per the tier-1 fast-test budget
+pytestmark = pytest.mark.slow
 from jax.sharding import Mesh
 
 from paddle_tpu.nn.functional.attention import _xla_sdpa

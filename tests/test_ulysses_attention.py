@@ -9,6 +9,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
+
+# enabled by the jax-0.4.x shard_map port (PR 12); all-to-all attention
+# compiles over 8 devices — slow lane per the tier-1 fast-test budget
+pytestmark = pytest.mark.slow
 from jax.sharding import Mesh
 
 import paddle_tpu as paddle

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Comm-efficient multichip training sweep (ROADMAP item 2 dryrun).
+
+A/B ledger over the comm-opt train-step arms on whatever mesh is up —
+the 8-virtual-CPU-device harness for dryruns (run this file directly)
+or real chips (imported by bench.py's ``multichip_commopt`` arm):
+
+* DP gradient exchange: exact fp32 vs bf16 vs int8 (error feedback on),
+  same model/batch/seed — records per-step wall time, final loss drift
+  vs exact, wire bytes and compression ratio per step, and the HLO
+  collective profile (op counts, largest all_reduce operand).
+* ZeRO-1 on/off at exact precision — records bitwise parameter parity
+  and per-replica optimizer-state elements.
+* TP training matmuls: overlapped (ppermute-ring custom-vjp) vs serial
+  (``dot -> psum``) — records per-step wall time, collective_permute vs
+  all_reduce counts, and the ``unoverlapped-collective`` verdicts.
+
+Usage (CPU dryrun):
+    python tools/bench_commopt.py [--steps 24] [--json]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if __name__ == "__main__":
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _timed(step, xt, yt, steps):
+    losses = [float(__import__("numpy").asarray(step(xt, yt)._data))]
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        losses.append(float(
+            __import__("numpy").asarray(step(xt, yt)._data)))
+    dt = time.perf_counter() - t0
+    return losses, dt / max(1, steps)
+
+
+def commopt_sweep(steps=24, include_tp=True):
+    """The full A/B ledger; import-time friends: bench.py calls this on
+    TPU, __main__ runs it as the CPU dryrun."""
+    import numpy as np
+
+    from check_train_collectives import (_batch, _build,
+                                         _collective_profile)
+    from paddle_tpu import analysis
+
+    xt, yt = _batch()
+    out = {"bench": "multichip_commopt", "steps": steps, "arms": {}}
+
+    import jax
+    out["devices"] = len(jax.devices())
+    out["backend"] = jax.default_backend()
+
+    # -- DP compression arms -------------------------------------------
+    base_losses = None
+    base_params = None
+    for name, gc, z1 in (("exact", None, False), ("bf16", "bf16", False),
+                         ("int8", "int8", False),
+                         ("exact_zero1", None, True),
+                         ("int8_zero1", "int8", True)):
+        step, model = _build(gc, zero1=z1)
+        losses, per_step = _timed(step, xt, yt, steps)
+        prof = _collective_profile(step.lower_hlo(xt, yt))
+        arm = {"ms_per_step": round(per_step * 1e3, 3),
+               "loss_first": losses[0], "loss_last": losses[-1],
+               "exchange_bytes_per_step": step.exchange_bytes,
+               "compression_ratio": round(step.compression_ratio, 3),
+               "opt_state_elems_per_replica":
+                   step.optimizer_state_elems_per_replica(),
+               "hlo_collectives": prof}
+        params = {k: np.asarray(p._data)
+                  for k, p in model.named_parameters()}
+        if name == "exact":
+            base_losses, base_params = losses, params
+        else:
+            arm["max_rel_loss_dev_vs_exact"] = max(
+                abs(a - b) / (abs(b) + 1e-9)
+                for a, b in zip(losses, base_losses))
+            arm["params_bitwise_equal_vs_exact"] = bool(all(
+                np.array_equal(base_params[k], params[k])
+                for k in params))
+        out["arms"][name] = arm
+
+    # -- TP overlap A/B ------------------------------------------------
+    if include_tp and out["devices"] >= 8:
+        for name, overlap in (("tp_overlap", True), ("tp_serial", False)):
+            step, _ = _build(None, mp=2, tp_overlap=overlap)
+            losses, per_step = _timed(step, xt, yt, steps)
+            rep = analysis.audit_train_step(step, xt, yt)
+            out["arms"][name] = {
+                "ms_per_step": round(per_step * 1e3, 3),
+                "loss_last": losses[-1],
+                "hlo_collectives": _collective_profile(
+                    step.lower_hlo(xt, yt)),
+                "unoverlapped_high": sum(
+                    1 for f in rep.findings
+                    if f.rule_id == "unoverlapped-collective"
+                    and f.severity == "high"),
+                "collective_metrics": rep.metrics.get(
+                    "unoverlapped-collective")}
+
+    try:
+        from paddle_tpu.aot import aot_stats
+        out["aot"] = {k: aot_stats()[k]
+                      for k in ("hits", "misses", "compiled")}
+    except Exception:   # tpu_lint: allow(silent-except) — the aot view
+        # is advisory ledger context, not a gate
+        pass
+    ok = (out["arms"]["exact_zero1"]["params_bitwise_equal_vs_exact"]
+          and out["arms"]["int8"]["max_rel_loss_dev_vs_exact"] < 0.05
+          and out["arms"]["int8"]["compression_ratio"] > 3.0)
+    if "tp_overlap" in out["arms"]:
+        ok = ok and out["arms"]["tp_overlap"]["unoverlapped_high"] == 0 \
+            and out["arms"]["tp_serial"]["unoverlapped_high"] >= 1
+    out["ok"] = bool(ok)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=24)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    out = commopt_sweep(steps=args.steps)
+    if args.json:
+        print(json.dumps(out))
+    else:
+        for name, arm in out["arms"].items():
+            extra = ""
+            if "compression_ratio" in arm:
+                extra = (f" ratio={arm['compression_ratio']}x "
+                         f"{arm['exchange_bytes_per_step']}B/step")
+            if "max_rel_loss_dev_vs_exact" in arm:
+                extra += (f" loss_dev="
+                          f"{arm['max_rel_loss_dev_vs_exact']:.2e}")
+            if "unoverlapped_high" in arm:
+                extra += f" unoverlapped_high={arm['unoverlapped_high']}"
+            print(f"{name:12s} {arm['ms_per_step']:8.2f} ms/step{extra}")
+        print("OK" if out["ok"] else "FAIL")
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
