@@ -22,6 +22,8 @@ Front ends
 ``audit_plan(program_or_plan)``        a static-executor _ReplayPlan
 ``audit_engine(engine)``               a serving.Engine (plus its real
                                        lowered decode program)
+``audit_fleet(fleet)``                 a serving ReplicaFleet: compile
+                                       budget = the UNION across replicas
 ``audit_dispatch()``                   the live eager-dispatch cache
 ``selflint(paths)``                    AST rules over python source
 =====================================  =====================================
@@ -83,9 +85,9 @@ StableHLO, ``.jaxpr``, ``.meta``), AST rules a
 :class:`Finding`s.
 """
 from .audit import (  # noqa: F401
-    ProgramView, audit, audit_dispatch, audit_engine, audit_model,
-    audit_plan, audit_stablehlo, audit_train_step, findings_summary,
-    selflint,
+    ProgramView, audit, audit_dispatch, audit_engine, audit_fleet,
+    audit_model, audit_plan, audit_stablehlo, audit_train_step,
+    findings_summary, selflint,
 )
 from .findings import (  # noqa: F401
     SEVERITIES, Finding, Report, parse_allowlist, severity_rank,
@@ -95,6 +97,7 @@ from .registry import iter_rules, rule, rules_table  # noqa: F401
 
 __all__ = [
     "ProgramView", "audit", "audit_dispatch", "audit_engine",
+    "audit_fleet",
     "audit_model", "audit_plan", "audit_stablehlo", "audit_train_step",
     "findings_summary",
     "selflint", "SEVERITIES", "Finding", "Report", "parse_allowlist",

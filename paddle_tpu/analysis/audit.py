@@ -344,6 +344,76 @@ def audit_engine(engine, compile_budget=None, rules=None,
                        stablehlo=text, meta=meta).run_rules(rules)
 
 
+def audit_fleet(fleet, compile_budget=None, rules=None,
+                lower_decode=False) -> Report:
+    """Audit a ``serving.fleet.ReplicaFleet``: the compile budget is the
+    UNION of prefill buckets (+ decode + chunk) across EVERY replica and
+    every supervisor-rebuilt incarnation — in-process the replicas share
+    the module-level jitted programs, so an N-replica fleet legitimately
+    budgets as ONE engine (0 extra lowerings is the fleet contract,
+    gated by ``tools/check_serving_compiles.py --fleet N``), and a fresh
+    process pays exactly that union. Geometry/donation meta comes from
+    replica 0 (fleet replicas share engine kwargs; tp degree may vary
+    per replica and is reported per replica)."""
+    import jax
+
+    from .engine_support import engine_donates
+
+    replicas = list(fleet.replicas.values())
+    buckets: set = set()
+    chunk_used = False
+    decode_used = False
+    per_replica = {}
+    for rep in replicas:
+        sup = rep.sup
+        b = set(sup.engine.buckets_seen) | sup.buckets_seen_total
+        buckets |= b
+        chunk_used |= (bool(getattr(sup.engine, "chunk_used", False))
+                       or bool(sup.chunk_used_total))
+        decode_used |= sup.engine.metrics.decode_steps > 0 or bool(b)
+        per_replica[rep.id] = {
+            "state": rep.state, "tp": sup.engine.tp,
+            "buckets_seen": sorted(b), "rebuilds": sup.rebuilds,
+            "replayed": sup.replayed}
+    first = replicas[0].engine
+    if compile_budget is None:
+        compile_budget = first.compile_budget
+    meta = {
+        "n_slots": first.n_slots, "max_len": first.max_len,
+        "min_prompt_bucket": first.min_prompt_bucket,
+        "buckets_seen": sorted(buckets),
+        "decode_used": decode_used,
+        "compile_budget": compile_budget,
+        "backend": jax.default_backend(),
+        "donate": engine_donates(first),
+        "kv_heads": first.cache.kv_heads,
+        "head_dim": first.cache.head_dim,
+        "kv_layout": first.kv_layout,
+        "block_size": first.block_size,
+        "n_blocks": (first.cache.pool.n_blocks
+                     if hasattr(first.cache, "pool") else None),
+        "prefill_chunk": first.prefill_chunk,
+        "chunk_used": chunk_used,
+        "fleet": {"name": fleet.name, "n_replicas": len(replicas),
+                  "states": fleet.replica_states(),
+                  "counters": fleet.counters(),
+                  "per_replica": per_replica},
+    }
+    text = None
+    if lower_decode:
+        from .engine_support import lower_decode_program
+        try:
+            text = lower_decode_program(first)
+        except Exception as e:
+            meta["decode_lowering_error"] = f"{type(e).__name__}: {e}"
+    report = ProgramView(f"ReplicaFleet[{len(replicas)}]", "engine",
+                         stablehlo=text, meta=meta).run_rules(rules)
+    # the fleet view rides in the report's measurements (Report carries
+    # metrics, not meta) — tools embed it in their JSON ledgers
+    report.metrics["fleet"] = meta["fleet"]
+    return report
+
+
 def audit_dispatch(rules=None) -> Report:
     """Audit the live eager-dispatch cache: blacklisted ops (with the
     recorded reason), megamorphic signatures, retrace pressure — plus

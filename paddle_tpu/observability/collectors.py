@@ -79,10 +79,39 @@ def _serving_families():
                "count": total_count}
 
 
+def _fleet_families():
+    from ..serving import fleet as fl
+
+    t = fl.global_counters()
+    yield _fam("paddle_serving_fleets", "gauge",
+               "live replica fleets", [({}, t["fleets"])])
+    if not t["fleets"]:
+        return
+    counter_keys = ("routed", "prefix_routed", "migrations", "failovers",
+                    "replica_kills", "route_flaps", "fleet_sheds",
+                    "backoffs", "retries", "re_registers")
+    yield _fam("paddle_serving_fleet_events_total", "counter",
+               "fleet routing/failover/migration counters summed "
+               "across live fleets",
+               [({"kind": k}, t[k]) for k in counter_keys])
+    # the replica health state machine, one gauge child per replica:
+    # 0=healthy 1=degraded 2=draining 3=condemned (REPLICA_STATES order)
+    samples = []
+    for f in fl.live_fleets():
+        for rid, state in f.replica_states().items():
+            samples.append(({"fleet": f.name, "replica": rid},
+                            fl.REPLICA_STATES.index(state)))
+    if samples:
+        yield _fam("paddle_serving_replica_state", "gauge",
+                   "replica health state "
+                   "(0=healthy 1=degraded 2=draining 3=condemned)",
+                   samples)
+
+
 def _resilience_families():
     from ..resilience import ledger
 
-    for scope in ("train", "serving"):
+    for scope in ("train", "serving", "fleet"):
         t = ledger.global_counters(scope=scope)
         n = t.pop("ledgers", 0)
         yield _fam(f"paddle_resilience_{scope}_ledgers", "gauge",
@@ -156,6 +185,7 @@ def install_default_collectors():
     re-registration under the same name replaces)."""
     register_collector(_dispatch_families, "dispatch")
     register_collector(_serving_families, "serving")
+    register_collector(_fleet_families, "fleet")
     register_collector(_resilience_families, "resilience")
     register_collector(_serving_resilience_families, "serving_resilience")
     register_collector(_aot_families, "aot")

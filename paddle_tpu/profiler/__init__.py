@@ -23,6 +23,7 @@ from .timer import Benchmark, benchmark  # noqa: F401
 __all__ = [
     "Benchmark", "benchmark", "dispatch_counters", "serving_counters",
     "resilience_counters", "serving_resilience_counters", "aot_counters",
+    "fleet_counters",
     "ProfilerState", "ProfilerTarget",
     "make_scheduler", "export_chrome_tracing", "export_protobuf",
     "Profiler", "RecordEvent", "RecordInstantEvent",
@@ -78,6 +79,15 @@ def serving_resilience_counters() -> dict:
     from ..serving import resilience as serving_resilience
 
     return serving_resilience.global_counters()
+
+
+def fleet_counters() -> dict:
+    """Aggregate replica-fleet counters across every live
+    ``serving.fleet.ReplicaFleet`` (routing decisions and prefix hits,
+    cross-replica migrations, failovers, replica health states)."""
+    from ..serving import fleet as serving_fleet
+
+    return serving_fleet.global_counters()
 
 
 class ProfilerState(Enum):
@@ -281,6 +291,22 @@ class Profiler:
                   f"restores={rc.get('resume', 0)} "
                   f"rollbacks={rc.get('rollback', 0)} "
                   f"aborts={rc.get('abort', 0)}")
+        fc = fleet_counters()
+        if fc["fleets"]:
+            print("fleet: "
+                  f"fleets={fc['fleets']} "
+                  f"replicas={fc['replicas']} "
+                  f"healthy={fc['healthy']} "
+                  f"degraded={fc['degraded']} "
+                  f"draining={fc['draining']} "
+                  f"condemned={fc['condemned']} "
+                  f"routed={fc['routed']} "
+                  f"prefix_routed={fc['prefix_routed']} "
+                  f"migrations={fc['migrations']} "
+                  f"failovers={fc['failovers']} "
+                  f"kills={fc['replica_kills']} "
+                  f"sheds={fc['fleet_sheds']} "
+                  f"backoffs={fc['backoffs']}")
         sv = serving_resilience_counters()
         if sv["supervisors"]:
             print("serving-resilience: "

@@ -22,7 +22,8 @@ BENCH_r02–r05 wedged-TPU-tunnel class):
   through ``Profiler.summary()``.
 """
 from .chaos import (  # noqa: F401
-    FAULTS, SERVING_FAULTS, ChaosError, ChaosMonkey, StallInjected,
+    FAULTS, FLEET_FAULTS, SERVING_FAULTS, ChaosError, ChaosMonkey,
+    StallInjected,
     corrupt_checkpoint, corrupt_kv, corrupt_latest,
 )
 from .ledger import FlightLedger, global_counters  # noqa: F401
@@ -33,6 +34,7 @@ from .supervisor import (  # noqa: F401
 __all__ = [
     "Supervisor", "SupervisorAborted", "StepTimeout", "TrainState",
     "ResumableLoader", "ChaosMonkey", "ChaosError", "StallInjected",
-    "FAULTS", "SERVING_FAULTS", "corrupt_checkpoint", "corrupt_kv",
+    "FAULTS", "SERVING_FAULTS", "FLEET_FAULTS", "corrupt_checkpoint",
+    "corrupt_kv",
     "corrupt_latest", "FlightLedger", "global_counters",
 ]

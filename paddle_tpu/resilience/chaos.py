@@ -46,6 +46,14 @@ import numpy as np
 
 FAULTS = ("nan", "stall", "error", "kill", "corrupt")
 SERVING_FAULTS = ("decode-stall", "decode-raise", "kv-corrupt", "abandon")
+#: Consumed by ``serving.fleet.ReplicaFleet`` (one fault per fleet step,
+#: injected into a deterministically chosen replica): ``replica-kill``
+#: condemns a replica's engine outright (process-death analog; requests
+#: migrate to peers), ``route-flap`` randomizes the next few routing
+#: decisions (placement must not change tokens), and the decode-* /
+#: kv-corrupt serving faults target one replica's engine.
+FLEET_FAULTS = ("replica-kill", "route-flap", "decode-stall",
+                "decode-raise", "kv-corrupt")
 
 
 class ChaosError(RuntimeError):
@@ -80,7 +88,7 @@ class ChaosMonkey:
         # its spans; last_trace_id is the most recent fault's
         self.trace_ids = {}             # step -> trace id
         self.last_trace_id = None
-        known = FAULTS + SERVING_FAULTS
+        known = FAULTS + SERVING_FAULTS + FLEET_FAULTS
         for f in tuple(dict(at or {}).values()) + tuple(faults):
             if f not in known:
                 raise ValueError(f"unknown fault {f!r} (one of {known})")

@@ -27,8 +27,9 @@ admission, brownout shedding and ``drain()``.
 """
 from __future__ import annotations
 
-from .engine import (Engine, RequestCancelled, RequestHandle,  # noqa: F401
-                     RequestShed, RequestTimeout)
+from .engine import (AdoptMismatch, Engine, RequestCancelled,  # noqa: F401
+                     RequestHandle, RequestShed, RequestTimeout)
+from .fleet import REPLICA_STATES, ReplicaFleet  # noqa: F401
 from .kv_cache import (BlockPool, PagedKVCache, RadixIndex,  # noqa: F401
                        SlotKVCache)
 from .metrics import EngineMetrics, RequestMetrics, ledger  # noqa: F401
@@ -38,11 +39,12 @@ from .scheduler import (EngineOverloaded, FIFOScheduler,    # noqa: F401
                         PriorityScheduler)
 
 __all__ = ["Engine", "RequestHandle", "RequestTimeout", "RequestShed",
-           "RequestCancelled", "SlotKVCache", "PagedKVCache", "BlockPool",
+           "RequestCancelled", "AdoptMismatch", "SlotKVCache",
+           "PagedKVCache", "BlockPool",
            "RadixIndex", "EngineMetrics",
            "RequestMetrics", "ledger", "EngineOverloaded", "FIFOScheduler",
            "PriorityScheduler", "EngineSupervisor", "ServingAborted",
-           "EngineDraining", "save_lm"]
+           "EngineDraining", "ReplicaFleet", "REPLICA_STATES", "save_lm"]
 
 
 def save_lm(model, path, precompile=None, n_slots=8, max_len=None,

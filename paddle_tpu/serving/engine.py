@@ -64,7 +64,8 @@ from .scheduler import (EngineOverloaded, FIFOScheduler,  # noqa: F401
                         PriorityScheduler)
 
 __all__ = ["Engine", "RequestHandle", "EngineOverloaded", "RequestTimeout",
-           "RequestShed", "RequestCancelled", "DEFAULT_RETRY_AFTER_S"]
+           "RequestShed", "RequestCancelled", "AdoptMismatch",
+           "DEFAULT_RETRY_AFTER_S"]
 
 #: Conservative retry-after hint (seconds) when the engine has no basis
 #: for a live estimate — a cold engine (no decode history yet) or an
@@ -77,24 +78,41 @@ DEFAULT_RETRY_AFTER_S = 1.0
 class RequestTimeout(TimeoutError):
     """A request exceeded its ``max_time_s`` deadline: its KV slot was
     reclaimed and ``result()`` raises this instead of blocking forever.
-    Tokens generated before the deadline remain on ``handle.tokens``."""
+    Tokens generated before the deadline remain on ``handle.tokens``.
+    ``replica`` names the fleet replica that held the request when it
+    expired (None outside a ReplicaFleet)."""
+
+    def __init__(self, message, replica=None):
+        super().__init__(message)
+        self.replica = replica
 
 
 class RequestShed(RuntimeError):
     """The request was evicted from the queue by overload brownout
     (``serving.resilience.EngineSupervisor`` past its ITL SLO): retry
     after ``retry_after_s`` seconds, by which point the engine expects
-    to be back under its latency target."""
+    to be back under its latency target. ``replica`` names the fleet
+    replica that shed it (None outside a ReplicaFleet)."""
 
-    def __init__(self, message, retry_after_s=None):
+    def __init__(self, message, retry_after_s=None, replica=None):
         super().__init__(message)
         self.retry_after_s = retry_after_s
+        self.replica = replica
 
 
 class RequestCancelled(RuntimeError):
     """The request was cancelled (client abandoned the stream) before
     finishing; tokens generated before cancellation stay on
     ``handle.tokens``."""
+
+
+class AdoptMismatch(RuntimeError):
+    """``Engine.adopt()`` refused a handle whose origin engine served a
+    DIFFERENT model/config/sampling fingerprint: replaying its token
+    history here would silently produce divergent tokens. Cross-replica
+    migration (and supervisor rebuild) is only token-identical between
+    engines over the same model — tp degree and KV geometry may differ
+    (adopt replays from tokens, not KV bytes), the math may not."""
 
 
 # ---------------------------------------------------------------------------
@@ -757,6 +775,33 @@ def _make_arch(model):
     return w, hp, geo
 
 
+def _model_fingerprint(model, hp, statics, eos_token_id, w):
+    """Cheap, deterministic identity of the token math an engine runs:
+    model class + config + arch hyperparams + engine-wide sampling
+    statics + the stacked-weight tree spec (keys/shapes/dtypes). Two
+    engines with equal fingerprints produce identical token streams for
+    the same (prompt, seed, gen kwargs) — the ``adopt()`` migration
+    precondition. Deliberately EXCLUDES tp degree, mesh, KV layout and
+    block geometry: adopt replays from tokens, not KV bytes, so those
+    may differ across the migration. Metadata only (never hashes weight
+    bytes, never runs a device op): construction stays compile-free and
+    cheap on sharded weights."""
+    import hashlib
+
+    cfg = getattr(model, "config", None)
+    try:
+        import dataclasses
+        cfg_repr = repr(sorted(dataclasses.asdict(cfg).items()))
+    except TypeError:
+        cfg_repr = repr(cfg)
+    wspec = tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                         for k, v in w.items()))
+    parts = (type(model).__name__, cfg_repr,
+             tuple(sorted(hp.items())), tuple(sorted(statics.items())),
+             eos_token_id, wspec)
+    return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
+
+
 class RequestHandle:
     """One submitted request: streams tokens as the engine decodes.
 
@@ -785,6 +830,12 @@ class RequestHandle:
         # "eos" | "length" | "timeout" | "shed" | "cancelled"
         self.finish_reason = None
         self.retry_after_s = None      # stamped when shed under brownout
+        # fleet identity: which replica currently serves this handle
+        # (restamped on adopt/migration) and the origin engine's model
+        # fingerprint (the adopt() compatibility guard)
+        self.replica_id = getattr(engine, "replica_id", None)
+        self.model_fingerprint = getattr(engine, "model_fingerprint",
+                                         None)
         self.slot = None
         self.metrics = RequestMetrics()
         # one trace id for the request's whole lifecycle — minted
@@ -798,15 +849,21 @@ class RequestHandle:
         while not self.finished:
             self._engine.step()
         if self.finish_reason == "timeout":
+            where = (f" on replica {self.replica_id}"
+                     if self.replica_id is not None else "")
             raise RequestTimeout(
                 f"request {self.request_id} exceeded max_time_s="
-                f"{self.max_time_s} after {len(self.tokens)} tokens; "
-                "its slot was reclaimed")
+                f"{self.max_time_s} after {len(self.tokens)} tokens"
+                f"{where}; its slot was reclaimed",
+                replica=self.replica_id)
         if self.finish_reason == "shed":
+            where = (f" by replica {self.replica_id}"
+                     if self.replica_id is not None else "")
             raise RequestShed(
                 f"request {self.request_id} (priority {self.priority}) "
-                f"was shed under overload; retry after "
-                f"{self.retry_after_s}s", retry_after_s=self.retry_after_s)
+                f"was shed under overload{where}; retry after "
+                f"{self.retry_after_s}s", retry_after_s=self.retry_after_s,
+                replica=self.replica_id)
         if self.finish_reason == "cancelled":
             raise RequestCancelled(
                 f"request {self.request_id} was cancelled after "
@@ -850,8 +907,11 @@ class Engine:
                  default_retry_after_s=DEFAULT_RETRY_AFTER_S,
                  kv_layout="paged", block_size=16, n_blocks=None,
                  prefill_chunk=None, prefix_sharing=True, tp=1,
-                 mesh=None):
+                 mesh=None, replica_id=None):
         self._w, self._hp, geo = _make_arch(model)
+        #: fleet identity: stamped onto handles and carried by
+        #: RequestTimeout/RequestShed/EngineOverloaded (None standalone)
+        self.replica_id = replica_id
         self.tp = int(tp)
         self._mesh = None
         self._n_layers = geo["n_layers"]
@@ -869,6 +929,11 @@ class Engine:
         self._statics = dict(self._hp, do_sample=bool(do_sample),
                              top_k=int(top_k),
                              top_p=None if top_p is None else float(top_p))
+        # the adopt()/migration compatibility token (see the helper):
+        # engines over the same model + sampling statics — regardless of
+        # tp degree or KV geometry — share it and may exchange handles
+        self.model_fingerprint = _model_fingerprint(
+            model, self._hp, self._statics, eos_token_id, self._w)
         if kv_layout not in ("paged", "slot"):
             raise ValueError("kv_layout must be 'paged' or 'slot'")
         self.kv_layout = kv_layout
@@ -927,6 +992,7 @@ class Engine:
         # thread that later unblocks must not mutate replayed handles
         self._condemned = False
         self.metrics = EngineMetrics()
+        self.metrics.replica = replica_id
         self._by_slot = [None] * self.n_slots
         self._next_id = 0
         self.base_seed = int(base_seed)
@@ -1520,9 +1586,27 @@ class Engine:
         identity, seed, priority and emitted tokens; admission
         re-prefills ``prompt + tokens`` and resumes the PRNG chain at
         the right split index, so decoding continues token-identically
-        to the uninterrupted run."""
+        to the uninterrupted run.
+
+        Raises :class:`AdoptMismatch` when the handle's origin engine
+        served a different model/config/sampling fingerprint — replaying
+        its history here would silently diverge. tp degree and KV
+        geometry are NOT part of the fingerprint (tp=2 -> tp=1 adoption
+        is token-identical: the replay runs from tokens, not KV
+        bytes)."""
+        fp = getattr(handle, "model_fingerprint", None)
+        if fp is not None and fp != self.model_fingerprint:
+            raise AdoptMismatch(
+                f"request {handle.request_id} originates from an engine "
+                f"with model fingerprint {fp} but this engine serves "
+                f"{self.model_fingerprint}: adopting would replay its "
+                "token history through different math and silently "
+                "diverge — migrate only between replicas of the SAME "
+                "model/config/sampling configuration")
         handle.slot = None
         handle._engine = self
+        handle.replica_id = self.replica_id
+        handle.model_fingerprint = self.model_fingerprint
         handle._queued_t = time.perf_counter()
         self._next_id = max(self._next_id, handle.request_id + 1)
         self.metrics.requests_submitted += 1

@@ -209,6 +209,21 @@ class RadixIndex:
             node = child
         return out
 
+    def match_len(self, tokens):
+        """Length in TOKENS of the longest cached full-block prefix of
+        ``tokens`` — a read-only probe (no LRU touch, no pool refs) for
+        the fleet router's prefix-affinity signal: probing every replica
+        per admission must not perturb any replica's eviction order."""
+        node = self.root
+        n = 0
+        for key in self._chunks(tokens):
+            child = node.children.get(key)
+            if child is None:
+                break
+            n += self.block_size
+            node = child
+        return n
+
     def insert(self, tokens, block_ids, pool):
         """Register ``tokens``' full blocks (already written to
         ``block_ids``, one per full chunk) for future sharing. Chunks

@@ -100,6 +100,10 @@ class EngineMetrics:
         self.pool_occupancy_sum = 0.0  # used/total blocks per sample
         self.pool_samples = 0
         self.pool_low_watermark = None  # min free blocks ever seen
+        # fleet identity (stamped by the engine; None standalone) —
+        # bench/chaos ledgers embedding a snapshot attribute it to the
+        # replica that produced it
+        self.replica = None
         # mesh geometry (stamped by the engine; tp=1 on single-device
         # engines) — surfaces underscoring at a glance in the profiler
         # serving line and the snapshot
@@ -189,6 +193,7 @@ class EngineMetrics:
                                 else round(itl * 1e3, 3)),
             "itl_p95_ms": (None if p95 is None
                            else round(p95 * 1e3, 3)),
+            "replica": self.replica,
             "tp": self.tp,
             "kv_pool_bytes_per_device": self.kv_pool_bytes_per_device,
             "collectives_per_decode_step":

@@ -98,9 +98,26 @@ class EngineSupervisor:
     def __init__(self, model, *, step_timeout_s=None, max_rebuilds=3,
                  retry_backoff_s=0.02, itl_slo_ms=None,
                  shed_protect_priority=0, kv_probe_interval=0,
-                 chaos=None, ledger=None, **engine_kwargs):
+                 chaos=None, ledger=None, replica_id=None,
+                 migrate_hook=None, **engine_kwargs):
         self._model = model
         self._engine_kwargs = dict(engine_kwargs)
+        #: fleet identity: stamped onto every engine incarnation (and
+        #: through it onto handles + overload exceptions); None when the
+        #: supervisor runs standalone
+        self.replica_id = replica_id
+        #: fleet failover hook: ``hook(supervisor, handles, why) ->
+        #: migrated_handles``. Called during rebuild-and-replay with the
+        #: surviving in-flight+queued handles BEFORE the local replay;
+        #: handles it absorbs (adopted onto healthy peer replicas) are
+        #: excluded from the local replay — the faulted replica rebuilds
+        #: empty and re-registers while its requests keep decoding
+        #: elsewhere. None (standalone) keeps PR-7 local replay.
+        self.migrate_hook = migrate_hook
+        # one-shot fleet-injected fault (ChaosMonkey fleet plans target a
+        # specific replica; the fleet injects here rather than giving
+        # every supervisor its own monkey)
+        self._pending_fault = None
         self.step_timeout_s = step_timeout_s
         self.max_rebuilds = int(max_rebuilds)
         self.retry_backoff_s = float(retry_backoff_s)
@@ -133,7 +150,23 @@ class EngineSupervisor:
         _register(self)
 
     def _build(self):
-        return Engine(self._model, **self._engine_kwargs)
+        return Engine(self._model, replica_id=self.replica_id,
+                      **self._engine_kwargs)
+
+    def inject(self, fault, trace_id=None):
+        """Arm a one-shot serving fault (``decode-stall`` /
+        ``decode-raise``) for the next supervised step — the
+        ReplicaFleet's chaos channel into a specific replica."""
+        self._pending_fault = fault
+        if trace_id is not None:
+            self._last_fault_trace_id = trace_id
+
+    def rebuild(self, why="requested"):
+        """Condemn the current engine incarnation and build a fresh one,
+        migrating/replaying survivors exactly like a detected fault —
+        the fleet's ``replica-kill`` path (a dead process can't run its
+        own ladder; the fleet drives the rebuild from outside)."""
+        self._rebuild_and_replay(why=why)
 
     # -- request intake ----------------------------------------------------
 
@@ -154,7 +187,8 @@ class EngineSupervisor:
                                retry_after_s=hint)
             raise EngineOverloaded(
                 f"brownout: ITL p95 over SLO — priority {priority} "
-                f"rejected; retry after ~{hint}s", retry_after_s=hint)
+                f"rejected; retry after ~{hint}s", retry_after_s=hint,
+                replica=self.replica_id)
         h = self.engine.submit(prompt, max_new_tokens, priority=priority,
                                **kw)
         h._engine = self      # result() pumps the SUPERVISED step
@@ -179,6 +213,9 @@ class EngineSupervisor:
             # the fault's trace id: anomaly/rebuild ledger records carry
             # it so a chaos run links to its spans (chaos verdicts too)
             self._last_fault_trace_id = self.chaos.last_trace_id
+        elif self._pending_fault is not None:
+            # fleet-injected one-shot fault (inject() set the trace id)
+            fault, self._pending_fault = self._pending_fault, None
         if fault == "kv-corrupt":
             try:
                 corrupt_kv(self.engine, seed=self.chaos.seed)
@@ -195,15 +232,18 @@ class EngineSupervisor:
             try:
                 if fault == "decode-stall":
                     fault = None
-                    time.sleep(self.chaos.stall_s)
+                    # chaos is None when the fault was fleet-injected
+                    stall = (self.chaos.stall_s if self.chaos is not None
+                             else 0.01)
+                    time.sleep(stall)
                     raise StallInjected(
-                        f"chaos: decode step wedged for "
-                        f"{self.chaos.stall_s}s (seed={self.chaos.seed})")
+                        f"chaos: decode step wedged for {stall}s "
+                        f"(replica={self.replica_id})")
                 if fault == "decode-raise":
                     fault = None
                     raise ChaosError(
                         f"chaos: decode step failed "
-                        f"(seed={self.chaos.seed})")
+                        f"(replica={self.replica_id})")
                 return self._engine_step()
             except Exception as e:
                 if isinstance(e, TimeoutError):
@@ -293,29 +333,42 @@ class EngineSupervisor:
         """Condemn the broken incarnation, build a fresh engine, and
         re-admit every surviving request: active handles re-prefill
         ``prompt + emitted`` with their PRNG chain fast-forwarded
-        (token-identical resume), queued ones re-enqueue untouched."""
+        (token-identical resume), queued ones re-enqueue untouched.
+        With a fleet ``migrate_hook``, survivors are first offered to
+        healthy peer replicas — whatever the hook absorbs keeps decoding
+        there (same token-identical adopt machinery) and this replica
+        rebuilds empty."""
         old = self.engine
         old._condemned = True
         actives = sorted((h for h in old._by_slot
                           if h is not None and not h.finished),
                          key=lambda h: h.request_id)
         queued = [h for h in list(old.scheduler._queue) if not h.finished]
+        survivors = actives + queued
         self.buckets_seen_total |= old.buckets_seen
         self.chunk_used_total |= bool(getattr(old, "chunk_used", False))
+        migrated = []
+        if self.migrate_hook is not None and survivors:
+            migrated = list(self.migrate_hook(self, survivors, why) or ())
+            gone = set(map(id, migrated))
+            survivors = [h for h in survivors if id(h) not in gone]
         self.engine = self._build()
         self.engine._next_id = old._next_id
         self.rebuilds += 1
-        self.ledger.record("rebuild", why=why, n_active=len(actives),
+        self.ledger.record("rebuild", why=why, replica=self.replica_id,
+                           n_active=len(actives),
                            n_queued=len(queued),
+                           n_migrated=len(migrated),
                            trace_id=self._last_fault_trace_id,
                            request_traces=[h.trace_id
                                            for h in actives + queued])
-        for h in actives + queued:
+        for h in survivors:
             if h.tokens:
                 self.replayed += 1
             self.engine.adopt(h)
             h._engine = self
-        self.ledger.record("replay", n=len(actives) + len(queued))
+        self.ledger.record("replay", n=len(survivors),
+                           migrated=len(migrated))
 
     def _abandon_one(self):
         """Chaos fault ``abandon``: the longest-running in-flight client
@@ -402,7 +455,7 @@ class EngineSupervisor:
                 "brownout_steps": self.brownout_steps}
 
     def stats(self):
-        return {**self.counters(),
+        return {**self.counters(), "replica": self.replica_id,
                 "brownout": self._brownout, "draining": self.draining,
                 "buckets_seen_total": sorted(
                     self.buckets_seen_total | self.engine.buckets_seen),

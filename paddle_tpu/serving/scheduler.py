@@ -29,12 +29,15 @@ class EngineOverloaded(RuntimeError):
 
     ``retry_after_s`` (when the engine has decode-latency history) is
     the estimated seconds until a slot frees — clients should back off
-    at least that long before resubmitting.
+    at least that long before resubmitting. ``replica`` names the fleet
+    replica that refused the request (None standalone; None also on a
+    fleet-wide rejection, where EVERY replica was browned out).
     """
 
-    def __init__(self, message, retry_after_s=None):
+    def __init__(self, message, retry_after_s=None, replica=None):
         super().__init__(message)
         self.retry_after_s = retry_after_s
+        self.replica = replica
 
 
 class FIFOScheduler:
@@ -78,7 +81,8 @@ class FIFOScheduler:
             raise EngineOverloaded(
                 f"serving queue full ({self.max_queue} waiting); retry "
                 f"after{hint or ' the engine drains'}",
-                retry_after_s=retry_after_s)
+                retry_after_s=retry_after_s,
+                replica=getattr(handle, "replica_id", None))
         self._queue.append(handle)
 
     def drop_expired(self, now):

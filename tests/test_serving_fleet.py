@@ -1,0 +1,518 @@
+"""Replica fleet (paddle_tpu.serving.fleet).
+
+The headline contract: kill / wedge / KV-corrupt ONE of N replicas
+mid-decode and every in-flight request still completes with output
+TOKEN-IDENTICAL to an uninterrupted single-engine baseline — the
+faulted replica's requests fail over to healthy peers via ``adopt()``
+(PRNG-chain fast-forward) while it drains, rebuilds, and re-registers,
+and zero requests are lost. Prefix-aware routing, jittered backoff
+honoring retry_after_s, fleet-wide-vs-per-replica brownout, the adopt
+fingerprint guard, audit_fleet budgeting and the metrics/profiler
+surface ride along.
+
+Kept slim for the tier-1 budget: one module-scope tiny model with the
+same geometry/statics as test_serving_resilience.py so the module-level
+jit programs are shared across test modules; the chaos soak and the
+mixed-tp sweep are marked slow.
+"""
+import dataclasses
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import paddle_tpu as paddle
+from paddle_tpu.resilience import FLEET_FAULTS, ChaosMonkey
+from paddle_tpu.serving import (AdoptMismatch, Engine, EngineDraining,
+                                EngineOverloaded, ReplicaFleet,
+                                RequestShed)
+from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CFG = dataclasses.replace(LLAMA_TINY, dtype="float32", num_hidden_layers=2)
+
+GREEDY = dict(n_slots=2, max_len=64, min_prompt_bucket=4, block_size=8)
+SAMPLED = dict(do_sample=True, top_k=8, **GREEDY)
+
+needs2 = pytest.mark.skipif(len(jax.devices()) < 2,
+                            reason="needs >= 2 virtual devices")
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(CFG)
+    m.eval()
+    return m
+
+
+def _prompts(lens, seed, shared_prefix=0):
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(0, CFG.vocab_size,
+                          (shared_prefix,)).astype(np.int32)
+    out = []
+    for n in lens:
+        tail = rng.integers(0, CFG.vocab_size, (int(n),)).astype(np.int32)
+        out.append(np.concatenate([prefix, tail]) if shared_prefix
+                   else tail)
+    return out
+
+
+def _staggered(server, prompts, gen):
+    """Same staggered schedule against an Engine or a ReplicaFleet: ≥3
+    requests at different decode positions when a mid-run fault fires."""
+    handles = []
+    handles.append(server.submit(prompts[0], **gen[0]))
+    server.step()
+    server.step()
+    handles.append(server.submit(prompts[1], **gen[1]))
+    server.step()
+    handles.append(server.submit(prompts[2], **gen[2]))
+    handles.append(server.submit(prompts[3], **gen[3]))
+    while any(not h.finished for h in handles):
+        server.step()
+    return handles
+
+
+_GEN = [dict(max_new_tokens=6, temperature=0.8, seed=11),
+        dict(max_new_tokens=6, temperature=1.2, seed=7),
+        dict(max_new_tokens=5, temperature=0.6, seed=3),
+        dict(max_new_tokens=4, temperature=1.0, seed=23)]
+
+
+# ---------------------------------------------------------------------------
+# headline: one replica faulted mid-decode -> cross-replica migration,
+# zero lost, token-identical to the single-engine baseline
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fault", ["replica-kill", "decode-stall",
+                                   "kv-corrupt"])
+def test_replica_fault_migrates_token_identical(model, fault):
+    prompts = _prompts([3, 4, 5, 6], seed=1, shared_prefix=8)
+    base = _staggered(Engine(model, **SAMPLED), prompts, _GEN)
+    want = [list(h.tokens) for h in base]
+
+    chaos = ChaosMonkey(seed=0, at={4: fault}, stall_s=0.01)
+    fleet = ReplicaFleet(model, 3, chaos=chaos, kv_probe_interval=1,
+                         **SAMPLED)
+    got = _staggered(fleet, prompts, _GEN)
+    assert [list(h.tokens) for h in got] == want
+    assert all(h.finish_reason == "length" for h in got)   # zero lost
+    assert chaos.fired == [(4, fault)]
+    assert fleet.migrations >= 1          # in-flight work moved to peers
+    # the faulted replica drained, rebuilt, and re-registered
+    assert fleet.re_registers == 1
+    assert all(s == "healthy" for s in fleet.replica_states().values())
+    counts = fleet.ledger.counts()
+    assert counts["migrate"] == fleet.migrations
+    assert counts["re-register"] == 1
+    if fault == "replica-kill":
+        assert fleet.replica_kills == 1
+    # pool hygiene on every replica after the fault + migration
+    assert all(r.engine.cache.check_refcounts()
+               for r in fleet.replicas.values())
+
+
+def test_migration_keeps_trace_id_and_replica_tag(model):
+    """A migrated handle keeps its lifecycle trace id (the PR-9
+    contract, now across REPLICAS) and its replica_id follows it to the
+    adopting peer; the fleet ledger's migrate record links both."""
+    prompts = _prompts([3, 4, 5, 6], seed=2, shared_prefix=8)
+    chaos = ChaosMonkey(seed=0, at={4: "replica-kill"})
+    fleet = ReplicaFleet(model, 3, chaos=chaos, **GREEDY)
+    handles = []
+    handles.append(fleet.submit(prompts[0], **_GEN[0]))
+    fleet.step()
+    fleet.step()
+    handles.append(fleet.submit(prompts[1], **_GEN[1]))
+    origins = {h.request_id: (h.trace_id, h.replica_id) for h in handles}
+    fleet.step()
+    fleet.step()      # step 3 ... chaos fires at fleet step 4
+    fleet.step()
+    assert fleet.replica_kills == 1 and fleet.migrations >= 1
+    migrated = [r for r in fleet.ledger.to_list()
+                if r["event"] == "migrate"]
+    assert migrated
+    for rec in migrated:
+        h = next(x for x in handles if x.request_id == rec["request_id"])
+        assert h.trace_id == rec["trace_id"]          # id survived
+        assert origins[h.request_id][0] == h.trace_id
+        assert rec["target"] == h.replica_id          # tag follows
+        assert rec["source"] != rec["target"]
+    while any(not h.finished for h in handles):
+        fleet.step()
+    assert all(h.finish_reason == "length" for h in handles)
+
+
+# ---------------------------------------------------------------------------
+# prefix-aware routing
+# ---------------------------------------------------------------------------
+
+def test_routing_prefers_prefix_holding_replica(model):
+    """A request whose prompt shares a full-block prefix with one
+    already served routes to THE replica whose radix holds it; a
+    prefix-less request balances to the least-loaded replica instead."""
+    fleet = ReplicaFleet(model, 3, **GREEDY)
+    shared = _prompts([3], seed=3, shared_prefix=8)[0]
+    h0 = fleet.submit(shared, max_new_tokens=2)
+    while not h0.finished:
+        fleet.step()
+    holder = h0.replica_id
+    # the prefix (one full 8-token block) is committed on `holder` only
+    h1 = fleet.submit(
+        np.concatenate([shared[:8],
+                        _prompts([4], seed=4)[0]]), max_new_tokens=2)
+    assert h1.replica_id == holder
+    assert fleet.prefix_routed == 1
+    route = [r for r in fleet.ledger.to_list() if r["event"] == "route"]
+    assert route[-1]["prefix_tokens"] == 8
+    # no prefix anywhere: load balances AWAY from the busy holder
+    h2 = fleet.submit(_prompts([5], seed=5)[0], max_new_tokens=2)
+    assert h2.replica_id != holder
+    while not (h1.finished and h2.finished):
+        fleet.step()
+    # the routing probe is read-only: refcounts/radix untouched
+    assert all(r.engine.cache.check_refcounts()
+               for r in fleet.replicas.values())
+
+
+def test_route_flap_does_not_change_tokens(model):
+    """Chaos route-flap randomizes placement; per-request PRNG chains
+    make tokens placement-independent, so output still matches the
+    single-engine baseline exactly."""
+    prompts = _prompts([3, 4, 5, 6], seed=6, shared_prefix=8)
+    want = [list(h.tokens)
+            for h in _staggered(Engine(model, **SAMPLED), prompts, _GEN)]
+    chaos = ChaosMonkey(seed=1, at={0: "route-flap"})
+    fleet = ReplicaFleet(model, 3, chaos=chaos, **SAMPLED)
+    got = _staggered(fleet, prompts, _GEN)
+    assert fleet.route_flaps == 1
+    assert [list(h.tokens) for h in got] == want
+
+
+# ---------------------------------------------------------------------------
+# brownout: one replica reroutes, ALL replicas shed fleet-wide
+# ---------------------------------------------------------------------------
+
+def test_one_browned_replica_reroutes_all_browned_sheds_fleet_wide(model):
+    prompts = _prompts([5, 5, 5, 5], seed=7)
+    fleet = ReplicaFleet(model, 2, n_slots=1, max_len=64,
+                         min_prompt_bucket=4, itl_slo_ms=50.0)
+    reps = list(fleet.replicas.values())
+    # occupy both replicas and queue one unprotected request on each
+    hogs = [fleet.submit(prompts[i], max_new_tokens=8, priority=0)
+            for i in range(2)]
+    lows = [fleet.submit(prompts[2 + i], max_new_tokens=4, priority=5)
+            for i in range(2)]
+    assert {h.replica_id for h in hogs} == {"r0", "r1"}
+    # ONE replica over its SLO: unprotected admission just routes to the
+    # healthy peer — nothing is shed fleet-wide
+    for _ in range(8):
+        reps[0].engine.metrics.mark_decode(0.5)
+    fleet.step()
+    assert reps[0].sup._brownout and not reps[1].sup._brownout
+    assert fleet.replica_states()["r0"] == "degraded"
+    h = fleet.submit(prompts[0], max_new_tokens=2, priority=5)
+    assert h.replica_id == "r1"
+    assert fleet.fleet_sheds == 0
+    fleet.cancel(h)
+    # BOTH replicas browned out: unprotected admission is rejected
+    # fleet-wide with a finite hint, and the lowest queued class is shed
+    # on EVERY replica
+    for _ in range(8):
+        reps[1].engine.metrics.mark_decode(0.5)
+    fleet.step()
+    assert all(r.sup._brownout for r in reps)
+    with pytest.raises(EngineOverloaded) as ei:
+        fleet.submit(prompts[0], max_new_tokens=2, priority=5)
+    assert ei.value.replica is None           # fleet-wide, not one replica
+    assert ei.value.retry_after_s is not None \
+        and np.isfinite(ei.value.retry_after_s)
+    still_queued = [h for h in lows if not h.finished]
+    assert not still_queued or fleet.fleet_sheds >= 1
+    shed = [h for h in lows if h.finish_reason == "shed"]
+    assert shed
+    with pytest.raises(RequestShed) as si:
+        shed[0].result()
+    assert si.value.replica == shed[0].replica_id is not None
+    # protected class still admits during the fleet brownout
+    hp = fleet.submit(prompts[1], max_new_tokens=2, priority=0)
+    assert hp.replica_id is not None
+    # recovery: p95 back under SLO on both -> healthy again
+    for r in reps:
+        for _ in range(64):
+            r.engine.metrics.mark_decode(0.001)
+    fleet.step()
+    assert all(s == "healthy" for s in fleet.replica_states().values())
+    fleet.drain()
+
+
+def test_backoff_honors_retry_after(model):
+    """A replica that rejects enters a jittered backoff window scaled
+    by its retry_after_s: the router skips it while the window holds
+    and returns to it after it elapses."""
+    p = _prompts([5], seed=8)[0]
+    fleet = ReplicaFleet(model, 2, n_slots=1, max_len=64,
+                         min_prompt_bucket=4, max_queue=1, seed=3,
+                         default_retry_after_s=0.05)
+    # fill r0 (slot + queue) so its next enqueue raises EngineOverloaded
+    h0 = fleet.submit(p, max_new_tokens=6)
+    h1 = fleet.submit(p, max_new_tokens=2)
+    first = h0.replica_id
+    assert h1.replica_id != first      # load-balanced, not backoff yet
+    h2 = fleet.submit(p, max_new_tokens=2)     # queues on one of them
+    h3 = fleet.submit(p, max_new_tokens=2)     # queues on the other
+    assert fleet.backoffs == 0
+    # both queues full now: the next submit hits a backoff on one
+    # replica, retries the peer, and ultimately raises fleet-wide
+    with pytest.raises(EngineOverloaded) as ei:
+        fleet.submit(p, max_new_tokens=2)
+    assert ei.value.replica is None
+    assert fleet.backoffs >= 1 and fleet.retries >= 1
+    # the window honors retry_after_s: deadline within (0.5, 1.0] x hint
+    now = time.monotonic()
+    for rid, until in fleet._backoff_until.items():
+        assert until <= now + 0.05 + 1e-3
+        assert until > now - 0.05
+    rec = [r for r in fleet.ledger.to_list() if r["event"] == "backoff"]
+    assert rec and rec[0]["retry_after_s"] is not None
+    time.sleep(0.06)                   # window elapses -> routable again
+    fleet.drain()
+    fleet.reopen()
+    h4 = fleet.submit(p, max_new_tokens=2)
+    assert h4.replica_id is not None
+    h4.result()
+
+
+# ---------------------------------------------------------------------------
+# drain / re-register / kill API
+# ---------------------------------------------------------------------------
+
+def test_kill_drain_reregister_and_fleet_drain(model):
+    prompts = _prompts([5, 5], seed=9)
+    fleet = ReplicaFleet(model, 2, cooldown_steps=3, **GREEDY)
+    h0 = fleet.submit(prompts[0], max_new_tokens=6)
+    victim = h0.replica_id
+    moved = fleet.kill_replica(victim)
+    assert moved == 1 and h0.replica_id != victim
+    assert fleet.replica_states()[victim] == "draining"
+    # draining replicas take no traffic
+    h1 = fleet.submit(prompts[1], max_new_tokens=2)
+    assert h1.replica_id != victim
+    for _ in range(3):
+        assert fleet.replica_states()[victim] == "draining"
+        fleet.step()
+    assert fleet.replica_states()[victim] == "healthy"
+    assert fleet.re_registers == 1
+    # fleet drain: everything finishes, admission closes, reopen works
+    report = fleet.drain()
+    assert report["drained"] and h0.finished and h1.finished
+    assert h0.finish_reason == "length"
+    with pytest.raises(EngineDraining):
+        fleet.submit(prompts[0], max_new_tokens=2)
+    fleet.reopen()
+    fleet.submit(prompts[0], max_new_tokens=2).result()
+
+
+# ---------------------------------------------------------------------------
+# adopt() fingerprint guard (satellite bugfix)
+# ---------------------------------------------------------------------------
+
+def test_adopt_guard_rejects_mismatched_model(model):
+    """adopt() refuses a handle from an engine over a DIFFERENT
+    model/config instead of silently producing divergent tokens; a
+    same-model engine (the migration case) adopts fine."""
+    paddle.seed(1)
+    other = LlamaForCausalLM(
+        dataclasses.replace(CFG, num_hidden_layers=1))
+    other.eval()
+    p = _prompts([5], seed=10)[0]
+    a = Engine(model, **GREEDY)
+    h = a.submit(p, max_new_tokens=6)
+    a.step()
+    a._condemned = True
+    b = Engine(other, **GREEDY)
+    with pytest.raises(AdoptMismatch, match="fingerprint"):
+        b.adopt(h)
+    # sampling statics are part of the fingerprint too: a do_sample
+    # engine must not adopt a greedy handle (different baked programs)
+    c = Engine(model, **SAMPLED)
+    with pytest.raises(AdoptMismatch):
+        c.adopt(h)
+    # the legitimate path: same model + statics, fresh engine
+    d = Engine(model, **GREEDY)
+    d.adopt(h)
+    base = Engine(model, **GREEDY).generate_all(
+        [p], max_new_tokens=6, seed=h.seed)[0]
+    assert list(h.result()[len(p):]) == list(base.tokens)
+
+
+# ---------------------------------------------------------------------------
+# analysis / metrics / profiler surface
+# ---------------------------------------------------------------------------
+
+def test_audit_fleet_budgets_union_across_replicas(model):
+    from paddle_tpu import analysis
+
+    chaos = ChaosMonkey(seed=0, at={3: "decode-raise"})
+    fleet = ReplicaFleet(model, 3, chaos=chaos, compile_budget=2,
+                         **GREEDY)
+    hs = [fleet.submit(_prompts([5], seed=11)[0], max_new_tokens=4)
+          for _ in range(3)]
+    for _ in range(2):
+        fleet.step()
+    while any(not h.finished for h in hs):
+        fleet.step()
+    rep = analysis.audit_fleet(fleet)
+    m = rep.metrics["compile-budget"]
+    # 3 replicas + a mid-run rebuild, ONE engine's program set
+    assert m["prefill_buckets"] == [8] and m["programs"] == 2
+    assert not [f for f in rep.findings
+                if f.rule_id == "compile-budget" and f.severity == "high"]
+    assert rep.metrics["fleet"]["n_replicas"] == 3
+    over = analysis.audit_fleet(fleet, compile_budget=1)
+    assert [f for f in over.findings
+            if f.rule_id == "compile-budget" and f.severity == "high"]
+
+
+def test_fleet_metrics_registry_and_profiler_line(model, capsys):
+    import paddle_tpu.profiler as profiler
+    from paddle_tpu import observability as obs
+
+    chaos = ChaosMonkey(seed=0, at={2: "replica-kill"})
+    fleet = ReplicaFleet(model, 2, chaos=chaos, **GREEDY)
+    h = fleet.submit(_prompts([5], seed=12)[0], max_new_tokens=6)
+    h.result()
+    c = profiler.fleet_counters()
+    assert c["fleets"] >= 1 and c["replica_kills"] >= 1
+    snap = obs.metrics.snapshot()
+    states = snap["paddle_serving_replica_state"]["samples"]
+    ours = [s for s in states
+            if s["labels"].get("fleet") == fleet.name]
+    assert {s["labels"]["replica"] for s in ours} == {"r0", "r1"}
+    assert all(s["value"] in (0.0, 1.0, 2.0, 3.0) for s in ours)
+    kinds = {s["labels"]["kind"]: s["value"] for s in
+             snap["paddle_serving_fleet_events_total"]["samples"]}
+    for k in ("routed", "prefix_routed", "migrations", "failovers",
+              "replica_kills", "route_flaps", "fleet_sheds", "backoffs"):
+        assert k in kinds
+    assert "paddle_serving_replica_state" in obs.metrics.to_prometheus()
+    # fleet-scope flight ledgers export separately from train/serving
+    assert snap["paddle_resilience_fleet_ledgers"]["samples"][0][
+        "value"] >= 1
+    prof = profiler.Profiler(timer_only=True)
+    prof.start()
+    prof.step()
+    prof.stop()
+    prof.summary()
+    out = capsys.readouterr().out
+    assert "fleet:" in out and "migrations=" in out
+
+
+def test_fleet_validation(model):
+    with pytest.raises(ValueError, match="n_replicas"):
+        ReplicaFleet(model, 0)
+    with pytest.raises(ValueError, match="tp_degrees"):
+        ReplicaFleet(model, 2, tp_degrees=[1])
+    with pytest.raises(ValueError):
+        ChaosMonkey(at={1: "replica-explode"})
+    assert set(FLEET_FAULTS) >= {"replica-kill", "route-flap"}
+
+
+# ---------------------------------------------------------------------------
+# chaos_serve --fleet CLI smoke (the tier-1 wiring)
+# ---------------------------------------------------------------------------
+
+def test_chaos_serve_fleet_cli_smoke(capsys):
+    import json
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import chaos_serve
+    finally:
+        sys.path.pop(0)
+    rc = chaos_serve.main(["--fleet", "3", "--fault", "kill", "--json"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and rec["ok"]
+    assert rec["token_identical"] and rec["zero_lost"]
+    for arm in ("greedy", "sampled"):
+        a = rec["arms"][arm]
+        assert a["replica_kills"] == 1 and a["migrations"] >= 1
+        assert a["fired"] == [[4, "replica-kill"]] \
+            or a["fired"] == [(4, "replica-kill")]
+
+
+# ---------------------------------------------------------------------------
+# slow: seeded chaos soak + mixed-tp fleet
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_soak_fleet_chaos_sweep(model):
+    """Seeded chaos over every fleet fault with random arrivals: all
+    requests finish token-identically to the uninterrupted baseline."""
+    rng = np.random.default_rng(13)
+    reqs = [(rng.integers(0, CFG.vocab_size, (int(n),)).astype(np.int32),
+             int(m), int(s))
+            for n, m, s in zip(rng.integers(4, 13, 16),
+                               rng.integers(2, 8, 16),
+                               rng.integers(0, 1 << 30, 16))]
+
+    def run(server):
+        handles = []
+        for i, (p, m, s) in enumerate(reqs):
+            handles.append(server.submit(p, max_new_tokens=m, seed=s,
+                                         temperature=0.9))
+            for _ in range(int(i % 3)):
+                server.step()
+        while any(not h.finished for h in handles):
+            server.step()
+        return handles
+
+    want = [list(h.tokens) for h in run(Engine(model, **SAMPLED))]
+    for seed in (1, 2, 3):
+        chaos = ChaosMonkey(seed=seed, p=0.12, faults=FLEET_FAULTS,
+                            stall_s=0.01, horizon=256)
+        fleet = ReplicaFleet(model, 3, chaos=chaos, kv_probe_interval=1,
+                             seed=seed, **SAMPLED)
+        got = run(fleet)
+        for i, h in enumerate(got):
+            assert list(h.tokens) == want[i], (seed, i, chaos.fired)
+        assert fleet.n_pending == 0
+        assert all(r.engine.cache.check_refcounts()
+                   for r in fleet.replicas.values())
+
+
+@pytest.mark.slow
+@needs2
+def test_mixed_tp_fleet_migration_parity(model):
+    """Mixed tp degrees in one fleet: a tp=2 replica's in-flight
+    requests migrate onto a tp=1 peer (adopt replays from tokens, not
+    KV bytes) and finish token-identically to the single-device
+    baseline — the tp-degree-crossing adopt parity regression."""
+    prompts = _prompts([3, 4, 5, 6], seed=14, shared_prefix=8)
+    want = [list(h.tokens)
+            for h in _staggered(Engine(model, **SAMPLED), prompts, _GEN)]
+    fleet = ReplicaFleet(model, 2, tp_degrees=[2, 1], **SAMPLED)
+    tp2 = fleet.replicas["r0"]
+    assert tp2.engine.tp == 2 and fleet.replicas["r1"].engine.tp == 1
+    handles = []
+    handles.append(fleet.submit(prompts[0], **_GEN[0]))
+    fleet.step()
+    fleet.step()
+    handles.append(fleet.submit(prompts[1], **_GEN[1]))
+    fleet.step()
+    # kill whichever replica holds in-flight work; at least one handle
+    # must cross a tp boundary over the two kills
+    fleet.kill_replica("r0")
+    handles.append(fleet.submit(prompts[2], **_GEN[2]))
+    fleet.step()
+    fleet.kill_replica("r1")
+    handles.append(fleet.submit(prompts[3], **_GEN[3]))
+    while any(not h.finished for h in handles):
+        fleet.step()
+    assert [list(h.tokens) for h in handles] == want
+    assert fleet.migrations >= 2
+    assert all(h.finish_reason == "length" for h in handles)

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
-"""chaos_serve — drive the serving EngineSupervisor through an injected
-fault and emit a JSON verdict ledger (the check_* tool contract;
-chaos_train.py's serving counterpart).
+"""chaos_serve — drive the serving EngineSupervisor (or a whole
+ReplicaFleet) through an injected fault and emit a JSON verdict ledger
+(the check_* tool contract; chaos_train.py's serving counterpart).
 
 A tiny llama serves a staggered, SAMPLED workload (per-request seeds, so
 the verdict also proves the PRNG-chain resume) twice: once uninterrupted
@@ -19,6 +19,17 @@ Faults: stall (wedged decode) | raise (decode error) | corrupt (KV slot
 poisoned; probe must detect before decode consumes it) | abandon (client
 disconnect mid-stream) | none. Exit code 0 iff the run recovered with
 token-identical survivors.
+
+``--fleet N`` runs the fleet verdict instead: N supervised replicas
+behind a ``ReplicaFleet`` serve the shared-prefix workload GREEDY and
+SAMPLED while the fault (kill = replica-kill | stall | raise | corrupt |
+flap = route-flap | none) fires mid-decode into one replica. The verdict
+asserts ``zero_lost`` (every request finishes) and ``token_identical``
+(every output equals the uninterrupted SINGLE-ENGINE baseline — the
+in-flight requests of the faulted replica complete via cross-replica
+``adopt()`` migration) in BOTH arms:
+
+    JAX_PLATFORMS=cpu python tools/chaos_serve.py --fleet 3 --fault kill
 """
 import argparse
 import json
@@ -30,6 +41,9 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 _FAULT_MAP = {"stall": "decode-stall", "raise": "decode-raise",
               "corrupt": "kv-corrupt", "abandon": "abandon"}
+_FLEET_FAULT_MAP = {"kill": "replica-kill", "stall": "decode-stall",
+                    "raise": "decode-raise", "corrupt": "kv-corrupt",
+                    "flap": "route-flap"}
 
 
 def _workload(seed):
@@ -146,22 +160,142 @@ def _verdict(fault, step, seed, stall_s):
     }
 
 
+def _fleet_verdict(fault, step, seed, stall_s, n_replicas):
+    """The fleet robustness headline, both sampling modes: kill / wedge
+    / corrupt one of N replicas mid-decode (or flap the router) — zero
+    requests lost, every output token-identical to an uninterrupted
+    single-engine baseline, replicas re-registered, pools consistent."""
+    import dataclasses
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
+    from paddle_tpu.resilience import ChaosMonkey
+    from paddle_tpu.serving import Engine, ReplicaFleet
+
+    from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+    obs.enable_tracing()
+    cfg = dataclasses.replace(LLAMA_TINY, dtype="float32",
+                              num_hidden_layers=2)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    reqs, schedule = _workload(seed)
+    chaos_fault = _FLEET_FAULT_MAP.get(fault)
+
+    arms = {}
+    for arm, sample_kw in (("greedy", {}),
+                           ("sampled", dict(do_sample=True, top_k=8))):
+        kw = dict(n_slots=2, max_len=64, min_prompt_bucket=4,
+                  block_size=8, **sample_kw)
+        baseline = _run(Engine(model, **kw), reqs, schedule)
+        base_tokens = [list(h.tokens) for h in baseline]
+
+        chaos = ChaosMonkey(seed=seed,
+                            at=({int(step): chaos_fault}
+                                if chaos_fault else {}),
+                            stall_s=stall_s)
+        fleet = ReplicaFleet(model, n_replicas, chaos=chaos,
+                             kv_probe_interval=1, **kw)
+        handles = _run(fleet, reqs, schedule)
+        trace_pre = [h.trace_id for h in handles]
+
+        lost = [i for i, h in enumerate(handles)
+                if h.finish_reason != "length"]
+        mismatches = [i for i, h in enumerate(handles)
+                      if list(h.tokens) != base_tokens[i]]
+        refcounts_ok = all(
+            r.engine.cache.check_refcounts()
+            for r in fleet.replicas.values())
+        states = fleet.replica_states()
+        c = fleet.counters()
+        # fault-specific evidence that the injection actually happened
+        # and was recovered from (not silently skipped)
+        evidence = {
+            "kill": c["replica_kills"] > 0 and c["migrations"] > 0,
+            "stall": c["migrations"] > 0,
+            "raise": c["migrations"] > 0,
+            "corrupt": sum(r.sup.kv_corruptions
+                           for r in fleet.replicas.values()) > 0,
+            "flap": c["route_flaps"] > 0,
+            "none": True,
+        }[fault]
+        arm_ok = (not lost and not mismatches and refcounts_ok
+                  and evidence and fleet.n_pending == 0
+                  and c["condemned"] == 0
+                  and all(s == "healthy" for s in states.values())
+                  and [h.trace_id for h in handles] == trace_pre)
+        arms[arm] = {
+            "fired": list(chaos.fired), "lost": lost,
+            "mismatched_requests": mismatches,
+            "token_identical": not mismatches,
+            "zero_lost": not lost,
+            "migrations": c["migrations"],
+            "replica_kills": c["replica_kills"],
+            "route_flaps": c["route_flaps"],
+            "prefix_routed": c["prefix_routed"],
+            "re_registers": c["re_registers"],
+            "states": states,
+            "refcounts_consistent": refcounts_ok,
+            "request_trace_ids": trace_pre,
+            "ledger": fleet.ledger.counts(),
+            "ok": bool(arm_ok),
+        }
+    ok = all(a["ok"] for a in arms.values())
+    return {
+        "fleet": n_replicas, "fault": fault, "injected_step": step,
+        "seed": seed, "requests": len(reqs),
+        "token_identical": all(a["token_identical"]
+                               for a in arms.values()),
+        "zero_lost": all(a["zero_lost"] for a in arms.values()),
+        "arms": arms, "ok": bool(ok),
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="chaos_serve",
         description="deterministic serving chaos vs the engine "
-        "supervisor (JSON verdict ledger)")
+        "supervisor / replica fleet (JSON verdict ledger)")
     ap.add_argument("--fault", default="stall",
                     choices=("stall", "raise", "corrupt", "abandon",
-                             "none"))
+                             "kill", "flap", "none"))
     ap.add_argument("--step", type=int, default=4,
                     help="0-based supervised step at which the fault "
                     "fires (mid-decode for the default workload)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--stall-s", type=float, default=0.05)
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="fleet mode: N supervised replicas behind a "
+                    "ReplicaFleet; faults kill/stall/raise/corrupt/"
+                    "flap; verdict = zero_lost + token_identical vs a "
+                    "single-engine baseline, greedy AND sampled")
     ap.add_argument("--json", action="store_true", help="emit a JSON line")
     args = ap.parse_args(argv)
 
+    if args.fleet:
+        if args.fault == "abandon":
+            ap.error("--fleet has no abandon fault (use the "
+                     "single-engine mode)")
+        record = {"bench": "chaos_serve_fleet",
+                  **_fleet_verdict(args.fault, args.step, args.seed,
+                                   args.stall_s, args.fleet)}
+        if args.json:
+            print(json.dumps(record, default=str))
+        else:
+            for k in ("fault", "injected_step", "requests",
+                      "token_identical", "zero_lost"):
+                print(f"{k:18s} {record[k]}")
+            for arm, a in record["arms"].items():
+                print(f"{arm:>8s}: migrations={a['migrations']} "
+                      f"kills={a['replica_kills']} states={a['states']}")
+            print("OK (fleet recovered, token-identical, zero lost)"
+                  if record["ok"] else
+                  "FAIL: fleet lost requests or diverged")
+        return 0 if record["ok"] else 1
+
+    if args.fault in ("kill", "flap"):
+        ap.error(f"--fault {args.fault} requires --fleet N")
     record = {"bench": "chaos_serve",
               **_verdict(args.fault, args.step, args.seed, args.stall_s)}
     if args.json:

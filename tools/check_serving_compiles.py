@@ -29,11 +29,19 @@ token-identical output to the single-device engine AND batch
 ``unoverlapped-collective`` findings while a seeded serial
 ``psum(x @ w)`` program IS caught by the same rule.
 
+``--fleet N`` is the replica-fleet contract: the SAME staggered
+workload routed through a ``ReplicaFleet`` of N replicas in one process
+must compile exactly the single-engine program set (module-level jitted
+programs are shared across replicas — 0 extra lowerings, gated against
+a fresh single engine's budget), do 0 warm compiles on a second pass,
+and keep every request token-identical to batch ``generate()``.
+
 Modeled on tools/check_retrace.py. Usage:
 
     JAX_PLATFORMS=cpu python tools/check_serving_compiles.py [--json]
     JAX_PLATFORMS=cpu python tools/check_serving_compiles.py --warm-cache
     JAX_PLATFORMS=cpu python tools/check_serving_compiles.py --mesh 4
+    JAX_PLATFORMS=cpu python tools/check_serving_compiles.py --fleet 3
 """
 import argparse
 import json
@@ -250,6 +258,106 @@ def run_mesh(args):
     return 0 if ok else 1
 
 
+def run_fleet(args):
+    """Replica-fleet compile contract: N replicas in one process pay
+    for exactly ONE engine's program set (cold == single-engine budget,
+    0 extra lowerings from replication or rebuild), 0 warm compiles,
+    full token parity vs batch generate()."""
+    import dataclasses
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from paddle_tpu.serving import ReplicaFleet
+    from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+    counter = analysis.CompileEventCounter().install()
+    have_monitor = counter.available
+
+    cfg = dataclasses.replace(LLAMA_TINY, dtype="float32",
+                              num_hidden_layers=2)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    min_bucket = 8
+    lens = [5 + (i % 8) for i in range(args.requests)]
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in lens]
+    new_tokens = [3 + (i % (args.max_new - 2))
+                  for i in range(args.requests)]
+    n_buckets = len({max(min_bucket, 1 << (n - 1).bit_length())
+                     for n in lens})
+    budget = n_buckets + 1          # the SINGLE-engine program set
+
+    def drive(fleet):
+        handles = []
+        it = iter(range(args.requests))
+        for i in (next(it), next(it), next(it)):
+            handles.append(fleet.submit(prompts[i],
+                                        max_new_tokens=new_tokens[i]))
+        for i in it:
+            fleet.step()
+            handles.append(fleet.submit(prompts[i],
+                                        max_new_tokens=new_tokens[i]))
+        fleet.drain()
+        fleet.reopen()
+        return handles
+
+    fleet = ReplicaFleet(model, args.fleet, n_slots=args.slots,
+                         max_len=64, min_prompt_bucket=min_bucket,
+                         compile_budget=budget)
+    counter.reset()
+    handles = drive(fleet)
+    cold_compiles = counter.count
+    counter.reset()
+    handles2 = drive(fleet)
+    warm_compiles = counter.count
+
+    mismatches = []
+    for run in (handles, handles2):
+        for h, p in zip(run, prompts):
+            want = np.asarray(model.generate(
+                paddle.to_tensor(p[None]),
+                max_new_tokens=h.max_new_tokens)._data)[0, len(p):]
+            if not np.array_equal(np.asarray(h.tokens, np.int32), want):
+                mismatches.append(h.request_id)
+    spread = {rid: r["requests_completed"] + r["active"]
+              for rid, r in ((rep.id, rep.engine.stats())
+                             for rep in fleet.replicas.values())}
+    rep = analysis.audit_fleet(fleet)
+    budget_high = [f for f in rep.findings
+                   if f.rule_id == "compile-budget"
+                   and f.severity == "high"]
+    ok = ((not have_monitor or (cold_compiles <= budget
+                                and warm_compiles == 0))
+          and not mismatches and not budget_high
+          and sum(1 for n in spread.values() if n > 0) > 1)
+    record = {
+        "bench": "serving_compile_fleet", "replicas": args.fleet,
+        "requests": args.requests, "prompt_buckets": n_buckets,
+        "compile_budget": budget,
+        "cold_compiles": cold_compiles if have_monitor else None,
+        "warm_compiles": warm_compiles if have_monitor else None,
+        "greedy_mismatches": mismatches,
+        "requests_per_replica": spread,
+        "budget_metrics": rep.metrics.get("compile-budget"),
+        "fleet": fleet.stats(), "ok": ok,
+    }
+    if args.json:
+        print(json.dumps(record, default=str))
+    else:
+        print(f"replicas {args.fleet}  single-engine budget {budget}")
+        print(f"cold compiles   {record['cold_compiles']}")
+        print(f"warm compiles   {record['warm_compiles']}")
+        print(f"spread          {spread}")
+        print(f"parity          {'OK' if not mismatches else mismatches}")
+        print("OK (N replicas = one engine's programs)" if ok else
+              "FAIL: fleet recompiles or diverges")
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", action="store_true", help="emit a JSON line")
@@ -262,7 +370,14 @@ def main():
     ap.add_argument("--mesh", type=int, default=0, metavar="N",
                     help="tensor-parallel mode: N virtual devices, "
                          "tp=N engine vs single-device parity + budget")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="replica-fleet mode: N replicas in one "
+                         "process must compile exactly the "
+                         "single-engine program set, 0 warm")
     args = ap.parse_args()
+
+    if args.fleet:
+        return run_fleet(args)
 
     if args.mesh:
         # must win before the first jax import in this process
