@@ -283,9 +283,58 @@ def bench_ernie_moe(backend):
     labels = paddle_tpu.to_tensor(
         rng.integers(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32))
     dt, _ = _timed_steps(lambda: step(ids, labels), n_steps)
-    return {"tokens_per_sec": round(batch * seqlen * n_steps / dt, 1),
-            "ms_per_step": round(dt / n_steps * 1000, 1),
-            "batch": batch, "seqlen": seqlen}
+    out = {"tokens_per_sec": round(batch * seqlen * n_steps / dt, 1),
+           "ms_per_step": round(dt / n_steps * 1000, 1),
+           "batch": batch, "seqlen": seqlen}
+    if backend == "tpu":
+        out["ragged_kernel"] = _bench_moe_ragged_kernel(cfg, batch, seqlen)
+    return out
+
+
+def _bench_moe_ragged_kernel(cfg, batch, seqlen):
+    """Un-starved (ISSUE 14): expert-FFN grouped matmul at this config's
+    dispatch shapes — XLA batched einsum over the full capacity vs the
+    pallas ragged kernel (tuner-elected tiles) under 2:1 imbalanced
+    routing, where skipping dead row tiles is the whole point."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import tuner
+    from paddle_tpu.ops.pallas.ragged_matmul import (
+        ragged_group_matmul, ragged_group_matmul_reference)
+
+    E = cfg.num_experts
+    S = batch * seqlen
+    C = max(4, int(np.ceil(2 * S * 1.25 / E)))     # k=2 gate capacity
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((E, C, cfg.hidden_size)),
+                    jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal(
+        (E, cfg.hidden_size, cfg.intermediate_size)) * 0.02, jnp.bfloat16)
+    # imbalanced live counts: half the experts loaded 2:1
+    counts = jnp.asarray([C if e % 2 == 0 else C // 2 for e in range(E)],
+                         jnp.int32)
+    tuned = tuner.tune("ragged_matmul", args=(x, w, counts),
+                       mode="measured")
+    bm, bn = tuned.config["block_m"], tuned.config["block_n"]
+
+    def timed(f, n=20):
+        out = f()
+        _sync(out)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = f()
+        _sync(out)
+        return (time.perf_counter() - t0) / n * 1e3
+
+    f_e = jax.jit(lambda: ragged_group_matmul_reference(x, w, counts))
+    f_r = jax.jit(lambda: ragged_group_matmul(x, w, counts, block_m=bm,
+                                              block_n=bn))
+    t_e, t_r = timed(f_e), timed(f_r)
+    return {"einsum_ms": round(t_e, 3), "ragged_ms": round(t_r, 3),
+            "speedup": round(t_e / t_r, 2),
+            "tuner_config": tuned.config, "tuner_mode": tuned.mode,
+            "tuner_n_configs": tuned.n_configs,
+            "shape": [E, C, cfg.hidden_size, cfg.intermediate_size]}
 
 
 def bench_llama_long_context(backend):
@@ -467,10 +516,39 @@ def bench_kernels(backend):
         r = stochastic_round(w, jnp.bfloat16, seed=7)
         _sync(r.astype(jnp.float32))
 
+    def _flash_decode():
+        from paddle_tpu.ops.pallas.flash_decode import flash_decode
+        S, H, n_kv, hd, nb, bs, mb = 8, 16, 16, 128, 65, 16, 16
+        q = jnp.asarray(rng.standard_normal((S, H, hd)), jnp.bfloat16)
+        kc = jnp.asarray(rng.standard_normal((nb, bs, n_kv, hd)),
+                         jnp.bfloat16)
+        tables = jnp.asarray(rng.integers(1, nb, (S, mb)), np.int32)
+        wp = jnp.asarray(rng.integers(0, mb * bs, (S,)), np.int32)
+        _sync(flash_decode(q, kc, kc, tables, wp, kv_heads_per_step=4))
+
+    def _ragged():
+        from paddle_tpu.ops.pallas.ragged_matmul import ragged_group_matmul
+        x = jnp.asarray(rng.standard_normal((8, 256, 512)), jnp.bfloat16)
+        w = jnp.asarray(rng.standard_normal((8, 512, 512)) * 0.02,
+                        jnp.bfloat16)
+        counts = jnp.asarray([256, 0, 128, 256, 64, 8, 200, 31], np.int32)
+        _sync(ragged_group_matmul(x, w, counts, block_m=128, block_n=256))
+
+    def _fused_ce():
+        from paddle_tpu.ops.pallas.fused_ce import fused_ce_loss
+        h = jnp.asarray(rng.standard_normal((256, 512)), jnp.bfloat16)
+        w = jnp.asarray(rng.standard_normal((512, 4096)) * 0.02,
+                        jnp.bfloat16)
+        lab = jnp.asarray(rng.integers(0, 4096, (256,)), np.int32)
+        _sync(fused_ce_loss(h, w, lab, 128, 1024, False))
+
     gate("flash_fwd", _flash_fwd)
     gate("flash_bwd", _flash_bwd)
     gate("int8_matmul", _int8)
     gate("stochastic_round", _stochrnd)
+    gate("flash_decode", _flash_decode)
+    gate("ragged_matmul", _ragged)
+    gate("fused_ce", _fused_ce)
     return out
 
 
@@ -537,22 +615,49 @@ def bench_flash_blocks(backend):
 
 
 def bench_llama_fused_ce(backend):
-    """A/B the chunked fused vocab-projection CE against the headline
-    (which uses PADDLE_TPU_BENCH_FUSED_CE). Same model/shapes as the
-    headline; compare tokens_per_sec to decide the default."""
+    """Un-starved (ISSUE 14): a kernel-level A/B at the headline LM-head
+    shapes [N=B*L, H] x [H, V] — dense logits+CE vs the chunked-scan
+    fused CE vs the new pallas ``fused_ce_loss`` (tuner-elected tile
+    config, searched on-device first), fwd+bwd each. Records the tuner's
+    choice in the arm's ledger entry."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import tuner
+    from paddle_tpu.nn.functional.fused_ce import _fused_raw
+    from paddle_tpu.ops.pallas.fused_ce import (fused_ce_loss,
+                                                fused_ce_reference)
+
     if backend != "tpu":
         return {"skipped": "tpu only"}
-    prev = os.environ.get("PADDLE_TPU_BENCH_FUSED_CE")
-    # bench_llama defaults the env to "0" — flip relative to that default
-    flip = "1" if (prev or "0") == "0" else "0"
-    os.environ["PADDLE_TPU_BENCH_FUSED_CE"] = flip
-    try:
-        return bench_llama(backend)  # records the resolved fused_ce_chunk
-    finally:
-        if prev is None:
-            os.environ.pop("PADDLE_TPU_BENCH_FUSED_CE", None)
-        else:
-            os.environ["PADDLE_TPU_BENCH_FUSED_CE"] = prev
+    rng = np.random.default_rng(0)
+    N, H, V = 4 * 2048, 2048, 32000          # headline batch*seq, dims
+    h = jnp.asarray(rng.standard_normal((N, H)), jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((H, V)) * 0.02, jnp.bfloat16)
+    lab = jnp.asarray(rng.integers(0, V, (N,)), jnp.int32)
+
+    tuned = tuner.tune("fused_ce", args=(h, w, lab), mode="measured")
+    cfg = tuned.config
+
+    def timed(f, n=10):
+        vg = jax.jit(jax.value_and_grad(f, argnums=(0, 1)))
+        _sync(vg(h, w)[0])                    # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            v, g = vg(h, w)
+        _sync(v)
+        return (time.perf_counter() - t0) / n * 1e3
+
+    t_dense = timed(lambda h, w: fused_ce_reference(h, w, lab))
+    t_chunk = timed(lambda h, w: _fused_raw(h, w, lab, 8192))
+    t_pallas = timed(lambda h, w: fused_ce_loss(
+        h, w, lab, cfg["block_n"], cfg["block_v"], False))
+    return {"dense_ms": round(t_dense, 2),
+            "chunked_scan_ms": round(t_chunk, 2),
+            "pallas_ms": round(t_pallas, 2),
+            "speedup_vs_dense": round(t_dense / t_pallas, 2),
+            "tuner_config": cfg, "tuner_mode": tuned.mode,
+            "tuner_n_configs": tuned.n_configs,
+            "shape": [N, H, V]}
 
 
 def bench_serving(backend):
@@ -637,6 +742,51 @@ def bench_serving_paged(backend):
     out = prefix_reuse_sweep(model, cfg, n_requests=32, max_new=32,
                              slot_slots=8, max_len=256, block_size=32,
                              sys_len=192, tail_len=16)
+    return out
+
+
+def bench_serving_flash_decode(backend):
+    """Flash-decode serving A/B (ISSUE 14 kernel a): the same
+    mixed-prompt workload through the paged engine with the gathered
+    XLA decode attention vs the pallas flash-decode kernel. ok requires
+    token-identical output; reports decode tokens/sec and ITL both
+    ways."""
+    import paddle_tpu
+    from paddle_tpu.serving import Engine, ledger
+    from paddle_tpu.text.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if backend != "tpu":
+        return {"skipped": "tpu only"}
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5504, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=16,
+                      max_position_embeddings=512, dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    n_req, max_new = 16, 64
+    rng = np.random.default_rng(0)
+    lens = [(48, 96, 120, 128)[i % 4] for i in range(n_req)]
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in lens]
+    out = {}
+    toks = {}
+    for name, flash in (("gathered", False), ("flash", True)):
+        eng = Engine(model, n_slots=8, max_len=256, min_prompt_bucket=64,
+                     block_size=32, flash_decode=flash)
+        eng.generate_all(prompts, max_new_tokens=max_new)       # warm
+        t0 = time.perf_counter()
+        handles = eng.generate_all(prompts, max_new_tokens=max_new)
+        wall = time.perf_counter() - t0
+        led = ledger(handles)
+        toks[name] = [h.result().tolist() for h in handles]
+        out[name] = {"tokens_per_sec": round(n_req * max_new / wall, 1),
+                     "itl_ms_p50": led.get("itl_ms_p50"),
+                     "itl_ms_p95": led.get("itl_ms_p95")}
+    out["token_identical"] = toks["gathered"] == toks["flash"]
+    out["speedup"] = round(out["flash"]["tokens_per_sec"]
+                           / out["gathered"]["tokens_per_sec"], 3)
+    out["ok"] = bool(out["token_identical"])
     return out
 
 
@@ -1023,6 +1173,8 @@ def main():
                          ("ctr_widedeep", bench_ctr_widedeep),
                          ("serving_engine", bench_serving),
                          ("serving_paged", bench_serving_paged),
+                         ("serving_flash_decode",
+                          bench_serving_flash_decode),
                          ("serving_tp", bench_serving_tp),
                          ("multichip_commopt", bench_multichip_commopt),
                          ("coldstart", bench_coldstart),

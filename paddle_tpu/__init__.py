@@ -60,6 +60,7 @@ from . import sparse  # noqa: F401
 from . import static  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import text  # noqa: F401
+from . import tuner  # noqa: F401
 from . import utils  # noqa: F401
 from . import vision  # noqa: F401
 

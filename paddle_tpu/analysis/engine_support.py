@@ -26,8 +26,8 @@ def lower_decode_program(engine) -> str:
     import jax
     import jax.numpy as jnp
 
-    from ..serving.engine import (_PAGED_STATICS, _STATICS, _decode_impl,
-                                  _paged_decode_impl)
+    from ..serving.engine import (_PAGED_DECODE_STATICS, _STATICS,
+                                  _decode_impl, _paged_decode_impl)
 
     if getattr(engine, "tp", 1) > 1:
         # the engine's own jitted shard_map program (statics baked):
@@ -48,8 +48,8 @@ def lower_decode_program(engine) -> str:
                 jnp.asarray(engine.cache.active),
                 jnp.asarray(engine._keys), jnp.asarray(engine._temps))
         lowered = jax.jit(_paged_decode_impl,
-                          static_argnames=_PAGED_STATICS).lower(
-            *args, **engine._paged_statics)
+                          static_argnames=_PAGED_DECODE_STATICS).lower(
+            *args, **engine._decode_statics)
         return lowered.as_text()
     args = (engine._w, jnp.asarray(engine.cache.kc),
             jnp.asarray(engine.cache.vc), jnp.asarray(engine._tok),

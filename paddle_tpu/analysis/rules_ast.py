@@ -446,7 +446,69 @@ def _fp64_ast(sf):
             yield f
 
 
-# -- 7. serial collectives wrapping matmuls (AST facet) ----------------------
+# -- 7. literal tile/block sizes at pallas kernel call sites -----------------
+
+#: public entry points of the tuner-registered pallas suite (plus raw
+#: pallas_call): tile choices at these call sites belong to the tuner
+_TUNED_KERNEL_CALLS = {
+    "flash_attention", "int8_matmul_rescale", "int8_linear",
+    "flash_decode", "ragged_group_matmul", "ragged_dot",
+    "fused_ce_stats", "fused_ce_loss", "sharded_vocab_ce", "pallas_call",
+}
+_TILE_KWARG_RE = re.compile(r"^(block_[a-z0-9]+|kv_heads_per_step)$")
+
+
+def _is_int_literal(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return True
+    return (isinstance(node, ast.Tuple)
+            and node.elts
+            and all(_is_int_literal(e) for e in node.elts))
+
+
+@rule("untuned-kernel-config", kind="ast", severity="medium",
+      title="literal tile/block size at a pallas kernel call site "
+            "outside the tuner registry — hand-picked configs bypass "
+            "the search (CUDA-L2: searched beats hand-picked)")
+def _untuned_kernel_config(sf):
+    """A ``block_*=128``-style integer literal passed to a
+    tuner-registered kernel bakes one tiling for every shape; the call
+    site should resolve its config through ``paddle_tpu.tuner``
+    (``get_config``/``call``) so searched winners and persisted tuned
+    configs apply. The tuner registry itself (``paddle_tpu/tuner/``)
+    owns its literal spaces; other intentional literals — references,
+    test fixtures, docs — annotate with
+    ``# tpu_lint: allow(untuned-kernel-config)``."""
+    if sf.tree is None:
+        return
+    path = sf.path.replace("\\", "/")
+    if "/tuner/" in path or path.endswith("/tuner"):
+        return        # the registry IS where literal spaces live
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call)
+                and _call_name(node) in _TUNED_KERNEL_CALLS):
+            continue
+        for kw in node.keywords:
+            if kw.arg is None or not _TILE_KWARG_RE.match(kw.arg):
+                continue
+            if not _is_int_literal(kw.value):
+                continue
+            f = _finding(
+                sf, "untuned-kernel-config", "medium", node,
+                f"{_call_name(node)}({kw.arg}=<literal>) pins a "
+                "hand-picked tile size at the call site — the tuner's "
+                "searched/persisted config for the shape never applies",
+                "resolve the config via paddle_tpu.tuner.get_config "
+                "(or route the call through tuner.call); intentional "
+                "literals annotate with  "
+                "# tpu_lint: allow(untuned-kernel-config)")
+            if f:
+                yield f
+            break     # one finding per call site is enough
+
+
+# -- 8. serial collectives wrapping matmuls (AST facet) ----------------------
 
 _COLLECTIVE_CALLS = {"psum", "all_gather", "reduce_scatter",
                      "psum_scatter", "all_to_all"}

@@ -6,10 +6,45 @@ already computes an analytical cost model for every compiled executable,
 so this asks the compiler (``jax.jit(...).lower().compile()
 .cost_analysis()``) instead of timing kernels, and falls back to wall-time
 profiling when asked.
+
+Since ISSUE 14 this module is also the kernel autotuner's OFFLINE ranker
+(``paddle_tpu.tuner`` with no accelerator up): a candidate tile config's
+score is the shape's config-independent ``cost_analysis()`` base (when
+available) times three deterministic penalty terms —
+
+* **tile alignment** — tile dims that aren't multiples of their
+  hardware alignment (sublane x lane minima per dtype) pay the padding
+  waste they'd cause on the MXU/VPU;
+* **VMEM footprint** — a config whose resident blocks exceed the
+  ~16 MB/core VMEM budget would spill (or refuse to compile) on real
+  hardware and is pushed to the back of the ranking;
+* **grid overhead** — a mild per-grid-step term so degenerate
+  tiny-tile configs don't tie with sane ones.
+
+Scores are pure functions of (features, base): the same space ranks
+identically in every process, which is what makes the offline winner
+deterministic and cacheable.
 """
 from __future__ import annotations
 
 import time
+
+#: per-core VMEM budget the penalty model assumes (v4/v5e class)
+VMEM_LIMIT_BYTES = 16 * 1024 * 1024
+
+#: min (sublane, lane) tile per dtype itemsize — the pallas guide's
+#: tiling table; itemsizes not listed fall back to fp32's (8, 128)
+_MIN_TILE_BY_ITEMSIZE = {4: (8, 128), 2: (16, 128), 1: (32, 128)}
+
+
+def min_tile(itemsize: int):
+    """(sublane, lane) hardware tile minimum for an operand itemsize."""
+    return _MIN_TILE_BY_ITEMSIZE.get(int(itemsize), (8, 128))
+
+
+def _unwrap(x):
+    """Tensor-like wrappers expose the device array as ``._data``."""
+    return getattr(x, "_data", x)
 
 
 class CostModel:
@@ -20,19 +55,37 @@ class CostModel:
         return {}
 
     def profile_measure(self, fn, args=(), kwargs=None, device="tpu",
-                        fetch_cost_list=("time",), warmup=1, iters=10):
-        """Measure a python callable's wall time (compiled path included)."""
-        kwargs = kwargs or {}
+                        fetch_cost_list=("time",), warmup=1, iters=10,
+                        batches=1):
+        """Measure a python callable's wall time (compiled path included).
+
+        Blocks on the WHOLE output pytree (tuple/dict/Tensor outputs all
+        synchronize — timing only the first leaf under-reports on
+        multi-output programs). With ``batches > 1`` the call runs
+        ``batches`` independent batches of ``iters`` and also reports
+        ``time_min`` — the min-of-batches mean, the noise-robust figure
+        the tuner and the observability overhead claims rank on."""
         import jax
+        kwargs = kwargs or {}
+
+        def sync(out):
+            jax.block_until_ready(
+                jax.tree_util.tree_map(_unwrap, out))
+
         for _ in range(warmup):
             out = fn(*args, **kwargs)
         if warmup:
-            jax.block_until_ready(getattr(out, "_data", out))
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(*args, **kwargs)
-        jax.block_until_ready(getattr(out, "_data", out))
-        return {"time": (time.perf_counter() - t0) / iters}
+            sync(out)
+        per_batch = []
+        for _ in range(max(1, int(batches))):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(*args, **kwargs)
+            sync(out)
+            per_batch.append((time.perf_counter() - t0) / iters)
+        return {"time": sum(per_batch) / len(per_batch),
+                "time_min": min(per_batch),
+                "batches": per_batch}
 
     def xla_cost(self, fn, *example_args):
         """Analytical cost of a jittable raw-array function: flops, bytes
@@ -48,3 +101,45 @@ class CostModel:
             "optimal_seconds": float(ca.get("optimal_seconds", -1.0)),
             "raw": dict(ca),
         }
+
+    # -- the tuner's offline ranker ---------------------------------------
+
+    def tile_penalty(self, tiles):
+        """``tiles`` is [(size, alignment), ...]: each misaligned tile
+        dim pays its padding waste — ceil(size/align)*align/size."""
+        f = 1.0
+        for size, align in tiles or ():
+            size = max(1, int(size))
+            align = max(1, int(align))
+            padded = (size + align - 1) // align * align
+            f *= padded / size
+        return f
+
+    def vmem_penalty(self, vmem_bytes, limit=VMEM_LIMIT_BYTES):
+        """Over-budget configs would spill/fail on hardware: quadratic
+        blow-up past the limit ranks them strictly behind every fitting
+        config of the same alignment class."""
+        if not vmem_bytes or vmem_bytes <= limit:
+            return 1.0
+        over = vmem_bytes / limit
+        return 4.0 * over * over
+
+    def grid_penalty(self, steps):
+        """Mild per-grid-step overhead (dispatch + pipeline fill)."""
+        return 1.0 + 1e-4 * max(0, int(steps or 0))
+
+    def config_score(self, features, base_seconds=None):
+        """Deterministic rank score for one candidate config. ``features``
+        carries ``tiles`` [(size, align)], ``vmem_bytes``, ``steps`` (all
+        optional). Lower is better; equal scores tie-break on space
+        order upstream."""
+        base = base_seconds if base_seconds and base_seconds > 0 else 1.0
+        return (base
+                * self.tile_penalty(features.get("tiles"))
+                * self.vmem_penalty(features.get("vmem_bytes"))
+                * self.grid_penalty(features.get("steps")))
+
+    def rank_configs(self, features_list, base_seconds=None):
+        """Indices of ``features_list`` sorted best-first (stable)."""
+        scores = [self.config_score(f, base_seconds) for f in features_list]
+        return sorted(range(len(scores)), key=lambda i: (scores[i], i))

@@ -143,10 +143,23 @@ class MoELayer(Layer):
 
     def __init__(self, d_model, d_hidden, num_experts, k=2,
                  capacity_factor=1.25, activation="gelu", gate=None,
-                 dispatch_mode="auto"):
+                 dispatch_mode="auto", expert_kernel=None):
         super().__init__()
         self.d_model = d_model
         self.num_experts = num_experts
+        # "einsum" (default: XLA batched matmul over the full capacity)
+        # or "ragged" (tuner-registered pallas grouped matmul that skips
+        # row tiles past each expert's live count — sparse dispatch
+        # only, where the per-expert counts exist). Env override:
+        # PADDLE_TPU_MOE_RAGGED=1.
+        if expert_kernel is None:
+            import os
+            expert_kernel = ("ragged"
+                             if os.environ.get("PADDLE_TPU_MOE_RAGGED")
+                             == "1" else "einsum")
+        if expert_kernel not in ("einsum", "ragged"):
+            raise ValueError("expert_kernel must be 'einsum' or 'ragged'")
+        self.expert_kernel = expert_kernel
         self.gate = gate or TopKGate(d_model, num_experts, k, capacity_factor)
         self.w_up = self.create_parameter((num_experts, d_model, d_hidden),
                                           default_initializer=XavierUniform())
@@ -229,6 +242,7 @@ class MoELayer(Layer):
         act = self._act()
         E = self.num_experts
         k = self.gate.k
+        ragged = self.expert_kernel == "ragged"
 
         def f(xf, e_flat, sort_idx, starts, counts, slot, w, keep, wu, wd):
             d = xf.shape[-1]
@@ -236,12 +250,22 @@ class MoELayer(Layer):
             # dispatch: queue slot (e, c) holds sorted assignment
             # starts[e]+c when c < counts[e]
             gpos = starts[:, None] + jnp.arange(C)[None, :]        # [E, C]
-            valid = jnp.arange(C)[None, :] < jnp.minimum(counts, C)[:, None]
+            live = jnp.minimum(counts, C)
+            valid = jnp.arange(C)[None, :] < live[:, None]
             a_id = sort_idx[jnp.clip(gpos, 0, kS - 1)]             # [E, C]
             tok = a_id % S                                         # choice-major
             expert_in = xf[tok] * valid[..., None].astype(xf.dtype)
-            h = act(jnp.einsum("ecd,edf->ecf", expert_in, wu))
-            expert_out = jnp.einsum("ecf,efd->ecd", h, wd)
+            if ragged:
+                # pallas grouped matmul: row tiles past each expert's
+                # live count skip their dot instead of multiplying the
+                # zero-masked padding (interpret mode = the CPU path)
+                from ..ops.pallas.ragged_matmul import ragged_dot
+                interp = jax.default_backend() == "cpu"
+                h = act(ragged_dot(expert_in, wu, live, interp))
+                expert_out = ragged_dot(h, wd, live, interp)
+            else:
+                h = act(jnp.einsum("ecd,edf->ecf", expert_in, wu))
+                expert_out = jnp.einsum("ecf,efd->ecd", h, wd)
             # combine: gather own slot's output, weight, k-sum per token
             # (w is already drop-masked and renormalized by the gate)
             flat = expert_out.reshape(E * C, d)

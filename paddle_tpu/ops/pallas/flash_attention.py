@@ -79,7 +79,11 @@ def _recompute_p_ds(q, k, v, do, lse, delta, mask, scale):
     return p, ds
 
 
-_PARALLEL_SEMANTICS = pltpu.CompilerParams(
+# jax renamed TPUCompilerParams -> CompilerParams across versions
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
+_PARALLEL_SEMANTICS = _CompilerParams(
     dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
 

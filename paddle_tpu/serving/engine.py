@@ -312,13 +312,16 @@ def _paged_prefill_impl(w, kc, vc, tok, cur_pos, keys, ids, n_prompt, slot,
 
 def _paged_decode_impl(w, kc, vc, tables, tok, cur_pos, active, keys,
                        temps, *, arch, n_heads, n_kv, eps, theta, do_sample,
-                       top_k, top_p, block_size):
+                       top_k, top_p, block_size, flash_decode=False):
     """One fused paged decode step: every decode-active slot advances a
     token at its own position, writing K/V through its block table
     (inactive rows scatter into the trash block so a freed slot's stale
     table can never corrupt the pool) and attending over the gathered
-    per-slot view. ONE program for the life of the engine — the block
-    table is a plain runtime operand of static shape."""
+    per-slot view — or, with ``flash_decode``, through the
+    tuner-registered pallas flash-decode kernel (block-table-aware DMA +
+    online softmax, no gathered view). ONE program for the life of the
+    engine — the block table is a plain runtime operand of static
+    shape."""
     from ..text import generation as G
 
     S = tok.shape[0]
@@ -334,7 +337,8 @@ def _paged_decode_impl(w, kc, vc, tables, tok, cur_pos, active, keys,
             xt2, kc_l, vc_l = G._llama_decode_layer_paged(
                 cx["x"], lw_kv, lw_kv["kc"], lw_kv["vc"], tables, dest,
                 cur_pos, cur_pos, n_heads=n_heads, n_kv=n_kv, eps=eps,
-                theta=theta, block_size=block_size)
+                theta=theta, block_size=block_size,
+                flash_decode=flash_decode)
             return {"x": xt2}, (kc_l, vc_l)
     else:
         xt = (jnp.take(w["wte"], tok, axis=0)
@@ -344,7 +348,8 @@ def _paged_decode_impl(w, kc, vc, tables, tok, cur_pos, active, keys,
         def one(cx, lw_kv):
             xt2, kc_l, vc_l = G._gpt_decode_layer_paged(
                 cx["x"], lw_kv, lw_kv["kc"], lw_kv["vc"], tables, dest,
-                cur_pos, n_heads=n_heads, block_size=block_size)
+                cur_pos, n_heads=n_heads, block_size=block_size,
+                flash_decode=flash_decode)
             return {"x": xt2}, (kc_l, vc_l)
 
     lw_kv = dict(stack)
@@ -662,6 +667,7 @@ def _tp_chunk_impl(w, kc, vc, tok, cur_pos, keys, ids, chunk_start,
 _STATICS = ("arch", "n_heads", "n_kv", "eps", "theta", "do_sample",
             "top_k", "top_p")
 _PAGED_STATICS = _STATICS + ("block_size",)
+_PAGED_DECODE_STATICS = _PAGED_STATICS + ("flash_decode",)
 _TP_STATICS = _PAGED_STATICS + ("tp",)
 
 _CODE_TOKEN = None
@@ -677,8 +683,9 @@ def _serving_code_token():
 
         from ..aot import keys as _akeys
         from ..distributed import collective_matmul as _cm
+        from ..ops.pallas import flash_decode as _fd
         from ..text import generation as G
-        _CODE_TOKEN = _akeys.code_token(G, _cm, sys.modules[__name__])
+        _CODE_TOKEN = _akeys.code_token(G, _cm, _fd, sys.modules[__name__])
     return _CODE_TOKEN
 
 
@@ -736,9 +743,10 @@ _PAGED_PREFILL = jax.jit(_paged_prefill_impl,
 _PAGED_PREFILL_DONATED = jax.jit(_paged_prefill_impl,
                                  static_argnames=_PAGED_STATICS,
                                  donate_argnums=(1, 2))
-_PAGED_DECODE = jax.jit(_paged_decode_impl, static_argnames=_PAGED_STATICS)
+_PAGED_DECODE = jax.jit(_paged_decode_impl,
+                        static_argnames=_PAGED_DECODE_STATICS)
 _PAGED_DECODE_DONATED = jax.jit(_paged_decode_impl,
-                                static_argnames=_PAGED_STATICS,
+                                static_argnames=_PAGED_DECODE_STATICS,
                                 donate_argnums=(1, 2))
 _PAGED_CHUNK = jax.jit(_paged_chunk_impl, static_argnames=_PAGED_STATICS)
 _PAGED_CHUNK_DONATED = jax.jit(_paged_chunk_impl,
@@ -907,7 +915,7 @@ class Engine:
                  default_retry_after_s=DEFAULT_RETRY_AFTER_S,
                  kv_layout="paged", block_size=16, n_blocks=None,
                  prefill_chunk=None, prefix_sharing=True, tp=1,
-                 mesh=None, replica_id=None):
+                 mesh=None, replica_id=None, flash_decode=False):
         self._w, self._hp, geo = _make_arch(model)
         #: fleet identity: stamped onto handles and carried by
         #: RequestTimeout/RequestShed/EngineOverloaded (None standalone)
@@ -936,6 +944,19 @@ class Engine:
             model, self._hp, self._statics, eos_token_id, self._w)
         if kv_layout not in ("paged", "slot"):
             raise ValueError("kv_layout must be 'paged' or 'slot'")
+        # the pallas flash-decode kernel replaces the gathered decode
+        # attention (paged, single-device only — the TP decode rings its
+        # own attention path). Interpret mode on CPU keeps the program
+        # compilable everywhere; output is token-identical to the
+        # gathered form, and the replay/adopt machinery is untouched.
+        self.flash_decode = bool(flash_decode)
+        if self.flash_decode and kv_layout != "paged":
+            raise ValueError("flash_decode=True requires kv_layout="
+                             "'paged' (the block-table operands)")
+        if self.flash_decode and self.tp > 1:
+            raise ValueError("flash_decode is not supported with tp > 1 "
+                             "yet (the TP decode shards attention over "
+                             "the mesh)")
         self.kv_layout = kv_layout
         self.prefix_sharing = bool(prefix_sharing) and kv_layout == "paged"
         self._chunking = []        # in-progress chunked prefills (paged)
@@ -957,9 +978,14 @@ class Engine:
                                       n_blocks=n_blocks)
             self._paged_statics = dict(self._statics,
                                        block_size=self.block_size)
+            # the flash_decode static only shapes the DECODE program;
+            # prefill/chunk keep their signatures (and AOT keys) stable
+            self._decode_statics = dict(self._paged_statics,
+                                        flash_decode=self.flash_decode)
         else:
             self.block_size = None
             self.prefill_chunk = None
+            self._decode_statics = dict(self._statics)
             self.cache = SlotKVCache(geo["n_layers"], self.n_slots,
                                      self.max_len, geo["kv_heads"],
                                      geo["head_dim"], geo["dtype"])
@@ -1214,7 +1240,7 @@ class Engine:
             specs.append((
                 "decode", ("decode",), self._decode,
                 (w, kc, vc, tables, tok, cur, active, keys, temps),
-                stat, "decode"))
+                {} if self.tp > 1 else self._decode_statics, "decode"))
             if self.prefill_chunk is not None:
                 ids = jax.ShapeDtypeStruct((1, self.prefill_chunk),
                                            np.int32)
@@ -1234,7 +1260,7 @@ class Engine:
             specs.append((
                 "decode", ("decode",), self._decode,
                 (w, kc, vc, tok, cur, active, keys, temps),
-                self._statics, "decode"))
+                self._decode_statics, "decode"))
         return specs
 
     def precompile_aot(self, dest_dir, buckets=None):
@@ -1702,13 +1728,13 @@ class Engine:
                         (self._w, self.cache.kc, self.cache.vc,
                          self.cache.block_tables.copy(), self._tok,
                          self._cur, active, self._keys, self._temps),
-                        self._paged_statics, "decode")
+                        self._decode_statics, "decode")
                 else:
                     out = self._run_program(
                         "decode", ("decode",), self._decode,
                         (self._w, self.cache.kc, self.cache.vc,
                          self._tok, self._cur, active, self._keys,
-                         self._temps), self._statics, "decode")
+                         self._temps), self._decode_statics, "decode")
             nxt, self.cache.kc, self.cache.vc, self._cur, self._keys = out
             self._tok = nxt
             self.metrics.mark_decode(time.perf_counter() - t0)
@@ -1798,6 +1824,7 @@ class Engine:
             out.update(self.cache.pool_stats())
             out["prefill_chunk"] = self.prefill_chunk
             out["prefix_sharing"] = self.prefix_sharing
+            out["flash_decode"] = self.flash_decode
         out["tp"] = self.tp
         if self.tp > 1:
             out["mesh"] = self.tp_geometry()

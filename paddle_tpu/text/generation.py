@@ -313,9 +313,39 @@ def _paged_view(pool_l, tables, block_size):
                      pool_l.shape[-1])
 
 
+def _paged_decode_attention(q, kc_pool, vc_pool, tables, write_pos,
+                            block_size, flash, dt):
+    """One-token paged attention: q [S, H, hd] over the pool through
+    block tables. ``flash=True`` runs the tuner-registered pallas
+    flash-decode kernel (block DMA straight off the table rows + online
+    softmax — no [S, T] gather materializes; interpret mode on CPU);
+    False keeps the gathered XLA form. Both share the causal contract
+    ``view position <= write_pos``; the flash output is token-identical,
+    not bitwise (online-softmax reduction order)."""
+    S, H, hd = q.shape
+    n_kv = kc_pool.shape[2]
+    if flash:
+        from ..ops.pallas.flash_decode import flash_decode
+        return flash_decode(
+            q, kc_pool, vc_pool, tables, write_pos,
+            interpret=jax.default_backend() == "cpu").astype(dt)
+    kview = _paged_view(kc_pool, tables, block_size)   # [S, T, n_kv, hd]
+    vview = _paged_view(vc_pool, tables, block_size)
+    kh = jnp.repeat(kview, H // n_kv, axis=2)
+    vh = jnp.repeat(vview, H // n_kv, axis=2)
+    s = jnp.einsum("bhd,bthd->bht", q, kh,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(hd))
+    T = kview.shape[1]
+    valid = jnp.arange(T)[None, :] <= write_pos[:, None]
+    s = jnp.where(valid[:, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    return jnp.einsum("bht,bthd->bhd", p, vh)
+
+
 def _llama_decode_layer_paged(xt, lw, kc_pool, vc_pool, tables, dest,
                               write_pos, rope_pos, *, n_heads, n_kv, eps,
-                              theta, block_size):
+                              theta, block_size, flash_decode=False):
     """One Llama decoder layer advancing every slot one token against
     the paged pool: the new K/V scatters to flat pool index ``dest``
     (trash-redirected for inactive rows), then attention gathers each
@@ -336,18 +366,9 @@ def _llama_decode_layer_paged(xt, lw, kc_pool, vc_pool, tables, dest,
         k[:, 0]).reshape(nb, bs, n_kv, hd)
     vc_pool = vc_pool.reshape(nb * bs, n_kv, hd).at[dest].set(
         v[:, 0]).reshape(nb, bs, n_kv, hd)
-    kview = _paged_view(kc_pool, tables, block_size)   # [S, T, n_kv, hd]
-    vview = _paged_view(vc_pool, tables, block_size)
-    kh = jnp.repeat(kview, n_heads // n_kv, axis=2)
-    vh = jnp.repeat(vview, n_heads // n_kv, axis=2)
-    s = jnp.einsum("bhd,bthd->bht", q[:, 0], kh,
-                   preferred_element_type=jnp.float32) / jnp.sqrt(
-                       jnp.float32(hd))
-    T = kview.shape[1]
-    valid = jnp.arange(T)[None, :] <= write_pos[:, None]
-    s = jnp.where(valid[:, None, :], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1).astype(dt)
-    o = jnp.einsum("bht,bthd->bhd", p, vh).reshape(S, 1, h)
+    o = _paged_decode_attention(q[:, 0], kc_pool, vc_pool, tables,
+                                write_pos, block_size, flash_decode,
+                                dt).reshape(S, 1, h)
     xt2 = xt + o @ lw["wo"]
     h2 = _rms(xt2, lw["ln2"], eps)
     xt2 = xt2 + (jax.nn.silu(h2 @ lw["wg"]) * (h2 @ lw["wu"])) @ lw["wd"]
@@ -355,7 +376,8 @@ def _llama_decode_layer_paged(xt, lw, kc_pool, vc_pool, tables, dest,
 
 
 def _gpt_decode_layer_paged(xt, lw, kc_pool, vc_pool, tables, dest,
-                            write_pos, *, n_heads, block_size):
+                            write_pos, *, n_heads, block_size,
+                            flash_decode=False):
     """GPT block, paged decode (learned positions enter at the
     embedding; only the pool write/gather differs from the slot body)."""
     S = xt.shape[0]
@@ -373,16 +395,9 @@ def _gpt_decode_layer_paged(xt, lw, kc_pool, vc_pool, tables, dest,
         k[:, 0]).reshape(nb, bs, n_heads, hd)
     vc_pool = vc_pool.reshape(nb * bs, n_heads, hd).at[dest].set(
         v[:, 0]).reshape(nb, bs, n_heads, hd)
-    kview = _paged_view(kc_pool, tables, block_size)
-    vview = _paged_view(vc_pool, tables, block_size)
-    s = jnp.einsum("bhd,bthd->bht", q[:, 0], kview,
-                   preferred_element_type=jnp.float32) / jnp.sqrt(
-                       jnp.float32(hd))
-    T = kview.shape[1]
-    valid = jnp.arange(T)[None, :] <= write_pos[:, None]
-    s = jnp.where(valid[:, None, :], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1).astype(dt)
-    o = jnp.einsum("bht,bthd->bhd", p, vview).reshape(S, 1, h)
+    o = _paged_decode_attention(q[:, 0], kc_pool, vc_pool, tables,
+                                write_pos, block_size, flash_decode,
+                                dt).reshape(S, 1, h)
     xt2 = xt + o @ lw["wproj"] + lw["bproj"]
     h2 = _ln(xt2, lw["ln2w"], lw["ln2b"])
     xt2 = xt2 + jax.nn.gelu(h2 @ lw["wfc1"] + lw["bfc1"],

@@ -1,0 +1,67 @@
+"""Winning-config persistence through the AOT disk store.
+
+Tuned configs ride the same ``aot.DiskCache`` as compiled executables
+(CUDA-L2-style: artifacts ship their tuned kernels). One entry per
+``(kernel, shape key, dtype, device kind, toolchain fingerprint)``:
+
+* the key is a sha over :func:`aot.keys.env_fingerprint` + the kernel's
+  shape key + the CONFIG-SPACE hash — a toolchain upgrade, a shape
+  change, or a change to the searchable space each make old winners
+  unreachable instead of silently stale;
+* the payload is a small dict (config + score + mode), CRC-framed by
+  DiskCache — a torn/corrupt entry reads as a miss and the tuner simply
+  re-searches (never raises);
+* reads consult the primary store first, then any read-only artifact
+  sources attached to the process CompileService, so a ``save_lm``
+  artifact can carry tuned configs alongside its precompiled programs.
+"""
+from __future__ import annotations
+
+import hashlib
+
+from ..aot import keys as _akeys
+
+__all__ = ["config_key", "load_config", "store_config"]
+
+#: bump when the payload schema changes
+TUNER_FORMAT = "pttuner-1"
+
+
+def config_key(name, shapes, dtype, space_token="") -> str:
+    h = hashlib.sha256()
+    h.update(_akeys.stable_bytes(
+        (TUNER_FORMAT, _akeys.env_fingerprint(), name, shapes, str(dtype),
+         space_token)))
+    return "tunercfg-" + h.hexdigest()[:32]
+
+
+def _stores():
+    from ..aot import get_service
+    svc = get_service()
+    if not svc.persistent:
+        return []
+    return ([svc.disk] if svc.disk is not None else []) + list(svc.sources)
+
+
+def load_config(name, shapes, dtype, space_token=""):
+    """The persisted winner for this key, or None (miss OR corrupt —
+    the degradation is re-search, never an exception)."""
+    key = config_key(name, shapes, dtype, space_token)
+    for store in _stores():
+        payload = store.get(key)
+        if isinstance(payload, dict) \
+                and payload.get("format") == TUNER_FORMAT \
+                and isinstance(payload.get("config"), dict):
+            return payload
+    return None
+
+
+def store_config(name, shapes, dtype, payload, space_token="") -> int:
+    """Persist one winner; returns bytes written (0 when no persistent
+    store is configured)."""
+    key = config_key(name, shapes, dtype, space_token)
+    payload = dict(payload, format=TUNER_FORMAT, kernel=name)
+    for store in _stores():
+        if not store.readonly:
+            return store.put(key, payload)
+    return 0

@@ -1,0 +1,83 @@
+"""Kernel registry for the search-based autotuner.
+
+Every tunable pallas kernel registers ONE :class:`KernelSpec` describing
+its config space and how to build/score/verify a candidate:
+
+* ``space(shapes, dtype)`` — enumerate candidate config dicts for one
+  shape key (deterministic order: ties in ranking resolve to the first);
+* ``build(config, interpret)`` — a jittable callable with the config
+  baked (``interpret=True`` is the CPU path: pallas interpret mode
+  lowers to plain XLA ops, so the built fn compiles, serializes and
+  AOT-caches on any backend);
+* ``reference(*args)`` — the jnp oracle the kernel must match
+  (CPU interpret-mode parity is a registration requirement);
+* ``features(shapes, dtype, config)`` — cost-model facts for offline
+  ranking: ``tiles`` [(size, alignment)], ``vmem_bytes``, ``steps``;
+* ``demo(rng)`` — small CPU-sized probe args ``(args, shapes, dtype)``
+  for the CLI / parity gate;
+* ``shapes_of(args)`` — the shape key of concrete call operands, so
+  ``tuner.call`` can key the lookup without kernel-specific knowledge.
+
+The shape-key convention is kernel-owned: a tuple of operand shape
+tuples, hashed together with dtype and device kind into the persisted
+key (see persist.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = ["KernelSpec", "register", "get", "names", "registered"]
+
+
+@dataclass(frozen=True)
+class KernelSpec:
+    name: str
+    space: object
+    build: object
+    reference: object
+    features: object
+    default: object
+    demo: object
+    shapes_of: object
+    tol: float = 2e-5
+    doc: str = ""
+
+
+_REGISTRY: dict = {}
+
+
+def register(spec: KernelSpec):
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def get(name: str) -> KernelSpec:
+    _ensure_builtin()
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown kernel {name!r}; registered: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def names():
+    _ensure_builtin()
+    return sorted(_REGISTRY)
+
+
+def registered(name: str) -> bool:
+    _ensure_builtin()
+    return name in _REGISTRY
+
+
+_builtin_loaded = False
+
+
+def _ensure_builtin():
+    """Built-in kernel registrations load lazily (they import the pallas
+    modules) so ``import paddle_tpu`` stays cheap."""
+    global _builtin_loaded
+    if not _builtin_loaded:
+        _builtin_loaded = True
+        from . import kernels  # noqa: F401  (registers on import)

@@ -1,0 +1,272 @@
+"""Search + ranking: the autotuner's engine.
+
+Two ranking modes, picked by what the process can actually observe:
+
+* **measured** (a real accelerator is up): every candidate config is
+  built, compiled and timed min-of-batches over the PR-9 monotonic span
+  timer (``cost_model.profile_measure(batches=...)`` — the min over
+  batch means is robust to scheduler noise on a busy host, the same
+  discipline the observability overhead claims use);
+* **offline** (CPU, or ``mode="offline"``): candidates are ranked by
+  the upgraded :mod:`paddle_tpu.cost_model` — one XLA
+  ``cost_analysis()`` of the *reference* program for the shape (the
+  config-independent flops/bytes base) times per-config tile-alignment
+  / VMEM-footprint / grid-overhead penalties. Deterministic: equal
+  scores resolve to the earlier config in the registered space, so the
+  same space always elects the same winner in every process.
+
+The winner persists twice through the AOT store: its config JSON
+(persist.py) and — when concrete probe args are available — its
+compiled executable via ``aot.CompileService`` under a
+``tuner:<kernel>`` signature, so a warm process reuses BOTH at zero
+backend compiles.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..observability import tracing as _tracing
+from . import persist, registry
+
+__all__ = ["tune", "get_config", "call", "TuneResult", "enable",
+           "disable", "enabled", "status", "clear_memory"]
+
+#: (name, shapes, dtype) -> winning config dict resolved this process
+_MEM: dict = {}
+_ENABLED = False
+
+
+def enable():
+    """Auto-tune (offline mode) on a ``get_config`` miss instead of
+    returning the registered default — the incubate.autotune switch."""
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable():
+    global _ENABLED
+    _ENABLED = False
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def clear_memory():
+    _MEM.clear()
+
+
+@dataclass
+class TuneResult:
+    kernel: str
+    shapes: tuple
+    dtype: str
+    mode: str                      # "measured" | "offline"
+    config: dict = field(default_factory=dict)
+    score: float = 0.0             # seconds (measured) / penalty score
+    n_configs: int = 0
+    ranked: list = field(default_factory=list)   # [(config, score), ...]
+    persisted_bytes: int = 0
+    source: str = "search"         # "search" | "disk" | "default"
+
+    def to_dict(self):
+        return {"kernel": self.kernel, "shapes": self.shapes,
+                "dtype": self.dtype, "mode": self.mode,
+                "config": self.config, "score": self.score,
+                "n_configs": self.n_configs,
+                "ranked": self.ranked[:5],
+                "persisted_bytes": self.persisted_bytes,
+                "source": self.source}
+
+
+def _space_token(spec, shapes, dtype):
+    """Hash of the enumerated space: changing the searchable configs
+    invalidates persisted winners (they may no longer be in the space)."""
+    import hashlib
+
+    from ..aot import keys as _akeys
+    cfgs = spec.space(shapes, dtype)
+    h = hashlib.sha256(_akeys.stable_bytes(
+        tuple(tuple(sorted(c.items())) for c in cfgs)))
+    return h.hexdigest()[:16]
+
+
+def _backend():
+    import jax
+    return jax.default_backend()
+
+
+def _measure_config(spec, config, args, iters, batches):
+    """Min-of-batches wall time of one built candidate (compile excluded
+    via warmup). Returns seconds, or None when the candidate fails to
+    build/compile at this shape (over-VMEM tilings on real hardware)."""
+    import jax
+
+    from ..cost_model import CostModel
+    fn = jax.jit(spec.build(config, interpret=_backend() == "cpu"))
+    try:
+        with _tracing.span("tuner.measure", cat="tuner",
+                           kernel=spec.name, config=str(config)):
+            m = CostModel().profile_measure(
+                fn, args=args, warmup=1, iters=iters, batches=batches)
+        return m["time_min"]
+    except Exception as e:   # candidate invalid at this shape: rank last
+        _tracing.instant("tuner.candidate_failed", cat="tuner",
+                         kernel=spec.name, config=str(config),
+                         error=f"{type(e).__name__}: {str(e)[:120]}")
+        return None
+
+
+def tune(name, *, shapes=None, dtype=None, args=None, mode="auto",
+         iters=10, batches=5, persist_winner=True):
+    """Search the registered space for ``name`` at one shape key and
+    return a :class:`TuneResult` (winner first in ``ranked``).
+
+    ``args`` (concrete operands) are required for measured mode and for
+    persisting the winning executable; with only ``shapes``/``dtype``
+    the offline ranker still elects and persists a config.
+    """
+    spec = registry.get(name)
+    if args is not None and (shapes is None or dtype is None):
+        shapes, dtype = spec.shapes_of(args)
+    if shapes is None or dtype is None:
+        raise ValueError("tune() needs args= or shapes=+dtype=")
+    shapes = tuple(tuple(s) for s in shapes)
+    if mode == "auto":
+        mode = "offline" if _backend() == "cpu" else "measured"
+    if mode == "measured" and args is None:
+        raise ValueError("measured tuning needs concrete args=")
+    cfgs = spec.space(shapes, dtype)
+    if not cfgs:
+        cfgs = [spec.default(shapes, dtype)]
+    res = TuneResult(kernel=name, shapes=shapes, dtype=str(dtype),
+                     mode=mode, n_configs=len(cfgs))
+    with _tracing.span("tuner.search", cat="tuner", kernel=name,
+                       mode=mode, n_configs=len(cfgs)):
+        if mode == "measured":
+            scored = []
+            for c in cfgs:
+                t = _measure_config(spec, c, args, iters, batches)
+                scored.append((c, float("inf") if t is None else t))
+        else:
+            from ..cost_model import CostModel
+            cm = CostModel()
+            base = None
+            if args is not None:
+                try:
+                    import jax
+                    base = cm.xla_cost(
+                        jax.jit(spec.reference), *args)["optimal_seconds"]
+                    if base is not None and base <= 0:
+                        base = None
+                except Exception as e:
+                    # reference not compilable here: rank on penalties
+                    # alone (still a total order) — record why
+                    base = None
+                    _tracing.instant(
+                        "tuner.base_cost_failed", cat="tuner",
+                        kernel=name,
+                        error=f"{type(e).__name__}: {str(e)[:120]}")
+            scored = [(c, cm.config_score(
+                spec.features(shapes, dtype, c), base_seconds=base))
+                for c in cfgs]
+    # stable sort: equal scores keep space order -> deterministic winner
+    order = sorted(range(len(scored)), key=lambda i: (scored[i][1], i))
+    res.ranked = [(scored[i][0], scored[i][1]) for i in order]
+    res.config, res.score = res.ranked[0]
+    if persist_winner:
+        res.persisted_bytes = persist.store_config(
+            name, shapes, dtype,
+            {"config": res.config, "score": res.score, "mode": mode,
+             "measured_at": time.time()},   # ledger timestamp (absolute)
+            space_token=_space_token(spec, shapes, dtype))
+        if args is not None:
+            _persist_executable(spec, res.config, args)
+    _MEM[(name, shapes, str(dtype))] = dict(res.config)
+    return res
+
+
+def _aot_key_parts(spec, config):
+    from ..aot import keys as _akeys
+    import sys
+    mod = sys.modules.get(getattr(spec.build, "__module__", None))
+    parts = ("tuner", spec.name, tuple(sorted(config.items())))
+    if mod is not None:
+        parts = parts + (_akeys.code_token(mod),)
+    return parts
+
+
+def _persist_executable(spec, config, args):
+    """Compile the winner and push it through the shared AOT service so
+    a warm process revives the executable with zero backend compiles."""
+    import jax
+
+    from ..aot import get_service
+    svc = get_service()
+    if not svc.persistent:
+        return
+    fn = jax.jit(spec.build(config, interpret=_backend() == "cpu"))
+    try:
+        svc.get(f"tuner:{spec.name}", args=tuple(args), statics={},
+                key_parts=_aot_key_parts(spec, config), jitted=fn,
+                origin=f"tuner:{spec.name}")
+    except Exception as e:   # persistence is best-effort; record why
+        svc._note_error(f"tuner:{spec.name}", e)
+
+
+def get_config(name, *, shapes, dtype):
+    """Resolve the config a kernel call should run with: this-process
+    memory -> persisted winner (AOT store) -> auto-tune offline (only
+    when :func:`enable`d) -> the registered default. Never raises for a
+    cache problem and never measures implicitly."""
+    spec = registry.get(name)
+    shapes = tuple(tuple(s) for s in shapes)
+    hit = _MEM.get((name, shapes, str(dtype)))
+    if hit is not None:
+        return dict(hit)
+    payload = persist.load_config(
+        name, shapes, dtype,
+        space_token=_space_token(spec, shapes, dtype))
+    if payload is not None:
+        cfg = dict(payload["config"])
+        _MEM[(name, shapes, str(dtype))] = dict(cfg)
+        return cfg
+    if _ENABLED:
+        try:
+            return dict(tune(name, shapes=shapes, dtype=dtype,
+                             mode="offline").config)
+        except Exception as e:
+            _tracing.instant("tuner.autotune_failed", cat="tuner",
+                             kernel=name,
+                             error=f"{type(e).__name__}: {str(e)[:120]}")
+    cfg = dict(spec.default(shapes, dtype))
+    _MEM[(name, shapes, str(dtype))] = dict(cfg)
+    return cfg
+
+
+def call(name, *args):
+    """Run one kernel with its resolved tuned config, routed through the
+    shared AOT compile service (warm store => the persisted executable
+    revives: zero trace, zero backend compile)."""
+    import jax
+
+    from ..aot import get_service
+    spec = registry.get(name)
+    shapes, dtype = spec.shapes_of(args)
+    config = get_config(name, shapes=shapes, dtype=dtype)
+    fn = spec.build(config, interpret=_backend() == "cpu")
+    h = get_service().get(
+        f"tuner:{name}", args=tuple(args), statics={},
+        key_parts=_aot_key_parts(spec, config),
+        jitted_thunk=lambda: jax.jit(fn), origin=f"tuner:{name}")
+    return h.call(*args)
+
+
+def status():
+    """Introspection for incubate.autotune / the CLI ledger."""
+    return {"enabled": _ENABLED,
+            "kernels": registry.names(),
+            "resolved": {f"{k[0]}@{k[1]}/{k[2]}": v
+                         for k, v in sorted(_MEM.items(),
+                                            key=lambda kv: str(kv[0]))}}

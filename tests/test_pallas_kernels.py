@@ -1,0 +1,279 @@
+"""ISSUE-14 pallas suite growth: CPU interpret-mode parity for the three
+new kernels (flash-decode, ragged MoE matmul, fused sharded-vocab CE)
+and the engine-level flash-decode token-identity contract through
+prefix sharing, preemption and adopt() replay.
+
+Kept slim for the tier-1 budget: tiny shapes, one module-scope model,
+config sweeps marked slow.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas.flash_decode import (flash_decode,
+                                                flash_decode_reference)
+from paddle_tpu.ops.pallas.fused_ce import (fused_ce_loss,
+                                            fused_ce_reference,
+                                            sharded_vocab_ce)
+from paddle_tpu.ops.pallas.ragged_matmul import (
+    ragged_dot, ragged_group_matmul, ragged_group_matmul_reference)
+from paddle_tpu.serving import Engine
+from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+CFG = dataclasses.replace(LLAMA_TINY, dtype="float32", num_hidden_layers=2)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(CFG)
+    m.eval()
+    return m
+
+
+def _prompts(lens, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, CFG.vocab_size, (n,)).astype(np.int32)
+            for n in lens]
+
+
+# ---------------------------------------------------------------------------
+# flash-decode kernel
+# ---------------------------------------------------------------------------
+
+def _fd_case(rng, S, H, n_kv, hd, nb, bs, mb):
+    q = jnp.asarray(rng.standard_normal((S, H, hd)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((nb, bs, n_kv, hd)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((nb, bs, n_kv, hd)), jnp.float32)
+    tables = jnp.asarray(rng.integers(0, nb, (S, mb)), jnp.int32)
+    wp = jnp.asarray(rng.integers(0, mb * bs, (S,)), jnp.int32)
+    return q, kc, vc, tables, wp
+
+
+@pytest.mark.parametrize("S,H,n_kv,g", [(3, 4, 2, 1), (2, 8, 4, 2)])
+def test_flash_decode_parity(S, H, n_kv, g):
+    """GQA + MHA, ragged write positions, trash-block table tails."""
+    rng = np.random.default_rng(0)
+    args = _fd_case(rng, S, H, n_kv, hd=16, nb=7, bs=4, mb=4)
+    got = flash_decode(*args, kv_heads_per_step=g, interpret=True)
+    ref = flash_decode_reference(*args)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_decode_write_pos_zero_and_full():
+    """Edge bounds: a slot attending only position 0, and one attending
+    the entire table range."""
+    rng = np.random.default_rng(1)
+    q, kc, vc, tables, _ = _fd_case(rng, 2, 2, 2, hd=8, nb=5, bs=4, mb=3)
+    wp = jnp.asarray([0, 3 * 4 - 1], jnp.int32)
+    got = flash_decode(q, kc, vc, tables, wp, kv_heads_per_step=1,
+                       interpret=True)
+    ref = flash_decode_reference(q, kc, vc, tables, wp)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.slow
+def test_flash_decode_config_sweep():
+    rng = np.random.default_rng(2)
+    args = _fd_case(rng, 4, 8, 8, hd=32, nb=11, bs=8, mb=5)
+    ref = flash_decode_reference(*args)
+    for g in (1, 2, 4, 8):
+        got = flash_decode(*args, kv_heads_per_step=g, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# ragged grouped matmul
+# ---------------------------------------------------------------------------
+
+def test_ragged_matmul_parity_and_tile_skip():
+    """Counts of 0 / partial / full per group, unaligned C and N."""
+    rng = np.random.default_rng(0)
+    G, C, K, N = 4, 19, 8, 13
+    x = jnp.asarray(rng.standard_normal((G, C, K)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((G, K, N)), jnp.float32)
+    counts = jnp.asarray([0, 5, 19, 12], jnp.int32)
+    got = ragged_group_matmul(x, w, counts, block_m=8, block_n=8,
+                              interpret=True)
+    ref = ragged_group_matmul_reference(x, w, counts)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    # rows past the count are exactly zero (not just close)
+    assert not np.asarray(got)[0].any()
+    assert not np.asarray(got)[1, 5:].any()
+
+
+def test_ragged_dot_grads_match_masked_einsum():
+    rng = np.random.default_rng(1)
+    G, C, K, N = 2, 8, 4, 6
+    x = jnp.asarray(rng.standard_normal((G, C, K)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((G, K, N)), jnp.float32)
+    counts = jnp.asarray([3, 8], jnp.int32)
+    gx, gw = jax.grad(lambda x, w: ragged_dot(x, w, counts, True).sum(),
+                      argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(
+        lambda x, w: ragged_group_matmul_reference(x, w, counts).sum(),
+        argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), atol=1e-5)
+
+
+def test_moe_layer_ragged_kernel_matches_einsum():
+    from paddle_tpu.nn.moe import MoELayer
+    paddle.seed(0)
+    m_e = MoELayer(16, 32, 4, k=2, dispatch_mode="sparse",
+                   expert_kernel="einsum")
+    paddle.seed(0)
+    m_r = MoELayer(16, 32, 4, k=2, dispatch_mode="sparse",
+                   expert_kernel="ragged")
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((2, 8, 16)).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(m_e(x)._data),
+                               np.asarray(m_r(x)._data), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# fused sharded-vocab CE
+# ---------------------------------------------------------------------------
+
+def _ce_case(rng, N, H, V):
+    h = jnp.asarray(rng.standard_normal((N, H)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((H, V)) * 0.2, jnp.float32)
+    lab = jnp.asarray(rng.integers(0, V, (N,)), jnp.int32)
+    return h, w, lab
+
+
+def test_fused_ce_value_and_grads():
+    rng = np.random.default_rng(0)
+    h, w, lab = _ce_case(rng, 24, 16, 103)   # V not a tile multiple
+    got = fused_ce_loss(h, w, lab, 8, 32, True)
+    ref = fused_ce_reference(h, w, lab)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+    gf = jax.grad(lambda h, w: fused_ce_loss(h, w, lab, 8, 32, True),
+                  argnums=(0, 1))(h, w)
+    gr = jax.grad(lambda h, w: fused_ce_reference(h, w, lab),
+                  argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(np.asarray(gf[0]), np.asarray(gr[0]),
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(gf[1]), np.asarray(gr[1]),
+                               atol=2e-5)
+
+
+def test_sharded_vocab_ce_ring_psum_free():
+    """4-way vocab shard under shard_map: value + grads match the dense
+    reference and the lowered HLO carries NO all-reduce (ppermute ring
+    only — the PR-11 machinery)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    rng = np.random.default_rng(1)
+    N, H, V, tp = 16, 8, 64, 4
+    h, w, lab = _ce_case(rng, N, H, V)
+    mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
+
+    def f(h, w):
+        return shard_map(
+            lambda h, w, l: sharded_vocab_ce(h, w, l, "tp", tp, 8, 16,
+                                             True),
+            mesh=mesh, in_specs=(P(), P(None, "tp"), P()),
+            out_specs=P(), check_rep=False)(h, w, lab)
+
+    np.testing.assert_allclose(float(f(h, w)),
+                               float(fused_ce_reference(h, w, lab)),
+                               rtol=1e-5)
+    gs = jax.jit(jax.grad(f, argnums=(0, 1)))(h, w)
+    gr = jax.grad(lambda h, w: fused_ce_reference(h, w, lab),
+                  argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(np.asarray(gs[0]), np.asarray(gr[0]),
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(gs[1]), np.asarray(gr[1]),
+                               atol=2e-5)
+    hlo = jax.jit(f).lower(h, w).as_text()   # StableHLO spelling
+    assert "all_reduce" not in hlo and "all-reduce" not in hlo
+    assert "collective_permute" in hlo or "collective-permute" in hlo
+
+
+@pytest.mark.slow
+def test_fused_ce_config_sweep():
+    rng = np.random.default_rng(2)
+    h, w, lab = _ce_case(rng, 40, 24, 257)
+    ref = float(fused_ce_reference(h, w, lab))
+    for bn in (8, 16, 64):
+        for bv in (32, 128, 512):
+            got = float(fused_ce_loss(h, w, lab, bn, bv, True))
+            np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Engine(flash_decode=True): token identity through the serving paths
+# ---------------------------------------------------------------------------
+
+def test_engine_flash_decode_token_identical_with_prefix_sharing(model):
+    """Flash vs gathered decode attention: same tokens (greedy AND
+    sampled) over a shared-prefix workload — prefix sharing, block
+    tables and the PRNG chains are untouched by the kernel swap."""
+    sys_p = _prompts([12], seed=7)[0]
+    prompts = [np.concatenate([sys_p, t]) for t in _prompts([4, 6], seed=8)]
+
+    def run(flash, sample):
+        kw = dict(do_sample=True, top_k=8) if sample else {}
+        eng = Engine(model, n_slots=2, max_len=64, min_prompt_bucket=4,
+                     block_size=8, flash_decode=flash, **kw)
+        hs = eng.generate_all(prompts, max_new_tokens=6,
+                              **({"temperature": 0.9, "seed": 11}
+                                 if sample else {}))
+        out = [h.result().tolist() for h in hs]
+        assert eng.stats()["flash_decode"] is flash
+        assert eng.stats()["prefix_hit_tokens"] > 0 or not flash
+        return out
+
+    assert run(True, False) == run(False, False)
+    assert run(True, True) == run(False, True)
+
+
+def test_engine_flash_decode_preempt_and_adopt_replay(model):
+    """The replay machinery under flash decode: pool exhaustion preempts
+    and replays token-identically, and a fresh flash engine adopt()s
+    mid-flight handles to the same tokens as an uninterrupted run."""
+    prompts = _prompts([12, 12], seed=4)
+
+    def baseline(p, n):
+        out = model.generate(paddle.to_tensor(p[None]), max_new_tokens=n)
+        return np.asarray(out._data)[0, len(p):]
+
+    # preemption: pool sized below the combined worst case
+    eng = Engine(model, n_slots=2, max_len=64, min_prompt_bucket=4,
+                 block_size=8, n_blocks=6, prefix_sharing=False,
+                 flash_decode=True)
+    h1 = eng.submit(prompts[0], max_new_tokens=16)
+    h2 = eng.submit(prompts[1], max_new_tokens=16)
+    eng.drain()
+    assert eng.stats()["preemptions"] >= 1
+    np.testing.assert_array_equal(np.asarray(h1.tokens, np.int32),
+                                  baseline(prompts[0], 16))
+    np.testing.assert_array_equal(np.asarray(h2.tokens, np.int32),
+                                  baseline(prompts[1], 16))
+
+    # adopt(): decode a few tokens, migrate the live handle to a fresh
+    # flash engine, finish there — tokens equal the uninterrupted run
+    src = Engine(model, n_slots=2, max_len=64, min_prompt_bucket=4,
+                 block_size=8, flash_decode=True)
+    h = src.submit(prompts[0], max_new_tokens=10)
+    for _ in range(4):
+        src.step()
+    assert 0 < len(h.tokens) < 10
+    src._condemned = True
+    dst = Engine(model, n_slots=2, max_len=64, min_prompt_bucket=4,
+                 block_size=8, flash_decode=True)
+    dst.adopt(h)
+    dst.drain()
+    np.testing.assert_array_equal(np.asarray(h.tokens, np.int32),
+                                  baseline(prompts[0], 10))

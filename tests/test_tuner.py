@@ -1,0 +1,252 @@
+"""paddle_tpu.tuner: offline determinism, cost-model ranking,
+persistence through the AOT store, corrupt-entry degradation, the
+incubate.autotune delegation, the untuned-kernel-config lint rule, and
+the two subprocess acceptance checks (CLI smoke = cross-process same
+winner; warm cache = persisted config + executable reused at 0 backend
+compiles)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401  (registers the package path)
+from paddle_tpu import tuner
+from paddle_tpu.aot import get_service, reset_service
+from paddle_tpu.cost_model import CostModel
+from paddle_tpu.tuner.registry import get as get_spec
+from paddle_tpu.tuner.search import _space_token
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tuner_state():
+    tuner.clear_memory()
+    tuner.disable()
+    yield
+    tuner.clear_memory()
+    tuner.disable()
+
+
+# ---------------------------------------------------------------------------
+# cost model (satellite: offline ranker + profile_measure fix)
+# ---------------------------------------------------------------------------
+
+def test_profile_measure_blocks_on_pytree_outputs():
+    """Tuple/dict outputs synchronize fully (the old code only touched
+    ``out._data``) and batches>1 reports the min-of-batches figure."""
+    import jax.numpy as jnp
+    cm = CostModel()
+
+    def fn(x):
+        return {"a": x * 2, "b": (x + 1, x.sum())}
+
+    m = cm.profile_measure(fn, args=(jnp.ones((8, 8)),), warmup=1,
+                           iters=3, batches=3, device="cpu")
+    assert m["time"] > 0 and m["time_min"] > 0
+    assert len(m["batches"]) == 3
+    assert m["time_min"] == min(m["batches"])
+
+
+def test_cost_model_penalties_rank_sanely():
+    cm = CostModel()
+    aligned = {"tiles": [(128, 8), (256, 128)], "vmem_bytes": 1 << 20}
+    misaligned = {"tiles": [(100, 8), (256, 128)], "vmem_bytes": 1 << 20}
+    oversized = {"tiles": [(128, 8), (256, 128)], "vmem_bytes": 1 << 30}
+    assert cm.config_score(aligned) < cm.config_score(misaligned)
+    assert cm.config_score(aligned) < cm.config_score(oversized)
+    # deterministic + stable order
+    feats = [misaligned, aligned, oversized]
+    assert cm.rank_configs(feats) == cm.rank_configs(feats) == [1, 0, 2]
+
+
+def test_offline_tune_deterministic_same_winner():
+    rng = np.random.default_rng(0)
+    spec = get_spec("ragged_matmul")
+    args, shapes, dtype = spec.demo(rng)
+    r1 = tuner.tune("ragged_matmul", args=args, mode="offline")
+    tuner.clear_memory()
+    r2 = tuner.tune("ragged_matmul", shapes=shapes, dtype=dtype,
+                    mode="offline")
+    assert r1.config == r2.config
+    assert r1.n_configs == r2.n_configs >= 1
+    assert [c for c, _ in r1.ranked] == [c for c, _ in r2.ranked]
+
+
+# ---------------------------------------------------------------------------
+# persistence: roundtrip, corrupt degradation
+# ---------------------------------------------------------------------------
+
+def test_config_roundtrip_and_corrupt_degrades(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_AOT_CACHE_DIR", str(tmp_path))
+    reset_service()
+    try:
+        rng = np.random.default_rng(0)
+        spec = get_spec("ragged_matmul")
+        args, shapes, dtype = spec.demo(rng)
+        r = tuner.tune("ragged_matmul", args=args, mode="offline")
+        assert r.persisted_bytes > 0
+        tuner.clear_memory()
+        assert tuner.get_config("ragged_matmul", shapes=shapes,
+                                dtype=dtype) == r.config
+        # corrupt the entry: get_config degrades to the default, no raise
+        key = tuner.config_key(
+            "ragged_matmul", tuple(tuple(s) for s in shapes), dtype,
+            space_token=_space_token(spec, shapes, dtype))
+        with open(os.path.join(str(tmp_path), "objs", key + ".bin"),
+                  "wb") as f:
+            f.write(b"torn garbage")
+        tuner.clear_memory()
+        cfg = tuner.get_config("ragged_matmul", shapes=shapes, dtype=dtype)
+        assert cfg == spec.default(shapes, dtype)
+        # re-search overwrites the corrupt entry
+        tuner.tune("ragged_matmul", args=args, mode="offline")
+        tuner.clear_memory()
+        assert tuner.get_config("ragged_matmul", shapes=shapes,
+                                dtype=dtype) == r.config
+    finally:
+        reset_service()
+
+
+def test_incubate_autotune_delegates_to_tuner():
+    from paddle_tpu.incubate import autotune
+    autotune.set_config({"kernel": {"enable": True}})
+    assert tuner.enabled()
+    st = autotune.status()
+    assert st["tuner"]["enabled"] and "ragged_matmul" in st["tuner"]["kernels"]
+    autotune.set_config({"kernel": {"enable": False}})
+    assert not tuner.enabled()
+    # enabled => get_config auto-tunes offline on a miss (not default)
+    autotune.set_config({"kernel": {"enable": True}})
+    rng = np.random.default_rng(0)
+    spec = get_spec("fused_ce")
+    _, shapes, dtype = spec.demo(rng)
+    cfg = tuner.get_config("fused_ce", shapes=shapes, dtype=dtype)
+    want = tuner.tune("fused_ce", shapes=shapes, dtype=dtype,
+                      mode="offline").config
+    assert cfg == want
+
+
+# ---------------------------------------------------------------------------
+# lint rule: untuned-kernel-config
+# ---------------------------------------------------------------------------
+
+def test_untuned_kernel_config_lint_rule():
+    from paddle_tpu.analysis.rules_ast import (SourceFile,
+                                               _untuned_kernel_config)
+    bad = SourceFile.load("x/ops/demo.py", text=(
+        "from paddle_tpu.ops.pallas.flash_attention import flash_attention\n"
+        "y = flash_attention(q, k, v, block_q=256, block_k=512)\n"))
+    found = list(_untuned_kernel_config(bad))
+    assert len(found) == 1 and found[0].rule_id == "untuned-kernel-config"
+    # allow annotation suppresses
+    ok = SourceFile.load("x/ops/demo.py", text=(
+        "# tpu_lint: allow(untuned-kernel-config)\n"
+        "y = flash_attention(q, k, v, block_q=256)\n"))
+    assert not list(_untuned_kernel_config(ok))
+    # variables (tuner-resolved configs) don't fire
+    var = SourceFile.load("x/ops/demo.py", text=(
+        "cfg = tuner.get_config('flash_attention', shapes=s, dtype=d)\n"
+        "y = flash_attention(q, k, v, block_q=cfg['block_q'])\n"))
+    assert not list(_untuned_kernel_config(var))
+    # the tuner registry path is exempt
+    reg = SourceFile.load("paddle_tpu/tuner/kernels.py", text=(
+        "y = flash_attention(q, k, v, block_q=256)\n"))
+    assert not list(_untuned_kernel_config(reg))
+
+
+def test_rule_registered_in_table():
+    from paddle_tpu import analysis
+    ids = {rid for rid, kind, sev, _ in analysis.rules_table()
+           if kind == "ast"}
+    assert "untuned-kernel-config" in ids
+
+
+# ---------------------------------------------------------------------------
+# subprocess acceptance: CLI smoke (= cross-process same winner) and the
+# warm-cache zero-compile reuse of config + executable
+# ---------------------------------------------------------------------------
+
+def _run(cmd, env_extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **env_extra)
+    out = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                         cwd=REPO, timeout=240)
+    return out
+
+
+def test_cli_smoke_and_cross_process_winner(tmp_path):
+    """tools/tune_kernels.py --offline --json on one kernel: exit 0,
+    parity ok, and the subprocess elects the SAME winner as this
+    process (offline determinism across processes)."""
+    out = _run([sys.executable, "tools/tune_kernels.py",
+                "--kernel", "ragged_matmul", "--offline", "--json"],
+               {"PADDLE_TPU_AOT_CACHE_DIR": str(tmp_path)})
+    assert out.returncode == 0, out.stderr[-1500:]
+    ledger = json.loads(out.stdout.strip().splitlines()[-1])
+    rec = ledger["kernels"]["ragged_matmul"]
+    assert ledger["ok"] and rec["parity"]["ok"]
+    rng = np.random.default_rng(0)
+    spec = get_spec("ragged_matmul")
+    args, shapes, dtype = spec.demo(rng)
+    here = tuner.tune("ragged_matmul", args=args, mode="offline",
+                      persist_winner=False)
+    assert rec["config"] == here.config
+
+
+_WARM_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import analysis, tuner
+from paddle_tpu.tuner.registry import get as get_spec
+rng = np.random.default_rng(0)
+spec = get_spec("ragged_matmul")
+args, shapes, dtype = spec.demo(rng)
+mode = sys.argv[1]
+counter = analysis.CompileEventCounter().install()
+if mode == "cold":
+    r = tuner.tune("ragged_matmul", args=args, mode="offline")
+    out = np.asarray(tuner.call("ragged_matmul", *args))
+    print(json.dumps({{"config": r.config,
+                      "bits": out.tobytes().hex()[:512],
+                      "compiles": counter.count
+                      if counter.available else None}}))
+else:
+    cfg = tuner.get_config("ragged_matmul", shapes=shapes, dtype=dtype)
+    counter.reset()
+    out = np.asarray(tuner.call("ragged_matmul", *args))
+    from paddle_tpu.aot import get_service
+    sources = {{h.source for h in get_service()._mem.values()}}
+    print(json.dumps({{"config": cfg,
+                      "bits": out.tobytes().hex()[:512],
+                      "compiles": counter.count
+                      if counter.available else None,
+                      "sources": sorted(sources)}}))
+"""
+
+
+def test_warm_subprocess_reuses_tuned_config_and_exec_zero_compiles(
+        tmp_path):
+    """ISSUE-14 acceptance: process A searches and persists (config +
+    executable through the AOT store); a FRESH process B resolves the
+    same winner from disk and runs the kernel via the revived executable
+    with 0 XLA backend compiles, bit-identical output."""
+    env = {"PADDLE_TPU_AOT_CACHE_DIR": str(tmp_path)}
+    cold = _run([sys.executable, "-c", _WARM_CHILD.format(repo=REPO),
+                 "cold"], env)
+    assert cold.stdout.strip(), cold.stderr[-1500:]
+    cold_rec = json.loads(cold.stdout.strip().splitlines()[-1])
+    warm = _run([sys.executable, "-c", _WARM_CHILD.format(repo=REPO),
+                 "warm"], env)
+    assert warm.stdout.strip(), warm.stderr[-1500:]
+    warm_rec = json.loads(warm.stdout.strip().splitlines()[-1])
+    assert warm_rec["config"] == cold_rec["config"]
+    assert warm_rec["bits"] == cold_rec["bits"]
+    assert "disk-exec" in warm_rec["sources"]
+    if warm_rec["compiles"] is None:
+        pytest.skip("jax monitoring unavailable")
+    assert warm_rec["compiles"] == 0
