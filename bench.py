@@ -831,6 +831,39 @@ def bench_serving_tp(backend):
                     n_slots=8, max_len=256)
 
 
+def bench_serving_spec(backend):
+    """Speculative decoding A/B (ROADMAP item 4(a)): a latency-shaped
+    (serial-request) workload through the paged engine non-speculative
+    vs n-gram-lookahead vs model-draft speculative. ok requires
+    token-identical output across every arm and < 0.6 target-model
+    steps per emitted token on the model-draft arm (the self-draft
+    high-acceptance proxy — random weights starve a real small draft of
+    acceptance, so the structural steps-per-token claim is the honest
+    gate; the wall-clock ITL win with real weights stays recorded as
+    real-TPU window debt). The ledger lives in tools/bench_serving.py
+    (``spec_sweep``, reused here verbatim); this is the TPU arm."""
+    import paddle_tpu
+    from paddle_tpu.text.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if backend != "tpu":
+        return {"skipped": "tpu only"}
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    try:
+        from bench_serving import spec_sweep
+    finally:
+        sys.path.pop(0)
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5504, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=16,
+                      max_position_embeddings=512, dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return spec_sweep(model, cfg, n_requests=8, max_new=48, k=4,
+                      max_len=256, block_size=32)
+
+
 def bench_multichip_commopt(backend):
     """Comm-efficient multichip training A/B (ROADMAP item 2): exact vs
     bf16 vs int8 gradient exchange (error feedback on), ZeRO-1 on/off,
@@ -1176,6 +1209,7 @@ def main():
                          ("serving_flash_decode",
                           bench_serving_flash_decode),
                          ("serving_tp", bench_serving_tp),
+                         ("serving_spec", bench_serving_spec),
                          ("multichip_commopt", bench_multichip_commopt),
                          ("coldstart", bench_coldstart),
                          ("flash_blocks", bench_flash_blocks)):

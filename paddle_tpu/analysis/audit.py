@@ -289,9 +289,18 @@ def audit_engine(engine, compile_budget=None, rules=None,
         engine = supervisor.engine
     buckets = set(engine.buckets_seen)
     chunk_used = bool(getattr(engine, "chunk_used", False))
+    verify_used = bool(getattr(engine, "verify_used", False))
+    draft_buckets = set(getattr(engine, "draft_buckets_seen", ()))
+    draft_decode = bool(getattr(engine, "draft_decode_used", False))
     if supervisor is not None:
         buckets |= supervisor.buckets_seen_total
         chunk_used |= bool(getattr(supervisor, "chunk_used_total", False))
+        verify_used |= bool(getattr(supervisor, "verify_used_total",
+                                    False))
+        draft_buckets |= set(getattr(supervisor, "draft_buckets_total",
+                                     ()))
+        draft_decode |= bool(getattr(supervisor,
+                                     "draft_decode_used_total", False))
     meta = {
         "n_slots": engine.n_slots, "max_len": engine.max_len,
         "min_prompt_bucket": engine.min_prompt_bucket,
@@ -314,6 +323,28 @@ def audit_engine(engine, compile_budget=None, rules=None,
         "mesh": (engine.tp_geometry()
                  if hasattr(engine, "tp_geometry") else None),
     }
+    spec = getattr(engine, "spec", None)
+    if spec is not None:
+        # speculative config + program usage (the compile-budget rule
+        # counts the verify program and any draft-model lowerings) and
+        # the acceptance ledger — across supervisor incarnations when
+        # audited through one
+        m = engine.metrics
+        acc = {k: getattr(m, k, 0)
+               for k in ("spec_steps", "draft_steps",
+                         "spec_proposed_tokens", "spec_accepted_tokens",
+                         "spec_emitted_tokens")}
+        if supervisor is not None and hasattr(supervisor,
+                                              "spec_counters"):
+            acc = supervisor.spec_counters()
+        rate = (acc["spec_accepted_tokens"] / acc["spec_proposed_tokens"]
+                if acc["spec_proposed_tokens"] else None)
+        meta["spec"] = {
+            "k": spec.k, "draft": spec.draft_kind(),
+            "verify_used": verify_used,
+            "draft_buckets_seen": sorted(draft_buckets),
+            "draft_decode_used": draft_decode,
+            "acceptance": {**acc, "rate": rate}}
     # AOT warm-start visibility: programs restored from the executable
     # cache cost a fresh process zero backend compiles — the honest
     # warm-start compile count is programs minus disk-exec entries

@@ -38,7 +38,7 @@ def lower_decode_program(engine) -> str:
             engine.cache.block_tables.copy(),
             jnp.asarray(engine._tok), jnp.asarray(engine._cur),
             engine.cache.active.copy(), jnp.asarray(engine._keys),
-            engine._temps.copy())
+            engine._temps.copy(), jnp.asarray(engine._vmask))
         return lowered.as_text()
     if getattr(engine, "kv_layout", "slot") == "paged":
         args = (engine._w, jnp.asarray(engine.cache.kc),
@@ -46,7 +46,8 @@ def lower_decode_program(engine) -> str:
                 jnp.asarray(engine.cache.block_tables),
                 jnp.asarray(engine._tok), jnp.asarray(engine._cur),
                 jnp.asarray(engine.cache.active),
-                jnp.asarray(engine._keys), jnp.asarray(engine._temps))
+                jnp.asarray(engine._keys), jnp.asarray(engine._temps),
+                jnp.asarray(engine._vmask))
         lowered = jax.jit(_paged_decode_impl,
                           static_argnames=_PAGED_DECODE_STATICS).lower(
             *args, **engine._decode_statics)
@@ -54,7 +55,8 @@ def lower_decode_program(engine) -> str:
     args = (engine._w, jnp.asarray(engine.cache.kc),
             jnp.asarray(engine.cache.vc), jnp.asarray(engine._tok),
             jnp.asarray(engine._cur), jnp.asarray(engine.cache.active),
-            jnp.asarray(engine._keys), jnp.asarray(engine._temps))
+            jnp.asarray(engine._keys), jnp.asarray(engine._temps),
+            jnp.asarray(engine._vmask))
     lowered = jax.jit(_decode_impl,
                       static_argnames=_STATICS).lower(
         *args, **engine._statics)

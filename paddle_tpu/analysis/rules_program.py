@@ -428,13 +428,24 @@ def _compile_budget(view):
         chunk = 1 if m.get("chunk_used") else 0
         # paged budget: the block table is a plain RUNTIME operand, so
         # paging itself adds zero lowerings; chunked prefill adds
-        # exactly ONE shared chunk program regardless of prompt length
+        # exactly ONE shared chunk program regardless of prompt length.
+        # Speculative decoding adds ONE verify program (chunk-shaped,
+        # per draft width k); a model draft additionally pays its own
+        # prefill buckets + one fused draft decode (n-gram/custom
+        # proposers are host-side: zero programs)
+        spec = m.get("spec") or {}
+        verify = 1 if spec.get("verify_used") else 0
+        draft_buckets = sorted(spec.get("draft_buckets_seen", ()))
+        draft = len(draft_buckets) \
+            + (1 if spec.get("draft_decode_used") else 0)
         programs = len(buckets) + (1 if m.get("decode_used") else 0) \
-            + chunk
+            + chunk + verify + draft
         budget = m.get("compile_budget")
         view.metrics["compile-budget"] = {
             "programs": programs, "prefill_buckets": buckets,
-            "chunk_program": bool(chunk), "budget": budget}
+            "chunk_program": bool(chunk), "budget": budget,
+            "verify_program": bool(verify),
+            "draft_programs": draft}
         pc = m.get("prefill_chunk")
         # a request of length <= prefill_chunk legitimately buckets to
         # the next power of two above it; anything beyond that should
@@ -456,7 +467,10 @@ def _compile_budget(view):
                 "compile-budget", "high",
                 f"{programs} XLA programs compiled ({len(buckets)} "
                 f"prefill buckets {buckets} + decode"
-                + (" + chunk" if chunk else "") + ") exceeds the "
+                + (" + chunk" if chunk else "")
+                + (" + verify" if verify else "")
+                + (f" + {draft} draft" if draft else "")
+                + ") exceeds the "
                 f"declared budget of {budget}",
                 location="serving.Engine",
                 suggested_fix="cap prompt bucketing (raise "

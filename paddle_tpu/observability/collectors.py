@@ -42,7 +42,9 @@ def _serving_families():
         "requests_timed_out", "requests_cancelled", "requests_shed",
         "tokens_generated", "prefills", "decode_steps", "preemptions",
         "chunked_prefills", "chunk_steps", "prefix_hit_tokens",
-        "prompt_tokens", "cow_copies")
+        "prompt_tokens", "cow_copies", "spec_steps", "draft_steps",
+        "spec_proposed_tokens", "spec_accepted_tokens",
+        "spec_emitted_tokens")
     yield _fam("paddle_serving_events_total", "counter",
                "serving-engine counters summed across live engines",
                [({"kind": k}, t[k]) for k in counter_keys])
@@ -51,6 +53,9 @@ def _serving_families():
               ("peak_active", t["peak_active"])]
     if t["prefix_hit_rate"] is not None:
         gauges.append(("prefix_hit_rate", t["prefix_hit_rate"]))
+    if t.get("spec_acceptance_rate") is not None:
+        gauges.append(("spec_acceptance_rate",
+                       t["spec_acceptance_rate"]))
     if t["pool_low_watermark"] is not None:
         gauges.append(("pool_low_watermark", t["pool_low_watermark"]))
     yield _fam("paddle_serving_gauge", "gauge",

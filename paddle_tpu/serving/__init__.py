@@ -37,6 +37,7 @@ from .resilience import (EngineDraining, EngineSupervisor,  # noqa: F401
                          ServingAborted)
 from .scheduler import (EngineOverloaded, FIFOScheduler,    # noqa: F401
                         PriorityScheduler)
+from .speculative import SpecConfig  # noqa: F401
 
 __all__ = ["Engine", "RequestHandle", "RequestTimeout", "RequestShed",
            "RequestCancelled", "AdoptMismatch", "SlotKVCache",
@@ -44,7 +45,8 @@ __all__ = ["Engine", "RequestHandle", "RequestTimeout", "RequestShed",
            "RadixIndex", "EngineMetrics",
            "RequestMetrics", "ledger", "EngineOverloaded", "FIFOScheduler",
            "PriorityScheduler", "EngineSupervisor", "ServingAborted",
-           "EngineDraining", "ReplicaFleet", "REPLICA_STATES", "save_lm"]
+           "EngineDraining", "ReplicaFleet", "REPLICA_STATES", "save_lm",
+           "SpecConfig"]
 
 
 def save_lm(model, path, precompile=None, n_slots=8, max_len=None,

@@ -45,6 +45,17 @@ ring-gathered full logits with the same PRNG chains, so output stays
 token-identical to the single-device engine. Host-side bookkeeping,
 scheduling, prefix sharing and the adopt()/skip replay machinery are
 untouched by sharding.
+
+``Engine(speculative=SpecConfig(...))`` flips the latency shape:
+instead of one fused decode step per token, a draft proposer (host-side
+n-gram lookahead or a small same-family model) proposes k tokens and
+ONE chunk-shaped verify program scores them at k+1 positions with
+token-identical acceptance — emitted tokens and consumed PRNG splits
+are byte-equal to the non-speculative engine for greedy AND sampled
+decoding (see serving/speculative.py). ``submit(logit_mask=...)``
+threads a per-request vocab mask through every sampled position
+(prefill, decode, chunk and verify) as a runtime operand — constrained
+decoding with zero extra lowerings, replay/migration-safe.
 """
 from __future__ import annotations
 
@@ -121,8 +132,8 @@ class AdoptMismatch(RuntimeError):
 # ---------------------------------------------------------------------------
 
 def _prefill_impl(w, kc, vc, tok, cur_pos, keys, ids, n_prompt, slot, seed,
-                  skip, temp, *, arch, n_heads, n_kv, eps, theta, do_sample,
-                  top_k, top_p):
+                  skip, temp, vmask, *, arch, n_heads, n_kv, eps, theta,
+                  do_sample, top_k, top_p):
     """Prefill one request (ids [1, Lb], right-padded to its bucket) into
     KV slot ``slot``, sample its first token, and register the request's
     PRNG chain. One compile per bucket length Lb.
@@ -173,6 +184,7 @@ def _prefill_impl(w, kc, vc, tok, cur_pos, keys, ids, n_prompt, slot, seed,
     key = jax.lax.fori_loop(0, skip,
                             lambda _, k: jax.random.split(k)[0], key)
     key, sk = jax.random.split(key)
+    logits0 = jnp.where(vmask > 0, logits0, -jnp.inf)
     logits_f = G._filter_logits(logits0[None], temp, do_sample, top_k,
                                 top_p)
     if do_sample:
@@ -186,11 +198,14 @@ def _prefill_impl(w, kc, vc, tok, cur_pos, keys, ids, n_prompt, slot, seed,
     return kc, vc, tok, cur_pos, keys, tok0
 
 
-def _decode_impl(w, kc, vc, tok, cur_pos, active, keys, temps, *, arch,
-                 n_heads, n_kv, eps, theta, do_sample, top_k, top_p):
+def _decode_impl(w, kc, vc, tok, cur_pos, active, keys, temps, vmasks, *,
+                 arch, n_heads, n_kv, eps, theta, do_sample, top_k, top_p):
     """One fused decode step: every active slot advances one token at its
     own position (inactive slots compute masked garbage and keep their
-    state). ONE program for the life of the engine."""
+    state). ONE program for the life of the engine. ``vmasks`` [S, V] is
+    the per-request vocab mask (grammar/JSON-constrained decoding): a
+    plain runtime operand — all-ones rows sample unconstrained, so
+    masking adds zero lowerings."""
     from ..text import generation as G
 
     if arch == "llama":
@@ -223,6 +238,7 @@ def _decode_impl(w, kc, vc, tok, cur_pos, active, keys, temps, *, arch,
         logits = hidden @ w["head"]
     else:
         logits = G._ln(cx["x"][:, 0], w["lnfw"], w["lnfb"]) @ w["head"]
+    logits = jnp.where(vmasks > 0, logits, -jnp.inf)
 
     split = jax.vmap(jax.random.split)(keys)        # [S, 2, 2]
     new_keys, sks = split[:, 0], split[:, 1]
@@ -240,9 +256,9 @@ def _decode_impl(w, kc, vc, tok, cur_pos, active, keys, temps, *, arch,
 
 
 def _paged_prefill_impl(w, kc, vc, tok, cur_pos, keys, ids, n_prompt, slot,
-                        seed, skip, temp, table_row, skip_write, *, arch,
-                        n_heads, n_kv, eps, theta, do_sample, top_k, top_p,
-                        block_size):
+                        seed, skip, temp, table_row, skip_write, vmask, *,
+                        arch, n_heads, n_kv, eps, theta, do_sample, top_k,
+                        top_p, block_size):
     """Paged prefill: the SAME full causal forward as ``_prefill_impl``
     (so the first sampled token is bit-identical to the slot engine and
     ``generate()``), but K/V lands in the paged pool through the slot's
@@ -297,6 +313,7 @@ def _paged_prefill_impl(w, kc, vc, tok, cur_pos, keys, ids, n_prompt, slot,
     key = jax.lax.fori_loop(0, skip,
                             lambda _, k: jax.random.split(k)[0], key)
     key, sk = jax.random.split(key)
+    logits0 = jnp.where(vmask > 0, logits0, -jnp.inf)
     logits_f = G._filter_logits(logits0[None], temp, do_sample, top_k,
                                 top_p)
     if do_sample:
@@ -311,8 +328,9 @@ def _paged_prefill_impl(w, kc, vc, tok, cur_pos, keys, ids, n_prompt, slot,
 
 
 def _paged_decode_impl(w, kc, vc, tables, tok, cur_pos, active, keys,
-                       temps, *, arch, n_heads, n_kv, eps, theta, do_sample,
-                       top_k, top_p, block_size, flash_decode=False):
+                       temps, vmasks, *, arch, n_heads, n_kv, eps, theta,
+                       do_sample, top_k, top_p, block_size,
+                       flash_decode=False):
     """One fused paged decode step: every decode-active slot advances a
     token at its own position, writing K/V through its block table
     (inactive rows scatter into the trash block so a freed slot's stale
@@ -361,6 +379,7 @@ def _paged_decode_impl(w, kc, vc, tables, tok, cur_pos, active, keys,
         logits = hidden @ w["head"]
     else:
         logits = G._ln(cx["x"][:, 0], w["lnfw"], w["lnfb"]) @ w["head"]
+    logits = jnp.where(vmasks > 0, logits, -jnp.inf)
 
     split = jax.vmap(jax.random.split)(keys)        # [S, 2, 2]
     new_keys, sks = split[:, 0], split[:, 1]
@@ -378,8 +397,8 @@ def _paged_decode_impl(w, kc, vc, tables, tok, cur_pos, active, keys,
 
 def _paged_chunk_impl(w, kc, vc, tok, cur_pos, keys, ids, chunk_start,
                       n_prompt, slot, table_row, skip_write, is_final,
-                      seed, skip, temp, *, arch, n_heads, n_kv, eps, theta,
-                      do_sample, top_k, top_p, block_size):
+                      seed, skip, temp, vmask, *, arch, n_heads, n_kv, eps,
+                      theta, do_sample, top_k, top_p, block_size):
     """One block-aligned prefill CHUNK of one slot, co-schedulable with
     the fused decode step: processes ``ids`` ([1, C], global positions
     ``chunk_start + j``) through every layer, scattering its K/V into
@@ -437,6 +456,7 @@ def _paged_chunk_impl(w, kc, vc, tok, cur_pos, keys, ids, chunk_start,
     key = jax.lax.fori_loop(0, skip,
                             lambda _, k: jax.random.split(k)[0], key)
     key, sk = jax.random.split(key)
+    logits0 = jnp.where(vmask > 0, logits0, -jnp.inf)
     logits_f = G._filter_logits(logits0[None], temp, do_sample, top_k,
                                 top_p)
     if do_sample:
@@ -454,9 +474,9 @@ def _paged_chunk_impl(w, kc, vc, tok, cur_pos, keys, ids, chunk_start,
 
 
 def _tp_prefill_impl(w, kc, vc, tok, cur_pos, keys, ids, n_prompt, slot,
-                     seed, skip, temp, table_row, skip_write, *, arch,
-                     n_heads, n_kv, eps, theta, do_sample, top_k, top_p,
-                     block_size, tp):
+                     seed, skip, temp, table_row, skip_write, vmask, *,
+                     arch, n_heads, n_kv, eps, theta, do_sample, top_k,
+                     top_p, block_size, tp):
     """Tensor-parallel paged prefill (runs INSIDE shard_map over the
     ``tp`` mesh axis): same causal forward and PRNG chain as
     ``_paged_prefill_impl``, but every weight leaf / the KV pool arrive
@@ -516,6 +536,7 @@ def _tp_prefill_impl(w, kc, vc, tok, cur_pos, keys, ids, n_prompt, slot,
     key = jax.lax.fori_loop(0, skip,
                             lambda _, k: jax.random.split(k)[0], key)
     key, sk = jax.random.split(key)
+    logits0 = jnp.where(vmask > 0, logits0, -jnp.inf)
     logits_f = G._filter_logits(logits0[None], temp, do_sample, top_k,
                                 top_p)
     if do_sample:
@@ -530,8 +551,8 @@ def _tp_prefill_impl(w, kc, vc, tok, cur_pos, keys, ids, n_prompt, slot,
 
 
 def _tp_decode_impl(w, kc, vc, tables, tok, cur_pos, active, keys, temps,
-                    *, arch, n_heads, n_kv, eps, theta, do_sample, top_k,
-                    top_p, block_size, tp):
+                    vmasks, *, arch, n_heads, n_kv, eps, theta, do_sample,
+                    top_k, top_p, block_size, tp):
     """Tensor-parallel fused paged decode step (inside shard_map): ONE
     SPMD program for the life of the engine. Each device scatters its
     kv-head shard into its pool shard and attends over its local head
@@ -575,6 +596,7 @@ def _tp_decode_impl(w, kc, vc, tables, tok, cur_pos, active, keys, temps,
     else:
         hidden = G._ln(cx["x"][:, 0], w["lnfw"], w["lnfb"])
     logits = G.matmul_allgather(hidden, w["head"], G._TP_AXIS, tp)
+    logits = jnp.where(vmasks > 0, logits, -jnp.inf)
 
     split = jax.vmap(jax.random.split)(keys)        # [S, 2, 2]
     new_keys, sks = split[:, 0], split[:, 1]
@@ -592,7 +614,7 @@ def _tp_decode_impl(w, kc, vc, tables, tok, cur_pos, active, keys, temps,
 
 def _tp_chunk_impl(w, kc, vc, tok, cur_pos, keys, ids, chunk_start,
                    n_prompt, slot, table_row, skip_write, is_final, seed,
-                   skip, temp, *, arch, n_heads, n_kv, eps, theta,
+                   skip, temp, vmask, *, arch, n_heads, n_kv, eps, theta,
                    do_sample, top_k, top_p, block_size, tp):
     """Tensor-parallel chunked-prefill step (inside shard_map): the SAME
     one-extra-lowering contract as ``_paged_chunk_impl`` — every chunk
@@ -648,6 +670,7 @@ def _tp_chunk_impl(w, kc, vc, tok, cur_pos, keys, ids, chunk_start,
     key = jax.lax.fori_loop(0, skip,
                             lambda _, k: jax.random.split(k)[0], key)
     key, sk = jax.random.split(key)
+    logits0 = jnp.where(vmask > 0, logits0, -jnp.inf)
     logits_f = G._filter_logits(logits0[None], temp, do_sample, top_k,
                                 top_p)
     if do_sample:
@@ -662,6 +685,83 @@ def _tp_chunk_impl(w, kc, vc, tok, cur_pos, keys, ids, chunk_start,
                         cur_pos)
     keys = jnp.where(fin, keys.at[slot].set(key), keys)
     return kc, vc, tok, cur_pos, keys, tok0
+
+
+def _spec_verify_impl(w, kc, vc, keys, ids, start, slot, table_row,
+                      n_write, temp, vmask, *, arch, n_heads, n_kv, eps,
+                      theta, do_sample, top_k, top_p, block_size):
+    """Speculative verify: ONE fused pass over a k-token draft chunk of
+    one slot, scoring k+1 positions (the chunked-prefill program shape
+    — ``generation._llama/_gpt_verify_layer`` share the chunk-layer
+    math). ``ids`` [1, k+1] = [last emitted token, d_1..d_k] at global
+    positions ``start + j``; candidate K/V scatters through the slot's
+    block-table row with positions at/above ``n_write`` (draft width
+    clamped by remaining budget / max_len) trash-redirected.
+
+    Token-identical acceptance, on-device half: starting from the
+    slot's CURRENT chain key (``keys[slot]``), each position re-runs the
+    request's own sampling with exactly the split the non-speculative
+    decode step would have consumed — returns the k+1 chain-sampled
+    tokens plus the key-chain state after each split. The host accepts
+    draft tokens while they equal the chain samples, emits the first
+    mismatch's chain sample as the corrective token, rewinds ``cur`` to
+    the accepted length and restores ``keys[slot]`` to the matching
+    chain state — so tokens AND consumed PRNG splits are byte-equal to
+    the non-speculative engine (greedy and sampled), and adopt()/replay
+    machinery is untouched. ``vmask`` [V] is the request's vocab mask
+    (all-ones when unconstrained), applied exactly as in the decode
+    program."""
+    from ..text import generation as G
+
+    K1 = ids.shape[1]
+    gpos = start + jnp.arange(K1)
+    writable = jnp.arange(K1) < n_write
+    wdest = jnp.where(writable,
+                      table_row[gpos // block_size] * block_size
+                      + gpos % block_size,
+                      gpos % block_size)
+    if arch == "llama":
+        x = jnp.take(w["embed"], ids, axis=0)
+        stack = {k: w[k] for k in G._LLAMA_STACK_KEYS}
+
+        def one(cx, lw_kv):
+            x2, kc_l, vc_l = G._llama_verify_layer(
+                cx["x"], lw_kv, lw_kv["kc"], lw_kv["vc"], table_row, gpos,
+                wdest, n_heads=n_heads, n_kv=n_kv, eps=eps, theta=theta,
+                block_size=block_size)
+            return {"x": x2}, (kc_l, vc_l)
+    else:
+        x = jnp.take(w["wte"], ids, axis=0) + w["wpe"][gpos][None]
+        stack = {k: w[k] for k in G._GPT_STACK_KEYS}
+
+        def one(cx, lw_kv):
+            x2, kc_l, vc_l = G._gpt_verify_layer(
+                cx["x"], lw_kv, lw_kv["kc"], lw_kv["vc"], table_row, gpos,
+                wdest, n_heads=n_heads, block_size=block_size)
+            return {"x": x2}, (kc_l, vc_l)
+
+    lw_kv = dict(stack)
+    lw_kv["kc"] = kc
+    lw_kv["vc"] = vc
+    cx, (kc, vc) = jax.lax.scan(one, {"x": x}, lw_kv)
+    if arch == "llama":
+        logits = G._rms(cx["x"], w["norm"], eps)[0] @ w["head"]
+    else:
+        logits = G._ln(cx["x"][0], w["lnfw"], w["lnfb"]) @ w["head"]
+    logits = jnp.where(vmask[None, :] > 0, logits, -jnp.inf)   # [K1, V]
+
+    def samp(key, logits_i):
+        key, sk = jax.random.split(key)
+        lf = G._filter_logits(logits_i[None], temp, do_sample, top_k,
+                              top_p)
+        if do_sample:
+            t = jax.random.categorical(sk, lf, axis=-1)[0]
+        else:
+            t = jnp.argmax(lf, axis=-1)[0]
+        return key, (t.astype(jnp.int32), key)
+
+    _, (samples, chain) = jax.lax.scan(samp, keys[slot], logits)
+    return kc, vc, samples, chain
 
 
 _STATICS = ("arch", "n_heads", "n_kv", "eps", "theta", "do_sample",
@@ -685,7 +785,9 @@ def _serving_code_token():
         from ..distributed import collective_matmul as _cm
         from ..ops.pallas import flash_decode as _fd
         from ..text import generation as G
-        _CODE_TOKEN = _akeys.code_token(G, _cm, _fd, sys.modules[__name__])
+        from . import speculative as _spec
+        _CODE_TOKEN = _akeys.code_token(G, _cm, _fd, _spec,
+                                        sys.modules[__name__])
     return _CODE_TOKEN
 
 
@@ -697,7 +799,7 @@ def _serving_code_token():
 #: still hits this cache and re-traces nothing in-process.
 _TP_PROGRAMS: dict = {}
 
-_TP_IN_REST = {"prefill": 11, "decode": 6, "chunk": 13}
+_TP_IN_REST = {"prefill": 12, "decode": 7, "chunk": 14}
 _TP_IMPLS = {"prefill": _tp_prefill_impl, "decode": _tp_decode_impl,
              "chunk": _tp_chunk_impl}
 
@@ -750,6 +852,10 @@ _PAGED_DECODE_DONATED = jax.jit(_paged_decode_impl,
                                 donate_argnums=(1, 2))
 _PAGED_CHUNK = jax.jit(_paged_chunk_impl, static_argnames=_PAGED_STATICS)
 _PAGED_CHUNK_DONATED = jax.jit(_paged_chunk_impl,
+                               static_argnames=_PAGED_STATICS,
+                               donate_argnums=(1, 2))
+_SPEC_VERIFY = jax.jit(_spec_verify_impl, static_argnames=_PAGED_STATICS)
+_SPEC_VERIFY_DONATED = jax.jit(_spec_verify_impl,
                                static_argnames=_PAGED_STATICS,
                                donate_argnums=(1, 2))
 
@@ -820,7 +926,8 @@ class RequestHandle:
     """
 
     def __init__(self, engine, request_id, prompt_ids, max_new_tokens,
-                 temperature, seed, on_token, max_time_s=None, priority=0):
+                 temperature, seed, on_token, max_time_s=None, priority=0,
+                 logit_mask=None):
         self._engine = engine
         self.request_id = request_id
         self.prompt_ids = prompt_ids
@@ -830,6 +937,9 @@ class RequestHandle:
         self.seed = int(seed)
         self.on_token = on_token
         self.priority = int(priority)
+        # per-request vocab mask (constrained decoding); adopt()/replay
+        # carries it, so a migrated request stays constrained
+        self.logit_mask = logit_mask
         self.max_time_s = None if max_time_s is None else float(max_time_s)
         self.deadline = (None if max_time_s is None
                          else time.monotonic() + float(max_time_s))
@@ -915,7 +1025,8 @@ class Engine:
                  default_retry_after_s=DEFAULT_RETRY_AFTER_S,
                  kv_layout="paged", block_size=16, n_blocks=None,
                  prefill_chunk=None, prefix_sharing=True, tp=1,
-                 mesh=None, replica_id=None, flash_decode=False):
+                 mesh=None, replica_id=None, flash_decode=False,
+                 speculative=None):
         self._w, self._hp, geo = _make_arch(model)
         #: fleet identity: stamped onto handles and carried by
         #: RequestTimeout/RequestShed/EngineOverloaded (None standalone)
@@ -957,6 +1068,21 @@ class Engine:
             raise ValueError("flash_decode is not supported with tp > 1 "
                              "yet (the TP decode shards attention over "
                              "the mesh)")
+        # speculative decoding (draft-verify; see serving/speculative.py):
+        # the verify program is chunk-shaped against the paged pool, and
+        # the TP decode shards attention over the mesh — both gates below
+        self.spec = speculative
+        if self.spec is not None:
+            from .speculative import SpecConfig
+            if not isinstance(self.spec, SpecConfig):
+                raise TypeError("speculative= takes a SpecConfig")
+            if kv_layout != "paged":
+                raise ValueError("speculative decoding requires "
+                                 "kv_layout='paged' (the verify program "
+                                 "writes through block tables)")
+            if self.tp > 1:
+                raise ValueError("speculative decoding is not supported "
+                                 "with tp > 1 yet")
         self.kv_layout = kv_layout
         self.prefix_sharing = bool(prefix_sharing) and kv_layout == "paged"
         self._chunking = []        # in-progress chunked prefills (paged)
@@ -994,6 +1120,12 @@ class Engine:
         self._cur = np.zeros(self.n_slots, np.int32)
         self._keys = np.zeros((self.n_slots, 2), np.uint32)
         self._temps = np.ones(self.n_slots, np.float32)
+        # per-request vocab masks (grammar/JSON-constrained decoding):
+        # a plain [n_slots, V] runtime operand of the decode AND verify
+        # programs — all-ones rows are unconstrained, so the feature
+        # costs zero lowerings and leaves unmasked sampling bit-exact
+        self._vocab = int(self._w["head"].shape[-1])
+        self._vmask = np.ones((self.n_slots, self._vocab), np.float32)
         if self.tp > 1:
             # commit the KV pool (head dim split over tp) and the small
             # replicated state up front so every program call sees one
@@ -1057,6 +1189,21 @@ class Engine:
         self.buckets_seen = set()
         self.compile_budget = (None if compile_budget is None
                                else int(compile_budget))
+        # speculative-program ledger (compile-budget rule): the verify
+        # program is ONE extra lowering once any slot verifies; a model
+        # draft additionally pays its own prefill buckets + one fused
+        # draft decode (ngram / custom proposers are host-side: zero)
+        self.verify_used = False
+        self.draft_buckets_seen = set()
+        self.draft_decode_used = False
+        if self.spec is not None:
+            from .speculative import make_runtime
+            self._verify = (_SPEC_VERIFY_DONATED if donate
+                            else _SPEC_VERIFY)
+            self._spec = make_runtime(self, self.spec, model)
+        else:
+            self._verify = None
+            self._spec = None
         self.metrics.tp = self.tp
         if self.tp > 1:
             g = self.tp_geometry()
@@ -1218,12 +1365,14 @@ class Engine:
         keys = jax.ShapeDtypeStruct((S, 2), np.uint32, sharding=rep)
         temps = jax.ShapeDtypeStruct((S,), np.float32)
         active = jax.ShapeDtypeStruct((S,), np.bool_)
+        vmasks = jax.ShapeDtypeStruct((S, self._vocab), np.float32)
         i32 = jax.ShapeDtypeStruct((), np.int32)
         u32 = jax.ShapeDtypeStruct((), np.uint32)
         f32 = jax.ShapeDtypeStruct((), np.float32)
         if buckets is None:
             buckets = self._aot_buckets()
         specs = []
+        vrow = jax.ShapeDtypeStruct((self._vocab,), np.float32)
         if self.kv_layout == "paged":
             # TP programs bake their statics into the shard_map closure
             stat = {} if self.tp > 1 else self._paged_statics
@@ -1235,19 +1384,29 @@ class Engine:
                 specs.append((
                     "prefill", ("prefill", int(Lb)), self._prefill,
                     (w, kc, vc, tok, cur, keys, ids, i32, i32, u32, i32,
-                     f32, trow, i32),
+                     f32, trow, i32, vrow),
                     stat, f"prefill:L{Lb}"))
             specs.append((
                 "decode", ("decode",), self._decode,
-                (w, kc, vc, tables, tok, cur, active, keys, temps),
+                (w, kc, vc, tables, tok, cur, active, keys, temps,
+                 vmasks),
                 {} if self.tp > 1 else self._decode_statics, "decode"))
+            if self.spec is not None:
+                K1 = self.spec.k + 1
+                sids = jax.ShapeDtypeStruct((1, K1), np.int32)
+                specs.append((
+                    "verify", ("verify", K1), self._verify,
+                    (w, kc, vc, keys, sids, i32, i32, trow, i32, f32,
+                     vrow),
+                    self._paged_statics, "spec.verify"))
+                specs.extend(self._spec.probe_specs(buckets))
             if self.prefill_chunk is not None:
                 ids = jax.ShapeDtypeStruct((1, self.prefill_chunk),
                                            np.int32)
                 specs.append((
                     "chunk", ("chunk",), self._chunk,
                     (w, kc, vc, tok, cur, keys, ids, i32, i32, i32, trow,
-                     i32, i32, u32, i32, f32),
+                     i32, i32, u32, i32, f32, vrow),
                     stat, "chunk"))
         else:
             for Lb in buckets:
@@ -1255,11 +1414,11 @@ class Engine:
                 specs.append((
                     "prefill", ("prefill", int(Lb)), self._prefill,
                     (w, kc, vc, tok, cur, keys, ids, i32, i32, u32, i32,
-                     f32),
+                     f32, vrow),
                     self._statics, f"prefill:L{Lb}"))
             specs.append((
                 "decode", ("decode",), self._decode,
-                (w, kc, vc, tok, cur, active, keys, temps),
+                (w, kc, vc, tok, cur, active, keys, temps, vmasks),
                 self._decode_statics, "decode"))
         return specs
 
@@ -1298,7 +1457,8 @@ class Engine:
         return ids
 
     def submit(self, prompt, max_new_tokens=32, temperature=1.0,
-               seed=None, on_token=None, max_time_s=None, priority=0):
+               seed=None, on_token=None, max_time_s=None, priority=0,
+               logit_mask=None):
         """Enqueue a request; returns a RequestHandle immediately. The
         request prefills as soon as a slot + token budget admit it (often
         inside this call). Raises EngineOverloaded past max_queue.
@@ -1314,10 +1474,27 @@ class Engine:
         (:class:`~paddle_tpu.serving.resilience.EngineSupervisor`) sheds
         the highest-numbered queued classes first. Within a class,
         deadline-carrying requests admit earliest-deadline-first and
-        the rest keep strict FIFO (see PriorityScheduler)."""
+        the rest keep strict FIFO (see PriorityScheduler).
+
+        ``logit_mask`` (grammar/JSON-constrained decoding) is a [vocab]
+        mask (bool or numeric, nonzero = allowed) applied to EVERY
+        sampled position of THIS request — prefill (the first token),
+        decode, chunked prefill and speculative verify — as a plain
+        runtime operand: zero new lowerings, co-batched neighbours
+        untouched, and adopt()/replay re-samples under the same mask so
+        constrained requests migrate token-identically."""
         ids = self._as_ids(prompt)
         if ids.shape[0] < 1:
             raise ValueError("empty prompt")
+        if logit_mask is not None:
+            m = np.asarray(logit_mask)
+            if m.shape != (self._vocab,):
+                raise ValueError(
+                    f"logit_mask must have shape ({self._vocab},), got "
+                    f"{m.shape}")
+            logit_mask = (m > 0).astype(np.float32)
+            if not logit_mask.any():
+                raise ValueError("logit_mask allows no tokens")
         if max_time_s is not None and float(max_time_s) <= 0:
             raise ValueError("max_time_s must be positive")
         if ids.shape[0] + int(max_new_tokens) > self.max_len:
@@ -1336,7 +1513,8 @@ class Engine:
         h = RequestHandle(
             self, rid, ids, max_new_tokens, temperature,
             self.base_seed + rid if seed is None else seed, on_token,
-            max_time_s=max_time_s, priority=priority)
+            max_time_s=max_time_s, priority=priority,
+            logit_mask=logit_mask)
         self.metrics.requests_submitted += 1
         _tracing.instant("serving.submit", cat="serving",
                          trace_id=h.trace_id, request_id=rid,
@@ -1411,6 +1589,8 @@ class Engine:
         h.slot = slot
         self._by_slot[slot] = h
         self._temps[slot] = h.temperature
+        self._vmask[slot] = (1.0 if h.logit_mask is None
+                             else h.logit_mask)
         Lb = self._bucket(n_eff)
         self.buckets_seen.add(Lb)
         ids = np.zeros((1, Lb), np.int32)
@@ -1429,7 +1609,8 @@ class Engine:
                 (self._w, self.cache.kc, self.cache.vc, self._tok,
                  self._cur, self._keys, ids, np.int32(n_eff),
                  np.int32(slot), np.uint32(h.seed), np.int32(k),
-                 np.float32(h.temperature)), self._statics,
+                 np.float32(h.temperature),
+                 self._vmask[slot].copy()), self._statics,
                 f"prefill:L{Lb}")
         (self.cache.kc, self.cache.vc, self._tok, self._cur,
          self._keys, tok0) = out
@@ -1455,6 +1636,8 @@ class Engine:
         h.slot = slot
         self._by_slot[slot] = h
         self._temps[slot] = h.temperature
+        self._vmask[slot] = (1.0 if h.logit_mask is None
+                             else h.logit_mask)
         self.metrics.prompt_tokens += n_eff
         self.metrics.prefix_hit_tokens += min(n_shared, n_eff)
         if cow:
@@ -1497,8 +1680,8 @@ class Engine:
                  np.int32(slot), np.uint32(h.seed), np.int32(k),
                  np.float32(h.temperature),
                  self.cache.block_tables[slot].copy(),
-                 np.int32(n_shared)), self._paged_statics,
-                f"prefill:L{Lb}")
+                 np.int32(n_shared), self._vmask[slot].copy()),
+                self._paged_statics, f"prefill:L{Lb}")
         (self.cache.kc, self.cache.vc, self._tok, self._cur,
          self._keys, tok0) = out
         self.metrics.prefills += 1
@@ -1506,6 +1689,8 @@ class Engine:
         if self.prefix_sharing:
             self.cache.commit_prefix(slot, full)
         self._emit(h, int(tok0))
+        if self._spec is not None and not h.finished:
+            self._spec.on_admit(h, full)
         return True
 
     def _chunk_tick(self):
@@ -1533,7 +1718,8 @@ class Engine:
                  self.cache.block_tables[h.slot].copy(),
                  np.int32(cs.n_shared), np.int32(1 if is_final else 0),
                  np.uint32(h.seed), np.int32(cs.skip),
-                 np.float32(h.temperature)), self._paged_statics,
+                 np.float32(h.temperature),
+                 self._vmask[h.slot].copy()), self._paged_statics,
                 "chunk")
         (self.cache.kc, self.cache.vc, self._tok, self._cur,
          self._keys, tok0) = out
@@ -1547,6 +1733,8 @@ class Engine:
             if self.prefix_sharing:
                 self.cache.commit_prefix(h.slot, cs.ids)
             self._emit(h, int(tok0))
+            if self._spec is not None and not h.finished:
+                self._spec.on_admit(h, cs.ids)
 
     # -- paged pool pressure ----------------------------------------------
 
@@ -1717,32 +1905,160 @@ class Engine:
             self.metrics.sample(self.cache.occupancy,
                                 self.scheduler.queue_depth,
                                 active=self.cache.n_active)
-        if n_active:
-            t0 = time.perf_counter()
-            with _tracing.span("serving.decode_step", cat="serving",
-                               n_active=n_active), \
-                    _compile_scope("decode"):
-                if paged:
-                    out = self._run_program(
-                        "decode", ("decode",), self._decode,
-                        (self._w, self.cache.kc, self.cache.vc,
-                         self.cache.block_tables.copy(), self._tok,
-                         self._cur, active, self._keys, self._temps),
-                        self._decode_statics, "decode")
-                else:
-                    out = self._run_program(
-                        "decode", ("decode",), self._decode,
-                        (self._w, self.cache.kc, self.cache.vc,
-                         self._tok, self._cur, active, self._keys,
-                         self._temps), self._decode_statics, "decode")
-            nxt, self.cache.kc, self.cache.vc, self._cur, self._keys = out
-            self._tok = nxt
-            self.metrics.mark_decode(time.perf_counter() - t0)
-            toks = np.asarray(nxt)
-            for slot in np.nonzero(active)[0]:
-                h = self._by_slot[int(slot)]
-                self._emit(h, int(toks[slot]))
+        if not n_active:
+            return 0
+        if self._spec is not None:
+            return self._spec_step(active, n_active)
+        self._decode_once(active, n_active)
         return n_active
+
+    def _decode_once(self, active, n_active):
+        """One fused decode-step invocation over ``active`` rows: every
+        active slot advances exactly one token."""
+        paged = self.kv_layout == "paged"
+        t0 = time.perf_counter()
+        with _tracing.span("serving.decode_step", cat="serving",
+                           n_active=n_active), \
+                _compile_scope("decode"):
+            if paged:
+                out = self._run_program(
+                    "decode", ("decode",), self._decode,
+                    (self._w, self.cache.kc, self.cache.vc,
+                     self.cache.block_tables.copy(), self._tok,
+                     self._cur, active, self._keys, self._temps,
+                     self._vmask.copy()),
+                    self._decode_statics, "decode")
+            else:
+                out = self._run_program(
+                    "decode", ("decode",), self._decode,
+                    (self._w, self.cache.kc, self.cache.vc,
+                     self._tok, self._cur, active, self._keys,
+                     self._temps, self._vmask.copy()),
+                    self._decode_statics, "decode")
+        nxt, self.cache.kc, self.cache.vc, self._cur, self._keys = out
+        self._tok = nxt
+        self.metrics.mark_decode(time.perf_counter() - t0)
+        toks = np.asarray(nxt)
+        for slot in np.nonzero(active)[0]:
+            h = self._by_slot[int(slot)]
+            self._emit(h, int(toks[slot]))
+
+    # -- speculative decoding (draft-verify; serving/speculative.py) ------
+
+    @staticmethod
+    def _host(a):
+        """Writable host copy of a (possibly device) state vector."""
+        a = np.asarray(a)
+        return a if a.flags.writeable else a.copy()
+
+    def _ensure_spec_capacity(self, h, k_eff):
+        """Reserve writable blocks for the verify chunk's k_eff+1
+        candidate lines (positions cur..cur+k_eff), preempting like the
+        decode path on pool exhaustion. False when ``h`` itself got
+        preempted along the way (the caller skips its verify)."""
+        base = int(self.cache.cur_pos[h.slot])
+        for pos in range(base, base + k_eff + 1):
+            while not self.cache.ensure(h.slot, pos):
+                victim = self._pick_preempt_victim(exclude=h)
+                if victim is None:
+                    return False     # lone request: clamp handled upstream
+                self._preempt(victim)
+                if h.slot is None:
+                    return False
+        return True
+
+    def _spec_step(self, active, n_active):
+        """One speculative engine iteration: propose k tokens per
+        eligible slot (host n-gram lookahead or the fused draft-model
+        decode), verify each slot's chunk in ONE chunk-shaped program
+        invocation, and emit the accepted prefix + one chain-sampled
+        token — between 1 and k+1 tokens per slot per step, always
+        byte-equal to what the non-speculative engine would emit.
+        Slots with no proposal (no n-gram match, draft width clamped to
+        zero near max_new/max_len) take the plain fused decode step, so
+        the decode program stays live in mixed traffic."""
+        k = self.spec.k
+        cand, plain = [], np.zeros(self.n_slots, bool)
+        for slot in np.nonzero(active)[0]:
+            slot = int(slot)
+            h = self._by_slot[slot]
+            if h is None:
+                continue
+            remaining = h.max_new_tokens - len(h.tokens)
+            p = int(self.cache.cur_pos[slot])
+            k_cap = min(k, remaining - 1, self.max_len - 1 - p)
+            if k_cap >= 1:
+                cand.append((h, k_cap))
+            else:
+                plain[slot] = True
+        proposals = self._spec.propose_all(cand) if cand else {}
+        plan = []
+        for h, k_cap in cand:
+            props = proposals.get(h.slot)
+            if props is None or len(props) == 0:
+                plain[h.slot] = True
+            else:
+                plan.append((h, np.asarray(props[:k_cap], np.int32)))
+        if plain.any():
+            self._decode_once(plain, int(plain.sum()))
+        for h, props in plan:
+            if h.finished or h.slot is None:
+                continue        # finished/preempted earlier this step
+            if not self._ensure_spec_capacity(h, len(props)):
+                continue        # preempted while reserving draft lines
+            self._verify_one(h, props)
+        return n_active
+
+    def _verify_one(self, h, props):
+        """Verify one slot's draft chunk and emit its accepted tokens
+        (token-identical acceptance — see ``_spec_verify_impl``)."""
+        slot, k_eff = h.slot, len(props)
+        p = int(self.cache.cur_pos[slot])
+        K1 = self.spec.k + 1
+        ids = np.zeros((1, K1), np.int32)
+        ids[0, 0] = h.tokens[-1]
+        ids[0, 1:1 + k_eff] = props
+        t0 = time.perf_counter()
+        with _tracing.span("spec.verify", cat="serving",
+                           trace_id=h.trace_id, request_id=h.request_id,
+                           k=k_eff), _compile_scope("verify"):
+            out = self._run_program(
+                "verify", ("verify", K1), self._verify,
+                (self._w, self.cache.kc, self.cache.vc, self._keys, ids,
+                 np.int32(p), np.int32(slot),
+                 self.cache.block_tables[slot].copy(),
+                 np.int32(k_eff + 1), np.float32(h.temperature),
+                 self._vmask[slot].copy()),
+                self._paged_statics, "spec.verify")
+        self.cache.kc, self.cache.vc, samples, chain = out
+        self.verify_used = True
+        samples = np.asarray(samples)
+        chain = np.asarray(chain)
+        m = 0
+        while m < k_eff and samples[m] == props[m]:
+            m += 1
+        e = m + 1           # accepted drafts + the corrective/bonus token
+        # host-side rewind/advance: the slot continues exactly as if it
+        # had taken e fused decode steps — tok/cur/keys jump to the
+        # post-acceptance chain state; rejected candidate lines sit past
+        # the causal bound and are rewritten before ever being readable
+        tok_h = self._host(self._tok)
+        cur_h = self._host(self._cur)
+        keys_h = self._host(self._keys)
+        tok_h[slot] = samples[e - 1]
+        cur_h[slot] = p + e
+        keys_h[slot] = chain[e - 1]
+        self._tok, self._cur, self._keys = tok_h, cur_h, keys_h
+        self.metrics.mark_decode(time.perf_counter() - t0, tokens=e)
+        self.metrics.spec_steps += 1
+        self.metrics.spec_proposed_tokens += k_eff
+        self.metrics.spec_accepted_tokens += m
+        self.metrics.spec_emitted_tokens += e
+        for t in samples[:e]:
+            self._emit(h, int(t))
+            if h.finished:
+                return
+        self._spec.after_verify(h, int(samples[e - 1]), p + e)
 
     def _emit(self, h, token):
         if self._condemned:
@@ -1825,6 +2141,15 @@ class Engine:
             out["prefill_chunk"] = self.prefill_chunk
             out["prefix_sharing"] = self.prefix_sharing
             out["flash_decode"] = self.flash_decode
+        if self.spec is not None:
+            ar = self.metrics.acceptance_rate()
+            out["speculative"] = {
+                "k": self.spec.k, "draft": self.spec.draft_kind(),
+                "verify_used": self.verify_used,
+                "draft_buckets_seen": sorted(self.draft_buckets_seen),
+                "draft_decode_used": self.draft_decode_used,
+                "acceptance_rate": (None if ar is None
+                                    else round(ar, 4))}
         out["tp"] = self.tp
         if self.tp > 1:
             out["mesh"] = self.tp_geometry()

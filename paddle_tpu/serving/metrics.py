@@ -100,6 +100,14 @@ class EngineMetrics:
         self.pool_occupancy_sum = 0.0  # used/total blocks per sample
         self.pool_samples = 0
         self.pool_low_watermark = None  # min free blocks ever seen
+        # speculative decoding (zero on non-speculative engines):
+        # verify invocations, fused draft-decode steps, and the
+        # proposed/accepted/emitted token ledger behind acceptance_rate
+        self.spec_steps = 0
+        self.draft_steps = 0
+        self.spec_proposed_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_emitted_tokens = 0
         # fleet identity (stamped by the engine; None standalone) —
         # bench/chaos ledgers embedding a snapshot attribute it to the
         # replica that produced it
@@ -141,9 +149,27 @@ class EngineMetrics:
             return None
         return self.prefix_hit_tokens / self.prompt_tokens
 
-    def mark_decode(self, duration_s):
+    def mark_decode(self, duration_s, tokens=1):
+        """Record one target-model step (fused decode OR speculative
+        verify). ``tokens`` is how many tokens the step emitted per
+        participating request: the ITL histogram records PER-EMITTED-
+        TOKEN intervals (``tokens`` observations of
+        ``duration_s/tokens``), so the brownout SLO p95 and the
+        ``retry_after_s`` hint stay meaningful when one speculative
+        step yields >1 token — and stay bit-unchanged at tokens=1 (the
+        non-speculative/k=0 path)."""
         self.decode_steps += 1
-        self.itl_hist.observe(duration_s)
+        n = max(int(tokens), 1)
+        per = duration_s / n
+        for _ in range(n):
+            self.itl_hist.observe(per)
+
+    def acceptance_rate(self):
+        """Fraction of proposed draft tokens the verify pass accepted;
+        None before any speculative step."""
+        if not self.spec_proposed_tokens:
+            return None
+        return self.spec_accepted_tokens / self.spec_proposed_tokens
 
     def itl_estimate(self):
         """Rolling-window median decode-step wall time (seconds), None
@@ -163,7 +189,15 @@ class EngineMetrics:
         itl = self.itl_estimate()
         p95 = self.itl_p95()
         hr = self.prefix_hit_rate()
+        ar = self.acceptance_rate()
         return {
+            "spec_steps": self.spec_steps,
+            "draft_steps": self.draft_steps,
+            "spec_proposed_tokens": self.spec_proposed_tokens,
+            "spec_accepted_tokens": self.spec_accepted_tokens,
+            "spec_emitted_tokens": self.spec_emitted_tokens,
+            "spec_acceptance_rate": (None if ar is None
+                                     else round(ar, 4)),
             "requests_submitted": self.requests_submitted,
             "requests_completed": self.requests_completed,
             "requests_rejected": self.requests_rejected,
@@ -220,6 +254,9 @@ def global_counters():
         "prefix_hit_tokens": 0, "prompt_tokens": 0, "cow_copies": 0,
         "peak_active": 0, "prefix_hit_rate": None,
         "pool_low_watermark": None, "tp_max": 1,
+        "spec_steps": 0, "draft_steps": 0, "spec_proposed_tokens": 0,
+        "spec_accepted_tokens": 0, "spec_emitted_tokens": 0,
+        "spec_acceptance_rate": None,
     }
     live = []
     for ref in _ENGINES:
@@ -234,7 +271,9 @@ def global_counters():
                   "requests_cancelled", "requests_shed",
                   "tokens_generated", "prefills", "decode_steps",
                   "preemptions", "chunked_prefills", "chunk_steps",
-                  "prefix_hit_tokens", "prompt_tokens", "cow_copies"):
+                  "prefix_hit_tokens", "prompt_tokens", "cow_copies",
+                  "spec_steps", "draft_steps", "spec_proposed_tokens",
+                  "spec_accepted_tokens", "spec_emitted_tokens"):
             total[k] += s[k]
         total["peak_queue_depth"] = max(total["peak_queue_depth"],
                                         s["peak_queue_depth"])
@@ -249,6 +288,10 @@ def global_counters():
     if total["prompt_tokens"]:
         total["prefix_hit_rate"] = round(
             total["prefix_hit_tokens"] / total["prompt_tokens"], 4)
+    if total["spec_proposed_tokens"]:
+        total["spec_acceptance_rate"] = round(
+            total["spec_accepted_tokens"]
+            / total["spec_proposed_tokens"], 4)
     return total
 
 

@@ -134,6 +134,15 @@ class EngineSupervisor:
         self.buckets_seen_total = set()
         self.chunk_used_total = False   # any incarnation traced the
         self.rebuilds = 0               # chunked-prefill program
+        # speculative ledger across incarnations: program-usage union
+        # (verify/draft lowerings a fresh process would pay) and the
+        # acceptance counters of condemned engines — rebuilds must not
+        # zero the acceptance history (chaos_serve --spec gates this)
+        self.verify_used_total = False
+        self.draft_buckets_total = set()
+        self.draft_decode_used_total = False
+        from .speculative import SPEC_COUNTER_KEYS
+        self.spec_totals = {k: 0 for k in SPEC_COUNTER_KEYS}
         self.replayed = 0              # handles re-admitted with tokens
         self.wedges = 0
         self.step_errors = 0
@@ -347,6 +356,14 @@ class EngineSupervisor:
         survivors = actives + queued
         self.buckets_seen_total |= old.buckets_seen
         self.chunk_used_total |= bool(getattr(old, "chunk_used", False))
+        self.verify_used_total |= bool(getattr(old, "verify_used",
+                                               False))
+        self.draft_buckets_total |= set(getattr(old,
+                                                "draft_buckets_seen", ()))
+        self.draft_decode_used_total |= bool(
+            getattr(old, "draft_decode_used", False))
+        for k in self.spec_totals:
+            self.spec_totals[k] += getattr(old.metrics, k, 0)
         migrated = []
         if self.migrate_hook is not None and survivors:
             migrated = list(self.migrate_hook(self, survivors, why) or ())
@@ -454,13 +471,24 @@ class EngineSupervisor:
                 "abandoned": self.abandoned, "drains": self.drains,
                 "brownout_steps": self.brownout_steps}
 
+    def spec_counters(self):
+        """Speculative acceptance counters summed across every engine
+        incarnation this supervisor has owned (condemned + live): the
+        counters that must SURVIVE a rebuild."""
+        return {k: self.spec_totals[k] + getattr(self.engine.metrics, k,
+                                                 0)
+                for k in self.spec_totals}
+
     def stats(self):
-        return {**self.counters(), "replica": self.replica_id,
-                "brownout": self._brownout, "draining": self.draining,
-                "buckets_seen_total": sorted(
-                    self.buckets_seen_total | self.engine.buckets_seen),
-                "ledger": self.ledger.counts(),
-                "engine": self.engine.stats()}
+        out = {**self.counters(), "replica": self.replica_id,
+               "brownout": self._brownout, "draining": self.draining,
+               "buckets_seen_total": sorted(
+                   self.buckets_seen_total | self.engine.buckets_seen),
+               "ledger": self.ledger.counts(),
+               "engine": self.engine.stats()}
+        if getattr(self.engine, "spec", None) is not None:
+            out["spec_counters_total"] = self.spec_counters()
+        return out
 
     def _abort(self, exc):
         self._aborted = True

@@ -484,6 +484,29 @@ def _gpt_chunk_layer(x, lw, kc_pool, vc_pool, table_row, gpos, wdest, *,
     return x, kc_pool, vc_pool
 
 
+def _llama_verify_layer(x, lw, kc_pool, vc_pool, table_row, gpos, wdest, *,
+                        n_heads, n_kv, eps, theta, block_size):
+    """One Llama layer over a speculative VERIFY chunk: the draft's k+1
+    candidate tokens of one slot at decode positions ``gpos``, candidate
+    K/V scattered through the slot's block table (``wdest`` trash-
+    redirects positions past the effective draft width), attention over
+    the slot's gathered view under the causal bound. Deliberately THE
+    chunk-layer math — verification is a k-token chunk scoring k+1
+    positions, so there is one body to keep conformant with prefill and
+    one extra lowering total."""
+    return _llama_chunk_layer(x, lw, kc_pool, vc_pool, table_row, gpos,
+                              wdest, n_heads=n_heads, n_kv=n_kv, eps=eps,
+                              theta=theta, block_size=block_size)
+
+
+def _gpt_verify_layer(x, lw, kc_pool, vc_pool, table_row, gpos, wdest, *,
+                      n_heads, block_size):
+    """GPT block over a speculative verify chunk (see
+    :func:`_llama_verify_layer`): shares the chunk-layer math."""
+    return _gpt_chunk_layer(x, lw, kc_pool, vc_pool, table_row, gpos,
+                            wdest, n_heads=n_heads, block_size=block_size)
+
+
 # ---------------------------------------------------------------------------
 # tensor-parallel bodies (serving engine, paged KV): the SAME math as the
 # single-device bodies above with the weights column-/row-parallel over a

@@ -110,6 +110,83 @@ def prefix_reuse_sweep(model, cfg, *, n_requests=24, max_new=8,
     }
 
 
+def spec_sweep(model, cfg, *, n_requests=6, max_new=24, k=4,
+               draft_model=None, max_len=96, block_size=16):
+    """Speculative decoding A/B, latency-shaped (one request in flight
+    at a time — the traffic speculative decoding exists for): the same
+    workload through a non-speculative engine, an n-gram-lookahead
+    engine, and a model-draft engine. ``draft_model`` defaults to the
+    TARGET itself — the high-acceptance CPU-measurable proxy (random
+    tiny weights give a real small draft ~0 acceptance, but the
+    TARGET-MODEL-STEPS-per-emitted-token ledger is exact either way and
+    that is the structural claim; the wall-clock ITL win needs real
+    weights on a real TPU and is recorded as window debt). ``ok`` is
+    gated on token-identical outputs across ALL arms and on the
+    model-draft arm spending < 0.6 target steps per emitted token."""
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as paddle  # noqa: F401
+    from paddle_tpu.serving import Engine, SpecConfig, ledger
+
+    rng = np.random.default_rng(7)
+    lens = [(6, 11, 17, 23)[i % 4] for i in range(n_requests)]
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in lens]
+
+    def run(spec):
+        kw = dict(n_slots=2, max_len=max_len, min_prompt_bucket=8,
+                  block_size=block_size)
+        if spec is not None:
+            kw["speculative"] = spec
+        Engine(model, **kw).generate_all(prompts,
+                                         max_new_tokens=max_new)  # warm
+        eng = Engine(model, **kw)
+        handles = []
+        t0 = time.perf_counter()
+        for p in prompts:            # latency-shaped: strictly serial
+            h = eng.submit(p, max_new_tokens=max_new)
+            h.result()
+            handles.append(h)
+        wall = time.perf_counter() - t0
+        m = eng.metrics
+        led = ledger(handles)
+        led.update({
+            "wall_s": round(wall, 3),
+            "tokens_per_sec": round(m.tokens_generated / wall, 2),
+            "target_steps": m.decode_steps,
+            "tokens": m.tokens_generated,
+            "target_steps_per_token": round(
+                m.decode_steps / max(1, m.tokens_generated), 4),
+            "draft_steps": m.draft_steps,
+            "acceptance_rate": (
+                None if m.acceptance_rate() is None
+                else round(m.acceptance_rate(), 4)),
+            "verify_used": eng.verify_used,
+        })
+        return led, [list(h.tokens) for h in handles]
+
+    base_led, base_toks = run(None)
+    ngram_led, ngram_toks = run(SpecConfig(draft="ngram", k=k))
+    draft = model if draft_model is None else draft_model
+    model_led, model_toks = run(SpecConfig(draft=draft, k=k))
+    identical = base_toks == ngram_toks == model_toks
+    return {
+        "requests": n_requests, "max_new": max_new, "k": k,
+        "self_draft": draft_model is None,
+        "nonspec": base_led, "ngram": ngram_led,
+        "model_draft": model_led,
+        "token_identical": identical,
+        "target_steps_per_token": {
+            "nonspec": base_led["target_steps_per_token"],
+            "ngram": ngram_led["target_steps_per_token"],
+            "model_draft": model_led["target_steps_per_token"]},
+        "ok": bool(identical
+                   and model_led["target_steps_per_token"] < 0.6),
+    }
+
+
 def tp_sweep(model, cfg, prompts, tp_degrees, *, max_new=8, n_slots=4,
              max_len=64):
     """Tensor-parallel A/B on the live device set: the same workload
@@ -169,6 +246,10 @@ def main():
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--hidden", type=int, default=256)
     ap.add_argument("--skip-prefix-sweep", action="store_true")
+    ap.add_argument("--spec", action="store_true",
+                    help="add the speculative-decoding sweep (nonspec "
+                         "vs ngram vs self-draft model; ok gated on "
+                         "token identity + <0.6 target steps/token)")
     ap.add_argument("--tp", type=int, nargs="+", default=None,
                     help="tensor-parallel degrees to sweep (virtual "
                          "devices on CPU; must divide the head counts)")
@@ -238,6 +319,11 @@ def main():
         prefix = prefix_reuse_sweep(model, cfg)
         ok = ok and prefix["ok"]
 
+    spec = None
+    if args.spec:
+        spec = spec_sweep(model, cfg)
+        ok = ok and spec["ok"]
+
     tp = None
     if args.tp:
         tp = tp_sweep(model, cfg, prompts, args.tp,
@@ -262,6 +348,7 @@ def main():
         "best_n_slots": best["n_slots"],
         "speedup_vs_sequential": round(best["tokens_per_sec"] / seq_tps, 2),
         "prefix_reuse": prefix,
+        "spec_sweep": spec,
         "tp_sweep": tp,
         "observability": obs.snapshot(),
         "compiles_by_origin": obs.compiles_by_origin(),

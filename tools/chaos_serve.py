@@ -20,6 +20,18 @@ poisoned; probe must detect before decode consumes it) | abandon (client
 disconnect mid-stream) | none. Exit code 0 iff the run recovered with
 token-identical survivors.
 
+``--spec`` runs the verdict against a SPECULATIVE engine
+(``Engine(speculative=SpecConfig(draft="ngram", k=4))``) wrapped in the
+supervisor, with part of the workload vocab-masked repetitive so the
+verify program provably runs before the fault fires. The baseline is
+the plain NON-speculative engine — the verdict asserts the recovered
+speculative run is token-identical to it (the speculative token-
+identity contract composed with fault recovery) and that the
+acceptance counters survive the rebuild (``EngineSupervisor``
+accumulates condemned incarnations' spec counters):
+
+    JAX_PLATFORMS=cpu python tools/chaos_serve.py --spec --fault raise
+
 ``--fleet N`` runs the fleet verdict instead: N supervised replicas
 behind a ``ReplicaFleet`` serve the shared-prefix workload GREEDY and
 SAMPLED while the fault (kill = replica-kill | stall | raise | corrupt |
@@ -160,6 +172,129 @@ def _verdict(fault, step, seed, stall_s):
     }
 
 
+def _spec_workload(seed, vocab):
+    """Speculative chaos workload: two vocab-masked repetitive requests
+    (the emitted stream repeats, so the n-gram proposer fires and the
+    verify program runs before the fault) plus two plain sampled ones
+    (the fused-decode fallback path). Returns (prompt, kwargs) pairs +
+    the pump schedule."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    a, b = (int(t) for t in rng.integers(10, vocab - 10, (2,)))
+    va = np.zeros(vocab, bool)
+    va[a] = True
+    vb = np.zeros(vocab, bool)
+    vb[[a, b]] = True
+    reqs = [
+        (np.full((9,), a, np.int32),
+         dict(max_new_tokens=10, temperature=0.8, seed=11,
+              logit_mask=va)),
+        (np.asarray([a, b] * 5, np.int32),
+         dict(max_new_tokens=9, temperature=1.2, seed=7,
+              logit_mask=vb)),
+        (rng.integers(0, 1000, (5,)).astype(np.int32),
+         dict(max_new_tokens=7, temperature=0.6, seed=3)),
+        (rng.integers(0, 1000, (6,)).astype(np.int32),
+         dict(max_new_tokens=6, temperature=1.0, seed=23)),
+    ]
+    schedule = (2, 1, 1, 0)
+    return reqs, schedule
+
+
+def _run_kw(server, reqs, schedule):
+    handles = []
+    for (ids, kw), pump in zip(reqs, schedule):
+        handles.append(server.submit(ids, **kw))
+        for _ in range(pump):
+            server.step()
+    while any(not h.finished for h in handles):
+        server.step()
+    return handles
+
+
+def _spec_verdict(fault, step, seed, stall_s):
+    """Speculative engine under chaos: recovered output must equal the
+    NON-speculative uninterrupted baseline (token-identity composed
+    through rebuild-and-replay), verify must have actually run, pool
+    refcounts must balance, and the acceptance counters must survive
+    the rebuild."""
+    import dataclasses
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
+    from paddle_tpu.resilience import ChaosMonkey
+    from paddle_tpu.serving import Engine, EngineSupervisor, SpecConfig
+    from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+    obs.enable_tracing()
+    cfg = dataclasses.replace(LLAMA_TINY, dtype="float32",
+                              num_hidden_layers=2)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    kw = dict(n_slots=2, max_len=64, min_prompt_bucket=4, do_sample=True,
+              top_k=8, block_size=8)
+    reqs, schedule = _spec_workload(seed, cfg.vocab_size)
+
+    baseline = _run_kw(Engine(model, **kw), reqs, schedule)
+    base_tokens = [list(h.tokens) for h in baseline]
+
+    chaos = ChaosMonkey(seed=seed,
+                        at=({int(step): _FAULT_MAP[fault]}
+                            if fault != "none" else {}),
+                        stall_s=stall_s)
+    sup = EngineSupervisor(
+        model, chaos=chaos, step_timeout_s=None, kv_probe_interval=1,
+        speculative=SpecConfig(draft="ngram", k=4), **kw)
+    handles = _run_kw(sup, reqs, schedule)
+
+    survivors = [(i, h) for i, h in enumerate(handles)
+                 if h.finish_reason not in ("cancelled",)]
+    mismatches = [i for i, h in survivors
+                  if list(h.tokens) != base_tokens[i]]
+    fired = list(chaos.fired)
+    expected_counter = {"stall": sup.wedges + sup.step_errors,
+                        "raise": sup.step_errors,
+                        "corrupt": sup.kv_corruptions,
+                        "abandon": sup.abandoned}.get(fault, 0)
+    detected = fault == "none" or (bool(fired) and expected_counter > 0)
+    recovered = (fault in ("none", "abandon")
+                 or sup.rebuilds > 0) and not mismatches
+    idle = (sup.engine.cache.n_active == 0
+            and sup.engine.scheduler.queue_depth == 0)
+    refcounts_ok = sup.engine.cache.check_refcounts()
+    spec_total = sup.spec_counters()
+    # the rebuild must not zero acceptance history: when an incarnation
+    # was condemned, its pre-fault counters live in sup.spec_totals
+    counters_survived = (sup.rebuilds == 0
+                         or sup.spec_totals["spec_steps"] > 0)
+    ok = bool(detected and recovered and idle and refcounts_ok
+              and spec_total["spec_steps"] > 0
+              and spec_total["spec_accepted_tokens"] > 0
+              and counters_survived)
+    return {
+        "fault": fault, "injected_step": step, "seed": seed,
+        "speculative": {"draft": "ngram", "k": 4},
+        "requests": len(reqs), "fired": fired,
+        "trace_id": chaos.last_trace_id,
+        "rebuilds": sup.rebuilds, "replayed": sup.replayed,
+        "wedges": sup.wedges, "step_errors": sup.step_errors,
+        "kv_corruptions": sup.kv_corruptions,
+        "survivors": len(survivors), "mismatched_requests": mismatches,
+        "token_identical": not mismatches,
+        "refcounts_consistent": refcounts_ok,
+        "spec_counters_total": spec_total,
+        "spec_counters_survived_rebuild": counters_survived,
+        "acceptance_rate": (
+            None if not spec_total["spec_proposed_tokens"]
+            else round(spec_total["spec_accepted_tokens"]
+                       / spec_total["spec_proposed_tokens"], 4)),
+        "ledger": sup.ledger.counts(),
+        "ok": ok,
+    }
+
+
 def _fleet_verdict(fault, step, seed, stall_s, n_replicas):
     """The fleet robustness headline, both sampling modes: kill / wedge
     / corrupt one of N replicas mid-decode (or flap the router) — zero
@@ -265,6 +400,11 @@ def main(argv=None):
                     "fires (mid-decode for the default workload)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--stall-s", type=float, default=0.05)
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative mode: ngram-draft engine under "
+                    "the supervisor; verdict = token_identical vs the "
+                    "NON-speculative baseline + acceptance counters "
+                    "survive the rebuild")
     ap.add_argument("--fleet", type=int, default=0, metavar="N",
                     help="fleet mode: N supervised replicas behind a "
                     "ReplicaFleet; faults kill/stall/raise/corrupt/"
@@ -296,6 +436,23 @@ def main(argv=None):
 
     if args.fault in ("kill", "flap"):
         ap.error(f"--fault {args.fault} requires --fleet N")
+    if args.spec:
+        record = {"bench": "chaos_serve_spec",
+                  **_spec_verdict(args.fault, args.step, args.seed,
+                                  args.stall_s)}
+        if args.json:
+            print(json.dumps(record, default=str))
+        else:
+            for k in ("fault", "injected_step", "requests", "rebuilds",
+                      "replayed", "survivors", "token_identical",
+                      "acceptance_rate",
+                      "spec_counters_survived_rebuild"):
+                print(f"{k:30s} {record[k]}")
+            print("OK (speculative run recovered token-identically)"
+                  if record["ok"] else
+                  "FAIL: speculative run diverged or lost acceptance "
+                  "counters")
+        return 0 if record["ok"] else 1
     record = {"bench": "chaos_serve",
               **_verdict(args.fault, args.step, args.seed, args.stall_s)}
     if args.json:

@@ -20,6 +20,18 @@ process drives the whole workload with 0 cold XLA backend compiles
 budget once the persistent executable cache lands (without this mode a
 warm cache would read as a spurious budget pass/violation).
 
+``--spec`` is the speculative-decoding contract: a staggered workload
+(half vocab-masked repetitive traffic, so the n-gram proposer
+deterministically fires; half plain random, so the fused-decode
+fallback stays live) through a non-speculative engine and an
+``Engine(speculative=SpecConfig(draft="ngram", k=4))`` engine. The
+speculative engine must compile EXACTLY its declared budget (prefill
+buckets + decode + the ONE chunk-shaped verify program), do 0 warm
+compiles, and emit token-identical output to the non-speculative
+engine (greedy AND sampled) and to batch ``generate()``. Composes with
+``--warm-cache`` (the second process must serve the speculative
+workload, verify program included, at 0 cold backend compiles).
+
 ``--mesh N`` is the tensor-parallel contract: N virtual CPU devices, the
 same workload through a single-device engine and a tp=N engine. The TP
 engine must compile exactly its declared budget (buckets + decode —
@@ -63,11 +75,12 @@ def run_warm_cache(args):
     env = dict(os.environ, PADDLE_TPU_AOT_CACHE_DIR=cache_dir)
     runs = []
     for tag in ("cold", "warm"):
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--json",
-             "--requests", str(args.requests), "--slots", str(args.slots),
-             "--max-new", str(args.max_new)],
-            capture_output=True, text=True, env=env)
+        cmd = [sys.executable, os.path.abspath(__file__), "--json",
+               "--requests", str(args.requests), "--slots",
+               str(args.slots), "--max-new", str(args.max_new)]
+        if getattr(args, "spec", False):
+            cmd.append("--spec")
+        out = subprocess.run(cmd, capture_output=True, text=True, env=env)
         if not out.stdout.strip():
             print(_json.dumps({"bench": "serving_compile_warm_cache",
                                "ok": False,
@@ -77,7 +90,7 @@ def run_warm_cache(args):
     cold, warm = runs
     have = warm["cold_compiles"] is not None
     ok = (cold["ok"] and warm["ok"]
-          and not warm["greedy_mismatches"]
+          and not warm.get("greedy_mismatches")
           and (not have or warm["cold_compiles"] == 0))
     record = {"bench": "serving_compile_warm_cache",
               "cache_dir": cache_dir,
@@ -91,6 +104,182 @@ def run_warm_cache(args):
         print(f"warm-process compiles {record['warm_run_compiles']}")
         print("OK (warm process serves compile-free)" if ok else
               "FAIL: warm cache still compiles (or parity broke)")
+    return 0 if ok else 1
+
+
+def run_spec(args):
+    """Speculative serving contract: budget (buckets + decode + verify,
+    exact), 0 warm compiles, token identity vs the non-speculative
+    engine AND batch generate(), greedy and sampled — with the verify
+    program provably exercised and the plain decode fallback provably
+    live."""
+    import dataclasses
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from paddle_tpu.serving import Engine, SpecConfig
+    from paddle_tpu.text.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+    counter = analysis.CompileEventCounter().install()
+    have_monitor = counter.available
+
+    cfg = dataclasses.replace(LLAMA_TINY, dtype="float32",
+                              num_hidden_layers=2)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    V = cfg.vocab_size
+    min_bucket = 8
+    # even requests: plain random prompts (no n-gram ever matches on a
+    # random model -> the fused decode fallback runs). Odd requests:
+    # single-token repetitive prompts vocab-masked to that token, so
+    # the emitted stream repeats it and the n-gram proposer fires
+    # deterministically -> the verify program runs.
+    reqs = []
+    for i in range(args.requests):
+        if i % 2 == 0:
+            n = 5 + (i % 8)
+            reqs.append((rng.integers(0, V, (n,)).astype(np.int32),
+                         None))
+        else:
+            tok = int(rng.integers(0, V))
+            n = 9 + (i % 4)
+            mask = np.zeros(V, bool)
+            mask[tok] = True
+            reqs.append((np.full((n,), tok, np.int32), mask))
+    new_tokens = [3 + (i % (args.max_new - 2))
+                  for i in range(args.requests)]
+    n_buckets = len({max(min_bucket, 1 << (n - 1).bit_length())
+                     for n, _ in ((len(p), m) for p, m in reqs)})
+    budget = n_buckets + 1                     # the non-spec program set
+    spec_budget = budget + 1                   # + the ONE verify program
+
+    def drive(engine, sampled=False):
+        handles = []
+        it = iter(range(args.requests))
+        for i in (next(it), next(it), next(it)):
+            handles.append(engine.submit(
+                reqs[i][0], max_new_tokens=new_tokens[i],
+                temperature=0.9 if sampled else 1.0, seed=100 + i,
+                logit_mask=reqs[i][1]))
+        for i in it:
+            engine.step()
+            handles.append(engine.submit(
+                reqs[i][0], max_new_tokens=new_tokens[i],
+                temperature=0.9 if sampled else 1.0, seed=100 + i,
+                logit_mask=reqs[i][1]))
+        engine.drain()
+        return handles
+
+    # the plain arm compiles the shared program set (buckets + decode);
+    # the spec arm of the same sampling mode then cold-compiles EXACTLY
+    # ONE more program — the verify chunk (module-level jit cache:
+    # prefill/decode are shared shapes). The spec engine's own declared
+    # budget stays buckets + decode + verify — that is what a fresh
+    # process pays, and the audit compile-budget rule gates it below.
+    # Under a warm AOT cache dir every expected count may also be 0
+    # (deserialized executables).
+    cache_warm = bool(os.environ.get("PADDLE_TPU_AOT_CACHE_DIR"))
+    arms = {}
+    for label, kw, sampled, arm_budget, expected_cold in (
+            ("plain_greedy", {}, False, budget, budget),
+            ("spec_greedy",
+             {"speculative": SpecConfig(draft="ngram", k=4)}, False,
+             spec_budget, 1),
+            ("plain_sampled", {"do_sample": True, "top_k": 8}, True,
+             budget, budget),
+            ("spec_sampled",
+             {"do_sample": True, "top_k": 8,
+              "speculative": SpecConfig(draft="ngram", k=4)}, True,
+             spec_budget, 1)):
+        engine = Engine(model, n_slots=args.slots, max_len=64,
+                        min_prompt_bucket=min_bucket,
+                        compile_budget=arm_budget, **kw)
+        counter.reset()
+        handles = drive(engine, sampled)
+        cold = counter.count
+        counter.reset()
+        handles2 = drive(engine, sampled)
+        warm = counter.count
+        arms[label] = {
+            "cold_compiles": cold if have_monitor else None,
+            "warm_compiles": warm if have_monitor else None,
+            "budget": arm_budget, "expected_cold": expected_cold,
+            "tokens": [list(h.tokens) for h in handles],
+            "tokens2": [list(h.tokens) for h in handles2],
+            "engine": engine}
+
+    greedy_parity = (arms["spec_greedy"]["tokens"]
+                     == arms["plain_greedy"]["tokens"]
+                     == arms["plain_greedy"]["tokens2"]
+                     == arms["spec_greedy"]["tokens2"])
+    sampled_parity = (arms["spec_sampled"]["tokens"]
+                      == arms["plain_sampled"]["tokens"]
+                      == arms["spec_sampled"]["tokens2"])
+    # generate() parity on the unmasked requests (the prefill-sampled
+    # first token of masked requests is unconstrained either way, but
+    # generate() has no mask operand to compare the rest against)
+    gen_parity = all(
+        np.array_equal(
+            np.asarray(arms["spec_greedy"]["tokens"][i], np.int32),
+            np.asarray(model.generate(
+                paddle.to_tensor(reqs[i][0][None]),
+                max_new_tokens=new_tokens[i])._data)
+            [0, len(reqs[i][0]):])
+        for i in range(args.requests) if reqs[i][1] is None)
+
+    spec_eng = arms["spec_greedy"]["engine"]
+    verify_used = (spec_eng.verify_used
+                   and arms["spec_sampled"]["engine"].verify_used)
+    decode_used = ("decode",) in spec_eng._aot
+    acceptance = spec_eng.metrics.acceptance_rate()
+    rep = analysis.audit_engine(spec_eng)
+    budget_high = [f for f in rep.findings
+                   if f.rule_id == "compile-budget"
+                   and f.severity == "high"]
+
+    budgets_ok = not have_monitor or all(
+        (arms[a]["cold_compiles"] == arms[a]["expected_cold"]
+         or (cache_warm and arms[a]["cold_compiles"] == 0))
+        and arms[a]["warm_compiles"] == 0 for a in arms)
+    ok = bool(budgets_ok and greedy_parity and sampled_parity
+              and gen_parity and verify_used and decode_used
+              and not budget_high)
+    for a in arms.values():
+        a.pop("engine")
+        a.pop("tokens")
+        a.pop("tokens2")
+    record = {
+        "bench": "serving_compile_spec", "requests": args.requests,
+        "k": 4, "compile_budget": spec_budget, "arms": arms,
+        "greedy_parity": greedy_parity, "sampled_parity": sampled_parity,
+        "generate_parity": gen_parity, "verify_used": verify_used,
+        "decode_fallback_used": decode_used,
+        "acceptance_rate": acceptance,
+        "budget_metrics": rep.metrics.get("compile-budget"),
+        "ok": ok,
+    }
+    record["cold_compiles"] = (
+        None if not have_monitor
+        else sum(a["cold_compiles"] for a in arms.values()))
+    if args.json:
+        print(json.dumps(record))
+    else:
+        print(f"spec budget {spec_budget} (= {n_buckets} buckets + "
+              "decode + verify)")
+        for a, r in arms.items():
+            print(f"  {a}: cold={r['cold_compiles']} "
+                  f"(expected {r['expected_cold']}) "
+                  f"warm={r['warm_compiles']} budget={r['budget']}")
+        print(f"parity greedy={greedy_parity} sampled={sampled_parity} "
+              f"generate={gen_parity}")
+        print(f"verify used {verify_used}  decode fallback {decode_used}"
+              f"  acceptance {acceptance}")
+        print("OK (speculative serving contract holds)" if ok else
+              "FAIL: speculative engine recompiles or diverges")
     return 0 if ok else 1
 
 
@@ -367,6 +556,11 @@ def main():
     ap.add_argument("--warm-cache", action="store_true",
                     help="subprocess-pair AOT cache gate: the second "
                          "process must do 0 cold backend compiles")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative-decoding mode: ngram-draft engine "
+                         "vs non-speculative parity + budget (the "
+                         "verify program is exactly ONE extra "
+                         "lowering); composes with --warm-cache")
     ap.add_argument("--mesh", type=int, default=0, metavar="N",
                     help="tensor-parallel mode: N virtual devices, "
                          "tp=N engine vs single-device parity + budget")
@@ -390,6 +584,9 @@ def main():
 
     if args.warm_cache:
         return run_warm_cache(args)
+
+    if args.spec:
+        return run_spec(args)
 
     import dataclasses
 
